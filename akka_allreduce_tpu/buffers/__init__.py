@@ -1,0 +1,14 @@
+"""Host-plane staging buffers: a ``max_lag``-deep ring of per-peer arrays.
+
+These are the exact-semantics port of the reference's buffer layer
+(reference: buffer/AllReduceBuffer.scala, buffer/ScatteredDataBuffer.scala,
+buffer/ReducedDataBuffer.scala) to numpy float32. On TPU they serve the host
+control plane (DCN-level coordination, protocol tests, CPU-only emulation);
+the device plane replaces them with XLA collective buffers.
+"""
+
+from akka_allreduce_tpu.buffers.base import AllReduceBuffer
+from akka_allreduce_tpu.buffers.scattered import ScatteredDataBuffer
+from akka_allreduce_tpu.buffers.reduced import ReducedDataBuffer
+
+__all__ = ["AllReduceBuffer", "ScatteredDataBuffer", "ReducedDataBuffer"]

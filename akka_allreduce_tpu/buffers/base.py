@@ -1,0 +1,74 @@
+"""Round-ring staging buffer base.
+
+Semantic port of the reference's ``AllReduceBuffer``
+(reference: buffer/AllReduceBuffer.scala:3-47): a ``max_lag``-deep ring of
+``[peer][element]`` float32 staging arrays with chunk-granular fill counting
+and ring rotation. ``max_lag`` here is the ring depth (the worker passes
+``config.max_lag + 1``, reference: AllreduceWorker.scala:64, :74).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from akka_allreduce_tpu.config import num_chunks as _num_chunks
+
+
+class AllReduceBuffer:
+    """A ring of ``max_lag`` rows; each row stages ``peer_size`` vectors of
+    ``data_size`` float32 elements, filled chunk-by-chunk."""
+
+    def __init__(self, data_size: int, peer_size: int, max_lag: int,
+                 max_chunk_size: int):
+        self.data_size = data_size
+        self.peer_size = peer_size
+        self.max_lag = max_lag
+        self.max_chunk_size = max_chunk_size
+
+        self.temporal_offset = 0
+        self.num_chunks = self.get_num_chunk(data_size)
+        # (ring row, peer, element) staging storage
+        # (reference: AllReduceBuffer.scala:11-15)
+        self.temporal_buffer = np.zeros(
+            (max_lag, peer_size, data_size), dtype=np.float32)
+        # chunk-granular fill counts per ring row
+        # (reference: AllReduceBuffer.scala:23)
+        self.count_filled = np.zeros((max_lag, self.num_chunks), dtype=np.int64)
+
+    def store(self, data: np.ndarray, row: int, src_id: int,
+              chunk_id: int) -> None:
+        """Copy one chunk into the staging slot and bump its fill count.
+
+        Raises IndexError when the chunk overruns the staging vector — the
+        reference relies on arraycopy's ArrayIndexOutOfBoundsException for
+        oversized trailing chunks (reference: AllReduceBuffer.scala:25-32;
+        pinned by ScatteredDataBufferSpec.scala:32-42). The count is NOT
+        bumped on failure.
+        """
+        data = np.asarray(data, dtype=np.float32)
+        start = chunk_id * self.max_chunk_size
+        end = start + data.shape[0]
+        if (start < 0 or end > self.data_size
+                or src_id < 0 or src_id >= self.peer_size):
+            raise IndexError(
+                f"chunk [{start}, {end}) from src {src_id} out of bounds for "
+                f"buffer of {self.peer_size} peers x {self.data_size} elements")
+        t = self._time_idx(row)
+        self.temporal_buffer[t, src_id, start:end] = data
+        self.count_filled[t, chunk_id] += 1
+
+    def _time_idx(self, row: int) -> int:
+        """Ring indexing (reference: AllReduceBuffer.scala:34-36)."""
+        return (row + self.temporal_offset) % self.max_lag
+
+    def up(self) -> None:
+        """Rotate the ring: retire the oldest row and zero it for reuse as the
+        newest (reference: AllReduceBuffer.scala:38-42)."""
+        self.temporal_offset = (self.temporal_offset + 1) % self.max_lag
+        t = self._time_idx(self.max_lag - 1)
+        self.temporal_buffer[t] = 0.0
+        self.count_filled[t] = 0
+
+    def get_num_chunk(self, size: int) -> int:
+        """Chunks covering ``size`` (reference: AllReduceBuffer.scala:44-46)."""
+        return _num_chunks(size, self.max_chunk_size)

@@ -1,0 +1,53 @@
+"""Scatter-phase buffer: stage peers' chunks of *my* block; reduce at threshold.
+
+Semantic port of the reference's ``ScatteredDataBuffer``
+(reference: buffer/ScatteredDataBuffer.scala:3-41). The summation in
+:meth:`reduce` is the reference's only FLOP kernel
+(reference: ScatteredDataBuffer.scala:20-32); here it is a vectorised numpy
+sum, and on the device plane it is fused into XLA ``reduce_scatter``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from akka_allreduce_tpu.buffers.base import AllReduceBuffer
+
+
+class ScatteredDataBuffer(AllReduceBuffer):
+    def __init__(self, data_size: int, peer_size: int, max_lag: int,
+                 reducing_threshold: float, max_chunk_size: int):
+        super().__init__(data_size, peer_size, max_lag, max_chunk_size)
+        self.reducing_threshold = reducing_threshold
+        # Number of peers' chunks needed to trigger a reduce
+        # (reference: ScatteredDataBuffer.scala:9). int() truncation could
+        # yield 0 for small thresholds, which would deadlock (the == check
+        # only runs after a store bumps the count to >= 1), so clamp to 1.
+        self.min_chunk_required = max(1, int(reducing_threshold * peer_size)) \
+            if peer_size > 0 else 0
+
+    def reach_reducing_threshold(self, row: int, chunk_id: int) -> bool:
+        """True exactly when the fill count *equals* the threshold — ``==``
+        not ``>=``, so the reduce fires exactly once; later arrivals are
+        absorbed but never re-broadcast
+        (reference: ScatteredDataBuffer.scala:11-13; pinned by
+        AllreduceSpec.scala:444-458)."""
+        return bool(self.count_filled[self._time_idx(row), chunk_id] ==
+                    self.min_chunk_required)
+
+    def count(self, row: int, chunk_id: int) -> int:
+        return int(self.count_filled[self._time_idx(row), chunk_id])
+
+    def reduce(self, row: int, chunk_id: int) -> tuple[np.ndarray, int]:
+        """Sum one chunk across all peer slots (unfilled slots are zeros);
+        return the reduced chunk and how many peers contributed
+        (reference: ScatteredDataBuffer.scala:20-32)."""
+        start = chunk_id * self.max_chunk_size
+        end = min(self.data_size, (chunk_id + 1) * self.max_chunk_size)
+        t = self._time_idx(row)
+        reduced = self.temporal_buffer[t, :, start:end].sum(
+            axis=0, dtype=np.float32)
+        return reduced, self.count(row, chunk_id)
+
+    def empty(self) -> bool:
+        return self.data_size == 0

@@ -1,0 +1,113 @@
+"""The 5-message allreduce wire protocol + the user-plane data API.
+
+Protocol messages mirror the reference's case classes one-for-one
+(reference: AllreduceMessage.scala:7-21); the data API mirrors
+DataWrapper.scala:3-7. On TPU these messages are the *control-plane*
+vocabulary: the host protocol engine (protocol/worker.py, protocol/master.py)
+exchanges them over the in-process router or a DCN transport, while the bulk
+float payloads ride XLA collectives on the device plane. The host engine can
+also carry payloads directly (numpy) — that is the pure-host emulation mode
+used for protocol tests and CPU-only operation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+# A WorkerRef is whatever handle the transport routes on (an ActorRef in the
+# reference). The in-proc transport uses small ref objects; a DCN transport
+# would use (host, port) or a coordination-service id.
+WorkerRef = Any
+
+
+@dataclasses.dataclass
+class InitWorkers:
+    """Master -> worker: set rank, peer map, thresholds, data geometry
+    (reference: AllreduceMessage.scala:7-17)."""
+
+    workers: Mapping[int, WorkerRef]
+    worker_num: int
+    master: Optional[WorkerRef]
+    dest_id: int
+    th_reduce: float
+    th_complete: float
+    max_lag: int
+    data_size: int
+    max_chunk_size: int
+
+
+@dataclasses.dataclass
+class StartAllreduce:
+    """Master -> workers: begin round ``round``
+    (reference: AllreduceMessage.scala:18)."""
+
+    round: int
+
+
+@dataclasses.dataclass
+class ScatterBlock:
+    """Worker -> peer owning the block: one chunk of my input for your block
+    (reference: AllreduceMessage.scala:19)."""
+
+    value: np.ndarray  # float32, length <= max_chunk_size
+    src_id: int
+    dest_id: int
+    chunk_id: int
+    round: int
+
+
+@dataclasses.dataclass
+class ReduceBlock:
+    """Block owner -> all peers: one reduced chunk, with the number of peers
+    that contributed (count piggybacking, reference:
+    AllreduceMessage.scala:20; ReducedDataBuffer.scala:21-24)."""
+
+    value: np.ndarray  # float32, length <= max_chunk_size
+    src_id: int
+    dest_id: int
+    chunk_id: int
+    round: int
+    count: int
+
+
+@dataclasses.dataclass
+class CompleteAllreduce:
+    """Worker -> master: I flushed round ``round``
+    (reference: AllreduceMessage.scala:21)."""
+
+    src_id: int
+    round: int
+
+
+# ---------------------------------------------------------------------------
+# User-plane data API (reference: DataWrapper.scala:3-7,
+# AllreduceWorker.scala:305-306)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AllReduceInputRequest:
+    """Pull request handed to the user's data source each round."""
+
+    iteration: int
+
+
+@dataclasses.dataclass
+class AllReduceInput:
+    """User-supplied input vector for one round."""
+
+    data: np.ndarray  # float32, length == data_size
+
+
+@dataclasses.dataclass
+class AllReduceOutput:
+    """Reduced output pushed to the user's data sink: the (possibly partial)
+    sum plus per-element contribution counts so the caller can rescale
+    (reference: ReducedDataBuffer.scala:26-53)."""
+
+    data: np.ndarray  # float32, length == data_size
+    count: np.ndarray  # int32, length == data_size
+    iteration: int

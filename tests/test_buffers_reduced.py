@@ -1,0 +1,144 @@
+"""Port of the reference's ReducedDataBuffer unit spec.
+
+Scenario-for-scenario port of
+reference: src/test/scala/sample/cluster/allreduce/buffer/ReducedDataBufferSpec.scala.
+"""
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.buffers import ReducedDataBuffer
+
+rng = np.random.default_rng(1)
+
+
+def random_floats(n):
+    return rng.random(n, dtype=np.float32)
+
+
+class TestEvenBlocks:
+    """maxBlock=5, minBlock=5, peers=3, maxLag=4, threshold=0.7, chunk=2,
+    total=15 (reference: ReducedDataBufferSpec.scala:10-121)."""
+
+    ROW = 1
+
+    @pytest.fixture(scope="class")
+    def buf(self):
+        return ReducedDataBuffer(5, 5, 15, 3, 4, 0.7, 2)
+
+    def test_initialize_buffers(self, buf):
+        assert buf.temporal_buffer.shape == (4, 3, 5)
+
+    def test_zero_counts(self, buf):
+        output, count = buf.get_with_counts(self.ROW)
+        assert output.sum() == 0
+        assert count.sum() == 0
+
+    def test_store_first_peer_first_chunk(self, buf):
+        to_store = random_floats(2)
+        buf.store(to_store, self.ROW, src_id=0, chunk_id=0, count=3)
+        output, count = buf.get_with_counts(self.ROW)
+        np.testing.assert_array_equal(output[:2], to_store)
+        assert (count[:2] == 3).all()
+
+    def test_store_last_peer_last_chunk_smaller(self, buf):
+        src = 2
+        chunk = buf.num_chunks - 1
+        with pytest.raises(IndexError):
+            buf.store(random_floats(2), self.ROW, src, chunk, count=3)
+        last_chunk_size = 5 - (buf.num_chunks - 1) * 2
+        to_store = random_floats(last_chunk_size)
+        buf.store(to_store, self.ROW, src, chunk, count=3)
+        output, _ = buf.get_with_counts(self.ROW)
+        np.testing.assert_array_equal(output[15 - last_chunk_size:], to_store)
+
+    def test_store_until_completion_threshold(self, buf):
+        # gate = int(0.7 * 9 chunks) = 6 reduced chunks
+        # (reference: ReducedDataBufferSpec.scala:72-92)
+        assert buf.reach_completion_threshold(self.ROW) is False
+        buf.store(random_floats(2), self.ROW, src_id=0, chunk_id=1, count=3)
+        assert buf.reach_completion_threshold(self.ROW) is False
+        buf.store(random_floats(2), self.ROW, src_id=1, chunk_id=0, count=3)
+        buf.store(random_floats(2), self.ROW, src_id=1, chunk_id=1, count=3)
+        assert buf.reach_completion_threshold(self.ROW) is False
+        buf.store(random_floats(2), self.ROW, src_id=2, chunk_id=1, count=3)
+        assert buf.reach_completion_threshold(self.ROW) is True
+
+    def test_get_reduced_row_zero_fills_missing(self, buf):
+        # peers 0 and 1 are missing their 3rd chunk; peer 2 its 1st
+        # (reference: ReducedDataBufferSpec.scala:95-119)
+        reduced, counts = buf.get_with_counts(self.ROW)
+        assert reduced.shape == counts.shape
+        missing = [4, 9, 10, 11]
+        for i in missing:
+            assert reduced[i] == 0
+            assert counts[i] == 0
+        present = [i for i in range(15) if i not in missing]
+        for i in present:
+            assert counts[i] == 3
+
+
+class TestUnevenBlocks:
+    """maxBlock=6, minBlock=4, peers=3, threshold=1, chunk=2, total=16
+    (reference: ReducedDataBufferSpec.scala:124-158)."""
+
+    ROW = 1
+
+    def test_store_until_completion_threshold(self):
+        buf = ReducedDataBuffer(6, 4, 16, 3, 4, 1.0, 2)
+        # total chunks = 3 + 3 + 2 = 8; gate = 8
+        assert buf.reach_completion_threshold(self.ROW) is False
+        for chunk_id in range(3):
+            for peer_id in range(2):
+                buf.store(random_floats(2), self.ROW, peer_id, chunk_id,
+                          count=3)
+                assert buf.reach_completion_threshold(self.ROW) is False
+        buf.store(random_floats(2), self.ROW, 2, 0, count=3)
+        assert buf.reach_completion_threshold(self.ROW) is False
+        buf.store(random_floats(2), self.ROW, 2, 1, count=3)
+        assert buf.reach_completion_threshold(self.ROW) is True
+
+    def test_uneven_reassembly_counts(self):
+        """Uneven last block: output slots past the last block's real extent
+        stay zero-filled with zero counts."""
+        buf = ReducedDataBuffer(6, 4, 16, 3, 4, 1.0, 2)
+        for peer in range(3):
+            block = 4 if peer == 2 else 6
+            for chunk in range(buf.get_num_chunk(block)):
+                size = min(2, block - 2 * chunk)
+                buf.store(np.full(size, peer + 1, dtype=np.float32),
+                          self.ROW, peer, chunk, count=peer + 1)
+        out, counts = buf.get_with_counts(self.ROW)
+        np.testing.assert_array_equal(out[:6], np.full(6, 1.0))
+        np.testing.assert_array_equal(out[6:12], np.full(6, 2.0))
+        np.testing.assert_array_equal(out[12:16], np.full(4, 3.0))
+        assert (counts[:6] == 1).all()
+        assert (counts[6:12] == 2).all()
+        assert (counts[12:16] == 3).all()
+
+
+class TestDegenerateGeometry:
+    """Review findings: gates must stay attainable for geometries the
+    reference crashes on but config.block_ranges supports."""
+
+    def test_more_peers_than_elements_can_complete(self):
+        # data_size=4, peers=8: blocks are 1,1,1,1,0,0,0,0 -> only 4
+        # attainable chunks; gate must be 4, not 7.
+        buf = ReducedDataBuffer(1, 0, 4, 8, 2, 1.0, 2)
+        assert buf.total_chunks == 4
+        assert buf.min_chunk_required == 4
+        for peer in range(4):
+            buf.store(np.ones(1, np.float32), 0, peer, 0, count=1)
+        assert buf.reach_completion_threshold(0) is True
+
+    def test_tiny_threshold_clamps_gate_to_one(self):
+        # int(0.1 * 9) = 0 would deadlock; clamp to 1.
+        buf = ReducedDataBuffer(5, 5, 15, 3, 4, 0.1, 2)
+        assert buf.min_chunk_required == 1
+        buf.store(np.ones(2, np.float32), 0, 0, 0, count=3)
+        assert buf.reach_completion_threshold(0) is True
+
+    def test_negative_src_id_raises(self):
+        buf = ReducedDataBuffer(5, 5, 15, 3, 4, 0.7, 2)
+        with pytest.raises(IndexError):
+            buf.store(np.ones(2, np.float32), 0, -1, 0, count=3)
