@@ -20,7 +20,10 @@ class ReducedDataBuffer(AllReduceBuffer):
                  completion_threshold: float, max_chunk_size: int):
         super().__init__(max_block_size, peer_size, max_lag, max_chunk_size)
         self.max_block_size = max_block_size
-        self.min_block_size = min_block_size
+        # min_block_size is accepted for constructor parity with the
+        # reference (ReducedDataBuffer.scala:5-11) but the completion gate is
+        # derived from the actual block layout below, which subsumes it.
+        del min_block_size
         self.total_data_size = total_data_size
         self.completion_threshold = completion_threshold
 

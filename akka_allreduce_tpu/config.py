@@ -137,8 +137,6 @@ def block_ranges(data_size: int, peer_num: int) -> list[tuple[int, int]]:
         if i < len(starts):
             start = starts[i]
             end = starts[i + 1] if i + 1 < len(starts) else data_size
-            if i == peer_num - 1:
-                end = data_size
             ranges.append((start, end))
         else:
             ranges.append((data_size, data_size))

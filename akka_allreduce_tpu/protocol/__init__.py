@@ -1,0 +1,28 @@
+"""Host control-plane protocol engine.
+
+A transport-agnostic, deterministic re-implementation of the reference's
+actor protocol (reference: AllreduceWorker.scala:7-301,
+AllreduceMaster.scala:12-90): the scatter → reduce → broadcast → complete
+state machine with threshold gates, the ``max_lag`` staleness window and
+catch-up path, and the master's membership / rank-assignment / round-pacing
+duties.
+
+On TPU this layer coordinates *rounds* across hosts (DCN); the bulk float
+traffic rides the device plane (`ops/`, `parallel/`). It also runs standalone
+as a pure-host emulation — that mode carries the reference's protocol test
+suite and the CPU demo configs.
+"""
+
+from akka_allreduce_tpu.protocol.transport import ActorRef, Router, Probe
+from akka_allreduce_tpu.protocol.worker import AllreduceWorker
+from akka_allreduce_tpu.protocol.master import AllreduceMaster
+from akka_allreduce_tpu.protocol.cluster import LocalCluster
+
+__all__ = [
+    "ActorRef",
+    "Router",
+    "Probe",
+    "AllreduceWorker",
+    "AllreduceMaster",
+    "LocalCluster",
+]

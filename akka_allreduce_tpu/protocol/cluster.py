@@ -1,0 +1,124 @@
+"""In-process cluster harness: master + N workers on one router.
+
+The deterministic equivalent of the reference's localhost multi-process
+cluster (reference: scripts/testAllreduceMaster.sc + testAllreduceWorker.sc):
+real master, real workers, real message traffic — one process, fully
+reproducible. Used by the end-to-end emulation tests and the host-plane
+benchmark path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from akka_allreduce_tpu.config import AllreduceConfig
+from akka_allreduce_tpu.messages import (
+    AllReduceInput,
+    AllReduceInputRequest,
+    AllReduceOutput,
+)
+from akka_allreduce_tpu.protocol.master import AllreduceMaster
+from akka_allreduce_tpu.protocol.transport import Router
+from akka_allreduce_tpu.protocol.worker import AllreduceWorker, DataSink, \
+    DataSource
+
+
+def constant_range_source(data_size: int) -> DataSource:
+    """The reference's synthetic source: floats [0, 1, ..., n-1] every round
+    (reference: AllreduceWorker.scala:325-326)."""
+    floats = np.arange(data_size, dtype=np.float32)
+
+    def source(_req: AllReduceInputRequest) -> AllReduceInput:
+        return AllReduceInput(floats)
+
+    return source
+
+
+class ThroughputSink:
+    """The reference's benchmark sink: wall-clock goodput every ``checkpoint``
+    rounds, with an optional correctness assertion ``output == N x input``,
+    ``counts == N`` valid when all thresholds are 1.0
+    (reference: AllreduceWorker.scala:329-343)."""
+
+    def __init__(self, data_size: int, checkpoint: int = 50,
+                 assert_multiple: int = 0, verbose: bool = False):
+        self.data_size = data_size
+        self.checkpoint = checkpoint
+        self.assert_multiple = assert_multiple
+        self.verbose = verbose
+        self.tic = time.perf_counter()
+        self.rates_mbps: list[float] = []
+        self.outputs_seen = 0
+
+    def __call__(self, r: AllReduceOutput) -> None:
+        self.outputs_seen += 1
+        if r.iteration % self.checkpoint == 0 and r.iteration != 0:
+            elapsed = time.perf_counter() - self.tic
+            nbytes = len(r.data) * 4.0 * self.checkpoint
+            rate = nbytes / 1e6 / elapsed if elapsed > 0 else float("inf")
+            self.rates_mbps.append(rate)
+            if self.verbose:
+                print(f"{nbytes / 1e6:.1f} MB in {elapsed:.2f}s "
+                      f"at {rate:.3f} MB/s")
+            if self.assert_multiple > 0:
+                expected = np.arange(self.data_size, dtype=np.float32) \
+                    * self.assert_multiple
+                np.testing.assert_array_equal(r.data, expected)
+                np.testing.assert_array_equal(
+                    r.count, np.full(self.data_size, self.assert_multiple))
+            self.tic = time.perf_counter()
+
+
+class LocalCluster:
+    """Spin up a master and ``total_size`` workers on one deterministic
+    router, register membership, and pump rounds to completion."""
+
+    def __init__(self, config: AllreduceConfig,
+                 source_factory: Optional[Callable[[int], DataSource]] = None,
+                 sink_factory: Optional[Callable[[int], DataSink]] = None,
+                 strict: bool = True):
+        self.config = config
+        self.router = Router()
+        self.completed_rounds: list[int] = []
+        self.master = AllreduceMaster(
+            self.router, config,
+            on_round_complete=self.completed_rounds.append)
+
+        n = config.workers.total_size
+        size = config.data.data_size
+        src = source_factory or (lambda _rank: constant_range_source(size))
+        snk = sink_factory or (lambda _rank: (lambda out: None))
+        self.workers = [
+            AllreduceWorker(self.router, src(rank), snk(rank),
+                            name=f"worker-{rank}", strict=strict)
+            for rank in range(n)
+        ]
+
+    def start(self) -> None:
+        """Register every worker with the master (arrival order = rank) —
+        the Akka MemberUp flow (reference: AllreduceMaster.scala:36-44)."""
+        for w in self.workers:
+            self.master.member_up(w.ref)
+
+    def run(self) -> int:
+        """Register members and pump until traffic drains. The master paces
+        ``config.data.max_round`` rounds (its free-running behavior,
+        reference: AllreduceMaster.scala:58-62); if gates can never pass
+        (e.g. thresholds=1.0 with a dead worker) the pump drains early and
+        fewer rounds complete. Returns the number of paced rounds."""
+        self.start()
+        self.router.pump()
+        return len(self.completed_rounds)
+
+    def kill_worker(self, rank: int) -> None:
+        """Simulate a worker death: deathwatch fires on master and peers
+        (reference: AllreduceMaster.scala:46-52;
+        AllreduceWorker.scala:141-146)."""
+        ref = self.workers[rank].ref
+        self.router.unregister(ref)
+        self.master.terminated(ref)
+        for w in self.workers:
+            w.terminated(ref)

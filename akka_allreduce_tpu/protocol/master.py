@@ -1,0 +1,122 @@
+"""The allreduce master: membership, rank assignment, round pacing.
+
+Behavioral port of the reference's master actor
+(reference: AllreduceMaster.scala:12-90): workers register as they come up
+(arrival order IS the rank), and once the quorum of ``total_workers`` is
+reached the master initializes every worker and paces rounds — advancing when
+``th_allreduce`` of workers report completion, dropping stale completion
+reports. Dead workers are removed by deathwatch; thresholds then tolerate
+their missing contributions.
+
+In the TPU deployment these duties are carried by
+runtime/coordinator.py on top of ``jax.distributed`` + slice topology
+metadata; this class is the transport-level engine behind it and the
+emulation-mode control plane.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from akka_allreduce_tpu.config import AllreduceConfig
+from akka_allreduce_tpu.messages import (
+    CompleteAllreduce,
+    InitWorkers,
+    StartAllreduce,
+)
+from akka_allreduce_tpu.protocol.transport import ActorRef, Router
+
+log = logging.getLogger(__name__)
+
+
+class AllreduceMaster:
+    def __init__(self, router: Router, config: AllreduceConfig,
+                 name: Optional[str] = None,
+                 on_round_complete=None):
+        """``on_round_complete(round)`` is an optional callback fired when a
+        round's completion gate passes — the hook the round pacer and
+        benchmark harness attach to."""
+        self.router = router
+        self.config = config
+        self.total_workers = config.workers.total_size
+        self.th_allreduce = config.thresholds.th_allreduce
+        self.on_round_complete = on_round_complete
+        self.ref = router.register(name or "master", handler=self.receive)
+
+        self.workers: dict[int, ActorRef] = {}
+        self.round = -1
+        self.num_complete = 0
+
+    # -- membership (reference: AllreduceMaster.scala:36-44, :66-74) --------
+
+    def member_up(self, worker_ref: ActorRef, role: str = "worker") -> None:
+        """A cluster member came up. Rank = arrival order. On quorum, init
+        all workers and start round 0. (The reference resolves the remote
+        actor and deathwatches it; here the ref is handed in directly and
+        the owner calls :meth:`terminated` on failure.)"""
+        if role != "worker":
+            return
+        # Next unused rank. The reference uses workers.size, which collides
+        # with a live worker's rank after a lower-ranked death
+        # (documented quirk, AllreduceMaster.scala:71).
+        new_id = max(self.workers, default=-1) + 1
+        self.workers[new_id] = worker_ref
+        log.info("master: worker %d up (%s), %d/%d", new_id, worker_ref,
+                 len(self.workers), self.total_workers)
+        if len(self.workers) >= self.total_workers and self.round == -1:
+            self._init_workers()
+            self.round = 0
+            self._start_allreduce()
+
+    def terminated(self, ref: ActorRef) -> None:
+        """Deathwatch removal (reference: AllreduceMaster.scala:46-52).
+        Ranks of dead workers are never reused; :meth:`member_up` assigns
+        the next rank above the highest live one."""
+        for idx, worker in list(self.workers.items()):
+            if worker is ref:
+                del self.workers[idx]
+
+    # -- round pacing (reference: AllreduceMaster.scala:54-63) --------------
+
+    def receive(self, msg) -> None:
+        if isinstance(msg, CompleteAllreduce):
+            self._handle_complete(msg)
+        else:
+            log.warning("master: unknown message %r", msg)
+
+    def _handle_complete(self, c: CompleteAllreduce) -> None:
+        """Tally completions; advance when th_allreduce of workers report.
+        Stale rounds' completions are dropped."""
+        if c.round != self.round:
+            return
+        self.num_complete += 1
+        if (self.num_complete >= self.total_workers * self.th_allreduce
+                and self.round < self.config.data.max_round):
+            log.info("master: %d/%d complete round %d", self.num_complete,
+                     self.total_workers, self.round)
+            if self.on_round_complete is not None:
+                self.on_round_complete(self.round)
+            self.round += 1
+            self._start_allreduce()
+
+    # -- worker init + kick-off (reference: AllreduceMaster.scala:76-89) ----
+
+    def _init_workers(self) -> None:
+        for idx, worker in self.workers.items():
+            self.router.send(worker, InitWorkers(
+                workers=dict(self.workers),
+                worker_num=self.total_workers,
+                master=self.ref,
+                dest_id=idx,
+                th_reduce=self.config.thresholds.th_reduce,
+                th_complete=self.config.thresholds.th_complete,
+                max_lag=self.config.workers.max_lag,
+                data_size=self.config.data.data_size,
+                max_chunk_size=self.config.data.max_chunk_size,
+            ))
+
+    def _start_allreduce(self) -> None:
+        self.num_complete = 0
+        for worker in self.workers.values():
+            self.router.send(worker, StartAllreduce(self.round))

@@ -1,0 +1,345 @@
+"""The allreduce worker: the full data-plane protocol state machine.
+
+Behavioral port of the reference's worker actor
+(reference: AllreduceWorker.scala:7-301). Per round: fetch input from the
+data source, scatter chunked blocks to their owners, reduce each chunk when
+the ``th_reduce`` gate fires (exactly once), broadcast reduced chunks with
+contributor counts piggybacked, and complete the round when the
+``th_complete`` gate fires — flushing output + per-element counts to the
+data sink and reporting to the master. A worker lagging more than ``max_lag``
+rounds force-completes stale rounds with whatever arrived (possibly zeros
+with count 0) — the bounded-staleness catch-up path
+(reference: AllreduceWorker.scala:100-106).
+
+In the TPU deployment this state machine paces *rounds* per host while the
+chunk payloads ride XLA collectives; in emulation mode it carries the numpy
+payloads itself. Either way the observable message protocol is identical and
+is pinned by tests/test_protocol_worker.py (a port of the reference's
+AllreduceSpec).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Mapping, Optional
+
+import numpy as np
+
+from akka_allreduce_tpu.buffers import ReducedDataBuffer, ScatteredDataBuffer
+from akka_allreduce_tpu.config import block_ranges
+from akka_allreduce_tpu.messages import (
+    AllReduceInput,
+    AllReduceInputRequest,
+    AllReduceOutput,
+    CompleteAllreduce,
+    InitWorkers,
+    ReduceBlock,
+    ScatterBlock,
+    StartAllreduce,
+)
+from akka_allreduce_tpu.protocol.transport import ActorRef, Router
+
+log = logging.getLogger(__name__)
+
+DataSource = Callable[[AllReduceInputRequest], AllReduceInput]
+DataSink = Callable[[AllReduceOutput], None]
+
+
+class AllreduceWorker:
+    """One rank's protocol engine.
+
+    ``strict=False`` (default) reproduces the reference's supervision
+    behavior: exceptions while handling a message are logged and swallowed so
+    one bad message cannot kill the worker
+    (reference: AllreduceWorker.scala:287-299 ``tryCatch``). ``strict=True``
+    re-raises, for tests that pin the guard conditions.
+    """
+
+    def __init__(self, router: Router, data_source: DataSource,
+                 data_sink: DataSink, name: Optional[str] = None,
+                 strict: bool = False):
+        self.router = router
+        self.data_source = data_source
+        self.data_sink = data_sink
+        self.strict = strict
+        self.ref = router.register(name or "worker", handler=self.receive)
+
+        # Protocol state (reference: AllreduceWorker.scala:10-31)
+        self.id = -1
+        self.master: Optional[ActorRef] = None
+        self.peers: dict[int, ActorRef] = {}
+        self.peer_num = 0
+        self.th_reduce = 1.0
+        self.th_complete = 1.0
+        self.max_lag = 0
+        self.round = -1          # current (unfinished) round
+        self.max_round = -1      # newest StartAllreduce seen
+        self.max_scattered = -1  # newest round scatter() has run for
+        self.completed: set[int] = set()
+
+        # Data geometry
+        self.data_size = 0
+        self.data = np.zeros(0, dtype=np.float32)
+        self.ranges: list[tuple[int, int]] = []
+        self.my_block_size = 0
+        self.max_block_size = 0
+        self.min_block_size = 0
+        self.max_chunk_size = 1024
+        self.scatter_block_buf = ScatteredDataBuffer(0, 0, 1, 1.0, 1024)
+        self.reduce_block_buf = ReducedDataBuffer(0, 0, 0, 0, 1, 1.0, 1024)
+
+    # -- message dispatch ---------------------------------------------------
+
+    def receive(self, msg) -> None:
+        """Actor receive block (reference: AllreduceWorker.scala:33-147)."""
+        try:
+            if isinstance(msg, InitWorkers):
+                self._handle_init(msg)
+            elif isinstance(msg, StartAllreduce):
+                self._handle_start(msg)
+            elif isinstance(msg, ScatterBlock):
+                if self.id == -1:
+                    log.warning("worker not initialized; re-queueing scatter")
+                    self.router.send(self.ref, msg)
+                else:
+                    self.handle_scatter_block(msg)
+            elif isinstance(msg, ReduceBlock):
+                if self.id == -1:
+                    log.warning("worker not initialized; re-queueing reduce")
+                    self.router.send(self.ref, msg)
+                else:
+                    self.handle_reduce_block(msg)
+            else:
+                log.warning("worker %s: unknown message %r", self.id, msg)
+        except Exception:
+            if self.strict:
+                raise
+            log.exception("worker %s: error handling %r", self.id, msg)
+
+    def terminated(self, ref: ActorRef) -> None:
+        """Deathwatch: drop a dead peer from the map; thresholds then
+        tolerate its missing contributions
+        (reference: AllreduceWorker.scala:141-146)."""
+        for idx, peer in list(self.peers.items()):
+            if peer is ref:
+                del self.peers[idx]
+
+    # -- init ---------------------------------------------------------------
+
+    def _handle_init(self, init: InitWorkers) -> None:
+        """First init sets everything; a re-init only refreshes the peer map
+        (late joiners) (reference: AllreduceWorker.scala:35-90)."""
+        if self.id != -1:
+            self.peers = dict(init.workers)
+            return
+
+        self.id = init.dest_id
+        self.master = init.master
+        self.peer_num = init.worker_num
+        self.peers = dict(init.workers)
+        self.th_reduce = init.th_reduce
+        self.th_complete = init.th_complete
+        self.max_lag = init.max_lag
+        self.round = 0
+        self.max_round = -1
+        self.max_scattered = -1
+        self.completed = set()
+
+        self.data_size = init.data_size
+        self.data = np.zeros(self.data_size, dtype=np.float32)
+        self.ranges = block_ranges(self.data_size, self.peer_num)
+        self.my_block_size = self._block_size(self.id)
+        self.max_block_size = self._block_size(0)
+        self.min_block_size = self._block_size(self.peer_num - 1)
+        self.max_chunk_size = init.max_chunk_size
+
+        self.scatter_block_buf = ScatteredDataBuffer(
+            data_size=self.my_block_size,
+            peer_size=self.peer_num,
+            max_lag=self.max_lag + 1,
+            reducing_threshold=self.th_reduce,
+            max_chunk_size=self.max_chunk_size,
+        )
+        self.reduce_block_buf = ReducedDataBuffer(
+            max_block_size=self.max_block_size,
+            min_block_size=self.min_block_size,
+            total_data_size=self.data_size,
+            peer_size=self.peer_num,
+            max_lag=self.max_lag + 1,
+            completion_threshold=self.th_complete,
+            max_chunk_size=self.max_chunk_size,
+        )
+        log.info(
+            "worker %d: peers %d/%d, thReduce=%s thComplete=%s maxLag=%d",
+            self.id, len(self.peers), self.peer_num, self.th_reduce,
+            self.th_complete, self.max_lag)
+
+    # -- round start + catch-up --------------------------------------------
+
+    def _handle_start(self, s: StartAllreduce) -> None:
+        """Round kick-off, catch-up, and scatter pipelining
+        (reference: AllreduceWorker.scala:92-114)."""
+        if self.id == -1:
+            log.warning("worker not initialized; re-queueing start")
+            self.router.send(self.ref, s)
+            return
+        self.max_round = max(self.max_round, s.round)
+        # Fallen more than max_lag behind: force-complete stale rounds with
+        # whatever arrived — zero data, honest count 0 if nothing did
+        # (reference: AllreduceWorker.scala:100-106; pinned by the cold
+        # catch-up scenario AllreduceSpec.scala:632-656).
+        while self.round < self.max_round - self.max_lag:
+            for k in range(self.scatter_block_buf.num_chunks):
+                reduced, count = self.scatter_block_buf.reduce(0, k)
+                self._broadcast(reduced, k, self.round, count)
+            self._complete(self.round, 0)
+        # Pipeline scatters up to the newest round (max_lag-deep window).
+        while self.max_scattered < self.max_round:
+            self._fetch(self.max_scattered + 1)
+            self._scatter()
+            self.max_scattered += 1
+        self.completed = {e for e in self.completed if e >= self.round}
+
+    # -- scatter phase ------------------------------------------------------
+
+    def handle_scatter_block(self, s: ScatterBlock) -> None:
+        """Stage a peer's chunk of my block; reduce + broadcast when the
+        th_reduce gate fires (reference: AllreduceWorker.scala:170-186)."""
+        assert s.dest_id == self.id, \
+            f"scatter for {s.dest_id} routed to {self.id}"
+        if s.round < self.round or s.round in self.completed:
+            log.debug("worker %d: outdated scatter round %d", self.id, s.round)
+        elif s.round <= self.max_round:
+            row = s.round - self.round
+            self.scatter_block_buf.store(s.value, row, s.src_id, s.chunk_id)
+            if self.scatter_block_buf.reach_reducing_threshold(row, s.chunk_id):
+                reduced, count = self.scatter_block_buf.reduce(row, s.chunk_id)
+                self._broadcast(reduced, s.chunk_id, s.round, count)
+        else:
+            # A round we haven't been started for: requeue behind a
+            # self-sent start (reference: AllreduceWorker.scala:183-184).
+            self.router.send(self.ref, StartAllreduce(s.round))
+            self.router.send(self.ref, s)
+
+    def _scatter(self) -> None:
+        """Send every peer its (chunked) block of my input, rank-staggered so
+        all workers don't hammer rank 0 first
+        (reference: AllreduceWorker.scala:212-238). We iterate all peer_num
+        rank slots and skip gaps: the reference's ``range(peers.size)`` +
+        modular indexing silently starves live trailing ranks once a
+        mid-rank peer dies."""
+        for i in range(self.peer_num):
+            idx = (i + self.id) % self.peer_num
+            peer = self.peers.get(idx)
+            if peer is None:
+                continue
+            block_start, block_end = self._range(idx)
+            peer_block_size = block_end - block_start
+            peer_num_chunks = -(-peer_block_size // self.max_chunk_size) \
+                if peer_block_size > 0 else 0
+            for c in range(peer_num_chunks):
+                chunk_start = c * self.max_chunk_size
+                chunk_end = min((c + 1) * self.max_chunk_size,
+                                peer_block_size)
+                chunk = np.array(
+                    self.data[block_start + chunk_start:
+                              block_start + chunk_end],
+                    dtype=np.float32)
+                msg = ScatterBlock(chunk, self.id, idx, c,
+                                   self.max_scattered + 1)
+                if peer is self.ref:
+                    # Self-delivery bypass: direct call, no mailbox hop
+                    # (reference: AllreduceWorker.scala:228-231).
+                    self.handle_scatter_block(msg)
+                else:
+                    self.router.send(peer, msg)
+
+    # -- reduce / broadcast phase -------------------------------------------
+
+    def handle_reduce_block(self, r: ReduceBlock) -> None:
+        """Stage a reduced chunk; complete the round when the th_complete
+        gate fires (reference: AllreduceWorker.scala:149-168)."""
+        if len(r.value) > self.max_chunk_size:
+            raise ValueError(
+                f"reduced block of size {len(r.value)} exceeds max chunk "
+                f"size {self.max_chunk_size}")
+        if r.dest_id != self.id:
+            raise ValueError(
+                f"message for {r.dest_id} incorrectly routed to {self.id}")
+        if r.round < self.round or r.round in self.completed:
+            log.debug("worker %d: outdated reduce round %d", self.id, r.round)
+        elif r.round <= self.max_round:
+            row = r.round - self.round
+            self.reduce_block_buf.store(r.value, row, r.src_id, r.chunk_id,
+                                        r.count)
+            if self.reduce_block_buf.reach_completion_threshold(row):
+                self._complete(r.round, row)
+        else:
+            self.router.send(self.ref, StartAllreduce(r.round))
+            self.router.send(self.ref, r)
+
+    def _broadcast(self, data: np.ndarray, chunk_id: int, bcast_round: int,
+                   reduce_count: int) -> None:
+        """Fan the reduced chunk out to every peer, rank-staggered, count
+        piggybacked (reference: AllreduceWorker.scala:252-268). All rank
+        slots are visited (gaps skipped) — see :meth:`_scatter`."""
+        for i in range(self.peer_num):
+            idx = (i + self.id) % self.peer_num
+            peer = self.peers.get(idx)
+            if peer is None:
+                continue
+            msg = ReduceBlock(data, self.id, idx, chunk_id, bcast_round,
+                              reduce_count)
+            if peer is self.ref:
+                self.handle_reduce_block(msg)
+            else:
+                self.router.send(peer, msg)
+
+    # -- completion ---------------------------------------------------------
+
+    def _complete(self, completed_round: int, row: int) -> None:
+        """Flush to the sink, report to the master, advance the window past
+        any already-completed rounds (reference:
+        AllreduceWorker.scala:270-285). Out-of-order completion across rounds
+        is legal (pinned by AllreduceSpec.scala:722-732)."""
+        self._flush(completed_round, row)
+        self.data = np.zeros(0, dtype=np.float32)
+        if self.master is not None:
+            self.router.send(self.master,
+                             CompleteAllreduce(self.id, completed_round))
+        self.completed.add(completed_round)
+        if self.round == completed_round:
+            while True:
+                self.round += 1
+                self.scatter_block_buf.up()
+                self.reduce_block_buf.up()
+                if self.round not in self.completed:
+                    break
+
+    def _flush(self, completed_round: int, row: int) -> None:
+        """Deliver (output, per-element counts) to the data sink
+        (reference: AllreduceWorker.scala:206-210)."""
+        output, counts = self.reduce_block_buf.get_with_counts(row)
+        self.data_sink(AllReduceOutput(output, counts, completed_round))
+
+    # -- input --------------------------------------------------------------
+
+    def _fetch(self, round_: int) -> None:
+        """Pull the round's input from the data source
+        (reference: AllreduceWorker.scala:197-204)."""
+        inp = self.data_source(AllReduceInputRequest(round_))
+        data = np.asarray(inp.data, dtype=np.float32)
+        if data.shape[0] != self.data_size:
+            raise ValueError(
+                f"input size {data.shape[0]} != configured {self.data_size}")
+        self.data = data
+
+    # -- geometry helpers ---------------------------------------------------
+
+    def _block_size(self, idx: int) -> int:
+        lo, hi = self._range(idx)
+        return hi - lo
+
+    def _range(self, idx: int) -> tuple[int, int]:
+        """Block ownership (reference: AllreduceWorker.scala:245-250)."""
+        return self.ranges[idx]

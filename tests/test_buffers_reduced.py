@@ -16,66 +16,63 @@ def random_floats(n):
     return rng.random(n, dtype=np.float32)
 
 
-class TestEvenBlocks:
+def test_even_blocks_story():
     """maxBlock=5, minBlock=5, peers=3, maxLag=4, threshold=0.7, chunk=2,
-    total=15 (reference: ReducedDataBufferSpec.scala:10-121)."""
+    total=15 — a single sequential story (the Scala WordSpec runs these
+    clauses in order on one buffer)
+    (reference: ReducedDataBufferSpec.scala:10-121)."""
+    buf = ReducedDataBuffer(5, 5, 15, 3, 4, 0.7, 2)
+    row = 1
 
-    ROW = 1
+    # "initialize buffers"
+    assert buf.temporal_buffer.shape == (4, 3, 5)
 
-    @pytest.fixture(scope="class")
-    def buf(self):
-        return ReducedDataBuffer(5, 5, 15, 3, 4, 0.7, 2)
+    # "have zero counts"
+    output, count = buf.get_with_counts(row)
+    assert output.sum() == 0
+    assert count.sum() == 0
 
-    def test_initialize_buffers(self, buf):
-        assert buf.temporal_buffer.shape == (4, 3, 5)
+    # "store first peer first chunk data"
+    to_store = random_floats(2)
+    buf.store(to_store, row, src_id=0, chunk_id=0, count=3)
+    output, count = buf.get_with_counts(row)
+    np.testing.assert_array_equal(output[:2], to_store)
+    assert (count[:2] == 3).all()
 
-    def test_zero_counts(self, buf):
-        output, count = buf.get_with_counts(self.ROW)
-        assert output.sum() == 0
-        assert count.sum() == 0
+    # "store last peer last chunk with smaller size"
+    src = 2
+    chunk = buf.num_chunks - 1
+    with pytest.raises(IndexError):
+        buf.store(random_floats(2), row, src, chunk, count=3)
+    last_chunk_size = 5 - (buf.num_chunks - 1) * 2
+    to_store = random_floats(last_chunk_size)
+    buf.store(to_store, row, src, chunk, count=3)
+    output, _ = buf.get_with_counts(row)
+    np.testing.assert_array_equal(output[15 - last_chunk_size:], to_store)
 
-    def test_store_first_peer_first_chunk(self, buf):
-        to_store = random_floats(2)
-        buf.store(to_store, self.ROW, src_id=0, chunk_id=0, count=3)
-        output, count = buf.get_with_counts(self.ROW)
-        np.testing.assert_array_equal(output[:2], to_store)
-        assert (count[:2] == 3).all()
+    # "store until reach completion threshold":
+    # gate = int(0.7 * 9 chunks) = 6 reduced chunks
+    # (reference: ReducedDataBufferSpec.scala:72-92)
+    assert buf.reach_completion_threshold(row) is False
+    buf.store(random_floats(2), row, src_id=0, chunk_id=1, count=3)
+    assert buf.reach_completion_threshold(row) is False
+    buf.store(random_floats(2), row, src_id=1, chunk_id=0, count=3)
+    buf.store(random_floats(2), row, src_id=1, chunk_id=1, count=3)
+    assert buf.reach_completion_threshold(row) is False
+    buf.store(random_floats(2), row, src_id=2, chunk_id=1, count=3)
+    assert buf.reach_completion_threshold(row) is True
 
-    def test_store_last_peer_last_chunk_smaller(self, buf):
-        src = 2
-        chunk = buf.num_chunks - 1
-        with pytest.raises(IndexError):
-            buf.store(random_floats(2), self.ROW, src, chunk, count=3)
-        last_chunk_size = 5 - (buf.num_chunks - 1) * 2
-        to_store = random_floats(last_chunk_size)
-        buf.store(to_store, self.ROW, src, chunk, count=3)
-        output, _ = buf.get_with_counts(self.ROW)
-        np.testing.assert_array_equal(output[15 - last_chunk_size:], to_store)
-
-    def test_store_until_completion_threshold(self, buf):
-        # gate = int(0.7 * 9 chunks) = 6 reduced chunks
-        # (reference: ReducedDataBufferSpec.scala:72-92)
-        assert buf.reach_completion_threshold(self.ROW) is False
-        buf.store(random_floats(2), self.ROW, src_id=0, chunk_id=1, count=3)
-        assert buf.reach_completion_threshold(self.ROW) is False
-        buf.store(random_floats(2), self.ROW, src_id=1, chunk_id=0, count=3)
-        buf.store(random_floats(2), self.ROW, src_id=1, chunk_id=1, count=3)
-        assert buf.reach_completion_threshold(self.ROW) is False
-        buf.store(random_floats(2), self.ROW, src_id=2, chunk_id=1, count=3)
-        assert buf.reach_completion_threshold(self.ROW) is True
-
-    def test_get_reduced_row_zero_fills_missing(self, buf):
-        # peers 0 and 1 are missing their 3rd chunk; peer 2 its 1st
-        # (reference: ReducedDataBufferSpec.scala:95-119)
-        reduced, counts = buf.get_with_counts(self.ROW)
-        assert reduced.shape == counts.shape
-        missing = [4, 9, 10, 11]
-        for i in missing:
-            assert reduced[i] == 0
-            assert counts[i] == 0
-        present = [i for i in range(15) if i not in missing]
-        for i in present:
-            assert counts[i] == 3
+    # "get reduced row": peers 0 and 1 are missing their 3rd chunk; peer 2
+    # its 1st (reference: ReducedDataBufferSpec.scala:95-119)
+    reduced, counts = buf.get_with_counts(row)
+    assert reduced.shape == counts.shape
+    missing = [4, 9, 10, 11]
+    for i in missing:
+        assert reduced[i] == 0
+        assert counts[i] == 0
+    present = [i for i in range(15) if i not in missing]
+    for i in present:
+        assert counts[i] == 3
 
 
 class TestUnevenBlocks:

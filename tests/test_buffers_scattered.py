@@ -16,74 +16,62 @@ def random_floats(n):
     return rng.random(n, dtype=np.float32)
 
 
-class TestScatteredBufferBehavior:
-    """reference: ScatteredDataBufferSpec.scala:10-68."""
+def test_scattered_buffer_behavior_story():
+    """reference: ScatteredDataBufferSpec.scala:10-68 — a single sequential
+    story (the Scala WordSpec runs these clauses in order on one buffer)."""
+    # blockSize=5, peerSize=4, maxLag=4, threshold=0.75, maxChunkSize=3
+    buf = ScatteredDataBuffer(5, 4, 4, 0.75, 3)
+    row = 1
 
-    @pytest.fixture(scope="class")
-    def buf(self):
-        # blockSize=5, peerSize=4, maxLag=4, threshold=0.75, maxChunkSize=3
-        return ScatteredDataBuffer(5, 4, 4, 0.75, 3)
+    # "initialize buffers"
+    assert buf.temporal_buffer.shape == (4, 4, 5)
 
-    ROW = 1
+    # "throw exception when data to store at the end exceeds expected size":
+    # the last chunk of a 5-element block with chunk size 3 holds only 2
+    # elements; storing 3 must raise and must NOT bump the fill count
+    # (reference: ScatteredDataBufferSpec.scala:32-42).
+    last_chunk = buf.num_chunks - 1
+    with pytest.raises(IndexError):
+        buf.store(random_floats(3), row, 0, last_chunk)
+    assert buf.count(row, last_chunk) == 0
+    excess = buf.num_chunks * 3 - 5
+    buf.store(random_floats(3 - excess), row, 0, last_chunk)
+    assert buf.count(row, last_chunk) == 1
 
-    def test_initialize_buffers(self, buf):
-        assert buf.temporal_buffer.shape == (4, 4, 5)
+    # "reach reducing threshold": 0.75 * 4 peers = 3 stores; fires exactly
+    # at the third (reference: ScatteredDataBufferSpec.scala:44-54).
+    expected = [False, False, True]
+    for i in range(3):
+        buf.store(random_floats(3), row, src_id=i, chunk_id=0)
+        assert buf.reach_reducing_threshold(row, 0) is expected[i]
 
-    def test_oversized_last_chunk_raises(self, buf):
-        # Last chunk of a 5-element block with chunk size 3 holds only 2
-        # elements; storing 3 must raise and must NOT bump the fill count
-        # (reference: ScatteredDataBufferSpec.scala:32-42).
-        last_chunk = buf.num_chunks - 1
-        with pytest.raises(IndexError):
-            buf.store(random_floats(3), self.ROW, 0, last_chunk)
-        assert buf.count(self.ROW, last_chunk) == 0
-        excess = buf.num_chunks * 3 - 5
-        buf.store(random_floats(3 - excess), self.ROW, 0, last_chunk)
-        assert buf.count(self.ROW, last_chunk) == 1
-
-    def test_reach_reducing_threshold(self, buf):
-        # threshold 0.75 * 4 peers = 3 stores; fires exactly at the third
-        # (reference: ScatteredDataBufferSpec.scala:44-54).
-        expected = [False, False, True]
-        for i in range(3):
-            buf.store(random_floats(3), self.ROW, src_id=i, chunk_id=0)
-            assert buf.reach_reducing_threshold(self.ROW, 0) is expected[i]
-
-    def test_reduce_values_with_correct_count(self, buf):
-        # Untouched row reduces to zeros with count 0
-        # (reference: ScatteredDataBufferSpec.scala:56-64).
-        empty_reduced, empty_count = buf.reduce(0, 0)
-        assert empty_count == 0
-        assert empty_reduced.sum() == 0
-
-        _, counts = buf.reduce(self.ROW, 0)
-        assert counts == 3
+    # "reduce values with correct count": untouched row reduces to zeros
+    # with count 0 (reference: ScatteredDataBufferSpec.scala:56-64).
+    empty_reduced, empty_count = buf.reduce(0, 0)
+    assert empty_count == 0
+    assert empty_reduced.sum() == 0
+    _, counts = buf.reduce(row, 0)
+    assert counts == 3
 
 
-class TestScatteredBufferSummation:
+def test_scattered_buffer_summation_story():
     """reference: ScatteredDataBufferSpec.scala:70-105."""
+    # blockSize=2, peerSize=2, maxLag=2, threshold=1, maxChunkSize=3
+    buf = ScatteredDataBuffer(2, 2, 2, 1.0, 3)
 
-    @pytest.fixture(scope="class")
-    def buf(self):
-        # blockSize=2, peerSize=2, maxLag=2, threshold=1, maxChunkSize=3
-        return ScatteredDataBuffer(2, 2, 2, 1.0, 3)
+    # "sum from all peers at one row"
+    for i in range(2):
+        buf.store(np.full(2, float(i), dtype=np.float32), row=0,
+                  src_id=i, chunk_id=0)
+        _, count = buf.reduce(0, 0)
+        assert count == i + 1
+    reduced, _ = buf.reduce(0, 0)
+    np.testing.assert_array_equal(reduced, np.full(2, 1.0, dtype=np.float32))
 
-    def test_sum_from_all_peers_at_one_row(self, buf):
-        for i in range(2):
-            buf.store(np.full(2, float(i), dtype=np.float32), row=0,
-                      src_id=i, chunk_id=0)
-            _, count = buf.reduce(0, 0)
-            assert count == i + 1
-
-        reduced, _ = buf.reduce(0, 0)
-        np.testing.assert_array_equal(reduced, np.full(2, 1.0,
-                                                       dtype=np.float32))
-
-    def test_other_rows_unaffected(self, buf):
-        init_array, count_zero = buf.reduce(1, 0)
-        assert count_zero == 0
-        np.testing.assert_array_equal(init_array, np.zeros(2,
-                                                           dtype=np.float32))
+    # "not be affected by other rows"
+    init_array, count_zero = buf.reduce(1, 0)
+    assert count_zero == 0
+    np.testing.assert_array_equal(init_array, np.zeros(2, dtype=np.float32))
 
 
 def test_ring_rotation_reclaims_oldest_row():

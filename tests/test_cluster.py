@@ -1,0 +1,199 @@
+"""End-to-end emulation cluster tests.
+
+Covers what the reference only exercised via its multi-process localhost
+scripts (reference: scripts/testAllreduceMaster.sc + testAllreduceWorker.sc:
+4 workers, dataSize=778, maxChunkSize=3, maxLag=3, thresholds 1.0, worker
+asserts output == 4 x input) plus master control-plane behavior
+(reference: AllreduceMaster.scala:34-89).
+"""
+
+import numpy as np
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    DataConfig,
+    ThresholdConfig,
+    WorkerConfig,
+)
+from akka_allreduce_tpu.messages import CompleteAllreduce
+from akka_allreduce_tpu.protocol.cluster import (
+    LocalCluster,
+    ThroughputSink,
+    constant_range_source,
+)
+from akka_allreduce_tpu.protocol.master import AllreduceMaster
+from akka_allreduce_tpu.protocol.transport import Probe, Router
+
+
+def make_config(n, data_size, chunk, max_lag=1, max_round=10,
+                th=(1.0, 1.0, 1.0)):
+    return AllreduceConfig(
+        thresholds=ThresholdConfig(*th),
+        data=DataConfig(data_size=data_size, max_chunk_size=chunk,
+                        max_round=max_round),
+        workers=WorkerConfig(total_size=n, max_lag=max_lag),
+    )
+
+
+class TestScriptClusterConfig:
+    """The reference's canonical smoke config, in-process."""
+
+    def test_four_workers_output_is_four_times_input(self):
+        n, data_size = 4, 778
+        config = make_config(n, data_size, chunk=3, max_lag=3, max_round=20)
+        sinks = [ThroughputSink(data_size, checkpoint=10, assert_multiple=n)
+                 for _ in range(n)]
+        cluster = LocalCluster(
+            config,
+            source_factory=lambda r: constant_range_source(data_size),
+            sink_factory=lambda r: sinks[r],
+        )
+        rounds = cluster.run()
+        assert rounds == 20
+        # every worker flushed every round and the assert_multiple invariant
+        # held inside the sink (it raises otherwise)
+        for s in sinks:
+            assert s.outputs_seen == 21  # rounds 0..20 inclusive flush
+            assert len(s.rates_mbps) == 2  # checkpoints at rounds 10 and 20
+
+    def test_readme_cpu_demo_config(self):
+        """README demo: 2 workers, dataSize=10, maxChunkSize=2
+        (reference: README.md:3-7, AllreduceMaster.scala:101-104)."""
+        config = make_config(2, 10, chunk=2, max_lag=1, max_round=5,
+                             th=(1.0, 1.0, 1.0))
+        outputs = {0: [], 1: []}
+        cluster = LocalCluster(
+            config,
+            sink_factory=lambda r: (lambda out: outputs[r].append(out)),
+        )
+        rounds = cluster.run()
+        assert rounds == 5
+        expected = np.arange(10, dtype=np.float32) * 2
+        for r in range(2):
+            for out in outputs[r]:
+                np.testing.assert_array_equal(out.data, expected)
+                assert (out.count == 2).all()
+
+
+class TestLossyCluster:
+    def test_dead_worker_with_lossy_thresholds_still_completes(self):
+        """Thresholds < 1 tolerate a dead worker: rounds keep completing with
+        partial sums and honest counts (the system's signature capability,
+        SURVEY.md §5.3)."""
+        n, data_size = 4, 64
+        config = make_config(n, data_size, chunk=16, max_lag=1, max_round=6,
+                             th=(0.75, 0.75, 0.75))
+        outputs = []
+        cluster = LocalCluster(
+            config,
+            sink_factory=lambda r: (
+                outputs.append if r == 0 else (lambda out: None)),
+        )
+        cluster.start()
+        cluster.kill_worker(3)
+        cluster.router.pump()
+        assert len(cluster.completed_rounds) == 6
+        # outputs reflect 3 contributors on every element of blocks whose
+        # owner is alive; counts are honest
+        assert outputs, "worker 0 must have flushed"
+        for out in outputs:
+            alive_elems = out.count > 0
+            assert alive_elems.any()
+            np.testing.assert_allclose(
+                out.data[alive_elems],
+                np.arange(data_size, dtype=np.float32)[alive_elems]
+                * out.count[alive_elems])
+
+
+class TestMasterControlPlane:
+    def test_quorum_init_and_round_pacing(self):
+        """Master inits workers at quorum, assigns ranks in arrival order,
+        and advances rounds on the th_allreduce gate."""
+        router = Router()
+        probe = Probe(router)
+        config = make_config(2, 10, chunk=5, max_round=3)
+        master = AllreduceMaster(router, config)
+        # two "workers" both played by the probe
+        master.member_up(probe.ref)
+        router.pump()
+        probe.expect_no_msg()  # no quorum yet
+        master.member_up(probe.ref)
+        msgs = probe.drain()
+        # 2 InitWorkers + 2 StartAllreduce(0)
+        kinds = [type(m).__name__ for m in msgs]
+        assert kinds.count("InitWorkers") == 2
+        assert kinds.count("StartAllreduce") == 2
+        inits = [m for m in msgs if type(m).__name__ == "InitWorkers"]
+        assert sorted(i.dest_id for i in inits) == [0, 1]
+
+        # completion tally: stale rounds dropped, gate advances the round
+        router.send(master.ref, CompleteAllreduce(0, 99))  # stale: ignored
+        router.pump()
+        probe.expect_no_msg()
+        router.send(master.ref, CompleteAllreduce(0, 0))
+        router.send(master.ref, CompleteAllreduce(1, 0))
+        starts = [m for m in probe.drain()
+                  if type(m).__name__ == "StartAllreduce"]
+        assert [s.round for s in starts] == [1, 1]
+
+    def test_th_allreduce_below_one_advances_early(self):
+        router = Router()
+        probe = Probe(router)
+        config = make_config(4, 10, chunk=5, th=(0.5, 1.0, 1.0))
+        master = AllreduceMaster(router, config)
+        for _ in range(4):
+            master.member_up(probe.ref)
+        probe.drain()
+        # 2 of 4 completions suffice at th_allreduce=0.5
+        router.send(master.ref, CompleteAllreduce(0, 0))
+        probe.expect_no_msg()
+        router.send(master.ref, CompleteAllreduce(1, 0))
+        starts = [m for m in probe.drain()
+                  if type(m).__name__ == "StartAllreduce"]
+        assert [s.round for s in starts] == [1, 1, 1, 1]
+
+    def test_non_worker_roles_ignored(self):
+        router = Router()
+        probe = Probe(router)
+        master = AllreduceMaster(router, make_config(1, 10, chunk=5))
+        master.member_up(probe.ref, role="master")
+        assert master.workers == {}
+        probe.expect_no_msg()
+
+    def test_deathwatch_removes_worker(self):
+        router = Router()
+        probe = Probe(router)
+        master = AllreduceMaster(router, make_config(3, 10, chunk=5))
+        master.member_up(probe.ref)
+        other = router.register("other")
+        master.member_up(other)
+        master.terminated(other)
+        assert list(master.workers.keys()) == [0]
+
+
+class TestMidRankDeath:
+    """Regression: a mid-rank peer death must not starve live higher ranks
+    (the reference's range(peers.size) + modular indexing quirk)."""
+
+    def test_live_trailing_rank_still_receives_after_mid_rank_death(self):
+        n, data_size = 4, 16
+        config = make_config(n, data_size, chunk=4, max_lag=1, max_round=4,
+                             th=(0.75, 0.75, 0.75))
+        outputs = {r: [] for r in range(n)}
+        cluster = LocalCluster(
+            config,
+            sink_factory=lambda r: outputs[r].append,
+        )
+        cluster.start()
+        cluster.kill_worker(1)  # mid-rank death, rank 3 remains live
+        cluster.router.pump()
+        assert len(cluster.completed_rounds) == 4
+        # rank 3 must keep flushing: its block (elements 12..15) reduced by
+        # itself and broadcast to all, its own flushes complete
+        assert outputs[3], "rank 3 starved after mid-rank death"
+        last = outputs[3][-1]
+        # blocks owned by live ranks (0, 2, 3) have count 3; dead rank 1's
+        # block has count 0
+        assert (last.count[0:4] == 3).all()
+        assert (last.count[4:8] == 0).all()
+        assert (last.count[8:16] == 3).all()

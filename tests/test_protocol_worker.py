@@ -1,0 +1,569 @@
+"""Port of the reference's protocol integration spec.
+
+Scenario-for-scenario port of reference: src/test/scala/AllreduceSpec.scala,
+using the same trick: ONE real worker, a probe posing as every peer and the
+master (reference: AllreduceSpec.scala:812-818 ``initializeWorkersAsSelf``),
+scripted message schedules, and exact assertions on the worker's outbound
+messages.
+"""
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.messages import (
+    AllReduceInput,
+    CompleteAllreduce,
+    InitWorkers,
+    ReduceBlock,
+    ScatterBlock,
+    StartAllreduce,
+)
+from akka_allreduce_tpu.protocol.transport import Probe, Router
+from akka_allreduce_tpu.protocol.worker import AllreduceWorker
+
+
+# -- harness (reference: AllreduceSpec.scala:23-44, :770-818) ---------------
+
+def basic_source(size):
+    return custom_source(size, lambda idx, it: idx + float(it))
+
+
+def custom_source(size, fn):
+    def source(req):
+        return AllReduceInput(
+            np.array([fn(i, req.iteration) for i in range(size)],
+                     dtype=np.float32))
+    return source
+
+
+def assertive_sink(expected_output, expected_count, iterations):
+    def sink(r):
+        assert r.iteration in iterations
+        pos = iterations.index(r.iteration)
+        np.testing.assert_allclose(r.data, expected_output[pos])
+        np.testing.assert_array_equal(r.count, expected_count[pos])
+    return sink
+
+
+null_sink = lambda r: None  # noqa: E731
+
+
+class Harness:
+    def __init__(self, source, sink=null_sink, strict=True):
+        self.router = Router()
+        self.probe = Probe(self.router)
+        self.worker = AllreduceWorker(self.router, source, sink,
+                                      strict=strict)
+
+    def peers_as_probe(self, n):
+        return {i: self.probe.ref for i in range(n)}
+
+    def tell(self, msg):
+        self.router.send(self.worker.ref, msg)
+
+    def init(self, workers, worker_num, idx, th_reduce, th_complete, max_lag,
+             data_size, max_chunk_size):
+        self.tell(InitWorkers(workers, worker_num, self.probe.ref, idx,
+                              th_reduce, th_complete, max_lag, data_size,
+                              max_chunk_size))
+
+    def expect_scatter(self, expected: ScatterBlock):
+        got = self.probe.receive_one()
+        assert isinstance(got, ScatterBlock), f"expected scatter, got {got!r}"
+        assert got.src_id == expected.src_id
+        assert got.dest_id == expected.dest_id
+        assert got.round == expected.round
+        assert got.chunk_id == expected.chunk_id
+        np.testing.assert_allclose(got.value, expected.value)
+
+    def expect_reduce(self, expected: ReduceBlock):
+        got = self.probe.receive_one()
+        assert isinstance(got, ReduceBlock), f"expected reduce, got {got!r}"
+        assert got.src_id == expected.src_id
+        assert got.dest_id == expected.dest_id
+        assert got.round == expected.round
+        assert got.chunk_id == expected.chunk_id
+        assert got.count == expected.count
+        np.testing.assert_allclose(got.value, expected.value)
+
+    def expect_complete(self, src_id, round_):
+        got = self.probe.receive_one()
+        assert got == CompleteAllreduce(src_id, round_), f"got {got!r}"
+
+    def fish_for_complete(self, src_id, round_):
+        """Skip other traffic until the completion arrives
+        (reference fishForMessage)."""
+        while True:
+            got = self.probe.receive_one()
+            if isinstance(got, CompleteAllreduce):
+                assert got == CompleteAllreduce(src_id, round_)
+                return
+
+    def expect_no_msg(self):
+        self.probe.expect_no_msg()
+
+
+def f32(*xs):
+    return np.array(xs, dtype=np.float32)
+
+
+# -- scenarios --------------------------------------------------------------
+
+
+class TestFlushedOutput:
+    """reference: AllreduceSpec.scala:46-97 'sum up all correct data'."""
+
+    def test_sum_up_all_correct_data(self):
+        gen = lambda idx, it: idx + float(it)  # noqa: E731
+        data_size, worker_num, idx = 3, 2, 1
+        out0 = [gen(i, 0) * worker_num for i in range(data_size)]
+        out1 = [gen(i, 1) * worker_num for i in range(data_size)]
+        sink = assertive_sink([out0, out1], [[2, 2, 2]] * 2, [0, 1])
+        h = Harness(custom_source(data_size, gen), sink)
+        # rank 1 is the worker itself: self-delivery bypass is exercised
+        workers = h.peers_as_probe(worker_num)
+        workers[idx] = h.worker.ref
+        h.init(workers, worker_num, idx, 1.0, 1.0, 5, data_size, 2)
+
+        h.tell(StartAllreduce(0))
+        h.tell(ScatterBlock(f32(2), 0, 1, 0, 0))
+        h.tell(ReduceBlock(f32(0, 2), 0, 1, 0, 0, count=2))
+        h.tell(StartAllreduce(1))
+        h.tell(ScatterBlock(f32(3), 0, 1, 0, 1))
+        h.tell(ReduceBlock(f32(2, 4), 0, 1, 0, 1, count=2))
+
+        h.fish_for_complete(1, 0)
+        h.fish_for_complete(1, 1)
+
+
+class TestEarlyReceivingReduce:
+    """reference: AllreduceSpec.scala:99-139: reduces for a future round
+    complete that round before its scatter even starts; late scatters are
+    then ignored."""
+
+    def test_future_reduce_completes_then_scatters_ignored(self):
+        h = Harness(basic_source(8))
+        h.init(h.peers_as_probe(4), 4, 0, 1.0, 0.8, 5, 8, 2)
+        h.tell(StartAllreduce(0))
+        future = 3
+        h.tell(ReduceBlock(f32(12, 15), 0, 0, 0, future, 4))
+        h.tell(ReduceBlock(f32(11, 10), 1, 0, 0, future, 4))
+        h.tell(ReduceBlock(f32(10, 20), 2, 0, 0, future, 4))
+        h.tell(ReduceBlock(f32(9, 10), 3, 0, 0, future, 4))
+        h.fish_for_complete(0, future)
+
+        # completed round: scatters are silently dropped
+        for i in range(4):
+            h.tell(ScatterBlock(f32(2 * i, 2 * i), i, 0, 0, future))
+        # drain remaining scatter chatter; no reduce/complete may appear
+        for m in h.probe.drain():
+            assert isinstance(m, ScatterBlock)
+
+
+class TestNodesLiveAtDifferentTimes:
+    """reference: AllreduceSpec.scala:141-172: partial peer map scatters only
+    to known peers; re-init refreshes the map."""
+
+    def test_partial_then_full_peer_map(self):
+        h = Harness(basic_source(8))
+        full = h.peers_as_probe(4)
+        partial = {0: full[0]}
+        h.init(partial, 4, 0, 1.0, 1.0, 5, 8, 2)
+        h.tell(StartAllreduce(0))
+        h.expect_scatter(ScatterBlock(f32(0, 1), 0, 0, 0, 0))
+        h.expect_no_msg()
+
+        h.init(full, 4, 0, 1.0, 1.0, 5, 8, 2)
+        h.tell(StartAllreduce(1))
+        for i in range(4):
+            h.expect_scatter(
+                ScatterBlock(f32(2 * i + 1, 2 * i + 2), 0, i, 0, 1))
+
+
+class TestSingleRound:
+    """reference: AllreduceSpec.scala:174-213: full message-by-message
+    choreography of one round."""
+
+    def test_single_round_allreduce(self):
+        h = Harness(basic_source(8))
+        h.init(h.peers_as_probe(4), 4, 0, 1.0, 0.75, 5, 8, 2)
+        h.tell(StartAllreduce(0))
+        for i in range(4):
+            h.expect_scatter(
+                ScatterBlock(f32(2 * i, 2 * i + 1), 0, i, 0, 0))
+        for i in range(4):
+            h.tell(ScatterBlock(f32(2 * i, 2 * i), i, 0, 0, 0))
+        for i in range(4):
+            h.expect_reduce(ReduceBlock(f32(12, 12), 0, i, 0, 0, 4))
+        h.tell(ReduceBlock(f32(12, 15), 0, 0, 0, 0, 4))
+        h.tell(ReduceBlock(f32(11, 10), 1, 0, 0, 0, 4))
+        h.tell(ReduceBlock(f32(10, 20), 2, 0, 0, 0, 4))
+        h.tell(ReduceBlock(f32(9, 10), 3, 0, 0, 0, 4))
+        h.expect_complete(0, 0)
+
+    def test_uneven_size_sending_to_self_first(self):
+        """reference: AllreduceSpec.scala:215-238: rank-staggered order means
+        rank 1 sends to itself first; uneven 3-element split over 2 ranks."""
+        h = Harness(basic_source(3))
+        h.init(h.peers_as_probe(2), 2, 1, 1.0, 1.0, 1, 3, 1)
+        h.tell(StartAllreduce(0))
+        h.expect_scatter(ScatterBlock(f32(2), 1, 1, 0, 0))
+        h.expect_scatter(ScatterBlock(f32(0), 1, 0, 0, 0))
+        h.expect_scatter(ScatterBlock(f32(1), 1, 0, 1, 0))
+
+    def test_nasty_chunk_size(self):
+        """reference: AllreduceSpec.scala:240-284: non-dividing chunk sizes
+        with thresholds < 1."""
+        h = Harness(basic_source(6))
+        h.init(h.peers_as_probe(2), 2, 0, 0.9, 0.8, 5, 6, 2)
+        h.tell(StartAllreduce(0))
+        h.expect_scatter(ScatterBlock(f32(0, 1), 0, 0, 0, 0))
+        h.expect_scatter(ScatterBlock(f32(2), 0, 0, 1, 0))
+        h.expect_scatter(ScatterBlock(f32(3, 4), 0, 1, 0, 0))
+        h.expect_scatter(ScatterBlock(f32(5), 0, 1, 1, 0))
+
+        h.tell(ScatterBlock(f32(0, 1), 0, 0, 0, 0))
+        h.tell(ScatterBlock(f32(2), 0, 0, 1, 0))
+        h.tell(ScatterBlock(f32(0, 1), 1, 0, 0, 0))
+        h.tell(ScatterBlock(f32(2), 1, 0, 1, 0))
+
+        # th_reduce 0.9 * 2 peers -> gate 1: each chunk reduces on FIRST
+        # arrival with count 1
+        h.expect_reduce(ReduceBlock(f32(0, 1), 0, 0, 0, 0, 1))
+        h.expect_reduce(ReduceBlock(f32(0, 1), 0, 1, 0, 0, 1))
+        h.expect_reduce(ReduceBlock(f32(2), 0, 0, 1, 0, 1))
+        h.expect_reduce(ReduceBlock(f32(2), 0, 1, 1, 0, 1))
+
+        h.tell(ReduceBlock(f32(0, 2), 0, 0, 0, 0, 1))
+        h.tell(ReduceBlock(f32(4), 0, 0, 1, 0, 1))
+        h.tell(ReduceBlock(f32(6, 8), 1, 0, 0, 0, 1))
+        h.expect_complete(0, 0)
+        h.tell(ReduceBlock(f32(10), 1, 0, 1, 0, 1))
+        h.expect_no_msg()
+
+    def test_nasty_chunk_size_contd(self):
+        """reference: AllreduceSpec.scala:286-349: chunk size 1, thresholds
+        0.7, 3 workers, late reduces after completion are dropped."""
+        h = Harness(basic_source(9))
+        h.init(h.peers_as_probe(3), 3, 0, 0.7, 0.7, 5, 9, 1)
+        h.tell(StartAllreduce(0))
+        for dest in range(3):
+            for c in range(3):
+                h.expect_scatter(
+                    ScatterBlock(f32(dest * 3 + c), 0, dest, c, 0))
+        for src in range(3):
+            for c in range(3):
+                h.tell(ScatterBlock(f32(c), src, 0, c, 0))
+        # gate = int(0.7*3) = 2: fires on the second arrival of each chunk
+        for c in range(3):
+            for dest in range(3):
+                h.expect_reduce(ReduceBlock(f32(2 * c), 0, dest, c, 0, 2))
+        # completion gate = int(0.7 * 9) = 6: fires at the 7th store?? No:
+        # == 6 fires exactly at the 6th reduced chunk staged.
+        h.tell(ReduceBlock(f32(0), 0, 0, 0, 0, 2))
+        h.tell(ReduceBlock(f32(3), 0, 0, 1, 0, 2))
+        h.tell(ReduceBlock(f32(6), 0, 0, 2, 0, 2))
+        h.tell(ReduceBlock(f32(9), 1, 0, 0, 0, 2))
+        h.tell(ReduceBlock(f32(12), 1, 0, 1, 0, 2))
+        h.tell(ReduceBlock(f32(15), 1, 0, 2, 0, 2))
+        h.expect_complete(0, 0)
+        h.tell(ReduceBlock(f32(18), 2, 0, 0, 0, 2))
+        h.tell(ReduceBlock(f32(21), 2, 0, 1, 0, 2))
+        h.tell(ReduceBlock(f32(24), 2, 0, 2, 0, 2))
+        h.expect_no_msg()
+
+
+class TestMultiRound:
+    """reference: AllreduceSpec.scala:351-422: 10 pipelined rounds at two
+    threshold settings."""
+
+    def test_multi_round(self):
+        h = Harness(basic_source(8))
+        h.init(h.peers_as_probe(4), 4, 0, 0.8, 0.5, 5, 8, 2)
+        for i in range(10):
+            h.tell(StartAllreduce(i))
+            for d in range(4):
+                h.expect_scatter(
+                    ScatterBlock(f32(2 * d + i, 2 * d + 1 + i), 0, d, 0, i))
+            for s in range(4):
+                h.tell(ScatterBlock(f32(0 + i, 1 + i), s, 0, 0, i))
+            # gate int(0.8*4)=3: fires at third arrival, sum = 3*(i, 1+i)
+            for d in range(4):
+                h.expect_reduce(
+                    ReduceBlock(f32(3 * i, 3 + 3 * i), 0, d, 0, i, 3))
+            h.tell(ReduceBlock(f32(1, 2), 0, 0, 0, i, 3))
+            h.tell(ReduceBlock(f32(1, 2), 1, 0, 0, i, 3))
+            h.expect_complete(0, i)
+            h.tell(ReduceBlock(f32(1, 2), 2, 0, 0, i, 3))
+            h.tell(ReduceBlock(f32(1, 2), 3, 0, 0, i, 3))
+            h.expect_no_msg()
+
+    def test_multi_round_v2(self):
+        h = Harness(basic_source(8))
+        h.init(h.peers_as_probe(2), 2, 0, 0.6, 0.8, 5, 8, 2)
+        for i in range(10):
+            h.tell(StartAllreduce(i))
+            h.expect_scatter(ScatterBlock(f32(0 + i, 1 + i), 0, 0, 0, i))
+            h.expect_scatter(ScatterBlock(f32(2 + i, 3 + i), 0, 0, 1, i))
+            h.expect_scatter(ScatterBlock(f32(4 + i, 5 + i), 0, 1, 0, i))
+            h.expect_scatter(ScatterBlock(f32(6 + i, 7 + i), 0, 1, 1, i))
+            h.tell(ScatterBlock(f32(0 + i, 1 + i), 0, 0, 0, i))
+            h.tell(ScatterBlock(f32(2 + i, 3 + i), 0, 0, 1, i))
+            h.tell(ScatterBlock(f32(10 + i, 11 + i), 1, 0, 0, i))
+            h.tell(ScatterBlock(f32(12 + i, 13 + i), 1, 0, 1, i))
+            h.expect_reduce(ReduceBlock(f32(0 + i, 1 + i), 0, 0, 0, i, 1))
+            h.expect_reduce(ReduceBlock(f32(0 + i, 1 + i), 0, 1, 0, i, 1))
+            h.expect_reduce(ReduceBlock(f32(2 + i, 3 + i), 0, 0, 1, i, 1))
+            h.expect_reduce(ReduceBlock(f32(2 + i, 3 + i), 0, 1, 1, i, 1))
+            h.tell(ReduceBlock(f32(1, 2), 0, 0, 0, i, 1))
+            h.tell(ReduceBlock(f32(1, 2), 0, 0, 1, i, 1))
+            h.tell(ReduceBlock(f32(1, 2), 1, 0, 0, i, 1))
+            h.expect_complete(0, i)
+            h.tell(ReduceBlock(f32(1, 2), 1, 0, 1, i, 1))
+            h.expect_no_msg()
+
+
+class TestStragglers:
+    """reference: AllreduceSpec.scala:424-599: missed/delayed messages."""
+
+    def test_missed_scatter(self):
+        h = Harness(basic_source(4))
+        h.init(h.peers_as_probe(4), 4, 0, 0.75, 0.75, 5, 4, 2)
+        h.tell(StartAllreduce(0))
+        for d in range(4):
+            h.expect_scatter(ScatterBlock(f32(d), 0, d, 0, 0))
+        h.tell(ScatterBlock(f32(0), 0, 0, 0, 0))
+        h.expect_no_msg()
+        h.tell(ScatterBlock(f32(2), 1, 0, 0, 0))
+        h.expect_no_msg()
+        h.tell(ScatterBlock(f32(4), 2, 0, 0, 0))
+        h.tell(ScatterBlock(f32(6), 3, 0, 0, 0))
+        # gate 3 fired at third arrival: sum 0+2+4=6, count 3; the 4th
+        # absorbed silently (exactly-once)
+        for d in range(4):
+            h.expect_reduce(ReduceBlock(f32(6), 0, d, 0, 0, 3))
+        h.tell(ReduceBlock(f32(12), 0, 0, 0, 0, 3))
+        h.tell(ReduceBlock(f32(11), 1, 0, 0, 0, 3))
+        h.tell(ReduceBlock(f32(10), 2, 0, 0, 0, 3))
+        h.expect_complete(0, 0)
+        h.tell(ReduceBlock(f32(9), 3, 0, 0, 0, 3))
+        h.expect_no_msg()
+
+    def test_future_scatter(self):
+        """Interleaved two-round delivery with a delayed straggler
+        (reference: AllreduceSpec.scala:461-513)."""
+        h = Harness(basic_source(4))
+        h.init(h.peers_as_probe(4), 4, 0, 0.75, 0.75, 5, 4, 2)
+        h.tell(StartAllreduce(0))
+        for d in range(4):
+            h.expect_scatter(ScatterBlock(f32(d), 0, d, 0, 0))
+        h.tell(ScatterBlock(f32(2), 1, 0, 0, 0))
+        h.tell(ScatterBlock(f32(4), 2, 0, 0, 0))
+        h.tell(ReduceBlock(f32(11), 1, 0, 0, 0, 3))
+        h.tell(ReduceBlock(f32(10), 2, 0, 0, 0, 3))
+        h.tell(StartAllreduce(1))
+        h.tell(ScatterBlock(f32(2), 1, 0, 0, 1))
+        h.tell(ScatterBlock(f32(4), 2, 0, 0, 1))
+        h.tell(ScatterBlock(f32(6), 3, 0, 0, 1))
+        for d in range(4):
+            h.expect_scatter(ScatterBlock(f32(d + 1), 0, d, 0, 1))
+        for d in range(4):
+            h.expect_reduce(ReduceBlock(f32(12), 0, d, 0, 1, 3))
+        # round 0 stragglers arrive late: third arrival fires reduce; the
+        # next is outdated and dropped
+        h.tell(ScatterBlock(f32(0), 3, 0, 0, 0))
+        h.tell(ScatterBlock(f32(6), 3, 0, 0, 0))
+        for d in range(4):
+            h.expect_reduce(ReduceBlock(f32(6), 0, d, 0, 0, 3))
+        h.tell(ReduceBlock(f32(9), 3, 0, 0, 0, 3))
+        h.expect_complete(0, 0)
+        h.tell(ReduceBlock(f32(11), 1, 0, 0, 1, 3))
+        h.tell(ReduceBlock(f32(10), 2, 0, 0, 1, 3))
+        h.tell(ReduceBlock(f32(9), 3, 0, 0, 1, 3))
+        h.expect_complete(0, 1)
+
+    def test_missed_reduce(self):
+        """reference: AllreduceSpec.scala:515-548."""
+        h = Harness(basic_source(4))
+        h.init(h.peers_as_probe(4), 4, 0, 1.0, 0.75, 5, 4, 100)
+        h.tell(StartAllreduce(0))
+        for d in range(4):
+            h.expect_scatter(ScatterBlock(f32(d), 0, d, 0, 0))
+        h.tell(ScatterBlock(f32(0), 0, 0, 0, 0))
+        h.tell(ScatterBlock(f32(2), 1, 0, 0, 0))
+        h.tell(ScatterBlock(f32(4), 2, 0, 0, 0))
+        h.tell(ScatterBlock(f32(6), 3, 0, 0, 0))
+        for d in range(4):
+            h.expect_reduce(ReduceBlock(f32(12), 0, d, 0, 0, 4))
+        h.tell(ReduceBlock(f32(12), 0, 0, 0, 0, 4))
+        h.expect_no_msg()
+        h.tell(ReduceBlock(f32(11), 1, 0, 0, 0, 4))
+        h.expect_no_msg()
+        h.tell(ReduceBlock(f32(10), 2, 0, 0, 0, 4))
+        h.expect_complete(0, 0)  # gate int(0.75*4)=3: peer 3's never needed
+
+    def test_delayed_future_reduce(self):
+        """reference: AllreduceSpec.scala:550-599: FIFO-ordered interleaved
+        round 0/1 reduces complete both rounds in order."""
+        h = Harness(basic_source(4))
+        h.init(h.peers_as_probe(4), 4, 0, 0.75, 0.75, 5, 4, 100)
+        h.tell(StartAllreduce(0))
+        for d in range(4):
+            h.expect_scatter(ScatterBlock(f32(d), 0, d, 0, 0))
+        h.tell(ScatterBlock(f32(2), 1, 0, 0, 0))
+        h.tell(ScatterBlock(f32(4), 2, 0, 0, 0))
+        h.tell(ScatterBlock(f32(6), 3, 0, 0, 0))
+        for d in range(4):
+            h.expect_reduce(ReduceBlock(f32(12), 0, d, 0, 0, 3))
+        h.tell(StartAllreduce(1))
+        h.tell(ScatterBlock(f32(3), 1, 0, 0, 1))
+        h.tell(ScatterBlock(f32(5), 2, 0, 0, 1))
+        h.tell(ScatterBlock(f32(7), 3, 0, 0, 1))
+        for d in range(4):
+            h.expect_scatter(ScatterBlock(f32(d + 1), 0, d, 0, 1))
+        for d in range(4):
+            h.expect_reduce(ReduceBlock(f32(15), 0, d, 0, 1, 3))
+        h.tell(ReduceBlock(f32(11), 1, 0, 0, 0, 3))
+        h.tell(ReduceBlock(f32(11), 1, 0, 0, 1, 3))
+        h.tell(ReduceBlock(f32(10), 2, 0, 0, 0, 3))
+        h.tell(ReduceBlock(f32(10), 2, 0, 0, 1, 3))
+        h.tell(ReduceBlock(f32(9), 3, 0, 0, 0, 3))
+        h.tell(ReduceBlock(f32(9), 3, 0, 0, 1, 3))
+        h.expect_complete(0, 0)
+        h.expect_complete(0, 1)
+
+
+class TestCatchUp:
+    """reference: AllreduceSpec.scala:603-656."""
+
+    def _expect_basic_scatters(self, h, i):
+        for d in range(4):
+            h.expect_scatter(
+                ScatterBlock(f32(2 * d + i, 2 * d + 1 + i), 0, d, 0, i))
+
+    def test_simple_catchup(self):
+        h = Harness(basic_source(8))
+        h.init(h.peers_as_probe(4), 4, 0, 1.0, 1.0, 5, 8, 2)
+        for i in range(6):
+            h.tell(StartAllreduce(i))
+            self._expect_basic_scatters(h, i)
+            h.tell(ScatterBlock(f32(1 * (i + 1), 1 * (i + 1)), 1, 0, 0, i))
+            h.tell(ScatterBlock(f32(2 * (i + 1), 2 * (i + 1)), 2, 0, 0, i))
+            h.tell(ScatterBlock(f32(4 * (i + 1), 4 * (i + 1)), 3, 0, 0, i))
+            h.tell(ReduceBlock(f32(12, 12), 1, 0, 0, i, 4))
+            h.tell(ReduceBlock(f32(12, 12), 2, 0, 0, i, 4))
+            h.tell(ReduceBlock(f32(12, 12), 3, 0, 0, i, 4))
+        for catchup_round in (6, 7, 8):
+            h.tell(StartAllreduce(catchup_round))
+            completion = catchup_round - 6  # maxLag+1 behind
+            # force-reduce of whatever arrived: 7*(i+1) from the three peers
+            v = 7.0 * (completion + 1)
+            for d in range(4):
+                h.expect_reduce(ReduceBlock(f32(v, v), 0, d, 0,
+                                            completion, 3))
+            h.expect_complete(0, completion)
+            self._expect_basic_scatters(h, catchup_round)
+
+    def test_cold_catchup(self):
+        """Worker woken at round 10 with maxLag 5 emits zero-data,
+        count-0 reduces and completes rounds 0-4 immediately
+        (reference: AllreduceSpec.scala:632-656)."""
+        h = Harness(basic_source(8))
+        h.init(h.peers_as_probe(4), 4, 0, 1.0, 1.0, 5, 8, 2)
+        h.tell(StartAllreduce(10))
+        for i in range(5):
+            for d in range(4):
+                h.expect_reduce(ReduceBlock(f32(0, 0), 0, d, 0, i, 0))
+            h.expect_complete(0, i)
+        for i in range(11):
+            self._expect_basic_scatters(h, i)
+
+
+class TestOutOfOrderCompletion:
+    """reference: AllreduceSpec.scala:662-734 'multi-round allreduce v3':
+    round 1 completes before round 0."""
+
+    def test_round1_completes_before_round0(self):
+        h = Harness(basic_source(9))
+        h.init(h.peers_as_probe(3), 3, 0, 0.75, 0.75, 5, 9, 2)
+        h.tell(StartAllreduce(0))
+        h.expect_scatter(ScatterBlock(f32(0, 1), 0, 0, 0, 0))
+        h.expect_scatter(ScatterBlock(f32(2), 0, 0, 1, 0))
+        h.expect_scatter(ScatterBlock(f32(3, 4), 0, 1, 0, 0))
+        h.expect_scatter(ScatterBlock(f32(5), 0, 1, 1, 0))
+        h.expect_scatter(ScatterBlock(f32(6, 7), 0, 2, 0, 0))
+        h.expect_scatter(ScatterBlock(f32(8), 0, 2, 1, 0))
+
+        h.tell(ScatterBlock(f32(0, 1), 0, 0, 0, 0))
+        h.tell(ScatterBlock(f32(0, 1), 1, 0, 0, 0))
+        h.tell(ScatterBlock(f32(0, 1), 2, 0, 0, 0))
+        h.tell(ScatterBlock(f32(2), 0, 0, 1, 0))
+        h.tell(ScatterBlock(f32(2), 1, 0, 1, 0))
+        h.tell(ScatterBlock(f32(2), 2, 0, 1, 0))
+        for d in range(3):
+            h.expect_reduce(ReduceBlock(f32(0, 2), 0, d, 0, 0, 2))
+        for d in range(3):
+            h.expect_reduce(ReduceBlock(f32(4), 0, d, 1, 0, 2))
+
+        h.tell(StartAllreduce(1))
+        h.tell(ScatterBlock(f32(10, 11), 1, 0, 0, 1))
+        h.tell(ScatterBlock(f32(12), 1, 0, 1, 1))
+        h.tell(ScatterBlock(f32(10, 11), 2, 0, 0, 1))
+        h.tell(ScatterBlock(f32(12), 2, 0, 1, 1))
+        h.expect_scatter(ScatterBlock(f32(1, 2), 0, 0, 0, 1))
+        h.expect_scatter(ScatterBlock(f32(3), 0, 0, 1, 1))
+        h.expect_scatter(ScatterBlock(f32(4, 5), 0, 1, 0, 1))
+        h.expect_scatter(ScatterBlock(f32(6), 0, 1, 1, 1))
+        h.expect_scatter(ScatterBlock(f32(7, 8), 0, 2, 0, 1))
+        h.expect_scatter(ScatterBlock(f32(9), 0, 2, 1, 1))
+        for d in range(3):
+            h.expect_reduce(ReduceBlock(f32(20, 22), 0, d, 0, 1, 2))
+        for d in range(3):
+            h.expect_reduce(ReduceBlock(f32(24), 0, d, 1, 1, 2))
+
+        # completion gate = int(0.75 * 6) = 4 chunks
+        h.tell(ReduceBlock(f32(11, 11), 1, 0, 0, 0, 2))
+        h.tell(ReduceBlock(f32(11), 1, 0, 1, 1, 2))
+        h.tell(ReduceBlock(f32(11, 11), 1, 0, 0, 1, 2))
+        h.tell(ReduceBlock(f32(11), 1, 0, 1, 0, 2))
+        h.tell(ReduceBlock(f32(11, 11), 2, 0, 0, 0, 2))
+        h.tell(ReduceBlock(f32(11), 2, 0, 1, 1, 2))
+        h.expect_no_msg()
+        h.tell(ReduceBlock(f32(11, 11), 2, 0, 0, 1, 2))
+        h.expect_complete(0, 1)
+        h.tell(ReduceBlock(f32(11), 2, 0, 1, 0, 2))
+        h.expect_complete(0, 0)
+
+
+class TestGuards:
+    """Strict-mode guard conditions (reference:
+    AllreduceWorker.scala:149-154)."""
+
+    def test_oversized_reduce_block_raises(self):
+        h = Harness(basic_source(4), strict=True)
+        h.init(h.peers_as_probe(2), 2, 0, 1.0, 1.0, 1, 4, 2)
+        h.router.pump()
+        with pytest.raises(ValueError, match="exceeds max chunk"):
+            h.worker.handle_reduce_block(
+                ReduceBlock(f32(1, 2, 3), 1, 0, 0, 0, 1))
+
+    def test_misrouted_reduce_block_raises(self):
+        h = Harness(basic_source(4), strict=True)
+        h.init(h.peers_as_probe(2), 2, 0, 1.0, 1.0, 1, 4, 2)
+        h.router.pump()
+        with pytest.raises(ValueError, match="incorrectly routed"):
+            h.worker.handle_reduce_block(ReduceBlock(f32(1), 1, 1, 0, 0, 1))
+
+    def test_uninitialized_worker_requeues(self):
+        """Messages before InitWorkers self-requeue and are replayed after
+        init (reference: AllreduceWorker.scala:95-97, :120-122)."""
+        h = Harness(basic_source(8))
+        h.tell(StartAllreduce(0))
+        # pump would spin forever; cap proves the requeue loop exists
+        with pytest.raises(RuntimeError, match="re-queue loop"):
+            h.router.pump(max_messages=50)
+        # now init: the queued start replays and scatters flow
+        h.init(h.peers_as_probe(4), 4, 0, 1.0, 1.0, 5, 8, 2)
+        for d in range(4):
+            h.expect_scatter(
+                ScatterBlock(f32(2 * d, 2 * d + 1), 0, d, 0, 0))
