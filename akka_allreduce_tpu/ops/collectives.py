@@ -1,0 +1,80 @@
+"""XLA collective paths: the allreduce hot loop, TPU-native.
+
+The reference implements allreduce in application code as direct P2P
+scatter-reduce plus direct broadcast — structurally reduce-scatter +
+all-gather with fan-out N-1 (reference: AllreduceWorker.scala:212-268;
+SURVEY.md §5.8). On TPU both phases lower to single XLA collectives over ICI:
+
+* :func:`two_phase_allreduce` — ``psum_scatter`` (the scatter+reduce phases:
+  each rank ends owning the reduced version of *its* block, exactly the
+  reference's block-ownership rule AllreduceWorker.scala:240-250) followed by
+  ``all_gather`` (the broadcast phase). Chunk granularity = the bucket
+  leading axis from ops/bucketing.py.
+* :func:`psum_allreduce` — the fused fast path when thresholds are 1.0
+  (the reference's whole protocol degenerates to one sum).
+
+Both are *rank-local* functions meant for use inside ``shard_map`` /
+``pjit``-traced train steps; the ``exact_allreduce`` driver wraps one for
+standalone use on a stacked per-device contribution array (the emulation of
+N workers each holding a full gradient vector).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def psum_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
+    """Fused allreduce: one XLA AllReduce over the mesh axis. Rank-local
+    (call inside shard_map)."""
+    return lax.psum(x, axis_name)
+
+
+def two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
+    """Reduce-scatter + all-gather along the *last* axis. Rank-local.
+
+    Requires the last-axis length to be divisible by the axis size — use
+    bucket_elems that are a multiple of the group size (pad otherwise;
+    ops/bucketing pads with zeros which sum harmlessly).
+    """
+    n = lax.axis_size(axis_name)
+    if x.shape[-1] % n != 0:
+        raise ValueError(
+            f"last axis {x.shape[-1]} not divisible by group size {n}; "
+            "choose bucket_elems as a multiple of the dp axis size")
+    scattered = lax.psum_scatter(x, axis_name, scatter_dimension=x.ndim - 1,
+                                 tiled=True)
+    return lax.all_gather(scattered, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def exact_allreduce(stacked: jnp.ndarray, mesh: Mesh, axis_name: str = "dp",
+                    two_phase: bool = False) -> jnp.ndarray:
+    """Standalone driver: ``stacked[(i, ...)]`` is rank i's contribution;
+    every row of the result is the full sum (the reference's
+    ``output == sum over workers`` invariant,
+    AllreduceWorker.scala:337-339).
+
+    This is the N-workers-each-holding-a-vector emulation used by tests and
+    benchmarks; real training steps call the rank-local functions inside
+    their own shard_map.
+    """
+    if stacked.shape[0] != mesh.shape[axis_name]:
+        raise ValueError(
+            f"leading axis {stacked.shape[0]} != mesh axis "
+            f"{mesh.shape[axis_name]}")
+
+    reduce_fn = two_phase_allreduce if two_phase else psum_allreduce
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axis_name),
+             out_specs=P(axis_name))
+    def _allreduce(xs):
+        # xs: (1, ...) — this rank's contribution
+        return reduce_fn(xs[0], axis_name)[None]
+
+    return _allreduce(stacked)

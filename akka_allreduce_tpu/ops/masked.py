@@ -1,0 +1,64 @@
+"""Lossy (threshold) allreduce semantics as mask/count arithmetic.
+
+The reference's thresholds < 1 make the allreduce lossy: a round's output may
+include only a subset of peers' contributions, and the sink receives
+per-element contribution counts so it can rescale
+(reference: ScatteredDataBuffer.scala:9-13; ReducedDataBuffer.scala:40-48;
+SURVEY.md §3a.3, §3a.9).
+
+XLA collectives are bulk-synchronous and deterministic — "reduce when 90%
+arrived" has no direct lowering (SURVEY.md §7 hard parts). The observable
+semantics are preserved by making participation *data*: every rank always
+participates in the psum but contributes ``(values * valid, valid)`` per
+bucket. A straggling rank whose round deadline passed contributes zeros with
+valid=0, and the summed valid masks ARE the reference's piggybacked counts
+(ReduceBlock.count expanded per element). Who gets masked is decided at the
+host layer: the round pacer zero-masks contributions that missed their
+deadline (runtime/pacer.py), mirroring the reference's force-completed
+stale rounds.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from akka_allreduce_tpu.ops.bucketing import BucketSpec
+
+
+def masked_allreduce(buckets: jnp.ndarray, valid: jnp.ndarray,
+                     axis_name: str = "dp") -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Rank-local lossy allreduce (call inside shard_map).
+
+    ``buckets``: (num_buckets, bucket_elems) — this rank's contribution.
+    ``valid``: (num_buckets,) bool/int — which buckets this rank contributes
+    this round (the per-chunk granularity of the reference's gates).
+
+    Returns ``(summed_buckets, counts)`` where ``counts[b]`` is the number of
+    ranks whose bucket b arrived — the ReduceBlock.count piggyback
+    (reference: AllreduceMessage.scala:20).
+    """
+    v = valid.astype(buckets.dtype)
+    contrib = buckets * v[:, None]
+    summed, counts = lax.psum(
+        (contrib, valid.astype(jnp.int32)), axis_name)
+    return summed, counts
+
+
+def expand_bucket_counts(counts: jnp.ndarray, spec: BucketSpec) -> jnp.ndarray:
+    """Per-bucket counts → per-element counts over the unpadded vector,
+    duplicating each bucket's count across its elements
+    (reference: ReducedDataBuffer.scala:46)."""
+    per_elem = jnp.repeat(counts, spec.bucket_elems)
+    return per_elem[:spec.total_size]
+
+
+def rescale_by_count(summed: jnp.ndarray, counts: jnp.ndarray,
+                     target: float = 1.0) -> jnp.ndarray:
+    """Turn a partial sum into a mean scaled to ``target`` contributors:
+    ``summed * target / max(counts, 1)`` — the "divide by count"
+    compensation the reference's data-sink contract exists for
+    (SURVEY.md §3a.3). Elements nobody contributed stay 0.
+    """
+    counts = counts.astype(summed.dtype)
+    return jnp.where(counts > 0, summed * target / jnp.maximum(counts, 1), 0.0)

@@ -110,8 +110,21 @@ class LocalCluster:
         (e.g. thresholds=1.0 with a dead worker) the pump drains early and
         fewer rounds complete. Returns the number of paced rounds."""
         self.start()
-        self.router.pump()
+        self.router.pump(max_messages=self._message_budget())
         return len(self.completed_rounds)
+
+    def _message_budget(self) -> int:
+        """Scale the pump's runaway-loop cap to the configured workload so
+        long healthy runs never trip it: per round each worker sends ~2
+        messages per chunk (scatter + reduce) to every peer plus a
+        completion; x16 slack on top."""
+        from akka_allreduce_tpu.config import num_chunks
+        n = self.config.workers.total_size
+        chunks = max(1, num_chunks(self.config.data.data_size,
+                                   self.config.data.max_chunk_size))
+        per_round = n * n * 2 * chunks + 4 * n
+        rounds = self.config.data.max_round + self.config.workers.max_lag + 2
+        return max(1_000_000, 16 * per_round * rounds)
 
     def kill_worker(self, rank: int) -> None:
         """Simulate a worker death: deathwatch fires on master and peers

@@ -205,8 +205,9 @@ class AllreduceWorker:
     def handle_scatter_block(self, s: ScatterBlock) -> None:
         """Stage a peer's chunk of my block; reduce + broadcast when the
         th_reduce gate fires (reference: AllreduceWorker.scala:170-186)."""
-        assert s.dest_id == self.id, \
-            f"scatter for {s.dest_id} routed to {self.id}"
+        if s.dest_id != self.id:
+            raise ValueError(
+                f"scatter for {s.dest_id} incorrectly routed to {self.id}")
         if s.round < self.round or s.round in self.completed:
             log.debug("worker %d: outdated scatter round %d", self.id, s.round)
         elif s.round <= self.max_round:
@@ -222,17 +223,9 @@ class AllreduceWorker:
             self.router.send(self.ref, s)
 
     def _scatter(self) -> None:
-        """Send every peer its (chunked) block of my input, rank-staggered so
-        all workers don't hammer rank 0 first
-        (reference: AllreduceWorker.scala:212-238). We iterate all peer_num
-        rank slots and skip gaps: the reference's ``range(peers.size)`` +
-        modular indexing silently starves live trailing ranks once a
-        mid-rank peer dies."""
-        for i in range(self.peer_num):
-            idx = (i + self.id) % self.peer_num
-            peer = self.peers.get(idx)
-            if peer is None:
-                continue
+        """Send every peer its (chunked) block of my input
+        (reference: AllreduceWorker.scala:212-238)."""
+        def send_block(idx, deliver):
             block_start, block_end = self._range(idx)
             peer_block_size = block_end - block_start
             peer_num_chunks = -(-peer_block_size // self.max_chunk_size) \
@@ -245,14 +238,10 @@ class AllreduceWorker:
                     self.data[block_start + chunk_start:
                               block_start + chunk_end],
                     dtype=np.float32)
-                msg = ScatterBlock(chunk, self.id, idx, c,
-                                   self.max_scattered + 1)
-                if peer is self.ref:
-                    # Self-delivery bypass: direct call, no mailbox hop
-                    # (reference: AllreduceWorker.scala:228-231).
-                    self.handle_scatter_block(msg)
-                else:
-                    self.router.send(peer, msg)
+                deliver(ScatterBlock(chunk, self.id, idx, c,
+                                     self.max_scattered + 1))
+
+        self._fan_out(send_block, self.handle_scatter_block)
 
     # -- reduce / broadcast phase -------------------------------------------
 
@@ -280,20 +269,31 @@ class AllreduceWorker:
 
     def _broadcast(self, data: np.ndarray, chunk_id: int, bcast_round: int,
                    reduce_count: int) -> None:
-        """Fan the reduced chunk out to every peer, rank-staggered, count
-        piggybacked (reference: AllreduceWorker.scala:252-268). All rank
-        slots are visited (gaps skipped) — see :meth:`_scatter`."""
+        """Fan the reduced chunk out to every peer, count piggybacked
+        (reference: AllreduceWorker.scala:252-268)."""
+        def send_block(idx, deliver):
+            deliver(ReduceBlock(data, self.id, idx, chunk_id, bcast_round,
+                                reduce_count))
+
+        self._fan_out(send_block, self.handle_reduce_block)
+
+    def _fan_out(self, send_block, self_handler) -> None:
+        """Rank-staggered peer iteration shared by scatter and broadcast:
+        start at own rank so all workers don't hammer rank 0 first
+        (reference: AllreduceWorker.scala:214, :255), visit ALL peer_num
+        rank slots skipping gaps (the reference's ``range(peers.size)`` +
+        modular indexing silently starves live trailing ranks once a
+        mid-rank peer dies), and deliver to self by direct call, no mailbox
+        hop (reference: AllreduceWorker.scala:228-231, :260-263)."""
         for i in range(self.peer_num):
             idx = (i + self.id) % self.peer_num
             peer = self.peers.get(idx)
             if peer is None:
                 continue
-            msg = ReduceBlock(data, self.id, idx, chunk_id, bcast_round,
-                              reduce_count)
             if peer is self.ref:
-                self.handle_reduce_block(msg)
+                send_block(idx, self_handler)
             else:
-                self.router.send(peer, msg)
+                send_block(idx, lambda msg, p=peer: self.router.send(p, msg))
 
     # -- completion ---------------------------------------------------------
 

@@ -1,0 +1,21 @@
+"""Runtime layer: host-side pacing, topology bootstrap, and deadlines.
+
+The reference's control plane split across master and worker — round pacing,
+the ``max_lag`` staleness window, catch-up, membership — lives here for the
+TPU deployment. Devices run ahead asynchronously (JAX dispatch is async);
+the pacer bounds how far, and converts missed deadlines into the masks the
+device plane's lossy collectives consume.
+"""
+
+from akka_allreduce_tpu.runtime.pacer import RoundPacer, RoundClock
+from akka_allreduce_tpu.runtime.coordinator import (
+    initialize_distributed,
+    topology_summary,
+)
+
+__all__ = [
+    "RoundPacer",
+    "RoundClock",
+    "initialize_distributed",
+    "topology_summary",
+]

@@ -1,0 +1,74 @@
+"""Topology bootstrap: ranks from hardware, not gossip.
+
+The reference's master derives membership from Akka cluster gossip and hands
+out ranks by arrival order (reference: AllreduceMaster.scala:30-44, :66-74).
+On TPU both are properties of the hardware allocation: the JAX distributed
+runtime (coordination service) already knows process count and process index,
+and ``jax.devices()`` enumerates the slice in topology order. This module
+wraps that bootstrap and exposes the same quorum/identity facts the master
+used to own.
+
+Multi-host: call :func:`initialize_distributed` once per process before any
+device use; collectives over a global mesh then ride ICI within a slice and
+DCN across slices, with XLA routing by mesh axis — no application-level
+transport (SURVEY.md §7 capability map, rows 1-2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySummary:
+    """The identity facts the reference's InitWorkers message carried
+    (rank, peer count) plus device geometry."""
+
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    platform: str
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.process_count > 1
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Join the multi-host coordination service (the master's quorum step).
+
+    No-ops when single-process and no coordinator is configured. On TPU pods
+    the three arguments are discoverable from the environment and may be
+    omitted (jax.distributed reads the TPU metadata); explicit values
+    support CPU/GPU fleets and tests.
+    """
+    explicit = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if explicit is None and num_processes is None:
+        log.debug("single-process run; skipping jax.distributed.initialize")
+        return
+    jax.distributed.initialize(
+        coordinator_address=explicit,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def topology_summary() -> TopologySummary:
+    devices = jax.devices()
+    return TopologySummary(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=len(devices),
+        platform=devices[0].platform if devices else "none",
+    )

@@ -1,0 +1,112 @@
+"""Round pacing: the ``max_lag`` bounded-staleness window on TPU.
+
+The reference keeps up to ``maxLag`` rounds in flight via its ring buffers
+(reference: AllReduceBuffer.scala:9-42; AllreduceWorker.scala:16, :100-111)
+and force-completes rounds that fall out of the window (§3.4 catch-up). The
+TPU equivalent exploits JAX's asynchronous dispatch: every submitted round's
+collective is in flight on the device stream the moment it is enqueued; the
+pacer simply refuses to run more than ``max_lag + 1`` rounds ahead of the
+oldest unfinished one, blocking on its result exactly when the reference's
+window would stall a fast worker.
+
+Straggler deadlines live here too: :class:`RoundClock` turns "peer X's
+contribution for round r missed its deadline" into the per-bucket ``valid``
+masks the lossy collective consumes (ops/masked.py) — the host-layer home of
+genuine timeout-based partial completion (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Optional
+
+import jax
+
+
+class RoundPacer:
+    """Bound in-flight rounds to ``max_lag + 1``, like the reference's ring
+    of ``maxLag + 1`` buffer rows (reference: AllreduceWorker.scala:64)."""
+
+    def __init__(self, max_lag: int = 1):
+        if max_lag < 0:
+            raise ValueError("max_lag must be >= 0")
+        self.max_lag = max_lag
+        self._inflight: collections.deque[tuple[int, Any]] = \
+            collections.deque()
+        self._next_round = 0
+        self.completed_rounds: list[int] = []
+
+    @property
+    def round(self) -> int:
+        return self._next_round
+
+    def submit(self, step: Callable[[int], Any]) -> Any:
+        """Dispatch ``step(round)`` (typically a jitted train/allreduce step;
+        returns device arrays asynchronously). If the window is full, first
+        block on the oldest round — that is the pacing stall."""
+        while len(self._inflight) > self.max_lag:
+            self._harvest_oldest()
+        r = self._next_round
+        out = step(r)
+        self._inflight.append((r, out))
+        self._next_round += 1
+        return out
+
+    def _harvest_oldest(self) -> None:
+        r, out = self._inflight.popleft()
+        jax.block_until_ready(out)
+        self.completed_rounds.append(r)
+
+    def drain(self) -> None:
+        """Block until every in-flight round has completed."""
+        while self._inflight:
+            self._harvest_oldest()
+
+
+class RoundClock:
+    """Deadline bookkeeping → contribution masks.
+
+    Peers report arrival times per round (over DCN in a real deployment; the
+    tests script them). ``valid_mask(round)`` returns, for each peer, whether
+    its contribution landed inside the round's deadline — feeding the masks
+    whose psum'd values are the reference's contribution counts. A peer with
+    no report at all is a cold straggler: masked until it reports again,
+    mirroring deathwatch + threshold tolerance
+    (reference: AllreduceMaster.scala:46-52; SURVEY.md §5.3).
+    """
+
+    def __init__(self, num_peers: int, deadline_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.num_peers = num_peers
+        self.deadline_s = deadline_s
+        self.clock = clock
+        self._round_open: dict[int, float] = {}
+        self._arrivals: dict[int, dict[int, float]] = {}
+
+    def open_round(self, round_: int) -> None:
+        self._round_open[round_] = self.clock()
+        self._arrivals.setdefault(round_, {})
+
+    def report_arrival(self, round_: int, peer: int,
+                       at: Optional[float] = None) -> None:
+        self._arrivals.setdefault(round_, {})[peer] = \
+            self.clock() if at is None else at
+
+    def valid_peers(self, round_: int) -> list[bool]:
+        """True per peer iff its round contribution arrived in time."""
+        opened = self._round_open.get(round_)
+        arrivals = self._arrivals.get(round_, {})
+        out = []
+        for p in range(self.num_peers):
+            t = arrivals.get(p)
+            out.append(t is not None and opened is not None
+                       and (t - opened) <= self.deadline_s)
+        return out
+
+    def expire(self, up_to_round: int) -> None:
+        """Forget state for rounds below ``up_to_round`` (the ring
+        rotation)."""
+        for r in [r for r in self._round_open if r < up_to_round]:
+            del self._round_open[r]
+            self._arrivals.pop(r, None)

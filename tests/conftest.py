@@ -6,13 +6,21 @@ peers under TestKit; SURVEY.md §4): here, multi-"chip" collective code runs on
 8 virtual CPU devices via XLA's host-platform device-count override, so mesh /
 shard_map / collective paths are exercised without TPUs. Benchmarks and the
 driver's dryrun use real hardware separately.
+
+Note: this environment's site customization force-registers the TPU backend
+and overrides ``jax_platforms`` at interpreter start, so setting the
+JAX_PLATFORMS env var is not enough — the jax config itself must be updated
+before any backend initializes.
 """
 
 import os
 
-# Must be set before jax (or anything importing jax) is imported.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be in the env before the CPU backend initializes (lazily, at first use).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

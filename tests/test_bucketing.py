@@ -1,0 +1,73 @@
+"""Bucketing layer unit tests — the pure-function chunking math, tested
+independently exactly as the reference unit-tests its buffer math first
+(SURVEY.md §4, §7 build order step 2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.ops.bucketing import (
+    bucketize,
+    debucketize,
+    tree_to_vector,
+    vector_to_tree,
+    _spec_for,
+)
+
+
+def ragged_tree():
+    return {
+        "w1": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+        "b1": jnp.arange(3, dtype=jnp.float32),
+        "nested": {"w2": jnp.ones((5,), dtype=jnp.bfloat16)},
+    }
+
+
+class TestRoundTrip:
+    def test_bucketize_round_trips_ragged_tree(self):
+        tree = ragged_tree()
+        buckets, spec = bucketize(tree, bucket_elems=4)
+        assert buckets.shape == (4, 4)  # 14 elems -> 4 buckets of 4
+        assert spec.total_size == 14
+        assert spec.pad == 2
+        back = debucketize(buckets, spec)
+        for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+            assert a.dtype == b.dtype
+            np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
+                                       np.asarray(b, dtype=np.float32))
+
+    def test_padding_is_zero(self):
+        tree = {"x": jnp.ones((5,), dtype=jnp.float32)}
+        buckets, spec = bucketize(tree, bucket_elems=4)
+        assert buckets.shape == (2, 4)
+        np.testing.assert_array_equal(np.asarray(buckets)[1, 1:], 0.0)
+
+    def test_exact_fit_no_padding(self):
+        tree = {"x": jnp.ones((8,), dtype=jnp.float32)}
+        buckets, spec = bucketize(tree, bucket_elems=4)
+        assert buckets.shape == (2, 4)
+        assert spec.pad == 0
+
+    def test_empty_tree(self):
+        buckets, spec = bucketize({}, bucket_elems=4)
+        assert buckets.shape == (1, 4)
+        assert spec.total_size == 0
+        assert debucketize(buckets, spec) == {}
+
+    def test_vector_round_trip_preserves_structure(self):
+        tree = ragged_tree()
+        vec = tree_to_vector(tree)
+        assert vec.shape == (14,)
+        spec = _spec_for(tree, bucket_elems=14)
+        back = vector_to_tree(vec, spec)
+        assert jax.tree.structure(back) == jax.tree.structure(tree)
+
+    def test_bucketize_is_jittable(self):
+        tree = ragged_tree()
+        _, spec = bucketize(tree, bucket_elems=4)
+        jitted = jax.jit(lambda t: bucketize(t, 4)[0])
+        buckets = jitted(tree)
+        np.testing.assert_allclose(
+            np.asarray(debucketize(buckets, spec)["w1"]),
+            np.asarray(tree["w1"]))

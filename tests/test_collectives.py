@@ -1,0 +1,182 @@
+"""Device-plane collective tests on the 8-virtual-device CPU mesh.
+
+The JAX equivalent of the reference's forged-peer protocol tests (SURVEY.md
+§4 testing lesson): real collective code, simulated devices, scripted
+straggler masks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from functools import partial
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.ops import (
+    bucketize,
+    debucketize,
+    exact_allreduce,
+    expand_bucket_counts,
+    masked_allreduce,
+    rescale_by_count,
+    two_phase_allreduce,
+)
+from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh, \
+    single_axis_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return single_axis_mesh("dp")
+
+
+N = 8
+
+
+class TestExactAllreduce:
+    """The thresholds=1.0 path: output == sum over all ranks — the
+    reference's core invariant (AllreduceWorker.scala:337-339)."""
+
+    def test_psum_path_sums_all_ranks(self, mesh):
+        # rank i contributes [i, i, ...]: sum = 0+..+7 = 28 everywhere
+        stacked = jnp.tile(
+            jnp.arange(N, dtype=jnp.float32)[:, None], (1, 16))
+        out = exact_allreduce(stacked, mesh)
+        np.testing.assert_array_equal(np.asarray(out), 28.0)
+
+    def test_two_phase_path_matches_psum(self, mesh):
+        rng = np.random.default_rng(0)
+        stacked = jnp.asarray(rng.normal(size=(N, 64)).astype(np.float32))
+        fused = exact_allreduce(stacked, mesh, two_phase=False)
+        phased = exact_allreduce(stacked, mesh, two_phase=True)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(phased),
+                                   rtol=1e-5)
+
+    def test_two_phase_rejects_indivisible_buckets(self, mesh):
+        stacked = jnp.ones((N, 10), dtype=jnp.float32)
+        with pytest.raises(ValueError, match="not divisible"):
+            exact_allreduce(stacked, mesh, two_phase=True)
+
+    def test_readme_demo_config_on_two_ranks(self):
+        """README CPU baseline: 2 workers, dataSize=10
+        (BASELINE.md config #1)."""
+        mesh2 = single_axis_mesh("dp", devices=jax.devices()[:2])
+        stacked = jnp.stack([jnp.arange(10, dtype=jnp.float32)] * 2)
+        out = exact_allreduce(stacked, mesh2)
+        np.testing.assert_array_equal(
+            np.asarray(out)[0], np.arange(10, dtype=np.float32) * 2)
+
+
+class TestMaskedAllreduce:
+    """The lossy path: thresholds < 1 as masks; counts piggybacked
+    (reference semantics §3a.3, §3a.9 re-expressed as data)."""
+
+    def test_straggler_masked_out_with_honest_counts(self, mesh):
+        num_buckets, elems = 4, 8
+        # every rank contributes ones; rank 7 is a straggler for buckets 2,3
+        buckets = jnp.ones((N, num_buckets, elems), dtype=jnp.float32)
+        valid = jnp.ones((N, num_buckets), dtype=jnp.int32)
+        valid = valid.at[7, 2:].set(0)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")))
+        def run(b, v):
+            s, c = masked_allreduce(b[0], v[0], "dp")
+            return s[None], c[None]
+
+        summed, counts = run(buckets, valid)
+        summed, counts = np.asarray(summed)[0], np.asarray(counts)[0]
+        np.testing.assert_array_equal(counts, [8, 8, 7, 7])
+        np.testing.assert_array_equal(summed[0], 8.0)
+        np.testing.assert_array_equal(summed[2], 7.0)
+
+    def test_masked_values_do_not_leak(self, mesh):
+        """A masked rank's (possibly garbage) values must not contaminate
+        the sum — the analog of dropped late chunks being absorbed, never
+        re-broadcast (reference: ScatteredDataBuffer.scala:11-13)."""
+        buckets = jnp.ones((N, 1, 4), dtype=jnp.float32)
+        buckets = buckets.at[3].set(1e9)  # garbage from the straggler
+        valid = jnp.ones((N, 1), dtype=jnp.int32).at[3].set(0)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")))
+        def run(b, v):
+            s, c = masked_allreduce(b[0], v[0], "dp")
+            return s[None], c[None]
+
+        summed, counts = run(buckets, valid)
+        np.testing.assert_array_equal(np.asarray(summed)[0][0], 7.0)
+        np.testing.assert_array_equal(np.asarray(counts)[0], [7])
+
+    def test_count_expansion_and_rescale(self):
+        """Chunk→element count expansion (reference:
+        ReducedDataBuffer.scala:46) and divide-by-count compensation."""
+        tree = {"w": jnp.ones((10,), dtype=jnp.float32)}
+        buckets, spec = bucketize(tree, bucket_elems=4)
+        counts = jnp.array([8, 7, 0], dtype=jnp.int32)
+        per_elem = expand_bucket_counts(counts, spec)
+        np.testing.assert_array_equal(
+            np.asarray(per_elem), [8] * 4 + [7] * 4 + [0] * 2)
+
+        summed = jnp.concatenate(
+            [jnp.full(4, 8.0), jnp.full(4, 7.0), jnp.zeros(2)])
+        rescaled = rescale_by_count(summed, per_elem, target=1.0)
+        np.testing.assert_allclose(
+            np.asarray(rescaled), [1] * 8 + [0] * 2)
+
+
+class TestEndToEndBucketedAllreduce:
+    """Full pipeline: pytree → buckets → masked collective → counts →
+    rebuild. The device-plane equivalent of one whole protocol round."""
+
+    def test_gradient_pytree_allreduce_with_straggler(self, mesh):
+        rng = np.random.default_rng(1)
+        grads = {
+            "dense": jnp.asarray(rng.normal(size=(6, 5)).astype(np.float32)),
+            "bias": jnp.asarray(rng.normal(size=(7,)).astype(np.float32)),
+        }
+        buckets, spec = bucketize(grads, bucket_elems=8)
+        nb = spec.num_buckets
+        stacked = jnp.tile(buckets[None], (N, 1, 1))
+        valid = jnp.ones((N, nb), dtype=jnp.int32).at[5, 0].set(0)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")))
+        def run(b, v):
+            s, c = masked_allreduce(b[0], v[0], "dp")
+            return s[None], c[None]
+
+        summed, counts = run(stacked, valid)
+        summed, counts = summed[0], counts[0]
+        per_elem = expand_bucket_counts(counts, spec)
+        mean_vec = rescale_by_count(
+            summed.reshape(-1)[:spec.total_size], per_elem)
+        # every element equals its original value (all ranks sent the same
+        # grads; the straggler only lowered the count, and rescale fixed it)
+        # jax.tree flattens dicts in sorted-key order: bias before dense
+        flat = np.concatenate([np.asarray(grads["bias"]).ravel(),
+                               np.asarray(grads["dense"]).ravel()])
+        np.testing.assert_allclose(np.asarray(mean_vec), flat, rtol=1e-5)
+        # counts are honest: bucket 0 saw 7 contributors
+        assert int(counts[0]) == 7
+        assert (np.asarray(counts[1:]) == 8).all()
+
+
+class TestMultiAxisMesh:
+    def test_dp_allreduce_within_2d_mesh(self):
+        """DP sum must stay within dp groups when a tp axis coexists."""
+        mesh = make_device_mesh(MeshSpec(dp=4, tp=2))
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P(("dp", "tp")),
+                 out_specs=P(("dp", "tp")))
+        def run(x):
+            return jax.lax.psum(x[0], "dp")[None]
+
+        # rank value = dp_index * 10 + tp_index
+        vals = jnp.array(
+            [d * 10.0 + t for d in range(4) for t in range(2)],
+            dtype=jnp.float32).reshape(8, 1)
+        out = np.asarray(run(vals)).reshape(4, 2)
+        # each tp column sums over dp: sum(d*10) + 4*t = 60 + 4t
+        np.testing.assert_array_equal(out[:, 0], 60.0)
+        np.testing.assert_array_equal(out[:, 1], 64.0)

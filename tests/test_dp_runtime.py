@@ -1,0 +1,152 @@
+"""DP gradient-sync API + runtime pacer tests on the 8-device CPU mesh."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.parallel.dp import GradSyncConfig, allreduce_gradients
+from akka_allreduce_tpu.parallel.mesh import single_axis_mesh
+from akka_allreduce_tpu.runtime.pacer import RoundClock, RoundPacer
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return single_axis_mesh("dp")
+
+
+def per_rank_grads(rank_val):
+    """A ragged gradient pytree whose every element equals rank_val."""
+    return {
+        "w": jnp.full((3, 5), rank_val, dtype=jnp.float32),
+        "b": jnp.full((7,), rank_val, dtype=jnp.float32),
+    }
+
+
+class TestAllreduceGradients:
+    def test_mean_over_ranks(self, mesh):
+        cfg = GradSyncConfig(bucket_elems=8, average=True)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")))
+        def step(ranks):
+            g = per_rank_grads(ranks[0, 0])
+            res = allreduce_gradients(g, cfg)
+            return (res.grads["w"][None], res.counts["w"][None])
+
+        ranks = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+        w, counts = step(ranks)
+        # mean of 0..7 = 3.5 everywhere; counts = 8
+        np.testing.assert_allclose(np.asarray(w)[0], 3.5)
+        np.testing.assert_array_equal(np.asarray(counts)[0], 8)
+
+    def test_sum_mode_matches_reference_sink_contract(self, mesh):
+        """average=False returns the raw sum — what the reference's sink
+        receives (output == N x input for identical inputs)."""
+        cfg = GradSyncConfig(bucket_elems=8, average=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")))
+        def step(ranks):
+            g = per_rank_grads(1.0 + 0 * ranks[0, 0])
+            res = allreduce_gradients(g, cfg)
+            return (res.grads["b"][None], res.counts["b"][None])
+
+        b, counts = step(jnp.zeros((N, 1), dtype=jnp.float32))
+        np.testing.assert_array_equal(np.asarray(b)[0], float(N))
+        np.testing.assert_array_equal(np.asarray(counts)[0], N)
+
+    def test_straggler_mask_keeps_mean_honest(self, mesh):
+        """A rank masked out of one bucket lowers its count, not the mean:
+        the divide-by-count compensation (reference sink contract)."""
+        cfg = GradSyncConfig(bucket_elems=8, average=True)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp"), P("dp")))
+        def step(masks):
+            g = per_rank_grads(2.0)
+            res = allreduce_gradients(g, cfg, valid=masks[0])
+            return (res.grads["w"][None], res.counts["w"][None],
+                    res.bucket_counts[None])
+
+        # 22 elems / 8 -> 3 buckets; rank 4 misses bucket 1
+        masks = jnp.ones((N, 3), dtype=jnp.int32).at[4, 1].set(0)
+        w, counts, bucket_counts = step(masks)
+        np.testing.assert_allclose(np.asarray(w)[0], 2.0)  # mean unaffected
+        np.testing.assert_array_equal(np.asarray(bucket_counts)[0],
+                                      [8, 7, 8])
+        # per-element counts: 'b' occupies the sorted-first 7 elements,
+        # then 'w' fills 15 of buckets 1-2
+        c = np.asarray(counts)[0].ravel()
+        assert set(c.tolist()) <= {7, 8}
+        assert (c == 7).sum() == 8  # bucket 1 spans flat elems 8..15, all in w
+
+    def test_counts_dtype_and_structure_match_grads(self, mesh):
+        cfg = GradSyncConfig(bucket_elems=8)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"))
+        def step(x):
+            res = allreduce_gradients(per_rank_grads(x[0, 0]), cfg)
+            assert jax.tree.structure(res.counts) == \
+                jax.tree.structure(res.grads)
+            assert res.counts["w"].dtype == jnp.int32
+            assert res.counts["w"].shape == (3, 5)
+            return res.grads["w"][None]
+
+        step(jnp.ones((N, 1), dtype=jnp.float32))
+
+
+class TestRoundPacer:
+    def test_window_bounds_inflight_rounds(self):
+        pacer = RoundPacer(max_lag=2)
+        seen = []
+
+        def step(r):
+            seen.append(r)
+            return jnp.zeros((4,))
+
+        for _ in range(10):
+            pacer.submit(step)
+        # no more than max_lag+1 rounds may be unharvested
+        assert pacer.round - len(pacer.completed_rounds) <= 3
+        pacer.drain()
+        assert pacer.completed_rounds == list(range(10))
+        assert seen == list(range(10))
+
+    def test_zero_lag_is_fully_synchronous(self):
+        pacer = RoundPacer(max_lag=0)
+        for _ in range(3):
+            pacer.submit(lambda r: jnp.ones(()))
+        assert len(pacer.completed_rounds) >= 2
+        pacer.drain()
+        assert pacer.completed_rounds == [0, 1, 2]
+
+
+class TestRoundClock:
+    def test_deadline_masks(self):
+        t = {"now": 100.0}
+        clock = RoundClock(num_peers=4, deadline_s=1.0,
+                           clock=lambda: t["now"])
+        clock.open_round(0)
+        clock.report_arrival(0, 0)          # t=100, in time
+        t["now"] = 100.5
+        clock.report_arrival(0, 1)          # in time
+        t["now"] = 102.0
+        clock.report_arrival(0, 2)          # late
+        # peer 3 never reports
+        assert clock.valid_peers(0) == [True, True, False, False]
+
+    def test_expire_rotates_window(self):
+        clock = RoundClock(num_peers=2, deadline_s=1.0, clock=lambda: 0.0)
+        clock.open_round(0)
+        clock.open_round(1)
+        clock.report_arrival(0, 0)
+        clock.expire(1)
+        assert clock.valid_peers(0) == [False, False]  # forgotten
+        assert clock.valid_peers(1) == [False, False]  # no arrivals yet
