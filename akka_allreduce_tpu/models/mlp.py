@@ -1,0 +1,39 @@
+"""Minimal MLP: the simplest real gradient producer for the DP path.
+
+Plays the role of the reference's synthetic float-vector workload
+(reference: AllreduceWorker.scala:325-343) but with actual backprop, so the
+gradient-sync API is exercised by a genuine pytree of ragged parameter
+shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key: jax.Array, sizes: Sequence[int]) -> dict:
+    """He-initialised dense stack: sizes = [in, hidden..., out]."""
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (k, d_in, d_out) in enumerate(zip(keys, sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = jax.random.normal(k, (d_in, d_out)) \
+            * jnp.sqrt(2.0 / d_in)
+        params[f"b{i}"] = jnp.zeros((d_out,))
+    return params
+
+
+def mlp_apply(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    n_layers = len(params) // 2
+    for i in range(n_layers):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = jax.nn.gelu(x)
+    return x
+
+
+def mlp_loss(params: dict, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    pred = mlp_apply(params, x)
+    return jnp.mean((pred - y) ** 2)

@@ -1,0 +1,187 @@
+"""The full training step: dp x tp x sp composed over one device mesh.
+
+This is the end-to-end slice SURVEY.md §7 builds toward (step 7): a real
+model consuming the framework's gradient-sync API. The loss/backprop/sync
+core runs rank-local under one ``shard_map``; the (elementwise) optimizer
+update runs on the global arrays in the same jit, where XLA propagates the
+existing parameter shardings. One traced program, fully fused:
+
+* **dp** — batch sharded; gradients synced through
+  :func:`akka_allreduce_tpu.parallel.dp.allreduce_gradients` (bucketed,
+  masked, counted — the reference's whole protocol as one collective).
+* **tp** — attention heads and FF width sharded (parallel/tp.py); one psum
+  per projection pair, inserted explicitly in the forward pass.
+* **sp** — sequence sharded; ring attention (parallel/ring_attention.py)
+  rotates K/V blocks around the ring; next-token targets cross shard
+  boundaries via a single ppermute.
+
+Loss scaling is exact: every rank minimises ``local_sum / global_token
+_count``, so the psum of rank gradients IS the gradient of the global mean
+loss. Gradient sync runs over the combined ('dp', 'sp') axes with rescale
+target = rank count: with no stragglers the result equals the exact psum;
+with masked contributions it is the natural unbiased scale-up, counts
+reported honestly (metrics carry the minimum bucket count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+    next_token_loss,
+)
+from akka_allreduce_tpu.parallel.dp import GradSyncConfig, allreduce_gradients
+from akka_allreduce_tpu.parallel.ring_attention import ring_attention, \
+    local_causal_attention
+from akka_allreduce_tpu.utils.vma import psum_all
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    model: TransformerConfig
+    learning_rate: float = 1e-3
+    bucket_elems: int = 1 << 16
+    grad_axes: tuple[str, ...] = ("dp", "sp")
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpec per parameter leaf: QKV/FF1 column-sharded over tp,
+    WO/FF2 row-sharded, the rest replicated (Megatron layout)."""
+    layer = {
+        "ln1": P(), "ln2": P(),
+        "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "w1": P(None, "tp"), "w2": P("tp", None),
+    }
+    return {
+        "embed": P(), "pos": P(), "out_norm": P(), "lm_head": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def shard_params(params: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a host-initialised full parameter tree onto the mesh with the
+    given per-leaf specs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh
+                     ) -> tuple[Any, Any, optax.GradientTransformation]:
+    """Init (sharded params, congruently-sharded opt state, optimizer)."""
+    tp = mesh.shape.get("tp", 1)
+    full = init_transformer(key, cfg.model, tp=tp)
+    params = shard_params(full, param_specs(cfg.model), mesh)
+    opt = optax.adamw(cfg.learning_rate)
+    # jit so the moment buffers inherit the parameters' shardings
+    opt_state = jax.jit(opt.init)(params)
+    return params, opt_state, opt
+
+
+def make_grad_step(cfg: TrainConfig, mesh: Mesh,
+                   valid_buckets: Optional[jnp.ndarray] = None):
+    """The rank-local core under shard_map: loss, backprop, bucketed
+    gradient sync. Returns ``grad_step(params, tokens) -> (synced_grads,
+    metrics)``; tokens (B_global, T_global) int32 sharded (dp, sp)."""
+    mcfg = cfg.model
+    specs = param_specs(mcfg)
+    has_sp = mesh.shape.get("sp", 1) > 1
+    has_tp = mesh.shape.get("tp", 1) > 1
+    tp_axis = "tp" if has_tp else None
+    n_grad_ranks = math.prod(mesh.shape.get(a, 1) for a in cfg.grad_axes)
+    gcfg = GradSyncConfig(bucket_elems=cfg.bucket_elems,
+                          axis_name=cfg.grad_axes, average=True,
+                          rescale_target=float(n_grad_ranks))
+
+    def targets_and_weights(tokens):
+        """Per-token next-token targets and loss weights; under sp the
+        boundary target comes from the right neighbor and the global final
+        position gets weight 0."""
+        t_local = tokens.shape[1]
+        if not has_sp:
+            targets = jnp.concatenate(
+                [tokens[:, 1:], tokens[:, :1]], axis=1)  # last col weight 0
+            weights = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+            positions = jnp.arange(t_local)
+            return targets, weights, positions
+        n_sp = lax.axis_size("sp")
+        sp_idx = lax.axis_index("sp")
+        positions = sp_idx * t_local + jnp.arange(t_local)
+        perm = [(j, (j - 1) % n_sp) for j in range(n_sp)]
+        next_first = lax.ppermute(tokens[:, :1], "sp", perm)
+        targets = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
+        weights = jnp.ones(tokens.shape, jnp.float32)
+        is_last = (sp_idx == n_sp - 1).astype(jnp.float32)
+        weights = weights.at[:, -1].set(1.0 - is_last)
+        return targets, weights, positions
+
+    attn = partial(ring_attention, axis_name="sp", causal=True) if has_sp \
+        else local_causal_attention
+
+    def grad_local(params, tokens):
+        targets, weights, positions = targets_and_weights(tokens)
+        total_count = psum_all(weights.sum(), cfg.grad_axes)
+
+        def loss_fn(p):
+            loss_sum, _ = next_token_loss(
+                p, tokens, mcfg, positions, attn, tp_axis,
+                targets=targets, weights=weights)
+            # exact global-mean scaling: psum of these local losses (and of
+            # their grads) is the global mean loss (and its gradient)
+            return loss_sum / total_count
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # Gradient sync over (dp, sp): the framework's bucketed, counted
+        # collective — THE allreduce the reference exists for. Gradients
+        # for tp shards need no sync (tp_grad_boundary completed them in
+        # the backward pass); the data axes are ours alone to reduce —
+        # which is the point: sync policy (masks, counts, lossy rounds)
+        # stays in framework hands, not autodiff's.
+        res = allreduce_gradients(grads, gcfg, valid=valid_buckets)
+        metrics = {
+            "loss": psum_all(loss, cfg.grad_axes),
+            "tokens": total_count,
+            "min_bucket_count": res.bucket_counts.min(),
+        }
+        return res.grads, metrics
+
+    # check_vma=False: varying-axis tracking would auto-insert psums over
+    # the data axes in the backward pass (pvary transpose), taking gradient
+    # sync out of the framework's hands — the explicit Megatron boundary
+    # (parallel/tp.py) plus allreduce_gradients carry it instead.
+    return jax.shard_map(
+        grad_local, mesh=mesh,
+        in_specs=(specs, P("dp", "sp")),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+
+
+def make_train_step(cfg: TrainConfig, mesh: Mesh,
+                    opt: optax.GradientTransformation,
+                    valid_buckets: Optional[jnp.ndarray] = None):
+    """Full jitted step: grads+sync under shard_map, elementwise optimizer
+    on the global (sharded) arrays — XLA keeps the Megatron layout."""
+    grad_step = make_grad_step(cfg, mesh, valid_buckets)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        grads, metrics = grad_step(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    return step

@@ -1,0 +1,174 @@
+"""Flagship model: a causal transformer LM, parallelism-aware by design.
+
+Pure-pytree parameters and a functional ``apply`` keep the model a single
+traced computation XLA can fuse end-to-end (bf16-friendly matmuls on the
+MXU, static shapes throughout). Parallelism is injected, not hard-coded:
+
+* ``attn_fn`` — plain local causal attention on one chip, or ring attention
+  over the ``sp`` axis (parallel/ring_attention.py) for sequence sharding.
+* ``tp_axis`` — when set, QKV/FF1 are column-parallel shards and the output
+  projections row-parallel with one psum each (parallel/tp.py); head count
+  and FF width passed in params are the *local* shards.
+
+The same ``apply`` therefore serves the single-chip graft entry, the
+dp-only data-parallel trainer, and the full dp x tp x sp training step
+(models/train.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from akka_allreduce_tpu.parallel.ring_attention import local_causal_attention
+from akka_allreduce_tpu.parallel.tp import column_parallel_dense, \
+    row_parallel_dense, tp_grad_boundary
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 128
+    dtype: object = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def init_transformer(key: jax.Array, cfg: TransformerConfig,
+                     tp: int = 1) -> dict:
+    """Full (unsharded) parameters when tp=1; per-rank TP shards when the
+    caller slices (models/train.py shards via the mesh instead — this
+    function always builds the full tree; tp only validates divisibility)."""
+    if cfg.n_heads % tp or cfg.d_ff % tp:
+        raise ValueError(
+            f"tp={tp} must divide both n_heads={cfg.n_heads} and "
+            f"d_ff={cfg.d_ff}")
+    k = iter(jax.random.split(key, 4 + 8 * cfg.n_layers))
+    dt = cfg.dtype
+    scale = cfg.d_model ** -0.5
+    params = {
+        "embed": jax.random.normal(next(k), (cfg.vocab_size, cfg.d_model),
+                                   dt) * scale,
+        "pos": jax.random.normal(next(k), (cfg.max_seq, cfg.d_model),
+                                 dt) * scale,
+        "out_norm": jnp.ones((cfg.d_model,), dt),
+        "lm_head": jax.random.normal(next(k), (cfg.d_model, cfg.vocab_size),
+                                     dt) * scale,
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        layer = {
+            "ln1": jnp.ones((cfg.d_model,), dt),
+            "wq": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
+                                    dt) * scale,
+            "wk": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
+                                    dt) * scale,
+            "wv": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
+                                    dt) * scale,
+            "wo": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
+                                    dt) * scale,
+            "ln2": jnp.ones((cfg.d_model,), dt),
+            "w1": jax.random.normal(next(k), (cfg.d_model, cfg.d_ff),
+                                    dt) * scale,
+            "w2": jax.random.normal(next(k), (cfg.d_ff, cfg.d_model),
+                                    dt) * scale,
+        }
+        params["layers"].append(layer)
+    return params
+
+
+AttnFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def transformer_apply(params: dict, tokens: jnp.ndarray,
+                      cfg: TransformerConfig,
+                      positions: Optional[jnp.ndarray] = None,
+                      attn_fn: AttnFn = local_causal_attention,
+                      tp_axis: Optional[str] = None) -> jnp.ndarray:
+    """tokens: (B, T_local) int32 → logits (B, T_local, vocab).
+
+    ``positions``: global sequence positions of this rank's tokens (needed
+    under sequence sharding; defaults to 0..T-1). When ``tp_axis`` is set,
+    the per-layer weight shards passed in params are already the local tp
+    slices and head count is the local count.
+    """
+    b, t = tokens.shape
+    if positions is None:
+        positions = jnp.arange(t)
+    x = params["embed"][tokens] + params["pos"][positions]
+
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["ln1"])
+        if tp_axis is not None:
+            # identity fwd / psum('tp') bwd: completes dL/dh across the
+            # column-parallel shards (parallel/tp.py)
+            h = tp_grad_boundary(h, tp_axis)
+        q = column_parallel_dense(h, layer["wq"])
+        k_ = column_parallel_dense(h, layer["wk"])
+        v = column_parallel_dense(h, layer["wv"])
+        n_heads_local = q.shape[-1] // cfg.head_dim
+        q = q.reshape(b, t, n_heads_local, cfg.head_dim)
+        k_ = k_.reshape(b, t, n_heads_local, cfg.head_dim)
+        v = v.reshape(b, t, n_heads_local, cfg.head_dim)
+        attn = attn_fn(q, k_, v).reshape(b, t, -1)
+        if tp_axis is not None:
+            x = x + row_parallel_dense(attn, layer["wo"], tp_axis)
+        else:
+            x = x + attn @ layer["wo"]
+
+        h = _rmsnorm(x, layer["ln2"])
+        if tp_axis is not None:
+            h = tp_grad_boundary(h, tp_axis)
+        h = jax.nn.gelu(column_parallel_dense(h, layer["w1"]))
+        if tp_axis is not None:
+            x = x + row_parallel_dense(h, layer["w2"], tp_axis)
+        else:
+            x = x + h @ layer["w2"]
+
+    x = _rmsnorm(x, params["out_norm"])
+    return x @ params["lm_head"]
+
+
+def next_token_loss(params: dict, tokens: jnp.ndarray,
+                    cfg: TransformerConfig,
+                    positions: Optional[jnp.ndarray] = None,
+                    attn_fn: AttnFn = local_causal_attention,
+                    tp_axis: Optional[str] = None,
+                    targets: Optional[jnp.ndarray] = None,
+                    weights: Optional[jnp.ndarray] = None
+                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted summed next-token cross-entropy and total weight (sums, not
+    means, so multi-rank losses combine exactly via psum).
+
+    Without ``targets``, the shift happens locally (the last token has no
+    target and is dropped). With ``targets`` — sequence sharding, where the
+    boundary target is the NEXT rank's first token — every position has a
+    target and ``weights`` masks the positions that shouldn't count (the
+    global final token).
+    """
+    logits = transformer_apply(params, tokens, cfg, positions, attn_fn,
+                               tp_axis)
+    if targets is None:
+        logits = logits[:, :-1]
+        tgt = tokens[:, 1:]
+    else:
+        tgt = targets
+    if weights is None:
+        weights = jnp.ones(tgt.shape, jnp.float32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return -(ll * weights).sum(), weights.sum()

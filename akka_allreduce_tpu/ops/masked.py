@@ -21,9 +21,9 @@ stale rounds.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
 
 from akka_allreduce_tpu.ops.bucketing import BucketSpec
+from akka_allreduce_tpu.utils.vma import psum_all
 
 
 def masked_allreduce(buckets: jnp.ndarray, valid: jnp.ndarray,
@@ -40,7 +40,7 @@ def masked_allreduce(buckets: jnp.ndarray, valid: jnp.ndarray,
     """
     v = valid.astype(buckets.dtype)
     contrib = buckets * v[:, None]
-    summed, counts = lax.psum(
+    summed, counts = psum_all(
         (contrib, valid.astype(jnp.int32)), axis_name)
     return summed, counts
 

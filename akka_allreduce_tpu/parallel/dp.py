@@ -41,8 +41,12 @@ class GradSyncConfig:
     exactly what the reference's sink receives."""
 
     bucket_elems: int = 1 << 18  # 256k float32 = 1 MiB buckets
-    axis_name: str = "dp"
+    axis_name: "str | tuple[str, ...]" = "dp"
     average: bool = True
+    # When averaging, scale the per-contributor mean by this target (e.g.
+    # the rank count, so a no-straggler round equals the exact psum and a
+    # lossy round is the unbiased scale-up).
+    rescale_target: float = 1.0
 
 
 @dataclasses.dataclass
@@ -75,7 +79,7 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     vec = summed.reshape(-1)[:spec.total_size]
     per_elem = expand_bucket_counts(bucket_counts, spec)
     if config.average:
-        vec = rescale_by_count(vec, per_elem, target=1.0)
+        vec = rescale_by_count(vec, per_elem, target=config.rescale_target)
     out_tree = vector_to_tree(vec, spec)
 
     counts_spec = dataclasses.replace(

@@ -1,0 +1,127 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has no attention, but its chunked block rotation with
+rank-staggered scheduling and a bounded ring is exactly the index machinery
+ring attention needs (SURVEY.md §5.7: block ownership
+AllreduceWorker.scala:240-250, rotation :214/:255, ring
+AllReduceBuffer.scala:34-42). Here that machinery becomes a first-class
+sequence-parallel primitive: each rank owns a contiguous sequence block of
+K/V; blocks rotate around the ``sp`` ring via ``ppermute`` while every rank
+accumulates blockwise attention for its local queries with online (flash)
+softmax — O(T/n) memory per chip, full-sequence attention semantics.
+
+Implemented as ``lax.scan`` over ring steps so reverse-mode autodiff works
+out of the box (``ppermute`` is differentiable; scan keeps the program
+compiler-friendly — no Python loops over data-dependent state inside jit).
+Rank-local: call inside ``shard_map`` with the sequence axis sharded over
+``axis_name``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from akka_allreduce_tpu.utils.vma import cast_varying
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, m, l, acc, q_offset, k_offset, causal):
+    """One blockwise attention accumulation step with online softmax.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D); m, l: (B, H, Tq);
+    acc: (B, Tq, H, D). Offsets are the blocks' global sequence positions,
+    used for causal masking across ranks.
+    """
+    scale = q.shape[-1] ** -0.5
+    # scores: (B, H, Tq, Tk)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # correction of previously accumulated stats (guard the -inf init so
+    # exp(-inf - -inf) can't NaN)
+    correction = jnp.exp(jnp.minimum(m, m_new) - m_new)
+    p = jnp.exp(scores - m_new[..., None])  # (B, H, Tq, Tk)
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str = "sp", causal: bool = True) -> jnp.ndarray:
+    """Full-sequence attention over sequence-sharded q/k/v (rank-local).
+
+    Shapes (per rank): q, k, v: (B, T_local, H, D); returns (B, T_local, H,
+    D). Global sequence length is ``T_local * axis_size``; rank i owns
+    positions ``[i*T_local, (i+1)*T_local)`` — the reference's contiguous
+    block-ownership rule applied to the sequence dimension.
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    q_offset = my_idx * t_local
+
+    # constant-initialised carries must be typed as varying over the ring
+    # axis or scan rejects the carry (the step outputs depend on
+    # ring-position data)
+    m0 = cast_varying(jnp.full((b, h, t_local), NEG_INF, dtype=q.dtype),
+                      (axis_name,))
+    l0 = cast_varying(jnp.zeros((b, h, t_local), dtype=q.dtype),
+                      (axis_name,))
+    acc0 = jnp.zeros_like(q)  # already varying: derived from q
+
+    # Ring schedule: at step s every rank holds the K/V block originally
+    # owned by rank (my_idx - s) % n, then passes it to the right neighbor —
+    # the rank-staggered rotation of the reference's scatter loop.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        m, l, acc, k_blk, v_blk = carry
+        src = (my_idx - s) % n
+        k_offset = src * t_local
+        if causal:
+            # Skip blocks entirely in the queries' future (src > my rank):
+            # every score would be masked, so both einsums would produce
+            # guaranteed zeros — ~half the ring steps on average.
+            m, l, acc = lax.cond(
+                src <= my_idx,
+                lambda mla: _block_attention(q, k_blk, v_blk, *mla,
+                                             q_offset, k_offset, True),
+                lambda mla: mla,
+                (m, l, acc))
+        else:
+            m, l, acc = _block_attention(q, k_blk, v_blk, m, l, acc,
+                                         q_offset, k_offset, False)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, acc, k_blk, v_blk), None
+
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(n))
+
+    # normalise; causal rows always include the query's own position so
+    # l > 0 everywhere
+    return acc / l.transpose(0, 2, 1)[..., None]
+
+
+def local_causal_attention(q: jnp.ndarray, k: jnp.ndarray,
+                           v: jnp.ndarray) -> jnp.ndarray:
+    """Single-rank reference attention (no sequence sharding): the oracle
+    ring_attention must match."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    t = q.shape[1]
+    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,95 @@
+"""Tensor parallelism: Megatron-style sharded dense layers.
+
+Out of the reference's scope (SURVEY.md §2: TP honestly absent there) but
+required of a TPU-scale framework. The pattern: a column-parallel projection
+shards its output features over the ``tp`` axis (no communication forward; the
+backward all-reduce of activations is inserted by autodiff through ``psum``),
+and the following row-parallel projection shards its input features and
+``psum``s its partial outputs. One psum per pair per direction — the minimal
+collective schedule, riding ICI along the tp mesh axis.
+
+Rank-local helpers for use inside ``shard_map``; parameters are passed as
+per-rank shards (the train step's sharding rules slice them).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_grad_boundary(x: jnp.ndarray, axis_name: str = "tp") -> jnp.ndarray:
+    """Megatron's "g" operator: identity forward, all-reduce backward.
+
+    Place on activations entering a column-parallel region. Each tp rank's
+    backward pass produces only its shard's contribution to dL/dx; the
+    psum here completes it, so gradients of everything upstream (embeddings,
+    norms) are computed once, correctly, on every rank — no parameter-grad
+    fixups needed. (The framework's gradient sync then runs ONLY over the
+    data axes, by design: tp replicas never need it.)
+    """
+    return x
+
+
+def _boundary_fwd(x, axis_name):
+    return x, None
+
+
+def _boundary_bwd(axis_name, _res, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+tp_grad_boundary.defvjp(_boundary_fwd, _boundary_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_psum(x: jnp.ndarray, axis_name: str = "tp") -> jnp.ndarray:
+    """Megatron's "f" operator: all-reduce forward, identity backward.
+
+    The row-parallel output reduction. The reduced activation is identical
+    on every tp rank, so its cotangent is already complete — it must pass
+    through unchanged. (A plain ``lax.psum`` cannot be used here: its
+    transpose is another psum, which multiplies every downstream gradient
+    by the tp group size.)
+    """
+    return lax.psum(x, axis_name)
+
+
+def _tp_psum_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _tp_psum_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+tp_psum.defvjp(_tp_psum_fwd, _tp_psum_bwd)
+
+
+def column_parallel_dense(x: jnp.ndarray, w_shard: jnp.ndarray,
+                          b_shard: Optional[jnp.ndarray] = None
+                          ) -> jnp.ndarray:
+    """y_local = x @ W[:, shard]: output features sharded, no forward
+    collective."""
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel_dense(x_shard: jnp.ndarray, w_shard: jnp.ndarray,
+                       axis_name: str = "tp",
+                       bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """y = psum_tp(x_local @ W[shard, :]): input features sharded, partial
+    products summed across the tp group. Bias (full-width) is added once,
+    after the reduction."""
+    partial_out = x_shard @ w_shard
+    y = tp_psum(partial_out, axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
