@@ -1,0 +1,181 @@
+"""Command-line entry points.
+
+The reference ships two mains — a master and a worker, joined over a
+localhost Akka cluster (reference: AllreduceMaster.scala:95-112,
+AllreduceWorker.scala:309-315, scripts/testAllreduceMaster.sc) — whose
+defaults form its README demo (2 workers, dataSize = 2x5, maxChunkSize=2).
+On TPU there is no separate master process (ranks come from topology), so
+the CLI surface maps as:
+
+* ``emulate`` — the reference's localhost cluster, in one process: real
+  master + N workers on the deterministic router, with the reference's
+  defaults, throughput sink, and ``output == N x input`` assertion.
+* ``train`` — the flagship workload: dp x tp x sp transformer training on
+  the available devices.
+* ``bench`` — the device-plane goodput benchmark (bench.py).
+* ``info`` — topology summary: the master's membership view, hardware
+  edition.
+
+Run as ``python -m akka_allreduce_tpu.cli <subcommand> [flags]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _add_emulate(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "emulate", help="run the in-process protocol cluster "
+        "(reference master defaults: AllreduceMaster.scala:98-107)")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--data-size", type=int, default=None,
+                   help="default: workers * 5 (reference default)")
+    p.add_argument("--max-chunk-size", type=int, default=2)
+    p.add_argument("--max-round", type=int, default=100)
+    p.add_argument("--max-lag", type=int, default=1)
+    p.add_argument("--th-allreduce", type=float, default=1.0)
+    p.add_argument("--th-reduce", type=float, default=1.0)
+    p.add_argument("--th-complete", type=float, default=0.8)
+    p.add_argument("--checkpoint", type=int, default=50,
+                   help="throughput print interval in rounds")
+    p.add_argument("--assert-multiple", type=int, default=0,
+                   help="assert output == N x input (needs thresholds 1.0)")
+    p.add_argument("--kill-rank", type=int, default=None,
+                   help="kill this rank after registration (fault demo)")
+
+
+def _cmd_emulate(args: argparse.Namespace) -> int:
+    from akka_allreduce_tpu.config import (AllreduceConfig, DataConfig,
+                                           ThresholdConfig, WorkerConfig)
+    from akka_allreduce_tpu.protocol.cluster import (LocalCluster,
+                                                     ThroughputSink,
+                                                     constant_range_source)
+
+    data_size = args.data_size or args.workers * 5
+    config = AllreduceConfig(
+        thresholds=ThresholdConfig(args.th_allreduce, args.th_reduce,
+                                   args.th_complete),
+        data=DataConfig(data_size=data_size,
+                        max_chunk_size=args.max_chunk_size,
+                        max_round=args.max_round),
+        workers=WorkerConfig(total_size=args.workers, max_lag=args.max_lag),
+    )
+    sinks = [ThroughputSink(data_size, checkpoint=args.checkpoint,
+                            assert_multiple=args.assert_multiple,
+                            verbose=(rank == 0))
+             for rank in range(args.workers)]
+    cluster = LocalCluster(
+        config,
+        source_factory=lambda r: constant_range_source(data_size),
+        sink_factory=lambda r: sinks[r])
+    t0 = time.perf_counter()
+    rounds = cluster.run(kill_rank=args.kill_rank)
+    dt = time.perf_counter() - t0
+    print(f"completed {rounds}/{args.max_round} rounds in {dt:.2f}s "
+          f"({args.workers} workers, dataSize={data_size}, "
+          f"chunk={args.max_chunk_size}, maxLag={args.max_lag})")
+    return 0 if rounds == args.max_round or args.kill_rank is not None else 1
+
+
+def _add_train(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("train", help="train the flagship transformer on "
+                                     "the available devices")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--dp", type=int, default=0,
+                   help="data-parallel degree (0 = all devices)")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--d-ff", type=int, default=512)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--batch", type=int, default=0,
+                   help="global batch (0 = 2 per dp rank)")
+    p.add_argument("--seq", type=int, default=0,
+                   help="global sequence (0 = 32 per sp rank)")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--bucket-elems", type=int, default=1 << 16)
+
+
+def _cmd_train(args: argparse.Namespace) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                 make_train_state,
+                                                 make_train_step)
+    from akka_allreduce_tpu.models.transformer import TransformerConfig
+    from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+
+    n_dev = len(jax.devices())
+    dp = args.dp or max(1, n_dev // (args.tp * args.sp))
+    if dp * args.tp * args.sp != n_dev:
+        print(f"error: dp*tp*sp = {dp * args.tp * args.sp} != "
+              f"{n_dev} devices", file=sys.stderr)
+        return 2
+    mesh = make_device_mesh(MeshSpec(dp=dp, tp=args.tp, sp=args.sp))
+    b = args.batch or 2 * dp
+    t = args.seq or 32 * args.sp
+    mcfg = TransformerConfig(vocab_size=args.vocab, d_model=args.d_model,
+                             n_heads=args.n_heads, n_layers=args.n_layers,
+                             d_ff=args.d_ff, max_seq=t)
+    cfg = TrainConfig(model=mcfg, learning_rate=args.lr,
+                      bucket_elems=args.bucket_elems)
+    params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, opt)
+
+    rng = np.random.default_rng(0)
+    print(f"mesh dp={dp} tp={args.tp} sp={args.sp}; batch={b} seq={t}")
+    tic = time.perf_counter()
+    steps_in_window = 0
+    for i in range(args.steps):
+        tokens = jnp.asarray(rng.integers(0, args.vocab, size=(b, t),
+                                          dtype=np.int32))
+        params, opt_state, metrics = step(params, opt_state, tokens)
+        steps_in_window += 1
+        if i == 0 or (i + 1) % 10 == 0:
+            loss = float(jax.block_until_ready(metrics["loss"]))
+            toks = float(metrics["tokens"])
+            dt = time.perf_counter() - tic
+            print(f"step {i + 1:4d}: loss {loss:.4f} "
+                  f"({toks * steps_in_window / dt:.0f} tok/s)")
+            tic = time.perf_counter()
+            steps_in_window = 0
+    return 0
+
+
+def _cmd_info(_args: argparse.Namespace) -> int:
+    from akka_allreduce_tpu.runtime.coordinator import topology_summary
+
+    t = topology_summary()
+    print(f"platform={t.platform} process {t.process_index}/"
+          f"{t.process_count} local_devices={t.local_device_count} "
+          f"global_devices={t.global_device_count}")
+    return 0
+
+
+def _cmd_bench(_args: argparse.Namespace) -> int:
+    from akka_allreduce_tpu.bench import main as bench_main
+    bench_main()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="akka_allreduce_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    _add_emulate(sub)
+    _add_train(sub)
+    sub.add_parser("info", help="topology summary")
+    sub.add_parser("bench", help="device-plane goodput benchmark")
+    args = parser.parse_args(argv)
+    return {"emulate": _cmd_emulate, "train": _cmd_train,
+            "info": _cmd_info, "bench": _cmd_bench}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

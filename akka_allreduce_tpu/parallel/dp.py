@@ -25,11 +25,13 @@ import dataclasses
 from typing import Any, Optional
 
 import jax.numpy as jnp
+from jax import lax
 
 from akka_allreduce_tpu.ops.bucketing import BucketSpec, bucketize, \
     debucketize, vector_to_tree
 from akka_allreduce_tpu.ops.masked import expand_bucket_counts, \
     masked_allreduce, rescale_by_count
+from akka_allreduce_tpu.utils.vma import _axis_tuple, psum_all
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,13 +75,29 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     """
     buckets, spec = bucketize(grads, config.bucket_elems)
     if valid is None:
-        valid = jnp.ones((spec.num_buckets,), dtype=jnp.int32)
-    summed, bucket_counts = masked_allreduce(buckets, valid, config.axis_name)
+        # Exact path (thresholds = 1.0): every rank contributes every
+        # bucket, so the masking multiply and the count psum are pure
+        # overhead — counts are the static group size. This keeps the
+        # whole round at ~2 HBM passes (the reference's fast-path
+        # degenerate case: the entire protocol is one sum).
+        summed = psum_all(buckets, config.axis_name)
+        group = 1
+        for a in _axis_tuple(config.axis_name):
+            group *= lax.axis_size(a)
+        bucket_counts = jnp.full((spec.num_buckets,), group, jnp.int32)
+    else:
+        summed, bucket_counts = masked_allreduce(buckets, valid,
+                                                 config.axis_name)
+        group = None
 
     vec = summed.reshape(-1)[:spec.total_size]
     per_elem = expand_bucket_counts(bucket_counts, spec)
     if config.average:
-        vec = rescale_by_count(vec, per_elem, target=config.rescale_target)
+        if group is not None:
+            vec = vec * (config.rescale_target / group)
+        else:
+            vec = rescale_by_count(vec, per_elem,
+                                   target=config.rescale_target)
     out_tree = vector_to_tree(vec, spec)
 
     counts_spec = dataclasses.replace(

@@ -103,13 +103,17 @@ class LocalCluster:
         for w in self.workers:
             self.master.member_up(w.ref)
 
-    def run(self) -> int:
+    def run(self, kill_rank: Optional[int] = None) -> int:
         """Register members and pump until traffic drains. The master paces
         ``config.data.max_round`` rounds (its free-running behavior,
         reference: AllreduceMaster.scala:58-62); if gates can never pass
         (e.g. thresholds=1.0 with a dead worker) the pump drains early and
-        fewer rounds complete. Returns the number of paced rounds."""
+        fewer rounds complete. ``kill_rank`` kills that worker right after
+        registration — the fault-tolerance demo. Returns the number of
+        paced rounds."""
         self.start()
+        if kill_rank is not None:
+            self.kill_worker(kill_rank)
         self.router.pump(max_messages=self._message_budget())
         return len(self.completed_rounds)
 

@@ -1,0 +1,15 @@
+"""Driver entry point: delegates to the packaged benchmark.
+
+See akka_allreduce_tpu/bench.py for the methodology. Kept at the repo root
+as a thin shim because the driver invokes ``python bench.py`` here.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from akka_allreduce_tpu.bench import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()
