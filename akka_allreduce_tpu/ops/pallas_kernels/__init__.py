@@ -1,0 +1,32 @@
+"""Hand-written Pallas TPU kernels for the hot paths XLA's defaults leave on
+the table (SURVEY.md §7 build order step 6).
+
+* `reduce.py` — fused masked peer-sum + count + rescale in one VMEM pass:
+  the device-native form of the reference's only FLOP kernel
+  (reference: ScatteredDataBuffer.scala:20-32) fused with its count
+  bookkeeping and the sink's divide-by-count compensation.
+* `quantized.py` — int8 stochastic-rounding quantize/dequantize with
+  per-chunk scales: the wire-compression direction of PAPERS.md (EQuARX).
+* `ring.py` — ICI ring reduce-scatter + all-gather via remote DMA: the
+  reference's scatter/broadcast phases as a hand-scheduled chip-to-chip
+  pipeline, for when XLA's built-in collective schedule loses to a custom
+  chunk schedule.
+
+The ring collective falls back to ``lax.psum`` for group size 1; the local
+kernels accept ``interpret=True`` to run on non-TPU backends (CPU tests use
+this), and compile natively on TPU.
+"""
+
+from akka_allreduce_tpu.ops.pallas_kernels.reduce import fused_masked_reduce
+from akka_allreduce_tpu.ops.pallas_kernels.quantized import (
+    dequantize_int8,
+    quantize_int8_stochastic,
+)
+from akka_allreduce_tpu.ops.pallas_kernels.ring import pallas_ring_allreduce
+
+__all__ = [
+    "fused_masked_reduce",
+    "quantize_int8_stochastic",
+    "dequantize_int8",
+    "pallas_ring_allreduce",
+]

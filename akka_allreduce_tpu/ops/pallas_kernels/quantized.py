@@ -1,0 +1,93 @@
+"""Int8 quantized transport kernels (EQuARX direction, PAPERS.md).
+
+Per-chunk symmetric int8 quantization with stochastic rounding: the payload
+shrinks 4x on the wire (ICI/DCN) at the cost of one extra quantize/
+dequantize pass per hop; stochastic rounding keeps the sum unbiased across
+rounds, which is what makes the scheme usable for gradient allreduce.
+
+The rounding uses random bits generated OUTSIDE the kernel (jax.random) and
+plain arithmetic inside, rather than the TPU-only ``pltpu.prng_*`` /
+``pltpu.stochastic_round`` primitives — the kernel then runs identically on
+real TPUs and in interpreter mode, and the bits cost one extra VMEM input
+per chunk. Per-row (chunk) scales confine an outlier's damage to its own
+chunk, mirroring the framework's bucket/chunk granularity
+(cf. the guide's quantization pattern, pallas_guide.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _quantize_kernel(x_ref, bits_ref, values_ref, scales_ref):
+    x = x_ref[:]  # (rows, elems)
+    abs_max = jnp.max(jnp.abs(x), axis=1, keepdims=True)  # per-row scale
+    scale = jnp.maximum(abs_max / 127.0, 1e-30)
+    scales_ref[:] = scale
+    scaled = x / scale  # in [-127, 127]
+    # stochastic rounding: floor + Bernoulli(frac), uniform from the top
+    # 24 bits so the f32 conversion is exact
+    low = jnp.floor(scaled)
+    frac = scaled - low
+    # top 24 bits as uniform [0,1); go through an int32 bitcast because
+    # Mosaic has no uint32->f32 cast (values < 2^24 are sign-safe)
+    u24 = pltpu.bitcast(bits_ref[:] >> 8, jnp.int32)
+    u = u24.astype(jnp.float32) * (1.0 / (1 << 24))
+    rounded = low + (frac > u).astype(jnp.float32)
+    rounded = jnp.clip(rounded, -127.0, 127.0)
+    values_ref[:] = rounded.astype(jnp.int8)
+
+
+def _dequantize_kernel(values_ref, scales_ref, out_ref):
+    out_ref[:] = values_ref[:].astype(jnp.float32) * scales_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_int8_stochastic(x: jnp.ndarray, seed,
+                             interpret: bool = False
+                             ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """x: (rows, elems) f32 -> (int8 values (rows, elems),
+    f32 scales (rows, 1)). Each row is one wire chunk; ``seed`` drives the
+    stochastic rounding."""
+    rows, elems = x.shape
+    bits = jax.random.bits(jax.random.key(seed), (rows, elems),
+                           dtype=jnp.uint32)
+    values, scales = pl.pallas_call(
+        _quantize_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, elems), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(x, bits)
+    return values, scales
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dequantize_int8(values: jnp.ndarray, scales: jnp.ndarray,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Inverse of :func:`quantize_int8_stochastic`."""
+    rows, elems = values.shape
+    return pl.pallas_call(
+        _dequantize_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, elems), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(values, scales)

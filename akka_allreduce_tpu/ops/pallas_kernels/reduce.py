@@ -1,0 +1,63 @@
+"""Fused masked peer-reduction kernel.
+
+One VMEM pass computes what the staged host plane does in four
+(reference: ScatteredDataBuffer.scala:20-32 summation;
+ReducedDataBuffer.scala:26-53 count expansion; the sink's rescale):
+
+    out[e] = (sum over peers p of valid[p] * staged[p, e]) * target / count
+    count  = sum over peers of valid[p]
+
+for each chunk, where ``staged`` is a (peers, elems) staging matrix — the
+device-resident analog of one ring-buffer row. Used by the single-chip
+emulation path and as the combiner inside the Pallas ring collective.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+
+
+def _kernel(staged_ref, valid_ref, out_ref, count_ref, *, target):
+    valid = valid_ref[:]  # (peers, 1) f32
+    contrib = staged_ref[:] * valid  # mask garbage from invalid peers
+    total = jnp.sum(contrib, axis=0)  # (elems,)
+    count = jnp.sum(valid)
+    count_ref[0, 0] = count.astype(jnp.int32)
+    scale = jnp.where(count > 0, target / jnp.maximum(count, 1.0), 0.0)
+    out_ref[:] = (total * scale)[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("target", "interpret"))
+def fused_masked_reduce(staged: jnp.ndarray, valid: jnp.ndarray,
+                        target: float = 1.0,
+                        interpret: bool = False
+                        ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """staged: (peers, elems) f32; valid: (peers,) — returns
+    (reduced (elems,), count scalar int32). ``elems`` should be a multiple
+    of 128 (lane width) for peak efficiency; any size compiles."""
+    peers, elems = staged.shape
+    valid_f = valid.astype(jnp.float32).reshape(peers, 1)
+    out, count = pl.pallas_call(
+        functools.partial(_kernel, target=float(target)),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, elems), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        interpret=interpret,
+    )(staged, valid_f)
+    return out[0], count[0, 0]

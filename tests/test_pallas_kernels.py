@@ -1,0 +1,166 @@
+"""Pallas kernel tests.
+
+Local kernels (fused reduce, quantize) run in interpreter mode on CPU —
+numerically exact against numpy oracles. The RDMA ring collective needs >= 2
+real chips; here it is validated for the group-size-1 fallback, its input
+contract, and (where the installed JAX supports distributed interpret mode)
+an 8-virtual-device run against psum.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.ops.pallas_kernels import (
+    dequantize_int8,
+    fused_masked_reduce,
+    pallas_ring_allreduce,
+    quantize_int8_stochastic,
+)
+from akka_allreduce_tpu.parallel.mesh import single_axis_mesh
+
+
+class TestFusedMaskedReduce:
+    def test_matches_reference_reduce_semantics(self):
+        """The kernel computes the reference's reduce + count + rescale
+        (ScatteredDataBuffer.scala:20-32 + sink compensation) in one pass."""
+        rng = np.random.default_rng(0)
+        staged = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        valid = jnp.array([1, 1, 0, 1], jnp.int32)  # peer 2 is a straggler
+        out, count = fused_masked_reduce(staged, valid, target=1.0,
+                                         interpret=True)
+        assert int(count) == 3
+        want = np.asarray(staged)[[0, 1, 3]].sum(axis=0) / 3.0
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+    def test_zero_contributors_yield_zeros(self):
+        staged = jnp.ones((2, 128), jnp.float32)
+        valid = jnp.zeros((2,), jnp.int32)
+        out, count = fused_masked_reduce(staged, valid, interpret=True)
+        assert int(count) == 0
+        np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+    def test_target_rescale(self):
+        staged = jnp.ones((4, 128), jnp.float32)
+        valid = jnp.array([1, 1, 1, 0], jnp.int32)
+        out, _ = fused_masked_reduce(staged, valid, target=4.0,
+                                     interpret=True)
+        # sum 3, mean 1, scaled to target 4 contributors -> 4
+        np.testing.assert_allclose(np.asarray(out), 4.0, rtol=1e-6)
+
+
+class TestQuantized:
+    def test_round_trip_accuracy(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(8, 512)).astype(np.float32))
+        values, scales = quantize_int8_stochastic(x, seed=0, interpret=True)
+        assert values.dtype == jnp.int8
+        back = dequantize_int8(values, scales, interpret=True)
+        # max error per element is one quantization step = scale
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        bound = np.broadcast_to(np.asarray(scales) * 1.001, err.shape)
+        np.testing.assert_array_less(err, bound)
+
+    def test_per_row_scales_isolate_outliers(self):
+        x = jnp.ones((2, 128), jnp.float32)
+        x = x.at[1, 0].set(1000.0)  # outlier only in row 1
+        _, scales = quantize_int8_stochastic(x, seed=0, interpret=True)
+        s = np.asarray(scales).ravel()
+        assert s[0] == pytest.approx(1.0 / 127.0)
+        assert s[1] == pytest.approx(1000.0 / 127.0)
+
+    def test_stochastic_rounding_is_unbiased(self):
+        """Mean of many stochastic quantizations converges to the input —
+        the property that keeps multi-round gradient sums unbiased."""
+        x = jnp.full((1, 256), 0.37, jnp.float32)  # not on the int8 grid
+        acc = np.zeros((1, 256), np.float64)
+        n = 64
+        for seed in range(n):
+            v, s = quantize_int8_stochastic(x, seed=seed, interpret=True)
+            acc += np.asarray(dequantize_int8(v, s, interpret=True))
+        mean_err = abs(acc / n - 0.37).mean()
+        step = float(np.asarray(s).ravel()[0])
+        assert mean_err < 0.2 * step, (mean_err, step)
+
+
+class TestRingAllreduce:
+    def test_single_rank_falls_back_to_psum(self):
+        mesh1 = single_axis_mesh("dp", devices=jax.devices()[:1])
+
+        @partial(jax.shard_map, mesh=mesh1, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(x):
+            return pallas_ring_allreduce(x[0], "dp")[None]
+
+        x = jnp.arange(256, dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(run(x[None])[0]),
+                                      np.asarray(x))
+
+    def test_rejects_non_divisible_vectors(self):
+        mesh = single_axis_mesh("dp")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(x):
+            return pallas_ring_allreduce(x[0], "dp")[None]
+
+        with pytest.raises(ValueError, match="ring blocks"):
+            run(jnp.ones((8, 8 * 128 + 4), jnp.float32))
+
+    def test_interpret_mode_ring_vs_psum(self):
+        """Full 8-rank ring in interpreter mode, if this JAX supports
+        distributed interpret; otherwise skip (needs >= 2 real chips)."""
+        mesh = single_axis_mesh("dp")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(x):
+            return pallas_ring_allreduce(x[0], "dp", interpret=True)[None]
+
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(8, 8 * 128)).astype(np.float32))
+        try:
+            out = np.asarray(jax.jit(run)(x))
+        except Exception as e:  # pragma: no cover - env capability probe
+            pytest.skip(f"distributed pallas interpret unsupported: {e}")
+        want = np.asarray(x).sum(axis=0)
+        for r in range(8):
+            # atol covers summation-order noise on near-zero sums
+            np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("n", [2, 3, 4, 8])
+    def test_ring_schedule_index_math(self, n):
+        """Simulate the kernel's exact ring schedule (same index formulas as
+        ring.py's _ring_kernel) across n simulated devices: every device
+        must end with the complete sum of every block. Validates the
+        algorithm; the RDMA mechanics follow the documented guide pattern."""
+        rows = 1
+        rng = np.random.default_rng(n)
+        local = [rng.normal(size=(n, rows)).astype(np.float32)
+                 for _ in range(n)]  # local[i][b] = device i's block b
+        want = sum(local)
+
+        carry = [local[i][i].copy() for i in range(n)]  # phase 1 init
+        out = [np.zeros((n, rows), np.float32) for _ in range(n)]
+        for s in range(n - 1):
+            sent = [c.copy() for c in carry]  # everyone sends to the right
+            for i in range(n):
+                recv = sent[(i - 1) % n]  # from the left neighbor
+                absorb = (i - 1 - s) % n
+                carry[i] = recv + local[i][absorb]
+        for i in range(n):
+            out[i][(i + 1) % n] = carry[i]
+        for s in range(n - 1):
+            sent = [c.copy() for c in carry]
+            for i in range(n):
+                recv = sent[(i - 1) % n]
+                got = (i - s) % n
+                out[i][got] = recv
+                carry[i] = recv
+        for i in range(n):
+            np.testing.assert_allclose(out[i], want, rtol=1e-6,
+                                       err_msg=f"device {i} of {n}")
