@@ -84,9 +84,12 @@ def _ring_kernel(my_ref, x_ref, out_ref, carry_ref, comm_ref, send_sem,
         )
         rdma.start()
         rdma.wait()
-        if not interpret:
+        if not interpret and t < 2 * n - 3:
             # our send from `slot` is done: grant the LEFT neighbor its
-            # next remote write into that slot of ours
+            # next remote write into that slot of ours. The final step
+            # (t == 2n-3) grants nothing — no send follows, and an extra
+            # signal would land on a neighbor that may have exited, leaving
+            # a stale +1 that lets a future invocation's send race ahead.
             pltpu.semaphore_signal(free_sem.at[slot], inc=1, device_id=left,
                                    device_id_type=pltpu.DeviceIdType.LOGICAL)
         return recv_slot
