@@ -10,6 +10,10 @@ the CLI surface maps as:
 * ``emulate`` — the reference's localhost cluster, in one process: real
   master + N workers on the deterministic router, with the reference's
   defaults, throughput sink, and ``output == N x input`` assertion.
+* ``master`` / ``worker`` — the reference's actual two-program surface:
+  separate processes joined over localhost TCP via the native C++
+  transport (reference: AllreduceMaster.scala:95-112,
+  AllreduceWorker.scala:309-315).
 * ``train`` — the flagship workload: dp x tp x sp transformer training on
   the available devices.
 * ``bench`` — the device-plane goodput benchmark (bench.py).
@@ -54,7 +58,7 @@ def _cmd_emulate(args: argparse.Namespace) -> int:
                                                      ThroughputSink,
                                                      constant_range_source)
 
-    data_size = args.data_size or args.workers * 5
+    data_size = args.workers * 5 if args.data_size is None else args.data_size
     config = AllreduceConfig(
         thresholds=ThresholdConfig(args.th_allreduce, args.th_reduce,
                                    args.th_complete),
@@ -78,6 +82,71 @@ def _cmd_emulate(args: argparse.Namespace) -> int:
           f"({args.workers} workers, dataSize={data_size}, "
           f"chunk={args.max_chunk_size}, maxLag={args.max_lag})")
     return 0 if rounds == args.max_round or args.kill_rank is not None else 1
+
+
+def _add_master(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "master", help="run a master process over the native TCP transport "
+        "(reference: AllreduceMaster.scala:95-112)")
+    p.add_argument("--port", type=int, default=2551)
+    p.add_argument("--bind-host", default="127.0.0.1")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--data-size", type=int, default=None,
+                   help="default: workers * 5 (reference default)")
+    p.add_argument("--max-chunk-size", type=int, default=2)
+    p.add_argument("--max-round", type=int, default=100)
+    p.add_argument("--max-lag", type=int, default=1)
+    p.add_argument("--th-allreduce", type=float, default=1.0)
+    p.add_argument("--th-reduce", type=float, default=1.0)
+    p.add_argument("--th-complete", type=float, default=0.8)
+    p.add_argument("--timeout", type=float, default=120.0)
+
+
+def _cmd_master(args: argparse.Namespace) -> int:
+    from akka_allreduce_tpu.config import (AllreduceConfig, DataConfig,
+                                           ThresholdConfig, WorkerConfig)
+    from akka_allreduce_tpu.protocol.remote import run_master
+
+    data_size = args.workers * 5 if args.data_size is None else args.data_size
+    config = AllreduceConfig(
+        thresholds=ThresholdConfig(args.th_allreduce, args.th_reduce,
+                                   args.th_complete),
+        data=DataConfig(data_size=data_size,
+                        max_chunk_size=args.max_chunk_size,
+                        max_round=args.max_round),
+        workers=WorkerConfig(total_size=args.workers, max_lag=args.max_lag),
+    )
+    rounds = run_master(config, bind_host=args.bind_host, port=args.port,
+                        timeout_s=args.timeout)
+    return 0 if rounds == args.max_round else 1
+
+
+def _add_worker(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "worker", help="run a worker process over the native TCP transport "
+        "(reference: AllreduceWorker.scala:309-315)")
+    p.add_argument("--master-host", default="127.0.0.1")
+    p.add_argument("--master-port", type=int, default=2551)
+    p.add_argument("--data-size", type=int, default=10,
+                   help="synthetic source length (must match the master's)")
+    p.add_argument("--checkpoint", type=int, default=10,
+                   help="throughput print interval in rounds")
+    p.add_argument("--assert-multiple", type=int, default=0,
+                   help="assert output == N x input (needs thresholds 1.0)")
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--verbose", action="store_true")
+
+
+def _cmd_worker(args: argparse.Namespace) -> int:
+    from akka_allreduce_tpu.protocol.remote import run_worker
+
+    outputs = run_worker(master_host=args.master_host,
+                         master_port=args.master_port,
+                         source_data_size=args.data_size,
+                         checkpoint=args.checkpoint,
+                         assert_multiple=args.assert_multiple,
+                         timeout_s=args.timeout, verbose=args.verbose)
+    return 0 if outputs > 0 else 1
 
 
 def _add_train(sub: argparse._SubParsersAction) -> None:
@@ -169,11 +238,14 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="akka_allreduce_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
     _add_emulate(sub)
+    _add_master(sub)
+    _add_worker(sub)
     _add_train(sub)
     sub.add_parser("info", help="topology summary")
     sub.add_parser("bench", help="device-plane goodput benchmark")
     args = parser.parse_args(argv)
-    return {"emulate": _cmd_emulate, "train": _cmd_train,
+    return {"emulate": _cmd_emulate, "master": _cmd_master,
+            "worker": _cmd_worker, "train": _cmd_train,
             "info": _cmd_info, "bench": _cmd_bench}[args.cmd](args)
 
 

@@ -1,0 +1,82 @@
+"""Native (C++) runtime components.
+
+The reference's transport layer is JVM-native netty TCP under Akka remoting
+(reference: application.conf:5-11); this package supplies the equivalent for
+the TPU framework's host plane: a C++ framed TCP transport
+(src/transport.cpp) loaded via ctypes, built on demand with the in-tree
+Makefile (g++; no pybind11 in this environment).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_lib", "libaatpu.so")
+_SRC = os.path.join(_DIR, "src", "transport.cpp")
+
+_lib: ctypes.CDLL | None = None
+
+
+def build_library(force: bool = False) -> str:
+    """Compile the shared library if missing or older than its source.
+    Concurrent-process safe: compiles to a per-pid temp file and atomically
+    renames, so simultaneous cold starts (the multi-process cluster) never
+    load a partially-written .so. Returns the .so path."""
+    stale = (not os.path.exists(_SO)
+             or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+    if force or stale:
+        os.makedirs(os.path.dirname(_SO), exist_ok=True)
+        tmp = f"{_SO}.tmp.{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+                 "-pthread", "-shared", "-o", tmp, _SRC],
+                check=True, capture_output=True, text=True)
+            os.replace(tmp, _SO)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return _SO
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if needed) and configure the C ABI."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build_library())
+
+    lib.aat_create.restype = ctypes.c_void_p
+    lib.aat_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.aat_port.restype = ctypes.c_int
+    lib.aat_port.argtypes = [ctypes.c_void_p]
+    lib.aat_connect.restype = ctypes.c_int
+    lib.aat_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int]
+    lib.aat_send.restype = ctypes.c_int
+    lib.aat_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_uint8),
+                             ctypes.c_uint64]
+    lib.aat_recv_len.restype = ctypes.c_int64
+    lib.aat_recv_len.argtypes = [ctypes.c_void_p]
+    lib.aat_recv_take.restype = ctypes.c_int64
+    lib.aat_recv_take.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint8),
+                                  ctypes.c_uint64,
+                                  ctypes.POINTER(ctypes.c_int)]
+    lib.aat_poll_disconnect.restype = ctypes.c_int
+    lib.aat_poll_disconnect.argtypes = [ctypes.c_void_p]
+    lib.aat_close_peer.restype = None
+    lib.aat_close_peer.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.aat_send_drained.restype = ctypes.c_int
+    lib.aat_send_drained.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.aat_num_connected.restype = ctypes.c_int
+    lib.aat_num_connected.argtypes = [ctypes.c_void_p]
+    lib.aat_destroy.restype = None
+    lib.aat_destroy.argtypes = [ctypes.c_void_p]
+
+    _lib = lib
+    return lib

@@ -1,0 +1,102 @@
+"""Multi-process cluster runners over the native TCP transport.
+
+The true equivalent of the reference's L6 deployment — separate master and
+worker processes joined over localhost TCP (reference:
+AllreduceMaster.scala:95-112, AllreduceWorker.scala:309-315,
+scripts/testAllreduceMaster.sc / testAllreduceWorker.sc) — with the C++
+transport (native/src/transport.cpp) in netty's role. The master process
+paces a fixed number of rounds then closes; workers treat the master's
+disconnect as shutdown (the reference's clusters are stopped by killing the
+master, so deathwatch-as-shutdown matches observed behavior).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import numpy as np
+
+from akka_allreduce_tpu.config import AllreduceConfig
+from akka_allreduce_tpu.protocol.cluster import ThroughputSink, \
+    constant_range_source
+from akka_allreduce_tpu.protocol.master import AllreduceMaster
+from akka_allreduce_tpu.protocol.tcp import TcpRouter
+from akka_allreduce_tpu.protocol.worker import AllreduceWorker
+
+log = logging.getLogger(__name__)
+
+
+def run_master(config: AllreduceConfig, bind_host: str = "127.0.0.1",
+               port: int = 2551, timeout_s: float = 120.0,
+               verbose: bool = True) -> int:
+    """Serve membership + round pacing until ``config.data.max_round`` rounds
+    complete (or timeout). Returns rounds completed."""
+    completed: list[int] = []
+    with TcpRouter(bind_host=bind_host, port=port, role="master") as router:
+        master = AllreduceMaster(router, config,
+                                 on_round_complete=completed.append)
+        router.on_member = lambda ref, role: (
+            master.member_up(ref, role) if role == "worker" else None)
+        router.on_terminated = master.terminated
+        if verbose:
+            print(f"master: listening on {router.addr[0]}:{router.addr[1]}, "
+                  f"waiting for {config.workers.total_size} workers")
+        deadline = time.monotonic() + timeout_s
+        while len(completed) < config.data.max_round \
+                and time.monotonic() < deadline:
+            router.poll(0.05)
+        router.flush()
+    if verbose:
+        print(f"master: {len(completed)}/{config.data.max_round} rounds")
+    return len(completed)
+
+
+def run_worker(master_host: str = "127.0.0.1", master_port: int = 2551,
+               source_data_size: int = 10, checkpoint: int = 10,
+               assert_multiple: int = 0, bind_host: str = "127.0.0.1",
+               port: int = 0, timeout_s: float = 120.0,
+               verbose: bool = False) -> int:
+    """Join the master, run the worker engine until the master disconnects
+    (shutdown) or timeout. Returns outputs flushed to the sink."""
+    sink = ThroughputSink(source_data_size, checkpoint=checkpoint,
+                          assert_multiple=assert_multiple, verbose=verbose)
+    alive = {"up": True}
+    with TcpRouter(bind_host=bind_host, port=port, role="worker") as router:
+        worker = AllreduceWorker(router, constant_range_source(
+            source_data_size), sink)
+        # Join-retry: the master may not be listening yet (workers and
+        # master start concurrently, like Akka seed-node join retries).
+        join_deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                master_ref = router.dial((master_host, master_port))
+                break
+            except ConnectionError:
+                if time.monotonic() >= join_deadline:
+                    raise
+                time.sleep(0.2)
+
+        def on_terminated(ref):
+            worker.terminated(ref)
+            if ref is master_ref:
+                alive["up"] = False
+
+        router.on_terminated = on_terminated
+        deadline = time.monotonic() + timeout_s
+        while alive["up"] and time.monotonic() < deadline:
+            router.poll(0.05)
+    if verbose:
+        print(f"worker {worker.id}: {sink.outputs_seen} outputs")
+    return sink.outputs_seen
+
+
+def free_port(bind_host: str = "127.0.0.1") -> int:
+    """Pick an ephemeral port (test convenience; races are acceptable on
+    localhost)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind((bind_host, 0))
+        return s.getsockname()[1]

@@ -1,0 +1,239 @@
+"""TCP router: the protocol engines over the native C++ transport.
+
+The multi-process deployment surface, equivalent to the reference's Akka
+remoting configuration (reference: application.conf:1-21): each process runs
+one protocol engine (master or worker) behind a :class:`TcpRouter` exposing
+the same ``register``/``send`` surface as the in-process Router
+(protocol/transport.py), so the engines run unchanged. Remote peers are
+addressed by interned :class:`RemoteRef` (host, port) handles — interning
+preserves the identity semantics the engines rely on (self-delivery bypass,
+deathwatch ``is`` checks). Framing, connection management, and disconnect
+detection live in C++ (native/src/transport.cpp); this layer adds the codec
+(protocol/wire.py) and membership greetings.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from akka_allreduce_tpu.native import load_library
+from akka_allreduce_tpu.protocol import wire
+from akka_allreduce_tpu.protocol.transport import ActorRef
+
+log = logging.getLogger(__name__)
+
+
+class RemoteRef:
+    """Addressable handle for a peer process's engine. One interned instance
+    per address per router (see :meth:`TcpRouter.ref_of`)."""
+
+    def __init__(self, addr: wire.Addr):
+        self.addr = addr
+
+    def __repr__(self) -> str:
+        return f"<remote {self.addr[0]}:{self.addr[1]}>"
+
+
+class TcpRouter:
+    """Router surface over the native TCP transport.
+
+    ``on_member(ref, role)`` fires when a peer's Hello arrives (the MemberUp
+    flow); ``on_terminated(ref)`` fires when a peer's connection drops (the
+    deathwatch flow, reference: AllreduceMaster.scala:46-52).
+    """
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
+                 advertise_host: Optional[str] = None, role: str = "worker",
+                 on_member: Optional[Callable[[RemoteRef, str], None]] = None,
+                 on_terminated: Optional[Callable[[RemoteRef], None]] = None):
+        self._lib = load_library()
+        self._t = self._lib.aat_create(bind_host.encode(), port)
+        if not self._t:
+            raise OSError(f"cannot bind TCP transport on {bind_host}:{port}")
+        self.addr: wire.Addr = (advertise_host or bind_host,
+                                self._lib.aat_port(self._t))
+        self.role = role
+        self.on_member = on_member
+        self.on_terminated = on_terminated
+
+        self._local: dict[ActorRef, Callable] = {}
+        self._primary: Optional[ActorRef] = None
+        self._local_mail: deque = deque()
+        self._refs: dict[wire.Addr, RemoteRef] = {}
+        self._conn_of: dict[wire.Addr, int] = {}
+        self._addr_of_conn: dict[int, wire.Addr] = {}
+        self._recv_buf = (ctypes.c_uint8 * (1 << 20))()
+
+    # -- Router surface (what the engines call) -----------------------------
+
+    def register(self, name: Optional[str] = None,
+                 handler: Optional[Callable] = None) -> ActorRef:
+        ref = ActorRef(name)
+        if handler is not None:
+            self._local[ref] = handler
+            if self._primary is None:
+                self._primary = ref
+        return ref
+
+    def send(self, ref, msg) -> None:
+        if isinstance(ref, ActorRef):
+            # Local re-queue (uninitialized-worker path): back of the line,
+            # like an actor self-send.
+            self._local_mail.append((ref, msg))
+            return
+        if not isinstance(ref, RemoteRef):
+            raise TypeError(f"cannot route to {ref!r}")
+        conn = self._ensure_conn(ref.addr)
+        if conn is None:
+            return  # dead peer: dead-letter drop, like Akka
+        data = wire.encode(msg, self._addr_for)
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        self._lib.aat_send(self._t, conn, buf, len(data))
+
+    # -- address/ref resolution ---------------------------------------------
+
+    def ref_of(self, addr: wire.Addr):
+        """Interned ref for an address; our own address resolves to the
+        primary local engine so the self-delivery bypass still short-circuits
+        (reference: AllreduceWorker.scala:228-231)."""
+        if tuple(addr) == tuple(self.addr) and self._primary is not None:
+            return self._primary
+        ref = self._refs.get(addr)
+        if ref is None:
+            ref = self._refs[addr] = RemoteRef(addr)
+        return ref
+
+    def _addr_for(self, ref) -> wire.Addr:
+        if isinstance(ref, RemoteRef):
+            return ref.addr
+        return self.addr  # a local ref: advertise our own address
+
+    def _ensure_conn(self, addr: wire.Addr) -> Optional[int]:
+        conn = self._conn_of.get(addr)
+        if conn is not None:
+            return conn
+        conn = self._lib.aat_connect(self._t, addr[0].encode(), addr[1])
+        if conn < 0:
+            return None
+        self._conn_of[addr] = conn
+        self._addr_of_conn[conn] = addr
+        # Greet so the remote can map this connection back to our address.
+        data = wire.encode(wire.Hello(self.addr, self.role), self._addr_for)
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        self._lib.aat_send(self._t, conn, buf, len(data))
+        return conn
+
+    def dial(self, addr: wire.Addr) -> RemoteRef:
+        """Explicitly connect (worker -> master seed-node join)."""
+        if self._ensure_conn(tuple(addr)) is None:
+            raise ConnectionError(f"cannot reach {addr}")
+        return self.ref_of(tuple(addr))
+
+    # -- event pump ----------------------------------------------------------
+
+    def poll(self, timeout_s: float = 0.0) -> int:
+        """Process available traffic: local self-sends, inbound frames, and
+        disconnects. Blocks up to ``timeout_s`` waiting for the first
+        activity; returns messages delivered."""
+        deadline = time.monotonic() + timeout_s
+        delivered = 0
+        while True:
+            delivered += self._drain_local()
+            delivered += self._drain_inbound()
+            self._drain_disconnects()
+            if delivered or timeout_s == 0.0 \
+                    or time.monotonic() >= deadline:
+                return delivered
+            time.sleep(0.0002)
+
+    def _drain_local(self) -> int:
+        # Process only what was queued at entry: a handler that re-queues to
+        # itself (uninitialized worker waiting for InitWorkers) must not
+        # starve the inbound drain where that InitWorkers is waiting.
+        n = 0
+        for _ in range(len(self._local_mail)):
+            ref, msg = self._local_mail.popleft()
+            handler = self._local.get(ref)
+            if handler is not None:
+                handler(msg)
+                n += 1
+        return n
+
+    def _drain_inbound(self) -> int:
+        n = 0
+        while True:
+            need = self._lib.aat_recv_len(self._t)
+            if need < 0:
+                return n
+            if need > len(self._recv_buf):
+                self._recv_buf = (ctypes.c_uint8 * int(need * 2))()
+            src = ctypes.c_int(-1)
+            got = self._lib.aat_recv_take(self._t, self._recv_buf,
+                                          len(self._recv_buf),
+                                          ctypes.byref(src))
+            if got < 0:
+                return n
+            try:
+                msg = wire.decode(bytes(self._recv_buf[:got]), self.ref_of)
+            except Exception:
+                # One malformed frame must not kill the whole event loop:
+                # dead-letter it, like Akka dropping undeserializable mail.
+                log.exception("dropping undecodable frame from conn %d",
+                              src.value)
+                continue
+            if isinstance(msg, wire.Hello):
+                self._handle_hello(msg, src.value)
+            else:
+                if self._primary is not None:
+                    self._local[self._primary](msg)
+            n += 1
+
+    def _handle_hello(self, hello: wire.Hello, conn: int) -> None:
+        addr = tuple(hello.addr)
+        self._addr_of_conn[conn] = addr
+        # Prefer an existing (dialed) connection for sending; otherwise the
+        # inbound one is bidirectional TCP — reply on it.
+        self._conn_of.setdefault(addr, conn)
+        ref = self.ref_of(addr)  # intern now so deathwatch can resolve it
+        if self.on_member is not None and isinstance(ref, RemoteRef):
+            self.on_member(ref, hello.role)
+
+    def _drain_disconnects(self) -> None:
+        while True:
+            conn = self._lib.aat_poll_disconnect(self._t)
+            if conn < 0:
+                return
+            addr = self._addr_of_conn.pop(conn, None)
+            if addr is None:
+                continue
+            if self._conn_of.get(addr) == conn:
+                del self._conn_of[addr]
+            if self.on_terminated is not None and addr in self._refs:
+                self.on_terminated(self._refs[addr])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Wait until queued outbound bytes reach the kernel."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(self._lib.aat_send_drained(self._t, c)
+                   for c in self._conn_of.values()):
+                return True
+            time.sleep(0.001)
+        return False
+
+    def close(self) -> None:
+        if self._t:
+            self._lib.aat_destroy(self._t)
+            self._t = None
+
+    def __enter__(self) -> "TcpRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

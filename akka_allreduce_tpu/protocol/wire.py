@@ -1,0 +1,159 @@
+"""Binary wire codec for the 5-message allreduce protocol.
+
+Plays the role of Akka's message serializer above the netty transport
+(reference: AllreduceMessage.scala:7-21 are the serialized case classes;
+application.conf:5-11 is the transport below). Frames are produced/consumed
+by the native C++ TCP transport (native/src/transport.cpp); this module maps
+dataclasses <-> bytes. Little-endian throughout; float payloads are raw f32.
+
+Actor references travel as (host, port) listen addresses. Encoding asks the
+caller to resolve a ref to its address; decoding asks the caller to resolve
+an address back to a ref object — the TCP router interns refs so identity
+checks in the engines (self-bypass, deathwatch) keep working.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from akka_allreduce_tpu.messages import (
+    CompleteAllreduce,
+    InitWorkers,
+    ReduceBlock,
+    ScatterBlock,
+    StartAllreduce,
+)
+
+Addr = Tuple[str, int]
+
+MSG_HELLO = 0
+MSG_INIT = 1
+MSG_START = 2
+MSG_SCATTER = 3
+MSG_REDUCE = 4
+MSG_COMPLETE = 5
+
+
+class Hello:
+    """Transport-level greeting: the dialing process advertises its listen
+    address and role, letting the receiver map the inbound connection to an
+    addressable peer (the Akka-cluster MemberUp analogue,
+    reference: AllreduceMaster.scala:36-44)."""
+
+    def __init__(self, addr: Addr, role: str = "worker"):
+        self.addr = addr
+        self.role = role
+
+    def __repr__(self) -> str:
+        return f"Hello({self.addr}, {self.role!r})"
+
+
+def _pack_addr(addr: Addr) -> bytes:
+    host = addr[0].encode()
+    return struct.pack("<H", len(host)) + host + struct.pack("<I", addr[1])
+
+
+def _unpack_addr(buf: bytes, off: int) -> tuple[Addr, int]:
+    (hlen,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    host = buf[off:off + hlen].decode()
+    off += hlen
+    (port,) = struct.unpack_from("<I", buf, off)
+    return (host, port), off + 4
+
+
+def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
+    """Serialize a protocol message; ``addr_of(ref)`` resolves a ref to its
+    listen address."""
+    if isinstance(msg, Hello):
+        role = msg.role.encode()
+        return (struct.pack("<B", MSG_HELLO) + _pack_addr(msg.addr)
+                + struct.pack("<B", len(role)) + role)
+    if isinstance(msg, InitWorkers):
+        out = [struct.pack("<BiIddIQQ", MSG_INIT, msg.dest_id,
+                           msg.worker_num, msg.th_reduce, msg.th_complete,
+                           msg.max_lag, msg.data_size, msg.max_chunk_size)]
+        if msg.master is None:
+            out.append(struct.pack("<B", 0))
+        else:
+            out.append(struct.pack("<B", 1))
+            out.append(_pack_addr(addr_of(msg.master)))
+        out.append(struct.pack("<I", len(msg.workers)))
+        for rank, ref in sorted(msg.workers.items()):
+            out.append(struct.pack("<i", rank))
+            out.append(_pack_addr(addr_of(ref)))
+        return b"".join(out)
+    if isinstance(msg, StartAllreduce):
+        return struct.pack("<Bq", MSG_START, msg.round)
+    if isinstance(msg, ScatterBlock):
+        payload = np.asarray(msg.value, dtype=np.float32).tobytes()
+        return struct.pack("<BiiiqQ", MSG_SCATTER, msg.src_id, msg.dest_id,
+                           msg.chunk_id, msg.round, len(payload)) + payload
+    if isinstance(msg, ReduceBlock):
+        payload = np.asarray(msg.value, dtype=np.float32).tobytes()
+        return struct.pack("<BiiiqqQ", MSG_REDUCE, msg.src_id, msg.dest_id,
+                           msg.chunk_id, msg.round, msg.count,
+                           len(payload)) + payload
+    if isinstance(msg, CompleteAllreduce):
+        return struct.pack("<Biq", MSG_COMPLETE, msg.src_id, msg.round)
+    raise TypeError(f"cannot encode {type(msg).__name__}")
+
+
+def decode(buf: bytes, ref_of: Callable[[Addr], object]):
+    """Deserialize one frame; ``ref_of(addr)`` resolves an address to a
+    (possibly interned/local) ref object."""
+    (mtype,) = struct.unpack_from("<B", buf, 0)
+    off = 1
+    if mtype == MSG_HELLO:
+        addr, off = _unpack_addr(buf, off)
+        (rlen,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        role = buf[off:off + rlen].decode()
+        return Hello(addr, role)
+    if mtype == MSG_INIT:
+        (dest_id, worker_num, th_reduce, th_complete, max_lag, data_size,
+         max_chunk_size) = struct.unpack_from("<iIddIQQ", buf, off)
+        off += struct.calcsize("<iIddIQQ")
+        (has_master,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        master: Optional[object] = None
+        if has_master:
+            maddr, off = _unpack_addr(buf, off)
+            master = ref_of(maddr)
+        (count,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        workers = {}
+        for _ in range(count):
+            (rank,) = struct.unpack_from("<i", buf, off)
+            off += 4
+            addr, off = _unpack_addr(buf, off)
+            workers[rank] = ref_of(addr)
+        return InitWorkers(workers=workers, worker_num=worker_num,
+                           master=master, dest_id=dest_id,
+                           th_reduce=th_reduce, th_complete=th_complete,
+                           max_lag=max_lag, data_size=data_size,
+                           max_chunk_size=max_chunk_size)
+    if mtype == MSG_START:
+        (round_,) = struct.unpack_from("<q", buf, off)
+        return StartAllreduce(round_)
+    if mtype == MSG_SCATTER:
+        src, dest, chunk, round_, nbytes = struct.unpack_from("<iiiqQ", buf,
+                                                              off)
+        off += struct.calcsize("<iiiqQ")
+        value = np.frombuffer(buf, dtype=np.float32, count=nbytes // 4,
+                              offset=off).copy()
+        return ScatterBlock(value, src, dest, chunk, round_)
+    if mtype == MSG_REDUCE:
+        src, dest, chunk, round_, count, nbytes = struct.unpack_from(
+            "<iiiqqQ", buf, off)
+        off += struct.calcsize("<iiiqqQ")
+        value = np.frombuffer(buf, dtype=np.float32, count=nbytes // 4,
+                              offset=off).copy()
+        return ReduceBlock(value, src, dest, chunk, round_, count)
+    if mtype == MSG_COMPLETE:
+        src, round_ = struct.unpack_from("<iq", buf, off)
+        return CompleteAllreduce(src, round_)
+    raise ValueError(f"unknown message type {mtype}")
