@@ -79,13 +79,14 @@ class LocalCluster:
     def __init__(self, config: AllreduceConfig,
                  source_factory: Optional[Callable[[int], DataSource]] = None,
                  sink_factory: Optional[Callable[[int], DataSink]] = None,
-                 strict: bool = True):
+                 strict: bool = True, tracer=None):
         self.config = config
         self.router = Router()
+        self.tracer = tracer
         self.completed_rounds: list[int] = []
         self.master = AllreduceMaster(
             self.router, config,
-            on_round_complete=self.completed_rounds.append)
+            on_round_complete=self.completed_rounds.append, tracer=tracer)
 
         n = config.workers.total_size
         size = config.data.data_size
@@ -93,7 +94,8 @@ class LocalCluster:
         snk = sink_factory or (lambda _rank: (lambda out: None))
         self.workers = [
             AllreduceWorker(self.router, src(rank), snk(rank),
-                            name=f"worker-{rank}", strict=strict)
+                            name=f"worker-{rank}", strict=strict,
+                            tracer=tracer)
             for rank in range(n)
         ]
 

@@ -26,6 +26,7 @@ from akka_allreduce_tpu.messages import (
     StartAllreduce,
 )
 from akka_allreduce_tpu.protocol.transport import ActorRef, Router
+from akka_allreduce_tpu.runtime.tracing import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -33,7 +34,7 @@ log = logging.getLogger(__name__)
 class AllreduceMaster:
     def __init__(self, router: Router, config: AllreduceConfig,
                  name: Optional[str] = None,
-                 on_round_complete=None):
+                 on_round_complete=None, tracer: Optional[Tracer] = None):
         """``on_round_complete(round)`` is an optional callback fired when a
         round's completion gate passes — the hook the round pacer and
         benchmark harness attach to."""
@@ -42,6 +43,7 @@ class AllreduceMaster:
         self.total_workers = config.workers.total_size
         self.th_allreduce = config.thresholds.th_allreduce
         self.on_round_complete = on_round_complete
+        self.tracer = tracer
         self.ref = router.register(name or "master", handler=self.receive)
 
         self.workers: dict[int, ActorRef] = {}
@@ -64,7 +66,12 @@ class AllreduceMaster:
         self.workers[new_id] = worker_ref
         log.info("master: worker %d up (%s), %d/%d", new_id, worker_ref,
                  len(self.workers), self.total_workers)
+        if self.tracer is not None:
+            self.tracer.record("member_up", rank=new_id,
+                               members=len(self.workers))
         if len(self.workers) >= self.total_workers and self.round == -1:
+            if self.tracer is not None:
+                self.tracer.record("quorum_init", members=len(self.workers))
             self._init_workers()
             self.round = 0
             self._start_allreduce()
@@ -76,6 +83,9 @@ class AllreduceMaster:
         for idx, worker in list(self.workers.items()):
             if worker is ref:
                 del self.workers[idx]
+                if self.tracer is not None:
+                    self.tracer.record("worker_dead", rank=idx,
+                                       members=len(self.workers))
 
     # -- round pacing (reference: AllreduceMaster.scala:54-63) --------------
 
@@ -118,5 +128,7 @@ class AllreduceMaster:
 
     def _start_allreduce(self) -> None:
         self.num_complete = 0
+        if self.tracer is not None:
+            self.tracer.record("round_start", round=self.round)
         for worker in self.workers.values():
             self.router.send(worker, StartAllreduce(self.round))

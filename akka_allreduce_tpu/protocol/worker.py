@@ -38,6 +38,7 @@ from akka_allreduce_tpu.messages import (
     StartAllreduce,
 )
 from akka_allreduce_tpu.protocol.transport import ActorRef, Router
+from akka_allreduce_tpu.runtime.tracing import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -57,11 +58,12 @@ class AllreduceWorker:
 
     def __init__(self, router: Router, data_source: DataSource,
                  data_sink: DataSink, name: Optional[str] = None,
-                 strict: bool = False):
+                 strict: bool = False, tracer: Optional[Tracer] = None):
         self.router = router
         self.data_source = data_source
         self.data_sink = data_sink
         self.strict = strict
+        self.tracer = tracer
         self.ref = router.register(name or "worker", handler=self.receive)
 
         # Protocol state (reference: AllreduceWorker.scala:10-31)
@@ -189,6 +191,9 @@ class AllreduceWorker:
         # (reference: AllreduceWorker.scala:100-106; pinned by the cold
         # catch-up scenario AllreduceSpec.scala:632-656).
         while self.round < self.max_round - self.max_lag:
+            if self.tracer is not None:
+                self.tracer.record("catchup_force_complete", worker=self.id,
+                                   round=self.round, behind=self.max_round)
             for k in range(self.scatter_block_buf.num_chunks):
                 reduced, count = self.scatter_block_buf.reduce(0, k)
                 self._broadcast(reduced, k, self.round, count)
@@ -210,11 +215,18 @@ class AllreduceWorker:
                 f"scatter for {s.dest_id} incorrectly routed to {self.id}")
         if s.round < self.round or s.round in self.completed:
             log.debug("worker %d: outdated scatter round %d", self.id, s.round)
+            if self.tracer is not None:
+                self.tracer.record("stale_scatter_dropped", worker=self.id,
+                                   round=s.round)
         elif s.round <= self.max_round:
             row = s.round - self.round
             self.scatter_block_buf.store(s.value, row, s.src_id, s.chunk_id)
             if self.scatter_block_buf.reach_reducing_threshold(row, s.chunk_id):
                 reduced, count = self.scatter_block_buf.reduce(row, s.chunk_id)
+                if self.tracer is not None:
+                    self.tracer.record("reduce_fired", worker=self.id,
+                                       round=s.round, chunk=s.chunk_id,
+                                       contributors=count)
                 self._broadcast(reduced, s.chunk_id, s.round, count)
         else:
             # A round we haven't been started for: requeue behind a
@@ -257,6 +269,9 @@ class AllreduceWorker:
                 f"message for {r.dest_id} incorrectly routed to {self.id}")
         if r.round < self.round or r.round in self.completed:
             log.debug("worker %d: outdated reduce round %d", self.id, r.round)
+            if self.tracer is not None:
+                self.tracer.record("stale_reduce_dropped", worker=self.id,
+                                   round=r.round)
         elif r.round <= self.max_round:
             row = r.round - self.round
             self.reduce_block_buf.store(r.value, row, r.src_id, r.chunk_id,
@@ -303,6 +318,9 @@ class AllreduceWorker:
         AllreduceWorker.scala:270-285). Out-of-order completion across rounds
         is legal (pinned by AllreduceSpec.scala:722-732)."""
         self._flush(completed_round, row)
+        if self.tracer is not None:
+            self.tracer.record("round_complete", worker=self.id,
+                               round=completed_round)
         self.data = np.zeros(0, dtype=np.float32)
         if self.master is not None:
             self.router.send(self.master,

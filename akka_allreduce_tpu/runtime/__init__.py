@@ -5,17 +5,35 @@ the ``max_lag`` staleness window, catch-up, membership — lives here for the
 TPU deployment. Devices run ahead asynchronously (JAX dispatch is async);
 the pacer bounds how far, and converts missed deadlines into the masks the
 device plane's lossy collectives consume.
-"""
 
-from akka_allreduce_tpu.runtime.pacer import RoundPacer, RoundClock
-from akka_allreduce_tpu.runtime.coordinator import (
-    initialize_distributed,
-    topology_summary,
-)
+Exports resolve lazily: ``tracing`` is stdlib-only and used by the jax-free
+protocol plane (every `cli master`/`cli worker` subprocess), so importing it
+must not drag in the jax-importing pacer/coordinator modules.
+"""
 
 __all__ = [
     "RoundPacer",
     "RoundClock",
     "initialize_distributed",
     "topology_summary",
+    "Tracer",
+    "TraceEvent",
 ]
+
+_SUBMODULE = {
+    "RoundPacer": "pacer",
+    "RoundClock": "pacer",
+    "initialize_distributed": "coordinator",
+    "topology_summary": "coordinator",
+    "Tracer": "tracing",
+    "TraceEvent": "tracing",
+}
+
+
+def __getattr__(name):
+    if name in _SUBMODULE:
+        import importlib
+        mod = importlib.import_module(
+            f"akka_allreduce_tpu.runtime.{_SUBMODULE[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

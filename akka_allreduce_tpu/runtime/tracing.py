@@ -1,0 +1,140 @@
+"""Structured tracing + metrics for the protocol and runtime planes.
+
+The reference has no tracing subsystem — its observability is ActorLogging
+debug lines on protocol events (reference: AllreduceWorker.scala:119, :131,
+:178) and a wall-clock goodput print in the benchmark sink (reference:
+AllreduceWorker.scala:329-343). This module supplies what SURVEY.md §5.1/§5.5
+flags as absent, designed for the TPU deployment: a cheap, structured,
+host-side event trace that can be aggregated per round, exported as JSONL
+(one object per event — greppable, loadable into pandas), and summarised
+into counters without touching the device hot path (events are recorded
+around collective dispatch, never inside traced/jitted code).
+
+Usage::
+
+    tracer = Tracer()
+    tracer.record("round_start", round=0)
+    with tracer.span("bucket_sync", round=0):
+        ...  # dispatch + block on the collective
+    tracer.counters["round_start"]        # -> 1
+    tracer.round_latencies()              # round -> seconds
+    tracer.write_jsonl("/tmp/trace.jsonl")
+
+Every protocol engine (worker/master) takes an optional ``tracer``; the
+default ``None`` keeps the hot path free of any tracing cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEvent:
+    """One structured event: monotonic timestamp, kind, free-form fields.
+    ``duration_s`` is present only for span-produced events."""
+
+    ts: float
+    kind: str
+    fields: dict[str, Any]
+    duration_s: Optional[float] = None
+
+    def as_dict(self) -> dict[str, Any]:
+        d = {"ts": self.ts, "kind": self.kind, **self.fields}
+        if self.duration_s is not None:
+            d["duration_s"] = self.duration_s
+        return d
+
+
+class Tracer:
+    """Append-only event log + per-kind counters.
+
+    Not thread-safe by design: each host process traces its own protocol
+    engine (one mailbox, one thread — the same safety argument as the
+    reference's actor model, SURVEY.md §5.2).
+    """
+
+    def __init__(self, clock=time.perf_counter, max_events: int = 1_000_000):
+        self._clock = clock
+        self._max_events = max_events
+        self.events: list[TraceEvent] = []
+        self.counters: dict[str, int] = defaultdict(int)
+
+    def record(self, kind: str, **fields: Any) -> TraceEvent:
+        ev = TraceEvent(ts=self._clock(), kind=kind, fields=fields)
+        self._append(ev)
+        return ev
+
+    @contextmanager
+    def span(self, kind: str, **fields: Any):
+        """Time a block; records one event with ``duration_s`` on exit."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            t1 = self._clock()
+            self._append(TraceEvent(ts=t0, kind=kind, fields=fields,
+                                    duration_s=t1 - t0))
+
+    def _append(self, ev: TraceEvent) -> None:
+        self.counters[ev.kind] += 1
+        if len(self.events) < self._max_events:
+            self.events.append(ev)
+
+    # -- aggregation --------------------------------------------------------
+
+    def round_latencies(self, start_kind: str = "round_start",
+                        end_kind: str = "round_complete") -> dict[int, float]:
+        """Per-round wall latency: first ``start_kind`` to last ``end_kind``
+        carrying the same ``round`` field."""
+        starts: dict[int, float] = {}
+        ends: dict[int, float] = {}
+        for ev in self.events:
+            r = ev.fields.get("round")
+            if r is None:
+                continue
+            if ev.kind == start_kind:
+                starts.setdefault(r, ev.ts)
+            elif ev.kind == end_kind:
+                ends[r] = ev.ts
+        return {r: ends[r] - starts[r] for r in starts if r in ends
+                and ends[r] >= starts[r]}
+
+    def span_stats(self, kind: str) -> dict[str, float]:
+        """count / total / mean / max seconds across spans of ``kind``."""
+        ds = [ev.duration_s for ev in self.events
+              if ev.kind == kind and ev.duration_s is not None]
+        if not ds:
+            return {"count": 0, "total_s": 0.0, "mean_s": 0.0, "max_s": 0.0}
+        return {"count": len(ds), "total_s": sum(ds),
+                "mean_s": sum(ds) / len(ds), "max_s": max(ds)}
+
+    def summary(self) -> dict[str, Any]:
+        lat = self.round_latencies()
+        out: dict[str, Any] = {"counters": dict(self.counters),
+                               "events": len(self.events)}
+        if lat:
+            vals = list(lat.values())
+            out["rounds_traced"] = len(vals)
+            out["round_latency_mean_s"] = sum(vals) / len(vals)
+            out["round_latency_max_s"] = max(vals)
+        return out
+
+    # -- export -------------------------------------------------------------
+
+    def write_jsonl(self, path: str) -> int:
+        """One JSON object per line; returns events written."""
+        with open(path, "w") as f:
+            for ev in self.events:
+                f.write(json.dumps(ev.as_dict()) + "\n")
+        return len(self.events)
+
+    @staticmethod
+    def read_jsonl(path: str) -> list[dict[str, Any]]:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]

@@ -1,0 +1,130 @@
+"""Tracing/metrics subsystem tests.
+
+The reference has no tracing (SURVEY.md §5.1); these pin the new subsystem's
+contract: structured events with counters, timed spans, per-round latency
+aggregation, JSONL round-trip, and end-to-end wiring through a live cluster.
+"""
+
+import numpy as np
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    DataConfig,
+    ThresholdConfig,
+    WorkerConfig,
+)
+from akka_allreduce_tpu.protocol.cluster import LocalCluster
+from akka_allreduce_tpu.runtime.tracing import Tracer
+
+
+def make_config(n, data_size, chunk, max_lag=1, max_round=5,
+                th=(1.0, 1.0, 1.0)):
+    return AllreduceConfig(
+        thresholds=ThresholdConfig(*th),
+        data=DataConfig(data_size=data_size, max_chunk_size=chunk,
+                        max_round=max_round),
+        workers=WorkerConfig(total_size=n, max_lag=max_lag),
+    )
+
+
+class TestTracerCore:
+    def test_record_counts_and_orders_events(self):
+        t = Tracer()
+        t.record("a", x=1)
+        t.record("b", x=2)
+        t.record("a", x=3)
+        assert t.counters == {"a": 2, "b": 1}
+        assert [e.kind for e in t.events] == ["a", "b", "a"]
+        assert t.events[2].fields == {"x": 3}
+
+    def test_span_measures_duration(self):
+        clock_vals = iter([10.0, 10.5])
+        t = Tracer(clock=lambda: next(clock_vals))
+        with t.span("work", round=3):
+            pass
+        (ev,) = t.events
+        assert ev.kind == "work"
+        assert ev.duration_s == 0.5
+        assert ev.ts == 10.0
+        assert t.span_stats("work") == {
+            "count": 1, "total_s": 0.5, "mean_s": 0.5, "max_s": 0.5}
+
+    def test_span_records_on_exception(self):
+        t = Tracer()
+        try:
+            with t.span("boom"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+        assert t.counters["boom"] == 1
+
+    def test_round_latency_pairs_start_to_last_complete(self):
+        ts = iter([0.0, 1.0, 2.0, 5.0])
+        t = Tracer(clock=lambda: next(ts))
+        t.record("round_start", round=0)
+        t.record("round_complete", round=0, worker=0)
+        t.record("round_start", round=1)
+        t.record("round_complete", round=1, worker=0)
+        lat = t.round_latencies()
+        assert lat == {0: 1.0, 1: 3.0}
+
+    def test_max_events_cap_keeps_counters(self):
+        t = Tracer(max_events=2)
+        for i in range(5):
+            t.record("e", i=i)
+        assert len(t.events) == 2
+        assert t.counters["e"] == 5
+
+    def test_jsonl_round_trip(self, tmp_path):
+        t = Tracer(clock=lambda: 1.25)
+        t.record("x", round=7, worker=1)
+        with t.span("y", round=7):
+            pass
+        path = str(tmp_path / "trace.jsonl")
+        assert t.write_jsonl(path) == 2
+        rows = Tracer.read_jsonl(path)
+        assert rows[0] == {"ts": 1.25, "kind": "x", "round": 7, "worker": 1}
+        assert rows[1]["kind"] == "y" and "duration_s" in rows[1]
+
+
+class TestClusterTracing:
+    def test_healthy_run_traces_rounds_and_reduces(self):
+        tracer = Tracer()
+        n, rounds = 4, 5
+        cluster = LocalCluster(make_config(n, 64, 16, max_round=rounds),
+                               tracer=tracer)
+        assert cluster.run() == rounds
+
+        # Master plane: quorum formed once, a round_start per paced round
+        # (master emits max_round+1 starts: rounds 0..max_round; the last is
+        # in flight when the pump drains).
+        assert tracer.counters["quorum_init"] == 1
+        assert tracer.counters["member_up"] == n
+        assert tracer.counters["round_start"] >= rounds
+
+        # Data plane: every worker completes every paced round.
+        completes = [e for e in tracer.events if e.kind == "round_complete"]
+        for r in range(rounds):
+            workers = {e.fields["worker"] for e in completes
+                       if e.fields["round"] == r}
+            assert workers == set(range(n)), f"round {r}"
+
+        # Each of 4 chunks per worker per round fires exactly one reduce.
+        fired = [e for e in tracer.events if e.kind == "reduce_fired"]
+        assert all(e.fields["contributors"] == n for e in fired)
+
+        lat = tracer.round_latencies()
+        assert set(range(rounds)) <= set(lat)
+        assert all(v >= 0 for v in lat.values())
+        summary = tracer.summary()
+        assert summary["rounds_traced"] >= rounds
+
+    def test_dead_worker_traced_via_deathwatch(self):
+        tracer = Tracer()
+        cluster = LocalCluster(
+            make_config(4, 64, 16, max_round=3, th=(0.75, 0.75, 0.75)),
+            tracer=tracer)
+        cluster.run(kill_rank=2)
+        dead = [e for e in tracer.events if e.kind == "worker_dead"]
+        assert len(dead) == 1 and dead[0].fields["rank"] == 2
+        assert tracer.counters["round_complete"] > 0
