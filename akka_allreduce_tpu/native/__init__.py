@@ -31,9 +31,13 @@ def build_library(force: bool = False) -> str:
         os.makedirs(os.path.dirname(_SO), exist_ok=True)
         tmp = f"{_SO}.tmp.{os.getpid()}"
         try:
+            # Build through the in-tree Makefile so its CXX/CXXFLAGS
+            # overrides apply on the automatic path too; OUT is redirected
+            # to a per-pid file and atomically renamed so concurrent cold
+            # starts never load a partially-written .so.
             subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
-                 "-pthread", "-shared", "-o", tmp, _SRC],
+                ["make", "-s", "-C", _DIR,
+                 f"OUT={os.path.relpath(tmp, _DIR)}"],
                 check=True, capture_output=True, text=True)
             os.replace(tmp, _SO)
         finally:
@@ -55,7 +59,7 @@ def load_library() -> ctypes.CDLL:
     lib.aat_port.argtypes = [ctypes.c_void_p]
     lib.aat_connect.restype = ctypes.c_int
     lib.aat_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                ctypes.c_int]
+                                ctypes.c_int, ctypes.c_int]
     lib.aat_send.restype = ctypes.c_int
     lib.aat_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
                              ctypes.POINTER(ctypes.c_uint8),

@@ -256,9 +256,11 @@ void* aat_create(const char* bind_host, int port) {
 
 int aat_port(void* tp) { return static_cast<Transport*>(tp)->port; }
 
-// Dial a peer. Blocking connect (local/DCN control plane — latency is fine);
-// returns a peer id >= 0, or -1 on failure.
-int aat_connect(void* tp, const char* host, int port) {
+// Dial a peer with a bounded wait: a dead host must not freeze the
+// single-threaded protocol engine for the kernel's SYN-retry window
+// (~2 min) — the engine's send path reaches here via _ensure_conn.
+// Returns a peer id >= 0, or -1 on failure/timeout.
+int aat_connect(void* tp, const char* host, int port, int timeout_ms) {
   auto* t = static_cast<Transport*>(tp);
   addrinfo hints{}, *res = nullptr;
   hints.ai_family = AF_INET;
@@ -272,13 +274,26 @@ int aat_connect(void* tp, const char* host, int port) {
     freeaddrinfo(res);
     return -1;
   }
-  if (connect(fd, res->ai_addr, res->ai_addrlen) < 0) {
-    freeaddrinfo(res);
-    close(fd);
-    return -1;
-  }
-  freeaddrinfo(res);
   set_nonblocking(fd);
+  int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc < 0) {
+    if (errno != EINPROGRESS) {
+      close(fd);
+      return -1;
+    }
+    pollfd p{fd, POLLOUT, 0};
+    if (poll(&p, 1, timeout_ms) <= 0) {  // timeout or poll error
+      close(fd);
+      return -1;
+    }
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) < 0 || err != 0) {
+      close(fd);
+      return -1;
+    }
+  }
   set_nodelay(fd);
   int peer;
   {

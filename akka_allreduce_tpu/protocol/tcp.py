@@ -49,8 +49,10 @@ class TcpRouter:
     def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
                  advertise_host: Optional[str] = None, role: str = "worker",
                  on_member: Optional[Callable[[RemoteRef, str], None]] = None,
-                 on_terminated: Optional[Callable[[RemoteRef], None]] = None):
+                 on_terminated: Optional[Callable[[RemoteRef], None]] = None,
+                 connect_timeout_s: float = 10.0):
         self._lib = load_library()
+        self._connect_timeout_ms = int(connect_timeout_s * 1000)
         self._t = self._lib.aat_create(bind_host.encode(), port)
         if not self._t:
             raise OSError(f"cannot bind TCP transport on {bind_host}:{port}")
@@ -116,7 +118,8 @@ class TcpRouter:
         conn = self._conn_of.get(addr)
         if conn is not None:
             return conn
-        conn = self._lib.aat_connect(self._t, addr[0].encode(), addr[1])
+        conn = self._lib.aat_connect(self._t, addr[0].encode(), addr[1],
+                                     self._connect_timeout_ms)
         if conn < 0:
             return None
         self._conn_of[addr] = conn
@@ -178,7 +181,10 @@ class TcpRouter:
             if got < 0:
                 return n
             try:
-                msg = wire.decode(bytes(self._recv_buf[:got]), self.ref_of)
+                # string_at is one C memcpy; slicing the ctypes array would
+                # materialize a per-byte Python int list on the hot path.
+                msg = wire.decode(ctypes.string_at(self._recv_buf, got),
+                                  self.ref_of)
             except Exception:
                 # One malformed frame must not kill the whole event loop:
                 # dead-letter it, like Akka dropping undeserializable mail.
