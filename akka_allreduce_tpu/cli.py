@@ -168,6 +168,11 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                    help="global sequence (0 = 32 per sp rank)")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--bucket-elems", type=int, default=1 << 16)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint directory; resumes from the latest "
+                        "checkpoint if one exists")
+    p.add_argument("--ckpt-every", type=int, default=10,
+                   help="save interval in steps")
 
 
 def _cmd_train(args: argparse.Namespace) -> int:
@@ -198,23 +203,53 @@ def _cmd_train(args: argparse.Namespace) -> int:
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
     step = make_train_step(cfg, mesh, opt)
 
-    rng = np.random.default_rng(0)
+    start = 0
+    mgr = None
+    if args.ckpt_dir:
+        from akka_allreduce_tpu.runtime.checkpoint import (CheckpointConfig,
+                                                           restore_or_init)
+        start, params, opt_state, extra, mgr = restore_or_init(
+            CheckpointConfig(args.ckpt_dir,
+                             save_interval_steps=args.ckpt_every),
+            params, opt_state)
+        if start:
+            print(f"resumed from step {start - 1} "
+                  f"(data position {extra.get('data_step', '?')})")
+
     print(f"mesh dp={dp} tp={args.tp} sp={args.sp}; batch={b} seq={t}")
     tic = time.perf_counter()
     steps_in_window = 0
-    for i in range(args.steps):
-        tokens = jnp.asarray(rng.integers(0, args.vocab, size=(b, t),
-                                          dtype=np.int32))
-        params, opt_state, metrics = step(params, opt_state, tokens)
-        steps_in_window += 1
-        if i == 0 or (i + 1) % 10 == 0:
-            loss = float(jax.block_until_ready(metrics["loss"]))
-            toks = float(metrics["tokens"])
-            dt = time.perf_counter() - tic
-            print(f"step {i + 1:4d}: loss {loss:.4f} "
-                  f"({toks * steps_in_window / dt:.0f} tok/s)")
-            tic = time.perf_counter()
-            steps_in_window = 0
+    try:
+        for i in range(start, args.steps):
+            # deterministic per-step data stream: a resumed run sees the
+            # same tokens the dead run would have
+            step_rng = np.random.default_rng(i)
+            tokens = jnp.asarray(step_rng.integers(0, args.vocab,
+                                                   size=(b, t),
+                                                   dtype=np.int32))
+            params, opt_state, metrics = step(params, opt_state, tokens)
+            if mgr is not None:
+                mgr.maybe_save(i, params, opt_state, {"data_step": i})
+            steps_in_window += 1
+            if i == start or (i + 1) % 10 == 0:
+                loss = float(jax.block_until_ready(metrics["loss"]))
+                toks = float(metrics["tokens"])
+                dt = time.perf_counter() - tic
+                print(f"step {i + 1:4d}: loss {loss:.4f} "
+                      f"({toks * steps_in_window / dt:.0f} tok/s)")
+                tic = time.perf_counter()
+                steps_in_window = 0
+        if mgr is not None:
+            final = args.steps - 1
+            if args.steps > start and mgr.latest_step() != final:
+                mgr.save(final, params, opt_state,
+                         {"data_step": final}, force=True)
+    finally:
+        # Preemption/SIGINT is this feature's target scenario: always let
+        # an in-flight async save land before the process dies.
+        if mgr is not None:
+            mgr.wait_until_finished()
+            mgr.close()
     return 0
 
 

@@ -25,8 +25,10 @@ def build_library(force: bool = False) -> str:
     Concurrent-process safe: compiles to a per-pid temp file and atomically
     renames, so simultaneous cold starts (the multi-process cluster) never
     load a partially-written .so. Returns the .so path."""
+    makefile = os.path.join(_DIR, "Makefile")
+    src_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(makefile))
     stale = (not os.path.exists(_SO)
-             or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+             or os.path.getmtime(_SO) < src_mtime)
     if force or stale:
         os.makedirs(os.path.dirname(_SO), exist_ok=True)
         tmp = f"{_SO}.tmp.{os.getpid()}"

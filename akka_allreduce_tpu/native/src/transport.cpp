@@ -278,14 +278,22 @@ int aat_connect(void* tp, const char* host, int port, int timeout_ms) {
   int rc = connect(fd, res->ai_addr, res->ai_addrlen);
   freeaddrinfo(res);
   if (rc < 0) {
-    if (errno != EINPROGRESS) {
+    // EINTR: the connect still proceeds asynchronously (POSIX) — wait for
+    // it like EINPROGRESS so a stray signal can't fail a healthy dial.
+    if (errno != EINPROGRESS && errno != EINTR) {
       close(fd);
       return -1;
     }
-    pollfd p{fd, POLLOUT, 0};
-    if (poll(&p, 1, timeout_ms) <= 0) {  // timeout or poll error
-      close(fd);
-      return -1;
+    for (;;) {
+      pollfd p{fd, POLLOUT, 0};
+      int pr = poll(&p, 1, timeout_ms);
+      if (pr > 0) break;
+      if (pr == 0 || errno != EINTR) {  // timeout or real poll error
+        close(fd);
+        return -1;
+      }
+      // EINTR: re-poll. timeout_ms is an upper bound per wait, which is
+      // fine — signals only ever shorten the elapsed slice.
     }
     int err = 0;
     socklen_t elen = sizeof(err);

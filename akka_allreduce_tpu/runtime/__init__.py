@@ -18,6 +18,9 @@ __all__ = [
     "topology_summary",
     "Tracer",
     "TraceEvent",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "restore_or_init",
 ]
 
 _SUBMODULE = {
@@ -27,6 +30,9 @@ _SUBMODULE = {
     "topology_summary": "coordinator",
     "Tracer": "tracing",
     "TraceEvent": "tracing",
+    "CheckpointConfig": "checkpoint",
+    "CheckpointManager": "checkpoint",
+    "restore_or_init": "checkpoint",
 }
 
 
