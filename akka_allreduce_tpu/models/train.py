@@ -86,20 +86,25 @@ def make_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh
     full = init_transformer(key, cfg.model, tp=tp)
     params = shard_params(full, param_specs(cfg.model), mesh)
     opt = optax.adamw(cfg.learning_rate)
-    # opt.init under jit places every leaf on a single device; re-place the
-    # state explicitly: param-shaped leaves (adam moments — 2x param memory)
-    # adopt their parameter's Megatron sharding, scalar bookkeeping (step
-    # count) replicates over the mesh. A uniformly mesh-resident state is
-    # also required for it to serve as a checkpoint-restore template
-    # (runtime/checkpoint.py restores onto template shardings).
-    opt_state = jax.jit(opt.init)(params)
+    opt_state = place_opt_state(opt, jax.jit(opt.init)(params), params, mesh)
+    return params, opt_state, opt
+
+
+def place_opt_state(opt: optax.GradientTransformation, opt_state: Any,
+                    params: Any, mesh: Mesh) -> Any:
+    """Place optimizer state on the mesh: param-shaped leaves (adam moments
+    — 2x param memory) adopt their parameter's Megatron sharding, scalar
+    bookkeeping (step count) replicates. Needed after init (opt.init under
+    jit lands every leaf on one device) and after an elastic mesh
+    re-formation (runtime/elastic.py); a uniformly mesh-resident state is
+    also what checkpoint restore uses as its sharding template
+    (runtime/checkpoint.py)."""
     replicated = NamedSharding(mesh, P())
-    opt_state = optax.tree_map_params(
+    return optax.tree_map_params(
         opt,
         lambda s, p: jax.device_put(s, p.sharding),
         opt_state, params,
         transform_non_params=lambda x: jax.device_put(x, replicated))
-    return params, opt_state, opt
 
 
 def make_grad_step(cfg: TrainConfig, mesh: Mesh,

@@ -25,6 +25,7 @@
 #include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <cstdint>
@@ -284,16 +285,28 @@ int aat_connect(void* tp, const char* host, int port, int timeout_ms) {
       close(fd);
       return -1;
     }
+    // Deadline-based wait: an EINTR re-poll gets only the REMAINING time,
+    // so periodic signals (profilers, timers) cannot extend the bound.
+    timespec t0{};
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    int64_t deadline_ms = int64_t(t0.tv_sec) * 1000 + t0.tv_nsec / 1000000
+                          + timeout_ms;
     for (;;) {
+      timespec now{};
+      clock_gettime(CLOCK_MONOTONIC, &now);
+      int64_t remaining = deadline_ms - (int64_t(now.tv_sec) * 1000
+                                         + now.tv_nsec / 1000000);
+      if (remaining <= 0) {
+        close(fd);
+        return -1;
+      }
       pollfd p{fd, POLLOUT, 0};
-      int pr = poll(&p, 1, timeout_ms);
+      int pr = poll(&p, 1, static_cast<int>(remaining));
       if (pr > 0) break;
       if (pr == 0 || errno != EINTR) {  // timeout or real poll error
         close(fd);
         return -1;
       }
-      // EINTR: re-poll. timeout_ms is an upper bound per wait, which is
-      // fine — signals only ever shorten the elapsed slice.
     }
     int err = 0;
     socklen_t elen = sizeof(err);

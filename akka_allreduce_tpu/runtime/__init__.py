@@ -21,6 +21,11 @@ __all__ = [
     "CheckpointConfig",
     "CheckpointManager",
     "restore_or_init",
+    "QuorumTracker",
+    "ElasticController",
+    "shrink_spec",
+    "reform_mesh",
+    "reshard",
 ]
 
 _SUBMODULE = {
@@ -33,6 +38,11 @@ _SUBMODULE = {
     "CheckpointConfig": "checkpoint",
     "CheckpointManager": "checkpoint",
     "restore_or_init": "checkpoint",
+    "QuorumTracker": "elastic",
+    "ElasticController": "elastic",
+    "shrink_spec": "elastic",
+    "reform_mesh": "elastic",
+    "reshard": "elastic",
 }
 
 

@@ -140,8 +140,12 @@ def restore_or_init(config: CheckpointConfig, params: Any, opt_state: Any
     latest checkpoint onto the given (sharded) state or keep the fresh
     init. Returns (next_step, params, opt_state, extra, manager)."""
     mgr = CheckpointManager(config)
-    step = mgr.latest_step()
-    if step is None:
-        return 0, params, opt_state, {}, mgr
-    step, params, opt_state, extra = mgr.restore(params, opt_state, step)
+    try:
+        step = mgr.latest_step()
+        if step is None:
+            return 0, params, opt_state, {}, mgr
+        step, params, opt_state, extra = mgr.restore(params, opt_state, step)
+    except BaseException:
+        mgr.close()  # don't leak orbax's async machinery on a bad restore
+        raise
     return step + 1, params, opt_state, extra, mgr

@@ -1,0 +1,186 @@
+"""Elastic recovery: quorum tracking, mesh re-formation, state resharding.
+
+The reference's fault story ends at *tolerating* a dead peer inside a run:
+deathwatch shrinks the peer map (reference: AllreduceMaster.scala:46-52;
+AllreduceWorker.scala:141-146) and thresholds let rounds complete without
+the missing contributions — but ranks are never reassigned, the group never
+re-forms, and a recovered worker can only rejoin through the documented
+rank-collision quirk (reference: AllreduceMaster.scala:71; SURVEY.md §3a.10,
+§5.3). This module supplies the re-formation half for the TPU deployment:
+
+* :class:`QuorumTracker` — membership bookkeeping with the reference's
+  ``thAllreduce``-style fraction deciding whether the surviving group may
+  continue (reference: AllreduceMaster.scala:58), plus a **generation**
+  counter: every loss/join bumps it, and stale work from an older
+  generation is discarded the same way stale rounds are
+  (reference: AllreduceWorker.scala:155).
+* :func:`shrink_spec` — given a mesh layout and the surviving device count,
+  choose the new layout: model axes (tp/sp/ep) are load-bearing (losing
+  one loses the sharded model state) so they are preserved; dp absorbs the
+  loss, dropping incomplete data-parallel replicas.
+* :func:`reform_mesh` / :func:`reshard` — rebuild the Mesh over surviving
+  devices and move live state onto it (values preserved; XLA handles the
+  device-to-device transfer).
+* :class:`ElasticController` — ties the three to the deathwatch/member-up
+  signals, the driver loop a TPU-VM preemption handler calls into.
+
+In a real pod, "surviving devices" comes from re-initialising the JAX
+distributed runtime after the coordinator notices the lost host
+(runtime/coordinator.py); these mechanics are identical from 8 virtual CPU
+devices, which is how the tests drive them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+
+log = logging.getLogger(__name__)
+
+
+class QuorumTracker:
+    """Membership + generation bookkeeping.
+
+    ``min_fraction`` plays the reference's ``thAllreduce`` role at the
+    membership level: the group may continue while
+    ``len(alive) >= ceil(min_fraction * total)``.
+    """
+
+    def __init__(self, total: int, min_fraction: float = 0.5):
+        if not 0.0 < min_fraction <= 1.0:
+            raise ValueError(f"min_fraction {min_fraction} not in (0, 1]")
+        self.total = total
+        self.min_fraction = min_fraction
+        self.alive: set[int] = set()
+        self.generation = 0
+
+    @property
+    def min_quorum(self) -> int:
+        # round() before ceil: IEEE noise (0.55*100 == 55.000000000000006)
+        # must not demand one more survivor than the fraction implies.
+        return max(1, math.ceil(round(self.min_fraction * self.total, 9)))
+
+    def member_up(self, rank: int) -> None:
+        if rank not in self.alive:
+            self.alive.add(rank)
+            self.generation += 1
+
+    def member_lost(self, rank: int) -> None:
+        if rank in self.alive:
+            self.alive.remove(rank)
+            self.generation += 1
+
+    def quorum_ok(self) -> bool:
+        return len(self.alive) >= self.min_quorum
+
+    def is_current(self, generation: int) -> bool:
+        """Work tagged with an older generation is stale — the group it was
+        computed for no longer exists (the membership analogue of dropping
+        stale rounds, reference: AllreduceWorker.scala:155)."""
+        return generation == self.generation
+
+
+def shrink_spec(spec: MeshSpec, n_devices: int) -> MeshSpec:
+    """The largest layout fitting ``n_devices`` that preserves the model
+    axes (tp/sp/ep) and shrinks dp — dropping incomplete dp replicas.
+
+    Raises if not even one full model replica survives (tp*sp*ep devices):
+    at that point the sharded model state is genuinely lost and only a
+    checkpoint restore (runtime/checkpoint.py) can recover.
+    """
+    model_devices = spec.tp * spec.sp * spec.ep
+    new_dp = n_devices // model_devices
+    if new_dp < 1:
+        raise RuntimeError(
+            f"unrecoverable: {n_devices} surviving devices cannot hold one "
+            f"model replica of tp*sp*ep = {model_devices}; restore from "
+            f"checkpoint on a fresh slice")
+    return dataclasses.replace(spec, dp=new_dp)
+
+
+def reform_mesh(spec: MeshSpec,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Mesh over the surviving devices with the (possibly shrunk) spec.
+    Devices beyond ``spec.size`` are left idle (incomplete replica)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < spec.size:
+        raise ValueError(
+            f"spec {spec} needs {spec.size} devices, have {len(devices)}")
+    return make_device_mesh(spec, devices=devices[:spec.size])
+
+
+def reshard(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Move live state onto ``mesh`` with per-leaf PartitionSpecs (same
+    contract as models/train.py's shard_params). Values are preserved —
+    only placement changes."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+class ElasticController:
+    """Drives recovery from membership churn.
+
+    ``on_reform(mesh, generation)`` fires after every successful
+    re-formation — the caller re-jits its step functions over the new mesh
+    and re-shards state via :func:`reshard`. While quorum is lost the
+    controller parks (``parked`` True) and the caller should idle/await
+    checkpoint restore rather than step.
+    """
+
+    def __init__(self, spec: MeshSpec, total_hosts: int,
+                 devices_per_host: int, min_fraction: float = 0.5,
+                 on_reform: Optional[Callable[[Mesh, int], None]] = None):
+        self.spec = spec
+        self.devices_per_host = devices_per_host
+        self.tracker = QuorumTracker(total_hosts, min_fraction)
+        self.on_reform = on_reform
+        self.mesh: Optional[Mesh] = None
+        self.parked = False
+
+    def _surviving_devices(self, all_devices: Sequence[jax.Device]
+                           ) -> list[jax.Device]:
+        """Devices of alive hosts, in rank order (host r owns the
+        contiguous block [r*dph, (r+1)*dph) — TPU topology order)."""
+        dph = self.devices_per_host
+        out: list[jax.Device] = []
+        for rank in sorted(self.tracker.alive):
+            out.extend(all_devices[rank * dph:(rank + 1) * dph])
+        return out
+
+    def handle_member_up(self, rank: int,
+                         all_devices: Sequence[jax.Device]) -> Optional[Mesh]:
+        self.tracker.member_up(rank)
+        return self._reform(all_devices)
+
+    def handle_member_lost(self, rank: int,
+                           all_devices: Sequence[jax.Device]
+                           ) -> Optional[Mesh]:
+        self.tracker.member_lost(rank)
+        return self._reform(all_devices)
+
+    def _reform(self, all_devices: Sequence[jax.Device]) -> Optional[Mesh]:
+        if not self.tracker.quorum_ok():
+            log.warning("elastic: quorum lost (%d/%d alive < %d) — parked",
+                        len(self.tracker.alive), self.tracker.total,
+                        self.tracker.min_quorum)
+            self.parked = True
+            self.mesh = None
+            return None
+        survivors = self._surviving_devices(all_devices)
+        new_spec = shrink_spec(self.spec, len(survivors))
+        self.mesh = reform_mesh(new_spec, survivors)
+        self.parked = False
+        log.info("elastic: generation %d, mesh %s over %d devices",
+                 self.tracker.generation, new_spec, new_spec.size)
+        if self.on_reform is not None:
+            self.on_reform(self.mesh, self.tracker.generation)
+        return self.mesh

@@ -1,0 +1,157 @@
+"""Elastic recovery tests: quorum, mesh re-formation, reshard-and-continue.
+
+The reference only *tolerates* loss inside a run (deathwatch + thresholds,
+SURVEY.md §5.3); these pin the recovery half the TPU build adds: a host dies,
+the mesh re-forms over survivors with model axes preserved, live state
+reshards onto it, and training continues — then the host rejoins and the
+group regrows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.train import (
+    TrainConfig,
+    make_train_state,
+    make_train_step,
+    param_specs,
+)
+from akka_allreduce_tpu.models.transformer import TransformerConfig
+from akka_allreduce_tpu.parallel.mesh import MeshSpec
+from akka_allreduce_tpu.runtime.elastic import (
+    ElasticController,
+    QuorumTracker,
+    reform_mesh,
+    reshard,
+    shrink_spec,
+)
+
+
+class TestQuorumTracker:
+    def test_membership_and_generation(self):
+        q = QuorumTracker(total=4, min_fraction=0.5)
+        for r in range(4):
+            q.member_up(r)
+        assert q.generation == 4 and q.quorum_ok()
+        gen = q.generation
+        q.member_lost(2)
+        assert q.generation == gen + 1
+        assert not q.is_current(gen)  # pre-loss work is stale
+        q.member_lost(2)  # idempotent: no double-bump
+        assert q.generation == gen + 1
+
+    def test_quorum_threshold(self):
+        q = QuorumTracker(total=4, min_fraction=0.75)
+        assert q.min_quorum == 3
+        for r in range(4):
+            q.member_up(r)
+        q.member_lost(0)
+        assert q.quorum_ok()       # 3/4 alive
+        q.member_lost(1)
+        assert not q.quorum_ok()   # 2/4 < ceil(0.75*4)
+        q.member_up(1)             # rejoin restores quorum
+        assert q.quorum_ok()
+
+    def test_invalid_fraction_rejected(self):
+        with pytest.raises(ValueError):
+            QuorumTracker(total=4, min_fraction=0.0)
+
+
+class TestShrinkSpec:
+    def test_dp_absorbs_loss_model_axes_preserved(self):
+        spec = MeshSpec(dp=4, tp=2, sp=1)
+        new = shrink_spec(spec, 6)  # lost 2 of 8 devices
+        assert (new.dp, new.tp, new.sp) == (3, 2, 1)
+
+    def test_incomplete_replica_dropped(self):
+        new = shrink_spec(MeshSpec(dp=2, tp=4), 7)  # 7//4 = 1 full replica
+        assert (new.dp, new.tp) == (1, 4)
+
+    def test_unrecoverable_raises(self):
+        with pytest.raises(RuntimeError, match="checkpoint"):
+            shrink_spec(MeshSpec(dp=2, tp=4), 3)
+
+
+class TestReshardAndContinue:
+    def test_lose_host_reshard_keep_training(self):
+        """dp=4 x tp=2 over 8 devices; host owning devices 2-3 dies ->
+        dp=3 x tp=2 over the 6 survivors; params/opt reshard value-exact;
+        the re-jitted step keeps training."""
+        mcfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                 n_layers=2, d_ff=64, max_seq=16)
+        cfg = TrainConfig(model=mcfg, learning_rate=1e-2, bucket_elems=256,
+                          grad_axes=("dp",))
+        spec = MeshSpec(dp=4, tp=2)
+        mesh = reform_mesh(spec)
+        params, opt_state, opt = make_train_state(jax.random.key(0), cfg,
+                                                  mesh)
+        step = make_train_step(cfg, mesh, opt)
+        tokens = jnp.asarray(np.random.default_rng(0).integers(
+            0, 64, size=(8, 16), dtype=np.int32))
+        params, opt_state, m0 = step(params, opt_state, tokens)
+
+        # Host 1 (devices 2-3) dies.
+        all_devices = jax.devices()
+        survivors = all_devices[:2] + all_devices[4:]
+        new_spec = shrink_spec(spec, len(survivors))
+        assert (new_spec.dp, new_spec.tp) == (3, 2)
+        new_mesh = reform_mesh(new_spec, survivors)
+
+        specs = param_specs(mcfg)
+        before = [np.asarray(x) for x in jax.tree.leaves(params)]
+        params2 = reshard(params, specs, new_mesh)
+        after = [np.asarray(x) for x in jax.tree.leaves(params2)]
+        for b, a in zip(before, after):
+            np.testing.assert_array_equal(b, a)
+
+        # opt state reshards with the same per-parameter layout
+        from akka_allreduce_tpu.models.train import place_opt_state
+        opt_state2 = place_opt_state(opt, opt_state, params2, new_mesh)
+
+        step2 = make_train_step(cfg, new_mesh, opt)
+        tokens2 = jnp.asarray(np.random.default_rng(1).integers(
+            0, 64, size=(6, 16), dtype=np.int32))  # batch follows dp 4->3
+        params2, opt_state2, m1 = step2(params2, opt_state2, tokens2)
+        assert np.isfinite(float(m1["loss"]))
+
+    def test_controller_full_cycle(self):
+        """4 hosts x 2 devices each: up -> lose one -> shrink -> rejoin ->
+        regrow, with the reform callback seeing each generation."""
+        reforms = []
+        ctl = ElasticController(
+            MeshSpec(dp=4, tp=2), total_hosts=4, devices_per_host=2,
+            min_fraction=0.5,
+            on_reform=lambda mesh, gen: reforms.append(
+                (gen, dict(mesh.shape))))
+        devs = jax.devices()
+        for r in range(4):
+            ctl.handle_member_up(r, devs)
+        assert ctl.mesh is not None and ctl.mesh.shape["dp"] == 4
+
+        mesh = ctl.handle_member_lost(1, devs)
+        assert mesh.shape["dp"] == 3 and not ctl.parked
+        # survivors exclude host 1's devices
+        assert set(mesh.devices.flat) == set(devs[:2] + devs[4:])
+
+        mesh = ctl.handle_member_up(1, devs)
+        assert mesh.shape["dp"] == 4
+        assert reforms[-1][1]["dp"] == 4
+        gens = [g for g, _ in reforms]
+        assert gens == sorted(gens) and len(set(gens)) == len(gens)
+
+    def test_controller_parks_without_quorum(self):
+        ctl = ElasticController(
+            MeshSpec(dp=4, tp=2), total_hosts=4, devices_per_host=2,
+            min_fraction=0.75)
+        devs = jax.devices()
+        for r in range(4):
+            ctl.handle_member_up(r, devs)
+        ctl.handle_member_lost(0, devs)
+        assert not ctl.parked          # 3/4 >= ceil(0.75*4)
+        out = ctl.handle_member_lost(1, devs)
+        assert out is None and ctl.parked and ctl.mesh is None
+        # rejoin un-parks
+        mesh = ctl.handle_member_up(0, devs)
+        assert mesh is not None and not ctl.parked
