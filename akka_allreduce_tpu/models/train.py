@@ -39,9 +39,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
     init_transformer,
-    next_token_loss,
+    next_token_loss_and_aux,
 )
 from akka_allreduce_tpu.parallel.dp import GradSyncConfig, allreduce_gradients
+from akka_allreduce_tpu.parallel.mesh import place_tree
 from akka_allreduce_tpu.parallel.ring_attention import ring_attention, \
     local_causal_attention
 from akka_allreduce_tpu.utils.vma import psum_all
@@ -57,32 +58,64 @@ class TrainConfig:
 
 def param_specs(cfg: TransformerConfig) -> dict:
     """PartitionSpec per parameter leaf: QKV/FF1 column-sharded over tp,
-    WO/FF2 row-sharded, the rest replicated (Megatron layout)."""
-    layer = {
+    WO/FF2 row-sharded, the rest replicated (Megatron layout). MoE layers:
+    expert weights sharded over ep (leading expert dim), router replicated
+    (the expert FF itself is replicated across tp — see transformer_block)."""
+    attn = {
         "ln1": P(), "ln2": P(),
         "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
         "wo": P("tp", None),
-        "w1": P(None, "tp"), "w2": P("tp", None),
     }
+    dense_ff = {"w1": P(None, "tp"), "w2": P("tp", None)}
+    moe_ff = {"router": P(), "we1": P("ep", None, None),
+              "we2": P("ep", None, None)}
     return {
         "embed": P(), "pos": P(), "out_norm": P(), "lm_head": P(),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": [
+            {**attn, **(moe_ff if cfg.is_moe_layer(i) else dense_ff)}
+            for i in range(cfg.n_layers)
+        ],
     }
 
 
 def shard_params(params: Any, specs: Any, mesh: Mesh) -> Any:
     """Place a host-initialised full parameter tree onto the mesh with the
     given per-leaf specs."""
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs,
-        is_leaf=lambda x: isinstance(x, P))
+    return place_tree(params, specs, mesh)
+
+
+def split_expert_leaves(grads: dict) -> tuple[dict, list]:
+    """Partition a gradient tree into (dense, expert): expert leaves (we1 /
+    we2) are ep-rank-OWNED — each ep rank holds different experts — so they
+    must not be reduced over ep, while everything else (router included) is
+    replicated over ep and must be. The reference's analogue: a worker only
+    reduces the block it owns (reference: AllreduceWorker.scala:240-250)."""
+    dense = dict(grads)
+    dense_layers, expert_layers = [], []
+    for lyr in grads["layers"]:
+        lyr = dict(lyr)
+        expert_layers.append(
+            {k: lyr.pop(k) for k in ("we1", "we2") if k in lyr})
+        dense_layers.append(lyr)
+    dense["layers"] = dense_layers
+    return dense, expert_layers
+
+
+def merge_expert_leaves(dense: dict, expert_layers: list) -> dict:
+    out = dict(dense)
+    out["layers"] = [{**lyr, **ex}
+                     for lyr, ex in zip(dense["layers"], expert_layers)]
+    return out
 
 
 def make_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh
                      ) -> tuple[Any, Any, optax.GradientTransformation]:
     """Init (sharded params, congruently-sharded opt state, optimizer)."""
     tp = mesh.shape.get("tp", 1)
+    ep = mesh.shape.get("ep", 1)
+    if cfg.model.moe is not None and cfg.model.moe.n_experts % ep:
+        raise ValueError(f"ep={ep} must divide "
+                         f"n_experts={cfg.model.moe.n_experts}")
     full = init_transformer(key, cfg.model, tp=tp)
     params = shard_params(full, param_specs(cfg.model), mesh)
     opt = optax.adamw(cfg.learning_rate)
@@ -111,16 +144,28 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                    valid_buckets: Optional[jnp.ndarray] = None):
     """The rank-local core under shard_map: loss, backprop, bucketed
     gradient sync. Returns ``grad_step(params, tokens) -> (synced_grads,
-    metrics)``; tokens (B_global, T_global) int32 sharded (dp, sp)."""
+    metrics)``; tokens (B_global, T_global) int32, batch sharded over
+    (dp, ep) — ep doubles as a data axis — and sequence over sp."""
     mcfg = cfg.model
     specs = param_specs(mcfg)
     has_sp = mesh.shape.get("sp", 1) > 1
     has_tp = mesh.shape.get("tp", 1) > 1
+    has_ep = mesh.shape.get("ep", 1) > 1
     tp_axis = "tp" if has_tp else None
-    n_grad_ranks = math.prod(mesh.shape.get(a, 1) for a in cfg.grad_axes)
+    ep_axis = "ep" if has_ep else None
+    has_moe = mcfg.moe is not None
+    # ep doubles as a data axis (batch sharded over dp x ep): dense params
+    # are replicated over it and their grads reduce over it; expert weights
+    # are ep-OWNED and reduce over the plain data axes only.
+    dense_axes = cfg.grad_axes + (("ep",) if has_ep else ())
+    n_dense_ranks = math.prod(mesh.shape.get(a, 1) for a in dense_axes)
+    n_expert_ranks = math.prod(mesh.shape.get(a, 1) for a in cfg.grad_axes)
     gcfg = GradSyncConfig(bucket_elems=cfg.bucket_elems,
-                          axis_name=cfg.grad_axes, average=True,
-                          rescale_target=float(n_grad_ranks))
+                          axis_name=dense_axes, average=True,
+                          rescale_target=float(n_dense_ranks))
+    gcfg_expert = GradSyncConfig(bucket_elems=cfg.bucket_elems,
+                                 axis_name=cfg.grad_axes, average=True,
+                                 rescale_target=float(n_expert_ranks))
 
     def targets_and_weights(tokens):
         """Per-token next-token targets and loss weights; under sp the
@@ -149,38 +194,56 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
     def grad_local(params, tokens):
         targets, weights, positions = targets_and_weights(tokens)
-        total_count = psum_all(weights.sum(), cfg.grad_axes)
+        total_count = psum_all(weights.sum(), dense_axes)
 
         def loss_fn(p):
-            loss_sum, _ = next_token_loss(
-                p, tokens, mcfg, positions, attn, tp_axis,
+            loss_sum, _, aux = next_token_loss_and_aux(
+                p, tokens, mcfg, positions, attn, tp_axis, ep_axis,
                 targets=targets, weights=weights)
             # exact global-mean scaling: psum of these local losses (and of
             # their grads) is the global mean loss (and its gradient)
-            return loss_sum / total_count
+            return loss_sum / total_count, aux
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        # Gradient sync over (dp, sp): the framework's bucketed, counted
-        # collective — THE allreduce the reference exists for. Gradients
-        # for tp shards need no sync (tp_grad_boundary completed them in
-        # the backward pass); the data axes are ours alone to reduce —
-        # which is the point: sync policy (masks, counts, lossy rounds)
-        # stays in framework hands, not autodiff's.
-        res = allreduce_gradients(grads, gcfg, valid=valid_buckets)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        # Gradient sync over the data axes: the framework's bucketed,
+        # counted collective — THE allreduce the reference exists for.
+        # Gradients for tp shards need no sync (tp_grad_boundary completed
+        # them in the backward pass); the data axes are ours alone to
+        # reduce — which is the point: sync policy (masks, counts, lossy
+        # rounds) stays in framework hands, not autodiff's. Expert weights
+        # sync separately: they are ep-owned, so ep is not a data axis for
+        # them (split_expert_leaves).
+        if has_moe:
+            dense, expert = split_expert_leaves(grads)
+            res = allreduce_gradients(dense, gcfg, valid=valid_buckets)
+            res_e = allreduce_gradients(expert, gcfg_expert)
+            grads_out = merge_expert_leaves(res.grads, res_e.grads)
+            min_count = jnp.minimum(res.bucket_counts.min(),
+                                    res_e.bucket_counts.min())
+        else:
+            res = allreduce_gradients(grads, gcfg, valid=valid_buckets)
+            grads_out = res.grads
+            min_count = res.bucket_counts.min()
         metrics = {
-            "loss": psum_all(loss, cfg.grad_axes),
+            "loss": psum_all(loss, dense_axes),
             "tokens": total_count,
-            "min_bucket_count": res.bucket_counts.min(),
+            "min_bucket_count": min_count,
+            "aux_loss": psum_all(aux["aux_loss"], dense_axes)
+            / n_dense_ranks,
+            "dispatch_fraction": psum_all(aux["dispatch_fraction"],
+                                          dense_axes) / n_dense_ranks,
         }
-        return res.grads, metrics
+        return grads_out, metrics
 
     # check_vma=False: varying-axis tracking would auto-insert psums over
     # the data axes in the backward pass (pvary transpose), taking gradient
     # sync out of the framework's hands — the explicit Megatron boundary
     # (parallel/tp.py) plus allreduce_gradients carry it instead.
+    batch_axes = ("dp", "ep") if "ep" in mesh.shape else "dp"
     return jax.shard_map(
         grad_local, mesh=mesh,
-        in_specs=(specs, P("dp", "sp")),
+        in_specs=(specs, P(batch_axes, "sp")),
         out_specs=(specs, P()),
         check_vma=False,
     )

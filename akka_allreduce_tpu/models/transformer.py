@@ -23,6 +23,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from akka_allreduce_tpu.parallel.ep import MoEConfig, init_moe_layer, moe_ffn
 from akka_allreduce_tpu.parallel.ring_attention import local_causal_attention
 from akka_allreduce_tpu.parallel.tp import column_parallel_dense, \
     row_parallel_dense, tp_grad_boundary
@@ -37,10 +38,18 @@ class TransformerConfig:
     d_ff: int = 512
     max_seq: int = 128
     dtype: object = jnp.float32
+    # Mixture-of-experts: when ``moe`` is set, every ``moe_every``-th layer
+    # (1-indexed: layers i with (i+1) % moe_every == 0) replaces its dense
+    # FF with a routed expert FF (parallel/ep.py). moe_every=1 => all layers.
+    moe: Optional[MoEConfig] = None
+    moe_every: int = 1
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.moe is not None and (i + 1) % self.moe_every == 0
 
 
 def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
@@ -57,7 +66,7 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig,
         raise ValueError(
             f"tp={tp} must divide both n_heads={cfg.n_heads} and "
             f"d_ff={cfg.d_ff}")
-    k = iter(jax.random.split(key, 4 + 8 * cfg.n_layers))
+    k = iter(jax.random.split(key, 4 + 9 * cfg.n_layers))
     dt = cfg.dtype
     scale = cfg.d_model ** -0.5
     params = {
@@ -70,7 +79,7 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig,
                                      dt) * scale,
         "layers": [],
     }
-    for _ in range(cfg.n_layers):
+    for i in range(cfg.n_layers):
         layer = {
             "ln1": jnp.ones((cfg.d_model,), dt),
             "wq": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
@@ -82,11 +91,15 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig,
             "wo": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
                                     dt) * scale,
             "ln2": jnp.ones((cfg.d_model,), dt),
-            "w1": jax.random.normal(next(k), (cfg.d_model, cfg.d_ff),
-                                    dt) * scale,
-            "w2": jax.random.normal(next(k), (cfg.d_ff, cfg.d_model),
-                                    dt) * scale,
         }
+        if cfg.is_moe_layer(i):
+            layer.update(init_moe_layer(next(k), cfg.d_model, cfg.moe,
+                                        dtype=dt))
+        else:
+            layer["w1"] = jax.random.normal(
+                next(k), (cfg.d_model, cfg.d_ff), dt) * scale
+            layer["w2"] = jax.random.normal(
+                next(k), (cfg.d_ff, cfg.d_model), dt) * scale
         params["layers"].append(layer)
     return params
 
@@ -94,43 +107,45 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig,
 AttnFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
-def transformer_apply(params: dict, tokens: jnp.ndarray,
-                      cfg: TransformerConfig,
-                      positions: Optional[jnp.ndarray] = None,
+def transformer_block(layer: dict, x: jnp.ndarray, cfg: TransformerConfig,
                       attn_fn: AttnFn = local_causal_attention,
-                      tp_axis: Optional[str] = None) -> jnp.ndarray:
-    """tokens: (B, T_local) int32 → logits (B, T_local, vocab).
+                      tp_axis: Optional[str] = None,
+                      ep_axis: Optional[str] = None
+                      ) -> tuple[jnp.ndarray, dict]:
+    """One residual block (attention + FF), rank-local. Returns (x, aux);
+    aux is empty for dense layers and carries ``aux_loss`` /
+    ``dispatch_fraction`` for MoE layers (``layer`` holds a ``router``).
+    The single block primitive every apply path composes."""
+    b, t, _ = x.shape
+    h = _rmsnorm(x, layer["ln1"])
+    if tp_axis is not None:
+        # identity fwd / psum('tp') bwd: completes dL/dh across the
+        # column-parallel shards (parallel/tp.py)
+        h = tp_grad_boundary(h, tp_axis)
+    q = column_parallel_dense(h, layer["wq"])
+    k_ = column_parallel_dense(h, layer["wk"])
+    v = column_parallel_dense(h, layer["wv"])
+    n_heads_local = q.shape[-1] // cfg.head_dim
+    q = q.reshape(b, t, n_heads_local, cfg.head_dim)
+    k_ = k_.reshape(b, t, n_heads_local, cfg.head_dim)
+    v = v.reshape(b, t, n_heads_local, cfg.head_dim)
+    attn = attn_fn(q, k_, v).reshape(b, t, -1)
+    if tp_axis is not None:
+        x = x + row_parallel_dense(attn, layer["wo"], tp_axis)
+    else:
+        x = x + attn @ layer["wo"]
 
-    ``positions``: global sequence positions of this rank's tokens (needed
-    under sequence sharding; defaults to 0..T-1). When ``tp_axis`` is set,
-    the per-layer weight shards passed in params are already the local tp
-    slices and head count is the local count.
-    """
-    b, t = tokens.shape
-    if positions is None:
-        positions = jnp.arange(t)
-    x = params["embed"][tokens] + params["pos"][positions]
-
-    for layer in params["layers"]:
-        h = _rmsnorm(x, layer["ln1"])
-        if tp_axis is not None:
-            # identity fwd / psum('tp') bwd: completes dL/dh across the
-            # column-parallel shards (parallel/tp.py)
-            h = tp_grad_boundary(h, tp_axis)
-        q = column_parallel_dense(h, layer["wq"])
-        k_ = column_parallel_dense(h, layer["wk"])
-        v = column_parallel_dense(h, layer["wv"])
-        n_heads_local = q.shape[-1] // cfg.head_dim
-        q = q.reshape(b, t, n_heads_local, cfg.head_dim)
-        k_ = k_.reshape(b, t, n_heads_local, cfg.head_dim)
-        v = v.reshape(b, t, n_heads_local, cfg.head_dim)
-        attn = attn_fn(q, k_, v).reshape(b, t, -1)
-        if tp_axis is not None:
-            x = x + row_parallel_dense(attn, layer["wo"], tp_axis)
-        else:
-            x = x + attn @ layer["wo"]
-
-        h = _rmsnorm(x, layer["ln2"])
+    h = _rmsnorm(x, layer["ln2"])
+    aux: dict = {}
+    if "router" in layer:
+        # Routed expert FF: dispatched over ep (parallel/ep.py). Replicated
+        # across tp — no column sharding, so no grad boundary needed, but
+        # the expert FLOPs are redone per tp rank; scale expert capacity
+        # over ep (the axis built for it), not tp. A tp-sharded expert
+        # d_ff is the known optimization if tp*MoE becomes the hot config.
+        y, aux = moe_ffn(h, layer, cfg.moe, axis_name=ep_axis)
+        x = x + y
+    else:
         if tp_axis is not None:
             h = tp_grad_boundary(h, tp_axis)
         h = jax.nn.gelu(column_parallel_dense(h, layer["w1"]))
@@ -138,21 +153,89 @@ def transformer_apply(params: dict, tokens: jnp.ndarray,
             x = x + row_parallel_dense(h, layer["w2"], tp_axis)
         else:
             x = x + h @ layer["w2"]
+    return x, aux
+
+
+def _merge_aux(total: dict, aux: dict) -> dict:
+    if not aux:
+        return total
+    if not total:
+        return {**aux, "_n_moe": jnp.asarray(1.0, jnp.float32)}
+    return {
+        "aux_loss": total["aux_loss"] + aux["aux_loss"],
+        "dispatch_fraction": total["dispatch_fraction"]
+        + aux["dispatch_fraction"],
+        "_n_moe": total["_n_moe"] + 1.0,
+    }
+
+
+def _finalize_aux(total: dict) -> dict:
+    """aux_loss stays a sum over MoE layers; dispatch_fraction becomes the
+    mean over them."""
+    if not total:
+        return {"aux_loss": jnp.asarray(0.0, jnp.float32),
+                "dispatch_fraction": jnp.asarray(1.0, jnp.float32)}
+    n = total.pop("_n_moe")
+    return {"aux_loss": total["aux_loss"],
+            "dispatch_fraction": total["dispatch_fraction"] / n}
+
+
+def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
+                               cfg: TransformerConfig,
+                               positions: Optional[jnp.ndarray] = None,
+                               attn_fn: AttnFn = local_causal_attention,
+                               tp_axis: Optional[str] = None,
+                               ep_axis: Optional[str] = None
+                               ) -> tuple[jnp.ndarray, dict]:
+    """tokens: (B, T_local) int32 → (logits (B, T_local, vocab), aux).
+
+    ``positions``: global sequence positions of this rank's tokens (needed
+    under sequence sharding; defaults to 0..T-1). When ``tp_axis`` is set,
+    the per-layer weight shards passed in params are already the local tp
+    slices and head count is the local count. ``ep_axis`` routes MoE layers
+    over that mesh axis (None = all experts local). aux: ``aux_loss`` (sum
+    of MoE load-balance losses, per-token-mean scale) and
+    ``dispatch_fraction`` (mean over MoE layers; 1.0 when there are none).
+    """
+    t = tokens.shape[1]
+    if positions is None:
+        positions = jnp.arange(t)
+    x = params["embed"][tokens] + params["pos"][positions]
+
+    aux_total: dict = {}
+    for layer in params["layers"]:
+        x, aux = transformer_block(layer, x, cfg, attn_fn, tp_axis, ep_axis)
+        aux_total = _merge_aux(aux_total, aux)
 
     x = _rmsnorm(x, params["out_norm"])
-    return x @ params["lm_head"]
+    return x @ params["lm_head"], _finalize_aux(aux_total)
 
 
-def next_token_loss(params: dict, tokens: jnp.ndarray,
-                    cfg: TransformerConfig,
-                    positions: Optional[jnp.ndarray] = None,
-                    attn_fn: AttnFn = local_causal_attention,
-                    tp_axis: Optional[str] = None,
-                    targets: Optional[jnp.ndarray] = None,
-                    weights: Optional[jnp.ndarray] = None
-                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Weighted summed next-token cross-entropy and total weight (sums, not
-    means, so multi-rank losses combine exactly via psum).
+def transformer_apply(params: dict, tokens: jnp.ndarray,
+                      cfg: TransformerConfig,
+                      positions: Optional[jnp.ndarray] = None,
+                      attn_fn: AttnFn = local_causal_attention,
+                      tp_axis: Optional[str] = None,
+                      ep_axis: Optional[str] = None) -> jnp.ndarray:
+    """Logits-only wrapper over :func:`transformer_apply_with_aux`."""
+    logits, _ = transformer_apply_with_aux(
+        params, tokens, cfg, positions, attn_fn, tp_axis, ep_axis)
+    return logits
+
+
+def next_token_loss_and_aux(params: dict, tokens: jnp.ndarray,
+                            cfg: TransformerConfig,
+                            positions: Optional[jnp.ndarray] = None,
+                            attn_fn: AttnFn = local_causal_attention,
+                            tp_axis: Optional[str] = None,
+                            ep_axis: Optional[str] = None,
+                            targets: Optional[jnp.ndarray] = None,
+                            weights: Optional[jnp.ndarray] = None
+                            ) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
+    """Weighted summed next-token cross-entropy, total weight, and MoE aux
+    (sums, not means, so multi-rank losses combine exactly via psum). The
+    MoE load-balance loss is folded into the returned loss sum scaled by
+    the local token weight, keeping the global mean exact under psum.
 
     Without ``targets``, the shift happens locally (the last token has no
     target and is dropped). With ``targets`` — sequence sharding, where the
@@ -160,8 +243,8 @@ def next_token_loss(params: dict, tokens: jnp.ndarray,
     target and ``weights`` masks the positions that shouldn't count (the
     global final token).
     """
-    logits = transformer_apply(params, tokens, cfg, positions, attn_fn,
-                               tp_axis)
+    logits, aux = transformer_apply_with_aux(
+        params, tokens, cfg, positions, attn_fn, tp_axis, ep_axis)
     if targets is None:
         logits = logits[:, :-1]
         tgt = tokens[:, 1:]
@@ -171,4 +254,26 @@ def next_token_loss(params: dict, tokens: jnp.ndarray,
         weights = jnp.ones(tgt.shape, jnp.float32)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return -(ll * weights).sum(), weights.sum()
+    w_sum = weights.sum()
+    loss_sum = -(ll * weights).sum() + aux["aux_loss"] * w_sum
+    return loss_sum, w_sum, aux
+
+
+def next_token_loss(params: dict, tokens: jnp.ndarray,
+                    cfg: TransformerConfig,
+                    positions: Optional[jnp.ndarray] = None,
+                    attn_fn: AttnFn = local_causal_attention,
+                    tp_axis: Optional[str] = None,
+                    targets: Optional[jnp.ndarray] = None,
+                    weights: Optional[jnp.ndarray] = None,
+                    ep_axis: Optional[str] = None
+                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(loss_sum, weight_sum) wrapper over
+    :func:`next_token_loss_and_aux` (MoE aux folded into the loss).
+    ``ep_axis`` must match how the params were sharded: inside an
+    ep-sharded shard_map the expert leaves are local shards and the
+    dispatch needs the axis name."""
+    loss_sum, w_sum, _ = next_token_loss_and_aux(
+        params, tokens, cfg, positions, attn_fn, tp_axis, ep_axis,
+        targets=targets, weights=weights)
+    return loss_sum, w_sum

@@ -1,0 +1,182 @@
+"""Expert parallelism: mixture-of-experts dispatch over the ``ep`` mesh axis.
+
+Out of the reference's scope (SURVEY.md §2: EP honestly absent there) but
+required of a TPU-scale framework. The design is the TPU-native MoE recipe
+(Switch/GShard style) rather than any actor-based dispatch:
+
+* **Routing is dense math, not control flow.** Top-k expert choice, slot
+  assignment and capacity enforcement are expressed as one-hot/cumsum
+  tensor algebra with static shapes, so the whole layer stays inside one
+  XLA program (no data-dependent Python, MXU-friendly einsums).
+* **Dispatch is a single ``lax.all_to_all`` over ``ep``** in each direction
+  (tokens to expert owners, results back) — the collective rides ICI along
+  the expert mesh axis, exactly where XLA schedules it best.
+* **Capacity overflow is the reference's lossy-allreduce semantics reborn**:
+  a token that misses its expert's capacity window is *dropped from that
+  expert* (its residual path keeps it alive), and the layer reports the
+  dispatched fraction — the analogue of the per-element contribution counts
+  the reference piggybacks on ReduceBlock (reference:
+  AllreduceMessage.scala:20, ReducedDataBuffer.scala:40-48). Nothing stalls
+  waiting for a straggler slot; the math is honest about what was summed.
+
+Rank-local: call inside ``shard_map``. Each ``ep`` rank owns
+``n_experts / ep_size`` experts; token batches are additionally sharded over
+``ep`` (the expert axis doubles as a data axis outside MoE layers, the
+standard TPU MoE meshing). With ``axis_name=None`` the same code runs
+single-rank (all experts local) — used by unit tests and the 1-chip path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """``n_experts`` is global; each ep rank owns ``n_experts // ep_size``.
+    ``capacity_factor`` scales the per-expert slot count above the perfectly
+    balanced load; ``router_k`` experts are combined per token."""
+
+    n_experts: int = 8
+    d_ff: int = 512
+    capacity_factor: float = 1.25
+    router_k: int = 2
+    aux_loss_coef: float = 1e-2
+
+
+def expert_capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    """Static per-expert slot count: ceil(cf * k * N / E), floor 1."""
+    ideal = cfg.capacity_factor * cfg.router_k * n_tokens / cfg.n_experts
+    return max(1, int(-(-ideal // 1)))
+
+
+def init_moe_layer(key: jax.Array, d_model: int, cfg: MoEConfig,
+                   ep: int = 1, dtype=jnp.float32) -> dict:
+    """Per-rank MoE FF parameters. ``we1``/``we2`` carry the FULL expert
+    leading dim here; the train step's sharding rules slice it over ep
+    (models/train.py param_specs). ``router`` is replicated."""
+    if cfg.n_experts % ep:
+        raise ValueError(f"ep={ep} must divide n_experts={cfg.n_experts}")
+    kr, k1, k2 = jax.random.split(key, 3)
+    scale = d_model ** -0.5
+    return {
+        "router": jax.random.normal(kr, (d_model, cfg.n_experts),
+                                    dtype) * scale,
+        "we1": jax.random.normal(k1, (cfg.n_experts, d_model, cfg.d_ff),
+                                 dtype) * scale,
+        "we2": jax.random.normal(k2, (cfg.n_experts, cfg.d_ff, d_model),
+                                 dtype) * (cfg.d_ff ** -0.5),
+    }
+
+
+def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int
+                    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                               jnp.ndarray]:
+    """Greedy top-k assignment with shared per-expert capacity.
+
+    probs: (N, E) router probabilities. Returns (dispatch (N, E, C) 0/1,
+    combine (N, E, C) gate-weighted, kept_fraction scalar, route_frac (E,)
+    — the PRE-capacity assignment fraction per expert, which is what the
+    load-balance loss must see). Assignment is choice-major (every token's
+    1st choice outranks any 2nd choice), the GShard priority rule,
+    expressed as a cumsum over the stacked one-hots — pure tensor algebra,
+    no sorting, no dynamic shapes. All slot/counter bookkeeping runs in
+    float32 regardless of the model dtype: a bf16 cumsum saturates past 256
+    assignments and silently merges tokens into one slot.
+    """
+    n, e = probs.shape
+    out_dtype = probs.dtype
+    probs = probs.astype(jnp.float32)
+    masked = probs
+    onehots = []
+    gates = []
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        onehots.append(oh)
+        gates.append((probs * oh).sum(-1))
+        masked = masked * (1.0 - oh)
+    oh_k = jnp.stack(onehots)                      # (k, N, E)
+    gate_k = jnp.stack(gates)                      # (k, N)
+    if k > 1:
+        # renormalise the k gates per token (GShard top-2 rule, generalised);
+        # k=1 keeps the raw router prob as the gate (Switch) so the router
+        # stays on the differentiable path
+        gate_k = gate_k / jnp.maximum(gate_k.sum(0, keepdims=True), 1e-9)
+
+    flat = oh_k.reshape(k * n, e)
+    pos = jnp.cumsum(flat, axis=0) - flat          # slots taken before me
+    pos = pos.reshape(k, n, e)
+    keep = (pos < capacity) * oh_k
+    slot = jax.nn.one_hot((pos * oh_k).sum(-1).astype(jnp.int32), capacity,
+                          dtype=jnp.float32)       # (k, N, C)
+    dispatch_k = keep[..., None] * slot[:, :, None, :]   # (k, N, E, C)
+    dispatch = dispatch_k.sum(0)
+    combine = (dispatch_k * gate_k[:, :, None, None]).sum(0)
+    kept_fraction = keep.sum() / (k * n)
+    route_frac = oh_k.sum((0, 1)) / (k * n)
+    return (dispatch.astype(out_dtype), combine.astype(out_dtype),
+            kept_fraction, route_frac)
+
+
+def moe_ffn(x: jnp.ndarray, params: dict, cfg: MoEConfig,
+            axis_name: Optional[str] = "ep"
+            ) -> tuple[jnp.ndarray, dict]:
+    """MoE feed-forward block, rank-local. x: (B, T, D) local tokens.
+
+    Returns (output (B, T, D), aux) where aux carries the Switch
+    load-balancing loss (``aux_loss``, already coefficient-scaled, a per-
+    token mean) and ``dispatch_fraction`` — the honest "how much was
+    actually summed" count in the spirit of the reference's AllReduceOutput
+    counts (reference: DataWrapper.scala:3-7).
+    """
+    b, t, d = x.shape
+    n = b * t
+    e = cfg.n_experts
+    ep = lax.axis_size(axis_name) if axis_name is not None else 1
+    e_local = e // ep
+    c = expert_capacity(cfg, n)
+    tokens = x.reshape(n, d)
+
+    logits = tokens @ params["router"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    dispatch, combine, kept, route_frac = _top_k_dispatch(
+        probs.astype(x.dtype), cfg.router_k, c)
+
+    # Switch aux loss: E * sum_e (token fraction routed TO e) * (mean prob
+    # on e). The fraction is the PRE-capacity assignment (route_frac): with
+    # post-capacity counts a saturated expert reads as perfectly balanced —
+    # exactly the overflow regime the loss exists to fix. Differentiable
+    # through the probs term only, as in the paper.
+    mean_prob = probs.mean(0)
+    aux_loss = cfg.aux_loss_coef * e * jnp.sum(
+        lax.stop_gradient(route_frac) * mean_prob)
+
+    expert_in = jnp.einsum("nd,nec->ecd", tokens, dispatch)  # (E, C, D)
+    if axis_name is not None and ep > 1:
+        # chunk s of my expert buffer -> rank s; receive my experts' slots
+        # from every source rank. One collective each way, over ICI.
+        shaped = expert_in.reshape(ep, e_local, c, d)
+        recv = lax.all_to_all(shaped, axis_name, split_axis=0,
+                              concat_axis=0)          # (ep=src, E_l, C, D)
+    else:
+        recv = expert_in.reshape(1, e_local, c, d)
+
+    h = jnp.einsum("secd,edf->secf", recv, params["we1"])
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("secf,efd->secd", h, params["we2"])
+
+    if axis_name is not None and ep > 1:
+        back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0)
+        expert_out = back.reshape(e, c, d)
+    else:
+        expert_out = out.reshape(e_local, c, d)
+
+    y = jnp.einsum("ecd,nec->nd", expert_out, combine)
+    aux = {"aux_loss": aux_loss, "dispatch_fraction": kept}
+    return y.reshape(b, t, d), aux

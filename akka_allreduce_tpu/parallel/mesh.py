@@ -19,11 +19,11 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import AxisType, Mesh
+from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,3 +85,14 @@ def single_axis_mesh(axis_name: str = "dp",
 
 def local_axis_size(mesh: Mesh, axis_name: str) -> int:
     return mesh.shape[axis_name]
+
+
+def place_tree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a pytree onto ``mesh`` with per-leaf PartitionSpecs. Values are
+    preserved — only placement/sharding changes. The one canonical placement
+    helper: initial sharding of host-built state (models/train.py) and
+    post-churn resharding (runtime/elastic.py) both route here."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))

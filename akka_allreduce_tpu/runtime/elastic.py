@@ -40,7 +40,8 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh, \
+    place_tree
 
 log = logging.getLogger(__name__)
 
@@ -120,10 +121,7 @@ def reshard(tree: Any, specs: Any, mesh: Mesh) -> Any:
     """Move live state onto ``mesh`` with per-leaf PartitionSpecs (same
     contract as models/train.py's shard_params). Values are preserved —
     only placement changes."""
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        tree, specs,
-        is_leaf=lambda x: isinstance(x, P))
+    return place_tree(tree, specs, mesh)
 
 
 class ElasticController:
