@@ -40,9 +40,18 @@ from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
     init_transformer,
     next_token_loss_and_aux,
+    rmsnorm,
+    transformer_block,
+    weighted_ce,
 )
 from akka_allreduce_tpu.parallel.dp import GradSyncConfig, allreduce_gradients
 from akka_allreduce_tpu.parallel.mesh import place_tree
+from akka_allreduce_tpu.parallel.pp import (
+    gpipe_apply,
+    last_stage_only,
+    scan_blocks,
+    stack_layer_params,
+)
 from akka_allreduce_tpu.parallel.ring_attention import ring_attention, \
     local_causal_attention
 from akka_allreduce_tpu.utils.vma import psum_all
@@ -54,13 +63,12 @@ class TrainConfig:
     learning_rate: float = 1e-3
     bucket_elems: int = 1 << 16
     grad_axes: tuple[str, ...] = ("dp", "sp")
+    # pipeline parallelism: microbatches per step (only read when the mesh
+    # has pp > 1; the local batch must divide by it)
+    microbatches: int = 1
 
 
-def param_specs(cfg: TransformerConfig) -> dict:
-    """PartitionSpec per parameter leaf: QKV/FF1 column-sharded over tp,
-    WO/FF2 row-sharded, the rest replicated (Megatron layout). MoE layers:
-    expert weights sharded over ep (leading expert dim), router replicated
-    (the expert FF itself is replicated across tp — see transformer_block)."""
+def _uniform_layer_spec(cfg: TransformerConfig) -> tuple[dict, dict, dict]:
     attn = {
         "ln1": P(), "ln2": P(),
         "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
@@ -69,12 +77,44 @@ def param_specs(cfg: TransformerConfig) -> dict:
     dense_ff = {"w1": P(None, "tp"), "w2": P("tp", None)}
     moe_ff = {"router": P(), "we1": P("ep", None, None),
               "we2": P("ep", None, None)}
+    return attn, dense_ff, moe_ff
+
+
+def _validate_pp(cfg: TransformerConfig, pp: int) -> None:
+    if cfg.n_layers % pp:
+        raise ValueError(f"pp={pp} must divide n_layers={cfg.n_layers}")
+    if cfg.moe is not None and cfg.moe_every != 1:
+        raise ValueError(
+            "pipeline stages need homogeneous layers: use moe_every=1 "
+            "(all-MoE) or moe=None (all-dense) when pp > 1")
+
+
+def param_specs(cfg: TransformerConfig, pp: int = 1) -> dict:
+    """PartitionSpec per parameter leaf: QKV/FF1 column-sharded over tp,
+    WO/FF2 row-sharded, the rest replicated (Megatron layout). MoE layers:
+    expert weights sharded over ep (leading expert dim), router replicated
+    (the expert FF itself is replicated across tp — see transformer_block).
+
+    With ``pp > 1`` the per-layer dicts are STACKED (parallel/pp.py) into
+    one dict of arrays with a leading layer dim sharded over pp — each
+    pipeline rank owns its contiguous slice of layers; non-layer leaves
+    stay replicated over pp (their grads psum over it in make_grad_step).
+    """
+    attn, dense_ff, moe_ff = _uniform_layer_spec(cfg)
+    top = {"embed": P(), "pos": P(), "out_norm": P(), "lm_head": P()}
+    if pp == 1:
+        return {
+            **top,
+            "layers": [
+                {**attn, **(moe_ff if cfg.is_moe_layer(i) else dense_ff)}
+                for i in range(cfg.n_layers)
+            ],
+        }
+    _validate_pp(cfg, pp)
+    layer = {**attn, **(moe_ff if cfg.moe is not None else dense_ff)}
     return {
-        "embed": P(), "pos": P(), "out_norm": P(), "lm_head": P(),
-        "layers": [
-            {**attn, **(moe_ff if cfg.is_moe_layer(i) else dense_ff)}
-            for i in range(cfg.n_layers)
-        ],
+        **top,
+        "layers": {k: P("pp", *tuple(s)) for k, s in layer.items()},
     }
 
 
@@ -84,13 +124,19 @@ def shard_params(params: Any, specs: Any, mesh: Mesh) -> Any:
     return place_tree(params, specs, mesh)
 
 
-def split_expert_leaves(grads: dict) -> tuple[dict, list]:
+def split_expert_leaves(grads: dict) -> tuple[dict, Any]:
     """Partition a gradient tree into (dense, expert): expert leaves (we1 /
     we2) are ep-rank-OWNED — each ep rank holds different experts — so they
     must not be reduced over ep, while everything else (router included) is
     replicated over ep and must be. The reference's analogue: a worker only
-    reduces the block it owns (reference: AllreduceWorker.scala:240-250)."""
+    reduces the block it owns (reference: AllreduceWorker.scala:240-250).
+    Handles both layer layouts: list-of-dicts and pp-stacked dict."""
     dense = dict(grads)
+    if isinstance(grads["layers"], dict):  # pp-stacked
+        layers = dict(grads["layers"])
+        expert = {k: layers.pop(k) for k in ("we1", "we2") if k in layers}
+        dense["layers"] = layers
+        return dense, expert
     dense_layers, expert_layers = [], []
     for lyr in grads["layers"]:
         lyr = dict(lyr)
@@ -101,8 +147,11 @@ def split_expert_leaves(grads: dict) -> tuple[dict, list]:
     return dense, expert_layers
 
 
-def merge_expert_leaves(dense: dict, expert_layers: list) -> dict:
+def merge_expert_leaves(dense: dict, expert_layers: Any) -> dict:
     out = dict(dense)
+    if isinstance(dense["layers"], dict):  # pp-stacked
+        out["layers"] = {**dense["layers"], **expert_layers}
+        return out
     out["layers"] = [{**lyr, **ex}
                      for lyr, ex in zip(dense["layers"], expert_layers)]
     return out
@@ -113,11 +162,15 @@ def make_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh
     """Init (sharded params, congruently-sharded opt state, optimizer)."""
     tp = mesh.shape.get("tp", 1)
     ep = mesh.shape.get("ep", 1)
+    pp = mesh.shape.get("pp", 1)
     if cfg.model.moe is not None and cfg.model.moe.n_experts % ep:
         raise ValueError(f"ep={ep} must divide "
                          f"n_experts={cfg.model.moe.n_experts}")
     full = init_transformer(key, cfg.model, tp=tp)
-    params = shard_params(full, param_specs(cfg.model), mesh)
+    if pp > 1:
+        _validate_pp(cfg.model, pp)
+        full = dict(full, layers=stack_layer_params(full["layers"]))
+    params = shard_params(full, param_specs(cfg.model, pp=pp), mesh)
     opt = optax.adamw(cfg.learning_rate)
     opt_state = place_opt_state(opt, jax.jit(opt.init)(params), params, mesh)
     return params, opt_state, opt
@@ -145,12 +198,16 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     """The rank-local core under shard_map: loss, backprop, bucketed
     gradient sync. Returns ``grad_step(params, tokens) -> (synced_grads,
     metrics)``; tokens (B_global, T_global) int32, batch sharded over
-    (dp, ep) — ep doubles as a data axis — and sequence over sp."""
+    (dp, ep) — ep doubles as a data axis — and sequence over sp. With
+    pp > 1 in the mesh the layer stack is pipelined (parallel/pp.py):
+    cfg.microbatches microbatches flow through the pp stages per step."""
     mcfg = cfg.model
-    specs = param_specs(mcfg)
     has_sp = mesh.shape.get("sp", 1) > 1
     has_tp = mesh.shape.get("tp", 1) > 1
     has_ep = mesh.shape.get("ep", 1) > 1
+    pp_size = mesh.shape.get("pp", 1)
+    has_pp = pp_size > 1
+    specs = param_specs(mcfg, pp=pp_size if has_pp else 1)
     tp_axis = "tp" if has_tp else None
     ep_axis = "ep" if has_ep else None
     has_moe = mcfg.moe is not None
@@ -192,6 +249,52 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     attn = partial(ring_attention, axis_name="sp", causal=True) if has_sp \
         else local_causal_attention
 
+    # metrics reduce over every axis the quantity varies over; under pp the
+    # loss/aux pieces are spread across stages too. dispatch_fraction is a
+    # per-MoE-layer mean on every rank (both paths arrange that), so the
+    # psum needs dividing by the full metric rank count.
+    metric_axes = dense_axes + (("pp",) if has_pp else ())
+    disp_norm = n_dense_ranks * (pp_size if has_pp else 1)
+
+    def sync_and_metrics(loss, aux, grads, total_count):
+        # Gradient sync over the data axes: the framework's bucketed,
+        # counted collective — THE allreduce the reference exists for.
+        # Gradients for tp shards need no sync (tp_grad_boundary completed
+        # them in the backward pass); the data axes are ours alone to
+        # reduce — which is the point: sync policy (masks, counts, lossy
+        # rounds) stays in framework hands, not autodiff's. Expert weights
+        # sync separately: they are ep-owned, so ep is not a data axis for
+        # them (split_expert_leaves). Pipeline-stage weights are pp-owned,
+        # but the replicated non-layer leaves (embeddings, head) received
+        # their gradient only on the stage that consumes them — complete
+        # those across pp first.
+        if has_pp:
+            grads = dict(grads)
+            for k in grads:
+                if k != "layers":
+                    grads[k] = psum_all(grads[k], "pp")
+        if has_moe:
+            dense, expert = split_expert_leaves(grads)
+            res = allreduce_gradients(dense, gcfg, valid=valid_buckets)
+            res_e = allreduce_gradients(expert, gcfg_expert)
+            grads_out = merge_expert_leaves(res.grads, res_e.grads)
+            min_count = jnp.minimum(res.bucket_counts.min(),
+                                    res_e.bucket_counts.min())
+        else:
+            res = allreduce_gradients(grads, gcfg, valid=valid_buckets)
+            grads_out = res.grads
+            min_count = res.bucket_counts.min()
+        metrics = {
+            "loss": psum_all(loss, metric_axes),
+            "tokens": total_count,
+            "min_bucket_count": min_count,
+            "aux_loss": psum_all(aux["aux_loss"], metric_axes)
+            / n_dense_ranks,
+            "dispatch_fraction": psum_all(aux["dispatch_fraction"],
+                                          metric_axes) / disp_norm,
+        }
+        return grads_out, metrics
+
     def grad_local(params, tokens):
         targets, weights, positions = targets_and_weights(tokens)
         total_count = psum_all(weights.sum(), dense_axes)
@@ -206,35 +309,48 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
-        # Gradient sync over the data axes: the framework's bucketed,
-        # counted collective — THE allreduce the reference exists for.
-        # Gradients for tp shards need no sync (tp_grad_boundary completed
-        # them in the backward pass); the data axes are ours alone to
-        # reduce — which is the point: sync policy (masks, counts, lossy
-        # rounds) stays in framework hands, not autodiff's. Expert weights
-        # sync separately: they are ep-owned, so ep is not a data axis for
-        # them (split_expert_leaves).
-        if has_moe:
-            dense, expert = split_expert_leaves(grads)
-            res = allreduce_gradients(dense, gcfg, valid=valid_buckets)
-            res_e = allreduce_gradients(expert, gcfg_expert)
-            grads_out = merge_expert_leaves(res.grads, res_e.grads)
-            min_count = jnp.minimum(res.bucket_counts.min(),
-                                    res_e.bucket_counts.min())
-        else:
-            res = allreduce_gradients(grads, gcfg, valid=valid_buckets)
-            grads_out = res.grads
-            min_count = res.bucket_counts.min()
-        metrics = {
-            "loss": psum_all(loss, dense_axes),
-            "tokens": total_count,
-            "min_bucket_count": min_count,
-            "aux_loss": psum_all(aux["aux_loss"], dense_axes)
-            / n_dense_ranks,
-            "dispatch_fraction": psum_all(aux["dispatch_fraction"],
-                                          dense_axes) / n_dense_ranks,
-        }
-        return grads_out, metrics
+        return sync_and_metrics(loss, aux, grads, total_count)
+
+    def grad_local_pp(params, tokens):
+        targets, weights, positions = targets_and_weights(tokens)
+        total_count = psum_all(weights.sum(), dense_axes)
+        m = cfg.microbatches
+        b_local, t_local = tokens.shape
+        if b_local % m:
+            raise ValueError(
+                f"local batch {b_local} must divide into "
+                f"microbatches={m}")
+
+        def block(lyr, h):
+            return transformer_block(lyr, h, mcfg, attn, tp_axis, ep_axis)
+
+        def stage(stacked, h):
+            return scan_blocks(stacked, h, block)
+
+        def loss_fn(p):
+            x = p["embed"][tokens] + p["pos"][positions]
+            xm = x.reshape(m, b_local // m, t_local, x.shape[-1])
+            outs, aux = gpipe_apply(p["layers"], xm, stage, "pp")
+            h = outs.reshape(b_local, t_local, outs.shape[-1])
+            logits = rmsnorm(h, p["out_norm"]) @ p["lm_head"]
+            ce_sum, w_sum = weighted_ce(logits, targets, weights)
+            if "dispatch_fraction" in aux:
+                # scan_blocks summed over this stage's layers — make it the
+                # per-layer mean so metric reduction is uniform
+                aux = dict(aux, dispatch_fraction=aux["dispatch_fraction"]
+                           / (mcfg.n_layers // pp_size))
+            aux = {"aux_loss": jnp.asarray(0.0, jnp.float32),
+                   "dispatch_fraction": jnp.asarray(1.0, jnp.float32),
+                   **aux}
+            # ce is real only on the last stage (gpipe outputs elsewhere
+            # are drain garbage); each stage owns its layers' aux term
+            local = (last_stage_only(ce_sum, "pp")
+                     + aux["aux_loss"] * w_sum)
+            return local / total_count, aux
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        return sync_and_metrics(loss, aux, grads, total_count)
 
     # check_vma=False: varying-axis tracking would auto-insert psums over
     # the data axes in the backward pass (pvary transpose), taking gradient
@@ -242,7 +358,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     # (parallel/tp.py) plus allreduce_gradients carry it instead.
     batch_axes = ("dp", "ep") if "ep" in mesh.shape else "dp"
     return jax.shard_map(
-        grad_local, mesh=mesh,
+        grad_local_pp if has_pp else grad_local, mesh=mesh,
         in_specs=(specs, P(batch_axes, "sp")),
         out_specs=(specs, P()),
         check_vma=False,

@@ -52,7 +52,7 @@ class TransformerConfig:
         return self.moe is not None and (i + 1) % self.moe_every == 0
 
 
-def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     return x * jax.lax.rsqrt(var + 1e-6) * scale
 
@@ -117,7 +117,7 @@ def transformer_block(layer: dict, x: jnp.ndarray, cfg: TransformerConfig,
     ``dispatch_fraction`` for MoE layers (``layer`` holds a ``router``).
     The single block primitive every apply path composes."""
     b, t, _ = x.shape
-    h = _rmsnorm(x, layer["ln1"])
+    h = rmsnorm(x, layer["ln1"])
     if tp_axis is not None:
         # identity fwd / psum('tp') bwd: completes dL/dh across the
         # column-parallel shards (parallel/tp.py)
@@ -135,7 +135,7 @@ def transformer_block(layer: dict, x: jnp.ndarray, cfg: TransformerConfig,
     else:
         x = x + attn @ layer["wo"]
 
-    h = _rmsnorm(x, layer["ln2"])
+    h = rmsnorm(x, layer["ln2"])
     aux: dict = {}
     if "router" in layer:
         # Routed expert FF: dispatched over ep (parallel/ep.py). Replicated
@@ -207,7 +207,7 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
         x, aux = transformer_block(layer, x, cfg, attn_fn, tp_axis, ep_axis)
         aux_total = _merge_aux(aux_total, aux)
 
-    x = _rmsnorm(x, params["out_norm"])
+    x = rmsnorm(x, params["out_norm"])
     return x @ params["lm_head"], _finalize_aux(aux_total)
 
 
@@ -250,13 +250,20 @@ def next_token_loss_and_aux(params: dict, tokens: jnp.ndarray,
         tgt = tokens[:, 1:]
     else:
         tgt = targets
-    if weights is None:
-        weights = jnp.ones(tgt.shape, jnp.float32)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    w_sum = weights.sum()
-    loss_sum = -(ll * weights).sum() + aux["aux_loss"] * w_sum
+    ce_sum, w_sum = weighted_ce(logits, tgt, weights)
+    loss_sum = ce_sum + aux["aux_loss"] * w_sum
     return loss_sum, w_sum, aux
+
+
+def weighted_ce(logits: jnp.ndarray, targets: jnp.ndarray,
+                weights: Optional[jnp.ndarray] = None
+                ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Summed weighted cross-entropy (f32 log-softmax) and total weight."""
+    if weights is None:
+        weights = jnp.ones(targets.shape, jnp.float32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -(ll * weights).sum(), weights.sum()
 
 
 def next_token_loss(params: dict, tokens: jnp.ndarray,

@@ -74,7 +74,8 @@ def init_moe_layer(key: jax.Array, d_model: int, cfg: MoEConfig,
     }
 
 
-def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int
+def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int,
+                    out_dtype=None
                     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                                jnp.ndarray]:
     """Greedy top-k assignment with shared per-expert capacity.
@@ -90,7 +91,7 @@ def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int
     assignments and silently merges tokens into one slot.
     """
     n, e = probs.shape
-    out_dtype = probs.dtype
+    out_dtype = out_dtype or probs.dtype
     probs = probs.astype(jnp.float32)
     masked = probs
     onehots = []
@@ -145,8 +146,10 @@ def moe_ffn(x: jnp.ndarray, params: dict, cfg: MoEConfig,
 
     logits = tokens @ params["router"]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # probs stay f32 into the dispatch (gate precision, argmax ties);
+    # out_dtype keeps the dispatch/combine tensors in the model dtype
     dispatch, combine, kept, route_frac = _top_k_dispatch(
-        probs.astype(x.dtype), cfg.router_k, c)
+        probs, cfg.router_k, c, out_dtype=x.dtype)
 
     # Switch aux loss: E * sum_e (token fraction routed TO e) * (mean prob
     # on e). The fraction is the PRE-capacity assignment (route_frac): with

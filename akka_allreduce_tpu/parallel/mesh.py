@@ -28,23 +28,29 @@ from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
-    """Named axis sizes for the standard 4-axis layout: data, tensor(model),
-    sequence, expert. Size 1 axes cost nothing — they simply don't shard."""
+    """Named axis sizes for the standard 5-axis layout: pipeline, data,
+    expert, sequence, tensor(model). Size 1 axes cost nothing — they simply
+    don't shard. Axis ORDER is the bandwidth hierarchy: the last (fastest-
+    varying) axis maps to nearest-neighbor ICI links, so tp — the most
+    latency/bandwidth-hungry collective traffic — sits innermost, while pp
+    — one point-to-point activation handoff per stage per tick — sits
+    outermost, happy to ride the longest hops (or DCN across slices)."""
 
     dp: int = 1
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.tp * self.sp * self.ep
+        return self.dp * self.tp * self.sp * self.ep * self.pp
 
     def axis_names(self) -> tuple[str, ...]:
-        return ("dp", "tp", "sp", "ep")
+        return ("pp", "dp", "ep", "sp", "tp")
 
     def axis_sizes(self) -> tuple[int, ...]:
-        return (self.dp, self.tp, self.sp, self.ep)
+        return (self.pp, self.dp, self.ep, self.sp, self.tp)
 
 
 def make_device_mesh(spec: Optional[MeshSpec] = None,

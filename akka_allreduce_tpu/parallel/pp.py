@@ -1,0 +1,139 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over ``pp``.
+
+Out of the reference's scope (SURVEY.md §2: PP honestly absent there — its
+``maxLag`` is round-pipelining of the collective, not layer pipelining) but
+required of a TPU-scale framework. The design is the TPU-native pipeline
+recipe, not a scheduler translation:
+
+* **Stages are mesh shards, not processes.** Layer parameters are stacked
+  along a leading layer dim and sharded over the ``pp`` axis; each rank
+  owns ``n_layers / pp`` contiguous layers. No per-stage programs — ONE
+  SPMD program, which is what XLA compiles best.
+* **The schedule is a ``lax.scan`` over ticks with one ``ppermute`` per
+  tick** rotating activations to the next stage over ICI. Microbatch m
+  enters stage 0 at tick m and exits stage S-1 at tick m+S-1; the classic
+  GPipe fill/drain bubble of (S-1) ticks on each side.
+* **Backward is derived, not scheduled**: autodiff through scan+ppermute
+  yields the reverse pipeline (cotangents flow backward along the reversed
+  permutation) — the 1F1B-ish schedule falls out of the transpose rules
+  instead of being hand-built actor choreography.
+
+The structural kinship with the reference is real, though: the tick loop
+with a rotating buffer is the same index gymnastics as its round-ring
+buffer (reference: AllReduceBuffer.scala:34-42), and rank-staggered
+rotation mirrors its ``(i+id)%peerNum`` schedule (AllreduceWorker.scala:214).
+
+Rank-local: call inside ``shard_map``. Works at pp=1 (single stage, no
+rotation) so the same train-step code path serves both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# stage_fn(stage_params, state) -> (state, aux); aux is a (possibly empty)
+# pytree of scalars accumulated across ticks (masked to valid ones).
+StageFn = Callable[[Any, jnp.ndarray], tuple[jnp.ndarray, Any]]
+
+
+def stack_layer_params(layers: Sequence[dict]) -> dict:
+    """Stack a homogeneous list of per-layer param dicts into one dict of
+    arrays with a leading layer dim — the layout that shards over pp (and
+    that ``lax.scan`` consumes). Heterogeneous layers (e.g. dense FF mixed
+    with MoE via moe_every>1) cannot stack; the caller must use a uniform
+    layer recipe when pipelining."""
+    if not layers:
+        raise ValueError("no layers to stack")
+    struct0 = jax.tree.structure(layers[0])
+    for i, lyr in enumerate(layers[1:], 1):
+        if jax.tree.structure(lyr) != struct0:
+            raise ValueError(
+                f"layer {i} structure differs from layer 0 — pipeline "
+                f"stages need homogeneous layers (got {jax.tree.structure(lyr)}"
+                f" vs {struct0})")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layer_params(stacked: dict, n_layers: int) -> list:
+    """Inverse of :func:`stack_layer_params`."""
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n_layers)]
+
+
+def scan_blocks(stacked: dict, x: jnp.ndarray,
+                block_fn: Callable[[dict, jnp.ndarray],
+                                   tuple[jnp.ndarray, Any]],
+                ) -> tuple[jnp.ndarray, Any]:
+    """Apply a stack of layers sequentially via ``lax.scan`` (one traced
+    block body regardless of depth — compile time stays flat). Returns the
+    final activations and the per-leaf SUM of the blocks' aux trees."""
+    def body(h, layer):
+        h, aux = block_fn(layer, h)
+        return h, aux
+
+    x, auxs = lax.scan(body, x, stacked)
+    return x, jax.tree.map(lambda a: a.sum(0), auxs)
+
+
+def gpipe_apply(stage_params: Any, x_micro: jnp.ndarray, stage_fn: StageFn,
+                axis_name: str = "pp") -> tuple[jnp.ndarray, Any]:
+    """Run microbatches through the stage pipeline. Rank-local.
+
+    ``x_micro``: (M, ...) microbatched stage-0 inputs — present (replicated)
+    on every pp rank; only rank 0's injection is consumed, which is also
+    what makes the replicated upstream params (embeddings) receive their
+    gradient only on rank 0 (callers psum those grads over pp).
+
+    Returns ``(outputs, aux)``: outputs (M, ...) are the last stage's
+    results — ONLY valid on rank S-1 (mask downstream consumption with
+    ``lax.axis_index(axis_name) == S-1``); aux is stage_fn's aux tree,
+    summed over this rank's M valid ticks and divided by M (a per-
+    microbatch mean), garbage fill/drain ticks masked out.
+    """
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_micro.shape[0]
+    n_ticks = m + s - 1
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    aux_struct = jax.eval_shape(
+        lambda p, x: stage_fn(p, x)[1], stage_params, x_micro[0])
+    aux0 = jax.tree.map(lambda t: jnp.zeros(t.shape, t.dtype), aux_struct)
+    buf0 = jnp.zeros_like(x_micro[0])
+    out0 = jnp.zeros_like(x_micro)
+
+    def tick(carry, t):
+        buf, outputs, aux_sum = carry
+        inject = x_micro[jnp.clip(t, 0, m - 1)]
+        state = jnp.where(idx == 0, inject, buf)
+        state, aux_t = stage_fn(stage_params, state)
+        # this rank processes microbatch t-idx at tick t; ticks outside
+        # [idx, idx+m) are pipeline fill/drain garbage — keep their aux out
+        valid = ((t >= idx) & (t < idx + m))
+        aux_sum = jax.tree.map(
+            lambda acc, a: acc + jnp.where(valid, a, 0), aux_sum, aux_t)
+        # the last stage's tick-t state is microbatch t-(S-1)'s output;
+        # early garbage writes land on slot 0 and are overwritten at
+        # t = S-1 (scan writes are ordered), so no masking is needed
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, state, jnp.clip(t - (s - 1), 0, m - 1), 0)
+        buf = lax.ppermute(state, axis_name, perm)
+        return (buf, outputs, aux_sum), None
+
+    (_, outputs, aux_sum), _ = lax.scan(
+        tick, (buf0, out0, aux0), jnp.arange(n_ticks))
+    aux = jax.tree.map(lambda a: a / m, aux_sum)
+    return outputs, aux
+
+
+def last_stage_only(value: jnp.ndarray, axis_name: str = "pp"
+                    ) -> jnp.ndarray:
+    """Zero ``value`` on all but the final pipeline stage — for folding the
+    (only-valid-on-last-stage) loss into an SPMD-uniform scalar that can
+    then be psummed over pp."""
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    return jnp.where(idx == s - 1, value, jnp.zeros_like(value))

@@ -90,18 +90,18 @@ class QuorumTracker:
 
 def shrink_spec(spec: MeshSpec, n_devices: int) -> MeshSpec:
     """The largest layout fitting ``n_devices`` that preserves the model
-    axes (tp/sp/ep) and shrinks dp — dropping incomplete dp replicas.
+    axes (tp/sp/ep/pp) and shrinks dp — dropping incomplete dp replicas.
 
-    Raises if not even one full model replica survives (tp*sp*ep devices):
-    at that point the sharded model state is genuinely lost and only a
-    checkpoint restore (runtime/checkpoint.py) can recover.
+    Raises if not even one full model replica survives (tp*sp*ep*pp
+    devices): at that point the sharded model state is genuinely lost and
+    only a checkpoint restore (runtime/checkpoint.py) can recover.
     """
-    model_devices = spec.tp * spec.sp * spec.ep
+    model_devices = spec.tp * spec.sp * spec.ep * spec.pp
     new_dp = n_devices // model_devices
     if new_dp < 1:
         raise RuntimeError(
             f"unrecoverable: {n_devices} surviving devices cannot hold one "
-            f"model replica of tp*sp*ep = {model_devices}; restore from "
+            f"model replica of tp*sp*ep*pp = {model_devices}; restore from "
             f"checkpoint on a fresh slice")
     return dataclasses.replace(spec, dp=new_dp)
 

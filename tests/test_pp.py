@@ -1,0 +1,125 @@
+"""Pipeline-parallelism unit tests: stacking, the gpipe schedule, aux
+masking, and differentiation through the pipeline."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.parallel.mesh import make_device_mesh
+from akka_allreduce_tpu.parallel.pp import (
+    gpipe_apply,
+    last_stage_only,
+    scan_blocks,
+    stack_layer_params,
+    unstack_layer_params,
+)
+
+
+def pp_mesh(s):
+    return make_device_mesh(axis_names=("pp",), axis_sizes=(s,),
+                            devices=jax.devices()[:s])
+
+
+class TestStacking:
+    def test_roundtrip(self):
+        layers = [{"w": jnp.full((3,), float(i)), "b": jnp.ones(())}
+                  for i in range(4)]
+        stacked = stack_layer_params(layers)
+        assert stacked["w"].shape == (4, 3)
+        back = unstack_layer_params(stacked, 4)
+        for a, b in zip(layers, back):
+            np.testing.assert_array_equal(np.asarray(a["w"]),
+                                          np.asarray(b["w"]))
+
+    def test_heterogeneous_layers_rejected(self):
+        layers = [{"w": jnp.ones(3)}, {"w": jnp.ones(3), "r": jnp.ones(2)}]
+        with pytest.raises(ValueError, match="homogeneous"):
+            stack_layer_params(layers)
+
+    def test_scan_blocks_matches_loop(self):
+        layers = [{"w": jnp.asarray(float(i + 1))} for i in range(3)]
+        stacked = stack_layer_params(layers)
+        x = jnp.arange(4.0)
+
+        def block(lyr, h):
+            return h * lyr["w"], {"s": h.sum()}
+
+        out, aux = scan_blocks(stacked, x, block)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 6.0)
+        # aux summed over blocks: x.sum()*(1 + 1 + 2) scales 1,1*1?,..
+        expected = float(x.sum() * (1 + 1 * 1 + 1 * 2))
+        assert float(aux["s"]) == pytest.approx(expected)
+
+
+class TestGpipe:
+    @pytest.mark.parametrize("s,m", [(4, 4), (2, 6), (4, 1), (8, 3)])
+    def test_pipeline_computes_product(self, s, m):
+        mesh = pp_mesh(s)
+        w = jnp.arange(1.0, s + 1)          # stage i multiplies by i+1
+        xm = jnp.asarray(
+            np.random.default_rng(0).normal(size=(m, 3)).astype(np.float32))
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("pp"), P()),
+                 out_specs=P(), check_vma=False)
+        def run(w_local, x):
+            def stage(p, h):
+                return h * p[0], {}
+
+            out, _ = gpipe_apply(w_local, x, stage, "pp")
+            return lax.psum(last_stage_only(out, "pp"), "pp")
+
+        out = run(w, xm)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(xm) * float(w.prod()),
+                                   rtol=1e-6)
+
+    def test_gradients_through_pipeline(self):
+        s, m = 4, 3
+        mesh = pp_mesh(s)
+        xm = jnp.asarray(
+            np.random.default_rng(1).normal(size=(m, 5)).astype(np.float32))
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("pp"), P()),
+                 out_specs=P(), check_vma=False)
+        def loss_sharded(w_local, x):
+            def stage(p, h):
+                return h * p[0], {}
+
+            out, _ = gpipe_apply(w_local, x, stage, "pp")
+            return lax.psum(last_stage_only(jnp.sum(out ** 2), "pp"), "pp")
+
+        w = jnp.asarray([1.5, -2.0, 0.5, 3.0])
+        g = jax.grad(lambda ww: loss_sharded(ww, xm))(w)
+
+        def ref_loss(ww):
+            return jnp.sum((xm * ww.prod()) ** 2)
+
+        g_ref = jax.grad(ref_loss)(w)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-5)
+
+    def test_aux_masks_fill_and_drain_ticks(self):
+        s, m = 4, 2
+        mesh = pp_mesh(s)
+        xm = jnp.stack([jnp.full((3,), 1.0), jnp.full((3,), 10.0)])
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("pp"), P()),
+                 out_specs=P("pp"), check_vma=False)
+        def aux_per_rank(w_local, x):
+            def stage(p, h):
+                return h * p[0], {"seen": h.sum()}
+
+            _, aux = gpipe_apply(w_local, x, stage, "pp")
+            return aux["seen"][None]
+
+        w = jnp.full((s,), 2.0)
+        seen = np.asarray(aux_per_rank(w, xm))
+        # rank i sees microbatch values scaled by 2^i, mean over m=2
+        # microbatches of sums 3*(1,10)*2^i -> 16.5 * 2^i
+        np.testing.assert_allclose(seen, [16.5 * 2 ** i for i in range(s)],
+                                   rtol=1e-6)
