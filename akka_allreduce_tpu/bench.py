@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from akka_allreduce_tpu.config import num_chunks
 from akka_allreduce_tpu.parallel.dp import GradSyncConfig, allreduce_gradients
 from akka_allreduce_tpu.parallel.mesh import single_axis_mesh
 
@@ -47,23 +48,44 @@ R_HI, R_LO = 40, 10
 REFERENCE_TRANSPORT_CEILING_GBPS = 1.25
 
 
-def main() -> None:
+def measure_device_goodput(elems: int, bucket_elems: int,
+                           r_hi: int = R_HI, r_lo: int = R_LO,
+                           valid_fraction: float = 1.0,
+                           reps: int = 3) -> float:
+    """Goodput (payload GB/s) of the full device sync path on all available
+    real devices. ``valid_fraction < 1`` exercises the lossy masked path
+    (BASELINE.md config #4): that fraction of buckets contributes per round
+    and the result is count-rescaled."""
     devices = jax.devices()
     n = len(devices)
     mesh = single_axis_mesh("dp", devices=devices)
-    cfg = GradSyncConfig(bucket_elems=BUCKET_ELEMS, average=True)
+    num_buckets = num_chunks(elems, bucket_elems)
+    lossy = valid_fraction < 1.0
+    cfg = GradSyncConfig(bucket_elems=bucket_elems, average=True,
+                         rescale_target=float(n) if lossy else 1.0)
+    base_valid = None
+    if lossy:
+        n_valid = max(1, int(round(valid_fraction * num_buckets)))
+        base_valid = jnp.zeros((num_buckets,), jnp.float32
+                               ).at[:n_valid].set(1.0)
 
     def make(rounds):
         @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
                  out_specs=P("dp"), check_vma=False)
         def run(x0, seeds):
+            # stagger the mask per rank so per-bucket counts land strictly
+            # between 1 and n — the partial-count rescale regime the lossy
+            # config exists to measure, not just all-or-nothing buckets
+            valid = None if base_valid is None else \
+                jnp.roll(base_valid, lax.axis_index("dp"))
+
             def one(carry, seed):
                 # fresh on-device "gradient" each round; abs() blocks
                 # cross-round algebraic collapse
                 x_r = jax.random.normal(jax.random.key(seed[0]),
-                                        (ELEMS,), jnp.float32)
+                                        (elems,), jnp.float32)
                 res = allreduce_gradients(
-                    {"g": jnp.abs(x_r + carry * 1e-30)}, cfg)
+                    {"g": jnp.abs(x_r + carry * 1e-30)}, cfg, valid=valid)
                 return res.grads["g"], None
 
             out, _ = lax.scan(one, x0[0], seeds[0, :rounds])
@@ -71,11 +93,11 @@ def main() -> None:
 
         return jax.jit(run)
 
-    x0 = jnp.zeros((n, ELEMS), jnp.float32)
-    seeds = jnp.tile(jnp.arange(R_HI, dtype=jnp.uint32)[None, :, None],
+    x0 = jnp.zeros((n, elems), jnp.float32)
+    seeds = jnp.tile(jnp.arange(r_hi, dtype=jnp.uint32)[None, :, None],
                      (n, 1, 1))
 
-    def measure(rounds, reps=3):
+    def measure(rounds):
         f = make(rounds)
         np.asarray(f(x0, seeds).addressable_shards[0].data[0, :4])  # warmup
         ts = []
@@ -86,11 +108,15 @@ def main() -> None:
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts))
 
-    t_hi = measure(R_HI)
-    t_lo = measure(R_LO)
-    per_round = (t_hi - t_lo) / (R_HI - R_LO)
+    t_hi = measure(r_hi)
+    t_lo = measure(r_lo)
+    per_round = (t_hi - t_lo) / (r_hi - r_lo)
+    return elems * 4 / per_round / 1e9
 
-    goodput_gbps = ELEMS * 4 / per_round / 1e9
+
+def main() -> None:
+    n = len(jax.devices())
+    goodput_gbps = measure_device_goodput(ELEMS, BUCKET_ELEMS)
     print(json.dumps({
         "metric": f"allreduce_goodput_25M_f32_{n}chip",
         "value": round(goodput_gbps, 2),

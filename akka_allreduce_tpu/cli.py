@@ -157,6 +157,18 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                    help="data-parallel degree (0 = all devices)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (layers stack-sharded)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree (needs --moe-experts)")
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="pipeline microbatches (0 = pp)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="experts per MoE layer (0 = dense model)")
+    p.add_argument("--moe-every", type=int, default=1,
+                   help="every Nth layer is MoE (pp>1 requires 1)")
+    p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--router-k", type=int, default=2)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--n-heads", type=int, default=4)
@@ -187,19 +199,33 @@ def _cmd_train(args: argparse.Namespace) -> int:
     from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
 
     n_dev = len(jax.devices())
-    dp = args.dp or max(1, n_dev // (args.tp * args.sp))
-    if dp * args.tp * args.sp != n_dev:
-        print(f"error: dp*tp*sp = {dp * args.tp * args.sp} != "
+    model_par = args.tp * args.sp * args.pp * args.ep
+    dp = args.dp or max(1, n_dev // model_par)
+    if dp * model_par != n_dev:
+        print(f"error: dp*tp*sp*pp*ep = {dp * model_par} != "
               f"{n_dev} devices", file=sys.stderr)
         return 2
-    mesh = make_device_mesh(MeshSpec(dp=dp, tp=args.tp, sp=args.sp))
-    b = args.batch or 2 * dp
+    mesh = make_device_mesh(MeshSpec(dp=dp, tp=args.tp, sp=args.sp,
+                                     pp=args.pp, ep=args.ep))
+    if args.microbatches > 1 and args.pp == 1:
+        print("error: --microbatches requires --pp > 1 (microbatching "
+              "only exists on the pipeline path)", file=sys.stderr)
+        return 2
+    micro = args.microbatches or (args.pp if args.pp > 1 else 1)
+    b = args.batch or 2 * dp * args.ep * micro
     t = args.seq or 32 * args.sp
+    moe = None
+    if args.moe_experts:
+        from akka_allreduce_tpu.parallel.ep import MoEConfig
+        moe = MoEConfig(n_experts=args.moe_experts, d_ff=args.d_ff,
+                        capacity_factor=args.capacity_factor,
+                        router_k=args.router_k)
     mcfg = TransformerConfig(vocab_size=args.vocab, d_model=args.d_model,
                              n_heads=args.n_heads, n_layers=args.n_layers,
-                             d_ff=args.d_ff, max_seq=t)
+                             d_ff=args.d_ff, max_seq=t,
+                             moe=moe, moe_every=args.moe_every)
     cfg = TrainConfig(model=mcfg, learning_rate=args.lr,
-                      bucket_elems=args.bucket_elems)
+                      bucket_elems=args.bucket_elems, microbatches=micro)
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
     step = make_train_step(cfg, mesh, opt)
 
@@ -216,7 +242,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
             print(f"resumed from step {start - 1} "
                   f"(data position {extra.get('data_step', '?')})")
 
-    print(f"mesh dp={dp} tp={args.tp} sp={args.sp}; batch={b} seq={t}")
+    print(f"mesh dp={dp} tp={args.tp} sp={args.sp} pp={args.pp} "
+          f"ep={args.ep}; batch={b} seq={t} microbatches={micro}"
+          + (f" moe_experts={args.moe_experts}" if moe else ""))
     tic = time.perf_counter()
     steps_in_window = 0
     try:

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Canonical cluster smoke: the master side.
+
+The TPU-framework edition of the reference's REPL script
+(reference: scripts/testAllreduceMaster.sc:1-24): a master for 4 workers,
+dataSize=778, maxChunkSize=3, maxLag=3, all thresholds 1.0 — served over
+the native C++ TCP transport on localhost:2551. Start this first, then
+four ``test_allreduce_worker.py`` processes (or just run
+``smoke_cluster.py`` which orchestrates all five).
+
+Usage: python scripts/test_allreduce_master.py [maxRound]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from akka_allreduce_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    max_round = sys.argv[1] if len(sys.argv) > 1 else "100"
+    sys.exit(main([
+        "master", "--port", "2551", "--workers", "4",
+        "--data-size", "778", "--max-chunk-size", "3", "--max-lag", "3",
+        "--th-allreduce", "1.0", "--th-reduce", "1.0",
+        "--th-complete", "1.0", "--max-round", max_round,
+    ]))

@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Canonical cluster smoke: the worker side.
+
+The TPU-framework edition of the reference's REPL script
+(reference: scripts/testAllreduceWorker.sc:1-4, AllreduceWorker.scala:
+317-346): joins the master at localhost:2551 with a 778-float synthetic
+source, prints MB/s every 10 rounds, and asserts ``output == 4 x input``
+with full contribution counts — the reference's own correctness invariant
+(reference: AllreduceWorker.scala:337-339).
+
+Usage: python scripts/test_allreduce_worker.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from akka_allreduce_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main([
+        "worker", "--master-port", "2551", "--data-size", "778",
+        "--checkpoint", "10", "--assert-multiple", "4",
+    ]))
