@@ -211,6 +211,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print("error: --microbatches requires --pp > 1 (microbatching "
               "only exists on the pipeline path)", file=sys.stderr)
         return 2
+    if args.pp > 1 and args.moe_experts and args.moe_every != 1:
+        print("error: --pp > 1 needs homogeneous layers: use "
+              "--moe-every 1 or drop --moe-experts", file=sys.stderr)
+        return 2
     micro = args.microbatches or (args.pp if args.pp > 1 else 1)
     b = args.batch or 2 * dp * args.ep * micro
     t = args.seq or 32 * args.sp

@@ -219,10 +219,12 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     n_expert_ranks = math.prod(mesh.shape.get(a, 1) for a in cfg.grad_axes)
     gcfg = GradSyncConfig(bucket_elems=cfg.bucket_elems,
                           axis_name=dense_axes, average=True,
-                          rescale_target=float(n_dense_ranks))
+                          rescale_target=float(n_dense_ranks),
+                          return_elem_counts=False)
     gcfg_expert = GradSyncConfig(bucket_elems=cfg.bucket_elems,
                                  axis_name=cfg.grad_axes, average=True,
-                                 rescale_target=float(n_expert_ranks))
+                                 rescale_target=float(n_expert_ranks),
+                                 return_elem_counts=False)
 
     def targets_and_weights(tokens):
         """Per-token next-token targets and loss weights; under sp the

@@ -13,6 +13,13 @@ the way out.
 All functions here are pure and jit-compatible (shapes come from the static
 :class:`BucketSpec`), and they are the independently unit-tested layer the
 reference's buffer specs model (SURVEY.md §7 build order step 2).
+
+Performance note: pick ``bucket_elems`` as a multiple of 1024 (the f32
+8-sublane x 128-lane TPU tile). Unaligned bucket rows force XLA to
+relayout the (num_buckets, bucket_elems) view whenever per-bucket math
+(mask multiplies, count rescaling) materialises it — measured 10x round
+cost on a 25M-element sync with bucket_elems=3_125_000 vs an aligned
+size. Aligned rows keep the reshape free and the bucket ops fused.
 """
 
 from __future__ import annotations

@@ -30,7 +30,7 @@ from jax import lax
 from akka_allreduce_tpu.ops.bucketing import BucketSpec, bucketize, \
     debucketize, vector_to_tree
 from akka_allreduce_tpu.ops.masked import expand_bucket_counts, \
-    masked_allreduce, rescale_by_count
+    masked_allreduce
 from akka_allreduce_tpu.utils.vma import _axis_tuple, psum_all
 
 
@@ -49,13 +49,18 @@ class GradSyncConfig:
     # the rank count, so a no-straggler round equals the exact psum and a
     # lossy round is the unbiased scale-up).
     rescale_target: float = 1.0
+    # Materialise the per-element counts pytree (the reference sink's
+    # ``AllReduceOutput.count`` payload). Costs a full-size int32 tensor
+    # (an extra HBM pass); callers that only need the per-bucket counts
+    # (training loops, benchmarks) turn it off and read bucket_counts.
+    return_elem_counts: bool = True
 
 
 @dataclasses.dataclass
 class GradSyncResult:
     """The AllReduceOutput equivalent: reduced gradients, per-element counts
-    (as a pytree congruent with the gradients), and the raw per-bucket
-    counts for observability."""
+    (as a pytree congruent with the gradients; None when the config opted
+    out), and the raw per-bucket counts for observability."""
 
     grads: Any
     counts: Any
@@ -85,23 +90,30 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         for a in _axis_tuple(config.axis_name):
             group *= lax.axis_size(a)
         bucket_counts = jnp.full((spec.num_buckets,), group, jnp.int32)
+        if config.average:
+            summed = summed * (config.rescale_target / group)
     else:
         summed, bucket_counts = masked_allreduce(buckets, valid,
                                                  config.axis_name)
-        group = None
+        if config.average:
+            # per-BUCKET rescale while still in bucket shape: the tiny
+            # (num_buckets, 1) factor broadcasts into the same HBM pass,
+            # instead of materialising + reading a full-size per-element
+            # count tensor (rescale_by_count) — same math, ~3 fewer passes
+            c = bucket_counts.astype(summed.dtype)
+            factor = jnp.where(c > 0,
+                               config.rescale_target / jnp.maximum(c, 1.0),
+                               0.0)
+            summed = summed * factor[:, None]
 
     vec = summed.reshape(-1)[:spec.total_size]
-    per_elem = expand_bucket_counts(bucket_counts, spec)
-    if config.average:
-        if group is not None:
-            vec = vec * (config.rescale_target / group)
-        else:
-            vec = rescale_by_count(vec, per_elem,
-                                   target=config.rescale_target)
     out_tree = vector_to_tree(vec, spec)
 
-    counts_spec = dataclasses.replace(
-        spec, dtypes=tuple(jnp.int32 for _ in spec.dtypes))
-    counts_tree = vector_to_tree(per_elem, counts_spec)
+    counts_tree = None
+    if config.return_elem_counts:
+        per_elem = expand_bucket_counts(bucket_counts, spec)
+        counts_spec = dataclasses.replace(
+            spec, dtypes=tuple(jnp.int32 for _ in spec.dtypes))
+        counts_tree = vector_to_tree(per_elem, counts_spec)
     return GradSyncResult(grads=out_tree, counts=counts_tree,
                           bucket_counts=bucket_counts, spec=spec)

@@ -101,6 +101,23 @@ class TestAllreduceGradients:
 
         step(jnp.ones((N, 1), dtype=jnp.float32))
 
+    def test_elem_counts_opt_out(self, mesh):
+        """Hot-path configs skip the full-size counts tree; bucket_counts
+        (the tiny per-bucket piggyback) must still be exact."""
+        cfg = GradSyncConfig(bucket_elems=8, return_elem_counts=False)
+        valid = jnp.zeros((3,), jnp.float32).at[:2].set(1.0)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"))
+        def step(x):
+            res = allreduce_gradients(per_rank_grads(x[0, 0]), cfg,
+                                      valid=valid)
+            assert res.counts is None
+            return res.bucket_counts[None]
+
+        counts = np.asarray(step(jnp.ones((N, 1), jnp.float32)))[0]
+        np.testing.assert_array_equal(counts, [N, N, 0])
+
 
 class TestRoundPacer:
     def test_window_bounds_inflight_rounds(self):
