@@ -66,6 +66,9 @@ class TrainConfig:
     # pipeline parallelism: microbatches per step (only read when the mesh
     # has pp > 1; the local batch must divide by it)
     microbatches: int = 1
+    # gradient-sync wire format: "f32" or "int8" (quantized two-phase
+    # allreduce — needs exactly one data axis of size > 1)
+    grad_transport: str = "f32"
 
 
 def _uniform_layer_spec(cfg: TransformerConfig) -> tuple[dict, dict, dict]:
@@ -220,11 +223,13 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     gcfg = GradSyncConfig(bucket_elems=cfg.bucket_elems,
                           axis_name=dense_axes, average=True,
                           rescale_target=float(n_dense_ranks),
-                          return_elem_counts=False)
+                          return_elem_counts=False,
+                          transport=cfg.grad_transport)
     gcfg_expert = GradSyncConfig(bucket_elems=cfg.bucket_elems,
                                  axis_name=cfg.grad_axes, average=True,
                                  rescale_target=float(n_expert_ranks),
-                                 return_elem_counts=False)
+                                 return_elem_counts=False,
+                                 transport=cfg.grad_transport)
 
     def targets_and_weights(tokens):
         """Per-token next-token targets and loss weights; under sp the
@@ -258,7 +263,19 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     metric_axes = dense_axes + (("pp",) if has_pp else ())
     disp_norm = n_dense_ranks * (pp_size if has_pp else 1)
 
-    def sync_and_metrics(loss, aux, grads, total_count):
+    def derive_quant_key(quant_seed, tokens):
+        """Stochastic-rounding key for the int8 transport: folds in the
+        caller's per-round seed (make_train_step passes the optimizer step
+        count) AND the batch content, so repeated batches and repeated
+        steps both get fresh rounding noise — the unbiasedness-across-
+        rounds requirement of the quantized collective — while the step
+        stays a pure function of its inputs."""
+        if cfg.grad_transport == "f32":
+            return None
+        k = jax.random.fold_in(jax.random.key(17), quant_seed)
+        return jax.random.fold_in(k, jnp.sum(tokens).astype(jnp.uint32))
+
+    def sync_and_metrics(loss, aux, grads, total_count, quant_key):
         # Gradient sync over the data axes: the framework's bucketed,
         # counted collective — THE allreduce the reference exists for.
         # Gradients for tp shards need no sync (tp_grad_boundary completed
@@ -277,13 +294,16 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                     grads[k] = psum_all(grads[k], "pp")
         if has_moe:
             dense, expert = split_expert_leaves(grads)
-            res = allreduce_gradients(dense, gcfg, valid=valid_buckets)
-            res_e = allreduce_gradients(expert, gcfg_expert)
+            res = allreduce_gradients(dense, gcfg, valid=valid_buckets,
+                                      quant_key=quant_key)
+            res_e = allreduce_gradients(expert, gcfg_expert,
+                                        quant_key=quant_key)
             grads_out = merge_expert_leaves(res.grads, res_e.grads)
             min_count = jnp.minimum(res.bucket_counts.min(),
                                     res_e.bucket_counts.min())
         else:
-            res = allreduce_gradients(grads, gcfg, valid=valid_buckets)
+            res = allreduce_gradients(grads, gcfg, valid=valid_buckets,
+                                      quant_key=quant_key)
             grads_out = res.grads
             min_count = res.bucket_counts.min()
         metrics = {
@@ -297,7 +317,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         }
         return grads_out, metrics
 
-    def grad_local(params, tokens):
+    def grad_local(params, tokens, quant_seed):
         targets, weights, positions = targets_and_weights(tokens)
         total_count = psum_all(weights.sum(), dense_axes)
 
@@ -311,9 +331,10 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
-        return sync_and_metrics(loss, aux, grads, total_count)
+        return sync_and_metrics(loss, aux, grads, total_count,
+                                derive_quant_key(quant_seed, tokens))
 
-    def grad_local_pp(params, tokens):
+    def grad_local_pp(params, tokens, quant_seed):
         targets, weights, positions = targets_and_weights(tokens)
         total_count = psum_all(weights.sum(), dense_axes)
         m = cfg.microbatches
@@ -352,19 +373,27 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
-        return sync_and_metrics(loss, aux, grads, total_count)
+        return sync_and_metrics(loss, aux, grads, total_count,
+                                derive_quant_key(quant_seed, tokens))
 
     # check_vma=False: varying-axis tracking would auto-insert psums over
     # the data axes in the backward pass (pvary transpose), taking gradient
     # sync out of the framework's hands — the explicit Megatron boundary
     # (parallel/tp.py) plus allreduce_gradients carry it instead.
     batch_axes = ("dp", "ep") if "ep" in mesh.shape else "dp"
-    return jax.shard_map(
+    mapped = jax.shard_map(
         grad_local_pp if has_pp else grad_local, mesh=mesh,
-        in_specs=(specs, P(batch_axes, "sp")),
+        in_specs=(specs, P(batch_axes, "sp"), P()),
         out_specs=(specs, P()),
         check_vma=False,
     )
+
+    def grad_step(params, tokens, quant_seed=None):
+        seed = jnp.asarray(0 if quant_seed is None else quant_seed,
+                           jnp.uint32)
+        return mapped(params, tokens, seed)
+
+    return grad_step
 
 
 def make_train_step(cfg: TrainConfig, mesh: Mesh,
@@ -376,7 +405,10 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
 
     @jax.jit
     def step(params, opt_state, tokens):
-        grads, metrics = grad_step(params, tokens)
+        # the optimizer's step counter seeds the int8 transport's rounding
+        # noise, so every round draws fresh bits even on repeated batches
+        count = optax.tree_utils.tree_get(opt_state, "count")
+        grads, metrics = grad_step(params, tokens, quant_seed=count)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, metrics

@@ -1,0 +1,176 @@
+"""Quantized (int8-wire) two-phase allreduce tests.
+
+Accuracy model: two quantize/dequantize hops, each with per-chunk
+symmetric int8 scaling — worst-case relative error ~2/127 of the chunk
+abs-max per hop — and stochastic rounding making the error zero-mean, so
+averaging over independent keys converges on the exact sum.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.models.train import (
+    TrainConfig,
+    make_train_state,
+    make_train_step,
+)
+from akka_allreduce_tpu.models.transformer import TransformerConfig
+from akka_allreduce_tpu.ops.collectives import quantized_two_phase_allreduce
+from akka_allreduce_tpu.parallel.dp import GradSyncConfig, allreduce_gradients
+from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh, \
+    single_axis_mesh
+
+N = 8
+
+
+def run_quantized(stacked, key, rows=8):
+    """stacked: (N, elems); quantize as ``rows`` bucket rows per rank."""
+    mesh = single_axis_mesh("dp")
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P()),
+             out_specs=P("dp"), check_vma=False)
+    def f(xs, k):
+        buckets = xs[0].reshape(rows, -1)
+        out = quantized_two_phase_allreduce(buckets, k, "dp")
+        return out.reshape(-1)[None]
+
+    return f(stacked, key)
+
+
+class TestQuantizedAllreduce:
+    def test_close_to_exact_sum(self):
+        rng = np.random.default_rng(0)
+        stacked = jnp.asarray(rng.normal(size=(N, 1024)).astype(np.float32))
+        out = run_quantized(stacked, jax.random.key(1))
+        exact = np.asarray(stacked.sum(0))
+        # every rank sees the same reduced vector
+        for r in range(N):
+            got = np.asarray(out[r])
+            np.testing.assert_allclose(got, exact,
+                                       atol=3 * 2 / 127 * N
+                                       * np.abs(stacked).max())
+
+    def test_rank_rows_identical(self):
+        rng = np.random.default_rng(1)
+        stacked = jnp.asarray(rng.normal(size=(N, 512)).astype(np.float32))
+        out = np.asarray(run_quantized(stacked, jax.random.key(2)))
+        for r in range(1, N):
+            np.testing.assert_array_equal(out[0], out[r])
+
+    def test_stochastic_rounding_is_unbiased(self):
+        rng = np.random.default_rng(2)
+        stacked = jnp.asarray(rng.normal(size=(N, 256)).astype(np.float32))
+        exact = np.asarray(stacked.sum(0))
+        outs = np.stack([np.asarray(run_quantized(stacked,
+                                                  jax.random.key(s))[0])
+                         for s in range(32)])
+        single_err = np.abs(outs[0] - exact).mean()
+        mean_err = np.abs(outs.mean(0) - exact).mean()
+        # averaging over keys must beat any single draw by a clear margin
+        assert mean_err < single_err / 2
+
+    def test_flat_input_rejected(self):
+        mesh = single_axis_mesh("dp")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def f(xs):
+            return quantized_two_phase_allreduce(
+                xs[0], jax.random.key(0), "dp")[None]
+
+        with pytest.raises(ValueError, match="num_buckets"):
+            f(jnp.ones((N, 1001), jnp.float32))
+
+    def test_row_count_not_divisible_by_ranks_pads(self):
+        # 3 bucket rows over 8 ranks: internal zero-row padding, result
+        # still exact-shaped and close to the true sum
+        rng = np.random.default_rng(7)
+        stacked = jnp.asarray(rng.normal(size=(N, 3 * 256))
+                              .astype(np.float32))
+        out = run_quantized(stacked, jax.random.key(3), rows=3)
+        exact = np.asarray(stacked.sum(0))
+        assert out.shape == (N, 3 * 256)
+        np.testing.assert_allclose(np.asarray(out[0]), exact,
+                                   atol=3 * 2 / 127 * N
+                                   * np.abs(stacked).max())
+
+    def test_outlier_bucket_damage_is_confined(self):
+        # row 0 carries a 1e4-scale outlier; row 1 is ~1e-2. Per-bucket
+        # scales must keep row 1's error at row-1 scale, not row-0 scale.
+        rng = np.random.default_rng(8)
+        big = rng.normal(size=(N, 256)).astype(np.float32) * 1e4
+        small = rng.normal(size=(N, 256)).astype(np.float32) * 1e-2
+        stacked = jnp.asarray(np.concatenate([big, small], axis=1))
+        out = run_quantized(stacked, jax.random.key(4), rows=2)
+        exact_small = small.sum(0)
+        err_small = np.abs(np.asarray(out[0])[256:] - exact_small).max()
+        # error bounded by the SMALL row's quantization step, with room
+        assert err_small < 3 * 2 / 127 * N * np.abs(small).max()
+
+
+class TestInt8GradSync:
+    def test_grad_sync_matches_f32_within_quant_error(self):
+        mesh = single_axis_mesh("dp")
+        grads = {"w": jnp.asarray(
+            np.random.default_rng(3).normal(size=(64, 16))
+            .astype(np.float32))}
+        cfg8 = GradSyncConfig(bucket_elems=128, transport="int8",
+                              return_elem_counts=False)
+        cfg32 = GradSyncConfig(bucket_elems=128,
+                               return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")), check_vma=False)
+        def f(xs):
+            g = {"w": xs[0]}
+            r8 = allreduce_gradients(g, cfg8,
+                                     quant_key=jax.random.key(5))
+            r32 = allreduce_gradients(g, cfg32)
+            return r8.grads["w"][None], r32.grads["w"][None]
+
+        stacked = jnp.asarray(np.random.default_rng(4).normal(
+            size=(N, 64, 16)).astype(np.float32))
+        g8, g32 = f(stacked)
+        err = np.abs(np.asarray(g8[0]) - np.asarray(g32[0])).max()
+        scale = np.abs(np.asarray(g32[0])).max()
+        assert err < 0.1 * scale
+        assert err > 0  # it actually quantized
+
+    def test_multi_axis_transport_rejected(self):
+        mesh = make_device_mesh(MeshSpec(dp=4, sp=2))
+        cfg = GradSyncConfig(bucket_elems=64, axis_name=("dp", "sp"),
+                             transport="int8")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp", "sp"),
+                 out_specs=P("dp", "sp"), check_vma=False)
+        def f(xs):
+            res = allreduce_gradients({"w": xs[0, 0]}, cfg)
+            return res.grads["w"][None, None]
+
+        with pytest.raises(ValueError, match="single"):
+            f(jnp.ones((4, 2, 64), jnp.float32))
+
+
+class TestInt8Training:
+    def test_training_converges_with_int8_transport(self):
+        mesh = make_device_mesh(MeshSpec(dp=8))
+        mcfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=4,
+                                 n_layers=2, d_ff=64, max_seq=32)
+        cfg = TrainConfig(model=mcfg, bucket_elems=1024,
+                          grad_axes=("dp",), grad_transport="int8")
+        tokens = jnp.asarray(np.random.default_rng(5).integers(
+            0, 61, size=(8, 32), dtype=np.int32))
+        params, opt_state, opt = make_train_state(jax.random.key(0), cfg,
+                                                  mesh)
+        step = make_train_step(cfg, mesh, opt)
+        losses = []
+        for _ in range(4):
+            params, opt_state, metrics = step(params, opt_state, tokens)
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
