@@ -9,10 +9,13 @@ pacing, straggler catch-up).
 Two planes, mirroring the reference's actor split but mapped to TPU hardware:
 
 * **Device plane** (`ops/`, `parallel/`): the hot path. Bucketed gradients
-  lower to XLA ``reduce_scatter`` + ``all_gather`` (or fused ``psum``) over
-  ICI via ``shard_map``; lossy threshold semantics become mask/count
-  arithmetic (``psum`` of ``(values*valid, valid)``); Pallas kernels cover
-  custom ring schedules and quantized transport.
+  lower to XLA ``reduce_scatter`` + ``all_gather`` (or fused ``psum``, or
+  the int8-quantized two-phase collective) over ICI via ``shard_map``;
+  lossy threshold semantics become mask/count arithmetic (``psum`` of
+  ``(values*valid, valid)``); Pallas kernels cover custom ring schedules
+  and quantized transport. On top sits the five-axis parallel stack —
+  dp / tp (Megatron) / sp (ring attention) / pp (GPipe) / ep (MoE) — over
+  one ``jax.sharding.Mesh``, composed in ``models/train.py``.
 * **Host control plane** (`protocol/`, `runtime/`): membership, rank
   assignment, round pacing with a ``max_lag`` staleness window, straggler
   catch-up, and completion tally — the exact observable semantics of the
