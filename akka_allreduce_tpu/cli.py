@@ -58,6 +58,12 @@ def _cmd_emulate(args: argparse.Namespace) -> int:
                                                      ThroughputSink,
                                                      constant_range_source)
 
+    if args.assert_multiple > 0 and not (
+            args.th_allreduce == args.th_reduce == args.th_complete == 1.0):
+        print("error: --assert-multiple requires all thresholds at 1.0 "
+              "(lossy rounds legitimately produce partial sums); pass "
+              "--th-complete 1.0 etc.", file=sys.stderr)
+        return 2
     data_size = args.workers * 5 if args.data_size is None else args.data_size
     config = AllreduceConfig(
         thresholds=ThresholdConfig(args.th_allreduce, args.th_reduce,
@@ -180,6 +186,10 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                    help="global sequence (0 = 32 per sp rank)")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--bucket-elems", type=int, default=1 << 16)
+    p.add_argument("--bf16", action="store_true",
+                   help="bfloat16 compute with f32 master weights")
+    p.add_argument("--int8-grads", action="store_true",
+                   help="int8-quantized gradient allreduce transport")
     p.add_argument("--ckpt-dir", default=None,
                    help="checkpoint directory; resumes from the latest "
                         "checkpoint if one exists")
@@ -229,7 +239,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                              d_ff=args.d_ff, max_seq=t,
                              moe=moe, moe_every=args.moe_every)
     cfg = TrainConfig(model=mcfg, learning_rate=args.lr,
-                      bucket_elems=args.bucket_elems, microbatches=micro)
+                      bucket_elems=args.bucket_elems, microbatches=micro,
+                      compute_dtype="bf16" if args.bf16 else "f32",
+                      grad_transport="int8" if args.int8_grads else "f32")
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
     step = make_train_step(cfg, mesh, opt)
 

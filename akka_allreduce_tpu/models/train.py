@@ -69,6 +69,11 @@ class TrainConfig:
     # gradient-sync wire format: "f32" or "int8" (quantized two-phase
     # allreduce — needs exactly one data axis of size > 1)
     grad_transport: str = "f32"
+    # "bf16" runs the model compute (matmuls, activations) in bfloat16 on
+    # the MXU while master weights, gradients, and the optimizer stay f32
+    # (loss/softmax/norm statistics are f32 internally regardless); "f32"
+    # is full precision end to end
+    compute_dtype: str = "f32"
 
 
 def _uniform_layer_spec(cfg: TransformerConfig) -> tuple[dict, dict, dict]:
@@ -263,6 +268,19 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     metric_axes = dense_axes + (("pp",) if has_pp else ())
     disp_norm = n_dense_ranks * (pp_size if has_pp else 1)
 
+    if cfg.compute_dtype not in ("f32", "bf16"):
+        raise ValueError(f"unknown compute_dtype {cfg.compute_dtype!r}")
+
+    def cast_compute(p):
+        """f32 master params -> bf16 compute copies (autodiff casts the
+        cotangents back to f32, so synced grads and the optimizer stay
+        full precision — standard TPU mixed precision)."""
+        if cfg.compute_dtype == "f32":
+            return p
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, p)
+
     def derive_quant_key(quant_seed, tokens):
         """Stochastic-rounding key for the int8 transport: folds in the
         caller's per-round seed (make_train_step passes the optimizer step
@@ -323,8 +341,8 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
         def loss_fn(p):
             loss_sum, _, aux = next_token_loss_and_aux(
-                p, tokens, mcfg, positions, attn, tp_axis, ep_axis,
-                targets=targets, weights=weights)
+                cast_compute(p), tokens, mcfg, positions, attn, tp_axis,
+                ep_axis, targets=targets, weights=weights)
             # exact global-mean scaling: psum of these local losses (and of
             # their grads) is the global mean loss (and its gradient)
             return loss_sum / total_count, aux
@@ -351,6 +369,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             return scan_blocks(stacked, h, block)
 
         def loss_fn(p):
+            p = cast_compute(p)
             x = p["embed"][tokens] + p["pos"][positions]
             xm = x.reshape(m, b_local // m, t_local, x.shape[-1])
             outs, aux = gpipe_apply(p["layers"], xm, stage, "pp")

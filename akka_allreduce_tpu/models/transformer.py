@@ -53,8 +53,11 @@ class TransformerConfig:
 
 
 def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
-    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
-    return x * jax.lax.rsqrt(var + 1e-6) * scale
+    """RMS statistics in f32 regardless of compute dtype (bf16 squares
+    lose ~5 bits where the variance needs them), result back in x's."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
 
 def init_transformer(key: jax.Array, cfg: TransformerConfig,

@@ -31,13 +31,17 @@ NEG_INF = -1e30
 def _block_attention(q, k, v, m, l, acc, q_offset, k_offset, causal):
     """One blockwise attention accumulation step with online softmax.
 
-    q: (B, Tq, H, D); k, v: (B, Tk, H, D); m, l: (B, H, Tq);
-    acc: (B, Tq, H, D). Offsets are the blocks' global sequence positions,
-    used for causal masking across ranks.
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D); m, l: (B, H, Tq) f32;
+    acc: (B, Tq, H, D) f32. Offsets are the blocks' global sequence
+    positions, used for causal masking across ranks. Softmax statistics
+    and the output accumulator run in f32 regardless of the input dtype
+    (the flash-attention rule: bf16 matmuls on the MXU, f32 running
+    max/sum/accumulate or long-sequence exp sums drift).
     """
     scale = q.shape[-1] ** -0.5
-    # scores: (B, H, Tq, Tk)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # scores: (B, H, Tq, Tk) — f32 accumulation out of the MXU
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = k_offset + jnp.arange(k.shape[1])
@@ -48,11 +52,12 @@ def _block_attention(q, k, v, m, l, acc, q_offset, k_offset, causal):
     # correction of previously accumulated stats (guard the -inf init so
     # exp(-inf - -inf) can't NaN)
     correction = jnp.exp(jnp.minimum(m, m_new) - m_new)
-    p = jnp.exp(scores - m_new[..., None])  # (B, H, Tq, Tk)
+    p = jnp.exp(scores - m_new[..., None])  # (B, H, Tq, Tk) f32
     if causal:
         p = jnp.where(mask[None, None], p, 0.0)
     l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
     acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, acc_new
 
@@ -73,12 +78,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     # constant-initialised carries must be typed as varying over the ring
     # axis or scan rejects the carry (the step outputs depend on
-    # ring-position data)
-    m0 = cast_varying(jnp.full((b, h, t_local), NEG_INF, dtype=q.dtype),
+    # ring-position data); stats/accumulator are f32 (see _block_attention)
+    m0 = cast_varying(jnp.full((b, h, t_local), NEG_INF, jnp.float32),
                       (axis_name,))
-    l0 = cast_varying(jnp.zeros((b, h, t_local), dtype=q.dtype),
+    l0 = cast_varying(jnp.zeros((b, h, t_local), jnp.float32),
                       (axis_name,))
-    acc0 = jnp.zeros_like(q)  # already varying: derived from q
+    acc0 = cast_varying(jnp.zeros(q.shape, jnp.float32), (axis_name,))
 
     # Ring schedule: at step s every rank holds the K/V block originally
     # owned by rank (my_idx - s) % n, then passes it to the right neighbor —
@@ -111,17 +116,21 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     # normalise; causal rows always include the query's own position so
     # l > 0 everywhere
-    return acc / l.transpose(0, 2, 1)[..., None]
+    return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
 def local_causal_attention(q: jnp.ndarray, k: jnp.ndarray,
                            v: jnp.ndarray) -> jnp.ndarray:
     """Single-rank reference attention (no sequence sharding): the oracle
-    ring_attention must match."""
+    ring_attention must match. Same precision rule: f32 scores/softmax,
+    bf16-friendly matmuls."""
     scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     t = q.shape[1]
     mask = jnp.tril(jnp.ones((t, t), dtype=bool))
     scores = jnp.where(mask[None, None], scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
