@@ -190,6 +190,9 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                    help="bfloat16 compute with f32 master weights")
     p.add_argument("--int8-grads", action="store_true",
                    help="int8-quantized gradient allreduce transport")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialise activations per block (long-context"
+                        " memory saver)")
     p.add_argument("--ckpt-dir", default=None,
                    help="checkpoint directory; resumes from the latest "
                         "checkpoint if one exists")
@@ -241,7 +244,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
     cfg = TrainConfig(model=mcfg, learning_rate=args.lr,
                       bucket_elems=args.bucket_elems, microbatches=micro,
                       compute_dtype="bf16" if args.bf16 else "f32",
-                      grad_transport="int8" if args.int8_grads else "f32")
+                      grad_transport="int8" if args.int8_grads else "f32",
+                      remat=args.remat)
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
     step = make_train_step(cfg, mesh, opt)
 

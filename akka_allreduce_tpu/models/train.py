@@ -74,6 +74,10 @@ class TrainConfig:
     # (loss/softmax/norm statistics are f32 internally regardless); "f32"
     # is full precision end to end
     compute_dtype: str = "f32"
+    # checkpoint (rematerialise) each transformer block in the backward
+    # pass: activation memory drops from O(layers) to O(1) blocks at the
+    # cost of one extra forward — the long-context lever
+    remat: bool = False
 
 
 def _uniform_layer_spec(cfg: TransformerConfig) -> tuple[dict, dict, dict]:
@@ -342,7 +346,8 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         def loss_fn(p):
             loss_sum, _, aux = next_token_loss_and_aux(
                 cast_compute(p), tokens, mcfg, positions, attn, tp_axis,
-                ep_axis, targets=targets, weights=weights)
+                ep_axis, targets=targets, weights=weights,
+                remat=cfg.remat)
             # exact global-mean scaling: psum of these local losses (and of
             # their grads) is the global mean loss (and its gradient)
             return loss_sum / total_count, aux
@@ -364,6 +369,9 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
         def block(lyr, h):
             return transformer_block(lyr, h, mcfg, attn, tp_axis, ep_axis)
+
+        if cfg.remat:
+            block = jax.checkpoint(block)
 
         def stage(stacked, h):
             return scan_blocks(stacked, h, block)

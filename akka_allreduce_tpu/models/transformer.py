@@ -188,7 +188,8 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
                                positions: Optional[jnp.ndarray] = None,
                                attn_fn: AttnFn = local_causal_attention,
                                tp_axis: Optional[str] = None,
-                               ep_axis: Optional[str] = None
+                               ep_axis: Optional[str] = None,
+                               remat: bool = False
                                ) -> tuple[jnp.ndarray, dict]:
     """tokens: (B, T_local) int32 → (logits (B, T_local, vocab), aux).
 
@@ -196,8 +197,11 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
     under sequence sharding; defaults to 0..T-1). When ``tp_axis`` is set,
     the per-layer weight shards passed in params are already the local tp
     slices and head count is the local count. ``ep_axis`` routes MoE layers
-    over that mesh axis (None = all experts local). aux: ``aux_loss`` (sum
-    of MoE load-balance losses, per-token-mean scale) and
+    over that mesh axis (None = all experts local). ``remat`` checkpoints
+    each block: activations are recomputed in the backward pass instead of
+    stored — O(sqrt)-ish activation memory, the long-context lever
+    (gradients are bit-identical; only the schedule changes). aux:
+    ``aux_loss`` (sum of MoE load-balance losses, per-token-mean scale) and
     ``dispatch_fraction`` (mean over MoE layers; 1.0 when there are none).
     """
     t = tokens.shape[1]
@@ -205,9 +209,15 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
         positions = jnp.arange(t)
     x = params["embed"][tokens] + params["pos"][positions]
 
+    def block(layer, h):
+        return transformer_block(layer, h, cfg, attn_fn, tp_axis, ep_axis)
+
+    if remat:
+        block = jax.checkpoint(block)
+
     aux_total: dict = {}
     for layer in params["layers"]:
-        x, aux = transformer_block(layer, x, cfg, attn_fn, tp_axis, ep_axis)
+        x, aux = block(layer, x)
         aux_total = _merge_aux(aux_total, aux)
 
     x = rmsnorm(x, params["out_norm"])
@@ -233,7 +243,8 @@ def next_token_loss_and_aux(params: dict, tokens: jnp.ndarray,
                             tp_axis: Optional[str] = None,
                             ep_axis: Optional[str] = None,
                             targets: Optional[jnp.ndarray] = None,
-                            weights: Optional[jnp.ndarray] = None
+                            weights: Optional[jnp.ndarray] = None,
+                            remat: bool = False
                             ) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
     """Weighted summed next-token cross-entropy, total weight, and MoE aux
     (sums, not means, so multi-rank losses combine exactly via psum). The
@@ -247,7 +258,8 @@ def next_token_loss_and_aux(params: dict, tokens: jnp.ndarray,
     global final token).
     """
     logits, aux = transformer_apply_with_aux(
-        params, tokens, cfg, positions, attn_fn, tp_axis, ep_axis)
+        params, tokens, cfg, positions, attn_fn, tp_axis, ep_axis,
+        remat=remat)
     if targets is None:
         logits = logits[:, :-1]
         tgt = tokens[:, 1:]

@@ -50,8 +50,6 @@ class TestBf16Attention:
 
         from jax.sharding import PartitionSpec as P
 
-        from akka_allreduce_tpu.parallel.mesh import make_device_mesh
-
         mesh = make_device_mesh(axis_names=("sp",), axis_sizes=(8,))
         rng = np.random.default_rng(1)
         q, k, v = (jnp.asarray(rng.normal(size=(2, 32, 4, 8))
