@@ -52,8 +52,11 @@ from akka_allreduce_tpu.parallel.pp import (
     scan_blocks,
     stack_layer_params,
 )
-from akka_allreduce_tpu.parallel.ring_attention import ring_attention, \
-    local_causal_attention
+from akka_allreduce_tpu.parallel.ring_attention import (
+    blockwise_causal_attention,
+    local_causal_attention,
+    ring_attention,
+)
 from akka_allreduce_tpu.utils.vma import psum_all
 
 
@@ -78,6 +81,11 @@ class TrainConfig:
     # pass: activation memory drops from O(layers) to O(1) blocks at the
     # cost of one extra forward — the long-context lever
     remat: bool = False
+    # KV block size for single-rank (no-sp) attention: when set, causal
+    # attention walks KV blocks with online softmax instead of
+    # materialising the (T, T) score tensor — the rank-local long-context
+    # path (must divide the local sequence length)
+    attn_block_size: Optional[int] = None
 
 
 def _uniform_layer_spec(cfg: TransformerConfig) -> tuple[dict, dict, dict]:
@@ -262,8 +270,13 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         weights = weights.at[:, -1].set(1.0 - is_last)
         return targets, weights, positions
 
-    attn = partial(ring_attention, axis_name="sp", causal=True) if has_sp \
-        else local_causal_attention
+    if has_sp:
+        attn = partial(ring_attention, axis_name="sp", causal=True)
+    elif cfg.attn_block_size:
+        attn = partial(blockwise_causal_attention,
+                       block_size=cfg.attn_block_size)
+    else:
+        attn = local_causal_attention
 
     # metrics reduce over every axis the quantity varies over; under pp the
     # loss/aux pieces are spread across stages too. dispatch_fraction is a

@@ -119,6 +119,43 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
+def blockwise_causal_attention(q: jnp.ndarray, k: jnp.ndarray,
+                               v: jnp.ndarray, block_size: int = 512
+                               ) -> jnp.ndarray:
+    """Single-rank causal attention with KV blocking + online softmax.
+
+    The rank-local long-context path: instead of materialising the full
+    (B, H, T, T) score tensor, ``lax.scan`` walks K/V blocks of
+    ``block_size`` and folds each into the running (m, l, acc) statistics —
+    the same math as one ring step (ring attention IS this loop with the
+    blocks living on other ranks), so peak score memory is O(T x block)
+    per head. Requires T % block_size == 0 (pick block_size as a divisor;
+    sequence lengths here are static).
+    """
+    b, t, h, d = q.shape
+    if t <= block_size:
+        return local_causal_attention(q, k, v)
+    if t % block_size:
+        raise ValueError(
+            f"sequence {t} not divisible by block_size {block_size}")
+    nb = t // block_size
+
+    m0 = jnp.full((b, h, t), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+
+    def step(carry, i):
+        m, l, acc = carry
+        k_blk = lax.dynamic_slice_in_dim(k, i * block_size, block_size, 1)
+        v_blk = lax.dynamic_slice_in_dim(v, i * block_size, block_size, 1)
+        m, l, acc = _block_attention(q, k_blk, v_blk, m, l, acc,
+                                     0, i * block_size, True)
+        return (m, l, acc), None
+
+    (m, l, acc), _ = lax.scan(step, (m0, l0, acc0), jnp.arange(nb))
+    return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
 def local_causal_attention(q: jnp.ndarray, k: jnp.ndarray,
                            v: jnp.ndarray) -> jnp.ndarray:
     """Single-rank reference attention (no sequence sharding): the oracle
