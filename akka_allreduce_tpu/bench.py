@@ -34,6 +34,8 @@ faster than the reference transport's best possible goodput.
 """
 
 import json
+import os
+import sys
 import time
 from functools import partial
 
@@ -59,6 +61,13 @@ R_HI, R_LO = 200, 50
 REFERENCE_TRANSPORT_CEILING_GBPS = 1.25
 
 
+def _log(msg: str) -> None:
+    """Progress goes to stderr so stdout stays a single parseable JSON line
+    (the reference's sink likewise prints progress as it goes, reference:
+    AllreduceWorker.scala:329-343)."""
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
 def measure_device_goodput(elems: int, bucket_elems: int,
                            r_hi: int = R_HI, r_lo: int = R_LO,
                            valid_fraction: float = 1.0,
@@ -67,8 +76,12 @@ def measure_device_goodput(elems: int, bucket_elems: int,
     real devices. ``valid_fraction < 1`` exercises the lossy masked path
     (BASELINE.md config #4): that fraction of buckets contributes per round
     and the result is count-rescaled."""
+    _log("initializing backend (jax.devices()) ...")
     devices = jax.devices()
     n = len(devices)
+    _log(f"backend up: {n} x {devices[0].platform} "
+         f"({elems} elems, buckets of {bucket_elems}, rounds "
+         f"{r_lo}/{r_hi}, reps {reps})")
     mesh = single_axis_mesh("dp", devices=devices)
     num_buckets = num_chunks(elems, bucket_elems)
     lossy = valid_fraction < 1.0
@@ -112,8 +125,10 @@ def measure_device_goodput(elems: int, bucket_elems: int,
                      (n, 1, 1))
 
     def measure(rounds):
+        _log(f"compiling + warming up {rounds}-round scan ...")
         f = make(rounds)
         np.asarray(f(x0, seeds).addressable_shards[0].data[0, :4])  # warmup
+        _log(f"measuring {rounds}-round scan x{reps} ...")
         ts = []
         for i in range(reps):
             t0 = time.perf_counter()
@@ -131,15 +146,42 @@ def measure_device_goodput(elems: int, bucket_elems: int,
 
 
 def main() -> None:
+    """One measurement attempt on one platform; the repo-root ``bench.py``
+    orchestrates attempts under a watchdog so a JSON line always lands.
+
+    Env knobs (all optional):
+      AATPU_BENCH_PLATFORM  "default" (whatever backend JAX picks) or "cpu"
+                            (force the CPU platform before backend init —
+                            the recipe tests/conftest.py documents; this
+                            environment's default TPU backend can hang for
+                            tens of minutes before failing UNAVAILABLE).
+      AATPU_BENCH_ELEMS / AATPU_BENCH_BUCKET_ELEMS / AATPU_BENCH_R_HI /
+      AATPU_BENCH_R_LO / AATPU_BENCH_REPS  measurement sizing.
+    """
+    platform = os.environ.get("AATPU_BENCH_PLATFORM", "default")
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elems = int(os.environ.get("AATPU_BENCH_ELEMS", ELEMS))
+    bucket_elems = int(os.environ.get("AATPU_BENCH_BUCKET_ELEMS",
+                                      min(BUCKET_ELEMS, elems)))
+    r_hi = int(os.environ.get("AATPU_BENCH_R_HI", R_HI))
+    r_lo = int(os.environ.get("AATPU_BENCH_R_LO", R_LO))
+    reps = int(os.environ.get("AATPU_BENCH_REPS", 3))
+    if not 0 < r_lo < r_hi:
+        raise SystemExit(f"need 0 < R_LO < R_HI, got {r_lo}/{r_hi}")
+    goodput_gbps = measure_device_goodput(elems, bucket_elems,
+                                          r_hi=r_hi, r_lo=r_lo, reps=reps)
     n = len(jax.devices())
-    goodput_gbps = measure_device_goodput(ELEMS, BUCKET_ELEMS)
+    plat = jax.devices()[0].platform
+    label = "chip" if plat == "tpu" else plat
+    mega = f"{elems / 1_000_000:g}"
     print(json.dumps({
-        "metric": f"allreduce_goodput_25M_f32_{n}chip",
+        "metric": f"allreduce_goodput_{mega}M_f32_{n}{label}",
         "value": round(goodput_gbps, 2),
         "unit": "GB/s",
         "vs_baseline": round(
             goodput_gbps / REFERENCE_TRANSPORT_CEILING_GBPS, 2),
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,15 +1,121 @@
-"""Driver entry point: delegates to the packaged benchmark.
+"""Driver benchmark entry: ALWAYS prints one JSON line to stdout.
 
-See akka_allreduce_tpu/bench.py for the methodology. Kept at the repo root
-as a thin shim because the driver invokes ``python bench.py`` here.
+Round-1 postmortem (VERDICT.md weak #1): the benchmark initialized this
+environment's default TPU backend in-process with no watchdog; the backend
+hung for ~35 minutes before failing UNAVAILABLE, the driver timed out, and
+no number was captured. The reference's measurement contract is a sink that
+always prints (reference: AllreduceWorker.scala:329-343) — so this shim now
+guarantees a JSON line lands no matter what the backend does:
+
+  1. attempt the real measurement (akka_allreduce_tpu/bench.py) on the
+     default backend in a SUBPROCESS with a hard wall-clock timeout;
+  2. on timeout/crash, retry on a forced-CPU platform with a smaller,
+     CPU-sized config (still the full bucketize->psum->rescale path);
+  3. if every attempt fails, print a JSON line with an "error" field.
+
+Progress goes to stderr throughout; stdout carries exactly one JSON line
+(the last one printed wins for the driver's parser, and only successful
+attempts print to stdout).
+
+Env knobs: AATPU_BENCH_TIMEOUT_S (per-attempt wall clock, default 270),
+AATPU_BENCH_PLATFORMS (comma list, default "default,cpu"), plus the sizing
+knobs documented in akka_allreduce_tpu/bench.py (forwarded verbatim).
 """
 
+import json
 import os
+import signal
+import subprocess
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
-from akka_allreduce_tpu.bench import main  # noqa: E402
+# CPU-sized fallback: 2.5M floats (10 MB) x 40 rounds keeps the attempt in
+# tens of seconds on 8 virtual CPU devices while still exercising the full
+# device sync path (bucketize -> psum -> rescale -> debucketize).
+CPU_FALLBACK_ENV = {
+    "AATPU_BENCH_ELEMS": "2500000",
+    "AATPU_BENCH_BUCKET_ELEMS": "312500",
+    "AATPU_BENCH_R_HI": "40",
+    "AATPU_BENCH_R_LO": "10",
+    "AATPU_BENCH_REPS": "2",
+}
+
+
+def _ensure_host_device_count(env: dict, n: int) -> None:
+    """Merge-append the device-count flag into XLA_FLAGS (an existing value
+    must not shadow it — same merge tests/conftest.py does)."""
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _log(msg: str) -> None:
+    print(f"[bench-driver] {msg}", file=sys.stderr, flush=True)
+
+
+def _attempt(platform: str, timeout_s: float) -> "dict | None":
+    """Run one measurement subprocess; return its parsed JSON or None."""
+    env = dict(os.environ)
+    env["AATPU_BENCH_PLATFORM"] = platform
+    if platform == "cpu":
+        for k, v in CPU_FALLBACK_ENV.items():
+            env.setdefault(k, v)
+        _ensure_host_device_count(env, 8)
+    cmd = [sys.executable, "-m", "akka_allreduce_tpu.bench"]
+    _log(f"attempt platform={platform} timeout={timeout_s:.0f}s: "
+         f"{' '.join(cmd)}")
+    # New session so a hung backend init (which ignores SIGTERM while
+    # blocked in C) can be killed as a whole process group.
+    proc = subprocess.Popen(cmd, cwd=REPO_ROOT, env=env,
+                            stdout=subprocess.PIPE, stderr=sys.stderr,
+                            text=True, start_new_session=True)
+    timed_out = False
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _log(f"attempt platform={platform} timed out; killing process group")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        # Recover whatever the child already printed: a measurement that
+        # emitted its JSON and then hung in backend teardown still counts.
+        out, _ = proc.communicate()
+        timed_out = True
+    if proc.returncode != 0 and not timed_out:
+        _log(f"attempt platform={platform} exited rc={proc.returncode}")
+        return None
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed
+    _log(f"attempt platform={platform} printed no JSON line")
+    return None
+
+
+def main() -> None:
+    timeout_s = float(os.environ.get("AATPU_BENCH_TIMEOUT_S", "270"))
+    platforms = os.environ.get("AATPU_BENCH_PLATFORMS", "default,cpu")
+    errors = []
+    for platform in [p.strip() for p in platforms.split(",") if p.strip()]:
+        result = _attempt(platform, timeout_s)
+        if result is not None:
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(f"{platform}: timeout/crash/no-json")
+    print(json.dumps({
+        "metric": "allreduce_goodput",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors) or "no platforms attempted",
+    }), flush=True)
+
 
 if __name__ == "__main__":
     main()
