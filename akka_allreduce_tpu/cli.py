@@ -198,6 +198,17 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "checkpoint if one exists")
     p.add_argument("--ckpt-every", type=int, default=10,
                    help="save interval in steps")
+    p.add_argument("--deadline-ms", type=float, default=0,
+                   help="per-round straggler deadline: data ranks whose "
+                        "contribution misses it are masked that round and "
+                        "the mean is count-rescaled (dynamic lossy sync)")
+    p.add_argument("--straggle-prob", type=float, default=0.0,
+                   help="simulated probability per data rank per round of "
+                        "missing the deadline (demo/testing; real "
+                        "deployments report arrivals over DCN)")
+    p.add_argument("--max-lag", type=int, default=1,
+                   help="in-flight round window for the deadline pacer "
+                        "(the reference's maxLag)")
 
 
 def _cmd_train(args: argparse.Namespace) -> int:
@@ -228,6 +239,36 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print("error: --pp > 1 needs homogeneous layers: use "
               "--moe-every 1 or drop --moe-experts", file=sys.stderr)
         return 2
+    if args.deadline_ms < 0:
+        print("error: --deadline-ms must be positive", file=sys.stderr)
+        return 2
+    if args.int8_grads:
+        # fail at the flag layer, not deep inside shard_map tracing: the
+        # int8 transport needs exactly one >1 data axis whose size divides
+        # the bucket length (parallel/dp.py, ops/collectives.py)
+        data_axes = {"dp": dp, "sp": args.sp, "ep": args.ep}
+        wide = [f"{k}={v}" for k, v in data_axes.items() if v > 1]
+        if len(wide) > 1:
+            print(f"error: --int8-grads needs a single >1 data axis, got "
+                  f"{' '.join(wide)}; use f32 transport or fold the "
+                  f"parallelism into dp", file=sys.stderr)
+            return 2
+        axis_size = max(data_axes.values())
+        if axis_size > 1 and args.bucket_elems % axis_size:
+            print(f"error: --int8-grads needs --bucket-elems divisible by "
+                  f"the data-axis size {axis_size}, got "
+                  f"{args.bucket_elems}", file=sys.stderr)
+            return 2
+        if args.deadline_ms:
+            print("error: --int8-grads cannot combine with --deadline-ms: "
+                  "masked (lossy) rounds always run the f32 counted path, "
+                  "and a dynamic mask makes every round masked",
+                  file=sys.stderr)
+            return 2
+    if args.straggle_prob and not args.deadline_ms:
+        print("error: --straggle-prob needs --deadline-ms",
+              file=sys.stderr)
+        return 2
     micro = args.microbatches or (args.pp if args.pp > 1 else 1)
     b = args.batch or 2 * dp * args.ep * micro
     t = args.seq or 32 * args.sp
@@ -247,7 +288,19 @@ def _cmd_train(args: argparse.Namespace) -> int:
                       grad_transport="int8" if args.int8_grads else "f32",
                       remat=args.remat)
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
-    step = make_train_step(cfg, mesh, opt)
+    dynamic = args.deadline_ms > 0
+    step = make_train_step(cfg, mesh, opt, dynamic_valid=dynamic)
+    trainer = None
+    if dynamic:
+        from akka_allreduce_tpu.models.train import (data_rank_count,
+                                                     dense_bucket_count)
+        from akka_allreduce_tpu.runtime.pacer import RoundClock
+        from akka_allreduce_tpu.runtime.straggler import DeadlineTrainer
+        n_ranks = data_rank_count(cfg, mesh)
+        clock = RoundClock(n_ranks, deadline_s=args.deadline_ms / 1e3)
+        trainer = DeadlineTrainer(step, clock,
+                                  dense_bucket_count(cfg, mesh, params),
+                                  max_lag=args.max_lag)
 
     start = 0
     mgr = None
@@ -275,7 +328,20 @@ def _cmd_train(args: argparse.Namespace) -> int:
             tokens = jnp.asarray(step_rng.integers(0, args.vocab,
                                                    size=(b, t),
                                                    dtype=np.int32))
-            params, opt_state, metrics = step(params, opt_state, tokens)
+            if trainer is not None:
+                r = trainer.open_round()
+                # arrival simulation: each data rank lands on time or
+                # misses the deadline with --straggle-prob (a deployment
+                # reports real DCN arrival timestamps here instead)
+                for peer in range(trainer.clock.num_peers):
+                    late = step_rng.random() < args.straggle_prob
+                    trainer.clock.report_offset(
+                        r, peer,
+                        (2.0 if late else 0.0) * trainer.clock.deadline_s)
+                params, opt_state, metrics = trainer.run_round(
+                    params, opt_state, tokens)
+            else:
+                params, opt_state, metrics = step(params, opt_state, tokens)
             if mgr is not None:
                 mgr.maybe_save(i, params, opt_state, {"data_step": i})
             steps_in_window += 1
@@ -283,10 +349,21 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 loss = float(jax.block_until_ready(metrics["loss"]))
                 toks = float(metrics["tokens"])
                 dt = time.perf_counter() - tic
+                lossy = ""
+                if trainer is not None:
+                    rep = trainer.reports[-1]
+                    lossy = (f" [masked {rep.n_masked}/"
+                             f"{trainer.clock.num_peers} ranks, "
+                             f"min_count "
+                             f"{int(metrics['min_bucket_count'])}]")
                 print(f"step {i + 1:4d}: loss {loss:.4f} "
-                      f"({toks * steps_in_window / dt:.0f} tok/s)")
+                      f"({toks * steps_in_window / dt:.0f} tok/s){lossy}")
                 tic = time.perf_counter()
                 steps_in_window = 0
+        if trainer is not None:
+            trainer.drain()
+            print(f"lossy rounds: {trainer.masked_round_count}/"
+                  f"{len(trainer.reports)} had masked contributions")
         if mgr is not None:
             final = args.steps - 1
             if args.steps > start and mgr.latest_step() != final:

@@ -214,13 +214,27 @@ def place_opt_state(opt: optax.GradientTransformation, opt_state: Any,
 
 
 def make_grad_step(cfg: TrainConfig, mesh: Mesh,
-                   valid_buckets: Optional[jnp.ndarray] = None):
+                   valid_buckets: Optional[jnp.ndarray] = None,
+                   dynamic_valid: bool = False):
     """The rank-local core under shard_map: loss, backprop, bucketed
     gradient sync. Returns ``grad_step(params, tokens) -> (synced_grads,
     metrics)``; tokens (B_global, T_global) int32, batch sharded over
     (dp, ep) — ep doubles as a data axis — and sequence over sp. With
     pp > 1 in the mesh the layer stack is pipelined (parallel/pp.py):
-    cfg.microbatches microbatches flow through the pp stages per step."""
+    cfg.microbatches microbatches flow through the pp stages per step.
+
+    ``valid_buckets`` bakes a STATIC per-bucket mask into the trace;
+    ``dynamic_valid=True`` instead adds a traced ``valid`` argument — a
+    ``(n_data_ranks, num_buckets)`` f32 array, rows in the mesh's data-axis
+    order (dp-major, then sp, then ep) — so the host can mask a different
+    set of contributions every round without recompiling. This is the
+    device half of genuine timeout-based partial completion: RoundClock
+    deadlines become mask rows (runtime/straggler.py), the TPU rendering of
+    the reference's dynamic per-round straggler tolerance (reference:
+    AllreduceWorker.scala:100-106, ScatteredDataBuffer.scala:9-13). The
+    dense gradient sync consumes the mask; expert weights are ep-owned and
+    keep the exact path (a straggling ep rank's experts have no replica to
+    be rescued by, so masking them would silently zero their update)."""
     mcfg = cfg.model
     has_sp = mesh.shape.get("sp", 1) > 1
     has_tp = mesh.shape.get("tp", 1) > 1
@@ -298,19 +312,20 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             lambda x: x.astype(jnp.bfloat16)
             if x.dtype == jnp.float32 else x, p)
 
-    def derive_quant_key(quant_seed, tokens):
-        """Stochastic-rounding key for the int8 transport: folds in the
+    def derive_quant_key(quant_seed):
+        """Stochastic-rounding key for the int8 transport, derived from the
         caller's per-round seed (make_train_step passes the optimizer step
-        count) AND the batch content, so repeated batches and repeated
-        steps both get fresh rounding noise — the unbiasedness-across-
-        rounds requirement of the quantized collective — while the step
-        stays a pure function of its inputs."""
+        count) ONLY: the unbiasedness argument needs rounding noise
+        independent of the values being quantized, so nothing
+        data-dependent may enter the key. Each sync call folds in its own
+        tag (sync_and_metrics) so the dense and expert collectives draw
+        uncorrelated noise in the same round."""
         if cfg.grad_transport == "f32":
             return None
-        k = jax.random.fold_in(jax.random.key(17), quant_seed)
-        return jax.random.fold_in(k, jnp.sum(tokens).astype(jnp.uint32))
+        return jax.random.fold_in(jax.random.key(17), quant_seed)
 
-    def sync_and_metrics(loss, aux, grads, total_count, quant_key):
+    def sync_and_metrics(loss, aux, grads, total_count, quant_key,
+                         valid=None):
         # Gradient sync over the data axes: the framework's bucketed,
         # counted collective — THE allreduce the reference exists for.
         # Gradients for tp shards need no sync (tp_grad_boundary completed
@@ -327,18 +342,26 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             for k in grads:
                 if k != "layers":
                     grads[k] = psum_all(grads[k], "pp")
+        if valid is None:
+            valid = valid_buckets
+        # distinct per-call tags: the two syncs in one round must not
+        # share rounding noise (correlated errors stop cancelling)
+        k_dense = k_expert = None
+        if quant_key is not None:
+            k_dense = jax.random.fold_in(quant_key, 0)
+            k_expert = jax.random.fold_in(quant_key, 1)
         if has_moe:
             dense, expert = split_expert_leaves(grads)
-            res = allreduce_gradients(dense, gcfg, valid=valid_buckets,
-                                      quant_key=quant_key)
+            res = allreduce_gradients(dense, gcfg, valid=valid,
+                                      quant_key=k_dense)
             res_e = allreduce_gradients(expert, gcfg_expert,
-                                        quant_key=quant_key)
+                                        quant_key=k_expert)
             grads_out = merge_expert_leaves(res.grads, res_e.grads)
             min_count = jnp.minimum(res.bucket_counts.min(),
                                     res_e.bucket_counts.min())
         else:
-            res = allreduce_gradients(grads, gcfg, valid=valid_buckets,
-                                      quant_key=quant_key)
+            res = allreduce_gradients(grads, gcfg, valid=valid,
+                                      quant_key=k_dense)
             grads_out = res.grads
             min_count = res.bucket_counts.min()
         metrics = {
@@ -352,7 +375,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         }
         return grads_out, metrics
 
-    def grad_local(params, tokens, quant_seed):
+    def grad_local(params, tokens, quant_seed, valid=None):
         targets, weights, positions = targets_and_weights(tokens)
         total_count = psum_all(weights.sum(), dense_axes)
 
@@ -368,9 +391,10 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
         return sync_and_metrics(loss, aux, grads, total_count,
-                                derive_quant_key(quant_seed, tokens))
+                                derive_quant_key(quant_seed),
+                                valid=valid)
 
-    def grad_local_pp(params, tokens, quant_seed):
+    def grad_local_pp(params, tokens, quant_seed, valid=None):
         targets, weights, positions = targets_and_weights(tokens)
         total_count = psum_all(weights.sum(), dense_axes)
         m = cfg.microbatches
@@ -414,23 +438,49 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
         return sync_and_metrics(loss, aux, grads, total_count,
-                                derive_quant_key(quant_seed, tokens))
+                                derive_quant_key(quant_seed),
+                                valid=valid)
 
     # check_vma=False: varying-axis tracking would auto-insert psums over
     # the data axes in the backward pass (pvary transpose), taking gradient
     # sync out of the framework's hands — the explicit Megatron boundary
     # (parallel/tp.py) plus allreduce_gradients carry it instead.
     batch_axes = ("dp", "ep") if "ep" in mesh.shape else "dp"
-    mapped = jax.shard_map(
-        grad_local_pp if has_pp else grad_local, mesh=mesh,
-        in_specs=(specs, P(batch_axes, "sp"), P()),
-        out_specs=(specs, P()),
-        check_vma=False,
-    )
+    local_fn = grad_local_pp if has_pp else grad_local
+    if dynamic_valid:
+        # the (n_data_ranks, num_buckets) mask shards one row per data
+        # rank; tp/pp ranks within a data rank see the same row
+        mapped = jax.shard_map(
+            lambda p, t, s, v: local_fn(p, t, s, valid=v[0]),
+            mesh=mesh,
+            in_specs=(specs, P(batch_axes, "sp"), P(),
+                      P(dense_axes, None)),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )
+    else:
+        mapped = jax.shard_map(
+            local_fn, mesh=mesh,
+            in_specs=(specs, P(batch_axes, "sp"), P()),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )
 
-    def grad_step(params, tokens, quant_seed=None):
+    def grad_step(params, tokens, quant_seed=None, valid=None):
+        if quant_seed is None and cfg.grad_transport == "int8":
+            # a defaulted seed would reuse one rounding key every round,
+            # making the quantization error systematic instead of
+            # zero-mean (make_train_step passes the optimizer step count)
+            raise ValueError(
+                "int8 grad transport needs a per-round quant_seed")
         seed = jnp.asarray(0 if quant_seed is None else quant_seed,
                            jnp.uint32)
+        if dynamic_valid:
+            if valid is None:
+                raise ValueError("dynamic_valid step needs a per-round "
+                                 "valid mask (n_data_ranks, num_buckets)")
+            return mapped(params, tokens, seed,
+                          jnp.asarray(valid, jnp.float32))
         return mapped(params, tokens, seed)
 
     return grad_step
@@ -438,10 +488,16 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
 def make_train_step(cfg: TrainConfig, mesh: Mesh,
                     opt: optax.GradientTransformation,
-                    valid_buckets: Optional[jnp.ndarray] = None):
+                    valid_buckets: Optional[jnp.ndarray] = None,
+                    dynamic_valid: bool = False):
     """Full jitted step: grads+sync under shard_map, elementwise optimizer
-    on the global (sharded) arrays — XLA keeps the Megatron layout."""
-    grad_step = make_grad_step(cfg, mesh, valid_buckets)
+    on the global (sharded) arrays — XLA keeps the Megatron layout.
+
+    With ``dynamic_valid=True`` the step takes a fourth argument — the
+    per-round ``(n_data_ranks, num_buckets)`` contribution mask (see
+    make_grad_step) — traced, so changing it never recompiles."""
+    grad_step = make_grad_step(cfg, mesh, valid_buckets,
+                               dynamic_valid=dynamic_valid)
 
     @jax.jit
     def step(params, opt_state, tokens):
@@ -453,4 +509,47 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
         params = optax.apply_updates(params, updates)
         return params, opt_state, metrics
 
-    return step
+    @jax.jit
+    def step_dynamic(params, opt_state, tokens, valid):
+        count = optax.tree_utils.tree_get(opt_state, "count")
+        grads, metrics = grad_step(params, tokens, quant_seed=count,
+                                   valid=valid)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    return step_dynamic if dynamic_valid else step
+
+
+def data_rank_count(cfg: TrainConfig, mesh: Mesh) -> int:
+    """How many data ranks contribute to the dense gradient sync — the row
+    count of a dynamic ``valid`` mask (dp x sp, x ep when the mesh has
+    experts; rows dp-major)."""
+    axes = cfg.grad_axes + (("ep",) if mesh.shape.get("ep", 1) > 1 else ())
+    return math.prod(mesh.shape.get(a, 1) for a in axes)
+
+
+def dense_bucket_count(cfg: TrainConfig, mesh: Mesh, params: Any) -> int:
+    """Bucket count of the rank-local dense gradient tree — the column
+    count of a dynamic ``valid`` mask. Computed from shapes only (no device
+    work): each rank's gradient shard is its parameter shard, so the local
+    leaf shapes follow from the global params and their PartitionSpecs."""
+    from jax.sharding import PartitionSpec
+    pp_size = mesh.shape.get("pp", 1)
+    specs = param_specs(cfg.model, pp=pp_size)
+
+    def local(x, s):
+        shape = list(x.shape)
+        for d, ax in enumerate(tuple(s)[:len(shape)]):
+            if ax is None:
+                continue
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                shape[d] //= mesh.shape.get(a, 1)
+        return jax.ShapeDtypeStruct(tuple(shape), x.dtype)
+
+    shaped = jax.tree.map(local, params, specs,
+                          is_leaf=lambda v: isinstance(v, PartitionSpec))
+    if cfg.model.moe is not None:
+        shaped, _ = split_expert_leaves(shaped)
+    from akka_allreduce_tpu.ops.bucketing import tree_bucket_spec
+    return tree_bucket_spec(shaped, cfg.bucket_elems).num_buckets

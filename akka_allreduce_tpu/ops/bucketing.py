@@ -71,6 +71,13 @@ def _spec_for(tree: Any, bucket_elems: int) -> BucketSpec:
     )
 
 
+def tree_bucket_spec(tree: Any, bucket_elems: int) -> BucketSpec:
+    """Bucket geometry for a pytree of arrays or ShapeDtypeStructs, without
+    touching data — how host-side drivers size per-round ``valid`` masks
+    before the first step runs (runtime/straggler.py)."""
+    return _spec_for(tree, bucket_elems)
+
+
 def tree_to_vector(tree: Any, dtype=jnp.float32) -> jnp.ndarray:
     """Flatten a pytree into one 1-D vector (cast to ``dtype``)."""
     leaves = jax.tree.leaves(tree)

@@ -22,6 +22,7 @@ scatter/reduce/broadcast protocol of the reference collapses into them
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Optional
 
 import jax
@@ -68,12 +69,18 @@ class GradSyncConfig:
 class GradSyncResult:
     """The AllReduceOutput equivalent: reduced gradients, per-element counts
     (as a pytree congruent with the gradients; None when the config opted
-    out), and the raw per-bucket counts for observability."""
+    out), and the raw per-bucket counts for observability.
+
+    ``transport`` is the wire format that actually ran: lossy (masked)
+    rounds always run the f32 counted path even under
+    ``config.transport='int8'``, and this field makes that fallback
+    observable instead of silent."""
 
     grads: Any
     counts: Any
     bucket_counts: jnp.ndarray
     spec: BucketSpec
+    transport: str = "f32"
 
 
 def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
@@ -91,6 +98,16 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     being unbiased across rounds).
     """
     buckets, spec = bucketize(grads, config.bucket_elems)
+    effective_transport = config.transport
+    if valid is not None and config.transport == "int8":
+        # the masked path has no int8 wire format (counts ride the same
+        # f32 psum); warn at trace time so a user who enabled int8 to cut
+        # wire traffic learns their lossy rounds run full width
+        effective_transport = "f32"
+        warnings.warn(
+            "transport='int8' with a valid mask falls back to the f32 "
+            "counted path for this round; GradSyncResult.transport "
+            "records what ran", stacklevel=2)
     if valid is None:
         # Exact path (thresholds = 1.0): every rank contributes every
         # bucket, so the masking multiply and the count psum are pure
@@ -146,4 +163,5 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
             spec, dtypes=tuple(jnp.int32 for _ in spec.dtypes))
         counts_tree = vector_to_tree(per_elem, counts_spec)
     return GradSyncResult(grads=out_tree, counts=counts_tree,
-                          bucket_counts=bucket_counts, spec=spec)
+                          bucket_counts=bucket_counts, spec=spec,
+                          transport=effective_transport)

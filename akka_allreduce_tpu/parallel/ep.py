@@ -89,6 +89,14 @@ def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int,
     no sorting, no dynamic shapes. All slot/counter bookkeeping runs in
     float32 regardless of the model dtype: a bf16 cumsum saturates past 256
     assignments and silently merges tokens into one slot.
+
+    Memory scaling caveat: the (k, N, E, C) dispatch/combine tensors are
+    O(k^2 * capacity_factor * N^2) elements per MoE layer (C is
+    proportional to N/E), quadratic in local token count — fine at the
+    batch x seq shards this framework targets, dominant at very long local
+    sequences. The long-context MoE remedy is index-based gather/scatter
+    dispatch (ragged, sort-based); swap it in here behind the same
+    (dispatch, combine) contract if that regime becomes a target.
     """
     n, e = probs.shape
     out_dtype = out_dtype or probs.dtype

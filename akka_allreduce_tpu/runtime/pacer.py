@@ -88,10 +88,22 @@ class RoundClock:
         self._round_open[round_] = self.clock()
         self._arrivals.setdefault(round_, {})
 
+    def is_open(self, round_: int) -> bool:
+        return round_ in self._round_open
+
     def report_arrival(self, round_: int, peer: int,
                        at: Optional[float] = None) -> None:
         self._arrivals.setdefault(round_, {})[peer] = \
             self.clock() if at is None else at
+
+    def report_offset(self, round_: int, peer: int, offset_s: float) -> None:
+        """Report an arrival ``offset_s`` after the round opened — the
+        scripted-schedule form (tests, CLI straggler simulation) that stays
+        deterministic under a real wall clock."""
+        opened = self._round_open.get(round_)
+        if opened is None:
+            raise ValueError(f"round {round_} was never opened")
+        self._arrivals.setdefault(round_, {})[peer] = opened + offset_s
 
     def valid_peers(self, round_: int) -> list[bool]:
         """True per peer iff its round contribution arrived in time."""

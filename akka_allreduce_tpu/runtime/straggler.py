@@ -1,0 +1,109 @@
+"""End-to-end straggler tolerance: deadlines -> masks -> lossy training.
+
+The reference's signature capability is DYNAMIC per-round straggler
+tolerance: a slow worker's contribution simply misses the thresholds and
+the round completes without it, counts reporting the gap (reference:
+AllreduceWorker.scala:100-106, ScatteredDataBuffer.scala:9-13). On TPU the
+collective itself is bulk-synchronous, so the timeout lives on the host:
+:class:`RoundClock` (runtime/pacer.py) turns arrival deadlines into
+per-peer validity, this driver turns validity into the
+``(n_data_ranks, num_buckets)`` mask rows the dynamic train step consumes
+(models/train.py ``dynamic_valid``), and :class:`RoundPacer` bounds how far
+the host may run ahead — the ``maxLag`` window.
+
+A "peer" here is a data rank (dp x sp x ep mesh coordinate, dp-major).
+Arrival reports come from wherever reality provides them — DCN heartbeat
+timestamps in a multi-host deployment (runtime/coordinator.py), scripted
+schedules in tests, a probability model in the CLI demo. The driver is
+deliberately agnostic: it reads ``RoundClock.valid_peers`` at launch time,
+nothing more.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from akka_allreduce_tpu.runtime.pacer import RoundClock, RoundPacer
+
+
+@dataclasses.dataclass
+class RoundReport:
+    """What one paced round looked like from the host."""
+
+    round: int
+    valid_peers: tuple[bool, ...]
+    n_masked: int
+
+
+class DeadlineTrainer:
+    """Stream rounds through a dynamic-valid train step under a deadline.
+
+    ``step(params, opt_state, tokens, valid) -> (params, opt_state,
+    metrics)`` is the jitted step from ``make_train_step(...,
+    dynamic_valid=True)``. Masks are whole-peer: a peer that misses its
+    deadline is masked for every bucket that round (the reference's
+    analogue: a worker whose scatter never arrived contributes to no
+    chunk). Per-bucket granularity stays available one level down
+    (allreduce_gradients ``valid``) for callers with partial-arrival
+    information.
+    """
+
+    def __init__(self, step: Callable, clock: RoundClock, num_buckets: int,
+                 max_lag: int = 1):
+        self.step = step
+        self.clock = clock
+        self.num_buckets = num_buckets
+        self.pacer = RoundPacer(max_lag)
+        self.reports: list[RoundReport] = []
+
+    @property
+    def round(self) -> int:
+        return self.pacer.round
+
+    def open_round(self) -> int:
+        """Start the deadline clock for the next round and return its
+        number. Arrival reports for the round land on the clock between
+        this call and :meth:`run_round` (over DCN in a deployment; via
+        ``clock.report_arrival``/``report_offset`` in tests)."""
+        r = self.pacer.round
+        self.clock.open_round(r)
+        return r
+
+    def run_round(self, params: Any, opt_state: Any, tokens: Any
+                  ) -> tuple[Any, Any, Any]:
+        """Build this round's mask from the clock and dispatch the step.
+
+        Dispatch is asynchronous (JAX); the pacer blocks only when more
+        than ``max_lag + 1`` rounds are in flight — the reference's ring
+        stalling a fast worker (reference: AllReduceBuffer.scala:34-42).
+        """
+        r = self.pacer.round
+        if not self.clock.is_open(r):
+            self.clock.open_round(r)
+        valid = self.clock.valid_peers(r)
+        if not any(valid):
+            # an all-masked round would psum to count 0 everywhere and
+            # zero the gradient; keep liveness by letting every on-time
+            # report count — here, nobody reported, so run exact. The
+            # reference's master likewise cannot advance below quorum
+            # (thAllreduce gate, reference: AllreduceMaster.scala:54-63).
+            valid = [True] * self.clock.num_peers
+        mask = np.repeat(
+            np.asarray(valid, np.float32)[:, None], self.num_buckets, axis=1)
+        out = self.pacer.submit(
+            lambda _r: self.step(params, opt_state, tokens, mask))
+        self.reports.append(RoundReport(
+            round=r, valid_peers=tuple(bool(v) for v in valid),
+            n_masked=sum(1 for v in valid if not v)))
+        self.clock.expire(r - self.pacer.max_lag)
+        return out
+
+    def drain(self) -> None:
+        self.pacer.drain()
+
+    @property
+    def masked_round_count(self) -> int:
+        return sum(1 for rep in self.reports if rep.n_masked)

@@ -24,6 +24,7 @@ knobs documented in akka_allreduce_tpu/bench.py (forwarded verbatim).
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -43,12 +44,17 @@ CPU_FALLBACK_ENV = {
 
 
 def _ensure_host_device_count(env: dict, n: int) -> None:
-    """Merge-append the device-count flag into XLA_FLAGS (an existing value
-    must not shadow it — same merge tests/conftest.py does)."""
+    """Merge the device-count flag into XLA_FLAGS: append when absent,
+    upgrade when an existing count is smaller (a pre-set '=1' would make
+    the 'allreduce' a 1-device no-op and the number meaningless)."""
     flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
         env["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(m.group(1)) < n:
+        env["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}")
 
 
 def _log(msg: str) -> None:
@@ -85,8 +91,9 @@ def _attempt(platform: str, timeout_s: float) -> "dict | None":
         out, _ = proc.communicate()
         timed_out = True
     if proc.returncode != 0 and not timed_out:
+        # still scan for JSON: a child that measured, printed, and then
+        # crashed in backend teardown produced a real number
         _log(f"attempt platform={platform} exited rc={proc.returncode}")
-        return None
     for line in reversed((out or "").strip().splitlines()):
         try:
             parsed = json.loads(line)

@@ -167,3 +167,33 @@ class TestRoundClock:
         clock.expire(1)
         assert clock.valid_peers(0) == [False, False]  # forgotten
         assert clock.valid_peers(1) == [False, False]  # no arrivals yet
+
+
+class TestInt8LossyFallback:
+    def test_masked_round_reports_f32_and_warns(self, mesh):
+        """ADVICE r1: transport='int8' with a valid mask silently ran the
+        f32 counted path; the fallback must be observable — a trace-time
+        warning plus GradSyncResult.transport recording what ran."""
+        import warnings
+
+        cfg = GradSyncConfig(bucket_elems=8, average=True,
+                             rescale_target=float(N), transport="int8")
+        seen = {}
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"))
+        def step(ranks):
+            g = per_rank_grads(ranks[0, 0])
+            valid = jnp.ones((3,), jnp.float32)  # 22 elems / 8 per bucket
+            res = allreduce_gradients(g, cfg, valid=valid,
+                                      quant_key=jax.random.key(0))
+            seen["transport"] = res.transport
+            return res.grads["w"][None]
+
+        ranks = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            step(ranks)
+        assert seen["transport"] == "f32"
+        assert any("falls back to the f32" in str(w.message)
+                   for w in caught)

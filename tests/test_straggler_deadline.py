@@ -1,0 +1,232 @@
+"""End-to-end straggler deadlines: RoundClock -> dynamic masks -> training.
+
+The reference's signature behavior — a straggler's contribution misses the
+threshold, the round completes without it, counts report the gap, and the
+caller rescales (reference: AllreduceWorker.scala:100-106,
+ScatteredDataBuffer.scala:9-13, ReducedDataBuffer.scala:40-48) — here as
+the device-plane equivalent: per-round valid masks traced through the full
+train step, driven by host deadlines under the maxLag pacing window.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.train import (
+    TrainConfig,
+    data_rank_count,
+    dense_bucket_count,
+    make_grad_step,
+    make_train_state,
+    make_train_step,
+    param_specs,
+    shard_params,
+)
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+from akka_allreduce_tpu.runtime.pacer import RoundClock
+from akka_allreduce_tpu.runtime.straggler import DeadlineTrainer
+from tests.test_train import MCFG, make_tokens, reference_mean_loss
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestDynamicValidStep:
+    def test_masked_round_equals_exact_on_valid_subset(self):
+        """THE unbiasedness pin: with ranks {2, 5} masked, the synced
+        gradient must equal the unsharded gradient of the mean loss over
+        only the valid ranks' batches (count-rescale math: sum over k
+        valid ranks x n/k, against total_count = n x per-rank tokens,
+        reduces to exactly that)."""
+        mesh = make_device_mesh(MeshSpec(dp=8))
+        cfg = TrainConfig(model=MCFG, bucket_elems=256)
+        tokens = make_tokens(b=8, t=32)
+        masked = (2, 5)
+        valid_rows = [i for i in range(8) if i not in masked]
+
+        full_params = init_transformer(jax.random.key(0), MCFG)
+        ref_grads = jax.grad(lambda p: reference_mean_loss(
+            p, tokens[jnp.asarray(valid_rows)], MCFG))(full_params)
+
+        params = shard_params(full_params, param_specs(MCFG), mesh)
+        grad_step = make_grad_step(cfg, mesh, dynamic_valid=True)
+        nb = dense_bucket_count(cfg, mesh, params)
+        mask = np.ones((8, nb), np.float32)
+        mask[list(masked)] = 0.0
+        grads, metrics = jax.jit(grad_step)(params, tokens, valid=mask)
+
+        assert int(metrics["min_bucket_count"]) == 6
+        got = jax.tree.leaves(grads)
+        want = jax.tree.leaves(ref_grads)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=5e-3, atol=1e-5)
+
+    def test_masked_rank_data_cannot_influence_result(self):
+        """A masked rank's batch shard is garbage-invariant: its
+        contribution must be zeroed BEFORE the collective, not rescaled
+        back in (the reference's missed-scatter semantics, reference:
+        AllreduceSpec.scala:444-458)."""
+        mesh = make_device_mesh(MeshSpec(dp=8))
+        cfg = TrainConfig(model=MCFG, bucket_elems=256)
+        tokens = make_tokens(b=8, t=32)
+        grad_step = jax.jit(make_grad_step(cfg, mesh, dynamic_valid=True))
+        full_params = init_transformer(jax.random.key(0), MCFG)
+        params = shard_params(full_params, param_specs(MCFG), mesh)
+        nb = dense_bucket_count(cfg, mesh, params)
+        mask = np.ones((8, nb), np.float32)
+        mask[3] = 0.0
+
+        grads_a, _ = grad_step(params, tokens, valid=mask)
+        garbled = tokens.at[3].set((tokens[3] + 7) % MCFG.vocab_size)
+        grads_b, _ = grad_step(params, garbled, valid=mask)
+        for a, b in zip(jax.tree.leaves(grads_a), jax.tree.leaves(grads_b)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_mask_is_traced_not_baked(self):
+        """Different masks per round reuse one executable — the whole point
+        of the dynamic path (a recompile per straggler pattern would stall
+        the pacer for seconds)."""
+        mesh = make_device_mesh(MeshSpec(dp=4, sp=2))
+        cfg = TrainConfig(model=MCFG, bucket_elems=256)
+        params, opt_state, opt = make_train_state(jax.random.key(1), cfg,
+                                                  mesh)
+        step = make_train_step(cfg, mesh, opt, dynamic_valid=True)
+        nb = dense_bucket_count(cfg, mesh, params)
+        n_ranks = data_rank_count(cfg, mesh)
+        assert n_ranks == 8
+        tokens = make_tokens(b=8, t=64)
+        # warm up twice: the first call returns outputs whose committed
+        # shardings key a second (same-executable) cache entry on call two;
+        # from there the cache must not grow no matter what the mask is
+        for _ in range(2):
+            params, opt_state, _ = step(params, opt_state, tokens,
+                                        np.ones((n_ranks, nb), np.float32))
+        warm = step._cache_size()
+        counts = []
+        for masked_peer in (None, 1, 6):
+            mask = np.ones((n_ranks, nb), np.float32)
+            if masked_peer is not None:
+                mask[masked_peer] = 0.0
+            params, opt_state, metrics = step(params, opt_state, tokens,
+                                              mask)
+            counts.append(int(metrics["min_bucket_count"]))
+        assert counts == [8, 7, 7]
+        assert step._cache_size() == warm  # masks never recompile
+
+
+class TestDeadlineTrainerEndToEnd:
+    def _setup(self, max_lag=1):
+        mesh = make_device_mesh(MeshSpec(dp=8))
+        cfg = TrainConfig(model=MCFG, learning_rate=3e-3, bucket_elems=256)
+        params, opt_state, opt = make_train_state(jax.random.key(2), cfg,
+                                                  mesh)
+        step = make_train_step(cfg, mesh, opt, dynamic_valid=True)
+        clock = FakeClock()
+        rc = RoundClock(num_peers=8, deadline_s=0.5, clock=clock)
+        trainer = DeadlineTrainer(
+            step, rc, dense_bucket_count(cfg, mesh, params),
+            max_lag=max_lag)
+        return trainer, params, opt_state, clock
+
+    def test_scripted_stragglers_converge_with_honest_counts(self):
+        """30 rounds on a fixed batch; every 3rd round one rotating rank
+        misses its deadline. Counts report the gap each lossy round, the
+        unbiased rescale keeps training on track, loss falls."""
+        trainer, params, opt_state, clock = self._setup()
+        tokens = make_tokens(b=8, t=32, seed=9)
+        losses, min_counts = [], []
+        for i in range(30):
+            r = trainer.open_round()
+            straggler = (i // 3) % 8 if i % 3 == 0 else None
+            for peer in range(8):
+                late = peer == straggler
+                trainer.clock.report_offset(r, peer, 1.0 if late else 0.1)
+            params, opt_state, metrics = trainer.run_round(
+                params, opt_state, tokens)
+            losses.append(float(metrics["loss"]))
+            min_counts.append(int(metrics["min_bucket_count"]))
+        trainer.drain()
+
+        for i in range(30):
+            want = 7 if i % 3 == 0 else 8
+            assert min_counts[i] == want, (i, min_counts[i])
+            assert trainer.reports[i].n_masked == (1 if i % 3 == 0 else 0)
+        assert losses[-1] < losses[0] * 0.6, losses
+        assert trainer.masked_round_count == 10
+
+    def test_all_masked_round_falls_back_to_exact(self):
+        """If every peer misses the deadline the round must not zero the
+        gradient (count-0 rescale): the driver keeps liveness by running
+        the round exact — the reference master likewise cannot advance
+        below quorum (reference: AllreduceMaster.scala:54-63)."""
+        trainer, params, opt_state, clock = self._setup()
+        tokens = make_tokens(b=8, t=32, seed=9)
+        r = trainer.open_round()
+        for peer in range(8):
+            trainer.clock.report_offset(r, peer, 2.0)  # all late
+        params, opt_state, metrics = trainer.run_round(params, opt_state,
+                                                       tokens)
+        trainer.drain()
+        assert int(metrics["min_bucket_count"]) == 8
+        assert trainer.reports[0].n_masked == 0
+
+    def test_unreported_peer_is_cold_straggler(self):
+        """A peer that never reports is masked (deathwatch analog:
+        reference AllreduceMaster.scala:46-52) without stalling the
+        round."""
+        trainer, params, opt_state, clock = self._setup()
+        tokens = make_tokens(b=8, t=32, seed=9)
+        r = trainer.open_round()
+        for peer in range(7):  # peer 7 silent
+            trainer.clock.report_offset(r, peer, 0.0)
+        _, _, metrics = trainer.run_round(params, opt_state, tokens)
+        trainer.drain()
+        assert int(metrics["min_bucket_count"]) == 7
+        assert trainer.reports[0].valid_peers[7] is False
+
+    def test_pacer_bounds_inflight_rounds(self):
+        """The maxLag window: with max_lag=2 the trainer never holds more
+        than 3 unharvested rounds (the reference's ring depth,
+        AllreduceWorker.scala:64)."""
+        trainer, params, opt_state, clock = self._setup(max_lag=2)
+        tokens = make_tokens(b=8, t=32, seed=9)
+        for _ in range(10):
+            r = trainer.open_round()
+            for peer in range(8):
+                trainer.clock.report_offset(r, peer, 0.0)
+            params, opt_state, _ = trainer.run_round(params, opt_state,
+                                                     tokens)
+            assert len(trainer.pacer._inflight) <= 3
+        trainer.drain()
+        assert trainer.pacer.completed_rounds == list(range(10))
+
+
+class TestRoundClockOffsets:
+    def test_report_offset_against_deadline(self):
+        clock = FakeClock()
+        rc = RoundClock(num_peers=3, deadline_s=1.0, clock=clock)
+        clock.t = 5.0
+        rc.open_round(0)
+        rc.report_offset(0, 0, 0.5)
+        rc.report_offset(0, 1, 1.0)   # boundary: <= deadline is on time
+        rc.report_offset(0, 2, 1.01)
+        assert rc.valid_peers(0) == [True, True, False]
+        assert rc.is_open(0)
+        rc.expire(1)
+        assert not rc.is_open(0)
+
+    def test_report_offset_requires_open_round(self):
+        rc = RoundClock(num_peers=1, deadline_s=1.0, clock=FakeClock())
+        with pytest.raises(ValueError):
+            rc.report_offset(3, 0, 0.0)
