@@ -145,6 +145,86 @@ def measure_device_goodput(elems: int, bucket_elems: int,
     return elems * 4 / per_round / 1e9
 
 
+def measure_train_mfu(compute_dtype: str = "bf16",
+                      d_model: int = 2048, n_layers: int = 8,
+                      d_ff: int = 8192, vocab: int = 32768,
+                      batch: int = 4, seq: int = 2048,
+                      steps_hi: int = 12, steps_lo: int = 4
+                      ) -> dict:
+    """Single-chip train-step MFU on the flagship transformer.
+
+    Useful FLOPs (models/flops.py: fwd matmuls + causal-half attention,
+    backward = 2x fwd, remat recompute NOT counted) / step wall time / peak
+    chip FLOPs. Timing is two-point over jitted steps with donated buffers;
+    async dispatch keeps the per-call relay latency off the device timeline
+    and the two-point delta cancels what remains.
+    """
+    from akka_allreduce_tpu.models.flops import (chip_peak_flops,
+                                                 transformer_step_flops)
+    from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                 make_train_state,
+                                                 make_train_step)
+    from akka_allreduce_tpu.models.transformer import TransformerConfig
+    from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+
+    devices = jax.devices()[:1]  # single-chip measurement
+    # the full 5-axis mesh at size 1 each: param_specs name tp/ep/pp axes
+    mesh = make_device_mesh(MeshSpec(dp=1), devices=devices)
+    mcfg = TransformerConfig(vocab_size=vocab, d_model=d_model,
+                             n_heads=d_model // 128, n_layers=n_layers,
+                             d_ff=d_ff, max_seq=seq)
+    cfg = TrainConfig(model=mcfg, learning_rate=1e-4,
+                      bucket_elems=1 << 22, grad_axes=("dp",),
+                      compute_dtype=compute_dtype,
+                      attn_block_size=min(512, seq))
+    _log(f"mfu: init {compute_dtype} d={d_model} L={n_layers} ff={d_ff} "
+         f"V={vocab} b={batch} t={seq} on {devices[0].device_kind}")
+    params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
+    # donate params/opt_state: the step updates them in place, halving HBM
+    # pressure at this chip-filling size
+    step = jax.jit(make_train_step(cfg, mesh, opt), donate_argnums=(0, 1))
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, vocab, size=(batch, seq), dtype=np.int32))
+
+    state = [params, opt_state]
+
+    def run(k):
+        # chained params serialize the steps on device; the scalar readback
+        # (NOT block_until_ready, which this machine's relay backend
+        # resolves before device completion) forces real execution, and the
+        # two-point delta cancels its round-trip constant
+        p, o = state
+        t0 = time.perf_counter()
+        m = None
+        for _ in range(k):
+            p, o, m = step(p, o, tokens)
+        np.asarray(m["loss"])
+        state[0], state[1] = p, o
+        return time.perf_counter() - t0
+
+    _log("mfu: compiling + warmup ...")
+    run(2)  # warmup/compile
+    t_lo = run(steps_lo)
+    t_hi = run(steps_hi)
+    per_step = (t_hi - t_lo) / (steps_hi - steps_lo)
+    flops = transformer_step_flops(mcfg, batch, seq)
+    peak = chip_peak_flops(devices[0])
+    achieved = flops / per_step
+    mfu = achieved / peak if peak else None
+    _log(f"mfu: {per_step * 1e3:.1f} ms/step, {achieved / 1e12:.1f} "
+         f"TFLOP/s achieved, peak "
+         f"{'%.0f' % (peak / 1e12) if peak else '?'} TFLOP/s")
+    return {
+        "per_step_s": per_step,
+        "achieved_tflops": achieved / 1e12,
+        "peak_tflops": peak / 1e12 if peak else None,
+        "mfu_pct": round(100 * mfu, 2) if mfu is not None else None,
+        "tokens_per_s": batch * seq / per_step,
+        "device_kind": devices[0].device_kind,
+        "compute_dtype": compute_dtype,
+    }
+
+
 def main() -> None:
     """One measurement attempt on one platform; the repo-root ``bench.py``
     orchestrates attempts under a watchdog so a JSON line always lands.

@@ -106,6 +106,16 @@ def _add_master(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--th-reduce", type=float, default=1.0)
     p.add_argument("--th-complete", type=float, default=0.8)
     p.add_argument("--timeout", type=float, default=120.0)
+    _add_liveness_flags(p)
+
+
+def _add_liveness_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--heartbeat-interval", type=float, default=2.0,
+                   help="seconds between transport Pings")
+    p.add_argument("--unreachable-after", type=float, default=10.0,
+                   help="down a silent peer after this many seconds "
+                   "(reference: application.conf:20 auto-down-unreachable-"
+                   "after = 10s); 0 disables liveness detection")
 
 
 def _cmd_master(args: argparse.Namespace) -> int:
@@ -123,7 +133,9 @@ def _cmd_master(args: argparse.Namespace) -> int:
         workers=WorkerConfig(total_size=args.workers, max_lag=args.max_lag),
     )
     rounds = run_master(config, bind_host=args.bind_host, port=args.port,
-                        timeout_s=args.timeout)
+                        timeout_s=args.timeout,
+                        heartbeat_interval_s=args.heartbeat_interval,
+                        unreachable_after_s=args.unreachable_after or None)
     return 0 if rounds == args.max_round else 1
 
 
@@ -141,6 +153,7 @@ def _add_worker(sub: argparse._SubParsersAction) -> None:
                    help="assert output == N x input (needs thresholds 1.0)")
     p.add_argument("--timeout", type=float, default=120.0)
     p.add_argument("--verbose", action="store_true")
+    _add_liveness_flags(p)
 
 
 def _cmd_worker(args: argparse.Namespace) -> int:
@@ -151,7 +164,9 @@ def _cmd_worker(args: argparse.Namespace) -> int:
                          source_data_size=args.data_size,
                          checkpoint=args.checkpoint,
                          assert_multiple=args.assert_multiple,
-                         timeout_s=args.timeout, verbose=args.verbose)
+                         timeout_s=args.timeout, verbose=args.verbose,
+                         heartbeat_interval_s=args.heartbeat_interval,
+                         unreachable_after_s=args.unreachable_after or None)
     return 0 if outputs > 0 else 1
 
 
@@ -240,7 +255,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
               "--moe-every 1 or drop --moe-experts", file=sys.stderr)
         return 2
     if args.deadline_ms < 0:
-        print("error: --deadline-ms must be positive", file=sys.stderr)
+        print("error: --deadline-ms must be >= 0 (0 disables deadlines)",
+              file=sys.stderr)
         return 2
     if args.int8_grads:
         # fail at the flag layer, not deep inside shard_map tracing: the
@@ -352,8 +368,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 lossy = ""
                 if trainer is not None:
                     rep = trainer.reports[-1]
+                    fb = " FELL BACK TO EXACT" if rep.fell_back else ""
                     lossy = (f" [masked {rep.n_masked}/"
-                             f"{trainer.clock.num_peers} ranks, "
+                             f"{trainer.clock.num_peers} ranks{fb}, "
                              f"min_count "
                              f"{int(metrics['min_bucket_count'])}]")
                 print(f"step {i + 1:4d}: loss {loss:.4f} "
@@ -362,8 +379,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 steps_in_window = 0
         if trainer is not None:
             trainer.drain()
+            fell = sum(1 for rep in trainer.reports if rep.fell_back)
             print(f"lossy rounds: {trainer.masked_round_count}/"
-                  f"{len(trainer.reports)} had masked contributions")
+                  f"{len(trainer.reports)} had masked contributions "
+                  f"({fell} all-masked, ran exact for liveness)")
         if mgr is not None:
             final = args.steps - 1
             if args.steps > start and mgr.latest_step() != final:

@@ -1,0 +1,86 @@
+"""Analytic FLOPs model + chip peak table for MFU reporting.
+
+The reference's only performance contract is its goodput sink (reference:
+AllreduceWorker.scala:329-343); on TPU the judging bar for the *model* side
+is train-step MFU — useful model FLOPs per second over the chip's peak
+(BASELINE.md north-star framing). This module supplies the two inputs:
+
+* :func:`transformer_step_flops` — analytic useful FLOPs for one training
+  step of the flagship causal transformer (matmul terms only, the MXU
+  work): QKVO projections, causal attention scores+AV (counted at the
+  causal half — blockwise/ring attention skips future blocks, so that IS
+  the executed work), the FF (dense or MoE expert, counted at top-k routed
+  compute), and the LM head; backward = 2x forward. Rematerialisation
+  recompute is deliberately NOT counted: MFU measures useful FLOPs, so a
+  remat run reports lower MFU by construction.
+* :func:`chip_peak_flops` — per-chip peak dense-matmul FLOPs/s by device
+  kind, bf16 numbers (the MXU's native rate; f32 runs report MFU against
+  the same peak, which is the standard convention and penalises f32
+  honestly). Override with AATPU_PEAK_TFLOPS when the table is wrong for
+  your part.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from akka_allreduce_tpu.models.transformer import TransformerConfig
+
+# bf16 dense peak TFLOPs/s per chip. Public numbers; substring-matched
+# against jax Device.device_kind (e.g. "TPU v5 lite", "TPU v4", "TPU v6e").
+_PEAK_TFLOPS_BF16 = (
+    ("v6", 918.0),       # Trillium / v6e
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),  # v5e reports device_kind "TPU v5 lite"
+    ("v5e", 197.0),
+    ("v5", 459.0),       # plain "TPU v5" -> assume p
+    ("v4 lite", 138.0),  # v4i
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def chip_peak_flops(device) -> Optional[float]:
+    """Peak dense bf16 FLOPs/s for one device, or None when unknown
+    (non-TPU backends have no meaningful MXU peak to normalise by)."""
+    env = os.environ.get("AATPU_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, tflops in _PEAK_TFLOPS_BF16:
+        if tag in kind:
+            return tflops * 1e12
+    return None
+
+
+def transformer_fwd_flops(cfg: TransformerConfig, batch: int,
+                          seq: int) -> float:
+    """Useful forward matmul FLOPs for one pass over (batch, seq) tokens."""
+    b, t, d = batch, seq, cfg.d_model
+    tokens = b * t
+    per_layer_attn = 8 * tokens * d * d  # wq/wk/wv/wo: 4 matmuls, 2 FLOPs/MAC
+    # scores (QK^T) + AV: 2 matmuls x 2 FLOPs/MAC x b*t*t*d, halved for
+    # causality (future blocks are skipped by the blockwise/ring kernels)
+    attn_core = 2 * tokens * t * d
+    if cfg.moe is not None:
+        # routed FF: router (d x E) + top-k expert FFs per token
+        k = cfg.moe.router_k
+        ff = (2 * tokens * d * cfg.moe.n_experts
+              + k * 4 * tokens * d * cfg.moe.d_ff)
+    else:
+        ff = 4 * tokens * d * cfg.d_ff  # w1 + w2
+    moe_layers = sum(1 for i in range(cfg.n_layers) if cfg.is_moe_layer(i))
+    dense_layers = cfg.n_layers - moe_layers
+    dense_ff = 4 * tokens * d * cfg.d_ff
+    layer_ff = (moe_layers * ff + dense_layers * dense_ff
+                if cfg.moe is not None else cfg.n_layers * dense_ff)
+    head = 2 * tokens * d * cfg.vocab_size
+    return (cfg.n_layers * (per_layer_attn + attn_core) + layer_ff + head)
+
+
+def transformer_step_flops(cfg: TransformerConfig, batch: int,
+                           seq: int) -> float:
+    """Useful FLOPs for one training step: forward + backward (2x)."""
+    return 3.0 * transformer_fwd_flops(cfg, batch, seq)

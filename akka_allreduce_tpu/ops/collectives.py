@@ -36,6 +36,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from akka_allreduce_tpu.ops.pallas_kernels.dispatch import use_pallas
+from akka_allreduce_tpu.ops.pallas_kernels.quantized import (
+    dequantize_int8,
+    quantize_int8,
+)
+
 
 def psum_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
     """Fused allreduce: one XLA AllReduce over the mesh axis. Rank-local
@@ -63,9 +69,15 @@ def two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
 def _quantize_rows(x2d: jnp.ndarray, key: jax.Array
                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(rows, c) f32 -> (int8 values, (rows, 1) f32 scales), symmetric
-    per-row quantization with stochastic rounding (same math as the staged
-    Pallas kernel, ops/pallas_kernels/quantized.py, expressed in jnp so XLA
-    fuses it into the collective's staging pass)."""
+    per-row quantization with stochastic rounding.
+
+    Default is the jnp form — the real-chip A/B (scripts/bench_suite.py,
+    v5e) measured XLA's fusion ~13% faster round-trip than the Pallas
+    kernel (ops/pallas_kernels/quantized.py), so XLA won this path; set
+    AATPU_PALLAS_INT8=1 to re-measure the kernel."""
+    if use_pallas("int8"):
+        bits = jax.random.bits(key, x2d.shape, dtype=jnp.uint32)
+        return quantize_int8(x2d, bits)
     abs_max = jnp.max(jnp.abs(x2d), axis=1, keepdims=True)
     scale = jnp.maximum(abs_max / 127.0, 1e-30)
     scaled = x2d / scale
@@ -77,6 +89,8 @@ def _quantize_rows(x2d: jnp.ndarray, key: jax.Array
 
 
 def _dequantize_rows(values: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    if use_pallas("int8"):
+        return dequantize_int8(values, scales)
     return values.astype(jnp.float32) * scales
 
 

@@ -17,15 +17,19 @@ kernels accept ``interpret=True`` to run on non-TPU backends (CPU tests use
 this), and compile natively on TPU.
 """
 
+from akka_allreduce_tpu.ops.pallas_kernels.dispatch import use_pallas
 from akka_allreduce_tpu.ops.pallas_kernels.reduce import fused_masked_reduce
 from akka_allreduce_tpu.ops.pallas_kernels.quantized import (
     dequantize_int8,
+    quantize_int8,
     quantize_int8_stochastic,
 )
 from akka_allreduce_tpu.ops.pallas_kernels.ring import pallas_ring_allreduce
 
 __all__ = [
+    "use_pallas",
     "fused_masked_reduce",
+    "quantize_int8",
     "quantize_int8_stochastic",
     "dequantize_int8",
     "pallas_ring_allreduce",

@@ -1,0 +1,53 @@
+"""Backend dispatch for the hand-written kernels.
+
+The production code paths (ops/collectives.py int8 transport,
+ops/masked.py staged reduce) choose between the Pallas kernel and the
+equivalent jnp/XLA formulation at trace time. The per-kernel defaults
+follow the measured A/B on this repo's real chip (scripts/bench_suite.py
+``ab_*`` lines, TPU v5e, 8 x 3.28M f32 inputs, round-2 measurements):
+
+* ``masked_reduce`` — Pallas WINS (738-779 GB/s vs 567-581 GB/s for the
+  jnp form, ~+30%): the one-VMEM-pass kernel beats XLA's mask+sum+rescale
+  fusion. Default on TPU: pallas.
+* ``int8`` (quantize/dequantize) — XLA WINS (167-170 GB/s vs 148-151 GB/s
+  round-trip, ~+13%): XLA's fusion of the scale/round/clip/cast chain
+  beats the hand kernel, which pays for materialising its random-bits
+  input tile-by-tile. Default everywhere: jnp.
+
+On CPU (tests, the virtual 8-device mesh) the jnp form always runs —
+interpreter-mode Pallas would only be slower. Overrides for re-measuring:
+``AATPU_PALLAS=0|1`` forces every kernel, ``AATPU_PALLAS_INT8`` /
+``AATPU_PALLAS_MASKED_REDUCE`` force one.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+# Measured winners on TPU (see module docstring). True = pallas.
+_TPU_DEFAULTS = {
+    "masked_reduce": True,
+    "int8": False,
+}
+
+
+def _parse(env: str) -> bool:
+    return env.strip().lower() not in ("0", "false", "no", "")
+
+
+def use_pallas(kernel: str = "masked_reduce") -> bool:
+    """True when the production path should call the Pallas kernel.
+
+    Trace-time decision (plain Python): the default backend's platform is
+    known before tracing starts, and a jitted function is traced per
+    backend anyway.
+    """
+    specific = os.environ.get(f"AATPU_PALLAS_{kernel.upper()}")
+    if specific is not None:
+        return _parse(specific)
+    blanket = os.environ.get("AATPU_PALLAS")
+    if blanket is not None:
+        return _parse(blanket)
+    return jax.default_backend() == "tpu" and _TPU_DEFAULTS[kernel]

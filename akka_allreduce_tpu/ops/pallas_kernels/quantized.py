@@ -5,31 +5,39 @@ shrinks 4x on the wire (ICI/DCN) at the cost of one extra quantize/
 dequantize pass per hop; stochastic rounding keeps the sum unbiased across
 rounds, which is what makes the scheme usable for gradient allreduce.
 
+These are the production kernels behind the int8 wire format of
+``quantized_two_phase_allreduce`` (ops/collectives.py) when the backend is
+TPU (ops/pallas_kernels/dispatch.py): :func:`quantize_int8` /
+:func:`dequantize_int8` are traced-callable (use them inside ``jit`` /
+``shard_map``) and grid-tiled over columns, so production-sized buckets
+(megabytes per row) stream through VMEM tile by tile instead of needing the
+whole array resident.
+
 The rounding uses random bits generated OUTSIDE the kernel (jax.random) and
 plain arithmetic inside, rather than the TPU-only ``pltpu.prng_*`` /
 ``pltpu.stochastic_round`` primitives — the kernel then runs identically on
 real TPUs and in interpreter mode, and the bits cost one extra VMEM input
-per chunk. Per-row (chunk) scales confine an outlier's damage to its own
+per tile. Per-row (chunk) scales confine an outlier's damage to its own
 chunk, mirroring the framework's bucket/chunk granularity
-(cf. the guide's quantization pattern, pallas_guide.md).
+(cf. the guide's quantization pattern, pallas_guide.md). The scale
+(a per-row abs-max) is computed with a jnp reduction before the kernel —
+one cheap XLA pass — so the kernel itself stays a single-visit elementwise
+pipeline over column tiles.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from akka_allreduce_tpu.ops.pallas_kernels.tiling import col_tile, pad_cols
 
-def _quantize_kernel(x_ref, bits_ref, values_ref, scales_ref):
-    x = x_ref[:]  # (rows, elems)
-    abs_max = jnp.max(jnp.abs(x), axis=1, keepdims=True)  # per-row scale
-    scale = jnp.maximum(abs_max / 127.0, 1e-30)
-    scales_ref[:] = scale
-    scaled = x / scale  # in [-127, 127]
+
+def _quantize_kernel(x_ref, bits_ref, scales_ref, values_ref):
+    scale = scales_ref[:]  # (rows, 1) f32, >= 1e-30
+    scaled = x_ref[:] / scale  # in [-127, 127]
     # stochastic rounding: floor + Bernoulli(frac), uniform from the top
     # 24 bits so the f32 conversion is exact
     low = jnp.floor(scaled)
@@ -47,47 +55,70 @@ def _dequantize_kernel(values_ref, scales_ref, out_ref):
     out_ref[:] = values_ref[:].astype(jnp.float32) * scales_ref[:]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_int8(x: jnp.ndarray, bits: jnp.ndarray,
+                  interpret: bool = False
+                  ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """x: (rows, elems) f32, bits: (rows, elems) uint32 random ->
+    (int8 values (rows, elems), f32 scales (rows, 1)).
+
+    Each row is one wire chunk with its own symmetric scale; ``bits`` drive
+    the stochastic rounding (vary them per round or the rounding error
+    stops being zero-mean across rounds). Traced-callable: call inside the
+    jitted/shard_mapped collective.
+    """
+    rows, elems = x.shape
+    abs_max = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scales = jnp.maximum(abs_max / 127.0, 1e-30)
+    tile = col_tile(rows, elems)
+    xp = pad_cols(x, tile)
+    bitsp = pad_cols(bits, tile)
+    grid = xp.shape[1] // tile
+    values = pl.pallas_call(
+        _quantize_kernel,
+        grid=(grid,),
+        out_shape=jax.ShapeDtypeStruct(xp.shape, jnp.int8),
+        in_specs=[
+            pl.BlockSpec((rows, tile), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, tile), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, tile), lambda j: (0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(xp, bitsp, scales)
+    return values[:, :elems], scales
+
+
+def dequantize_int8(values: jnp.ndarray, scales: jnp.ndarray,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Inverse of :func:`quantize_int8`. Traced-callable, grid-tiled."""
+    rows, elems = values.shape
+    tile = col_tile(rows, elems)
+    vp = pad_cols(values, tile)
+    grid = vp.shape[1] // tile
+    out = pl.pallas_call(
+        _dequantize_kernel,
+        grid=(grid,),
+        out_shape=jax.ShapeDtypeStruct(vp.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec((rows, tile), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, tile), lambda j: (0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(vp, scales)
+    return out[:, :elems]
+
+
 def quantize_int8_stochastic(x: jnp.ndarray, seed,
                              interpret: bool = False
                              ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """x: (rows, elems) f32 -> (int8 values (rows, elems),
-    f32 scales (rows, 1)). Each row is one wire chunk; ``seed`` drives the
-    stochastic rounding."""
-    rows, elems = x.shape
-    bits = jax.random.bits(jax.random.key(seed), (rows, elems),
-                           dtype=jnp.uint32)
-    values, scales = pl.pallas_call(
-        _quantize_kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((rows, elems), jnp.int8),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-        ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ),
-        interpret=interpret,
-    )(x, bits)
-    return values, scales
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def dequantize_int8(values: jnp.ndarray, scales: jnp.ndarray,
-                    interpret: bool = False) -> jnp.ndarray:
-    """Inverse of :func:`quantize_int8_stochastic`."""
-    rows, elems = values.shape
-    return pl.pallas_call(
-        _dequantize_kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, elems), jnp.float32),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=interpret,
-    )(values, scales)
+    """Convenience form generating the random bits from an int seed."""
+    bits = jax.random.bits(jax.random.key(seed), x.shape, dtype=jnp.uint32)
+    return quantize_int8(x, bits, interpret=interpret)

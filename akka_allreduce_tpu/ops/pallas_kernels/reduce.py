@@ -8,8 +8,11 @@ ReducedDataBuffer.scala:26-53 count expansion; the sink's rescale):
     count  = sum over peers of valid[p]
 
 for each chunk, where ``staged`` is a (peers, elems) staging matrix — the
-device-resident analog of one ring-buffer row. Used by the single-chip
-emulation path and as the combiner inside the Pallas ring collective.
+device-resident analog of one ring-buffer row. Production caller:
+:func:`akka_allreduce_tpu.ops.masked.masked_reduce_staged` (the N-workers-
+on-one-chip emulation path) dispatches here on TPU. Grid-tiled over
+columns so production-sized staging matrices (peers x megabytes) stream
+through VMEM tile by tile.
 """
 
 from __future__ import annotations
@@ -21,15 +24,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-LANE = 128
+from akka_allreduce_tpu.ops.pallas_kernels.tiling import col_tile, pad_cols
 
 
 def _kernel(staged_ref, valid_ref, out_ref, count_ref, *, target):
     valid = valid_ref[:]  # (peers, 1) f32
     contrib = staged_ref[:] * valid  # mask garbage from invalid peers
-    total = jnp.sum(contrib, axis=0)  # (elems,)
+    total = jnp.sum(contrib, axis=0)  # (tile,)
     count = jnp.sum(valid)
-    count_ref[0, 0] = count.astype(jnp.int32)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        count_ref[0, 0] = count.astype(jnp.int32)
+
     scale = jnp.where(count > 0, target / jnp.maximum(count, 1.0), 0.0)
     out_ref[:] = (total * scale)[None, :]
 
@@ -40,24 +47,31 @@ def fused_masked_reduce(staged: jnp.ndarray, valid: jnp.ndarray,
                         interpret: bool = False
                         ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """staged: (peers, elems) f32; valid: (peers,) — returns
-    (reduced (elems,), count scalar int32). ``elems`` should be a multiple
-    of 128 (lane width) for peak efficiency; any size compiles."""
+    (reduced (elems,), count scalar int32). Columns are processed in
+    lane-aligned tiles; any size compiles (zero-padded to the tile)."""
     peers, elems = staged.shape
     valid_f = valid.astype(jnp.float32).reshape(peers, 1)
+    tile = col_tile(peers, elems)
+    staged = pad_cols(staged, tile)
+    grid = staged.shape[1] // tile
     out, count = pl.pallas_call(
         functools.partial(_kernel, target=float(target)),
+        grid=(grid,),
         out_shape=(
-            jax.ShapeDtypeStruct((1, elems), jnp.float32),
+            jax.ShapeDtypeStruct((1, staged.shape[1]), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((peers, tile), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((peers, 1), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ),
         interpret=interpret,
     )(staged, valid_f)
-    return out[0], count[0, 0]
+    return out[0, :elems], count[0, 0]

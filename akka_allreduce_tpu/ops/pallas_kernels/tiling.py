@@ -1,0 +1,33 @@
+"""Shared VMEM tile sizing for the local (non-collective) kernels.
+
+One grid step of these kernels holds full-rows x one column tile per input
+array, and Pallas double-buffers every block for the pipeline — so the tile
+budget is PER INPUT ARRAY, sized to keep a step's resident footprint a few
+MiB against the ~16 MiB VMEM scoped limit (the quantize kernel's worst
+case: an f32 and a uint32 block plus the int8 output ~ 4.5 MiB at 1 MiB
+per input)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+TILE_BYTES = 1 << 20
+
+
+def col_tile(rows: int, elems: int) -> int:
+    """Widest lane-aligned column tile with (rows, tile) f32 <= TILE_BYTES,
+    clamped to the (lane-rounded) column count."""
+    per_row = max(LANE, TILE_BYTES // (4 * max(rows, 1)) // LANE * LANE)
+    return min(per_row, pl.cdiv(elems, LANE) * LANE)
+
+
+def pad_cols(x: jnp.ndarray, tile: int) -> jnp.ndarray:
+    """Zero-pad the last axis up to a multiple of ``tile`` (zeros are
+    harmless for every kernel here; callers slice the output back)."""
+    pad = (-x.shape[1]) % tile
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((x.shape[0], pad), x.dtype)], axis=1)
+    return x

@@ -1,0 +1,223 @@
+"""DCN router: the protocol engines over the JAX coordination service.
+
+The third transport behind the engines' ``register``/``send``/``poll``
+surface (after the in-process Router, protocol/transport.py, and the C++
+TCP router, protocol/tcp.py): messages travel through the coordination
+service's key-value store — the same service ``jax.distributed.initialize``
+already runs for every multi-host deployment (runtime/coordinator.py). The
+reference reaches remote actors through Akka remoting configured by seed
+nodes (reference: application.conf:5-16); here the "seed node" is the
+coordination service every JAX process is already joined to, so master and
+worker engines run across hosts with NO extra bootstrap, listener, or port
+— the host control plane rides the DCN fabric JAX itself uses.
+
+Mechanics: each process is addressed by its integer process rank. A message
+from src to dst is one KV entry ``aat/m/<dst>/<src>/<seq>`` holding a
+protocol/wire.py frame (refs travel as rank-addresses). ``poll`` scans the
+receiver's directory, delivers frames in per-sender seq order (the FIFO
+the protocol relies on, reference: AllreduceSpec.scala:590), and deletes
+consumed keys. Membership: each process announces ``aat/member/<rank>`` =
+role; poll surfaces new announcements via ``on_member`` (the MemberUp
+flow). Process failure is the coordination service's own concern — a dead
+task fails the service's heartbeat and jax.distributed surfaces it; this
+router adds no second failure detector.
+
+This is a CONTROL-plane transport (membership, pacing, host-side protocol
+emulation): per-message cost is a service RPC, so bulk gradient traffic
+belongs on the device plane's XLA collectives, not here.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from akka_allreduce_tpu.protocol import wire
+from akka_allreduce_tpu.protocol.transport import ActorRef
+
+log = logging.getLogger(__name__)
+
+_PREFIX = "aat"
+# Rank refs travel inside wire frames as (host="kv", port=rank) addresses,
+# reusing the codec unchanged.
+_KV_HOST = "kv"
+
+
+class KvRef:
+    """Addressable handle for a peer process's engine (by process rank)."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+
+    def __repr__(self) -> str:
+        return f"<kv rank={self.rank}>"
+
+
+def _default_client():
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "KvRouter needs the JAX coordination service: call "
+            "jax.distributed.initialize (or "
+            "runtime/coordinator.initialize_distributed) first")
+    return client
+
+
+class KvRouter:
+    """Router surface over the coordination-service KV store.
+
+    ``rank`` defaults to ``jax.process_index()``. ``on_member(ref, role)``
+    fires when another process's announcement is first seen.
+    """
+
+    def __init__(self, rank: Optional[int] = None, role: str = "worker",
+                 namespace: str = _PREFIX, client=None,
+                 on_member: Optional[Callable[[KvRef, str], None]] = None,
+                 on_terminated: Optional[Callable[[KvRef], None]] = None):
+        if client is None:
+            client = _default_client()
+        if rank is None:
+            import jax
+
+            rank = jax.process_index()
+        self._c = client
+        self.rank = int(rank)
+        self.role = role
+        self.ns = namespace
+        self.on_member = on_member
+        self.on_terminated = on_terminated  # fired by owner on service news
+
+        self._local: dict[ActorRef, Callable] = {}
+        self._primary: Optional[ActorRef] = None
+        self._local_mail: deque = deque()
+        self._refs: dict[int, KvRef] = {}
+        self._send_seq: dict[int, int] = {}
+        self._known_members: set[int] = set()
+        self._inbox = f"{self.ns}/m/{self.rank}/"
+        self._c.key_value_set(f"{self.ns}/member/{self.rank}", role,
+                              allow_overwrite=True)
+
+    # -- Router surface ------------------------------------------------------
+
+    def register(self, name: Optional[str] = None,
+                 handler: Optional[Callable] = None) -> ActorRef:
+        ref = ActorRef(name)
+        if handler is not None:
+            self._local[ref] = handler
+            if self._primary is None:
+                self._primary = ref
+        return ref
+
+    def send(self, ref, msg) -> None:
+        if isinstance(ref, ActorRef):
+            self._local_mail.append((ref, msg))  # actor self-send
+            return
+        if not isinstance(ref, KvRef):
+            raise TypeError(f"cannot route to {ref!r}")
+        if ref.rank == self.rank:
+            # self-delivery bypass (reference: AllreduceWorker.scala:228-231)
+            if self._primary is not None:
+                self._local_mail.append((self._primary, msg))
+            return
+        seq = self._send_seq.get(ref.rank, 0)
+        self._send_seq[ref.rank] = seq + 1
+        data = wire.encode(msg, self._addr_for)
+        self._c.key_value_set_bytes(
+            f"{self.ns}/m/{ref.rank}/{self.rank:06d}/{seq:012d}", data)
+
+    # -- ref/address resolution ----------------------------------------------
+
+    def ref_of(self, addr) -> "KvRef | ActorRef":
+        """Accepts a rank int or a ('kv', rank) wire address."""
+        rank = addr[1] if isinstance(addr, tuple) else int(addr)
+        if rank == self.rank and self._primary is not None:
+            return self._primary
+        ref = self._refs.get(rank)
+        if ref is None:
+            ref = self._refs[rank] = KvRef(rank)
+        return ref
+
+    def _addr_for(self, ref) -> wire.Addr:
+        if isinstance(ref, KvRef):
+            return (_KV_HOST, ref.rank)
+        return (_KV_HOST, self.rank)  # a local ref: our own rank
+
+    # -- event pump ----------------------------------------------------------
+
+    def poll(self, timeout_s: float = 0.0) -> int:
+        """Deliver local self-sends, new member announcements, and inbound
+        frames (per-sender FIFO). Blocks up to ``timeout_s`` for the first
+        activity; returns messages delivered."""
+        deadline = time.monotonic() + timeout_s
+        delivered = 0
+        while True:
+            delivered += self._drain_local()
+            self._scan_members()
+            delivered += self._drain_inbound()
+            if delivered or timeout_s == 0.0 \
+                    or time.monotonic() >= deadline:
+                return delivered
+            time.sleep(0.002)
+
+    def _drain_local(self) -> int:
+        n = 0
+        for _ in range(len(self._local_mail)):
+            ref, msg = self._local_mail.popleft()
+            handler = self._local.get(ref)
+            if handler is not None:
+                handler(msg)
+                n += 1
+        return n
+
+    def _scan_members(self) -> None:
+        if self.on_member is None:
+            return
+        try:
+            entries = self._c.key_value_dir_get(f"{self.ns}/member/")
+        except Exception:  # no entries yet surfaces as NOT_FOUND
+            return
+        for key, role in entries:
+            rank = int(key.rsplit("/", 1)[-1])
+            if rank == self.rank or rank in self._known_members:
+                continue
+            self._known_members.add(rank)
+            self.on_member(self.ref_of(rank), role)
+
+    def _drain_inbound(self) -> int:
+        try:
+            entries = self._c.key_value_dir_get_bytes(self._inbox)
+        except Exception:
+            return 0
+        if not entries:
+            return 0
+        n = 0
+        # keys sort as <src>/<seq> with fixed-width numbers: per-sender FIFO
+        for key, data in sorted(entries):
+            self._c.key_value_delete(key)
+            try:
+                msg = wire.decode(data, self.ref_of)
+            except Exception:
+                log.exception("dropping undecodable frame %s", key)
+                continue
+            if self._primary is not None:
+                self._local[self._primary](msg)
+                n += 1
+        return n
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        try:
+            self._c.key_value_delete(f"{self.ns}/member/{self.rank}")
+        except Exception:
+            pass
+
+    def __enter__(self) -> "KvRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

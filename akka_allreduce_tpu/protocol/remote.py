@@ -30,16 +30,33 @@ log = logging.getLogger(__name__)
 
 def run_master(config: AllreduceConfig, bind_host: str = "127.0.0.1",
                port: int = 2551, timeout_s: float = 120.0,
-               verbose: bool = True) -> int:
+               verbose: bool = True, heartbeat_interval_s: float = 2.0,
+               unreachable_after_s: Optional[float] = 10.0) -> int:
     """Serve membership + round pacing until ``config.data.max_round`` rounds
-    complete (or timeout). Returns rounds completed."""
+    complete (or timeout). Returns rounds completed.
+
+    ``unreachable_after_s`` is the liveness auto-down window (reference:
+    application.conf:20): a hung-but-connected worker silent that long is
+    removed from membership, and threshold semantics let the survivors'
+    rounds keep completing."""
     completed: list[int] = []
-    with TcpRouter(bind_host=bind_host, port=port, role="master") as router:
+    with TcpRouter(bind_host=bind_host, port=port, role="master",
+                   heartbeat_interval_s=heartbeat_interval_s,
+                   unreachable_after_s=unreachable_after_s) as router:
         master = AllreduceMaster(router, config,
                                  on_round_complete=completed.append)
         router.on_member = lambda ref, role: (
             master.member_up(ref, role) if role == "worker" else None)
-        router.on_terminated = master.terminated
+
+        def on_terminated(ref):
+            # the round marker lets operators (and the liveness test) see
+            # that progress continued past the down
+            if verbose:
+                print(f"master: worker down at round {len(completed)}",
+                      flush=True)
+            master.terminated(ref)
+
+        router.on_terminated = on_terminated
         if verbose:
             print(f"master: listening on {router.addr[0]}:{router.addr[1]}, "
                   f"waiting for {config.workers.total_size} workers")
@@ -57,13 +74,16 @@ def run_worker(master_host: str = "127.0.0.1", master_port: int = 2551,
                source_data_size: int = 10, checkpoint: int = 10,
                assert_multiple: int = 0, bind_host: str = "127.0.0.1",
                port: int = 0, timeout_s: float = 120.0,
-               verbose: bool = False) -> int:
+               verbose: bool = False, heartbeat_interval_s: float = 2.0,
+               unreachable_after_s: Optional[float] = 10.0) -> int:
     """Join the master, run the worker engine until the master disconnects
     (shutdown) or timeout. Returns outputs flushed to the sink."""
     sink = ThroughputSink(source_data_size, checkpoint=checkpoint,
                           assert_multiple=assert_multiple, verbose=verbose)
     alive = {"up": True}
-    with TcpRouter(bind_host=bind_host, port=port, role="worker") as router:
+    with TcpRouter(bind_host=bind_host, port=port, role="worker",
+                   heartbeat_interval_s=heartbeat_interval_s,
+                   unreachable_after_s=unreachable_after_s) as router:
         worker = AllreduceWorker(router, constant_range_source(
             source_data_size), sink)
         # Join-retry: the master may not be listening yet (workers and

@@ -50,7 +50,9 @@ class TcpRouter:
                  advertise_host: Optional[str] = None, role: str = "worker",
                  on_member: Optional[Callable[[RemoteRef, str], None]] = None,
                  on_terminated: Optional[Callable[[RemoteRef], None]] = None,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0,
+                 heartbeat_interval_s: float = 2.0,
+                 unreachable_after_s: Optional[float] = 10.0):
         self._lib = load_library()
         self._connect_timeout_ms = int(connect_timeout_s * 1000)
         self._t = self._lib.aat_create(bind_host.encode(), port)
@@ -61,6 +63,17 @@ class TcpRouter:
         self.role = role
         self.on_member = on_member
         self.on_terminated = on_terminated
+        # Liveness failure detection (reference: application.conf:20
+        # ``auto-down-unreachable-after = 10s``): every poll(), Pings go out
+        # at ``heartbeat_interval_s`` and any peer silent for
+        # ``unreachable_after_s`` is downed — connection closed, deathwatch
+        # fired — exactly as if it had disconnected. This catches
+        # hung-but-connected peers (SIGSTOP, GC pause, deadlock) that the
+        # closed-socket path never sees. ``None`` disables the detector.
+        self._hb_interval = heartbeat_interval_s
+        self._unreachable_after = unreachable_after_s
+        self._last_ping_sent = 0.0
+        self._last_heard: dict[int, float] = {}
 
         self._local: dict[ActorRef, Callable] = {}
         self._primary: Optional[ActorRef] = None
@@ -148,10 +161,44 @@ class TcpRouter:
             delivered += self._drain_local()
             delivered += self._drain_inbound()
             self._drain_disconnects()
+            self._heartbeat()
             if delivered or timeout_s == 0.0 \
                     or time.monotonic() >= deadline:
                 return delivered
             time.sleep(0.0002)
+
+    def _heartbeat(self) -> None:
+        """Send Pings at the heartbeat interval and down peers silent past
+        the unreachable window (the reference's auto-down,
+        application.conf:20). Runs from poll(), so a process that stops
+        polling also stops heartbeating and is downed by its peers."""
+        if self._unreachable_after is None:
+            return
+        now = time.monotonic()
+        if now - self._last_ping_sent < self._hb_interval:
+            return
+        self._last_ping_sent = now
+        ping = wire.encode(wire.Ping(), self._addr_for)
+        buf = (ctypes.c_uint8 * len(ping)).from_buffer_copy(ping)
+        for addr, conn in list(self._conn_of.items()):
+            heard = self._last_heard.get(conn)
+            if heard is None:
+                self._last_heard[conn] = now
+            elif now - heard > self._unreachable_after:
+                log.warning("downing unreachable peer %s:%s (silent %.1fs)",
+                            addr[0], addr[1], now - heard)
+                self._down_conn(conn, addr)
+                continue
+            self._lib.aat_send(self._t, conn, buf, len(ping))
+
+    def _down_conn(self, conn: int, addr: wire.Addr) -> None:
+        self._lib.aat_close_peer(self._t, conn)
+        self._last_heard.pop(conn, None)
+        self._addr_of_conn.pop(conn, None)
+        if self._conn_of.get(addr) == conn:
+            del self._conn_of[addr]
+        if self.on_terminated is not None and addr in self._refs:
+            self.on_terminated(self._refs[addr])
 
     def _drain_local(self) -> int:
         # Process only what was queued at entry: a handler that re-queues to
@@ -191,7 +238,11 @@ class TcpRouter:
                 log.exception("dropping undecodable frame from conn %d",
                               src.value)
                 continue
-            if isinstance(msg, wire.Hello):
+            # any frame proves the peer alive for the failure detector
+            self._last_heard[src.value] = time.monotonic()
+            if isinstance(msg, wire.Ping):
+                pass  # heartbeat only — never delivered to engines
+            elif isinstance(msg, wire.Hello):
                 self._handle_hello(msg, src.value)
             else:
                 if self._primary is not None:
@@ -213,6 +264,7 @@ class TcpRouter:
             conn = self._lib.aat_poll_disconnect(self._t)
             if conn < 0:
                 return
+            self._last_heard.pop(conn, None)
             addr = self._addr_of_conn.pop(conn, None)
             if addr is None:
                 continue

@@ -10,10 +10,10 @@ the reference uses (reference: AllreduceSpec.scala:812-818): a worker whose
 peer map points at the probe exposes its entire outbound traffic to
 assertions.
 
-A DCN transport for multi-host deployments implements the same ``send``
-surface over the JAX distributed coordination service (see
-runtime/coordinator.py); the protocol engine is unaware of which transport
-carries it.
+Two sibling transports implement the same ``register``/``send``/``poll``
+surface for real deployments — the C++ TCP router (protocol/tcp.py) and the
+DCN router over the JAX coordination service's KV store (protocol/kv.py);
+the protocol engines are unaware of which transport carries them.
 """
 
 from __future__ import annotations

@@ -35,6 +35,21 @@ MSG_START = 2
 MSG_SCATTER = 3
 MSG_REDUCE = 4
 MSG_COMPLETE = 5
+MSG_PING = 6
+
+
+class Ping:
+    """Transport-level heartbeat. Any inbound frame proves a peer alive;
+    Ping exists so liveness holds even when the protocol is quiet. It is the
+    failure-detector traffic behind the unreachable-after timeout
+    (reference: application.conf:20 ``auto-down-unreachable-after = 10s`` —
+    Akka's φ-detector pings members the same way). Consumed by the router,
+    never delivered to engines."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "Ping()"
 
 
 class Hello:
@@ -99,6 +114,8 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
                            len(payload)) + payload
     if isinstance(msg, CompleteAllreduce):
         return struct.pack("<Biq", MSG_COMPLETE, msg.src_id, msg.round)
+    if isinstance(msg, Ping):
+        return struct.pack("<B", MSG_PING)
     raise TypeError(f"cannot encode {type(msg).__name__}")
 
 
@@ -156,4 +173,6 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
     if mtype == MSG_COMPLETE:
         src, round_ = struct.unpack_from("<iq", buf, off)
         return CompleteAllreduce(src, round_)
+    if mtype == MSG_PING:
+        return Ping()
     raise ValueError(f"unknown message type {mtype}")

@@ -31,11 +31,16 @@ from akka_allreduce_tpu.runtime.pacer import RoundClock, RoundPacer
 
 @dataclasses.dataclass
 class RoundReport:
-    """What one paced round looked like from the host."""
+    """What one paced round looked like from the host.
+
+    ``valid_peers``/``n_masked`` describe what the clock SAW (pre-fallback),
+    so a fully-straggled round reports n_masked == num_peers even though
+    the step ran exact for liveness; ``fell_back`` marks those rounds."""
 
     round: int
     valid_peers: tuple[bool, ...]
     n_masked: int
+    fell_back: bool = False
 
 
 class DeadlineTrainer:
@@ -83,8 +88,10 @@ class DeadlineTrainer:
         r = self.pacer.round
         if not self.clock.is_open(r):
             self.clock.open_round(r)
-        valid = self.clock.valid_peers(r)
-        if not any(valid):
+        observed = self.clock.valid_peers(r)
+        valid = observed
+        fell_back = not any(observed)
+        if fell_back:
             # an all-masked round would psum to count 0 everywhere and
             # zero the gradient; keep liveness by letting every on-time
             # report count — here, nobody reported, so run exact. The
@@ -95,9 +102,12 @@ class DeadlineTrainer:
             np.asarray(valid, np.float32)[:, None], self.num_buckets, axis=1)
         out = self.pacer.submit(
             lambda _r: self.step(params, opt_state, tokens, mask))
+        # report what the clock observed, not the liveness substitution —
+        # a fully-straggled round must not masquerade as a clean one
         self.reports.append(RoundReport(
-            round=r, valid_peers=tuple(bool(v) for v in valid),
-            n_masked=sum(1 for v in valid if not v)))
+            round=r, valid_peers=tuple(bool(v) for v in observed),
+            n_masked=sum(1 for v in observed if not v),
+            fell_back=fell_back))
         self.clock.expire(r - self.pacer.max_lag)
         return out
 

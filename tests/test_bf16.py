@@ -70,6 +70,7 @@ class TestBf16Attention:
                                    atol=3e-2)
 
 
+@pytest.mark.slow
 class TestBf16Training:
     def test_invalid_dtype_rejected(self):
         mesh = make_device_mesh(MeshSpec(dp=8))

@@ -48,6 +48,7 @@ def tree_equal(a, b):
                for x, y in zip(leaves_a, leaves_b))
 
 
+@pytest.mark.slow
 class TestSaveRestore:
     def test_round_trip_preserves_values_and_sharding(self, tmp_path,
                                                       train_setup, mesh):
@@ -93,6 +94,7 @@ class TestSaveRestore:
                 m.restore(params, opt_state)
 
 
+@pytest.mark.slow
 class TestPreemptionResume:
     def test_killed_run_resumes_bit_exact(self, tmp_path, train_setup, mesh):
         """Run A trains 6 steps, checkpointing every 2, and 'dies'. Run B

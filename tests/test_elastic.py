@@ -74,6 +74,7 @@ class TestShrinkSpec:
             shrink_spec(MeshSpec(dp=2, tp=4), 3)
 
 
+@pytest.mark.slow
 class TestReshardAndContinue:
     def test_lose_host_reshard_keep_training(self):
         """dp=4 x tp=2 over 8 devices; host owning devices 2-3 dies ->

@@ -144,6 +144,7 @@ class TestMoELocal:
         assert float(aux["aux_loss"]) > 2.0 * balanced_value
 
 
+@pytest.mark.slow
 class TestMoEDistributedParity:
     """Gold test: 8-way ep dispatch == all-local, when nothing is dropped."""
 

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
@@ -44,6 +45,7 @@ class TestMoELayerPattern:
         assert all("w1" not in lyr for lyr in params["layers"])
 
 
+@pytest.mark.slow
 class TestMoEForward:
     def test_forward_and_aux(self):
         cfg = make_cfg()

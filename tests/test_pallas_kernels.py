@@ -73,6 +73,7 @@ class TestQuantized:
         assert s[0] == pytest.approx(1.0 / 127.0)
         assert s[1] == pytest.approx(1000.0 / 127.0)
 
+    @pytest.mark.slow
     def test_stochastic_rounding_is_unbiased(self):
         """Mean of many stochastic quantizations converges to the input —
         the property that keeps multi-round gradient sums unbiased."""

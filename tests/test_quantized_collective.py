@@ -42,6 +42,7 @@ def run_quantized(stacked, key, rows=8):
     return f(stacked, key)
 
 
+@pytest.mark.slow
 class TestQuantizedAllreduce:
     def test_close_to_exact_sum(self):
         rng = np.random.default_rng(0)
@@ -66,8 +67,19 @@ class TestQuantizedAllreduce:
         rng = np.random.default_rng(2)
         stacked = jnp.asarray(rng.normal(size=(N, 256)).astype(np.float32))
         exact = np.asarray(stacked.sum(0))
-        outs = np.stack([np.asarray(run_quantized(stacked,
-                                                  jax.random.key(s))[0])
+        mesh = single_axis_mesh("dp")
+
+        # jit once, vary the key as a traced argument — one compile for all
+        # 32 draws instead of a retrace per draw
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P()),
+                 out_specs=P("dp"), check_vma=False)
+        def f(xs, k):
+            out = quantized_two_phase_allreduce(
+                xs[0].reshape(8, -1), k, "dp")
+            return out.reshape(-1)[None]
+
+        outs = np.stack([np.asarray(f(stacked, jax.random.key(s))[0])
                          for s in range(32)])
         single_err = np.abs(outs[0] - exact).mean()
         mean_err = np.abs(outs.mean(0) - exact).mean()
@@ -156,6 +168,7 @@ class TestInt8GradSync:
             f(jnp.ones((4, 2, 64), jnp.float32))
 
 
+@pytest.mark.slow
 class TestInt8Training:
     def test_training_converges_with_int8_transport(self):
         mesh = make_device_mesh(MeshSpec(dp=8))

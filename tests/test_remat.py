@@ -44,6 +44,7 @@ def grads_for(cfg, mesh, tokens):
         max_seq=64,
         moe=MoEConfig(n_experts=4, d_ff=64, capacity_factor=8.0)), 1),
 ])
+@pytest.mark.slow
 def test_remat_grads_identical(spec, mcfg, micro):
     mesh = make_device_mesh(spec)
     tokens = make_tokens(8, 16)

@@ -75,6 +75,7 @@ class TestForwardParity:
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 class TestBackwardParity:
     def test_gradients_match_oracle(self, mesh):
         q, k, v = rand_qkv(2)

@@ -44,6 +44,7 @@ def make_tokens(b, t, seed=0):
                                     dtype=np.int32))
 
 
+@pytest.mark.slow
 class TestGradParity:
     @pytest.mark.parametrize("spec", [
         MeshSpec(dp=8), MeshSpec(dp=2, tp=2, sp=2), MeshSpec(dp=4, sp=2),
@@ -87,6 +88,7 @@ class TestGradParity:
         assert int(metrics["min_bucket_count"]) == 8  # dp*sp contributors
 
 
+@pytest.mark.slow
 class TestTraining:
     def test_loss_decreases_on_copy_task(self):
         """30 steps on a deterministic repeating-token task: the full

@@ -67,6 +67,7 @@ class TestSplitMerge:
             lambda a, b: (a == b).all(), merged, params))
 
 
+@pytest.mark.slow
 class TestMoEGradParity:
     @pytest.mark.parametrize("spec", [
         MeshSpec(dp=2, ep=4), MeshSpec(dp=2, ep=2, sp=2),
@@ -102,6 +103,7 @@ class TestMoEGradParity:
                              TrainConfig(model=mcfg), mesh)
 
 
+@pytest.mark.slow
 class TestMoETrainStep:
     def test_full_step_with_aux_loss(self):
         mesh = make_device_mesh(MeshSpec(dp=2, ep=2, sp=2))

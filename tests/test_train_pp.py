@@ -57,6 +57,7 @@ def assert_tree_close(got, ref, rtol=2e-4, atol=2e-5):
             err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow
 class TestPPGradParity:
     @pytest.mark.parametrize("spec,micro", [
         (MeshSpec(dp=2, pp=4), 2),
@@ -93,6 +94,7 @@ class TestPPGradParity:
         assert float(metrics["loss"]) == pytest.approx(ref_loss, rel=1e-5)
 
 
+@pytest.mark.slow
 class TestPPMoE:
     def test_moe_pipeline_grads_match_unsharded(self):
         mcfg = TransformerConfig(
