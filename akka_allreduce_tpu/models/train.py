@@ -489,17 +489,25 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 def make_train_step(cfg: TrainConfig, mesh: Mesh,
                     opt: optax.GradientTransformation,
                     valid_buckets: Optional[jnp.ndarray] = None,
-                    dynamic_valid: bool = False):
+                    dynamic_valid: bool = False,
+                    donate: bool = False):
     """Full jitted step: grads+sync under shard_map, elementwise optimizer
     on the global (sharded) arrays — XLA keeps the Megatron layout.
 
     With ``dynamic_valid=True`` the step takes a fourth argument — the
     per-round ``(n_data_ranks, num_buckets)`` contribution mask (see
-    make_grad_step) — traced, so changing it never recompiles."""
+    make_grad_step) — traced, so changing it never recompiles.
+
+    ``donate=True`` donates params and opt_state to the step (halves their
+    HBM residency — the lever that lets chip-filling configs fit). Only
+    for callers that rebind both from the step's return and never touch
+    the old arrays again (the training-loop pattern; cli.py train and the
+    MFU bench use it)."""
     grad_step = make_grad_step(cfg, mesh, valid_buckets,
                                dynamic_valid=dynamic_valid)
+    donate_args = (0, 1) if donate else ()
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_args)
     def step(params, opt_state, tokens):
         # the optimizer's step counter seeds the int8 transport's rounding
         # noise, so every round draws fresh bits even on repeated batches
@@ -509,7 +517,7 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
         params = optax.apply_updates(params, updates)
         return params, opt_state, metrics
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_args)
     def step_dynamic(params, opt_state, tokens, valid):
         count = optax.tree_utils.tree_get(opt_state, "count")
         grads, metrics = grad_step(params, tokens, quant_seed=count,

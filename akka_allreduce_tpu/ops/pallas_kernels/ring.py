@@ -18,6 +18,15 @@ this environment exposes one, so multi-chip execution is validated in
 interpreter mode where supported and structurally otherwise — the public
 wrapper falls back to ``lax.psum`` for group size 1 and keeps the whole
 package runnable anywhere.
+
+STATUS: EXPERIMENTAL until a real >= 2-chip run exists. The double-buffer
+slot-free handshake (see ``send_step``) is exactly the flow-control code
+that deadlocks or races only on real ICI; interpreter mode executes ranks
+sequentially and elides the handshake entirely, so it validates the ring
+schedule and reuse across invocations (tests cover repeated calls inside
+``lax.scan`` step loops at n=4/8), NOT the concurrent semaphore protocol.
+Production gradient sync uses the XLA collectives (ops/collectives.py);
+route through this kernel only on hardware where you can A/B it.
 """
 
 from __future__ import annotations
@@ -145,7 +154,10 @@ def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str = "dp",
                           interpret: bool = False) -> jnp.ndarray:
     """Rank-local (inside shard_map) allreduce of a flat f32 vector via the
     hand-scheduled ring. Requires ``x.size % (n * 128) == 0``; group size 1
-    falls back to the identity psum."""
+    falls back to the identity psum.
+
+    EXPERIMENTAL on real multi-chip ICI — see the module docstring; the
+    inter-device handshake has only ever executed in interpreter mode."""
     n = lax.axis_size(axis_name)
     if n == 1:
         return lax.psum(x, axis_name)

@@ -197,15 +197,21 @@ class KvRouter:
         n = 0
         # keys sort as <src>/<seq> with fixed-width numbers: per-sender FIFO
         for key, data in sorted(entries):
-            self._c.key_value_delete(key)
+            if self._primary is None:
+                # no engine registered yet (a master's InitWorkers can
+                # arrive before register()): leave the message in the
+                # store for redelivery on a later poll — deleting first
+                # would punch a permanent hole in the sender's FIFO
+                return n
             try:
                 msg = wire.decode(data, self.ref_of)
             except Exception:
                 log.exception("dropping undecodable frame %s", key)
+                self._c.key_value_delete(key)
                 continue
-            if self._primary is not None:
-                self._local[self._primary](msg)
-                n += 1
+            self._c.key_value_delete(key)
+            self._local[self._primary](msg)
+            n += 1
         return n
 
     # -- lifecycle -----------------------------------------------------------

@@ -65,11 +65,23 @@ class TcpRouter:
         self.on_terminated = on_terminated
         # Liveness failure detection (reference: application.conf:20
         # ``auto-down-unreachable-after = 10s``): every poll(), Pings go out
-        # at ``heartbeat_interval_s`` and any peer silent for
-        # ``unreachable_after_s`` is downed — connection closed, deathwatch
-        # fired — exactly as if it had disconnected. This catches
-        # hung-but-connected peers (SIGSTOP, GC pause, deadlock) that the
-        # closed-socket path never sees. ``None`` disables the detector.
+        # at ``heartbeat_interval_s`` REGARDLESS of the local detector (a
+        # node that opted out of detecting must stay detectable, or its
+        # detector-enabled peers down it during quiet stretches), and any
+        # peer silent for ``unreachable_after_s`` is downed — connection
+        # closed, deathwatch fired — exactly as if it had disconnected.
+        # This catches hung-but-connected peers (SIGSTOP, GC pause,
+        # deadlock) that the closed-socket path never sees. ``None``
+        # disables the local detector only.
+        if unreachable_after_s is not None \
+                and unreachable_after_s < 2 * heartbeat_interval_s:
+            # a window shorter than the peers' ping cadence downs healthy
+            # peers: at a detection tick their last ping can legitimately
+            # be a full interval old
+            raise ValueError(
+                f"unreachable_after_s={unreachable_after_s} must be at "
+                f"least 2 x heartbeat_interval_s={heartbeat_interval_s} "
+                f"(or None to disable the detector)")
         self._hb_interval = heartbeat_interval_s
         self._unreachable_after = unreachable_after_s
         self._last_ping_sent = 0.0
@@ -168,12 +180,13 @@ class TcpRouter:
             time.sleep(0.0002)
 
     def _heartbeat(self) -> None:
-        """Send Pings at the heartbeat interval and down peers silent past
-        the unreachable window (the reference's auto-down,
-        application.conf:20). Runs from poll(), so a process that stops
-        polling also stops heartbeating and is downed by its peers."""
-        if self._unreachable_after is None:
-            return
+        """Send Pings at the heartbeat interval and (when the local
+        detector is enabled) down peers silent past the unreachable window
+        (the reference's auto-down, application.conf:20). Runs from
+        poll(), so a process that stops polling also stops heartbeating
+        and is downed by its peers. Pings are sent even when the local
+        detector is disabled — opting out of detecting must not make this
+        node undetectable."""
         now = time.monotonic()
         if now - self._last_ping_sent < self._hb_interval:
             return
@@ -184,7 +197,8 @@ class TcpRouter:
             heard = self._last_heard.get(conn)
             if heard is None:
                 self._last_heard[conn] = now
-            elif now - heard > self._unreachable_after:
+            elif self._unreachable_after is not None \
+                    and now - heard > self._unreachable_after:
                 log.warning("downing unreachable peer %s:%s (silent %.1fs)",
                             addr[0], addr[1], now - heard)
                 self._down_conn(conn, addr)

@@ -100,8 +100,22 @@ class DeadlineTrainer:
             valid = [True] * self.clock.num_peers
         mask = np.repeat(
             np.asarray(valid, np.float32)[:, None], self.num_buckets, axis=1)
-        out = self.pacer.submit(
-            lambda _r: self.step(params, opt_state, tokens, mask))
+        result = {}
+
+        def launch(_r):
+            out = self.step(params, opt_state, tokens, mask)
+            result["out"] = out
+            # the pacer harvests (block_until_ready) what we return; hand
+            # it only the metrics — with a donating step, the old round's
+            # params/opt_state buffers are consumed by a NEWER call before
+            # the window forces a harvest, and blocking on a donated
+            # buffer raises. Metrics are never donated, and the single
+            # device stream runs rounds in order, so metrics-ready
+            # implies the round is done.
+            return out[2]
+
+        self.pacer.submit(launch)
+        out = result["out"]
         # report what the clock observed, not the liveness substitution —
         # a fully-straggled round must not masquerade as a clean one
         self.reports.append(RoundReport(

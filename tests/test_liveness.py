@@ -56,6 +56,28 @@ class TestHeartbeatDetector:
                     b.poll(0.01)
         assert downed == []
 
+    def test_detector_disabled_node_stays_detectable(self):
+        """A node that disables ITS detector must still send Pings — else
+        detector-enabled peers down it during protocol-quiet stretches."""
+        downed = []
+        with TcpRouter(role="master", heartbeat_interval_s=0.05,
+                       unreachable_after_s=0.4,
+                       on_terminated=downed.append) as a:
+            with TcpRouter(role="worker", heartbeat_interval_s=0.05,
+                           unreachable_after_s=None) as b:  # detector off
+                b.register("w", handler=lambda m: None)
+                b.dial(a.addr)
+                end = time.monotonic() + 1.2
+                while time.monotonic() < end:
+                    a.poll(0.01)
+                    b.poll(0.01)  # b polls (pings) but never detects
+        assert downed == []
+
+    def test_window_shorter_than_ping_cadence_rejected(self):
+        with pytest.raises(ValueError, match="heartbeat_interval"):
+            TcpRouter(role="master", heartbeat_interval_s=2.0,
+                      unreachable_after_s=1.0)
+
     def test_detector_disabled_never_downs(self):
         downed = []
         with TcpRouter(role="master", heartbeat_interval_s=0.05,

@@ -133,6 +133,43 @@ class TestRingAllreduce:
             # atol covers summation-order noise on near-zero sums
             np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_repeated_invocation_in_scan_step_loop(self, n):
+        """The kernel re-invoked every step of a lax.scan training-style
+        loop (ring.py's stale-grant reasoning: a leftover semaphore credit
+        from invocation k would let invocation k+1's send race ahead).
+        Interpreter mode elides the handshake itself, but this pins the
+        schedule's state reset across invocations: every step must produce
+        the exact psum of its own (carry-dependent) inputs."""
+        mesh = single_axis_mesh("dp", devices=jax.devices()[:n])
+        elems = n * 128
+        steps = 4
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(x):
+            def one(carry, _):
+                summed = pallas_ring_allreduce(carry, "dp", interpret=True)
+                # next step's input depends on this step's collective
+                return carry + summed / jnp.float32(n), summed
+            _, sums = jax.lax.scan(one, x[0], None, length=steps)
+            return sums[None]
+
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(n, elems)).astype(np.float32))
+        try:
+            out = np.asarray(jax.jit(run)(x))  # (n, steps, elems)
+        except Exception as e:  # pragma: no cover - env capability probe
+            pytest.skip(f"distributed pallas interpret unsupported: {e}")
+        carry = np.asarray(x, np.float64)
+        for s in range(steps):
+            want = carry.sum(axis=0)
+            for r in range(n):
+                np.testing.assert_allclose(out[r, s], want, rtol=1e-4,
+                                           atol=1e-4,
+                                           err_msg=f"step {s} rank {r}")
+            carry = carry + want[None, :] / n
+
     @pytest.mark.parametrize("n", [2, 3, 4, 8])
     def test_ring_schedule_index_math(self, n):
         """Simulate the kernel's exact ring schedule (same index formulas as
