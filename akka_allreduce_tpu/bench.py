@@ -241,6 +241,20 @@ def main() -> None:
     platform = os.environ.get("AATPU_BENCH_PLATFORM", "default")
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: the watchdogged attempt budget (repo-root
+    # bench.py) is dominated by compiles on a cold backend; caching across
+    # attempts/rounds buys the measurement loop the time instead
+    try:
+        cache_dir = os.environ.get(
+            "AATPU_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"))
+        if cache_dir:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+    except Exception:
+        pass  # cache is an optimization, never a failure
     elems = int(os.environ.get("AATPU_BENCH_ELEMS", ELEMS))
     bucket_elems = int(os.environ.get("AATPU_BENCH_BUCKET_ELEMS",
                                       min(BUCKET_ELEMS, elems)))

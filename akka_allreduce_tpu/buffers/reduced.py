@@ -103,6 +103,7 @@ class ReducedDataBuffer(AllReduceBuffer):
     def reach_completion_threshold(self, row: int) -> bool:
         """Round completes when the total number of stored reduced chunks
         *equals* the gate — ``==``, exactly-once
-        (reference: ReducedDataBuffer.scala:60-66)."""
-        total = int(self.count_filled[self._time_idx(row)].sum())
-        return total == self.min_chunk_required
+        (reference: ReducedDataBuffer.scala:60-66). O(1): reads the
+        running total the base buffer maintains per store."""
+        return int(self.total_filled[self._time_idx(row)]) \
+            == self.min_chunk_required

@@ -1,0 +1,150 @@
+"""Autoregressive decoding for the flagship transformer: KV cache + scan.
+
+The reference is a training-side system (no inference path exists to
+mirror), but a complete framework needs one: this module turns the trained
+checkpoint into tokens. TPU-first shape discipline throughout: the KV cache
+is a preallocated static ``(layers, batch, max_seq, heads, head_dim)``
+buffer updated with ``lax.dynamic_update_slice`` at the decode position,
+the decode loop is one ``lax.scan`` inside ``jit`` (no per-token Python,
+no host round-trips mid-generation), and attention over the cache masks by
+position instead of slicing to a dynamic length, so every step compiles to
+the same static-shape program.
+
+Numerics are pinned by a parity test (tests/test_generate.py): for any
+prompt, incremental cached decode must reproduce the full-sequence forward
+logits exactly (same ops, same dtypes) — the cache is an optimization,
+never a different model. MoE layers route per decoded token exactly as in
+training (capacity follows the 1-token sequence).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    rmsnorm,
+)
+from akka_allreduce_tpu.parallel.ep import moe_ffn
+from akka_allreduce_tpu.parallel.ring_attention import NEG_INF
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int) -> dict:
+    """Static-shape cache: one (batch, max_seq, heads, head_dim) K and V
+    buffer per layer, plus the write position. Buffers use the model's
+    compute dtype — the parity contract (and, for bf16 models, half the
+    cache HBM) depends on the cached K/V matching what the full forward's
+    attention consumed."""
+    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
+                      v_all: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """q: (b, 1, h, d); k_all/v_all: (b, max_seq, h, d) with positions
+    <= pos valid. Masked softmax over the full static buffer — the causal
+    mask IS the length mask at decode time."""
+    # op-for-op the math of local_causal_attention (same scale form, f32
+    # score/softmax, same cast points) so cached decode is bit-identical
+    # to the full forward at every valid position
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+                        preferred_element_type=jnp.float32) * scale
+    valid = (jnp.arange(k_all.shape[1]) <= pos)[None, None, None, :]
+    scores = jnp.where(valid, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_all.dtype), v_all,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def decode_step(params: dict, cache: dict, token: jnp.ndarray,
+                cfg: TransformerConfig) -> tuple[dict, jnp.ndarray]:
+    """One incremental step: consume ``token`` (b,) int32 at ``cache.pos``,
+    return (updated cache, logits (b, vocab)).
+
+    Mirrors transformer_apply's block math exactly (same layer dicts, same
+    rmsnorm/residual order) with attention served from the cache; parity
+    with the full forward is pinned by tests/test_generate.py.
+    """
+    b = token.shape[0]
+    pos = cache["pos"]
+    x = params["embed"][token][:, None, :] \
+        + lax.dynamic_slice_in_dim(params["pos"], pos, 1, axis=0)[None]
+    k_cache, v_cache = cache["k"], cache["v"]
+    for i, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["ln1"])
+        q = (h @ layer["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k[None].astype(k_cache.dtype), (i, 0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v[None].astype(v_cache.dtype), (i, 0, pos, 0, 0))
+        attn = _cached_attention(q, k_cache[i], v_cache[i], pos)
+        x = x + attn.reshape(b, 1, -1) @ layer["wo"]
+
+        h = rmsnorm(x, layer["ln2"])
+        if "router" in layer:
+            y, _aux = moe_ffn(h, layer, cfg.moe, axis_name=None)
+            x = x + y
+        else:
+            x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    logits = rmsnorm(x, params["out_norm"]) @ params["lm_head"]
+    new_cache = {"k": k_cache, "v": v_cache, "pos": pos + 1}
+    return new_cache, logits[:, 0, :]
+
+
+def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
+            cfg: TransformerConfig) -> tuple[dict, jnp.ndarray]:
+    """Feed the prompt (b, t) token by token via lax.scan; returns the
+    cache positioned after the prompt and the last step's logits."""
+    def one(c, tok):
+        c, logits = decode_step(params, c, tok, cfg)
+        return c, logits
+
+    cache, all_logits = lax.scan(one, cache, prompt.T)
+    return cache, all_logits[-1]
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "temperature"))
+def generate(params: dict, prompt: jnp.ndarray, cfg: TransformerConfig,
+             steps: int, key: Optional[jax.Array] = None,
+             temperature: float = 0.0) -> jnp.ndarray:
+    """Generate ``steps`` tokens after ``prompt`` (b, t) int32. Greedy when
+    ``temperature == 0`` (key unused), else temperature sampling. Returns
+    (b, steps) int32. One compiled program: prefill scan + decode scan."""
+    if prompt.shape[1] + steps > cfg.max_seq:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + steps {steps} exceeds "
+            f"max_seq {cfg.max_seq}")
+    b = prompt.shape[0]
+    cache = init_kv_cache(cfg, b)
+    cache, logits = prefill(params, cache, prompt, cfg)
+    if key is None:
+        key = jax.random.key(0)
+
+    def pick(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def one(carry, k):
+        cache, logits = carry
+        tok = pick(logits, k)
+        cache, logits = decode_step(params, cache, tok, cfg)
+        return (cache, logits), tok
+
+    keys = jax.random.split(key, steps)
+    _, tokens = lax.scan(one, (cache, logits), keys)
+    return tokens.T  # (b, steps)

@@ -86,6 +86,11 @@ class TcpRouter:
         self._unreachable_after = unreachable_after_s
         self._last_ping_sent = 0.0
         self._last_heard: dict[int, float] = {}
+        # each peer's advertised ping cadence (learned from its Pings): the
+        # down check widens its window to 2x this for slow-pinging peers,
+        # so asymmetric intervals can't produce false downs — the local
+        # 2x-interval ctor guard only covers symmetric deployments
+        self._peer_interval: dict[int, float] = {}
 
         self._local: dict[ActorRef, Callable] = {}
         self._primary: Optional[ActorRef] = None
@@ -191,23 +196,29 @@ class TcpRouter:
         if now - self._last_ping_sent < self._hb_interval:
             return
         self._last_ping_sent = now
-        ping = wire.encode(wire.Ping(), self._addr_for)
+        ping = wire.encode(wire.Ping(self._hb_interval), self._addr_for)
         buf = (ctypes.c_uint8 * len(ping)).from_buffer_copy(ping)
         for addr, conn in list(self._conn_of.items()):
             heard = self._last_heard.get(conn)
             if heard is None:
                 self._last_heard[conn] = now
-            elif self._unreachable_after is not None \
-                    and now - heard > self._unreachable_after:
-                log.warning("downing unreachable peer %s:%s (silent %.1fs)",
-                            addr[0], addr[1], now - heard)
-                self._down_conn(conn, addr)
-                continue
+            elif self._unreachable_after is not None:
+                # a slow-pinging (but alive) peer legitimately goes quiet
+                # for its whole interval: never down inside 2x its cadence
+                window = max(self._unreachable_after,
+                             2 * self._peer_interval.get(conn, 0.0))
+                if now - heard > window:
+                    log.warning(
+                        "downing unreachable peer %s:%s (silent %.1fs)",
+                        addr[0], addr[1], now - heard)
+                    self._down_conn(conn, addr)
+                    continue
             self._lib.aat_send(self._t, conn, buf, len(ping))
 
     def _down_conn(self, conn: int, addr: wire.Addr) -> None:
         self._lib.aat_close_peer(self._t, conn)
         self._last_heard.pop(conn, None)
+        self._peer_interval.pop(conn, None)
         self._addr_of_conn.pop(conn, None)
         if self._conn_of.get(addr) == conn:
             del self._conn_of[addr]
@@ -255,7 +266,10 @@ class TcpRouter:
             # any frame proves the peer alive for the failure detector
             self._last_heard[src.value] = time.monotonic()
             if isinstance(msg, wire.Ping):
-                pass  # heartbeat only — never delivered to engines
+                # heartbeat only — never delivered to engines; remember
+                # the sender's cadence for the adaptive down window
+                if msg.interval > 0:
+                    self._peer_interval[src.value] = msg.interval
             elif isinstance(msg, wire.Hello):
                 self._handle_hello(msg, src.value)
             else:
@@ -279,6 +293,7 @@ class TcpRouter:
             if conn < 0:
                 return
             self._last_heard.pop(conn, None)
+            self._peer_interval.pop(conn, None)
             addr = self._addr_of_conn.pop(conn, None)
             if addr is None:
                 continue

@@ -43,13 +43,18 @@ class Ping:
     Ping exists so liveness holds even when the protocol is quiet. It is the
     failure-detector traffic behind the unreachable-after timeout
     (reference: application.conf:20 ``auto-down-unreachable-after = 10s`` —
-    Akka's φ-detector pings members the same way). Consumed by the router,
-    never delivered to engines."""
+    Akka's φ-detector pings members the same way). Carries the sender's
+    heartbeat interval so the receiver's detector can widen its window for
+    slow-pinging peers instead of falsely downing them (asymmetric
+    deployments). Consumed by the router, never delivered to engines."""
 
-    __slots__ = ()
+    __slots__ = ("interval",)
+
+    def __init__(self, interval: float = 0.0):
+        self.interval = interval
 
     def __repr__(self) -> str:
-        return "Ping()"
+        return f"Ping({self.interval})"
 
 
 class Hello:
@@ -115,7 +120,7 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
     if isinstance(msg, CompleteAllreduce):
         return struct.pack("<Biq", MSG_COMPLETE, msg.src_id, msg.round)
     if isinstance(msg, Ping):
-        return struct.pack("<B", MSG_PING)
+        return struct.pack("<Bd", MSG_PING, msg.interval)
     raise TypeError(f"cannot encode {type(msg).__name__}")
 
 
@@ -174,5 +179,6 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
         src, round_ = struct.unpack_from("<iq", buf, off)
         return CompleteAllreduce(src, round_)
     if mtype == MSG_PING:
-        return Ping()
+        (interval,) = struct.unpack_from("<d", buf, off)
+        return Ping(interval)
     raise ValueError(f"unknown message type {mtype}")

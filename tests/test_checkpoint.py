@@ -95,6 +95,7 @@ class TestSaveRestore:
 
 
 @pytest.mark.slow
+@pytest.mark.xdist_group("cluster-procs")
 class TestPreemptionResume:
     def test_killed_run_resumes_bit_exact(self, tmp_path, train_setup, mesh):
         """Run A trains 6 steps, checkpointing every 2, and 'dies'. Run B

@@ -1,0 +1,142 @@
+"""Decode-path tests: cached incremental decode == full forward.
+
+The KV cache is an optimization, not a different model: prefill+decode must
+reproduce transformer_apply's logits exactly (same ops, same cast points).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.generate import (
+    decode_step,
+    generate,
+    init_kv_cache,
+    prefill,
+)
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+    transformer_apply,
+)
+from akka_allreduce_tpu.parallel.ep import MoEConfig
+
+CFG = TransformerConfig(vocab_size=97, d_model=64, n_heads=4, n_layers=3,
+                        d_ff=128, max_seq=24)
+
+
+def tokens_for(cfg, b, t, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).integers(
+        0, cfg.vocab_size, size=(b, t), dtype=np.int32))
+
+
+class TestDecodeParity:
+    def test_incremental_matches_full_forward(self):
+        params = init_transformer(jax.random.key(0), CFG)
+        toks = tokens_for(CFG, b=2, t=10)
+        full = transformer_apply(params, toks, CFG)  # (b, t, vocab)
+
+        cache = init_kv_cache(CFG, batch=2)
+        got = []
+        for i in range(10):
+            cache, logits = decode_step(params, cache, toks[:, i], CFG)
+            got.append(logits)
+        inc = jnp.stack(got, axis=1)
+        np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_prefill_matches_stepwise(self):
+        params = init_transformer(jax.random.key(1), CFG)
+        toks = tokens_for(CFG, b=2, t=8, seed=3)
+        c1 = init_kv_cache(CFG, batch=2)
+        c1, last = prefill(params, c1, toks, CFG)
+        c2 = init_kv_cache(CFG, batch=2)
+        for i in range(8):
+            c2, logits = decode_step(params, c2, toks[:, i], CFG)
+        # scan-traced vs eagerly-traced steps fuse differently; tolerances
+        # cover the resulting float noise, not a semantic gap
+        np.testing.assert_allclose(np.asarray(last), np.asarray(logits),
+                                   rtol=1e-5, atol=1e-5)
+        assert int(c1["pos"]) == int(c2["pos"]) == 8
+        np.testing.assert_allclose(np.asarray(c1["k"]), np.asarray(c2["k"]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bf16_decode_parity(self):
+        """bf16 model: the cache must hold bf16 K/V (what the full
+        forward's attention consumed) so cached decode matches within
+        bf16 noise."""
+        cfg = TransformerConfig(vocab_size=61, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_seq=12,
+                                dtype=jnp.bfloat16)
+        params = init_transformer(jax.random.key(4), cfg)
+        toks = tokens_for(cfg, b=2, t=6, seed=13)
+        full = transformer_apply(params, toks, cfg).astype(jnp.float32)
+        cache = init_kv_cache(cfg, batch=2)
+        assert cache["k"].dtype == jnp.bfloat16
+        got = []
+        for i in range(6):
+            cache, logits = decode_step(params, cache, toks[:, i], cfg)
+            got.append(logits.astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(jnp.stack(got, 1)),
+                                   np.asarray(full), rtol=0.05, atol=0.05)
+
+    def test_moe_decode_parity(self):
+        """Per-token routing through the expert FF: generous capacity so
+        neither path drops tokens, then logits must match."""
+        cfg = TransformerConfig(
+            vocab_size=61, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=12,
+            moe=MoEConfig(n_experts=4, d_ff=64, capacity_factor=8.0,
+                          router_k=2),
+            moe_every=2)
+        params = init_transformer(jax.random.key(2), cfg)
+        toks = tokens_for(cfg, b=2, t=6, seed=5)
+        full = transformer_apply(params, toks, cfg)
+        cache = init_kv_cache(cfg, batch=2)
+        got = []
+        for i in range(6):
+            cache, logits = decode_step(params, cache, toks[:, i], cfg)
+            got.append(logits)
+        np.testing.assert_allclose(np.asarray(jnp.stack(got, 1)),
+                                   np.asarray(full), rtol=2e-5, atol=2e-5)
+
+
+class TestGenerate:
+    def test_greedy_is_deterministic_and_in_range(self):
+        params = init_transformer(jax.random.key(0), CFG)
+        prompt = tokens_for(CFG, b=2, t=4, seed=7)
+        out1 = generate(params, prompt, CFG, steps=6)
+        out2 = generate(params, prompt, CFG, steps=6)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        assert out1.shape == (2, 6)
+        assert (np.asarray(out1) >= 0).all()
+        assert (np.asarray(out1) < CFG.vocab_size).all()
+
+    def test_greedy_matches_full_forward_argmax(self):
+        """The first generated token must be argmax of the full forward's
+        last-position logits — generation is the model, not a new one."""
+        params = init_transformer(jax.random.key(0), CFG)
+        prompt = tokens_for(CFG, b=3, t=5, seed=9)
+        full = transformer_apply(params, prompt, CFG)
+        want_first = np.argmax(np.asarray(full[:, -1]), axis=-1)
+        out = generate(params, prompt, CFG, steps=1)
+        np.testing.assert_array_equal(np.asarray(out[:, 0]), want_first)
+
+    def test_sampling_respects_temperature_key(self):
+        params = init_transformer(jax.random.key(0), CFG)
+        prompt = tokens_for(CFG, b=2, t=3, seed=11)
+        a = generate(params, prompt, CFG, steps=8,
+                     key=jax.random.key(1), temperature=1.5)
+        b = generate(params, prompt, CFG, steps=8,
+                     key=jax.random.key(1), temperature=1.5)
+        c = generate(params, prompt, CFG, steps=8,
+                     key=jax.random.key(2), temperature=1.5)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert (np.asarray(a) != np.asarray(c)).any()
+
+    def test_budget_overflow_rejected(self):
+        params = init_transformer(jax.random.key(0), CFG)
+        prompt = tokens_for(CFG, b=1, t=20)
+        with pytest.raises(ValueError, match="max_seq"):
+            generate(params, prompt, CFG, steps=10)

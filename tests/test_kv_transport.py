@@ -19,6 +19,7 @@ from akka_allreduce_tpu.protocol.remote import free_port
 
 
 @pytest.mark.slow
+@pytest.mark.xdist_group("cluster-procs")
 class TestTwoProcessCluster:
     def test_psum_and_kv_engines_across_processes(self):
         port = free_port()

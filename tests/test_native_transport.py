@@ -137,6 +137,7 @@ class TestTcpRouter:
 
 
 @pytest.mark.slow
+@pytest.mark.xdist_group("cluster-procs")
 class TestMultiProcessCluster:
     def test_master_and_workers_as_processes(self, tmp_path):
         """The reference's canonical smoke (scripts/testAllreduce*.sc):
