@@ -224,6 +224,122 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--max-lag", type=int, default=1,
                    help="in-flight round window for the deadline pacer "
                         "(the reference's maxLag)")
+    p.add_argument("--data-file", default=None,
+                   help="train on a real corpus: raw bytes (vocab 256) or "
+                        "*.bin little-endian uint16 tokens (vocab 65536); "
+                        "omitted = synthetic random tokens. Batches are "
+                        "deterministic in the step index, so checkpoint "
+                        "resume replays the exact stream")
+
+
+def _add_generate(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "generate", help="decode from a trained checkpoint (KV-cache "
+        "incremental decoding, models/generate.py)")
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--d-ff", type=int, default=512)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--max-seq", type=int, required=True,
+                   help="the trained model's max_seq (= train's --seq): "
+                        "the positional table's shape, which the "
+                        "checkpoint restore must match; prompt + --tokens "
+                        "must fit inside it")
+    p.add_argument("--moe-experts", type=int, default=0)
+    p.add_argument("--moe-every", type=int, default=1)
+    p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--router-k", type=int, default=2)
+    p.add_argument("--prompt", default=None,
+                   help="text prompt, consumed byte-level (vocab 256 "
+                        "models)")
+    p.add_argument("--prompt-tokens", default=None,
+                   help="comma-separated token ids (any vocab)")
+    p.add_argument("--tokens", type=int, default=64,
+                   help="tokens to generate")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--raw", action="store_true",
+                   help="print token ids instead of decoding bytes")
+
+
+def _cmd_generate(args: argparse.Namespace) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.models.generate import generate
+    from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                 make_train_state)
+    from akka_allreduce_tpu.models.transformer import TransformerConfig
+    from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+    from akka_allreduce_tpu.runtime.checkpoint import (CheckpointConfig,
+                                                       restore_or_init)
+
+    if (args.prompt is None) == (args.prompt_tokens is None):
+        print("error: exactly one of --prompt / --prompt-tokens",
+              file=sys.stderr)
+        return 2
+    if args.prompt is not None:
+        ids = list(args.prompt.encode())
+        if args.vocab < 256:
+            print(f"error: --prompt is byte-level but vocab={args.vocab}",
+                  file=sys.stderr)
+            return 2
+    else:
+        try:
+            ids = [int(x) for x in args.prompt_tokens.split(",") if x]
+        except ValueError:
+            print(f"error: bad --prompt-tokens {args.prompt_tokens!r}",
+                  file=sys.stderr)
+            return 2
+        if any(i < 0 or i >= args.vocab for i in ids):
+            print("error: prompt token out of vocab range", file=sys.stderr)
+            return 2
+    if not ids:
+        print("error: empty prompt", file=sys.stderr)
+        return 2
+    max_seq = args.max_seq
+    if len(ids) + args.tokens > max_seq:
+        print(f"error: prompt ({len(ids)}) + --tokens ({args.tokens}) "
+              f"exceeds --max-seq {max_seq}", file=sys.stderr)
+        return 2
+    moe = None
+    if args.moe_experts:
+        from akka_allreduce_tpu.parallel.ep import MoEConfig
+        moe = MoEConfig(n_experts=args.moe_experts, d_ff=args.d_ff,
+                        capacity_factor=args.capacity_factor,
+                        router_k=args.router_k)
+    mcfg = TransformerConfig(vocab_size=args.vocab, d_model=args.d_model,
+                             n_heads=args.n_heads, n_layers=args.n_layers,
+                             d_ff=args.d_ff, max_seq=max_seq,
+                             moe=moe, moe_every=args.moe_every)
+    cfg = TrainConfig(model=mcfg)
+    mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    params, opt_state, _opt = make_train_state(jax.random.key(0), cfg, mesh)
+    step0, params, _, _, mgr = restore_or_init(
+        CheckpointConfig(args.ckpt_dir), params, opt_state)
+    if mgr is not None:
+        mgr.close()  # restore-only use: release orbax's async machinery
+    if step0 == 0:
+        print(f"error: no checkpoint found in {args.ckpt_dir} "
+              f"(or shapes mismatch)", file=sys.stderr)
+        return 2
+    print(f"restored step {step0 - 1} from {args.ckpt_dir}",
+          file=sys.stderr)
+    prompt = jnp.asarray(np.asarray(ids, np.int32))[None]
+    out = generate(params, prompt, mcfg, steps=args.tokens,
+                   key=jax.random.key(args.seed),
+                   temperature=args.temperature)
+    toks = np.asarray(out)[0].tolist()
+    if args.raw or args.prompt_tokens is not None:
+        print(",".join(map(str, toks)))
+    else:
+        print(bytes(t for t in toks if t < 256
+                    ).decode("utf-8", errors="replace"))
+    return 0
 
 
 def _cmd_train(args: argparse.Namespace) -> int:
@@ -288,6 +404,17 @@ def _cmd_train(args: argparse.Namespace) -> int:
     micro = args.microbatches or (args.pp if args.pp > 1 else 1)
     b = args.batch or 2 * dp * args.ep * micro
     t = args.seq or 32 * args.sp
+    corpus = None
+    if args.data_file:
+        from akka_allreduce_tpu.data import load_corpus
+        corpus = load_corpus(args.data_file)
+        # size to the DATA, not the container format: a 1000-token .bin
+        # corpus must not inflate the model to the format's 65536 capacity
+        needed = corpus.max_token() + 1
+        if args.vocab < needed:
+            print(f"note: raising --vocab {args.vocab} -> {needed} to "
+                  f"cover the corpus (largest token id {needed - 1})")
+            args.vocab = needed
     moe = None
     if args.moe_experts:
         from akka_allreduce_tpu.parallel.ep import MoEConfig
@@ -347,9 +474,12 @@ def _cmd_train(args: argparse.Namespace) -> int:
             # deterministic per-step data stream: a resumed run sees the
             # same tokens the dead run would have
             step_rng = np.random.default_rng(i)
-            tokens = jnp.asarray(step_rng.integers(0, args.vocab,
-                                                   size=(b, t),
-                                                   dtype=np.int32))
+            if corpus is not None:
+                tokens = jnp.asarray(corpus.batch(i, b, t))
+            else:
+                tokens = jnp.asarray(step_rng.integers(0, args.vocab,
+                                                       size=(b, t),
+                                                       dtype=np.int32))
             if trainer is not None:
                 r = trainer.open_round()
                 # arrival simulation: each data rank lands on time or
@@ -426,11 +556,13 @@ def main(argv: list[str] | None = None) -> int:
     _add_master(sub)
     _add_worker(sub)
     _add_train(sub)
+    _add_generate(sub)
     sub.add_parser("info", help="topology summary")
     sub.add_parser("bench", help="device-plane goodput benchmark")
     args = parser.parse_args(argv)
     return {"emulate": _cmd_emulate, "master": _cmd_master,
             "worker": _cmd_worker, "train": _cmd_train,
+            "generate": _cmd_generate,
             "info": _cmd_info, "bench": _cmd_bench}[args.cmd](args)
 
 

@@ -1,0 +1,98 @@
+"""Deterministic, resumable input pipeline.
+
+The reference's only data source is a synthetic float generator wired into
+the worker bootstrap (reference: AllreduceWorker.scala:325-326); training a
+real model needs a real corpus. Design goals, in order:
+
+1. **Determinism by step index** — batch(i) is a pure function of (corpus,
+   batch, seq, seed, i). A resumed run (runtime/checkpoint.py tracks
+   ``data_step``) sees exactly the tokens the dead run would have, and
+   every host of a multi-host job draws the same global batch without any
+   coordination (the mesh's in_specs shard it; SURVEY.md §7's host-plane
+   duties stay trivial).
+2. **Zero-copy corpus residency** — the token file is memory-mapped;
+   batches gather windows at random offsets, so epochs are permutation-
+   free (sampling with replacement: the standard LM regime).
+3. **No tokenizer dependency** — byte-level corpora (vocab 256) work on
+   any file; pre-tokenized ``.bin`` corpora are raw little-endian uint16
+   (vocab up to 65536), the common export format of external tokenizers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenCorpus:
+    """A memory-mapped 1-D token stream."""
+
+    tokens: np.ndarray  # 1-D, any integer dtype
+    vocab_size: int
+    path: str = "<memory>"
+
+    def __post_init__(self):
+        if self.tokens.ndim != 1:
+            raise ValueError(f"corpus must be 1-D, got {self.tokens.shape}")
+        if len(self.tokens) < 2:
+            raise ValueError("corpus too small")
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def max_token(self) -> int:
+        """Largest token id actually present (one pass over the memmap,
+        cached): lets callers size the model to the DATA rather than the
+        container format's capacity."""
+        cached = getattr(self, "_max_token", None)
+        if cached is None:
+            cached = int(np.max(self.tokens))
+            object.__setattr__(self, "_max_token", cached)
+        return cached
+
+    def batch(self, step: int, batch: int, seq: int,
+              seed: int = 0) -> np.ndarray:
+        """(batch, seq) int32 windows for ``step`` — pure in (step, seed).
+
+        Windows start at uniform offsets; the LAST valid start leaves a
+        full ``seq`` tokens, so next-token targets (models/train.py shifts
+        by one inside the step) always exist.
+        """
+        if seq >= len(self.tokens):
+            raise ValueError(
+                f"seq {seq} does not fit corpus of {len(self.tokens)}")
+        rng = np.random.default_rng((seed, step))
+        starts = rng.integers(0, len(self.tokens) - seq,
+                              size=batch, dtype=np.int64)
+        idx = starts[:, None] + np.arange(seq, dtype=np.int64)[None, :]
+        return np.asarray(self.tokens[idx], dtype=np.int32)
+
+
+def load_corpus(path: str) -> TokenCorpus:
+    """Open a corpus file.
+
+    ``*.bin`` — raw little-endian uint16 tokens (external tokenizer
+    export), vocab 65536; anything else — raw bytes, vocab 256. Both are
+    memory-mapped read-only (the OS pages them in; nothing is copied)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if path.endswith(".bin"):
+        tokens = np.memmap(path, dtype="<u2", mode="r")
+        vocab = 65536
+    else:
+        tokens = np.memmap(path, dtype=np.uint8, mode="r")
+        vocab = 256
+    return TokenCorpus(tokens=tokens, vocab_size=vocab, path=path)
+
+
+def synthetic_corpus(vocab_size: int, length: int = 1 << 16,
+                     seed: int = 0) -> TokenCorpus:
+    """Uniform-random corpus — the reference's synthetic-source spirit
+    (reference: AllreduceWorker.scala:325-326) for demos and tests."""
+    rng = np.random.default_rng(seed)
+    return TokenCorpus(
+        tokens=rng.integers(0, vocab_size, size=length, dtype=np.int32),
+        vocab_size=vocab_size, path="<synthetic>")

@@ -12,9 +12,14 @@ the same static-shape program.
 
 Numerics are pinned by a parity test (tests/test_generate.py): for any
 prompt, incremental cached decode must reproduce the full-sequence forward
-logits exactly (same ops, same dtypes) — the cache is an optimization,
-never a different model. MoE layers route per decoded token exactly as in
-training (capacity follows the 1-token sequence).
+logits (same ops, same cast points) — the cache is an optimization, never
+a different model. One documented exception: MoE expert CAPACITY derives
+from the local token count (reference-free design choice), so a full
+forward over t tokens can drop overflow tokens from popular experts while
+single-token decode (capacity from b tokens) never does. Routing weights
+are identical; parity is exact whenever capacity does not bind (generous
+``capacity_factor``, which generation-time configs should use — dropping
+tokens at decode time would be strictly worse, not more faithful).
 """
 
 from __future__ import annotations
@@ -31,7 +36,10 @@ from akka_allreduce_tpu.models.transformer import (
     rmsnorm,
 )
 from akka_allreduce_tpu.parallel.ep import moe_ffn
-from akka_allreduce_tpu.parallel.ring_attention import NEG_INF
+from akka_allreduce_tpu.parallel.ring_attention import (
+    NEG_INF,
+    local_causal_attention,
+)
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int) -> dict:
@@ -106,14 +114,35 @@ def decode_step(params: dict, cache: dict, token: jnp.ndarray,
 
 def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
             cfg: TransformerConfig) -> tuple[dict, jnp.ndarray]:
-    """Feed the prompt (b, t) token by token via lax.scan; returns the
-    cache positioned after the prompt and the last step's logits."""
-    def one(c, tok):
-        c, logits = decode_step(params, c, tok, cfg)
-        return c, logits
+    """Fill the cache from the prompt (b, t) in ONE batched forward —
+    full-width matmuls on the MXU instead of t sequential single-token
+    steps — and return (cache after the prompt, last-position logits).
+    Same block math as decode_step/transformer_apply (parity-pinned)."""
+    b, t = prompt.shape
+    x = params["embed"][prompt] + params["pos"][:t][None]
+    k_cache, v_cache = cache["k"], cache["v"]
+    for i, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["ln1"])
+        q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k[None].astype(k_cache.dtype), (i, 0, 0, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v[None].astype(v_cache.dtype), (i, 0, 0, 0, 0))
+        attn = local_causal_attention(q, k, v)
+        x = x + attn.reshape(b, t, -1) @ layer["wo"]
 
-    cache, all_logits = lax.scan(one, cache, prompt.T)
-    return cache, all_logits[-1]
+        h = rmsnorm(x, layer["ln2"])
+        if "router" in layer:
+            y, _aux = moe_ffn(h, layer, cfg.moe, axis_name=None)
+            x = x + y
+        else:
+            x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    logits = rmsnorm(x[:, -1:], params["out_norm"]) @ params["lm_head"]
+    new_cache = {"k": k_cache, "v": v_cache,
+                 "pos": jnp.asarray(t, jnp.int32)}
+    return new_cache, logits[:, 0, :]
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "temperature"))

@@ -205,8 +205,12 @@ class TcpRouter:
             elif self._unreachable_after is not None:
                 # a slow-pinging (but alive) peer legitimately goes quiet
                 # for its whole interval: never down inside 2x its cadence
-                window = max(self._unreachable_after,
-                             2 * self._peer_interval.get(conn, 0.0))
+                # — but cap the widening at 5x the local window, so one
+                # misconfigured peer advertising a huge interval cannot
+                # opt itself out of failure detection entirely
+                widened = min(2 * self._peer_interval.get(conn, 0.0),
+                              5 * self._unreachable_after)
+                window = max(self._unreachable_after, widened)
                 if now - heard > window:
                     log.warning(
                         "downing unreachable peer %s:%s (silent %.1fs)",
