@@ -230,6 +230,16 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "omitted = synthetic random tokens. Batches are "
                         "deterministic in the step index, so checkpoint "
                         "resume replays the exact stream")
+    p.add_argument("--coordinator", default=None,
+                   help="multi-host: coordination-service address "
+                        "host:port (run the same command on every host "
+                        "with its own --process-id); the mesh then spans "
+                        "all hosts' devices and collectives ride ICI/DCN")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. cpu) before backend "
+                        "init — for tests and CPU-mesh rehearsals")
 
 
 def _add_generate(sub: argparse._SubParsersAction) -> None:
@@ -263,10 +273,18 @@ def _add_generate(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--raw", action="store_true",
                    help="print token ids instead of decoding bytes")
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. cpu) before backend "
+                        "init")
 
 
 def _cmd_generate(args: argparse.Namespace) -> int:
     import jax
+
+    if args.platform:
+        # before any backend init (site customization overrides the env
+        # var on some hosts — same reason train has the flag)
+        jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
     import numpy as np
 
@@ -318,14 +336,24 @@ def _cmd_generate(args: argparse.Namespace) -> int:
                              moe=moe, moe_every=args.moe_every)
     cfg = TrainConfig(model=mcfg)
     mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    # NOTE: this restores opt_state too (tripling restore I/O) — the
+    # installed orbax's StandardRestore has no per-leaf placeholder
+    # support for params-only partial restore (verified); acceptable at
+    # CLI scale.
     params, opt_state, _opt = make_train_state(jax.random.key(0), cfg, mesh)
-    step0, params, _, _, mgr = restore_or_init(
-        CheckpointConfig(args.ckpt_dir), params, opt_state)
+    try:
+        step0, params, _, _, mgr = restore_or_init(
+            CheckpointConfig(args.ckpt_dir), params, opt_state)
+    except Exception as e:
+        print(f"error: cannot restore {args.ckpt_dir} with the declared "
+              f"model shape (wrong --d-model/--vocab/--max-seq/...?): "
+              f"{e}", file=sys.stderr)
+        return 2
     if mgr is not None:
         mgr.close()  # restore-only use: release orbax's async machinery
     if step0 == 0:
-        print(f"error: no checkpoint found in {args.ckpt_dir} "
-              f"(or shapes mismatch)", file=sys.stderr)
+        print(f"error: no checkpoint found in {args.ckpt_dir}",
+              file=sys.stderr)
         return 2
     print(f"restored step {step0 - 1} from {args.ckpt_dir}",
           file=sys.stderr)
@@ -351,8 +379,25 @@ def _cmd_train(args: argparse.Namespace) -> int:
                                                  make_train_state,
                                                  make_train_step)
     from akka_allreduce_tpu.models.transformer import TransformerConfig
-    from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+    from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                  make_device_mesh,
+                                                  place_global_batch)
 
+    if args.platform:
+        # must land before any backend initializes (tests/conftest.py:
+        # the env var alone is overridden by site customization here)
+        jax.config.update("jax_platforms", args.platform)
+    if args.coordinator:
+        if args.deadline_ms:
+            print("error: --coordinator with --deadline-ms is not wired "
+                  "yet (the mask rows need global placement)",
+                  file=sys.stderr)
+            return 2
+        from akka_allreduce_tpu.runtime.coordinator import \
+            initialize_distributed
+        initialize_distributed(args.coordinator, args.num_processes,
+                               args.process_id)
+    chatty = jax.process_index() == 0
     n_dev = len(jax.devices())
     model_par = args.tp * args.sp * args.pp * args.ep
     dp = args.dp or max(1, n_dev // model_par)
@@ -410,7 +455,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
         corpus = load_corpus(args.data_file)
         # size to the DATA, not the container format: a 1000-token .bin
         # corpus must not inflate the model to the format's 65536 capacity
-        needed = corpus.max_token() + 1
+        # (scan only when the flag COULD be short of the format capacity —
+        # the scan reads the whole memmap once)
+        needed = (corpus.max_token() + 1
+                  if args.vocab < corpus.vocab_size else 0)
         if args.vocab < needed:
             print(f"note: raising --vocab {args.vocab} -> {needed} to "
                   f"cover the corpus (largest token id {needed - 1})")
@@ -460,13 +508,16 @@ def _cmd_train(args: argparse.Namespace) -> int:
             CheckpointConfig(args.ckpt_dir,
                              save_interval_steps=args.ckpt_every),
             params, opt_state)
-        if start:
+        if start and chatty:
             print(f"resumed from step {start - 1} "
                   f"(data position {extra.get('data_step', '?')})")
 
-    print(f"mesh dp={dp} tp={args.tp} sp={args.sp} pp={args.pp} "
-          f"ep={args.ep}; batch={b} seq={t} microbatches={micro}"
-          + (f" moe_experts={args.moe_experts}" if moe else ""))
+    if chatty:
+        print(f"mesh dp={dp} tp={args.tp} sp={args.sp} pp={args.pp} "
+              f"ep={args.ep}; batch={b} seq={t} microbatches={micro}"
+              + (f" moe_experts={args.moe_experts}" if moe else "")
+              + (f"; {jax.process_count()} processes" if
+                 jax.process_count() > 1 else ""))
     tic = time.perf_counter()
     steps_in_window = 0
     try:
@@ -475,11 +526,19 @@ def _cmd_train(args: argparse.Namespace) -> int:
             # same tokens the dead run would have
             step_rng = np.random.default_rng(i)
             if corpus is not None:
-                tokens = jnp.asarray(corpus.batch(i, b, t))
+                batch_np = corpus.batch(i, b, t)
             else:
-                tokens = jnp.asarray(step_rng.integers(0, args.vocab,
-                                                       size=(b, t),
-                                                       dtype=np.int32))
+                batch_np = step_rng.integers(0, args.vocab, size=(b, t),
+                                             dtype=np.int32)
+            if jax.process_count() > 1:
+                # every process computed the same global batch; build the
+                # global array from per-process addressable shards
+                from jax.sharding import PartitionSpec as P
+                batch_axes = ("dp", "ep") if args.ep > 1 else "dp"
+                tokens = place_global_batch(batch_np, mesh,
+                                            P(batch_axes, "sp"))
+            else:
+                tokens = jnp.asarray(batch_np)
             if trainer is not None:
                 r = trainer.open_round()
                 # arrival simulation: each data rank lands on time or
@@ -509,8 +568,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
                              f"{trainer.clock.num_peers} ranks{fb}, "
                              f"min_count "
                              f"{int(metrics['min_bucket_count'])}]")
-                print(f"step {i + 1:4d}: loss {loss:.4f} "
-                      f"({toks * steps_in_window / dt:.0f} tok/s){lossy}")
+                if chatty:
+                    print(f"step {i + 1:4d}: loss {loss:.4f} "
+                          f"({toks * steps_in_window / dt:.0f} "
+                          f"tok/s){lossy}")
                 tic = time.perf_counter()
                 steps_in_window = 0
         if trainer is not None:

@@ -61,11 +61,13 @@ class TokenCorpus:
         full ``seq`` tokens, so next-token targets (models/train.py shifts
         by one inside the step) always exist.
         """
-        if seq >= len(self.tokens):
+        if seq > len(self.tokens):
             raise ValueError(
                 f"seq {seq} does not fit corpus of {len(self.tokens)}")
         rng = np.random.default_rng((seed, step))
-        starts = rng.integers(0, len(self.tokens) - seq,
+        # high is EXCLUSIVE: len - seq is the last valid start (a window
+        # ending exactly at the corpus's final token)
+        starts = rng.integers(0, len(self.tokens) - seq + 1,
                               size=batch, dtype=np.int64)
         idx = starts[:, None] + np.arange(seq, dtype=np.int64)[None, :]
         return np.asarray(self.tokens[idx], dtype=np.int32)

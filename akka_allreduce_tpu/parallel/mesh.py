@@ -93,6 +93,18 @@ def local_axis_size(mesh: Mesh, axis_name: str) -> int:
     return mesh.shape[axis_name]
 
 
+def place_global_batch(array, mesh: Mesh, spec: PartitionSpec):
+    """Build a GLOBAL jax.Array for ``array`` (an identical host copy on
+    every process — the deterministic-batch contract of data.py makes this
+    free) sharded by ``spec`` over ``mesh``. Each process supplies only its
+    addressable shards, so this works unchanged from one process to a
+    multi-host mesh where no process could hold the whole array on device.
+    """
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        array.shape, sharding, lambda idx: array[idx])
+
+
 def place_tree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     """Place a pytree onto ``mesh`` with per-leaf PartitionSpecs. Values are
     preserved — only placement/sharding changes. The one canonical placement

@@ -30,7 +30,14 @@ class TestBatching:
     def test_seq_must_fit(self):
         c = synthetic_corpus(vocab_size=10, length=64)
         with pytest.raises(ValueError, match="fit"):
-            c.batch(0, 2, 64)
+            c.batch(0, 2, 65)
+        c.batch(0, 2, 64)  # seq == corpus length: exactly one window
+
+    def test_final_token_is_reachable(self):
+        c = TokenCorpus(tokens=np.arange(40, dtype=np.int32),
+                        vocab_size=40)
+        seen_last = any((c.batch(s, 16, 8) == 39).any() for s in range(64))
+        assert seen_last, "last corpus token never sampled"
 
 
 class TestFormats:

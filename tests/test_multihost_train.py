@@ -1,0 +1,46 @@
+"""Multi-host training through the CLI: two real processes, one global mesh.
+
+`train --coordinator` is the user-facing form of the multi-host device
+plane (runtime/coordinator.py + SURVEY.md §7 rows 1-2): each host runs the
+same command with its own --process-id, the mesh spans every host's
+devices, and each host feeds its addressable shards of the (identical,
+step-deterministic) global batch.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from akka_allreduce_tpu.protocol.remote import free_port
+
+
+@pytest.mark.slow
+@pytest.mark.xdist_group("cluster-procs")
+class TestTwoProcessTrain:
+    def test_cli_train_spans_two_processes(self):
+        port = free_port()
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "akka_allreduce_tpu.cli", "train",
+             "--platform", "cpu",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--steps", "4", "--dp", "4", "--batch", "8", "--seq", "16",
+             "--d-model", "32", "--n-heads", "4", "--n-layers", "2",
+             "--d-ff", "64"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for i in range(2)]
+        outs = []
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=150)
+            outs.append(out)
+            assert p.returncode == 0, f"proc {i}:\n{out}\n{err}"
+        # process 0 narrates; the mesh line proves the global geometry
+        assert "2 processes" in outs[0], outs[0]
+        assert "dp=4" in outs[0]
+        assert "loss" in outs[0]
+        # non-zero processes stay quiet (no duplicate narration)
+        assert "loss" not in outs[1], outs[1]
