@@ -49,6 +49,9 @@ def _add_emulate(sub: argparse._SubParsersAction) -> None:
                    help="assert output == N x input (needs thresholds 1.0)")
     p.add_argument("--kill-rank", type=int, default=None,
                    help="kill this rank after registration (fault demo)")
+    p.add_argument("--trace-file", default=None,
+                   help="write the structured protocol trace (JSONL: "
+                        "rounds, members, deaths) here on exit")
 
 
 def _cmd_emulate(args: argparse.Namespace) -> int:
@@ -77,13 +80,18 @@ def _cmd_emulate(args: argparse.Namespace) -> int:
                             assert_multiple=args.assert_multiple,
                             verbose=(rank == 0))
              for rank in range(args.workers)]
-    cluster = LocalCluster(
-        config,
-        source_factory=lambda r: constant_range_source(data_size),
-        sink_factory=lambda r: sinks[r])
-    t0 = time.perf_counter()
-    rounds = cluster.run(kill_rank=args.kill_rank)
-    dt = time.perf_counter() - t0
+    from akka_allreduce_tpu.runtime.tracing import tracer_to_file
+
+    with tracer_to_file(args.trace_file) as tracer:
+        cluster = LocalCluster(
+            config,
+            source_factory=lambda r: constant_range_source(data_size),
+            sink_factory=lambda r: sinks[r], tracer=tracer)
+        t0 = time.perf_counter()
+        rounds = cluster.run(kill_rank=args.kill_rank)
+        dt = time.perf_counter() - t0
+    if args.trace_file:
+        print(f"trace -> {args.trace_file}")
     print(f"completed {rounds}/{args.max_round} rounds in {dt:.2f}s "
           f"({args.workers} workers, dataSize={data_size}, "
           f"chunk={args.max_chunk_size}, maxLag={args.max_lag})")
@@ -110,6 +118,9 @@ def _add_master(sub: argparse._SubParsersAction) -> None:
 
 
 def _add_liveness_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--trace-file", default=None,
+                   help="write the structured protocol+liveness trace "
+                        "(JSONL) here on exit")
     p.add_argument("--heartbeat-interval", type=float, default=2.0,
                    help="seconds between transport Pings")
     p.add_argument("--unreachable-after", type=float, default=10.0,
@@ -135,7 +146,8 @@ def _cmd_master(args: argparse.Namespace) -> int:
     rounds = run_master(config, bind_host=args.bind_host, port=args.port,
                         timeout_s=args.timeout,
                         heartbeat_interval_s=args.heartbeat_interval,
-                        unreachable_after_s=args.unreachable_after or None)
+                        unreachable_after_s=args.unreachable_after or None,
+                        trace_file=args.trace_file)
     return 0 if rounds == args.max_round else 1
 
 
@@ -166,7 +178,8 @@ def _cmd_worker(args: argparse.Namespace) -> int:
                          assert_multiple=args.assert_multiple,
                          timeout_s=args.timeout, verbose=args.verbose,
                          heartbeat_interval_s=args.heartbeat_interval,
-                         unreachable_after_s=args.unreachable_after or None)
+                         unreachable_after_s=args.unreachable_after or None,
+                         trace_file=args.trace_file)
     return 0 if outputs > 0 else 1
 
 

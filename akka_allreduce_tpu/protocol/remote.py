@@ -24,6 +24,7 @@ from akka_allreduce_tpu.protocol.cluster import ThroughputSink, \
 from akka_allreduce_tpu.protocol.master import AllreduceMaster
 from akka_allreduce_tpu.protocol.tcp import TcpRouter
 from akka_allreduce_tpu.protocol.worker import AllreduceWorker
+from akka_allreduce_tpu.runtime.tracing import tracer_to_file
 
 log = logging.getLogger(__name__)
 
@@ -31,7 +32,8 @@ log = logging.getLogger(__name__)
 def run_master(config: AllreduceConfig, bind_host: str = "127.0.0.1",
                port: int = 2551, timeout_s: float = 120.0,
                verbose: bool = True, heartbeat_interval_s: float = 2.0,
-               unreachable_after_s: Optional[float] = 10.0) -> int:
+               unreachable_after_s: Optional[float] = 10.0,
+               trace_file: Optional[str] = None) -> int:
     """Serve membership + round pacing until ``config.data.max_round`` rounds
     complete (or timeout). Returns rounds completed.
 
@@ -40,11 +42,14 @@ def run_master(config: AllreduceConfig, bind_host: str = "127.0.0.1",
     removed from membership, and threshold semantics let the survivors'
     rounds keep completing."""
     completed: list[int] = []
-    with TcpRouter(bind_host=bind_host, port=port, role="master",
-                   heartbeat_interval_s=heartbeat_interval_s,
-                   unreachable_after_s=unreachable_after_s) as router:
+    with tracer_to_file(trace_file) as tracer, \
+         TcpRouter(bind_host=bind_host, port=port, role="master",
+                    heartbeat_interval_s=heartbeat_interval_s,
+                    unreachable_after_s=unreachable_after_s,
+                    tracer=tracer) as router:
         master = AllreduceMaster(router, config,
-                                 on_round_complete=completed.append)
+                                 on_round_complete=completed.append,
+                                 tracer=tracer)
         router.on_member = lambda ref, role: (
             master.member_up(ref, role) if role == "worker" else None)
 
@@ -65,6 +70,8 @@ def run_master(config: AllreduceConfig, bind_host: str = "127.0.0.1",
                 and time.monotonic() < deadline:
             router.poll(0.05)
         router.flush()
+    if trace_file and verbose:
+        print(f"master: trace -> {trace_file}")
     if verbose:
         print(f"master: {len(completed)}/{config.data.max_round} rounds")
     return len(completed)
@@ -75,17 +82,20 @@ def run_worker(master_host: str = "127.0.0.1", master_port: int = 2551,
                assert_multiple: int = 0, bind_host: str = "127.0.0.1",
                port: int = 0, timeout_s: float = 120.0,
                verbose: bool = False, heartbeat_interval_s: float = 2.0,
-               unreachable_after_s: Optional[float] = 10.0) -> int:
+               unreachable_after_s: Optional[float] = 10.0,
+               trace_file: Optional[str] = None) -> int:
     """Join the master, run the worker engine until the master disconnects
     (shutdown) or timeout. Returns outputs flushed to the sink."""
     sink = ThroughputSink(source_data_size, checkpoint=checkpoint,
                           assert_multiple=assert_multiple, verbose=verbose)
     alive = {"up": True}
-    with TcpRouter(bind_host=bind_host, port=port, role="worker",
-                   heartbeat_interval_s=heartbeat_interval_s,
-                   unreachable_after_s=unreachable_after_s) as router:
+    with tracer_to_file(trace_file) as tracer, \
+         TcpRouter(bind_host=bind_host, port=port, role="worker",
+                    heartbeat_interval_s=heartbeat_interval_s,
+                    unreachable_after_s=unreachable_after_s,
+                    tracer=tracer) as router:
         worker = AllreduceWorker(router, constant_range_source(
-            source_data_size), sink)
+            source_data_size), sink, tracer=tracer)
         # Join-retry: the master may not be listening yet (workers and
         # master start concurrently, like Akka seed-node join retries).
         join_deadline = time.monotonic() + timeout_s

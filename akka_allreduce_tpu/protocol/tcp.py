@@ -52,7 +52,8 @@ class TcpRouter:
                  on_terminated: Optional[Callable[[RemoteRef], None]] = None,
                  connect_timeout_s: float = 10.0,
                  heartbeat_interval_s: float = 2.0,
-                 unreachable_after_s: Optional[float] = 10.0):
+                 unreachable_after_s: Optional[float] = 10.0,
+                 tracer=None):
         self._lib = load_library()
         self._connect_timeout_ms = int(connect_timeout_s * 1000)
         self._t = self._lib.aat_create(bind_host.encode(), port)
@@ -86,6 +87,9 @@ class TcpRouter:
         self._unreachable_after = unreachable_after_s
         self._last_ping_sent = 0.0
         self._last_heard: dict[int, float] = {}
+        # optional runtime/tracing.Tracer: liveness events (peer downs,
+        # disconnects) join the same structured stream the engines write
+        self.tracer = tracer
         # each peer's advertised ping cadence (learned from its Pings): the
         # down check widens its window to 2x this for slow-pinging peers,
         # so asymmetric intervals can't produce false downs — the local
@@ -215,6 +219,11 @@ class TcpRouter:
                     log.warning(
                         "downing unreachable peer %s:%s (silent %.1fs)",
                         addr[0], addr[1], now - heard)
+                    if self.tracer is not None:
+                        self.tracer.record("peer_unreachable_down",
+                                           host=addr[0], port=addr[1],
+                                           silent_s=round(now - heard, 3),
+                                           window_s=round(window, 3))
                     self._down_conn(conn, addr)
                     continue
             self._lib.aat_send(self._t, conn, buf, len(ping))
@@ -303,6 +312,9 @@ class TcpRouter:
                 continue
             if self._conn_of.get(addr) == conn:
                 del self._conn_of[addr]
+            if self.tracer is not None:
+                self.tracer.record("peer_disconnect",
+                                   host=addr[0], port=addr[1])
             if self.on_terminated is not None and addr in self._refs:
                 self.on_terminated(self._refs[addr])
 

@@ -138,3 +138,20 @@ class Tracer:
     def read_jsonl(path: str) -> list[dict[str, Any]]:
         with open(path) as f:
             return [json.loads(line) for line in f if line.strip()]
+
+
+@contextmanager
+def tracer_to_file(path: Optional[str]):
+    """Yield a :class:`Tracer` (or ``None`` when ``path`` is falsy) and
+    write its JSONL on exit — INCLUDING exceptional exits (Ctrl-C, engine
+    errors), which is exactly when an operator needs the trace. The one
+    canonical setup for every --trace-file surface (cli.py,
+    protocol/remote.py)."""
+    if not path:
+        yield None
+        return
+    tracer = Tracer()
+    try:
+        yield tracer
+    finally:
+        tracer.write_jsonl(path)

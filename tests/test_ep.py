@@ -95,6 +95,7 @@ class TestTopKDispatch:
                                    np.asarray(probs.max(-1)), rtol=1e-5)
 
 
+@pytest.mark.slow
 class TestMoELocal:
     def test_shapes_and_finiteness(self):
         params = init_moe_layer(jax.random.key(0), D, CFG)

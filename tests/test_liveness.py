@@ -27,10 +27,13 @@ from akka_allreduce_tpu.protocol.tcp import TcpRouter
 
 class TestHeartbeatDetector:
     def test_silent_peer_is_downed(self):
+        from akka_allreduce_tpu.runtime.tracing import Tracer
+
         downed = []
+        tracer = Tracer()
         with TcpRouter(role="master", heartbeat_interval_s=0.05,
                        unreachable_after_s=0.4,
-                       on_terminated=downed.append) as a:
+                       on_terminated=downed.append, tracer=tracer) as a:
             with TcpRouter(role="worker", heartbeat_interval_s=0.05,
                            unreachable_after_s=0.4) as b:
                 b.register("w", handler=lambda m: None)
@@ -40,6 +43,11 @@ class TestHeartbeatDetector:
                     a.poll(0.05)
         assert len(downed) == 1
         assert downed[0].addr == b.addr
+        # the down joins the structured trace stream
+        downs = [e for e in tracer.events
+                 if e.kind == "peer_unreachable_down"]
+        assert len(downs) == 1
+        assert downs[0].fields["silent_s"] >= 0.4
 
     def test_polling_peer_stays_up(self):
         downed = []
