@@ -65,10 +65,12 @@ print(json.dumps({"platform": d.platform, "device_kind": d.device_kind}))
 
     results["headline"] = run("headline bench.py", """
 import subprocess, sys
+# explicit keys LAST so ambient shell exports cannot redirect a capture
+# labeled real-chip onto the CPU fallback or outlive the outer budget
 subprocess.run([sys.executable, "bench.py"],
-               env={"AATPU_BENCH_PLATFORMS": "default",
-                    "AATPU_BENCH_TIMEOUT_S": "420",
-                    **__import__("os").environ})
+               env={**__import__("os").environ,
+                    "AATPU_BENCH_PLATFORMS": "default",
+                    "AATPU_BENCH_TIMEOUT_S": "420"})
 """, 500)
 
     results["mfu"] = run("train MFU", """
@@ -76,12 +78,17 @@ import json
 from akka_allreduce_tpu.bench import measure_train_mfu
 for dtype in ("bf16", "f32"):
     r = measure_train_mfu(compute_dtype=dtype)
-    print(json.dumps({"metric": f"mfu_train_{dtype}", **r}))
+    # flush: a later hung step's SIGKILL must not eat this row from the
+    # pipe's block buffer
+    print(json.dumps({"metric": f"mfu_train_{dtype}", **r}), flush=True)
 """, 1800)
 
     results["suite"] = run("bench_suite", """
-import subprocess, sys
-subprocess.run([sys.executable, "scripts/bench_suite.py"])
+import os, subprocess, sys
+# -u: line-buffer the child so budget kills keep completed rows;
+# skip the suite's own MFU pass — the dedicated step above measured it
+env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1"}
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env)
 """, 1500)
 
     with open(os.path.join(ROOT, "perf_tpu.json"), "w") as f:
