@@ -449,12 +449,6 @@ def _cmd_train(args: argparse.Namespace) -> int:
                   f"the data-axis size {axis_size}, got "
                   f"{args.bucket_elems}", file=sys.stderr)
             return 2
-        if args.deadline_ms:
-            print("error: --int8-grads cannot combine with --deadline-ms: "
-                  "masked (lossy) rounds always run the f32 counted path, "
-                  "and a dynamic mask makes every round masked",
-                  file=sys.stderr)
-            return 2
     if args.straggle_prob and not args.deadline_ms:
         print("error: --straggle-prob needs --deadline-ms",
               file=sys.stderr)

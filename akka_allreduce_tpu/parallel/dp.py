@@ -22,7 +22,6 @@ scatter/reduce/broadcast protocol of the reference collapses into them
 from __future__ import annotations
 
 import dataclasses
-import warnings
 from typing import Any, Optional
 
 import jax
@@ -57,11 +56,13 @@ class GradSyncConfig:
     # (an extra HBM pass); callers that only need the per-bucket counts
     # (training loops, benchmarks) turn it off and read bucket_counts.
     return_elem_counts: bool = True
-    # Wire format of the exact collective: "f32" (stock psum) or "int8"
+    # Wire format of the collective: "f32" (stock psum) or "int8"
     # (quantized two-phase allreduce, ops/collectives.py — 4x less ICI/DCN
     # traffic, one stochastic-rounding error per hop). int8 requires a
-    # single data axis and bucket_elems divisible by its size; the lossy
-    # masked path always runs f32 (counts ride the same psum).
+    # single data axis and bucket_elems divisible by its size. Lossy
+    # (masked) rounds keep the int8 wire: masked contributions quantize to
+    # exact zeros and the per-bucket counts ride a separate exact int32
+    # psum.
     transport: str = "f32"
 
 
@@ -71,10 +72,8 @@ class GradSyncResult:
     (as a pytree congruent with the gradients; None when the config opted
     out), and the raw per-bucket counts for observability.
 
-    ``transport`` is the wire format that actually ran: lossy (masked)
-    rounds always run the f32 counted path even under
-    ``config.transport='int8'``, and this field makes that fallback
-    observable instead of silent."""
+    ``transport`` is the wire format that ran (both exact and lossy
+    rounds honor ``config.transport``)."""
 
     grads: Any
     counts: Any
@@ -98,16 +97,21 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     being unbiased across rounds).
     """
     buckets, spec = bucketize(grads, config.bucket_elems)
-    effective_transport = config.transport
-    if valid is not None and config.transport == "int8":
-        # the masked path has no int8 wire format (counts ride the same
-        # f32 psum); warn at trace time so a user who enabled int8 to cut
-        # wire traffic learns their lossy rounds run full width
-        effective_transport = "f32"
-        warnings.warn(
-            "transport='int8' with a valid mask falls back to the f32 "
-            "counted path for this round; GradSyncResult.transport "
-            "records what ran", stacklevel=2)
+    if config.transport == "int8":
+        # shared int8 preconditions (exact and masked paths)
+        int8_axes = [a for a in _axis_tuple(config.axis_name)
+                     if lax.axis_size(a) > 1]
+        if len(int8_axes) > 1:
+            raise ValueError(
+                f"int8 transport needs a single (>1) data axis, "
+                f"got {int8_axes}")
+        if quant_key is None:
+            raise ValueError(
+                "int8 transport needs quant_key, varied per round — a "
+                "fixed key makes the stochastic-rounding error systematic "
+                "instead of zero-mean across rounds")
+    elif config.transport != "f32":
+        raise ValueError(f"unknown transport {config.transport!r}")
     if valid is None:
         # Exact path (thresholds = 1.0): every rank contributes every
         # bucket, so the masking multiply and the count psum are pure
@@ -116,23 +120,11 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         # degenerate case: the entire protocol is one sum).
         if config.transport == "int8":
             # size-1 axes reduce to identity and don't need a wire format
-            axes = [a for a in _axis_tuple(config.axis_name)
-                    if lax.axis_size(a) > 1]
-            if len(axes) > 1:
-                raise ValueError(
-                    f"int8 transport needs a single (>1) data axis, "
-                    f"got {axes}")
-            if quant_key is None:
-                raise ValueError(
-                    "int8 transport needs quant_key, varied per round — "
-                    "a fixed key makes the stochastic-rounding error "
-                    "systematic instead of zero-mean across rounds")
-            summed = buckets if not axes else quantized_two_phase_allreduce(
-                buckets, quant_key, axes[0])
-        elif config.transport == "f32":
-            summed = psum_all(buckets, config.axis_name)
+            summed = buckets if not int8_axes else \
+                quantized_two_phase_allreduce(buckets, quant_key,
+                                              int8_axes[0])
         else:
-            raise ValueError(f"unknown transport {config.transport!r}")
+            summed = psum_all(buckets, config.axis_name)
         group = 1
         for a in _axis_tuple(config.axis_name):
             group *= lax.axis_size(a)
@@ -140,8 +132,23 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         if config.average:
             summed = summed * (config.rescale_target / group)
     else:
-        summed, bucket_counts = masked_allreduce(buckets, valid,
-                                                 config.axis_name)
+        if config.transport == "int8":
+            # Lossy rounds keep the int8 wire: a masked rank's zeroed
+            # contribution quantizes to exact zeros (scale of an all-zero
+            # row is the epsilon floor, values round to 0), so masking
+            # commutes with quantization; the per-bucket counts ride a
+            # separate exact int32 psum — tiny next to the payload, and
+            # the honesty contract (reference: ReduceBlock.count,
+            # AllreduceMessage.scala:20) tolerates no rounding.
+            contrib = buckets * valid.astype(buckets.dtype)[:, None]
+            summed = contrib if not int8_axes else \
+                quantized_two_phase_allreduce(contrib, quant_key,
+                                              int8_axes[0])
+            bucket_counts = psum_all(valid.astype(jnp.int32),
+                                     config.axis_name)
+        else:
+            summed, bucket_counts = masked_allreduce(buckets, valid,
+                                                     config.axis_name)
         if config.average:
             # per-BUCKET rescale while still in bucket shape: the tiny
             # (num_buckets, 1) factor broadcasts into the same HBM pass,
@@ -164,4 +171,4 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         counts_tree = vector_to_tree(per_elem, counts_spec)
     return GradSyncResult(grads=out_tree, counts=counts_tree,
                           bucket_counts=bucket_counts, spec=spec,
-                          transport=effective_transport)
+                          transport=config.transport)

@@ -113,7 +113,6 @@ def mfu_lines():
     to keep the path exercised — no MFU claim without a known peak).
     AATPU_SUITE_SKIP_MFU=1 skips it (capture_tpu_numbers.py measures MFU
     in its own budgeted step)."""
-    import os
     if os.environ.get("AATPU_SUITE_SKIP_MFU"):
         return
     import jax

@@ -153,6 +153,58 @@ class TestInt8GradSync:
         assert err < 0.1 * scale
         assert err > 0  # it actually quantized
 
+    def test_masked_int8_close_to_masked_f32_with_exact_counts(self):
+        """Lossy rounds keep the int8 wire: values within quantization
+        error of the f32 masked path, counts EXACT (they ride a separate
+        int32 psum — the ReduceBlock.count honesty contract)."""
+        mesh = single_axis_mesh("dp")
+        cfg8 = GradSyncConfig(bucket_elems=128, transport="int8",
+                              return_elem_counts=False)
+        cfg32 = GradSyncConfig(bucket_elems=128,
+                               return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp"), P("dp")), check_vma=False)
+        def f(xs):
+            g = {"w": xs[0]}
+            # rank r contributes bucket b unless (r + b) % 4 == 0:
+            # counts land strictly between 1 and N per bucket
+            r = jax.lax.axis_index("dp")
+            valid = (r + jnp.arange(4)) % 4 != 0
+            r8 = allreduce_gradients(g, cfg8, valid=valid,
+                                     quant_key=jax.random.key(9))
+            r32 = allreduce_gradients(g, cfg32, valid=valid)
+            return (r8.grads["w"][None], r32.grads["w"][None],
+                    r8.bucket_counts[None])
+
+        stacked = jnp.asarray(np.random.default_rng(6).normal(
+            size=(N, 4, 128)).astype(np.float32))
+        g8, g32, counts = f(stacked.reshape(N, 512))
+        np.testing.assert_array_equal(np.asarray(counts[0]),
+                                      [6, 6, 6, 6])  # N=8, 2 masked each
+        err = np.abs(np.asarray(g8[0]) - np.asarray(g32[0])).max()
+        scale = np.abs(np.asarray(g32[0])).max()
+        assert 0 < err < 0.1 * scale, (err, scale)
+
+    def test_masked_int8_zero_count_bucket_is_zero(self):
+        """A bucket nobody contributes must come back exactly zero under
+        int8 too (count-0 rescale gates it)."""
+        mesh = single_axis_mesh("dp")
+        cfg8 = GradSyncConfig(bucket_elems=128, transport="int8",
+                              return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")), check_vma=False)
+        def f(xs):
+            valid = jnp.array([0, 1, 1, 1], jnp.int32)  # bucket 0: nobody
+            res = allreduce_gradients({"w": xs[0]}, cfg8, valid=valid,
+                                      quant_key=jax.random.key(3))
+            return res.grads["w"][None], res.bucket_counts[None]
+
+        g, counts = f(jnp.ones((N, 512), jnp.float32))
+        np.testing.assert_array_equal(np.asarray(counts[0]), [0, 8, 8, 8])
+        np.testing.assert_array_equal(np.asarray(g[0])[:128], 0.0)
+
     def test_multi_axis_transport_rejected(self):
         mesh = make_device_mesh(MeshSpec(dp=4, sp=2))
         cfg = GradSyncConfig(bucket_elems=64, axis_name=("dp", "sp"),
