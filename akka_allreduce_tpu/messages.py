@@ -37,6 +37,11 @@ class InitWorkers:
     max_lag: int
     data_size: int
     max_chunk_size: int
+    # First round this worker participates in: 0 at cluster formation
+    # (the reference's only case); the CURRENT round for a mid-run
+    # rejoiner, so it does not replay the entire history through the
+    # catch-up path (beyond-reference rejoin, protocol/master.py).
+    start_round: int = 0
 
 
 @dataclasses.dataclass

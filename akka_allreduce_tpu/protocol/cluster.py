@@ -83,6 +83,7 @@ class LocalCluster:
         self.config = config
         self.router = Router()
         self.tracer = tracer
+        self.strict = strict
         self.completed_rounds: list[int] = []
         self.master = AllreduceMaster(
             self.router, config,
@@ -135,9 +136,43 @@ class LocalCluster:
     def kill_worker(self, rank: int) -> None:
         """Simulate a worker death: deathwatch fires on master and peers
         (reference: AllreduceMaster.scala:46-52;
-        AllreduceWorker.scala:141-146)."""
-        ref = self.workers[rank].ref
+        AllreduceWorker.scala:141-146). ``rank`` is the SEAT (the master's
+        view) — after rejoins, list position no longer equals seat."""
+        ref = self.master.workers.get(rank)
+        if ref is None:
+            raise KeyError(f"no live worker in seat {rank}")
         self.router.unregister(ref)
         self.master.terminated(ref)
         for w in self.workers:
             w.terminated(ref)
+
+    def run_until(self, rounds: int, bite: int = 200) -> int:
+        """Incremental driver: pump in small bites until ``rounds`` rounds
+        have completed or traffic drains. For tests that interleave
+        kill/rejoin with progress (run() pumps everything at once — a
+        round is only ~100 messages at smoke scale, so the bite must stay
+        small or one call drains the whole workload)."""
+        while len(self.completed_rounds) < rounds:
+            if self.router.pump(max_messages=bite, strict=False) == 0:
+                break
+        return len(self.completed_rounds)
+
+    def add_worker(self, source: Optional[DataSource] = None,
+                   sink: Optional[DataSink] = None) -> AllreduceWorker:
+        """A fresh worker process joins the running cluster (the rejoin
+        flow: the master hands it the lowest free seat and re-inits the
+        membership — see AllreduceMaster.member_up)."""
+        size = self.config.data.data_size
+        w = AllreduceWorker(
+            self.router, source or constant_range_source(size),
+            sink or (lambda out: None),
+            name=f"worker-joiner-{len(self.workers)}",
+            strict=self.strict, tracer=self.tracer)
+        self.master.member_up(w.ref)
+        if w.ref not in self.master.workers.values():
+            # all seats live: the master ignored the joiner — don't keep
+            # an uninitialized zombie engine on the router
+            self.router.unregister(w.ref)
+            return w
+        self.workers.append(w)
+        return w

@@ -53,33 +53,77 @@ class AllreduceMaster:
     # -- membership (reference: AllreduceMaster.scala:36-44, :66-74) --------
 
     def member_up(self, worker_ref: ActorRef, role: str = "worker") -> None:
-        """A cluster member came up. Rank = arrival order. On quorum, init
-        all workers and start round 0. (The reference resolves the remote
-        actor and deathwatches it; here the ref is handed in directly and
-        the owner calls :meth:`terminated` on failure.)"""
+        """A cluster member came up.
+
+        While FORMING (round == -1): rank = arrival order; on quorum, init
+        all workers and start round 0 (reference:
+        AllreduceMaster.scala:36-44). While RUNNING: the joiner takes over
+        the lowest FREE seat — block ownership is positional (rank i owns
+        block i, reference: AllreduceWorker.scala:240-250), so a dead
+        rank's seat must be REUSED, not grown past ``total_workers``; the
+        reference's ``workers.size`` counter collides with live ranks
+        after a lower-ranked death (documented quirk,
+        AllreduceMaster.scala:71) — this is the fixed rejoin it gestured
+        at. Every worker is re-inited (peer-map refresh, reference:
+        AllreduceWorker.scala:87-89) and the joiner is started at the
+        current round; its cold-start catch-up force-completes the stale
+        window (reference: AllreduceSpec.scala:632-656).
+
+        (The reference resolves the remote actor and deathwatches it; here
+        the ref is handed in directly and the owner calls
+        :meth:`terminated` on failure.)"""
         if role != "worker":
             return
-        # Next unused rank. The reference uses workers.size, which collides
-        # with a live worker's rank after a lower-ranked death
-        # (documented quirk, AllreduceMaster.scala:71).
-        new_id = max(self.workers, default=-1) + 1
-        self.workers[new_id] = worker_ref
-        log.info("master: worker %d up (%s), %d/%d", new_id, worker_ref,
-                 len(self.workers), self.total_workers)
-        if self.tracer is not None:
-            self.tracer.record("member_up", rank=new_id,
-                               members=len(self.workers))
-        if len(self.workers) >= self.total_workers and self.round == -1:
+        free = [r for r in range(self.total_workers)
+                if r not in self.workers]
+        if self.round == -1:
+            # forming: arrival order = rank; with a pre-quorum death the
+            # lowest free seat IS arrival order continued (max+1 would
+            # push a later arrival past total_workers-1 and break the
+            # positional block layout at quorum init)
+            if not free:
+                log.warning("master: joiner %s ignored — all %d seats "
+                            "live", worker_ref, self.total_workers)
+                return
+            new_id = free[0]
+            self.workers[new_id] = worker_ref
+            log.info("master: worker %d up (%s), %d/%d", new_id, worker_ref,
+                     len(self.workers), self.total_workers)
             if self.tracer is not None:
-                self.tracer.record("quorum_init", members=len(self.workers))
-            self._init_workers()
-            self.round = 0
-            self._start_allreduce()
+                self.tracer.record("member_up", rank=new_id,
+                                   members=len(self.workers))
+            if len(self.workers) >= self.total_workers:
+                if self.tracer is not None:
+                    self.tracer.record("quorum_init",
+                                       members=len(self.workers))
+                self._init_workers()
+                self.round = 0
+                self._start_allreduce()
+            return
+        if not free:
+            log.warning("master: joiner %s ignored — all %d seats live",
+                        worker_ref, self.total_workers)
+            return
+        new_id = free[0]
+        self.workers[new_id] = worker_ref
+        log.info("master: worker rejoined as rank %d at round %d", new_id,
+                 self.round)
+        if self.tracer is not None:
+            self.tracer.record("member_rejoin", rank=new_id,
+                               round=self.round,
+                               members=len(self.workers))
+        # full init for the joiner STARTING AT THE CURRENT ROUND (a fresh
+        # worker would otherwise replay the whole history through
+        # catch-up — O(rounds x peers x chunks) messages); peer-map
+        # refresh for everyone else
+        self._init_workers(start_round=self.round)
+        self.router.send(worker_ref, StartAllreduce(self.round))
 
     def terminated(self, ref: ActorRef) -> None:
         """Deathwatch removal (reference: AllreduceMaster.scala:46-52).
-        Ranks of dead workers are never reused; :meth:`member_up` assigns
-        the next rank above the highest live one."""
+        The freed seat is handed to the next joiner by :meth:`member_up`
+        — block ownership is positional, so seats are REUSED (unlike the
+        reference, whose rank counter collides after a mid-rank death)."""
         for idx, worker in list(self.workers.items()):
             if worker is ref:
                 del self.workers[idx]
@@ -112,7 +156,7 @@ class AllreduceMaster:
 
     # -- worker init + kick-off (reference: AllreduceMaster.scala:76-89) ----
 
-    def _init_workers(self) -> None:
+    def _init_workers(self, start_round: int = 0) -> None:
         for idx, worker in self.workers.items():
             self.router.send(worker, InitWorkers(
                 workers=dict(self.workers),
@@ -124,6 +168,7 @@ class AllreduceMaster:
                 max_lag=self.config.workers.max_lag,
                 data_size=self.config.data.data_size,
                 max_chunk_size=self.config.data.max_chunk_size,
+                start_round=start_round,
             ))
 
     def _start_allreduce(self) -> None:

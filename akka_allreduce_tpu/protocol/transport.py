@@ -74,13 +74,15 @@ class Router:
     def mailbox(self, ref: ActorRef) -> deque:
         return self._mailboxes[ref]
 
-    def pump(self, max_messages: int = 1_000_000) -> int:
+    def pump(self, max_messages: int = 1_000_000,
+             strict: bool = True) -> int:
         """Drain all handler-owned mailboxes deterministically: one message
         per actor per sweep, in registration order (a fair, reproducible
         stand-in for Akka's concurrent-but-FIFO dispatch). Self-sends land at
         the back of the sender's own mailbox, exactly like an actor
-        re-enqueueing to itself. Returns messages processed; raises if the
-        cap is hit (e.g. an uninitialized worker re-queueing forever)."""
+        re-enqueueing to itself. Returns messages processed. Hitting the
+        cap raises when ``strict`` (a re-queue loop — uninitialized worker?)
+        and simply returns otherwise (incremental drivers pump in bites)."""
         processed = 0
         while True:
             progressed = False
@@ -95,9 +97,12 @@ class Router:
                     processed += 1
                     progressed = True
                     if processed >= max_messages:
-                        raise RuntimeError(
-                            f"router pump exceeded {max_messages} messages — "
-                            "likely a re-queue loop (uninitialized worker?)")
+                        if strict:
+                            raise RuntimeError(
+                                f"router pump exceeded {max_messages} "
+                                "messages — likely a re-queue loop "
+                                "(uninitialized worker?)")
+                        return processed
             if not progressed:
                 return processed
 

@@ -93,9 +93,10 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
         return (struct.pack("<B", MSG_HELLO) + _pack_addr(msg.addr)
                 + struct.pack("<B", len(role)) + role)
     if isinstance(msg, InitWorkers):
-        out = [struct.pack("<BiIddIQQ", MSG_INIT, msg.dest_id,
+        out = [struct.pack("<BiIddIQQq", MSG_INIT, msg.dest_id,
                            msg.worker_num, msg.th_reduce, msg.th_complete,
-                           msg.max_lag, msg.data_size, msg.max_chunk_size)]
+                           msg.max_lag, msg.data_size, msg.max_chunk_size,
+                           msg.start_round)]
         if msg.master is None:
             out.append(struct.pack("<B", 0))
         else:
@@ -137,8 +138,9 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
         return Hello(addr, role)
     if mtype == MSG_INIT:
         (dest_id, worker_num, th_reduce, th_complete, max_lag, data_size,
-         max_chunk_size) = struct.unpack_from("<iIddIQQ", buf, off)
-        off += struct.calcsize("<iIddIQQ")
+         max_chunk_size, start_round) = struct.unpack_from("<iIddIQQq",
+                                                           buf, off)
+        off += struct.calcsize("<iIddIQQq")
         (has_master,) = struct.unpack_from("<B", buf, off)
         off += 1
         master: Optional[object] = None
@@ -157,7 +159,8 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
                            master=master, dest_id=dest_id,
                            th_reduce=th_reduce, th_complete=th_complete,
                            max_lag=max_lag, data_size=data_size,
-                           max_chunk_size=max_chunk_size)
+                           max_chunk_size=max_chunk_size,
+                           start_round=start_round)
     if mtype == MSG_START:
         (round_,) = struct.unpack_from("<q", buf, off)
         return StartAllreduce(round_)

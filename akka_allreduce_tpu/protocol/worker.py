@@ -142,9 +142,9 @@ class AllreduceWorker:
         self.th_reduce = init.th_reduce
         self.th_complete = init.th_complete
         self.max_lag = init.max_lag
-        self.round = 0
-        self.max_round = -1
-        self.max_scattered = -1
+        self.round = init.start_round
+        self.max_round = init.start_round - 1
+        self.max_scattered = init.start_round - 1
         self.completed = set()
 
         self.data_size = init.data_size

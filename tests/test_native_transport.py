@@ -64,13 +64,14 @@ class TestWireCodec:
             workers=workers, worker_num=2,
             master=RemoteRef(("10.0.0.9", 2550)), dest_id=1,
             th_reduce=0.9, th_complete=0.8, max_lag=3, data_size=778,
-            max_chunk_size=3))
+            max_chunk_size=3, start_round=41))
         assert m.dest_id == 1 and m.worker_num == 2
         assert m.master.addr == ("10.0.0.9", 2550)
         assert {r: ref.addr for r, ref in m.workers.items()} == {
             0: ("10.0.0.1", 2551), 1: ("10.0.0.2", 2552)}
         assert (m.th_reduce, m.th_complete) == (0.9, 0.8)
         assert (m.max_lag, m.data_size, m.max_chunk_size) == (3, 778, 3)
+        assert m.start_round == 41  # the mid-run rejoin init point
 
     def test_hello(self):
         h = self._roundtrip(wire.Hello(("127.0.0.1", 1234), "worker"))
