@@ -148,7 +148,7 @@ def measure_device_goodput(elems: int, bucket_elems: int,
 def measure_train_mfu(compute_dtype: str = "bf16",
                       d_model: int = 2048, n_layers: int = 8,
                       d_ff: int = 8192, vocab: int = 32768,
-                      batch: int = 4, seq: int = 2048,
+                      batch: int = 8, seq: int = 2048,
                       steps_hi: int = 12, steps_lo: int = 4
                       ) -> dict:
     """Single-chip train-step MFU on the flagship transformer.

@@ -52,6 +52,11 @@ from akka_allreduce_tpu.parallel.pp import (
     scan_blocks,
     stack_layer_params,
 )
+from akka_allreduce_tpu.ops.pallas_kernels.attention import (
+    flash_causal_attention,
+    pick_flash_block,
+)
+from akka_allreduce_tpu.ops.pallas_kernels.dispatch import use_pallas
 from akka_allreduce_tpu.parallel.ring_attention import (
     blockwise_causal_attention,
     local_causal_attention,
@@ -86,6 +91,12 @@ class TrainConfig:
     # materialising the (T, T) score tensor — the rank-local long-context
     # path (must divide the local sequence length)
     attn_block_size: Optional[int] = None
+    # Single-rank attention implementation: "auto" consults the measured
+    # per-chip dispatch table (ops/pallas_kernels/dispatch.py) and runs the
+    # fused Pallas flash kernel on TPU; "flash" / "blockwise" / "local"
+    # force one. Ignored under sequence parallelism (sp > 1 always rides
+    # ring attention). attn_block_size doubles as the flash block size.
+    attn_impl: str = "auto"
 
 
 def _uniform_layer_spec(cfg: TransformerConfig) -> tuple[dict, dict, dict]:
@@ -213,6 +224,51 @@ def place_opt_state(opt: optax.GradientTransformation, opt_state: Any,
         transform_non_params=lambda x: jax.device_put(x, replicated))
 
 
+def select_local_attention(cfg: TrainConfig):
+    """Rank-local attention per ``cfg.attn_impl`` (see TrainConfig).
+
+    Trace-time decision like every kernel dispatch
+    (ops/pallas_kernels/dispatch.py): on TPU "auto" runs the fused Pallas
+    flash kernel; elsewhere (the CPU test mesh) the pure-JAX blockwise /
+    local paths, with "flash" forcing the kernel in interpreter mode so
+    the CPU suite can still pin it end to end."""
+    impl = cfg.attn_impl
+    if impl not in ("auto", "flash", "blockwise", "local"):
+        raise ValueError(f"unknown attn_impl {impl!r}")
+    auto = impl == "auto"
+    if auto:
+        impl = "flash" if use_pallas("flash_attention") else (
+            "blockwise" if cfg.attn_block_size else "local")
+    if impl == "flash":
+        interpret = jax.default_backend() != "tpu"
+        want = cfg.attn_block_size or 512  # 512 = the measured A/B block
+
+        def flash_or_fallback(q, k, v):
+            # block choice needs T, known only at trace time; "auto" falls
+            # back to the pure-JAX paths for untileable lengths instead of
+            # failing lengths that worked before the kernel existed
+            blk = pick_flash_block(q.shape[1], want)
+            if blk is not None:
+                return flash_causal_attention(q, k, v, block_q=blk,
+                                              block_k=blk,
+                                              interpret=interpret)
+            if not auto:
+                raise ValueError(
+                    f"attn_impl='flash': no legal flash block for "
+                    f"sequence {q.shape[1]} (want <= {want})")
+            if cfg.attn_block_size and \
+                    q.shape[1] % cfg.attn_block_size == 0:
+                return blockwise_causal_attention(
+                    q, k, v, block_size=cfg.attn_block_size)
+            return local_causal_attention(q, k, v)
+
+        return flash_or_fallback
+    if impl == "blockwise":
+        return partial(blockwise_causal_attention,
+                       block_size=cfg.attn_block_size or 512)
+    return local_causal_attention
+
+
 def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                    valid_buckets: Optional[jnp.ndarray] = None,
                    dynamic_valid: bool = False):
@@ -286,11 +342,8 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
     if has_sp:
         attn = partial(ring_attention, axis_name="sp", causal=True)
-    elif cfg.attn_block_size:
-        attn = partial(blockwise_causal_attention,
-                       block_size=cfg.attn_block_size)
     else:
-        attn = local_causal_attention
+        attn = select_local_attention(cfg)
 
     # metrics reduce over every axis the quantity varies over; under pp the
     # loss/aux pieces are spread across stages too. dispatch_fraction is a

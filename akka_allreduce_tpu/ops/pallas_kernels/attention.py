@@ -1,0 +1,357 @@
+"""Flash attention: fused causal attention as Pallas TPU kernels.
+
+The framework's rank-local attention paths (parallel/ring_attention.py)
+implement online-softmax blocking in pure JAX — XLA fuses well, but the
+(blk_q, blk_k) score tile still round-trips HBM between the two einsums of
+every scan step. This kernel is the TPU-first answer: one fused VMEM pass
+per (batch, head, q-block) computes scores, causal mask, online softmax and
+the value contraction without the score matrix ever leaving VMEM, and the
+backward pass recomputes probabilities flash-style from the saved
+log-sum-exp instead of storing them — O(T) attention memory end to end.
+
+Structurally this is the device-kernel descendant of the reference's only
+FLOP kernel, the staged peer-sum loop (reference:
+ScatteredDataBuffer.scala:20-32): stage blocks, accumulate a running
+reduction, emit once per owner block — with the peer axis replaced by the
+key-block axis and the sum by an online softmax.
+
+Layout: the public API takes (B, T, H, D) exactly as the model produces
+it; the kernels run in (B, H, T, D) so every VMEM block is a legal
+(sequence-block, head-dim) tile (see _to_kernel_layout). Softmax
+statistics and accumulators are f32 (the flash rule: low-precision MXU
+matmuls, full-precision running stats); log-sum-exp is saved as (B, H, T, 1)
+f32 for the backward pass.
+
+Grid iteration relies on TPU Pallas executing the grid sequentially with
+the LAST dimension minormost: the key-block axis is innermost, so VMEM
+scratch carries (m, l, acc) across the key loop of one query block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _causal_mask(iq, ik, blk_q, blk_k):
+    """(blk_q, blk_k) bool: query position >= key position."""
+    q_pos = iq * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    k_pos = ik * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return q_pos >= k_pos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, blk_q, blk_k, causal):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal skip: key block entirely in the queries' future — every score
+    # masked, nothing to accumulate (same early-out as the ring/blockwise
+    # paths; ~half the inner iterations vanish).
+    live = True if not causal else ik * blk_k <= iq * blk_q + blk_q - 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0, :, :]  # (blk_q, D)
+        k = k_ref[0, 0, :, :]  # (blk_k, D)
+        v = v_ref[0, 0, :, :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, blk_q, blk_k), s, NEG_INF)
+        m_prev = m_scr[:, 0:1]  # (blk_q, 1)
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)  # m_prev <= m_new: no overflow
+        p = jnp.exp(s - m_new)  # masked lanes: exp(NEG_INF - m) == 0
+        l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        m = m_scr[:, 0:1]
+        l = l_scr[:, 0:1]
+        # causal rows always include the query's own position => l > 0;
+        # non-causal attends everything => l > 0 as well
+        o_ref[0, 0, :, :] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, blk_q, blk_k, causal):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = True if not causal else ik * blk_k <= iq * blk_q + blk_q - 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]  # (blk_q, 1)
+        delta = delta_ref[0, 0, :, :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, blk_q, blk_k), s, NEG_INF)
+        p = jnp.exp(s - lse)  # (blk_q, blk_k); masked lanes exactly 0
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, blk_q, blk_k, causal):
+    # Note the swapped grid: (B, H, key-block, query-block) — the query
+    # axis is innermost so scratch carries dk/dv across it.
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # Skip query blocks entirely BEFORE this key block (they never attend
+    # to it under causality).
+    live = True if not causal else iq * blk_q + blk_q - 1 >= ik * blk_k
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, blk_q, blk_k), s, NEG_INF)
+        p = jnp.exp(s - lse)
+        # dv += p^T @ do        (blk_k, D)
+        dv_scr[:] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        # dk += ds^T @ q        (blk_k, D)
+        dk_scr[:] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _block_sizes(t: int, block_q: int, block_k: int) -> tuple[int, int]:
+    blk_q, blk_k = min(block_q, t), min(block_k, t)
+    if t % blk_q or t % blk_k:
+        raise ValueError(
+            f"sequence {t} not divisible by block sizes ({blk_q}, {blk_k})")
+    return blk_q, blk_k
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    """q/k/v in kernel layout (B, H, T, D); returns (o (B,H,T,D), lse)."""
+    b, h, t, d = q.shape
+    blk_q, blk_k = _block_sizes(t, block_q, block_k)
+    nq, nk = t // blk_q, t // blk_k
+    scale = d ** -0.5
+
+    def qspec():
+        return pl.BlockSpec((1, 1, blk_q, d),
+                            lambda b_, h_, i, j: (b_, h_, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    def kspec():
+        return pl.BlockSpec((1, 1, blk_k, d),
+                            lambda b_, h_, i, j: (b_, h_, j, 0),
+                            memory_space=pltpu.VMEM)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, blk_q=blk_q,
+                          blk_k=blk_k, causal=causal),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec(), kspec(), kspec()],
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ),
+        out_specs=(
+            qspec(),
+            pl.BlockSpec((1, 1, blk_q, 1),
+                         lambda b_, h_, i, j: (b_, h_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # running sum
+            pltpu.VMEM((blk_q, d), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    """All tensors in kernel layout (B, H, T, D)."""
+    b, h, t, d = q.shape
+    blk_q, blk_k = _block_sizes(t, block_q, block_k)
+    nq, nk = t // blk_q, t // blk_k
+    scale = d ** -0.5
+    # delta_i = sum_d dO_i . O_i — the rowwise term of dsoftmax; one cheap
+    # fused elementwise pass in XLA, saved layout (B, H, T) like lse
+    delta = jnp.einsum("bhtd,bhtd->bht", do.astype(jnp.float32),
+                       o.astype(jnp.float32))[..., None]  # (B,H,T,1)
+
+    def tspec(blk, which):
+        # q-addressed or k-addressed (B, H, T, D) blocks per grid layout
+        return pl.BlockSpec((1, 1, blk, d),
+                            memory_space=pltpu.VMEM,
+                            index_map=which)
+
+    q_by_i = lambda b_, h_, i, j: (b_, h_, i, 0)
+    k_by_j = lambda b_, h_, i, j: (b_, h_, j, 0)
+    row_by_i = pl.BlockSpec((1, 1, blk_q, 1),
+                            lambda b_, h_, i, j: (b_, h_, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, blk_q=blk_q,
+                          blk_k=blk_k, causal=causal),
+        grid=(b, h, nq, nk),
+        in_specs=[tspec(blk_q, q_by_i), tspec(blk_k, k_by_j),
+                  tspec(blk_k, k_by_j), tspec(blk_q, q_by_i),
+                  row_by_i, row_by_i],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=tspec(blk_q, q_by_i),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # swapped grid: key blocks outer, query blocks inner
+    q_by_j = lambda b_, h_, i, j: (b_, h_, j, 0)
+    k_by_i = lambda b_, h_, i, j: (b_, h_, i, 0)
+    row_by_j = pl.BlockSpec((1, 1, blk_q, 1),
+                            lambda b_, h_, i, j: (b_, h_, j, 0),
+                            memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, blk_q=blk_q,
+                          blk_k=blk_k, causal=causal),
+        grid=(b, h, nk, nq),
+        in_specs=[tspec(blk_q, q_by_j), tspec(blk_k, k_by_i),
+                  tspec(blk_k, k_by_i), tspec(blk_q, q_by_j),
+                  row_by_j, row_by_j],
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        out_specs=(tspec(blk_k, k_by_i), tspec(blk_k, k_by_i)),
+        scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
+                        pltpu.VMEM((blk_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _to_kernel_layout(x):
+    """(B, T, H, D) -> (B, H, T, D). TPU block specs need the last two
+    block dims to be (sublane-multiple, lane-multiple) or the full array
+    dims, so the head axis cannot be blocked at size 1 in third-from-last
+    position; one HBM relayout per tensor buys legal (blk, D) tiles and is
+    noise next to the O(T^2) attention FLOPs."""
+    return jnp.swapaxes(x, 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                    interpret=False):
+    """Fused attention. q/k/v: (B, T, H, D) -> (B, T, H, D).
+
+    ``T`` must be divisible by the (clamped) block sizes; sequence lengths
+    here are static, so pick divisors — same contract as
+    :func:`parallel.ring_attention.blockwise_causal_attention`. ``interpret``
+    runs the kernels in Pallas interpreter mode (CPU-testable).
+    """
+    o, _ = _fwd(_to_kernel_layout(q), _to_kernel_layout(k),
+                _to_kernel_layout(v), causal, block_q, block_k, interpret)
+    return _to_kernel_layout(o)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    qt, kt, vt = (_to_kernel_layout(x) for x in (q, k, v))
+    o, lse = _fwd(qt, kt, vt, causal, block_q, block_k, interpret)
+    # residuals stay in kernel layout: the backward kernels consume them
+    # directly, so only the cotangent pays a relayout
+    return _to_kernel_layout(o), (qt, kt, vt, o, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+    qt, kt, vt, ot, lse = res
+    dq, dk, dv = _bwd(qt, kt, vt, ot, lse, _to_kernel_layout(do),
+                      causal, block_q, block_k, interpret)
+    return tuple(_to_kernel_layout(g) for g in (dq, dk, dv))
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_causal_attention(q, k, v, block_q=128, block_k=128,
+                           interpret=False):
+    """Drop-in ``attn_fn`` (models/transformer.py): causal flash attention
+    with the framework's (B, T, H, D) calling convention."""
+    return flash_attention(q, k, v, True, block_q, block_k, interpret)
+
+
+def pick_flash_block(t: int, want: int = 512) -> "int | None":
+    """Largest legal flash block for sequence length ``t``, or None.
+
+    ``want`` defaults to 512 — the block the dispatch default's A/B was
+    measured at (bench_suite.py ab_attn_*). Legality follows the Mosaic
+    block rule (last two block dims tile-aligned or equal to the array
+    dims): a block equal to ``t`` is always legal; otherwise prefer the
+    largest divisor of ``t`` <= ``want`` that is lane-aligned (x128), then
+    sublane-aligned (x16, then x8 — Mosaic accepts x8 blocks for bf16 too,
+    verified on this repo's v5e). None = no legal tiling (odd lengths) —
+    callers fall back to the pure-JAX paths.
+    """
+    if t <= want:
+        return t
+    for step in (128, 16, 8):
+        for blk in range(want - want % step, 0, -step):
+            if t % blk == 0:
+                return blk
+    return None

@@ -17,7 +17,8 @@ follow the measured A/B on this repo's real chip (scripts/bench_suite.py
 On CPU (tests, the virtual 8-device mesh) the jnp form always runs —
 interpreter-mode Pallas would only be slower. Overrides for re-measuring:
 ``AATPU_PALLAS=0|1`` forces every kernel, ``AATPU_PALLAS_INT8`` /
-``AATPU_PALLAS_MASKED_REDUCE`` force one.
+``AATPU_PALLAS_MASKED_REDUCE`` / ``AATPU_PALLAS_FLASH_ATTENTION`` force
+one.
 """
 
 from __future__ import annotations
@@ -30,6 +31,12 @@ import jax
 _TPU_DEFAULTS = {
     "masked_reduce": True,
     "int8": False,
+    # flash attention (ops/pallas_kernels/attention.py) — Pallas WINS by
+    # 3.6x (measured on this repo's TPU v5e, bench_suite.py ab_attn_*
+    # lines, B=4 T=4096 H=16 D=128 bf16 fwd+bwd: flash 45.1 TFLOP/s vs
+    # local 12.5 vs blockwise-scan 6.7): the fused VMEM pass keeps the
+    # score tile out of HBM in both directions. Default on TPU: pallas.
+    "flash_attention": True,
 }
 
 

@@ -102,8 +102,64 @@ def main() -> int:
          "(0.9 quantized to bucket granularity), count-rescaled")
 
     ab_pallas_vs_xla()
+    ab_flash_attention()
     mfu_lines()
     return 0
+
+
+def ab_flash_attention():
+    """A/B the fused Pallas flash-attention kernel against the pure-JAX
+    blockwise online-softmax scan (parallel/ring_attention.py) at a
+    train-realistic shape, forward+backward — the measurement behind the
+    dispatch default (ops/pallas_kernels/dispatch.py 'flash_attention')."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from akka_allreduce_tpu.ops.pallas_kernels.attention import (
+        flash_causal_attention)
+    from akka_allreduce_tpu.parallel.ring_attention import (
+        blockwise_causal_attention, local_causal_attention)
+
+    plat = jax.devices()[0].platform
+    on_tpu = plat == "tpu"
+    if on_tpu:
+        b, t, h, d = 4, 4096, 16, 128
+        blk = 512
+    else:  # keep the path exercised on CPU without a perf claim
+        b, t, h, d = 1, 256, 2, 64
+        blk = 128
+    shape = (b, t, h, d)
+    n_bufs = 2
+    qkvs = [tuple(jax.random.normal(jax.random.key(3 * i + j), shape,
+                                    jnp.bfloat16) for j in range(3))
+            for i in range(n_bufs)]
+    # useful attention FLOPs: 2 matmuls x 2bTThd, causal half, x3 for bwd
+    flops = 3 * (2 * 2 * b * t * t * h * d) / 2
+
+    impls = {
+        "flash": partial(flash_causal_attention, block_q=blk, block_k=blk,
+                         interpret=not on_tpu),
+        "blockwise": partial(blockwise_causal_attention, block_size=blk),
+        "local": local_causal_attention,
+    }
+    results = {}
+    for name, attn in impls.items():
+        def fwd_bwd(q, k, v, c):
+            def loss(q, k, v):
+                o = attn(q, k, v)
+                return jnp.sum(o.astype(jnp.float32) * 1e-3) + c
+            val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return val, grads
+        t_step = _time_device_fn(jax.jit(fwd_bwd), qkvs,
+                                 k_hi=40 if on_tpu else 8,
+                                 k_lo=10 if on_tpu else 2)
+        results[name] = flops / t_step / 1e12
+        emit(f"ab_attn_{name}_{plat}", results[name], "TFLOP/s",
+             f"fwd+bwd causal, B={b} T={t} H={h} D={d} bf16, blk={blk}")
+    if on_tpu:
+        win = max(results, key=results.get)
+        emit("ab_attn_winner", results[win], "TFLOP/s", win)
 
 
 def mfu_lines():

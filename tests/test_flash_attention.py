@@ -1,0 +1,229 @@
+"""Flash-attention kernel vs the oracle attention paths.
+
+Same strategy as the reference's buffer specs (SURVEY.md §4): pin the fused
+kernel's numerics against the straightforward implementation
+(`local_causal_attention`, itself the oracle ring attention matches), in
+interpreter mode so the whole contract runs on the CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.ops.pallas_kernels.attention import (
+    flash_attention,
+    flash_causal_attention,
+)
+from akka_allreduce_tpu.parallel.ring_attention import (
+    local_causal_attention,
+)
+
+
+def _qkv(key, b=2, t=256, h=2, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, t, h, d)
+    return (jax.random.normal(kq, shape, dtype),
+            jax.random.normal(kk, shape, dtype),
+            jax.random.normal(kv, shape, dtype))
+
+
+def _oracle_noncausal(q, k, v):
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def test_forward_matches_oracle_causal():
+    q, k, v = _qkv(jax.random.key(0))
+    got = flash_causal_attention(q, k, v, block_q=128, block_k=128,
+                                 interpret=True)
+    want = local_causal_attention(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_matches_oracle_noncausal():
+    q, k, v = _qkv(jax.random.key(1), t=128)
+    got = flash_attention(q, k, v, False, 64, 64, True)
+    want = _oracle_noncausal(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_uneven_block_sizes():
+    # blk_q != blk_k exercises the rectangular mask/skip logic
+    q, k, v = _qkv(jax.random.key(2), t=256)
+    got = flash_causal_attention(q, k, v, block_q=128, block_k=64,
+                                 interpret=True)
+    want = local_causal_attention(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    got = flash_causal_attention(q, k, v, block_q=64, block_k=128,
+                                 interpret=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_small_sequence_clamps_blocks():
+    # t < block size: blocks clamp to t (single grid step per axis)
+    q, k, v = _qkv(jax.random.key(3), t=32)
+    got = flash_causal_attention(q, k, v, interpret=True)
+    want = local_causal_attention(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_indivisible_sequence_raises():
+    q, k, v = _qkv(jax.random.key(4), t=96)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_causal_attention(q, k, v, block_q=64, block_k=64,
+                               interpret=True)
+
+
+def test_gradients_match_oracle():
+    q, k, v = _qkv(jax.random.key(5), b=1, t=128, h=2, d=32)
+
+    def loss_flash(q, k, v):
+        o = flash_causal_attention(q, k, v, block_q=64, block_k=64,
+                                   interpret=True)
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
+
+    def loss_oracle(q, k, v):
+        o = local_causal_attention(q, k, v)
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_oracle = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for gf, go, name in zip(g_flash, g_oracle, "qkv"):
+        np.testing.assert_allclose(gf, go, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_gradients_match_oracle_noncausal():
+    q, k, v = _qkv(jax.random.key(6), b=1, t=64, h=1, d=32)
+
+    def loss(attn, q, k, v):
+        return jnp.sum(jnp.cos(attn(q, k, v).astype(jnp.float32)))
+
+    g_flash = jax.grad(
+        lambda *a: loss(lambda q, k, v: flash_attention(
+            q, k, v, False, 64, 64, True), *a), argnums=(0, 1, 2))(q, k, v)
+    g_oracle = jax.grad(
+        lambda *a: loss(_oracle_noncausal, *a), argnums=(0, 1, 2))(q, k, v)
+    for gf, go, name in zip(g_flash, g_oracle, "qkv"):
+        np.testing.assert_allclose(gf, go, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(jax.random.key(7), t=128, dtype=jnp.bfloat16)
+    got = flash_causal_attention(q, k, v, block_q=64, block_k=64,
+                                 interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = local_causal_attention(q, k, v)
+    np.testing.assert_allclose(got.astype(np.float32),
+                               want.astype(np.float32), atol=3e-2, rtol=3e-2)
+
+
+def test_jit_and_vjp_compile_once():
+    # the train step jits the whole loss; kernel must trace cleanly inside
+    q, k, v = _qkv(jax.random.key(8), b=1, t=64, h=1, d=32)
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q, k, v):
+            o = flash_causal_attention(q, k, v, block_q=64, block_k=64,
+                                       interpret=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        return jax.value_and_grad(loss)(q, k, v)
+
+    val, gq = step(q, k, v)
+    assert np.isfinite(float(val))
+    assert np.isfinite(np.asarray(gq).sum())
+
+
+class TestFlashInTrainStep:
+    """attn_impl='flash' through the FULL sharded train step (interpret
+    mode on the CPU mesh) must match the local-attention path."""
+
+    def _grads(self, attn_impl):
+        from akka_allreduce_tpu.models.train import (
+            TrainConfig, make_grad_step, make_train_state)
+        from akka_allreduce_tpu.models.transformer import TransformerConfig
+        from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                      make_device_mesh)
+        mcfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=4,
+                                 n_layers=2, d_ff=64, max_seq=64)
+        mesh = make_device_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
+        cfg = TrainConfig(model=mcfg, learning_rate=1e-2, bucket_elems=256,
+                          grad_axes=("dp",), attn_impl=attn_impl)
+        params, _, _ = make_train_state(jax.random.key(0), cfg, mesh)
+        grad_step = make_grad_step(cfg, mesh)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 61, size=(4, 64),
+                                          dtype=np.int32))
+        grads, m = jax.jit(grad_step)(params, tokens)
+        return float(m["loss"]), grads
+
+    def test_flash_grads_match_local(self):
+        loss_flash, g_flash = self._grads("flash")
+        loss_local, g_local = self._grads("local")
+        assert abs(loss_flash - loss_local) < 1e-5
+        for lf, ll in zip(jax.tree.leaves(g_flash),
+                          jax.tree.leaves(g_local)):
+            np.testing.assert_allclose(np.asarray(lf), np.asarray(ll),
+                                       atol=2e-5, rtol=5e-3)
+
+    def test_unknown_impl_raises(self):
+        from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                     select_local_attention)
+        from akka_allreduce_tpu.models.transformer import TransformerConfig
+        cfg = TrainConfig(model=TransformerConfig(), attn_impl="nope")
+        with pytest.raises(ValueError, match="attn_impl"):
+            select_local_attention(cfg)
+
+
+class TestBlockSelection:
+    def test_pick_flash_block(self):
+        from akka_allreduce_tpu.ops.pallas_kernels.attention import (
+            pick_flash_block)
+        assert pick_flash_block(2048, 512) == 512
+        assert pick_flash_block(64, 512) == 64      # t <= want: one block
+        assert pick_flash_block(1000, 512) == 200   # x8 divisor tier
+        assert pick_flash_block(192, 512) == 192    # t <= want
+        assert pick_flash_block(4096 + 128, 512) == 384  # lane-aligned tier
+        assert pick_flash_block(4097, 512) is None  # odd: no legal tiling
+        assert pick_flash_block(2 * 4097, 512) is None  # 2 | t but no x8
+
+    def test_auto_falls_back_for_untileable_seq(self, monkeypatch):
+        # force the dispatch to claim flash wins (as on TPU), then feed a
+        # sequence length the kernel cannot tile: "auto" must fall back to
+        # the pure-JAX path instead of raising (previously-working config)
+        monkeypatch.setenv("AATPU_PALLAS_FLASH_ATTENTION", "1")
+        from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                     select_local_attention)
+        from akka_allreduce_tpu.models.transformer import TransformerConfig
+        cfg = TrainConfig(model=TransformerConfig(), attn_impl="auto")
+        attn = select_local_attention(cfg)
+        t = 4097  # odd and > the block budget: pick_flash_block -> None
+        q = jax.random.normal(jax.random.key(0), (1, t, 1, 8))
+        out = attn(q, q, q)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(local_causal_attention(q, q, q)),
+            atol=1e-5, rtol=1e-5)
+
+    def test_forced_flash_raises_for_untileable_seq(self):
+        from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                     select_local_attention)
+        from akka_allreduce_tpu.models.transformer import TransformerConfig
+        cfg = TrainConfig(model=TransformerConfig(), attn_impl="flash")
+        attn = select_local_attention(cfg)
+        q = jax.random.normal(jax.random.key(0), (1, 4097, 1, 8))
+        with pytest.raises(ValueError, match="no legal flash block"):
+            attn(q, q, q)
+        # t <= the block budget is always a single legal block, even odd
+        q = jax.random.normal(jax.random.key(0), (1, 129, 1, 8))
+        out = attn(q, q, q)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(local_causal_attention(q, q, q)),
+            atol=1e-5, rtol=1e-5)
