@@ -39,6 +39,8 @@ import sys
 import time
 from functools import partial
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -142,13 +144,28 @@ def measure_device_goodput(elems: int, bucket_elems: int,
     t_hi = measure(r_hi)
     t_lo = measure(r_lo)
     per_round = (t_hi - t_lo) / (r_hi - r_lo)
+    if per_round <= 0:
+        # relay jitter swamped the delta (small workloads): widen the span
+        # until the signal dominates rather than publishing a negative
+        # "goodput" (the reference's sink can't go negative either —
+        # bytes/elapsed, AllreduceWorker.scala:331-335)
+        wide_hi = 4 * r_hi
+        _log(f"non-positive two-point delta ({per_round:.3e}s/round); "
+             f"retrying with {wide_hi}-round span")
+        t_hi = measure(wide_hi)
+        per_round = (t_hi - t_lo) / (wide_hi - r_lo)
+    if per_round <= 0:
+        raise RuntimeError(
+            f"two-point timing failed twice (delta {per_round:.3e}s/round "
+            f"at {r_lo}/{r_hi} and {wide_hi} rounds): relay too noisy for "
+            f"this workload size")
     return elems * 4 / per_round / 1e9
 
 
 def measure_train_mfu(compute_dtype: str = "bf16",
                       d_model: int = 2048, n_layers: int = 8,
                       d_ff: int = 8192, vocab: int = 32768,
-                      batch: int = 8, seq: int = 2048,
+                      batch: Optional[int] = None, seq: int = 2048,
                       steps_hi: int = 12, steps_lo: int = 4
                       ) -> dict:
     """Single-chip train-step MFU on the flagship transformer.
@@ -161,6 +178,11 @@ def measure_train_mfu(compute_dtype: str = "bf16",
     """
     from akka_allreduce_tpu.models.flops import (chip_peak_flops,
                                                  transformer_step_flops)
+
+    if batch is None:
+        # dtype-sized default: bf16 halves activation HBM, so it fits (and
+        # wants) twice the batch; b=16 bf16 / b=8 f32 OOM the 16G chip
+        batch = 8 if compute_dtype == "bf16" else 4
     from akka_allreduce_tpu.models.train import (TrainConfig,
                                                  make_train_state,
                                                  make_train_step)

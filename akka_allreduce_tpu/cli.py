@@ -208,6 +208,14 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--n-heads", type=int, default=4)
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="KV heads for grouped-query attention "
+                        "(0 = multi-head: one per query head)")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings instead of a learned "
+                        "positional table")
+    p.add_argument("--ffn", choices=("gelu", "swiglu"), default="gelu",
+                   help="dense FF flavor (swiglu = Llama-style gated FF)")
     p.add_argument("--batch", type=int, default=0,
                    help="global batch (0 = 2 per dp rank)")
     p.add_argument("--seq", type=int, default=0,
@@ -265,6 +273,14 @@ def _add_generate(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--n-heads", type=int, default=4)
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="KV heads for grouped-query attention "
+                        "(0 = multi-head: one per query head)")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings instead of a learned "
+                        "positional table")
+    p.add_argument("--ffn", choices=("gelu", "swiglu"), default="gelu",
+                   help="dense FF flavor (swiglu = Llama-style gated FF)")
     p.add_argument("--max-seq", type=int, required=True,
                    help="the trained model's max_seq (= train's --seq): "
                         "the positional table's shape, which the "
@@ -346,7 +362,9 @@ def _cmd_generate(args: argparse.Namespace) -> int:
     mcfg = TransformerConfig(vocab_size=args.vocab, d_model=args.d_model,
                              n_heads=args.n_heads, n_layers=args.n_layers,
                              d_ff=args.d_ff, max_seq=max_seq,
-                             moe=moe, moe_every=args.moe_every)
+                             moe=moe, moe_every=args.moe_every,
+                             n_kv_heads=args.kv_heads or None,
+                             rope=args.rope, ffn=args.ffn)
     cfg = TrainConfig(model=mcfg)
     mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
     # NOTE: this restores opt_state too (tripling restore I/O) — the
@@ -479,7 +497,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
     mcfg = TransformerConfig(vocab_size=args.vocab, d_model=args.d_model,
                              n_heads=args.n_heads, n_layers=args.n_layers,
                              d_ff=args.d_ff, max_seq=t,
-                             moe=moe, moe_every=args.moe_every)
+                             moe=moe, moe_every=args.moe_every,
+                             n_kv_heads=args.kv_heads or None,
+                             rope=args.rope, ffn=args.ffn)
     cfg = TrainConfig(model=mcfg, learning_rate=args.lr,
                       bucket_elems=args.bucket_elems, microbatches=micro,
                       compute_dtype="bf16" if args.bf16 else "f32",

@@ -60,22 +60,27 @@ def transformer_fwd_flops(cfg: TransformerConfig, batch: int,
     """Useful forward matmul FLOPs for one pass over (batch, seq) tokens."""
     b, t, d = batch, seq, cfg.d_model
     tokens = b * t
-    per_layer_attn = 8 * tokens * d * d  # wq/wk/wv/wo: 4 matmuls, 2 FLOPs/MAC
+    d_kv = cfg.kv_heads * cfg.head_dim  # < d under grouped-query attention
+    # wq + wo at full width, wk + wv at the (possibly grouped) KV width
+    per_layer_attn = 4 * tokens * d * d + 4 * tokens * d * d_kv
     # scores (QK^T) + AV: 2 matmuls x 2 FLOPs/MAC x b*t*t*d, halved for
-    # causality (future blocks are skipped by the blockwise/ring kernels)
+    # causality (future blocks are skipped by the blockwise/ring kernels);
+    # every QUERY head attends, so GQA does not change this term
     attn_core = 2 * tokens * t * d
+    # dense FF matmul count: gelu = w1+w2, swiglu adds the w3 gate
+    n_ff_mats = 3 if cfg.ffn == "swiglu" else 2
+    dense_ff = n_ff_mats * 2 * tokens * d * cfg.d_ff
     if cfg.moe is not None:
         # routed FF: router (d x E) + top-k expert FFs per token
         k = cfg.moe.router_k
-        ff = (2 * tokens * d * cfg.moe.n_experts
-              + k * 4 * tokens * d * cfg.moe.d_ff)
+        moe_ff = (2 * tokens * d * cfg.moe.n_experts
+                  + k * 4 * tokens * d * cfg.moe.d_ff)
+        moe_layers = sum(1 for i in range(cfg.n_layers)
+                         if cfg.is_moe_layer(i))
+        layer_ff = (moe_layers * moe_ff
+                    + (cfg.n_layers - moe_layers) * dense_ff)
     else:
-        ff = 4 * tokens * d * cfg.d_ff  # w1 + w2
-    moe_layers = sum(1 for i in range(cfg.n_layers) if cfg.is_moe_layer(i))
-    dense_layers = cfg.n_layers - moe_layers
-    dense_ff = 4 * tokens * d * cfg.d_ff
-    layer_ff = (moe_layers * ff + dense_layers * dense_ff
-                if cfg.moe is not None else cfg.n_layers * dense_ff)
+        layer_ff = cfg.n_layers * dense_ff
     head = 2 * tokens * d * cfg.vocab_size
     return (cfg.n_layers * (per_layer_attn + attn_core) + layer_ff + head)
 

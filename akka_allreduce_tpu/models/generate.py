@@ -33,6 +33,7 @@ from jax import lax
 
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
+    apply_rope,
     rmsnorm,
 )
 from akka_allreduce_tpu.parallel.ep import moe_ffn
@@ -43,12 +44,14 @@ from akka_allreduce_tpu.parallel.ring_attention import (
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int) -> dict:
-    """Static-shape cache: one (batch, max_seq, heads, head_dim) K and V
+    """Static-shape cache: one (batch, max_seq, kv_heads, head_dim) K and V
     buffer per layer, plus the write position. Buffers use the model's
     compute dtype — the parity contract (and, for bf16 models, half the
     cache HBM) depends on the cached K/V matching what the full forward's
-    attention consumed."""
-    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    attention consumed. Under grouped-query attention the cache holds only
+    the kv_heads — the GQA decode win: cache HBM shrinks by the group
+    factor."""
+    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -58,21 +61,27 @@ def init_kv_cache(cfg: TransformerConfig, batch: int) -> dict:
 
 def _cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
                       v_all: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
-    """q: (b, 1, h, d); k_all/v_all: (b, max_seq, h, d) with positions
+    """q: (b, 1, h, d); k_all/v_all: (b, max_seq, h_kv, d) with positions
     <= pos valid. Masked softmax over the full static buffer — the causal
-    mask IS the length mask at decode time."""
+    mask IS the length mask at decode time. GQA (h_kv < h) runs as a
+    grouped einsum against the NARROW cache: no repeated K/V is ever
+    materialised, so decode reads cache HBM at the reduced width."""
     # op-for-op the math of local_causal_attention (same scale form, f32
     # score/softmax, same cast points) so cached decode is bit-identical
     # to the full forward at every valid position
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+    b, one, h, d = q.shape
+    h_kv = k_all.shape[2]
+    g = h // h_kv
+    qg = q.reshape(b, one, h_kv, g, d)
+    scale = d ** -0.5
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all,
                         preferred_element_type=jnp.float32) * scale
-    valid = (jnp.arange(k_all.shape[1]) <= pos)[None, None, None, :]
+    valid = (jnp.arange(k_all.shape[1]) <= pos)[None, None, None, None, :]
     scores = jnp.where(valid, scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_all.dtype), v_all,
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_all.dtype), v_all,
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.reshape(b, one, h, d).astype(q.dtype)
 
 
 def decode_step(params: dict, cache: dict, token: jnp.ndarray,
@@ -86,14 +95,19 @@ def decode_step(params: dict, cache: dict, token: jnp.ndarray,
     """
     b = token.shape[0]
     pos = cache["pos"]
-    x = params["embed"][token][:, None, :] \
-        + lax.dynamic_slice_in_dim(params["pos"], pos, 1, axis=0)[None]
+    x = params["embed"][token][:, None, :]
+    if not cfg.rope:
+        x = x + lax.dynamic_slice_in_dim(params["pos"], pos, 1,
+                                         axis=0)[None]
     k_cache, v_cache = cache["k"], cache["v"]
     for i, layer in enumerate(params["layers"]):
         h = rmsnorm(x, layer["ln1"])
         q = (h @ layer["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
-        k = (h @ layer["wk"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
-        v = (h @ layer["wv"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(b, 1, cfg.kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(b, 1, cfg.kv_heads, cfg.head_dim)
+        if cfg.rope:
+            q = apply_rope(q, pos[None], cfg.rope_theta)
+            k = apply_rope(k, pos[None], cfg.rope_theta)
         k_cache = lax.dynamic_update_slice(
             k_cache, k[None].astype(k_cache.dtype), (i, 0, pos, 0, 0))
         v_cache = lax.dynamic_update_slice(
@@ -105,6 +119,9 @@ def decode_step(params: dict, cache: dict, token: jnp.ndarray,
         if "router" in layer:
             y, _aux = moe_ffn(h, layer, cfg.moe, axis_name=None)
             x = x + y
+        elif "w3" in layer:
+            x = x + (jax.nn.silu(h @ layer["w1"])
+                     * (h @ layer["w3"])) @ layer["w2"]
         else:
             x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
     logits = rmsnorm(x, params["out_norm"]) @ params["lm_head"]
@@ -119,13 +136,18 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
     steps — and return (cache after the prompt, last-position logits).
     Same block math as decode_step/transformer_apply (parity-pinned)."""
     b, t = prompt.shape
-    x = params["embed"][prompt] + params["pos"][:t][None]
+    x = params["embed"][prompt]
+    if not cfg.rope:
+        x = x + params["pos"][:t][None]
     k_cache, v_cache = cache["k"], cache["v"]
     for i, layer in enumerate(params["layers"]):
         h = rmsnorm(x, layer["ln1"])
         q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
-        k = (h @ layer["wk"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
-        v = (h @ layer["wv"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(b, t, cfg.kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(b, t, cfg.kv_heads, cfg.head_dim)
+        if cfg.rope:
+            q = apply_rope(q, jnp.arange(t), cfg.rope_theta)
+            k = apply_rope(k, jnp.arange(t), cfg.rope_theta)
         k_cache = lax.dynamic_update_slice(
             k_cache, k[None].astype(k_cache.dtype), (i, 0, 0, 0, 0))
         v_cache = lax.dynamic_update_slice(
@@ -137,6 +159,9 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
         if "router" in layer:
             y, _aux = moe_ffn(h, layer, cfg.moe, axis_name=None)
             x = x + y
+        elif "w3" in layer:
+            x = x + (jax.nn.silu(h @ layer["w1"])
+                     * (h @ layer["w3"])) @ layer["w2"]
         else:
             x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
     logits = rmsnorm(x[:, -1:], params["out_norm"]) @ params["lm_head"]

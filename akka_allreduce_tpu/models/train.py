@@ -106,6 +106,8 @@ def _uniform_layer_spec(cfg: TransformerConfig) -> tuple[dict, dict, dict]:
         "wo": P("tp", None),
     }
     dense_ff = {"w1": P(None, "tp"), "w2": P("tp", None)}
+    if cfg.ffn == "swiglu":
+        dense_ff["w3"] = P(None, "tp")
     moe_ff = {"router": P(), "we1": P("ep", None, None),
               "we2": P("ep", None, None)}
     return attn, dense_ff, moe_ff
@@ -132,7 +134,9 @@ def param_specs(cfg: TransformerConfig, pp: int = 1) -> dict:
     stay replicated over pp (their grads psum over it in make_grad_step).
     """
     attn, dense_ff, moe_ff = _uniform_layer_spec(cfg)
-    top = {"embed": P(), "pos": P(), "out_norm": P(), "lm_head": P()}
+    top = {"embed": P(), "out_norm": P(), "lm_head": P()}
+    if not cfg.rope:
+        top["pos"] = P()
     if pp == 1:
         return {
             **top,
@@ -458,7 +462,8 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                 f"microbatches={m}")
 
         def block(lyr, h):
-            return transformer_block(lyr, h, mcfg, attn, tp_axis, ep_axis)
+            return transformer_block(lyr, h, mcfg, attn, tp_axis, ep_axis,
+                                     positions=positions)
 
         if cfg.remat:
             block = jax.checkpoint(block)
@@ -468,7 +473,9 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
 
         def loss_fn(p):
             p = cast_compute(p)
-            x = p["embed"][tokens] + p["pos"][positions]
+            x = p["embed"][tokens]
+            if not mcfg.rope:
+                x = x + p["pos"][positions]
             xm = x.reshape(m, b_local // m, t_local, x.shape[-1])
             outs, aux = gpipe_apply(p["layers"], xm, stage, "pp")
             h = outs.reshape(b_local, t_local, outs.shape[-1])

@@ -43,13 +43,69 @@ class TransformerConfig:
     # FF with a routed expert FF (parallel/ep.py). moe_every=1 => all layers.
     moe: Optional[MoEConfig] = None
     moe_every: int = 1
+    # Llama-family options (the second model family; all orthogonal to the
+    # parallel axes):
+    # * n_kv_heads < n_heads = grouped-query attention — K/V are projected
+    #   to fewer heads and each group of n_heads/n_kv_heads query heads
+    #   shares one; shrinks the KV cache and K/V projection by the group
+    #   factor (None = multi-head, every query head has its own K/V)
+    # * rope = rotary position embeddings applied to q/k inside every
+    #   block instead of a learned absolute "pos" table (no "pos" param)
+    # * ffn = "swiglu": FF becomes w2(silu(w1 x) * (w3 x)) with a third
+    #   gate matrix, vs the default "gelu" two-matrix FF
+    n_kv_heads: Optional[int] = None
+    rope: bool = False
+    rope_theta: float = 10000.0
+    ffn: str = "gelu"
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None \
+            else self.n_heads
+
     def is_moe_layer(self, i: int) -> bool:
         return self.moe is not None and (i + 1) % self.moe_every == 0
+
+    def __post_init__(self):
+        if self.n_kv_heads is not None and not (
+                0 < self.n_kv_heads <= self.n_heads):
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must be in "
+                f"[1, n_heads={self.n_heads}] (None = multi-head; the "
+                f"CLI's 0 sentinel maps to None before reaching here)")
+        if self.n_heads % self.kv_heads:
+            raise ValueError(
+                f"n_kv_heads={self.kv_heads} must divide "
+                f"n_heads={self.n_heads}")
+        if self.ffn not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown ffn {self.ffn!r}")
+        if self.rope and self.head_dim % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {self.head_dim} "
+                f"(d_model={self.d_model} / n_heads={self.n_heads})")
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               theta: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding over (B, T, H, D): each head-dim pair
+    (x[2i], x[2i+1] in the half-split convention) rotates by
+    pos * theta^(-2i/D). Stats in f32, result in x's dtype (same precision
+    rule as rmsnorm: position phases must not quantise to bf16)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]  # (1, T, 1, D/2)
+    sin = jnp.sin(angles)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
 
 
 def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
@@ -65,31 +121,33 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig,
     """Full (unsharded) parameters when tp=1; per-rank TP shards when the
     caller slices (models/train.py shards via the mesh instead — this
     function always builds the full tree; tp only validates divisibility)."""
-    if cfg.n_heads % tp or cfg.d_ff % tp:
+    if cfg.n_heads % tp or cfg.d_ff % tp or cfg.kv_heads % tp:
         raise ValueError(
-            f"tp={tp} must divide both n_heads={cfg.n_heads} and "
-            f"d_ff={cfg.d_ff}")
-    k = iter(jax.random.split(key, 4 + 9 * cfg.n_layers))
+            f"tp={tp} must divide n_heads={cfg.n_heads}, "
+            f"n_kv_heads={cfg.kv_heads}, and d_ff={cfg.d_ff}")
+    k = iter(jax.random.split(key, 4 + 10 * cfg.n_layers))
     dt = cfg.dtype
     scale = cfg.d_model ** -0.5
+    d_kv = cfg.kv_heads * cfg.head_dim
     params = {
         "embed": jax.random.normal(next(k), (cfg.vocab_size, cfg.d_model),
                                    dt) * scale,
-        "pos": jax.random.normal(next(k), (cfg.max_seq, cfg.d_model),
-                                 dt) * scale,
         "out_norm": jnp.ones((cfg.d_model,), dt),
         "lm_head": jax.random.normal(next(k), (cfg.d_model, cfg.vocab_size),
                                      dt) * scale,
         "layers": [],
     }
+    if not cfg.rope:
+        params["pos"] = jax.random.normal(
+            next(k), (cfg.max_seq, cfg.d_model), dt) * scale
     for i in range(cfg.n_layers):
         layer = {
             "ln1": jnp.ones((cfg.d_model,), dt),
             "wq": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
                                     dt) * scale,
-            "wk": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
+            "wk": jax.random.normal(next(k), (cfg.d_model, d_kv),
                                     dt) * scale,
-            "wv": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
+            "wv": jax.random.normal(next(k), (cfg.d_model, d_kv),
                                     dt) * scale,
             "wo": jax.random.normal(next(k), (cfg.d_model, cfg.d_model),
                                     dt) * scale,
@@ -103,6 +161,9 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig,
                 next(k), (cfg.d_model, cfg.d_ff), dt) * scale
             layer["w2"] = jax.random.normal(
                 next(k), (cfg.d_ff, cfg.d_model), dt) * scale
+            if cfg.ffn == "swiglu":
+                layer["w3"] = jax.random.normal(
+                    next(k), (cfg.d_model, cfg.d_ff), dt) * scale
         params["layers"].append(layer)
     return params
 
@@ -113,12 +174,21 @@ AttnFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 def transformer_block(layer: dict, x: jnp.ndarray, cfg: TransformerConfig,
                       attn_fn: AttnFn = local_causal_attention,
                       tp_axis: Optional[str] = None,
-                      ep_axis: Optional[str] = None
+                      ep_axis: Optional[str] = None,
+                      positions: Optional[jnp.ndarray] = None
                       ) -> tuple[jnp.ndarray, dict]:
     """One residual block (attention + FF), rank-local. Returns (x, aux);
     aux is empty for dense layers and carries ``aux_loss`` /
     ``dispatch_fraction`` for MoE layers (``layer`` holds a ``router``).
-    The single block primitive every apply path composes."""
+    The single block primitive every apply path composes.
+
+    ``positions`` (global sequence positions of this rank's tokens) is only
+    consulted under rope — rotary phases need absolute positions inside
+    every block, including under sequence sharding and pipelining. With
+    GQA K/V carry cfg.kv_heads heads; ``attn_fn`` receives the narrow K/V
+    (the flash kernel consumes them natively, the pure-JAX paths expand).
+    MoE layers keep their own expert FF (ffn="swiglu" shapes dense layers
+    only)."""
     b, t, _ = x.shape
     h = rmsnorm(x, layer["ln1"])
     if tp_axis is not None:
@@ -129,9 +199,15 @@ def transformer_block(layer: dict, x: jnp.ndarray, cfg: TransformerConfig,
     k_ = column_parallel_dense(h, layer["wk"])
     v = column_parallel_dense(h, layer["wv"])
     n_heads_local = q.shape[-1] // cfg.head_dim
+    n_kv_local = k_.shape[-1] // cfg.head_dim
     q = q.reshape(b, t, n_heads_local, cfg.head_dim)
-    k_ = k_.reshape(b, t, n_heads_local, cfg.head_dim)
-    v = v.reshape(b, t, n_heads_local, cfg.head_dim)
+    k_ = k_.reshape(b, t, n_kv_local, cfg.head_dim)
+    v = v.reshape(b, t, n_kv_local, cfg.head_dim)
+    if cfg.rope:
+        if positions is None:
+            positions = jnp.arange(t)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k_ = apply_rope(k_, positions, cfg.rope_theta)
     attn = attn_fn(q, k_, v).reshape(b, t, -1)
     if tp_axis is not None:
         x = x + row_parallel_dense(attn, layer["wo"], tp_axis)
@@ -151,11 +227,15 @@ def transformer_block(layer: dict, x: jnp.ndarray, cfg: TransformerConfig,
     else:
         if tp_axis is not None:
             h = tp_grad_boundary(h, tp_axis)
-        h = jax.nn.gelu(column_parallel_dense(h, layer["w1"]))
-        if tp_axis is not None:
-            x = x + row_parallel_dense(h, layer["w2"], tp_axis)
+        if "w3" in layer:  # swiglu: gate * up, silu-gated
+            hh = jax.nn.silu(column_parallel_dense(h, layer["w1"])) \
+                * column_parallel_dense(h, layer["w3"])
         else:
-            x = x + h @ layer["w2"]
+            hh = jax.nn.gelu(column_parallel_dense(h, layer["w1"]))
+        if tp_axis is not None:
+            x = x + row_parallel_dense(hh, layer["w2"], tp_axis)
+        else:
+            x = x + hh @ layer["w2"]
     return x, aux
 
 
@@ -207,10 +287,13 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
     t = tokens.shape[1]
     if positions is None:
         positions = jnp.arange(t)
-    x = params["embed"][tokens] + params["pos"][positions]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos"][positions]
 
     def block(layer, h):
-        return transformer_block(layer, h, cfg, attn_fn, tp_axis, ep_axis)
+        return transformer_block(layer, h, cfg, attn_fn, tp_axis, ep_axis,
+                                 positions=positions)
 
     if remat:
         block = jax.checkpoint(block)

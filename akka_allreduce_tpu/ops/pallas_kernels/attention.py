@@ -132,13 +132,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, blk_q, blk_k, causal):
-    # Note the swapped grid: (B, H, key-block, query-block) — the query
-    # axis is innermost so scratch carries dk/dv across it.
-    ik, iq = pl.program_id(2), pl.program_id(3)
-    nq = pl.num_programs(3)
+                *, scale, blk_q, blk_k, causal, nq):
+    # Swapped grid: (B, KV head, key-block, inner) where the innermost axis
+    # enumerates (query head within the GQA group) x (query block),
+    # jj = qh_local * nq + iq — scratch accumulates dk/dv across the whole
+    # group (see _bwd for why a plain per-q-head grid would be wrong).
+    ik, jj = pl.program_id(2), pl.program_id(3)
+    n_inner = pl.num_programs(3)
+    iq = jj % nq
 
-    @pl.when(iq == 0)
+    @pl.when(jj == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -172,7 +175,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(iq == nq - 1)
+    @pl.when(jj == n_inner - 1)
     def _emit():
         dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
@@ -187,8 +190,14 @@ def _block_sizes(t: int, block_q: int, block_k: int) -> tuple[int, int]:
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    """q/k/v in kernel layout (B, H, T, D); returns (o (B,H,T,D), lse)."""
+    """q/k/v in kernel layout (B, H, T, D); returns (o (B,H,T,D), lse).
+
+    Grouped-query attention is native: K/V may carry fewer heads than Q
+    (models/transformer.py ``n_kv_heads``) — their block index maps divide
+    the query-head grid index by the group factor, so the narrow heads are
+    read directly from HBM with no materialised repeat."""
     b, h, t, d = q.shape
+    g = h // k.shape[1]
     blk_q, blk_k = _block_sizes(t, block_q, block_k)
     nq, nk = t // blk_q, t // blk_k
     scale = d ** -0.5
@@ -200,7 +209,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
     def kspec():
         return pl.BlockSpec((1, 1, blk_k, d),
-                            lambda b_, h_, i, j: (b_, h_, j, 0),
+                            lambda b_, h_, i, j: (b_, h_ // g, j, 0),
                             memory_space=pltpu.VMEM)
 
     o, lse = pl.pallas_call(
@@ -229,8 +238,11 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
-    """All tensors in kernel layout (B, H, T, D)."""
+    """All tensors in kernel layout (B, H, T, D); k/v may carry fewer
+    (grouped) heads — see _fwd."""
     b, h, t, d = q.shape
+    g = h // k.shape[1]
+    h_kv = k.shape[1]
     blk_q, blk_k = _block_sizes(t, block_q, block_k)
     nq, nk = t // blk_q, t // blk_k
     scale = d ** -0.5
@@ -246,7 +258,7 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
                             index_map=which)
 
     q_by_i = lambda b_, h_, i, j: (b_, h_, i, 0)
-    k_by_j = lambda b_, h_, i, j: (b_, h_, j, 0)
+    k_by_j = lambda b_, h_, i, j: (b_, h_ // g, j, 0)
     row_by_i = pl.BlockSpec((1, 1, blk_q, 1),
                             lambda b_, h_, i, j: (b_, h_, i, 0),
                             memory_space=pltpu.VMEM)
@@ -264,19 +276,25 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    # swapped grid: key blocks outer, query blocks inner
-    q_by_j = lambda b_, h_, i, j: (b_, h_, j, 0)
-    k_by_i = lambda b_, h_, i, j: (b_, h_, i, 0)
-    row_by_j = pl.BlockSpec((1, 1, blk_q, 1),
-                            lambda b_, h_, i, j: (b_, h_, j, 0),
-                            memory_space=pltpu.VMEM)
+    # Swapped grid for dk/dv: (batch, KV head, key block, inner), with the
+    # inner axis running over (query head in group) x (query block) —
+    # jj = qh_local * nq + iq — so the scratch accumulates each KV head's
+    # gradient across its WHOLE query group before the single emit (with
+    # plain per-q-head grids a g-headed group would overwrite the shared
+    # dk/dv block g times, keeping only the last group's member).
+    q_by_jj = lambda b_, hk, i, jj: (b_, hk * g + jj // nq, jj % nq, 0)
+    k_by_i = lambda b_, hk, i, jj: (b_, hk, i, 0)
+    row_by_jj = pl.BlockSpec(
+        (1, 1, blk_q, 1),
+        lambda b_, hk, i, jj: (b_, hk * g + jj // nq, jj % nq, 0),
+        memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, blk_q=blk_q,
-                          blk_k=blk_k, causal=causal),
-        grid=(b, h, nk, nq),
-        in_specs=[tspec(blk_q, q_by_j), tspec(blk_k, k_by_i),
-                  tspec(blk_k, k_by_i), tspec(blk_q, q_by_j),
-                  row_by_j, row_by_j],
+                          blk_k=blk_k, causal=causal, nq=nq),
+        grid=(b, h_kv, nk, g * nq),
+        in_specs=[tspec(blk_q, q_by_jj), tspec(blk_k, k_by_i),
+                  tspec(blk_k, k_by_i), tspec(blk_q, q_by_jj),
+                  row_by_jj, row_by_jj],
         out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)),
         out_specs=(tspec(blk_k, k_by_i), tspec(blk_k, k_by_i)),

@@ -28,16 +28,31 @@ from akka_allreduce_tpu.utils.vma import cast_varying
 NEG_INF = -1e30
 
 
+def expand_kv_heads(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray
+                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Grouped-query attention support for the pure-JAX paths: when K/V
+    carry fewer heads than Q (models/transformer.py ``n_kv_heads``), repeat
+    each K/V head across its query group. The flash kernel instead indexes
+    the narrow heads directly (no materialised repeat); ring attention
+    rotates the NARROW K/V around the ring — the ICI traffic shrinks by
+    the group factor — and expands per block here."""
+    g = q.shape[2] // k.shape[2]
+    if g == 1:
+        return k, v
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 def _block_attention(q, k, v, m, l, acc, q_offset, k_offset, causal):
     """One blockwise attention accumulation step with online softmax.
 
-    q: (B, Tq, H, D); k, v: (B, Tk, H, D); m, l: (B, H, Tq) f32;
+    q: (B, Tq, H, D); k, v: (B, Tk, H or H_kv, D); m, l: (B, H, Tq) f32;
     acc: (B, Tq, H, D) f32. Offsets are the blocks' global sequence
     positions, used for causal masking across ranks. Softmax statistics
     and the output accumulator run in f32 regardless of the input dtype
     (the flash-attention rule: bf16 matmuls on the MXU, f32 running
     max/sum/accumulate or long-sequence exp sums drift).
     """
+    k, v = expand_kv_heads(q, k, v)
     scale = q.shape[-1] ** -0.5
     # scores: (B, H, Tq, Tk) — f32 accumulation out of the MXU
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -161,6 +176,7 @@ def local_causal_attention(q: jnp.ndarray, k: jnp.ndarray,
     """Single-rank reference attention (no sequence sharding): the oracle
     ring_attention must match. Same precision rule: f32 scores/softmax,
     bf16-friendly matmuls."""
+    k, v = expand_kv_heads(q, k, v)
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale

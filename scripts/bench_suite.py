@@ -150,6 +150,12 @@ def ab_flash_attention():
                 o = attn(q, k, v)
                 return jnp.sum(o.astype(jnp.float32) * 1e-3) + c
             val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            # the carry must depend on the BACKWARD outputs too, or the
+            # timing loop never forces the gradient programs (the
+            # _time_device_fn contract): fold a cheap slice of each grad in
+            val = val + sum(
+                jnp.sum(g[0, 0, 0, :8].astype(jnp.float32)) * 1e-9
+                for g in grads)
             return val, grads
         t_step = _time_device_fn(jax.jit(fwd_bwd), qkvs,
                                  k_hi=40 if on_tpu else 8,

@@ -80,6 +80,7 @@ def test_indivisible_sequence_raises():
                                interpret=True)
 
 
+@pytest.mark.slow
 def test_gradients_match_oracle():
     q, k, v = _qkv(jax.random.key(5), b=1, t=128, h=2, d=32)
 
@@ -165,6 +166,7 @@ class TestFlashInTrainStep:
         grads, m = jax.jit(grad_step)(params, tokens)
         return float(m["loss"]), grads
 
+    @pytest.mark.slow
     def test_flash_grads_match_local(self):
         loss_flash, g_flash = self._grads("flash")
         loss_local, g_local = self._grads("local")
@@ -227,3 +229,24 @@ class TestBlockSelection:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(local_causal_attention(q, q, q)),
             atol=1e-5, rtol=1e-5)
+
+
+def test_causal_gradients_fast_tier():
+    # small causal backward pin that stays in the fast tier (the larger
+    # parametrised grad tests are marked slow): exercises _causal_mask and
+    # the live-skip predicates in both backward kernels
+    q, k, v = _qkv(jax.random.key(9), b=1, t=64, h=1, d=32)
+
+    def loss(attn, q, k, v):
+        return jnp.sum(jnp.sin(attn(q, k, v).astype(jnp.float32)))
+
+    g_flash = jax.grad(
+        lambda *a: loss(lambda q, k, v: flash_causal_attention(
+            q, k, v, block_q=32, block_k=32, interpret=True), *a),
+        argnums=(0, 1, 2))(q, k, v)
+    g_oracle = jax.grad(
+        lambda *a: loss(local_causal_attention, *a), argnums=(0, 1, 2))(
+        q, k, v)
+    for gf, go, name in zip(g_flash, g_oracle, "qkv"):
+        np.testing.assert_allclose(gf, go, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")

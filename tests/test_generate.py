@@ -62,6 +62,7 @@ class TestDecodeParity:
         np.testing.assert_allclose(np.asarray(c1["k"]), np.asarray(c2["k"]),
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_bf16_decode_parity(self):
         """bf16 model: the cache must hold bf16 K/V (what the full
         forward's attention consumed) so cached decode matches within
@@ -81,6 +82,7 @@ class TestDecodeParity:
         np.testing.assert_allclose(np.asarray(jnp.stack(got, 1)),
                                    np.asarray(full), rtol=0.05, atol=0.05)
 
+    @pytest.mark.slow
     def test_moe_decode_parity(self):
         """Per-token routing through the expert FF: generous capacity so
         neither path drops tokens, then logits must match."""

@@ -17,12 +17,18 @@ import re
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
 
 from akka_allreduce_tpu.protocol.remote import free_port
 from akka_allreduce_tpu.protocol.tcp import TcpRouter
+
+
+def _drain(stream):
+    for _ in stream:
+        pass
 
 
 class TestHeartbeatDetector:
@@ -151,9 +157,19 @@ class TestSigstopCluster:
              "--master-port", str(port), "--data-size", "1024",
              "--timeout", "35", "--verbose", "--checkpoint", "10",
              "--heartbeat-interval", "0.4", "--unreachable-after", "2.0"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            # stdout piped ONLY to observe the first checkpoint line (the
+            # SIGSTOP trigger); everything else is discarded — an
+            # un-drained 64K pipe fills within seconds at --verbose round
+            # rates and BLOCKS the writer, stalling the whole cluster
+            # (observed as zero rounds completing after the down)
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
             for _ in range(n)]
         victim = workers[-1]
+        drains = [threading.Thread(target=_drain, args=(w.stdout,),
+                                   daemon=True)
+                  for w in workers if w is not victim]
+        for t in drains:
+            t.start()
         try:
             # stop the victim only once it has demonstrably joined and
             # completed rounds: its first throughput checkpoint print
@@ -161,6 +177,10 @@ class TestSigstopCluster:
             line = victim.stdout.readline()
             assert line, "victim produced no output before exiting"
             os.kill(victim.pid, signal.SIGSTOP)
+            # a SIGSTOPped victim writes nothing more, but drain anyway so
+            # the SIGCONT in the teardown can't block it either
+            threading.Thread(target=_drain, args=(victim.stdout,),
+                             daemon=True).start()
             m_out, m_err = master.communicate(timeout=60)
             assert "downing unreachable peer" in m_err, (m_out, m_err)
             downs = re.findall(r"worker down at round (\d+)", m_out)

@@ -78,6 +78,7 @@ class TestGpipe:
                                    np.asarray(xm) * float(w.prod()),
                                    rtol=1e-6)
 
+    @pytest.mark.slow
     def test_gradients_through_pipeline(self):
         s, m = 4, 3
         mesh = pp_mesh(s)

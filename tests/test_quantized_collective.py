@@ -153,6 +153,7 @@ class TestInt8GradSync:
         assert err < 0.1 * scale
         assert err > 0  # it actually quantized
 
+    @pytest.mark.slow
     def test_masked_int8_close_to_masked_f32_with_exact_counts(self):
         """Lossy rounds keep the int8 wire: values within quantization
         error of the f32 masked path, counts EXACT (they ride a separate
@@ -186,6 +187,7 @@ class TestInt8GradSync:
         scale = np.abs(np.asarray(g32[0])).max()
         assert 0 < err < 0.1 * scale, (err, scale)
 
+    @pytest.mark.slow
     def test_masked_int8_zero_count_bucket_is_zero(self):
         """A bucket nobody contributes must come back exactly zero under
         int8 too (count-0 rescale gates it)."""

@@ -185,6 +185,7 @@ class TestDeadlineTrainerEndToEnd:
         assert trainer.reports[0].n_masked == 8
         assert trainer.reports[0].fell_back is True
 
+    @pytest.mark.slow
     def test_unreported_peer_is_cold_straggler(self):
         """A peer that never reports is masked (deathwatch analog:
         reference AllreduceMaster.scala:46-52) without stalling the
@@ -199,6 +200,7 @@ class TestDeadlineTrainerEndToEnd:
         assert int(metrics["min_bucket_count"]) == 7
         assert trainer.reports[0].valid_peers[7] is False
 
+    @pytest.mark.slow
     def test_pacer_bounds_inflight_rounds(self):
         """The maxLag window: with max_lag=2 the trainer never holds more
         than 3 unharvested rounds (the reference's ring depth,
