@@ -57,6 +57,9 @@ from akka_allreduce_tpu.ops.pallas_kernels.attention import (
     pick_flash_block,
 )
 from akka_allreduce_tpu.ops.pallas_kernels.dispatch import use_pallas
+from akka_allreduce_tpu.ops.pallas_kernels.ring_flash import (
+    ring_flash_attention,
+)
 from akka_allreduce_tpu.parallel.ring_attention import (
     blockwise_causal_attention,
     local_causal_attention,
@@ -91,11 +94,13 @@ class TrainConfig:
     # materialising the (T, T) score tensor — the rank-local long-context
     # path (must divide the local sequence length)
     attn_block_size: Optional[int] = None
-    # Single-rank attention implementation: "auto" consults the measured
-    # per-chip dispatch table (ops/pallas_kernels/dispatch.py) and runs the
-    # fused Pallas flash kernel on TPU; "flash" / "blockwise" / "local"
-    # force one. Ignored under sequence parallelism (sp > 1 always rides
-    # ring attention). attn_block_size doubles as the flash block size.
+    # Attention implementation: "auto" consults the measured per-chip
+    # dispatch table (ops/pallas_kernels/dispatch.py) — on TPU that means
+    # the fused Pallas flash kernel, and under sequence parallelism
+    # (sp > 1) the ring-flash variant (ops/pallas_kernels/ring_flash.py);
+    # "flash" forces the kernels, "blockwise"/"local" force the pure-JAX
+    # paths (under sp both select the pure-JAX ring).
+    # attn_block_size doubles as the flash block size.
     attn_impl: str = "auto"
 
 
@@ -273,6 +278,37 @@ def select_local_attention(cfg: TrainConfig):
     return local_causal_attention
 
 
+def select_ring_attention(cfg: TrainConfig):
+    """Sequence-parallel attention per ``cfg.attn_impl``: on TPU "auto"
+    (or "flash") runs ring flash attention — the fused Pallas block
+    kernels inside the ppermute ring, rotating the NARROW (GQA) K/V —
+    with "auto" falling back to the pure-JAX ring for untileable local
+    lengths and forced "flash" raising (same contract as the sp=1 path);
+    "blockwise"/"local" (and CPU "auto") keep the pure-JAX ring, which
+    remains the oracle."""
+    impl = cfg.attn_impl
+    if impl not in ("auto", "flash", "blockwise", "local"):
+        raise ValueError(f"unknown attn_impl {impl!r}")
+    auto = impl == "auto"
+    if not (impl == "flash" or (auto and use_pallas("ring_flash"))):
+        return partial(ring_attention, axis_name="sp", causal=True)
+    interpret = jax.default_backend() != "tpu"
+    want = cfg.attn_block_size or 512
+
+    def ring_or_fallback(q, k, v):
+        blk = pick_flash_block(q.shape[1], want)
+        if blk is None:
+            if not auto:
+                raise ValueError(
+                    f"attn_impl='flash': no legal flash block for local "
+                    f"sequence {q.shape[1]} (want <= {want})")
+            return ring_attention(q, k, v, axis_name="sp", causal=True)
+        return ring_flash_attention(q, k, v, "sp", True, blk, blk,
+                                    interpret)
+
+    return ring_or_fallback
+
+
 def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                    valid_buckets: Optional[jnp.ndarray] = None,
                    dynamic_valid: bool = False):
@@ -345,7 +381,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         return targets, weights, positions
 
     if has_sp:
-        attn = partial(ring_attention, axis_name="sp", causal=True)
+        attn = select_ring_attention(cfg)
     else:
         attn = select_local_attention(cfg)
 

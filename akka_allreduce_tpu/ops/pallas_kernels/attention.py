@@ -40,11 +40,46 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _causal_mask(iq, ik, blk_q, blk_k):
-    """(blk_q, blk_k) bool: query position >= key position."""
-    q_pos = iq * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-    k_pos = ik * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+def _causal_mask(iq, ik, blk_q, blk_k, q_off=0, k_off=0):
+    """(blk_q, blk_k) bool: query position >= key position. Offsets shift
+    into GLOBAL sequence positions (ring_flash.py passes traced SMEM
+    scalars; the local kernels use in-array positions)."""
+    q_pos = q_off + iq * blk_q + lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 0)
+    k_pos = k_off + ik * blk_k + lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 1)
     return q_pos >= k_pos
+
+
+def _softmax_tile(q, k, v, m_prev, l_prev, acc_prev, mask, scale):
+    """One online-softmax accumulation tile (shared by the local forward
+    kernel and the ring step kernel — ONE copy of the flash numerics).
+    m/l: (blk_q, 1) f32; acc: (blk_q, D) f32; mask None = unmasked."""
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    corr = jnp.exp(jnp.minimum(m_prev, m_new) - m_new)  # no inf-inf NaN
+    p = jnp.exp(s - m_new)  # masked lanes: exp(NEG_INF - m) == 0
+    l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+    pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_prev * corr + pv
+
+
+def _bwd_tile(q, k, v, do, lse, delta, mask, scale):
+    """Recompute-from-LSE probabilities and score gradients for one tile
+    (shared by the local and ring backward kernels): returns (p, ds) with
+    p = softmax tile, ds = dL/dscores * scale."""
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse)  # masked lanes exactly 0
+    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    return p, p * (dp - delta) * scale
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -65,22 +100,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0, :, :]  # (blk_q, D)
-        k = k_ref[0, 0, :, :]  # (blk_k, D)
-        v = v_ref[0, 0, :, :]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = jnp.where(_causal_mask(iq, ik, blk_q, blk_k), s, NEG_INF)
-        m_prev = m_scr[:, 0:1]  # (blk_q, 1)
-        l_prev = l_scr[:, 0:1]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        corr = jnp.exp(m_prev - m_new)  # m_prev <= m_new: no overflow
-        p = jnp.exp(s - m_new)  # masked lanes: exp(NEG_INF - m) == 0
-        l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
-        pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        acc_scr[:] = acc_scr[:] * corr + pv
+        mask = _causal_mask(iq, ik, blk_q, blk_k) if causal else None
+        m_new, l_new, acc_new = _softmax_tile(
+            q_ref[0, 0, :, :], k_ref[0, 0, :, :], v_ref[0, 0, :, :],
+            m_scr[:, 0:1], l_scr[:, 0:1], acc_scr[:], mask, scale)
+        acc_scr[:] = acc_new
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -107,20 +131,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
-        v = v_ref[0, 0, :, :]
-        do = do_ref[0, 0, :, :]
-        lse = lse_ref[0, 0, :, :]  # (blk_q, 1)
-        delta = delta_ref[0, 0, :, :]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = jnp.where(_causal_mask(iq, ik, blk_q, blk_k), s, NEG_INF)
-        p = jnp.exp(s - lse)  # (blk_q, blk_k); masked lanes exactly 0
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        mask = _causal_mask(iq, ik, blk_q, blk_k) if causal else None
+        _, ds = _bwd_tile(q_ref[0, 0, :, :], k, v_ref[0, 0, :, :],
+                          do_ref[0, 0, :, :], lse_ref[0, 0, :, :],
+                          delta_ref[0, 0, :, :], mask, scale)
         dq_scr[:] += lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -153,24 +168,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(live)
     def _step():
         q = q_ref[0, 0, :, :]
-        k = k_ref[0, 0, :, :]
-        v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        lse = lse_ref[0, 0, :, :]
-        delta = delta_ref[0, 0, :, :]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = jnp.where(_causal_mask(iq, ik, blk_q, blk_k), s, NEG_INF)
-        p = jnp.exp(s - lse)
-        # dv += p^T @ do        (blk_k, D)
+        mask = _causal_mask(iq, ik, blk_q, blk_k) if causal else None
+        p, ds = _bwd_tile(q, k_ref[0, 0, :, :], v_ref[0, 0, :, :], do,
+                          lse_ref[0, 0, :, :], delta_ref[0, 0, :, :],
+                          mask, scale)
+        # dv += p^T @ do;  dk += ds^T @ q      (both (blk_k, D))
         dv_scr[:] += lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        # dk += ds^T @ q        (blk_k, D)
         dk_scr[:] += lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

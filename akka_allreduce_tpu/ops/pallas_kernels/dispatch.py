@@ -37,6 +37,14 @@ _TPU_DEFAULTS = {
     # local 12.5 vs blockwise-scan 6.7): the fused VMEM pass keeps the
     # score tile out of HBM in both directions. Default on TPU: pallas.
     "flash_attention": True,
+    # ring flash attention (ops/pallas_kernels/ring_flash.py) — the ring
+    # INNER step is the same fused block computation the local A/B above
+    # measures (the ring only adds ppermute rotation between steps), so
+    # the local 3.6x win carries; semantics are oracle-pinned on the CPU
+    # mesh (tests/test_ring_flash.py) and the kernels' Mosaic lowering is
+    # verified on this repo's real chip at sp=1. No multi-chip hardware
+    # exists here to A/B the rotated path itself. Default on TPU: pallas.
+    "ring_flash": True,
 }
 
 
