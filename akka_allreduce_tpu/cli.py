@@ -26,6 +26,7 @@ Run as ``python -m akka_allreduce_tpu.cli <subcommand> [flags]``.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 
@@ -263,11 +264,10 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "init — for tests and CPU-mesh rehearsals")
 
 
-def _add_generate(sub: argparse._SubParsersAction) -> None:
-    p = sub.add_parser(
-        "generate", help="decode from a trained checkpoint (KV-cache "
-        "incremental decoding, models/generate.py)")
-    p.add_argument("--ckpt-dir", required=True)
+
+def _add_model_args(p: argparse.ArgumentParser) -> None:
+    """Model-shape flags shared by every checkpoint-consuming command
+    (generate/eval must describe the trained model exactly)."""
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--n-heads", type=int, default=4)
@@ -281,15 +281,77 @@ def _add_generate(sub: argparse._SubParsersAction) -> None:
                         "positional table")
     p.add_argument("--ffn", choices=("gelu", "swiglu"), default="gelu",
                    help="dense FF flavor (swiglu = Llama-style gated FF)")
+    p.add_argument("--moe-experts", type=int, default=0)
+    p.add_argument("--moe-every", type=int, default=1)
+    p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--router-k", type=int, default=2)
+
+
+def _build_model_config(args: argparse.Namespace, max_seq: int):
+    """args (as declared by _add_model_args) -> TransformerConfig."""
+    from akka_allreduce_tpu.models.transformer import TransformerConfig
+
+    moe = None
+    if args.moe_experts:
+        from akka_allreduce_tpu.parallel.ep import MoEConfig
+        moe = MoEConfig(n_experts=args.moe_experts, d_ff=args.d_ff,
+                        capacity_factor=args.capacity_factor,
+                        router_k=args.router_k)
+    return TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff, max_seq=max_seq,
+        moe=moe, moe_every=args.moe_every,
+        n_kv_heads=args.kv_heads or None, rope=args.rope, ffn=args.ffn)
+
+
+def _restore_params(args: argparse.Namespace, mcfg) -> "tuple | int":
+    """Build a 1-device state and restore args.ckpt_dir into it. Returns
+    (step0, params) or an exit code int on failure (message printed)."""
+    import jax
+
+    from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                 make_train_state)
+    from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+    from akka_allreduce_tpu.runtime.checkpoint import (CheckpointConfig,
+                                                       restore_or_init)
+
+    cfg = TrainConfig(model=mcfg)
+    mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    # NOTE: restores opt_state too (tripling restore I/O) — the installed
+    # orbax's StandardRestore has no per-leaf placeholder support for
+    # params-only partial restore (verified); acceptable at CLI scale.
+    params, opt_state, _opt = make_train_state(jax.random.key(0), cfg,
+                                               mesh)
+    try:
+        step0, params, _, _, mgr = restore_or_init(
+            CheckpointConfig(args.ckpt_dir), params, opt_state)
+    except Exception as e:
+        print(f"error: cannot restore {args.ckpt_dir} with the declared "
+              f"model shape (wrong --d-model/--vocab/--max-seq/...?): "
+              f"{e}", file=sys.stderr)
+        return 2
+    if mgr is not None:
+        mgr.close()  # restore-only use: release orbax's async machinery
+    if step0 == 0:
+        print(f"error: no checkpoint found in {args.ckpt_dir}",
+              file=sys.stderr)
+        return 2
+    print(f"restored step {step0 - 1} from {args.ckpt_dir}",
+          file=sys.stderr)
+    return step0, params
+
+
+def _add_generate(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "generate", help="decode from a trained checkpoint (KV-cache "
+        "incremental decoding, models/generate.py)")
+    p.add_argument("--ckpt-dir", required=True)
+    _add_model_args(p)
     p.add_argument("--max-seq", type=int, required=True,
                    help="the trained model's max_seq (= train's --seq): "
                         "the positional table's shape, which the "
                         "checkpoint restore must match; prompt + --tokens "
                         "must fit inside it")
-    p.add_argument("--moe-experts", type=int, default=0)
-    p.add_argument("--moe-every", type=int, default=1)
-    p.add_argument("--capacity-factor", type=float, default=1.25)
-    p.add_argument("--router-k", type=int, default=2)
     p.add_argument("--prompt", default=None,
                    help="text prompt, consumed byte-level (vocab 256 "
                         "models)")
@@ -318,12 +380,6 @@ def _cmd_generate(args: argparse.Namespace) -> int:
     import numpy as np
 
     from akka_allreduce_tpu.models.generate import generate
-    from akka_allreduce_tpu.models.train import (TrainConfig,
-                                                 make_train_state)
-    from akka_allreduce_tpu.models.transformer import TransformerConfig
-    from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
-    from akka_allreduce_tpu.runtime.checkpoint import (CheckpointConfig,
-                                                       restore_or_init)
 
     if (args.prompt is None) == (args.prompt_tokens is None):
         print("error: exactly one of --prompt / --prompt-tokens",
@@ -353,41 +409,11 @@ def _cmd_generate(args: argparse.Namespace) -> int:
         print(f"error: prompt ({len(ids)}) + --tokens ({args.tokens}) "
               f"exceeds --max-seq {max_seq}", file=sys.stderr)
         return 2
-    moe = None
-    if args.moe_experts:
-        from akka_allreduce_tpu.parallel.ep import MoEConfig
-        moe = MoEConfig(n_experts=args.moe_experts, d_ff=args.d_ff,
-                        capacity_factor=args.capacity_factor,
-                        router_k=args.router_k)
-    mcfg = TransformerConfig(vocab_size=args.vocab, d_model=args.d_model,
-                             n_heads=args.n_heads, n_layers=args.n_layers,
-                             d_ff=args.d_ff, max_seq=max_seq,
-                             moe=moe, moe_every=args.moe_every,
-                             n_kv_heads=args.kv_heads or None,
-                             rope=args.rope, ffn=args.ffn)
-    cfg = TrainConfig(model=mcfg)
-    mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
-    # NOTE: this restores opt_state too (tripling restore I/O) — the
-    # installed orbax's StandardRestore has no per-leaf placeholder
-    # support for params-only partial restore (verified); acceptable at
-    # CLI scale.
-    params, opt_state, _opt = make_train_state(jax.random.key(0), cfg, mesh)
-    try:
-        step0, params, _, _, mgr = restore_or_init(
-            CheckpointConfig(args.ckpt_dir), params, opt_state)
-    except Exception as e:
-        print(f"error: cannot restore {args.ckpt_dir} with the declared "
-              f"model shape (wrong --d-model/--vocab/--max-seq/...?): "
-              f"{e}", file=sys.stderr)
-        return 2
-    if mgr is not None:
-        mgr.close()  # restore-only use: release orbax's async machinery
-    if step0 == 0:
-        print(f"error: no checkpoint found in {args.ckpt_dir}",
-              file=sys.stderr)
-        return 2
-    print(f"restored step {step0 - 1} from {args.ckpt_dir}",
-          file=sys.stderr)
+    mcfg = _build_model_config(args, max_seq)
+    restored = _restore_params(args, mcfg)
+    if isinstance(restored, int):
+        return restored
+    _step0, params = restored
     prompt = jnp.asarray(np.asarray(ids, np.int32))[None]
     out = generate(params, prompt, mcfg, steps=args.tokens,
                    key=jax.random.key(args.seed),
@@ -637,6 +663,89 @@ def _cmd_bench(_args: argparse.Namespace) -> int:
     return 0
 
 
+
+def _add_eval(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "eval", help="held-out perplexity of a trained checkpoint over a "
+        "corpus (sequential non-overlapping windows, each token once)")
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--data-file", required=True,
+                   help="byte-level file or .bin uint16 token corpus")
+    _add_model_args(p)
+    p.add_argument("--max-seq", type=int, required=True,
+                   help="the trained model's max_seq (eval windows use it "
+                        "as the window length)")
+    p.add_argument("--batch", type=int, default=8,
+                   help="windows per device batch")
+    p.add_argument("--max-windows", type=int, default=0,
+                   help="stop after this many windows (0 = whole corpus)")
+    p.add_argument("--platform", default=None)
+
+
+def _cmd_eval(args: argparse.Namespace) -> int:
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import math
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.data import eval_batches, load_corpus
+    from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                 select_local_attention)
+    from akka_allreduce_tpu.models.transformer import (
+        next_token_loss_and_aux)
+
+    try:
+        corpus = load_corpus(args.data_file)
+    except FileNotFoundError:
+        print(f"error: no such corpus {args.data_file}", file=sys.stderr)
+        return 2
+    mcfg = _build_model_config(args, args.max_seq)
+    restored = _restore_params(args, mcfg)
+    if isinstance(restored, int):
+        return restored
+    _step0, params = restored
+
+    attn = select_local_attention(TrainConfig(model=mcfg))
+
+    @jax.jit
+    def batch_loss(params, tokens):
+        # pure cross-entropy: next_token_loss folds the MoE load-balance
+        # aux into its sum, which would inflate perplexity for MoE
+        # checkpoints — eval must report the MODEL's predictive loss only
+        loss_sum, w_sum, _aux = next_token_loss_and_aux(
+            params, tokens, mcfg, attn_fn=attn)
+        ce_sum = loss_sum - _aux["aux_loss"] * w_sum
+        return ce_sum, w_sum
+
+    ce_total, tok_total, windows = 0.0, 0.0, 0
+    for arr in eval_batches(corpus, args.batch, args.max_seq):
+        if args.max_windows and windows >= args.max_windows:
+            break
+        if args.max_windows:
+            arr = arr[:args.max_windows - windows]
+        loss_sum, w_sum = batch_loss(params, jnp.asarray(arr))
+        ce_total += float(loss_sum)
+        tok_total += float(w_sum)
+        windows += arr.shape[0]
+        print(f"eval: {windows} windows, {int(tok_total)} tokens",
+              file=sys.stderr)
+    if tok_total == 0:
+        print("error: corpus smaller than one window", file=sys.stderr)
+        return 2
+    nats = ce_total / tok_total
+    out = {"windows": windows, "tokens": int(tok_total),
+           "ce_nats_per_token": round(nats, 6),
+           "perplexity": round(math.exp(nats), 4)}
+    if corpus.vocab_size == 256:
+        out["bits_per_byte"] = round(nats / math.log(2), 6)
+    print(json.dumps(out))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="akka_allreduce_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -645,12 +754,13 @@ def main(argv: list[str] | None = None) -> int:
     _add_worker(sub)
     _add_train(sub)
     _add_generate(sub)
+    _add_eval(sub)
     sub.add_parser("info", help="topology summary")
     sub.add_parser("bench", help="device-plane goodput benchmark")
     args = parser.parse_args(argv)
     return {"emulate": _cmd_emulate, "master": _cmd_master,
             "worker": _cmd_worker, "train": _cmd_train,
-            "generate": _cmd_generate,
+            "generate": _cmd_generate, "eval": _cmd_eval,
             "info": _cmd_info, "bench": _cmd_bench}[args.cmd](args)
 
 

@@ -73,6 +73,21 @@ class TokenCorpus:
         return np.asarray(self.tokens[idx], dtype=np.int32)
 
 
+def eval_batches(corpus: TokenCorpus, batch: int, seq: int):
+    """Yield (batch, seq) int32 arrays tiling the corpus ONCE, in order —
+    the held-out evaluation regime (training draws random windows with
+    replacement; perplexity over a fixed set must see each token once).
+    Windows are non-overlapping and contiguous, so each group is a plain
+    memmap slice — O(batch * seq) resident memory regardless of corpus
+    size; the final partial GROUP of windows is yielded at its smaller
+    batch size (one extra compile at the tail)."""
+    n_windows = len(corpus.tokens) // seq
+    for lo in range(0, n_windows, batch):
+        hi = min(lo + batch, n_windows)
+        yield np.asarray(corpus.tokens[lo * seq:hi * seq],
+                         dtype=np.int32).reshape(hi - lo, seq)
+
+
 def load_corpus(path: str) -> TokenCorpus:
     """Open a corpus file.
 

@@ -247,11 +247,6 @@ def _kl(x):
     return jnp.swapaxes(x, 1, 2)
 
 
-def _ring_scan(axis_name, n_steps, body, carry):
-    """lax.scan over ring steps (compiler-friendly: one traced body)."""
-    return lax.scan(body, carry, jnp.arange(n_steps))
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def ring_flash_attention(q, k, v, axis_name="sp", causal=True,
                          block_q=128, block_k=128, interpret=False):
@@ -303,8 +298,8 @@ def _ring_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret):
         vb = lax.ppermute(vb, axis_name, perm)
         return (m, l, acc, kb, vb), None
 
-    (m, l, acc, _, _), _ = _ring_scan(axis_name, n, step,
-                                      (m0, l0, acc0, kt, vt))
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, kt, vt),
+                                    jnp.arange(n))
     o = (acc / l).astype(q.dtype)  # causal rows see their own position
     lse = m + jnp.log(l)
     return jnp.swapaxes(o, 1, 2), (qt, kt, vt, o, lse)
@@ -359,8 +354,8 @@ def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, res,
         dvb = lax.ppermute(dvb, axis_name, perm)
         return (dq, kb, vb, dkb, dvb), None
 
-    (dq, _, _, dk, dv), _ = _ring_scan(axis_name, n, step,
-                                       (dq0, kt, vt, dk0, dv0))
+    (dq, _, _, dk, dv), _ = lax.scan(step, (dq0, kt, vt, dk0, dv0),
+                                     jnp.arange(n))
     out = (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
            jnp.swapaxes(dv, 1, 2))
     return tuple(g.astype(t_.dtype) for g, t_ in

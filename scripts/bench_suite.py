@@ -103,8 +103,56 @@ def main() -> int:
 
     ab_pallas_vs_xla()
     ab_flash_attention()
+    ab_moe_dispatch()
     mfu_lines()
     return 0
+
+
+def ab_moe_dispatch():
+    """A/B the MoE dispatch formulations (parallel/ep.py) at a
+    long-context token count — the measurement behind MoEConfig.dispatch's
+    auto threshold. einsum materialises (N, E, C) one-hots (quadratic in
+    N); scatter routes by slot indices (linear)."""
+    import jax
+    import jax.numpy as jnp
+
+    from akka_allreduce_tpu.parallel.ep import (MoEConfig, init_moe_layer,
+                                                moe_ffn)
+
+    plat = jax.devices()[0].platform
+    on_tpu = plat == "tpu"
+    d = 512 if on_tpu else 64
+    n_tok = 8192 if on_tpu else 512
+    d_ff = 2048 if on_tpu else 128
+    n_bufs = 2
+    xs = [(jax.random.normal(jax.random.key(i), (1, n_tok, d),
+                             jnp.bfloat16),) for i in range(n_bufs)]
+    results = {}
+    for disp in ("einsum", "scatter"):
+        cfg = MoEConfig(n_experts=8, d_ff=d_ff, capacity_factor=1.25,
+                        router_k=2, dispatch=disp)
+        params = init_moe_layer(jax.random.key(1), d, cfg,
+                                dtype=jnp.bfloat16)
+
+        def fwd_bwd(x, c):
+            def loss(p, x):
+                y, _ = moe_ffn(x, p, cfg, axis_name=None)
+                return jnp.sum(y.astype(jnp.float32) * 1e-3) + c
+            val, g = jax.value_and_grad(loss)(params, x)
+            val = val + sum(
+                jnp.sum(l.astype(jnp.float32)[..., :1]) * 1e-9
+                for l in jax.tree.leaves(g))
+            return val, g
+
+        t = _time_device_fn(jax.jit(fwd_bwd), xs,
+                            k_hi=40 if on_tpu else 8,
+                            k_lo=10 if on_tpu else 2)
+        results[disp] = t * 1e3
+        emit(f"ab_moe_dispatch_{disp}_{plat}", t * 1e3, "ms/step",
+             f"fwd+bwd, N={n_tok} tokens, E=8, d_ff={d_ff}, bf16")
+    if on_tpu:
+        win = min(results, key=results.get)
+        emit("ab_moe_dispatch_winner", results[win], "ms/step", win)
 
 
 def ab_flash_attention():

@@ -1,5 +1,7 @@
 """Input pipeline tests: determinism, resumability, corpus formats."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -62,3 +64,52 @@ class TestFormats:
     def test_missing_file(self):
         with pytest.raises(FileNotFoundError):
             load_corpus("/nonexistent/corpus.bin")
+
+
+class TestEvalBatches:
+    def test_tiles_corpus_once_in_order(self):
+        from akka_allreduce_tpu.data import eval_batches, synthetic_corpus
+        corpus = synthetic_corpus(61, length=1000, seed=1)
+        seen = []
+        shapes = []
+        for arr in eval_batches(corpus, batch=3, seq=64):
+            shapes.append(arr.shape)
+            seen.append(arr.reshape(-1))
+        flat = np.concatenate(seen)
+        n_windows = 1000 // 64
+        assert len(flat) == n_windows * 64
+        np.testing.assert_array_equal(
+            flat, np.asarray(corpus.tokens[:n_windows * 64], np.int32))
+        # all groups full batch except possibly the last
+        assert all(s == (3, 64) for s in shapes[:-1])
+        assert shapes[-1][0] == n_windows - 3 * (len(shapes) - 1)
+
+
+class TestEvalCli:
+    @pytest.mark.slow
+    def test_train_then_eval_reports_perplexity(self, tmp_path):
+        import json as _json
+        import subprocess
+        import sys as _sys
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_bytes(b"the quick brown fox jumps over the lazy dog "
+                           * 200)
+        ck = tmp_path / "ckpt"
+        env = dict(os.environ)
+        train = subprocess.run(
+            [_sys.executable, "-m", "akka_allreduce_tpu.cli", "train",
+             "--steps", "3", "--seq", "32", "--data-file", str(corpus),
+             "--ckpt-dir", str(ck), "--platform", "cpu"],
+            capture_output=True, text=True, env=env)
+        assert train.returncode == 0, train.stderr
+        ev = subprocess.run(
+            [_sys.executable, "-m", "akka_allreduce_tpu.cli", "eval",
+             "--ckpt-dir", str(ck), "--data-file", str(corpus),
+             "--max-seq", "32", "--max-windows", "20",
+             "--platform", "cpu"],
+            capture_output=True, text=True, env=env)
+        assert ev.returncode == 0, ev.stderr
+        out = _json.loads(ev.stdout.strip().splitlines()[-1])
+        assert out["windows"] == 20
+        assert out["perplexity"] > 1.0
+        assert 0 < out["bits_per_byte"] < 16
