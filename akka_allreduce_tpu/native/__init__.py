@@ -15,7 +15,8 @@ import subprocess
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_lib", "libaatpu.so")
-_SRC = os.path.join(_DIR, "src", "transport.cpp")
+_SRCS = [os.path.join(_DIR, "src", f)
+         for f in ("transport.cpp", "cluster.cpp")]
 
 _lib: ctypes.CDLL | None = None
 
@@ -26,7 +27,8 @@ def build_library(force: bool = False) -> str:
     renames, so simultaneous cold starts (the multi-process cluster) never
     load a partially-written .so. Returns the .so path."""
     makefile = os.path.join(_DIR, "Makefile")
-    src_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(makefile))
+    src_mtime = max([os.path.getmtime(s) for s in _SRCS]
+                    + [os.path.getmtime(makefile)])
     stale = (not os.path.exists(_SO)
              or os.path.getmtime(_SO) < src_mtime)
     if force or stale:
@@ -83,6 +85,12 @@ def load_library() -> ctypes.CDLL:
     lib.aat_num_connected.argtypes = [ctypes.c_void_p]
     lib.aat_destroy.restype = None
     lib.aat_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.aat_cluster_run.restype = ctypes.c_long
+    lib.aat_cluster_run.argtypes = [
+        ctypes.c_int, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_long)]
 
     _lib = lib
     return lib

@@ -1,0 +1,477 @@
+// In-process native protocol cluster: master + N workers on one FIFO
+// message queue, the C++ rendering of protocol/{master,worker}.py +
+// buffers/* (which are themselves the behavioral port of the reference's
+// Akka actors — AllreduceMaster.scala, AllreduceWorker.scala,
+// buffer/*.scala). The Python engine remains the SPEC (every rule pinned
+// by tests/test_protocol_worker.py); this engine exists because the
+// reference's runtime is JVM-native while ours would otherwise be
+// interpreted Python — the protocol-bound benchmark regime (tiny
+// payloads, README config) measures the runtime, and a native runtime is
+// what the reference brings to that fight.
+//
+// Semantics mirrored exactly (SURVEY.md §3a):
+//  * block ownership: step = ceil(dataSize/N), last block short/empty
+//  * chunking: ceil(block/maxChunk) wire chunks
+//  * thresholds: scatter gate max(1, int(thReduce*peers)), fired on ==
+//    (exactly once); completion gate clamp(int(thComplete*totalChunks)),
+//    fired on ==; master gate numComplete >= totalWorkers*thAllreduce
+//  * maxLag ring of maxLag+1 rows; catch-up force-completes stale rounds
+//  * stale drops (round < current or already completed); future rounds
+//    requeue behind a self-sent StartAllreduce
+//  * rank-staggered fan-out (i+id)%N with self-delivery bypass
+//  * count piggyback on ReduceBlock; flush zero-fills missing chunks and
+//    expands chunk counts to elements
+//  * deathwatch: a killed worker vanishes from the master's tally and
+//    every peer map; thresholds then tolerate the gap
+//
+// Build: part of libaatpu.so (native/Makefile). C ABI at the bottom.
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <set>
+#include <vector>
+
+namespace {
+
+struct Msg {
+    enum Type { kStart, kScatter, kReduce, kComplete } type;
+    int dest;   // worker rank, or -1 = master
+    int round;
+    int src;
+    int chunk;
+    int count;              // ReduceBlock piggyback
+    std::vector<float> payload;
+};
+
+struct Ring {
+    // maxLag-deep ring of [peer][element] staging rows with chunk counts
+    // (buffers/base.py; reference: AllReduceBuffer.scala:3-47)
+    int data_size = 0, peers = 0, depth = 1, chunk = 1, nchunks = 0;
+    int offset = 0;
+    std::vector<float> buf;       // depth * peers * data_size
+    std::vector<int64_t> filled;  // depth * nchunks
+    std::vector<int64_t> total;   // depth
+
+    void init(int ds, int p, int d, int c) {
+        data_size = ds; peers = p; depth = d; chunk = c;
+        nchunks = ds > 0 ? (ds + c - 1) / c : 0;
+        offset = 0;
+        buf.assign((size_t)depth * peers * (size_t)ds, 0.f);
+        filled.assign((size_t)depth * (nchunks ? nchunks : 1), 0);
+        total.assign(depth, 0);
+    }
+    int tidx(int row) const { return (row + offset) % depth; }
+    float* row_ptr(int t, int peer) {
+        return buf.data() + ((size_t)t * peers + peer) * data_size;
+    }
+    bool store(const float* data, size_t len, int row, int src, int cid) {
+        long start = (long)cid * chunk;
+        if (start < 0 || start + (long)len > data_size || src < 0 ||
+            src >= peers)
+            return false;  // python raises IndexError; count NOT bumped
+        int t = tidx(row);
+        std::memcpy(row_ptr(t, src) + start, data, len * sizeof(float));
+        filled[(size_t)t * nchunks + cid] += 1;
+        total[t] += 1;
+        return true;
+    }
+    void up() {
+        offset = (offset + 1) % depth;
+        int t = tidx(depth - 1);
+        std::memset(row_ptr(t, 0), 0,
+                    (size_t)peers * data_size * sizeof(float));
+        std::fill(filled.begin() + (size_t)t * nchunks,
+                  filled.begin() + (size_t)(t + 1) * nchunks, 0);
+        total[t] = 0;
+    }
+};
+
+struct Cluster;
+
+struct Worker {
+    Cluster* cl = nullptr;
+    int id = -1;
+    int peer_num = 0;
+    double th_reduce = 1.0, th_complete = 1.0;
+    int max_lag = 0;
+    int round = -1, max_round = -1, max_scattered = -1;
+    std::set<int> completed;
+
+    long data_size = 0;
+    int max_chunk = 1024;
+    std::vector<std::pair<long, long>> ranges;
+    long my_block = 0, max_block = 0;
+
+    Ring scatter_buf;   // my block: peers' scattered chunks
+    Ring reduce_buf;    // all owners' reduced chunks (+ counts)
+    std::vector<int> reduce_counts;  // depth * peers * nchunks piggyback
+    int scatter_gate = 0;            // max(1, int(th_reduce * peers))
+    long completion_gate = 0;        // clamp(int(th_complete * total))
+    long total_chunks = 0;
+
+    // scratch
+    std::vector<float> out_data;
+    std::vector<int> out_counts;
+
+    void init(Cluster* c, int rank);
+    void on_start(int r);
+    void on_scatter(const Msg& m);
+    void on_reduce(const Msg& m);
+    void scatter_round(int r);
+    void broadcast(const float* data, size_t len, int cid, int r, int cnt);
+    void complete(int r, int row);
+    void flush(int r, int row);
+};
+
+struct Cluster {
+    // config
+    int n = 0;
+    long data_size = 0;
+    int max_chunk = 1, max_lag = 0, max_round = 0;
+    double th_reduce = 1, th_complete = 1, th_allreduce = 1;
+    int assert_multiple = 0;
+
+    // runtime
+    std::deque<Msg> queue;
+    std::vector<Worker> workers;
+    std::vector<char> alive;
+    std::vector<float> source;     // constant arange input, shared
+    long outputs_flushed = 0;
+    bool failed = false;           // sink assertion tripped
+
+    // master state (protocol/master.py)
+    int m_round = -1;
+    int m_num_complete = 0;
+    long rounds_completed = 0;
+
+    void send(int dest, Msg&& m) {
+        m.dest = dest;
+        queue.emplace_back(std::move(m));
+    }
+
+    void master_on_complete(const Msg& m) {
+        if (m.round != m_round) return;  // stale completion dropped
+        m_num_complete += 1;
+        if ((double)m_num_complete >= n * th_allreduce &&
+            m_round < max_round) {
+            rounds_completed += 1;
+            m_round += 1;
+            start_round();
+        }
+    }
+    void start_round() {
+        m_num_complete = 0;
+        for (int i = 0; i < n; ++i)
+            if (alive[i]) {
+                Msg s; s.type = Msg::kStart; s.round = m_round;
+                send(i, std::move(s));
+            }
+    }
+    void kill(int rank) {
+        // deathwatch: master tally and every peer map drop the rank
+        // (reference: AllreduceMaster.scala:46-52,
+        //  AllreduceWorker.scala:141-146)
+        alive[rank] = 0;
+    }
+
+    void deliver(Msg& m) {
+        if (m.dest == -1) { master_on_complete(m); return; }
+        if (!alive[m.dest]) return;  // dead letter
+        Worker& w = workers[m.dest];
+        switch (m.type) {
+            case Msg::kStart:   w.on_start(m.round); break;
+            case Msg::kScatter: w.on_scatter(m); break;
+            case Msg::kReduce:  w.on_reduce(m); break;
+            default: break;
+        }
+    }
+
+    long run(int kill_rank) {
+        source.resize(data_size);
+        for (long i = 0; i < data_size; ++i) source[i] = (float)i;
+        workers.resize(n);
+        alive.assign(n, 1);
+        for (int i = 0; i < n; ++i) workers[i].init(this, i);
+        // quorum formed: init is constructor state here; start round 0
+        m_round = 0;
+        start_round();
+        if (kill_rank >= 0 && kill_rank < n) kill(kill_rank);
+
+        // runaway cap scaled to the workload (protocol/cluster.py
+        // _message_budget)
+        long chunks = workers.empty() ? 1
+            : (workers[0].max_block + max_chunk - 1) / max_chunk;
+        if (chunks < 1) chunks = 1;
+        long per_round = (long)n * n * 2 * chunks + 4L * n;
+        long budget = 16L * per_round * (max_round + max_lag + 2);
+        if (budget < 1000000L) budget = 1000000L;
+
+        while (!queue.empty() && budget-- > 0 && !failed) {
+            Msg m = std::move(queue.front());
+            queue.pop_front();
+            deliver(m);
+        }
+        return failed ? -1 : rounds_completed;
+    }
+};
+
+void Worker::init(Cluster* c, int rank) {
+    cl = c;
+    id = rank;
+    peer_num = c->n;
+    th_reduce = c->th_reduce;
+    th_complete = c->th_complete;
+    max_lag = c->max_lag;
+    round = 0;
+    max_round = -1;
+    max_scattered = -1;
+    data_size = c->data_size;
+    max_chunk = c->max_chunk;
+
+    long step = data_size > 0
+        ? (data_size + peer_num - 1) / peer_num : 0;
+    ranges.clear();
+    for (int i = 0; i < peer_num; ++i) {
+        long lo = step > 0 ? std::min((long)i * step, data_size)
+                           : data_size;
+        long hi = step > 0 ? std::min((long)(i + 1) * step, data_size)
+                           : data_size;
+        if (lo > data_size) { lo = data_size; hi = data_size; }
+        ranges.emplace_back(lo, hi);
+    }
+    my_block = ranges[id].second - ranges[id].first;
+    max_block = ranges[0].second - ranges[0].first;
+
+    scatter_buf.init((int)my_block, peer_num, max_lag + 1, max_chunk);
+    scatter_gate = peer_num > 0
+        ? std::max(1, (int)(th_reduce * peer_num)) : 0;
+
+    reduce_buf.init((int)max_block, peer_num, max_lag + 1, max_chunk);
+    reduce_counts.assign(
+        (size_t)(max_lag + 1) * peer_num *
+            (reduce_buf.nchunks ? reduce_buf.nchunks : 1), 0);
+    total_chunks = 0;
+    for (int i = 0; i < peer_num; ++i) {
+        long blk = ranges[i].second - ranges[i].first;
+        if (blk > 0) total_chunks += (blk + max_chunk - 1) / max_chunk;
+    }
+    long gate = (long)(th_complete * total_chunks);
+    completion_gate = total_chunks > 0
+        ? std::min(std::max(1L, gate), total_chunks) : 0;
+
+    out_data.resize(data_size);
+    out_counts.resize(data_size);
+}
+
+void Worker::on_start(int r) {
+    if (r > max_round) max_round = r;
+    // catch-up: force-complete rounds fallen out of the maxLag window
+    // (reference: AllreduceWorker.scala:100-106)
+    while (round < max_round - max_lag) {
+        for (int k = 0; k < scatter_buf.nchunks; ++k) {
+            long start = (long)k * max_chunk;
+            long end = std::min(my_block, start + max_chunk);
+            int t = scatter_buf.tidx(0);
+            std::vector<float> red((size_t)(end - start), 0.f);
+            for (int p = 0; p < peer_num; ++p) {
+                const float* row = scatter_buf.row_ptr(t, p);
+                for (long e = start; e < end; ++e)
+                    red[e - start] += row[e];
+            }
+            int cnt = (int)scatter_buf.filled[(size_t)t *
+                                              scatter_buf.nchunks + k];
+            broadcast(red.data(), red.size(), k, round, cnt);
+        }
+        complete(round, 0);
+    }
+    // pipeline scatters up to the newest round
+    while (max_scattered < max_round) {
+        scatter_round(max_scattered + 1);
+        max_scattered += 1;
+    }
+    // prune completions below the window
+    for (auto it = completed.begin(); it != completed.end();)
+        it = (*it < round) ? completed.erase(it) : ++it;
+}
+
+void Worker::scatter_round(int r) {
+    // rank-staggered fan-out, self-delivery bypass
+    // (reference: AllreduceWorker.scala:212-238)
+    for (int i = 0; i < peer_num; ++i) {
+        int idx = (i + id) % peer_num;
+        if (!cl->alive[idx]) continue;
+        long lo = ranges[idx].first, hi = ranges[idx].second;
+        long blk = hi - lo;
+        long nch = blk > 0 ? (blk + max_chunk - 1) / max_chunk : 0;
+        for (long c = 0; c < nch; ++c) {
+            long cs = c * max_chunk;
+            long ce = std::min(blk, cs + max_chunk);
+            Msg m; m.type = Msg::kScatter; m.round = r; m.src = id;
+            m.chunk = (int)c;
+            m.payload.assign(cl->source.begin() + lo + cs,
+                             cl->source.begin() + lo + ce);
+            if (idx == id) { m.dest = id; on_scatter(m); }
+            else cl->send(idx, std::move(m));
+        }
+    }
+}
+
+void Worker::on_scatter(const Msg& m) {
+    if (m.round < round || completed.count(m.round)) return;  // stale
+    if (m.round <= max_round) {
+        int row = m.round - round;
+        if (!scatter_buf.store(m.payload.data(), m.payload.size(), row,
+                               m.src, m.chunk))
+            return;
+        int t = scatter_buf.tidx(row);
+        if (scatter_buf.filled[(size_t)t * scatter_buf.nchunks + m.chunk]
+            == scatter_gate) {  // == : exactly-once fire
+            long start = (long)m.chunk * max_chunk;
+            long end = std::min(my_block, start + max_chunk);
+            std::vector<float> red((size_t)(end - start), 0.f);
+            for (int p = 0; p < peer_num; ++p) {
+                const float* rowp = scatter_buf.row_ptr(t, p);
+                for (long e = start; e < end; ++e)
+                    red[e - start] += rowp[e];
+            }
+            broadcast(red.data(), red.size(), m.chunk, m.round,
+                      scatter_gate);
+        }
+    } else {
+        // not started for this round yet: requeue behind a self Start
+        Msg s; s.type = Msg::kStart; s.round = m.round;
+        cl->send(id, std::move(s));
+        Msg copy = m;
+        cl->send(id, std::move(copy));
+    }
+}
+
+void Worker::broadcast(const float* data, size_t len, int cid, int r,
+                       int cnt) {
+    for (int i = 0; i < peer_num; ++i) {
+        int idx = (i + id) % peer_num;
+        if (!cl->alive[idx]) continue;
+        Msg m; m.type = Msg::kReduce; m.round = r; m.src = id;
+        m.chunk = cid; m.count = cnt;
+        m.payload.assign(data, data + len);
+        if (idx == id) { m.dest = id; on_reduce(m); }
+        else cl->send(idx, std::move(m));
+    }
+}
+
+void Worker::on_reduce(const Msg& m) {
+    if ((long)m.payload.size() > max_chunk) return;  // guard (strict=no)
+    if (m.round < round || completed.count(m.round)) return;  // stale
+    if (m.round <= max_round) {
+        int row = m.round - round;
+        if (!reduce_buf.store(m.payload.data(), m.payload.size(), row,
+                              m.src, m.chunk))
+            return;
+        int t = reduce_buf.tidx(row);
+        reduce_counts[((size_t)t * peer_num + m.src) *
+                      reduce_buf.nchunks + m.chunk] = m.count;
+        if (reduce_buf.total[t] == completion_gate)  // == : exactly once
+            complete(m.round, row);
+    } else {
+        Msg s; s.type = Msg::kStart; s.round = m.round;
+        cl->send(id, std::move(s));
+        Msg copy = m;
+        cl->send(id, std::move(copy));
+    }
+}
+
+void Worker::complete(int r, int row) {
+    flush(r, row);
+    Msg c; c.type = Msg::kComplete; c.round = r; c.src = id;
+    cl->send(-1, std::move(c));
+    completed.insert(r);
+    if (round == r) {
+        for (;;) {
+            round += 1;
+            scatter_buf.up();
+            reduce_buf.up();
+            // retire the rotated-out reduce_counts row
+            int t = reduce_buf.tidx(max_lag);
+            std::fill(reduce_counts.begin() +
+                          (size_t)t * peer_num * reduce_buf.nchunks,
+                      reduce_counts.begin() +
+                          (size_t)(t + 1) * peer_num * reduce_buf.nchunks,
+                      0);
+            if (!completed.count(round)) break;
+        }
+    }
+}
+
+void Worker::flush(int r, int row) {
+    // reassemble output + per-element counts, zero-filling missing chunks
+    // (reference: ReducedDataBuffer.scala:26-53)
+    (void)r;
+    int t = reduce_buf.tidx(row);
+    long transferred = 0, count_transferred = 0;
+    for (int i = 0; i < peer_num; ++i) {
+        const float* block = reduce_buf.row_ptr(t, i);
+        long bs = std::min(data_size - transferred, max_block);
+        if (bs > 0)
+            std::memcpy(out_data.data() + transferred, block,
+                        (size_t)bs * sizeof(float));
+        for (int j = 0; j < reduce_buf.nchunks; ++j) {
+            long csz = std::min((long)max_chunk,
+                                max_block - (long)max_chunk * j);
+            long take = std::min(data_size - count_transferred, csz);
+            if (take <= 0) break;
+            int cnt = reduce_counts[((size_t)t * peer_num + i) *
+                                    reduce_buf.nchunks + j];
+            std::fill(out_counts.begin() + count_transferred,
+                      out_counts.begin() + count_transferred + take, cnt);
+            count_transferred += take;
+        }
+        transferred += bs;
+    }
+    cl->outputs_flushed += 1;
+    if (cl->assert_multiple > 0) {
+        // the reference's benchmark sink invariant: output == N x input,
+        // counts == N (valid when all thresholds are 1.0; reference:
+        // AllreduceWorker.scala:337-339)
+        int nmul = cl->assert_multiple;
+        for (long e = 0; e < data_size; ++e) {
+            if (out_data[e] != (float)e * nmul || out_counts[e] != nmul) {
+                cl->failed = true;
+                return;
+            }
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Run a full in-process cluster; returns rounds completed, or -1 when the
+// correctness assertion (assert_multiple > 0) failed. out_flushed (may be
+// null) receives the total number of sink flushes across workers.
+long aat_cluster_run(int workers, long data_size, int max_chunk_size,
+                     int max_lag, double th_reduce, double th_complete,
+                     double th_allreduce, int max_round, int kill_rank,
+                     int assert_multiple, long* out_flushed) {
+    if (workers <= 0 || data_size < 0 || max_chunk_size <= 0 ||
+        max_lag < 0 || max_round < 0)
+        return -2;
+    if (kill_rank >= workers)
+        return -2;  // no such seat (the python engine raises KeyError)
+    Cluster c;
+    c.n = workers;
+    c.data_size = data_size;
+    c.max_chunk = max_chunk_size;
+    c.max_lag = max_lag;
+    c.max_round = max_round;
+    c.th_reduce = th_reduce;
+    c.th_complete = th_complete;
+    c.th_allreduce = th_allreduce;
+    c.assert_multiple = assert_multiple;
+    long rounds = c.run(kill_rank);
+    if (out_flushed) *out_flushed = c.outputs_flushed;
+    return rounds;
+}
+
+}  // extern "C"

@@ -58,12 +58,40 @@ def protocol_rounds_per_sec(workers, data_size, max_chunk_size, max_lag,
     return rounds / dt, rounds, outputs
 
 
+def native_rounds_per_sec(workers, data_size, max_chunk_size, max_lag,
+                          th=(1.0, 1.0, 1.0), max_round=200,
+                          kill_rank=None):
+    from akka_allreduce_tpu.config import (AllreduceConfig, DataConfig,
+                                           ThresholdConfig, WorkerConfig)
+    from akka_allreduce_tpu.protocol.native_cluster import (
+        run_native_cluster)
+
+    config = AllreduceConfig(
+        thresholds=ThresholdConfig(*th),
+        data=DataConfig(data_size=data_size, max_chunk_size=max_chunk_size,
+                        max_round=max_round),
+        workers=WorkerConfig(total_size=workers, max_lag=max_lag),
+    )
+    run_native_cluster(config, kill_rank=kill_rank)  # warm (build/load .so)
+    t0 = time.perf_counter()
+    rounds, flushed = run_native_cluster(config, kill_rank=kill_rank)
+    dt = time.perf_counter() - t0
+    return rounds / dt, rounds, flushed
+
+
 def main() -> int:
-    # 1. README CPU baseline: protocol-bound regime
+    # 1. README CPU baseline: protocol-bound regime — the Python engine
+    # (the spec) and the native C++ engine (the runtime that fights the
+    # reference's JVM on its own regime; protocol/native_cluster.py)
     rps, rounds, _ = protocol_rounds_per_sec(
         workers=2, data_size=10, max_chunk_size=2, max_lag=1)
     emit("config1_readme_2w_ds10_rounds_per_s", rps, "rounds/s",
-         f"host protocol engine, {rounds} rounds")
+         f"host protocol engine (python), {rounds} rounds")
+    rps, rounds, _ = native_rounds_per_sec(
+        workers=2, data_size=10, max_chunk_size=2, max_lag=1,
+        max_round=20000)
+    emit("config1_readme_2w_ds10_rounds_per_s_native", rps, "rounds/s",
+         f"native C++ engine, {rounds} rounds")
 
     # 4a. lossy protocol: thresholds 0.9, one straggler killed mid-run
     rps, rounds, outputs = protocol_rounds_per_sec(
@@ -71,14 +99,25 @@ def main() -> int:
         th=(0.85, 0.9, 0.9), max_round=100, kill_rank=7)
     emit("config4_lossy_th0.9_straggler_rounds_per_s", rps, "rounds/s",
          f"8 workers, rank 7 killed, {rounds} rounds completed, "
-         f"{len(outputs)} outputs flushed with honest counts")
+         f"{len(outputs)} outputs flushed with honest counts (python)")
+    rps, rounds, flushed = native_rounds_per_sec(
+        workers=8, data_size=1024, max_chunk_size=128, max_lag=2,
+        th=(0.85, 0.9, 0.9), max_round=1000, kill_rank=7)
+    emit("config4_lossy_th0.9_straggler_rounds_per_s_native", rps,
+         "rounds/s", f"native C++ engine, {rounds} rounds, "
+         f"{flushed} flushes")
 
     # 5. maxLag=4 streaming: reference script scale, 4 rounds in flight
     rps, rounds, _ = protocol_rounds_per_sec(
         workers=4, data_size=778, max_chunk_size=3, max_lag=4,
         max_round=100)
     emit("config5_maxlag4_stream_rounds_per_s", rps, "rounds/s",
-         f"4 workers, maxLag=4, {rounds} rounds")
+         f"4 workers, maxLag=4, {rounds} rounds (python)")
+    rps, rounds, _ = native_rounds_per_sec(
+        workers=4, data_size=778, max_chunk_size=3, max_lag=4,
+        max_round=2000)
+    emit("config5_maxlag4_stream_rounds_per_s_native", rps, "rounds/s",
+         f"native C++ engine, {rounds} rounds")
 
     # 2/3/4b need the device plane
     import jax

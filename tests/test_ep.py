@@ -264,17 +264,32 @@ class TestScatterDispatch:
         np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_e),
                                    atol=1e-5, rtol=1e-5)
 
-    def test_auto_threshold_selects_scatter(self):
-        from akka_allreduce_tpu.parallel.ep import _EINSUM_DISPATCH_MAX
-        cfg = MoEConfig(n_experts=8, d_ff=32, capacity_factor=1.0,
-                        router_k=2, dispatch="auto")
-        n = 2 * 16
-        c = expert_capacity(cfg, n)
-        assert n * 8 * c <= _EINSUM_DISPATCH_MAX  # tiny => einsum
-        # the auto rule itself (trace-time arithmetic, no giant alloc)
-        big_n = _EINSUM_DISPATCH_MAX  # any N with N*E*C over the line
-        assert big_n * 8 * expert_capacity(cfg, big_n) \
-            > _EINSUM_DISPATCH_MAX
+    def test_auto_threshold_selects_scatter(self, monkeypatch):
+        """'auto' must actually RUN the scatter branch past the size line:
+        shrink the threshold so this small shape crosses it and pin the
+        output against the forced paths."""
+        import akka_allreduce_tpu.parallel.ep as ep_mod
+        x = make_x(2, 16, seed=11)
+        kw = dict(n_experts=8, d_ff=32, capacity_factor=1.0, router_k=2)
+        params = init_moe_layer(jax.random.key(2), D, MoEConfig(**kw))
+        y_einsum, _ = moe_ffn(x, params, MoEConfig(**kw,
+                                                   dispatch="einsum"),
+                              axis_name=None)
+        # below the line: auto == einsum formulation
+        y_auto_small, _ = moe_ffn(x, params, MoEConfig(**kw,
+                                                       dispatch="auto"),
+                                  axis_name=None)
+        np.testing.assert_allclose(np.asarray(y_auto_small),
+                                   np.asarray(y_einsum), atol=1e-6)
+        # force the line below this shape: auto must take scatter and
+        # still match (would crash/diverge if the branch mis-selected)
+        monkeypatch.setattr(ep_mod, "_EINSUM_DISPATCH_MAX", 1)
+        y_auto_big, _ = moe_ffn(x, params, MoEConfig(**kw,
+                                                     dispatch="auto"),
+                                axis_name=None)
+        np.testing.assert_allclose(np.asarray(y_auto_big),
+                                   np.asarray(y_einsum),
+                                   atol=1e-5, rtol=1e-5)
 
     def test_unknown_dispatch_raises(self):
         cfg = MoEConfig(dispatch="nope")
