@@ -53,6 +53,11 @@ def _add_emulate(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--trace-file", default=None,
                    help="write the structured protocol trace (JSONL: "
                         "rounds, members, deaths) here on exit")
+    p.add_argument("--engine", choices=("python", "native"),
+                   default="python",
+                   help="protocol engine: python (the spec; supports "
+                        "tracing and per-round sinks) or native (the C++ "
+                        "engine, ~100x rounds/s; throughput only)")
 
 
 def _cmd_emulate(args: argparse.Namespace) -> int:
@@ -77,6 +82,24 @@ def _cmd_emulate(args: argparse.Namespace) -> int:
                         max_round=args.max_round),
         workers=WorkerConfig(total_size=args.workers, max_lag=args.max_lag),
     )
+    if args.engine == "native":
+        if args.trace_file:
+            print("error: --engine native does not produce traces "
+                  "(use the python engine)", file=sys.stderr)
+            return 2
+        from akka_allreduce_tpu.protocol.native_cluster import (
+            run_native_cluster)
+        t0 = time.perf_counter()
+        rounds, flushed = run_native_cluster(
+            config, kill_rank=args.kill_rank,
+            assert_multiple=args.assert_multiple)
+        dt = time.perf_counter() - t0
+        print(f"completed {rounds}/{args.max_round} rounds in {dt:.3f}s "
+              f"({rounds / dt if dt > 0 else float('inf'):,.0f} rounds/s, "
+              f"{flushed} flushes, native engine)")
+        return 0 if rounds == args.max_round \
+            or args.kill_rank is not None else 1
+
     sinks = [ThroughputSink(data_size, checkpoint=args.checkpoint,
                             assert_multiple=args.assert_multiple,
                             verbose=(rank == 0))

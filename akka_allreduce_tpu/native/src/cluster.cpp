@@ -79,8 +79,9 @@ struct Ring {
     void up() {
         offset = (offset + 1) % depth;
         int t = tidx(depth - 1);
-        std::memset(row_ptr(t, 0), 0,
-                    (size_t)peers * data_size * sizeof(float));
+        if (!buf.empty())  // empty-block ranks: data() may be null (UB)
+            std::memset(row_ptr(t, 0), 0,
+                        (size_t)peers * data_size * sizeof(float));
         std::fill(filled.begin() + (size_t)t * nchunks,
                   filled.begin() + (size_t)(t + 1) * nchunks, 0);
         total[t] = 0;
@@ -457,8 +458,9 @@ long aat_cluster_run(int workers, long data_size, int max_chunk_size,
     if (workers <= 0 || data_size < 0 || max_chunk_size <= 0 ||
         max_lag < 0 || max_round < 0)
         return -2;
-    if (kill_rank >= workers)
-        return -2;  // no such seat (the python engine raises KeyError)
+    if (kill_rank >= workers || kill_rank < -1)
+        return -2;  // no such seat (the python engine raises KeyError);
+                    // only -1 means "no kill"
     Cluster c;
     c.n = workers;
     c.data_size = data_size;
