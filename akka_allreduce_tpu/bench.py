@@ -197,8 +197,9 @@ def measure_train_mfu(compute_dtype: str = "bf16",
                              d_ff=d_ff, max_seq=seq)
     cfg = TrainConfig(model=mcfg, learning_rate=1e-4,
                       bucket_elems=1 << 22, grad_axes=("dp",),
-                      compute_dtype=compute_dtype,
-                      attn_block_size=min(512, seq))
+                      compute_dtype=compute_dtype)
+    # attention blocks: the auto path picks the dtype-aware swept optimum
+    # (1024 bf16 / 512 f32 — f32 tiles OOM scoped VMEM at 1024)
     _log(f"mfu: init {compute_dtype} d={d_model} L={n_layers} ff={d_ff} "
          f"V={vocab} b={batch} t={seq} on {devices[0].device_kind}")
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)

@@ -245,6 +245,13 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--seq", type=int, default=0,
                    help="global sequence (0 = 32 per sp rank)")
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--lr-schedule", choices=("constant", "cosine"),
+                   default="constant",
+                   help="cosine = linear warmup then cosine decay to 0 "
+                        "at --steps")
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--clip-norm", type=float, default=0.0,
+                   help="global-norm gradient clipping (0 = off)")
     p.add_argument("--bucket-elems", type=int, default=1 << 16)
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute with f32 master weights")
@@ -553,7 +560,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
                       bucket_elems=args.bucket_elems, microbatches=micro,
                       compute_dtype="bf16" if args.bf16 else "f32",
                       grad_transport="int8" if args.int8_grads else "f32",
-                      remat=args.remat)
+                      remat=args.remat,
+                      lr_schedule=args.lr_schedule,
+                      warmup_steps=args.warmup_steps,
+                      total_steps=args.steps, clip_norm=args.clip_norm)
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
     dynamic = args.deadline_ms > 0
     # donate: the loop rebinds params/opt_state every step and the

@@ -94,6 +94,14 @@ class TrainConfig:
     # materialising the (T, T) score tensor — the rank-local long-context
     # path (must divide the local sequence length)
     attn_block_size: Optional[int] = None
+    # Optimizer schedule: lr_schedule "constant" (default) or "cosine"
+    # (linear warmup over warmup_steps then cosine decay to ~0 at
+    # total_steps — which cosine REQUIRES); clip_norm > 0 adds global-norm
+    # gradient clipping before adamw.
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    total_steps: int = 0
+    clip_norm: float = 0.0
     # Attention implementation: "auto" consults the measured per-chip
     # dispatch table (ops/pallas_kernels/dispatch.py) — on TPU that means
     # the fused Pallas flash kernel, and under sequence parallelism
@@ -211,9 +219,34 @@ def make_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh
         _validate_pp(cfg.model, pp)
         full = dict(full, layers=stack_layer_params(full["layers"]))
     params = shard_params(full, param_specs(cfg.model, pp=pp), mesh)
-    opt = optax.adamw(cfg.learning_rate)
+    opt = optax.adamw(make_lr_schedule(cfg))
+    if cfg.clip_norm > 0:
+        opt = optax.chain(optax.clip_by_global_norm(cfg.clip_norm), opt)
     opt_state = place_opt_state(opt, jax.jit(opt.init)(params), params, mesh)
     return params, opt_state, opt
+
+
+def make_lr_schedule(cfg: TrainConfig):
+    """Step-indexed learning-rate schedule per TrainConfig (optax).
+
+    "constant" returns the plain float: optax.adamw(float) keeps the
+    optimizer-state pytree structure every pre-existing checkpoint was
+    saved with (a schedule wrapper would append a ScaleByScheduleState and
+    break orbax restore of old runs). Only opting into "cosine" changes
+    the state tree."""
+    if cfg.lr_schedule == "constant":
+        return cfg.learning_rate
+    if cfg.lr_schedule == "cosine":
+        if cfg.total_steps <= cfg.warmup_steps:
+            raise ValueError(
+                "lr_schedule='cosine' needs total_steps > warmup_steps "
+                f"(got total_steps={cfg.total_steps}, "
+                f"warmup_steps={cfg.warmup_steps})")
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=cfg.learning_rate,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=cfg.total_steps)
+    raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
 
 
 def place_opt_state(opt: optax.GradientTransformation, opt_state: Any,
@@ -250,9 +283,13 @@ def select_local_attention(cfg: TrainConfig):
             "blockwise" if cfg.attn_block_size else "local")
     if impl == "flash":
         interpret = jax.default_backend() != "tpu"
-        want = cfg.attn_block_size or 512  # 512 = the measured A/B block
 
         def flash_or_fallback(q, k, v):
+            # block-sweep optimum is dtype-dependent: bf16 tiles fit the
+            # 16M scoped VMEM at 1024, f32 tiles OOM there (capture r2
+            # postmortem) — halve for full precision
+            want = cfg.attn_block_size or (
+                1024 if q.dtype == jnp.bfloat16 else 512)
             # block choice needs T, known only at trace time; "auto" falls
             # back to the pure-JAX paths for untileable lengths instead of
             # failing lengths that worked before the kernel existed
@@ -293,9 +330,11 @@ def select_ring_attention(cfg: TrainConfig):
     if not (impl == "flash" or (auto and use_pallas("ring_flash"))):
         return partial(ring_attention, axis_name="sp", causal=True)
     interpret = jax.default_backend() != "tpu"
-    want = cfg.attn_block_size or 512
 
     def ring_or_fallback(q, k, v):
+        # same dtype-dependent block rule as the local path
+        want = cfg.attn_block_size or (
+            1024 if q.dtype == jnp.bfloat16 else 512)
         blk = pick_flash_block(q.shape[1], want)
         if blk is None:
             if not auto:
@@ -603,11 +642,33 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
                                dynamic_valid=dynamic_valid)
     donate_args = (0, 1) if donate else ()
 
+    def step_count(opt_state):
+        """The adam step counter. tree_get by key alone is ambiguous once
+        the optimizer chain carries several counters (the schedule state
+        counts too), so walk the (static) state structure for
+        ScaleByAdamState directly."""
+        found = []
+
+        def walk(node):
+            if isinstance(node, optax.ScaleByAdamState):
+                found.append(node.count)
+            elif isinstance(node, (tuple, list)):
+                for x in node:
+                    walk(x)
+            elif isinstance(node, dict):
+                for x in node.values():
+                    walk(x)
+
+        walk(opt_state)
+        if not found:
+            raise ValueError("optimizer state has no ScaleByAdamState")
+        return found[0]
+
     @partial(jax.jit, donate_argnums=donate_args)
     def step(params, opt_state, tokens):
         # the optimizer's step counter seeds the int8 transport's rounding
         # noise, so every round draws fresh bits even on repeated batches
-        count = optax.tree_utils.tree_get(opt_state, "count")
+        count = step_count(opt_state)
         grads, metrics = grad_step(params, tokens, quant_seed=count)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -615,7 +676,7 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
 
     @partial(jax.jit, donate_argnums=donate_args)
     def step_dynamic(params, opt_state, tokens, valid):
-        count = optax.tree_utils.tree_get(opt_state, "count")
+        count = step_count(opt_state)
         grads, metrics = grad_step(params, tokens, quant_seed=count,
                                    valid=valid)
         updates, opt_state = opt.update(grads, opt_state, params)

@@ -360,11 +360,12 @@ def flash_causal_attention(q, k, v, block_q=128, block_k=128,
     return flash_attention(q, k, v, True, block_q, block_k, interpret)
 
 
-def pick_flash_block(t: int, want: int = 512) -> "int | None":
+def pick_flash_block(t: int, want: int = 1024) -> "int | None":
     """Largest legal flash block for sequence length ``t``, or None.
 
-    ``want`` defaults to 512 — the block the dispatch default's A/B was
-    measured at (bench_suite.py ab_attn_*). Legality follows the Mosaic
+    ``want`` defaults to 1024 — the measured optimum of the on-chip block
+    sweep at T=2048 (B=8 H=16 D=128 bf16 fwd+bwd: 256 -> 19.8 ms,
+    512 -> 10.8 ms, 1024 -> 9.0 ms; 2048 fails VMEM). Legality follows the Mosaic
     block rule (last two block dims tile-aligned or equal to the array
     dims): a block equal to ``t`` is always legal; otherwise prefer the
     largest divisor of ``t`` <= ``want`` that is lane-aligned (x128), then

@@ -32,15 +32,16 @@ _TPU_DEFAULTS = {
     "masked_reduce": True,
     "int8": False,
     # flash attention (ops/pallas_kernels/attention.py) — Pallas WINS by
-    # 3.6x (measured on this repo's TPU v5e, bench_suite.py ab_attn_*
-    # lines, B=4 T=4096 H=16 D=128 bf16 fwd+bwd: flash 45.1 TFLOP/s vs
-    # local 12.5 vs blockwise-scan 6.7): the fused VMEM pass keeps the
-    # score tile out of HBM in both directions. Default on TPU: pallas.
+    # 5x (measured on this repo's TPU v5e, bench_suite.py ab_attn_*
+    # lines, B=4 T=4096 H=16 D=128 bf16 fwd+bwd at the swept-optimal
+    # block 1024: flash 62.4 TFLOP/s vs local 12.5 vs blockwise-scan
+    # 7.1): the fused VMEM pass keeps the score tile out of HBM in both
+    # directions. Default on TPU: pallas.
     "flash_attention": True,
     # ring flash attention (ops/pallas_kernels/ring_flash.py) — the ring
     # INNER step is the same fused block computation the local A/B above
     # measures (the ring only adds ppermute rotation between steps), so
-    # the local 3.6x win carries; semantics are oracle-pinned on the CPU
+    # the local 5x win carries; semantics are oracle-pinned on the CPU
     # mesh (tests/test_ring_flash.py) and the kernels' Mosaic lowering is
     # verified on this repo's real chip at sp=1. No multi-chip hardware
     # exists here to A/B the rotated path itself. Default on TPU: pallas.

@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def measure_decode(d_model=2048, n_layers=8, d_ff=8192, vocab=32768,
                    batch=8, prompt_len=128, kv_heads=None,
-                   steps_hi=192, steps_lo=64, reps=3, dtype="bf16"):
+                   steps_hi=384, steps_lo=64, reps=4, dtype="bf16"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -58,13 +58,18 @@ def measure_decode(d_model=2048, n_layers=8, d_ff=8192, vocab=32768,
     t_lo = run(steps_lo)
     per_step = (t_hi - t_lo) / (steps_hi - steps_lo)
     tok_s = batch / per_step
-    # decode working set re-read per step: weights + the KV cache slabs
+    # decode working set re-read per step: all weights EXCEPT the input
+    # embedding (decode only gathers `batch` rows of it; lm_head IS fully
+    # read by the logits matmul) + the KV cache slabs
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    n_embed = vocab * d_model
     bpe = 2 if dtype == "bf16" else 4
     kvh = cfg.kv_heads
     cache_bytes = (2 * n_layers * batch * cfg.max_seq * kvh *
                    cfg.head_dim * bpe)
-    gbs = (n_params * bpe + cache_bytes) / per_step / 1e9
+    read_bytes = ((n_params - n_embed + batch * d_model) * bpe
+                  + cache_bytes)
+    gbs = read_bytes / per_step / 1e9
     return {
         "per_step_ms": per_step * 1e3,
         "tokens_per_s": tok_s,
@@ -83,7 +88,8 @@ def main():
     ):
         if plat != "tpu":  # exercise tiny shapes off-TPU, no perf claim
             kw = dict(kw, d_model=256, n_layers=2, d_ff=512, vocab=512,
-                      batch=2, prompt_len=16, steps_hi=24, steps_lo=8)
+                      batch=2, prompt_len=16, steps_hi=24, steps_lo=8,
+                      reps=2)
             if name == "gqa4":
                 kw["kv_heads"] = 1
         r = measure_decode(**kw)

@@ -83,6 +83,11 @@ for dtype in ("bf16", "f32"):
     print(json.dumps({"metric": f"mfu_train_{dtype}", **r}), flush=True)
 """, 1800)
 
+    results["decode"] = run("bench_decode", """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_decode.py"])
+""", 600)
+
     results["suite"] = run("bench_suite", """
 import os, subprocess, sys
 # -u: line-buffer the child so budget kills keep completed rows;
@@ -105,7 +110,7 @@ subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env)
         "| metric | value | unit | note |",
         "|--------|-------|------|------|",
     ]
-    for section in ("headline", "mfu", "suite"):
+    for section in ("headline", "mfu", "decode", "suite"):
         for row in results.get(section, []):
             lines.append(
                 f"| {row.get('metric', '?')} | {row.get('value', row.get('mfu_pct', ''))} "
