@@ -240,6 +240,9 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "positional table")
     p.add_argument("--ffn", choices=("gelu", "swiglu"), default="gelu",
                    help="dense FF flavor (swiglu = Llama-style gated FF)")
+    p.add_argument("--attn-window", type=int, default=0,
+                   help="sliding-window causal attention: each position "
+                        "sees itself + N-1 predecessors (0 = full causal)")
     p.add_argument("--batch", type=int, default=0,
                    help="global batch (0 = 2 per dp rank)")
     p.add_argument("--seq", type=int, default=0,
@@ -311,6 +314,9 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
                         "positional table")
     p.add_argument("--ffn", choices=("gelu", "swiglu"), default="gelu",
                    help="dense FF flavor (swiglu = Llama-style gated FF)")
+    p.add_argument("--attn-window", type=int, default=0,
+                   help="sliding-window causal attention: each position "
+                        "sees itself + N-1 predecessors (0 = full causal)")
     p.add_argument("--moe-experts", type=int, default=0)
     p.add_argument("--moe-every", type=int, default=1)
     p.add_argument("--capacity-factor", type=float, default=1.25)
@@ -331,7 +337,8 @@ def _build_model_config(args: argparse.Namespace, max_seq: int):
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_seq=max_seq,
         moe=moe, moe_every=args.moe_every,
-        n_kv_heads=args.kv_heads or None, rope=args.rope, ffn=args.ffn)
+        n_kv_heads=args.kv_heads or None, rope=args.rope, ffn=args.ffn,
+        attn_window=args.attn_window or None)
 
 
 def _restore_params(args: argparse.Namespace, mcfg) -> "tuple | int":
@@ -555,7 +562,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                              d_ff=args.d_ff, max_seq=t,
                              moe=moe, moe_every=args.moe_every,
                              n_kv_heads=args.kv_heads or None,
-                             rope=args.rope, ffn=args.ffn)
+                             rope=args.rope, ffn=args.ffn,
+                             attn_window=args.attn_window or None)
     cfg = TrainConfig(model=mcfg, learning_rate=args.lr,
                       bucket_elems=args.bucket_elems, microbatches=micro,
                       compute_dtype="bf16" if args.bf16 else "f32",

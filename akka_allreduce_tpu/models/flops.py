@@ -63,10 +63,16 @@ def transformer_fwd_flops(cfg: TransformerConfig, batch: int,
     d_kv = cfg.kv_heads * cfg.head_dim  # < d under grouped-query attention
     # wq + wo at full width, wk + wv at the (possibly grouped) KV width
     per_layer_attn = 4 * tokens * d * d + 4 * tokens * d * d_kv
-    # scores (QK^T) + AV: 2 matmuls x 2 FLOPs/MAC x b*t*t*d, halved for
-    # causality (future blocks are skipped by the blockwise/ring kernels);
-    # every QUERY head attends, so GQA does not change this term
-    attn_core = 2 * tokens * t * d
+    # scores (QK^T) + AV: 2 matmuls x 2 FLOPs/MAC per attended (q, k)
+    # pair x d. Plain causal attends t(t+1)/2 pairs (the t/2 average
+    # below); a sliding window caps each query at w pairs except the
+    # first w-1 queries: exact count (t-w)*w + w(w+1)/2.
+    if cfg.attn_window is None or cfg.attn_window >= t:
+        pairs = t * (t + 1) / 2
+    else:
+        w = cfg.attn_window
+        pairs = (t - w) * w + w * (w + 1) / 2
+    attn_core = 2 * 2 * b * pairs * d
     # dense FF matmul count: gelu = w1+w2, swiglu adds the w3 gate
     n_ff_mats = 3 if cfg.ffn == "swiglu" else 2
     dense_ff = n_ff_mats * 2 * tokens * d * cfg.d_ff

@@ -60,7 +60,8 @@ def init_kv_cache(cfg: TransformerConfig, batch: int) -> dict:
 
 
 def _cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
-                      v_all: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+                      v_all: jnp.ndarray, pos: jnp.ndarray,
+                      window: "int | None" = None) -> jnp.ndarray:
     """q: (b, 1, h, d); k_all/v_all: (b, max_seq, h_kv, d) with positions
     <= pos valid. Masked softmax over the full static buffer — the causal
     mask IS the length mask at decode time. GQA (h_kv < h) runs as a
@@ -76,8 +77,11 @@ def _cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
     scale = d ** -0.5
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all,
                         preferred_element_type=jnp.float32) * scale
-    valid = (jnp.arange(k_all.shape[1]) <= pos)[None, None, None, None, :]
-    scores = jnp.where(valid, scores, NEG_INF)
+    k_idx = jnp.arange(k_all.shape[1])
+    valid = k_idx <= pos
+    if window is not None:  # sliding window: only the last `window` keys
+        valid = valid & (pos - k_idx < window)
+    scores = jnp.where(valid[None, None, None, None, :], scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_all.dtype), v_all,
                      preferred_element_type=jnp.float32)
@@ -112,7 +116,8 @@ def decode_step(params: dict, cache: dict, token: jnp.ndarray,
             k_cache, k[None].astype(k_cache.dtype), (i, 0, pos, 0, 0))
         v_cache = lax.dynamic_update_slice(
             v_cache, v[None].astype(v_cache.dtype), (i, 0, pos, 0, 0))
-        attn = _cached_attention(q, k_cache[i], v_cache[i], pos)
+        attn = _cached_attention(q, k_cache[i], v_cache[i], pos,
+                                 window=cfg.attn_window)
         x = x + attn.reshape(b, 1, -1) @ layer["wo"]
 
         h = rmsnorm(x, layer["ln2"])
@@ -152,7 +157,7 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
             k_cache, k[None].astype(k_cache.dtype), (i, 0, 0, 0, 0))
         v_cache = lax.dynamic_update_slice(
             v_cache, v[None].astype(v_cache.dtype), (i, 0, 0, 0, 0))
-        attn = local_causal_attention(q, k, v)
+        attn = local_causal_attention(q, k, v, window=cfg.attn_window)
         x = x + attn.reshape(b, t, -1) @ layer["wo"]
 
         h = rmsnorm(x, layer["ln2"])

@@ -53,6 +53,7 @@ from akka_allreduce_tpu.parallel.pp import (
     stack_layer_params,
 )
 from akka_allreduce_tpu.ops.pallas_kernels.attention import (
+    default_flash_block,
     flash_causal_attention,
     pick_flash_block,
 )
@@ -277,19 +278,17 @@ def select_local_attention(cfg: TrainConfig):
     impl = cfg.attn_impl
     if impl not in ("auto", "flash", "blockwise", "local"):
         raise ValueError(f"unknown attn_impl {impl!r}")
+    window = cfg.model.attn_window
     auto = impl == "auto"
     if auto:
         impl = "flash" if use_pallas("flash_attention") else (
-            "blockwise" if cfg.attn_block_size else "local")
+            "blockwise" if cfg.attn_block_size and window is None
+            else "local")
     if impl == "flash":
         interpret = jax.default_backend() != "tpu"
 
         def flash_or_fallback(q, k, v):
-            # block-sweep optimum is dtype-dependent: bf16 tiles fit the
-            # 16M scoped VMEM at 1024, f32 tiles OOM there (capture r2
-            # postmortem) — halve for full precision
-            want = cfg.attn_block_size or (
-                1024 if q.dtype == jnp.bfloat16 else 512)
+            want = cfg.attn_block_size or default_flash_block(q.dtype)
             # block choice needs T, known only at trace time; "auto" falls
             # back to the pure-JAX paths for untileable lengths instead of
             # failing lengths that worked before the kernel existed
@@ -297,22 +296,27 @@ def select_local_attention(cfg: TrainConfig):
             if blk is not None:
                 return flash_causal_attention(q, k, v, block_q=blk,
                                               block_k=blk,
-                                              interpret=interpret)
+                                              interpret=interpret,
+                                              window=window)
             if not auto:
                 raise ValueError(
                     f"attn_impl='flash': no legal flash block for "
                     f"sequence {q.shape[1]} (want <= {want})")
-            if cfg.attn_block_size and \
+            if window is None and cfg.attn_block_size and \
                     q.shape[1] % cfg.attn_block_size == 0:
                 return blockwise_causal_attention(
                     q, k, v, block_size=cfg.attn_block_size)
-            return local_causal_attention(q, k, v)
+            return local_causal_attention(q, k, v, window=window)
 
         return flash_or_fallback
     if impl == "blockwise":
+        if window is not None:
+            raise ValueError(
+                "attn_window is served by the flash and local paths; "
+                "attn_impl='blockwise' does not support it")
         return partial(blockwise_causal_attention,
                        block_size=cfg.attn_block_size or 512)
-    return local_causal_attention
+    return partial(local_causal_attention, window=window)
 
 
 def select_ring_attention(cfg: TrainConfig):
@@ -326,15 +330,17 @@ def select_ring_attention(cfg: TrainConfig):
     impl = cfg.attn_impl
     if impl not in ("auto", "flash", "blockwise", "local"):
         raise ValueError(f"unknown attn_impl {impl!r}")
+    if cfg.model.attn_window is not None:
+        raise ValueError(
+            "attn_window does not compose with sequence parallelism "
+            "(sp > 1) yet — drop --sp or the window")
     auto = impl == "auto"
     if not (impl == "flash" or (auto and use_pallas("ring_flash"))):
         return partial(ring_attention, axis_name="sp", causal=True)
     interpret = jax.default_backend() != "tpu"
 
     def ring_or_fallback(q, k, v):
-        # same dtype-dependent block rule as the local path
-        want = cfg.attn_block_size or (
-            1024 if q.dtype == jnp.bfloat16 else 512)
+        want = cfg.attn_block_size or default_flash_block(q.dtype)
         blk = pick_flash_block(q.shape[1], want)
         if blk is None:
             if not auto:

@@ -57,6 +57,11 @@ class TransformerConfig:
     rope: bool = False
     rope_theta: float = 10000.0
     ffn: str = "gelu"
+    # Sliding-window (Mistral-style) causal attention: each position sees
+    # itself plus attn_window-1 predecessors. Served by the flash kernel
+    # (banded tiles skipped -> O(T*window) compute) and the local oracle;
+    # not composable with sequence parallelism (sp > 1) yet.
+    attn_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -87,6 +92,9 @@ class TransformerConfig:
             raise ValueError(
                 f"rope needs an even head_dim, got {self.head_dim} "
                 f"(d_model={self.d_model} / n_heads={self.n_heads})")
+        if self.attn_window is not None and self.attn_window < 1:
+            raise ValueError(
+                f"attn_window must be >= 1, got {self.attn_window}")
 
 
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
@@ -172,7 +180,7 @@ AttnFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
 def transformer_block(layer: dict, x: jnp.ndarray, cfg: TransformerConfig,
-                      attn_fn: AttnFn = local_causal_attention,
+                      attn_fn: Optional[AttnFn] = None,
                       tp_axis: Optional[str] = None,
                       ep_axis: Optional[str] = None,
                       positions: Optional[jnp.ndarray] = None
@@ -190,6 +198,10 @@ def transformer_block(layer: dict, x: jnp.ndarray, cfg: TransformerConfig,
     MoE layers keep their own expert FF (ffn="swiglu" shapes dense layers
     only)."""
     b, t, _ = x.shape
+    if attn_fn is None:  # default oracle, window-aware (see apply)
+        def attn_fn(q, k, v):
+            return local_causal_attention(q, k, v,
+                                          window=cfg.attn_window)
     h = rmsnorm(x, layer["ln1"])
     if tp_axis is not None:
         # identity fwd / psum('tp') bwd: completes dL/dh across the
@@ -266,7 +278,7 @@ def _finalize_aux(total: dict) -> dict:
 def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
                                cfg: TransformerConfig,
                                positions: Optional[jnp.ndarray] = None,
-                               attn_fn: AttnFn = local_causal_attention,
+                               attn_fn: Optional[AttnFn] = None,
                                tp_axis: Optional[str] = None,
                                ep_axis: Optional[str] = None,
                                remat: bool = False
@@ -287,6 +299,13 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
     t = tokens.shape[1]
     if positions is None:
         positions = jnp.arange(t)
+    if attn_fn is None:
+        # default oracle attention, honoring the model's sliding window;
+        # train-step callers inject their own (kernel) attn_fn, which owns
+        # the window itself
+        def attn_fn(q, k, v):
+            return local_causal_attention(q, k, v,
+                                          window=cfg.attn_window)
     x = params["embed"][tokens]
     if not cfg.rope:
         x = x + params["pos"][positions]
@@ -310,7 +329,7 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
 def transformer_apply(params: dict, tokens: jnp.ndarray,
                       cfg: TransformerConfig,
                       positions: Optional[jnp.ndarray] = None,
-                      attn_fn: AttnFn = local_causal_attention,
+                      attn_fn: Optional[AttnFn] = None,
                       tp_axis: Optional[str] = None,
                       ep_axis: Optional[str] = None) -> jnp.ndarray:
     """Logits-only wrapper over :func:`transformer_apply_with_aux`."""
@@ -322,7 +341,7 @@ def transformer_apply(params: dict, tokens: jnp.ndarray,
 def next_token_loss_and_aux(params: dict, tokens: jnp.ndarray,
                             cfg: TransformerConfig,
                             positions: Optional[jnp.ndarray] = None,
-                            attn_fn: AttnFn = local_causal_attention,
+                            attn_fn: Optional[AttnFn] = None,
                             tp_axis: Optional[str] = None,
                             ep_axis: Optional[str] = None,
                             targets: Optional[jnp.ndarray] = None,
@@ -367,7 +386,7 @@ def weighted_ce(logits: jnp.ndarray, targets: jnp.ndarray,
 def next_token_loss(params: dict, tokens: jnp.ndarray,
                     cfg: TransformerConfig,
                     positions: Optional[jnp.ndarray] = None,
-                    attn_fn: AttnFn = local_causal_attention,
+                    attn_fn: Optional[AttnFn] = None,
                     tp_axis: Optional[str] = None,
                     targets: Optional[jnp.ndarray] = None,
                     weights: Optional[jnp.ndarray] = None,

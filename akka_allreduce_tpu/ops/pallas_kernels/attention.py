@@ -40,15 +40,36 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _causal_mask(iq, ik, blk_q, blk_k, q_off=0, k_off=0):
-    """(blk_q, blk_k) bool: query position >= key position. Offsets shift
-    into GLOBAL sequence positions (ring_flash.py passes traced SMEM
-    scalars; the local kernels use in-array positions)."""
+def _causal_mask(iq, ik, blk_q, blk_k, q_off=0, k_off=0, window=None):
+    """(blk_q, blk_k) bool: query position >= key position, and — under a
+    sliding window — within ``window`` positions back (k > q - window, the
+    Mistral convention: a query sees itself plus window-1 predecessors).
+    Offsets shift into GLOBAL sequence positions (ring_flash.py passes
+    traced SMEM scalars; the local kernels use in-array positions)."""
     q_pos = q_off + iq * blk_q + lax.broadcasted_iota(
         jnp.int32, (blk_q, blk_k), 0)
     k_pos = k_off + ik * blk_k + lax.broadcasted_iota(
         jnp.int32, (blk_q, blk_k), 1)
-    return q_pos >= k_pos
+    mask = q_pos >= k_pos
+    if window is not None:
+        mask = mask & (q_pos - k_pos < window)
+    return mask
+
+
+def _tile_live_local(iq, ik, blk_q, blk_k, causal, window=None):
+    """Tile has at least one potentially-unmasked score: not entirely in
+    the queries' future (causal) and not entirely fallen out of the
+    sliding window. Skipped tiles cost nothing (~half the grid for plain
+    causal; all but ~window/blk_k tiles per query row under a window)."""
+    if not causal:
+        return True
+    live = ik * blk_k <= iq * blk_q + blk_q - 1
+    if window is not None:
+        # newest key in the tile must still be inside the OLDEST query's
+        # window: max(k_pos) > min(q_pos) - window. & not `and`: the grid
+        # indices are traced scalars inside the kernel.
+        live = live & (ik * blk_k + blk_k - 1 > iq * blk_q - window)
+    return live
 
 
 def _softmax_tile(q, k, v, m_prev, l_prev, acc_prev, mask, scale):
@@ -61,7 +82,10 @@ def _softmax_tile(q, k, v, m_prev, l_prev, acc_prev, mask, scale):
         s = jnp.where(mask, s, NEG_INF)
     m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
     corr = jnp.exp(jnp.minimum(m_prev, m_new) - m_new)  # no inf-inf NaN
-    p = jnp.exp(s - m_new)  # masked lanes: exp(NEG_INF - m) == 0
+    # the where-guard keeps FULLY-masked rows exactly zero: without it a
+    # row whose live keys all sit in later tiles (possible under sliding
+    # windows) would see exp(NEG_INF - NEG_INF) == 1 on its masked lanes
+    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
     l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
     pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                          preferred_element_type=jnp.float32)
@@ -83,7 +107,7 @@ def _bwd_tile(q, k, v, do, lse, delta, mask, scale):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, blk_q, blk_k, causal):
+                *, scale, blk_q, blk_k, causal, window):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -96,11 +120,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     # Causal skip: key block entirely in the queries' future — every score
     # masked, nothing to accumulate (same early-out as the ring/blockwise
     # paths; ~half the inner iterations vanish).
-    live = True if not causal else ik * blk_k <= iq * blk_q + blk_q - 1
+    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window)
 
     @pl.when(live)
     def _step():
-        mask = _causal_mask(iq, ik, blk_q, blk_k) if causal else None
+        mask = _causal_mask(iq, ik, blk_q, blk_k, window=window) \
+            if causal else None
         m_new, l_new, acc_new = _softmax_tile(
             q_ref[0, 0, :, :], k_ref[0, 0, :, :], v_ref[0, 0, :, :],
             m_scr[:, 0:1], l_scr[:, 0:1], acc_scr[:], mask, scale)
@@ -119,7 +144,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, blk_q, blk_k, causal):
+               dq_scr, *, scale, blk_q, blk_k, causal, window):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -127,12 +152,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = True if not causal else ik * blk_k <= iq * blk_q + blk_q - 1
+    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window)
 
     @pl.when(live)
     def _step():
         k = k_ref[0, 0, :, :]
-        mask = _causal_mask(iq, ik, blk_q, blk_k) if causal else None
+        mask = _causal_mask(iq, ik, blk_q, blk_k, window=window) \
+            if causal else None
         _, ds = _bwd_tile(q_ref[0, 0, :, :], k, v_ref[0, 0, :, :],
                           do_ref[0, 0, :, :], lse_ref[0, 0, :, :],
                           delta_ref[0, 0, :, :], mask, scale)
@@ -147,7 +173,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, blk_q, blk_k, causal, nq):
+                *, scale, blk_q, blk_k, causal, nq, window):
     # Swapped grid: (B, KV head, key-block, inner) where the innermost axis
     # enumerates (query head within the GQA group) x (query block),
     # jj = qh_local * nq + iq — scratch accumulates dk/dv across the whole
@@ -163,13 +189,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     # Skip query blocks entirely BEFORE this key block (they never attend
     # to it under causality).
-    live = True if not causal else iq * blk_q + blk_q - 1 >= ik * blk_k
+    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window)
 
     @pl.when(live)
     def _step():
         q = q_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        mask = _causal_mask(iq, ik, blk_q, blk_k) if causal else None
+        mask = _causal_mask(iq, ik, blk_q, blk_k, window=window) \
+            if causal else None
         p, ds = _bwd_tile(q, k_ref[0, 0, :, :], v_ref[0, 0, :, :], do,
                           lse_ref[0, 0, :, :], delta_ref[0, 0, :, :],
                           mask, scale)
@@ -195,7 +222,7 @@ def _block_sizes(t: int, block_q: int, block_k: int) -> tuple[int, int]:
     return blk_q, blk_k
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
     """q/k/v in kernel layout (B, H, T, D); returns (o (B,H,T,D), lse).
 
     Grouped-query attention is native: K/V may carry fewer heads than Q
@@ -220,7 +247,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, blk_q=blk_q,
-                          blk_k=blk_k, causal=causal),
+                          blk_k=blk_k, causal=causal, window=window),
         grid=(b, h, nq, nk),
         in_specs=[qspec(), kspec(), kspec()],
         out_shape=(
@@ -243,7 +270,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     return o, lse
 
 
-def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+         window=None):
     """All tensors in kernel layout (B, H, T, D); k/v may carry fewer
     (grouped) heads — see _fwd."""
     b, h, t, d = q.shape
@@ -271,7 +299,7 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, blk_q=blk_q,
-                          blk_k=blk_k, causal=causal),
+                          blk_k=blk_k, causal=causal, window=window),
         grid=(b, h, nq, nk),
         in_specs=[tspec(blk_q, q_by_i), tspec(blk_k, k_by_j),
                   tspec(blk_k, k_by_j), tspec(blk_q, q_by_i),
@@ -296,7 +324,8 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
         memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, blk_q=blk_q,
-                          blk_k=blk_k, causal=causal, nq=nq),
+                          blk_k=blk_k, causal=causal, nq=nq,
+                          window=window),
         grid=(b, h_kv, nk, g * nq),
         in_specs=[tspec(blk_q, q_by_jj), tspec(blk_k, k_by_i),
                   tspec(blk_k, k_by_i), tspec(blk_q, q_by_jj),
@@ -320,33 +349,42 @@ def _to_kernel_layout(x):
     return jnp.swapaxes(x, 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
-                    interpret=False):
+                    interpret=False, window=None):
     """Fused attention. q/k/v: (B, T, H, D) -> (B, T, H, D).
 
     ``T`` must be divisible by the (clamped) block sizes; sequence lengths
     here are static, so pick divisors — same contract as
     :func:`parallel.ring_attention.blockwise_causal_attention`. ``interpret``
     runs the kernels in Pallas interpreter mode (CPU-testable).
+    ``window`` (causal only, >= 1): sliding-window attention — each query
+    sees itself plus the window-1 preceding positions; tiles entirely
+    outside the band are skipped, so compute is O(T * window).
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window needs causal=True and window >= 1")
     o, _ = _fwd(_to_kernel_layout(q), _to_kernel_layout(k),
-                _to_kernel_layout(v), causal, block_q, block_k, interpret)
+                _to_kernel_layout(v), causal, block_q, block_k, interpret,
+                window)
     return _to_kernel_layout(o)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret,
+                    window=None):
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window needs causal=True and window >= 1")
     qt, kt, vt = (_to_kernel_layout(x) for x in (q, k, v))
-    o, lse = _fwd(qt, kt, vt, causal, block_q, block_k, interpret)
+    o, lse = _fwd(qt, kt, vt, causal, block_q, block_k, interpret, window)
     # residuals stay in kernel layout: the backward kernels consume them
     # directly, so only the cotangent pays a relayout
     return _to_kernel_layout(o), (qt, kt, vt, o, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, window, res, do):
     qt, kt, vt, ot, lse = res
     dq, dk, dv = _bwd(qt, kt, vt, ot, lse, _to_kernel_layout(do),
-                      causal, block_q, block_k, interpret)
+                      causal, block_q, block_k, interpret, window)
     return tuple(_to_kernel_layout(g) for g in (dq, dk, dv))
 
 
@@ -354,18 +392,26 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_causal_attention(q, k, v, block_q=128, block_k=128,
-                           interpret=False):
+                           interpret=False, window=None):
     """Drop-in ``attn_fn`` (models/transformer.py): causal flash attention
     with the framework's (B, T, H, D) calling convention."""
-    return flash_attention(q, k, v, True, block_q, block_k, interpret)
+    return flash_attention(q, k, v, True, block_q, block_k, interpret,
+                           window)
 
 
-def pick_flash_block(t: int, want: int = 1024) -> "int | None":
+def default_flash_block(dtype) -> int:
+    """The swept-optimal flash block per dtype: bf16 tiles fit the 16M
+    scoped VMEM at 1024 (the T=2048 sweep optimum: 256 -> 19.8 ms,
+    512 -> 10.8 ms, 1024 -> 9.0 ms fwd+bwd); f32 tiles are 2x and OOM
+    there, so full precision halves to 512."""
+    return 1024 if dtype == jnp.bfloat16 else 512
+
+
+def pick_flash_block(t: int, want: int) -> "int | None":
     """Largest legal flash block for sequence length ``t``, or None.
 
-    ``want`` defaults to 1024 — the measured optimum of the on-chip block
-    sweep at T=2048 (B=8 H=16 D=128 bf16 fwd+bwd: 256 -> 19.8 ms,
-    512 -> 10.8 ms, 1024 -> 9.0 ms; 2048 fails VMEM). Legality follows the Mosaic
+    ``want`` is the caller's block budget — normally
+    :func:`default_flash_block` of the traced dtype. Legality follows the Mosaic
     block rule (last two block dims tile-aligned or equal to the array
     dims): a block equal to ``t`` is always legal; otherwise prefer the
     largest divisor of ``t`` <= ``want`` that is lane-aligned (x128), then

@@ -172,16 +172,22 @@ def blockwise_causal_attention(q: jnp.ndarray, k: jnp.ndarray,
 
 
 def local_causal_attention(q: jnp.ndarray, k: jnp.ndarray,
-                           v: jnp.ndarray) -> jnp.ndarray:
+                           v: jnp.ndarray,
+                           window: "int | None" = None) -> jnp.ndarray:
     """Single-rank reference attention (no sequence sharding): the oracle
-    ring_attention must match. Same precision rule: f32 scores/softmax,
-    bf16-friendly matmuls."""
+    ring_attention and the flash kernels must match. Same precision rule:
+    f32 scores/softmax, bf16-friendly matmuls. ``window``: sliding-window
+    causal attention (each query sees itself + window-1 predecessors) —
+    the O(T^2) oracle for the flash kernel's banded path."""
     k, v = expand_kv_heads(q, k, v)
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     t = q.shape[1]
     mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    if window is not None:
+        pos = jnp.arange(t)
+        mask = mask & (pos[:, None] - pos[None, :] < window)
     scores = jnp.where(mask[None, None], scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,

@@ -1,0 +1,171 @@
+"""Sliding-window (Mistral-style) causal attention.
+
+Oracle first: the flash kernel's banded path must match the masked-oracle
+attention for every window/block geometry, forward and backward; then end
+to end: training and cached decode with a window."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+    transformer_apply,
+)
+from akka_allreduce_tpu.ops.pallas_kernels.attention import (
+    flash_causal_attention,
+)
+from akka_allreduce_tpu.parallel.ring_attention import (
+    local_causal_attention,
+)
+
+WCFG = TransformerConfig(vocab_size=47, d_model=32, n_heads=4, n_layers=2,
+                         d_ff=64, max_seq=64, rope=True, attn_window=8)
+
+
+def _qkv(key, b=1, t=128, h=2, d=16):
+    kq, kk, kv = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, t, h, d))
+                 for k in (kq, kk, kv))
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("window,blk", [
+        (8, 32),    # window far below the block: most tiles banded out
+        (32, 32),   # window == block
+        (100, 32),  # window crosses several blocks, not a multiple
+        (1, 32),    # degenerate: self-attention only
+        (128, 32),  # window >= T: equals plain causal
+    ])
+    def test_forward_matches_windowed_oracle(self, window, blk):
+        q, k, v = _qkv(jax.random.key(0))
+        got = flash_causal_attention(q, k, v, block_q=blk, block_k=blk,
+                                     interpret=True, window=window)
+        want = local_causal_attention(q, k, v, window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_window_at_least_t_equals_plain_causal(self):
+        q, k, v = _qkv(jax.random.key(1), t=64)
+        got = flash_causal_attention(q, k, v, block_q=32, block_k=32,
+                                     interpret=True, window=64)
+        want = flash_causal_attention(q, k, v, block_q=32, block_k=32,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+
+    def test_gradients_match_windowed_oracle(self):
+        q, k, v = _qkv(jax.random.key(2), t=96, h=1)
+
+        def loss(attn, q, k, v):
+            return jnp.sum(jnp.sin(attn(q, k, v).astype(jnp.float32)))
+
+        g_flash = jax.grad(
+            lambda *a: loss(lambda q, k, v: flash_causal_attention(
+                q, k, v, block_q=32, block_k=32, interpret=True,
+                window=20), *a), argnums=(0, 1, 2))(q, k, v)
+        g_oracle = jax.grad(
+            lambda *a: loss(lambda q, k, v: local_causal_attention(
+                q, k, v, window=20), *a), argnums=(0, 1, 2))(q, k, v)
+        for gf, go, name in zip(g_flash, g_oracle, "qkv"):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(go),
+                                       atol=5e-5, rtol=5e-5,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_gqa_with_window(self):
+        kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+        q = jax.random.normal(kq, (1, 64, 4, 16))
+        k = jax.random.normal(kk, (1, 64, 2, 16))
+        v = jax.random.normal(kv, (1, 64, 2, 16))
+        got = flash_causal_attention(q, k, v, block_q=32, block_k=32,
+                                     interpret=True, window=16)
+        want = local_causal_attention(q, k, v, window=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_noncausal_window_rejected(self):
+        from akka_allreduce_tpu.ops.pallas_kernels.attention import (
+            flash_attention)
+        q, k, v = _qkv(jax.random.key(4), t=32)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, False, 32, 32, True, 8)
+
+
+class TestModelIntegration:
+    def test_window_must_be_positive(self):
+        with pytest.raises(ValueError, match="attn_window"):
+            TransformerConfig(attn_window=0)
+
+    def test_sp_rejects_window(self):
+        from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                     select_ring_attention)
+        cfg = TrainConfig(model=WCFG)
+        with pytest.raises(ValueError, match="sequence parallelism"):
+            select_ring_attention(cfg)
+
+    def test_train_step_learns_with_window(self):
+        from akka_allreduce_tpu.models.train import (
+            TrainConfig, make_train_state, make_train_step)
+        from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                      make_device_mesh)
+        mesh = make_device_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
+        cfg = TrainConfig(model=WCFG, learning_rate=1e-2, bucket_elems=256,
+                          grad_axes=("dp",))
+        params, opt_state, opt = make_train_state(jax.random.key(0), cfg,
+                                                  mesh)
+        step = make_train_step(cfg, mesh, opt)
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, 47, size=(4, 64), dtype=np.int32))
+        losses = []
+        for _ in range(8):
+            params, opt_state, m = step(params, opt_state, toks)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] - 0.2, losses
+
+    def test_forced_flash_window_matches_forced_local(self):
+        from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                     make_grad_step,
+                                                     make_train_state)
+        from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                      make_device_mesh)
+        mesh = make_device_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
+        toks = jnp.asarray(np.random.default_rng(1).integers(
+            0, 47, size=(4, 64), dtype=np.int32))
+
+        def grads(impl):
+            cfg = TrainConfig(model=WCFG, bucket_elems=256,
+                              grad_axes=("dp",), attn_impl=impl,
+                              attn_block_size=32)
+            params, _, _ = make_train_state(jax.random.key(2), cfg, mesh)
+            g, m = jax.jit(make_grad_step(cfg, mesh))(params, toks)
+            return float(m["loss"]), g
+
+        loss_f, g_f = grads("flash")
+        loss_l, g_l = grads("local")
+        assert abs(loss_f - loss_l) < 1e-5
+        for a, b in zip(jax.tree.leaves(g_f), jax.tree.leaves(g_l)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=5e-3)
+
+    def test_windowed_decode_matches_full_forward(self):
+        from akka_allreduce_tpu.models.generate import (decode_step,
+                                                        init_kv_cache)
+        params = init_transformer(jax.random.key(3), WCFG)
+        toks = jnp.asarray(np.random.default_rng(2).integers(
+            0, 47, size=(2, 20), dtype=np.int32))
+        full_logits = transformer_apply(params, toks, WCFG)
+
+        cache = init_kv_cache(WCFG, batch=2)
+        outs = []
+        for i in range(toks.shape[1]):
+            cache, logits = jax.jit(
+                decode_step, static_argnames="cfg")(
+                params, cache, toks[:, i], WCFG)
+            outs.append(logits)
+        got = jnp.stack(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(full_logits),
+                                   atol=2e-4, rtol=2e-3)
