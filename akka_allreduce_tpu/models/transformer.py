@@ -299,13 +299,8 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
     t = tokens.shape[1]
     if positions is None:
         positions = jnp.arange(t)
-    if attn_fn is None:
-        # default oracle attention, honoring the model's sliding window;
-        # train-step callers inject their own (kernel) attn_fn, which owns
-        # the window itself
-        def attn_fn(q, k, v):
-            return local_causal_attention(q, k, v,
-                                          window=cfg.attn_window)
+    # attn_fn=None resolves inside transformer_block to the window-aware
+    # oracle; train-step callers inject their own (kernel) attn_fn
     x = params["embed"][tokens]
     if not cfg.rope:
         x = x + params["pos"][positions]

@@ -40,6 +40,7 @@ from akka_allreduce_tpu.ops.pallas_kernels.dispatch import use_pallas
 from akka_allreduce_tpu.ops.pallas_kernels.quantized import (
     dequantize_int8,
     quantize_int8,
+    quantize_int8_prng,
 )
 
 
@@ -71,10 +72,21 @@ def _quantize_rows(x2d: jnp.ndarray, key: jax.Array
     """(rows, c) f32 -> (int8 values, (rows, 1) f32 scales), symmetric
     per-row quantization with stochastic rounding.
 
-    Default is the jnp form — the real-chip A/B (scripts/bench_suite.py,
-    v5e) measured XLA's fusion ~13% faster round-trip than the Pallas
-    kernel (ops/pallas_kernels/quantized.py), so XLA won this path; set
-    AATPU_PALLAS_INT8=1 to re-measure the kernel."""
+    On TPU the default is the in-kernel-PRNG Pallas kernel: producing the
+    rounding bits is part of the job, and the hardware PRNG inside the
+    kernel beats threefry outside it by ~68% end to end (dispatch.py /
+    PERF.md ``ab_int8_e2e_*``). The bits-input kernel
+    (AATPU_PALLAS_INT8_PRNG=0 AATPU_PALLAS_INT8=1 — the prng branch is
+    consulted first) and the pure jnp form (CPU default) remain
+    selectable; all three share the same floor+Bernoulli rounding rule
+    (pinned in one helper, ops/pallas_kernels/quantized.py
+    ``_stochastic_round``)."""
+    if use_pallas("int8_prng"):
+        # fold the key to a scalar seed: rounding stays unbiased as long
+        # as the seed is independent of the VALUES (the key derives from
+        # the step counter, models/train.py derive_quant_key)
+        seed = jax.random.key_data(key).astype(jnp.int32).sum()
+        return quantize_int8_prng(x2d, seed)
     if use_pallas("int8"):
         bits = jax.random.bits(key, x2d.shape, dtype=jnp.uint32)
         return quantize_int8(x2d, bits)

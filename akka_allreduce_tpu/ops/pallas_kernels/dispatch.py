@@ -9,16 +9,24 @@ follow the measured A/B on this repo's real chip (scripts/bench_suite.py
 * ``masked_reduce`` — Pallas WINS (738-779 GB/s vs 567-581 GB/s for the
   jnp form, ~+30%): the one-VMEM-pass kernel beats XLA's mask+sum+rescale
   fusion. Default on TPU: pallas.
-* ``int8`` (quantize/dequantize) — XLA WINS (167-170 GB/s vs 148-151 GB/s
-  round-trip, ~+13%): XLA's fusion of the scale/round/clip/cast chain
-  beats the hand kernel, which pays for materialising its random-bits
-  input tile-by-tile. Default everywhere: jnp.
+* ``int8`` (quantize/dequantize, PRE-GENERATED bits input) — XLA WINS
+  (167-170 GB/s vs 148-151 GB/s round-trip, ~+13%): XLA's fusion of the
+  scale/round/clip/cast chain beats the hand kernel, which pays for
+  materialising its random-bits input tile-by-tile. Default: jnp.
+* ``int8_prng`` (quantize with IN-KERNEL hardware PRNG) — Pallas WINS
+  end to end (182 vs 108 GB/s round-trip INCLUDING bits generation,
+  ~+68%; bench_suite.py ``ab_int8_e2e_*``, PERF.md carries the canonical
+  capture): production must generate rounding bits somewhere, and
+  threefry outside the kernel costs more than the hardware PRNG inside
+  it. Default on TPU: pallas (the production quantize path).
 
 On CPU (tests, the virtual 8-device mesh) the jnp form always runs —
 interpreter-mode Pallas would only be slower. Overrides for re-measuring:
-``AATPU_PALLAS=0|1`` forces every kernel, ``AATPU_PALLAS_INT8`` /
-``AATPU_PALLAS_MASKED_REDUCE`` / ``AATPU_PALLAS_FLASH_ATTENTION`` force
-one.
+``AATPU_PALLAS=0|1`` forces every kernel; ``AATPU_PALLAS_INT8`` /
+``AATPU_PALLAS_INT8_PRNG`` / ``AATPU_PALLAS_MASKED_REDUCE`` /
+``AATPU_PALLAS_FLASH_ATTENTION`` force one. NOTE: the production int8
+quantize consults ``int8_prng`` FIRST — to exercise the bits-input kernel
+on TPU set ``AATPU_PALLAS_INT8_PRNG=0 AATPU_PALLAS_INT8=1``.
 """
 
 from __future__ import annotations
@@ -31,6 +39,9 @@ import jax
 _TPU_DEFAULTS = {
     "masked_reduce": True,
     "int8": False,
+    # in-kernel PRNG quantize: wins END TO END (bits generation included;
+    # see module docstring) — the production int8 quantize on TPU
+    "int8_prng": True,
     # flash attention (ops/pallas_kernels/attention.py) — Pallas WINS by
     # 5x (measured on this repo's TPU v5e, bench_suite.py ab_attn_*
     # lines, B=4 T=4096 H=16 D=128 bf16 fwd+bwd at the swept-optimal

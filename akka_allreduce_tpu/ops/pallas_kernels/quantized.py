@@ -35,20 +35,23 @@ from jax.experimental.pallas import tpu as pltpu
 from akka_allreduce_tpu.ops.pallas_kernels.tiling import col_tile, pad_cols
 
 
-def _quantize_kernel(x_ref, bits_ref, scales_ref, values_ref):
-    scale = scales_ref[:]  # (rows, 1) f32, >= 1e-30
-    scaled = x_ref[:] / scale  # in [-127, 127]
-    # stochastic rounding: floor + Bernoulli(frac), uniform from the top
-    # 24 bits so the f32 conversion is exact
+def _stochastic_round(scaled, bits_u32):
+    """THE floor+Bernoulli rounding rule, in one place: both kernels (and,
+    kept textually in sync, the jnp form in ops/collectives.py and the
+    bench's quant_xla) must produce this exact wire format. Uniform from
+    the top 24 bits so the f32 conversion is exact; int32 bitcast because
+    Mosaic has no uint32->f32 cast (values < 2^24 are sign-safe)."""
     low = jnp.floor(scaled)
     frac = scaled - low
-    # top 24 bits as uniform [0,1); go through an int32 bitcast because
-    # Mosaic has no uint32->f32 cast (values < 2^24 are sign-safe)
-    u24 = pltpu.bitcast(bits_ref[:] >> 8, jnp.int32)
+    u24 = pltpu.bitcast(bits_u32 >> 8, jnp.int32)
     u = u24.astype(jnp.float32) * (1.0 / (1 << 24))
     rounded = low + (frac > u).astype(jnp.float32)
-    rounded = jnp.clip(rounded, -127.0, 127.0)
-    values_ref[:] = rounded.astype(jnp.int8)
+    return jnp.clip(rounded, -127.0, 127.0)
+
+
+def _quantize_kernel(x_ref, bits_ref, scales_ref, values_ref):
+    scaled = x_ref[:] / scales_ref[:]  # (rows, 1) scales >= 1e-30
+    values_ref[:] = _stochastic_round(scaled, bits_ref[:]).astype(jnp.int8)
 
 
 def _dequantize_kernel(values_ref, scales_ref, out_ref):
@@ -114,6 +117,48 @@ def dequantize_int8(values: jnp.ndarray, scales: jnp.ndarray,
         interpret=interpret,
     )(vp, scales)
     return out[:, :elems]
+
+
+def _quantize_prng_kernel(seed_ref, x_ref, scales_ref, values_ref):
+    """Quantize with IN-KERNEL random bits (pltpu PRNG): no bits tensor
+    ever exists in HBM, halving the kernel's input bandwidth — the cost
+    that made the bits-input formulation lose its A/B. TPU-only (the
+    pltpu.prng_* primitives have no interpreter path); per-tile seeding
+    offsets the seed by the grid index so tiles draw distinct streams."""
+    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    scaled = x_ref[:] / scales_ref[:]
+    bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
+    values_ref[:] = _stochastic_round(scaled, bits).astype(jnp.int8)
+
+
+def quantize_int8_prng(x: jnp.ndarray, seed: jnp.ndarray
+                       ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Like :func:`quantize_int8` but the stochastic-rounding bits are
+    generated INSIDE the kernel by the TPU's hardware PRNG. ``seed`` is a
+    traced int32 scalar (vary per round). TPU-only — no interpret mode.
+    """
+    rows, elems = x.shape
+    abs_max = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scales = jnp.maximum(abs_max / 127.0, 1e-30)
+    tile = col_tile(rows, elems)
+    xp = pad_cols(x, tile)
+    grid = xp.shape[1] // tile
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1)
+    values = pl.pallas_call(
+        _quantize_prng_kernel,
+        grid=(grid,),
+        out_shape=jax.ShapeDtypeStruct(xp.shape, jnp.int8),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((rows, tile), lambda j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, tile), lambda j: (0, j),
+                               memory_space=pltpu.VMEM),
+    )(seed_arr, xp, scales)
+    return values[:, :elems], scales
 
 
 def quantize_int8_stochastic(x: jnp.ndarray, seed,

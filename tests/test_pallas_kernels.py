@@ -202,3 +202,30 @@ class TestRingAllreduce:
         for i in range(n):
             np.testing.assert_allclose(out[i], want, rtol=1e-6,
                                        err_msg=f"device {i} of {n}")
+
+
+class TestPrngQuantize:
+    """The in-kernel-PRNG quantize (the TPU production path) — TPU-only:
+    pltpu.prng_* has no interpreter, so these gate on a real chip."""
+
+    @pytest.mark.skipif(jax.default_backend() != "tpu",
+                        reason="pltpu PRNG needs a real TPU")
+    def test_roundtrip_within_one_ulp_and_unbiased(self):
+        from akka_allreduce_tpu.ops.pallas_kernels.quantized import (
+            quantize_int8_prng)
+        x = jax.random.normal(jax.random.key(0), (4, 4096), jnp.float32)
+        v, s = jax.jit(quantize_int8_prng)(x, jnp.int32(3))
+        back = np.asarray(v, np.float32) * np.asarray(s)
+        err = (back - np.asarray(x)) / np.asarray(s)
+        assert np.abs(err).max() < 1.0 + 1e-5       # stochastic floor/ceil
+        assert abs(err.mean()) < 5e-3               # zero-mean rounding
+
+    @pytest.mark.skipif(jax.default_backend() != "tpu",
+                        reason="pltpu PRNG needs a real TPU")
+    def test_seeds_vary_the_rounding(self):
+        from akka_allreduce_tpu.ops.pallas_kernels.quantized import (
+            quantize_int8_prng)
+        x = jax.random.normal(jax.random.key(1), (2, 2048), jnp.float32)
+        v1, _ = jax.jit(quantize_int8_prng)(x, jnp.int32(1))
+        v2, _ = jax.jit(quantize_int8_prng)(x, jnp.int32(2))
+        assert np.asarray(v1 != v2).mean() > 0.01
