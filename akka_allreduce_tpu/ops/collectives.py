@@ -74,7 +74,7 @@ def _quantize_rows(x2d: jnp.ndarray, key: jax.Array
 
     On TPU the default is the in-kernel-PRNG Pallas kernel: producing the
     rounding bits is part of the job, and the hardware PRNG inside the
-    kernel beats threefry outside it by ~68% end to end (dispatch.py /
+    kernel beats threefry outside it by ~50-68% end to end (dispatch.py /
     PERF.md ``ab_int8_e2e_*``). The bits-input kernel
     (AATPU_PALLAS_INT8_PRNG=0 AATPU_PALLAS_INT8=1 — the prng branch is
     consulted first) and the pure jnp form (CPU default) remain

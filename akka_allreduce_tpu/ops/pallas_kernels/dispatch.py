@@ -14,9 +14,9 @@ follow the measured A/B on this repo's real chip (scripts/bench_suite.py
   scale/round/clip/cast chain beats the hand kernel, which pays for
   materialising its random-bits input tile-by-tile. Default: jnp.
 * ``int8_prng`` (quantize with IN-KERNEL hardware PRNG) — Pallas WINS
-  end to end (182 vs 108 GB/s round-trip INCLUDING bits generation,
-  ~+68%; bench_suite.py ``ab_int8_e2e_*``, PERF.md carries the canonical
-  capture): production must generate rounding bits somewhere, and
+  end to end (164-182 vs ~109 GB/s round-trip INCLUDING bits generation,
+  +50-68% across captures; bench_suite.py ``ab_int8_e2e_*``, PERF.md
+  carries the canonical capture): production must generate rounding bits somewhere, and
   threefry outside the kernel costs more than the hardware PRNG inside
   it. Default on TPU: pallas (the production quantize path).
 
