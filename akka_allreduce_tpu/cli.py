@@ -243,6 +243,9 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--attn-window", type=int, default=0,
                    help="sliding-window causal attention: each position "
                         "sees itself + N-1 predecessors (0 = full causal)")
+    p.add_argument("--tie-embeddings", action="store_true",
+                   help="output head reuses the input embedding "
+                        "(GPT-2-style weight tying)")
     p.add_argument("--batch", type=int, default=0,
                    help="global batch (0 = 2 per dp rank)")
     p.add_argument("--seq", type=int, default=0,
@@ -317,6 +320,9 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--attn-window", type=int, default=0,
                    help="sliding-window causal attention: each position "
                         "sees itself + N-1 predecessors (0 = full causal)")
+    p.add_argument("--tie-embeddings", action="store_true",
+                   help="output head reuses the input embedding "
+                        "(GPT-2-style weight tying)")
     p.add_argument("--moe-experts", type=int, default=0)
     p.add_argument("--moe-every", type=int, default=1)
     p.add_argument("--capacity-factor", type=float, default=1.25)
@@ -338,7 +344,8 @@ def _build_model_config(args: argparse.Namespace, max_seq: int):
         n_layers=args.n_layers, d_ff=args.d_ff, max_seq=max_seq,
         moe=moe, moe_every=args.moe_every,
         n_kv_heads=args.kv_heads or None, rope=args.rope, ffn=args.ffn,
-        attn_window=args.attn_window or None)
+        attn_window=args.attn_window or None,
+        tie_embeddings=args.tie_embeddings)
 
 
 def _restore_params(args: argparse.Namespace, mcfg) -> "tuple | int":
@@ -551,19 +558,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             print(f"note: raising --vocab {args.vocab} -> {needed} to "
                   f"cover the corpus (largest token id {needed - 1})")
             args.vocab = needed
-    moe = None
-    if args.moe_experts:
-        from akka_allreduce_tpu.parallel.ep import MoEConfig
-        moe = MoEConfig(n_experts=args.moe_experts, d_ff=args.d_ff,
-                        capacity_factor=args.capacity_factor,
-                        router_k=args.router_k)
-    mcfg = TransformerConfig(vocab_size=args.vocab, d_model=args.d_model,
-                             n_heads=args.n_heads, n_layers=args.n_layers,
-                             d_ff=args.d_ff, max_seq=t,
-                             moe=moe, moe_every=args.moe_every,
-                             n_kv_heads=args.kv_heads or None,
-                             rope=args.rope, ffn=args.ffn,
-                             attn_window=args.attn_window or None)
+    mcfg = _build_model_config(args, t)
     cfg = TrainConfig(model=mcfg, learning_rate=args.lr,
                       bucket_elems=args.bucket_elems, microbatches=micro,
                       compute_dtype="bf16" if args.bf16 else "f32",
@@ -609,7 +604,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
     if chatty:
         print(f"mesh dp={dp} tp={args.tp} sp={args.sp} pp={args.pp} "
               f"ep={args.ep}; batch={b} seq={t} microbatches={micro}"
-              + (f" moe_experts={args.moe_experts}" if moe else "")
+              + (f" moe_experts={args.moe_experts}" if mcfg.moe else "")
               + (f"; {jax.process_count()} processes" if
                  jax.process_count() > 1 else ""))
     tic = time.perf_counter()

@@ -34,6 +34,7 @@ from jax import lax
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
     apply_rope,
+    lm_logits,
     rmsnorm,
 )
 from akka_allreduce_tpu.parallel.ep import moe_ffn
@@ -129,7 +130,7 @@ def decode_step(params: dict, cache: dict, token: jnp.ndarray,
                      * (h @ layer["w3"])) @ layer["w2"]
         else:
             x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
-    logits = rmsnorm(x, params["out_norm"]) @ params["lm_head"]
+    logits = lm_logits(params, rmsnorm(x, params["out_norm"]), cfg)
     new_cache = {"k": k_cache, "v": v_cache, "pos": pos + 1}
     return new_cache, logits[:, 0, :]
 
@@ -169,7 +170,8 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
                      * (h @ layer["w3"])) @ layer["w2"]
         else:
             x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
-    logits = rmsnorm(x[:, -1:], params["out_norm"]) @ params["lm_head"]
+    logits = lm_logits(params, rmsnorm(x[:, -1:], params["out_norm"]),
+                       cfg)
     new_cache = {"k": k_cache, "v": v_cache,
                  "pos": jnp.asarray(t, jnp.int32)}
     return new_cache, logits[:, 0, :]

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
     init_transformer,
+    lm_logits,
     next_token_loss_and_aux,
     rmsnorm,
     transformer_block,
@@ -148,7 +149,9 @@ def param_specs(cfg: TransformerConfig, pp: int = 1) -> dict:
     stay replicated over pp (their grads psum over it in make_grad_step).
     """
     attn, dense_ff, moe_ff = _uniform_layer_spec(cfg)
-    top = {"embed": P(), "out_norm": P(), "lm_head": P()}
+    top = {"embed": P(), "out_norm": P()}
+    if not cfg.tie_embeddings:
+        top["lm_head"] = P()
     if not cfg.rope:
         top["pos"] = P()
     if pp == 1:
@@ -560,7 +563,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             xm = x.reshape(m, b_local // m, t_local, x.shape[-1])
             outs, aux = gpipe_apply(p["layers"], xm, stage, "pp")
             h = outs.reshape(b_local, t_local, outs.shape[-1])
-            logits = rmsnorm(h, p["out_norm"]) @ p["lm_head"]
+            logits = lm_logits(p, rmsnorm(h, p["out_norm"]), mcfg)
             ce_sum, w_sum = weighted_ce(logits, targets, weights)
             if "dispatch_fraction" in aux:
                 # scan_blocks summed over this stage's layers — make it the

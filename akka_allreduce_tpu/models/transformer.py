@@ -62,6 +62,10 @@ class TransformerConfig:
     # (banded tiles skipped -> O(T*window) compute) and the local oracle;
     # not composable with sequence parallelism (sp > 1) yet.
     attn_window: Optional[int] = None
+    # Weight tying (GPT-2 style): the output head reuses the input
+    # embedding transposed — no separate lm_head parameter, vocab x d
+    # fewer weights, and both ends of the model train one matrix.
+    tie_embeddings: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -124,6 +128,15 @@ def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     return (xf * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
 
+def lm_logits(params: dict, x: jnp.ndarray,
+              cfg: TransformerConfig) -> jnp.ndarray:
+    """Output head: the lm_head matmul, or the transposed embedding under
+    weight tying (one shared matrix serving both ends)."""
+    if cfg.tie_embeddings:
+        return x @ params["embed"].T
+    return x @ params["lm_head"]
+
+
 def init_transformer(key: jax.Array, cfg: TransformerConfig,
                      tp: int = 1) -> dict:
     """Full (unsharded) parameters when tp=1; per-rank TP shards when the
@@ -141,10 +154,11 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig,
         "embed": jax.random.normal(next(k), (cfg.vocab_size, cfg.d_model),
                                    dt) * scale,
         "out_norm": jnp.ones((cfg.d_model,), dt),
-        "lm_head": jax.random.normal(next(k), (cfg.d_model, cfg.vocab_size),
-                                     dt) * scale,
         "layers": [],
     }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jax.random.normal(
+            next(k), (cfg.d_model, cfg.vocab_size), dt) * scale
     if not cfg.rope:
         params["pos"] = jax.random.normal(
             next(k), (cfg.max_seq, cfg.d_model), dt) * scale
@@ -318,7 +332,7 @@ def transformer_apply_with_aux(params: dict, tokens: jnp.ndarray,
         aux_total = _merge_aux(aux_total, aux)
 
     x = rmsnorm(x, params["out_norm"])
-    return x @ params["lm_head"], _finalize_aux(aux_total)
+    return lm_logits(params, x, cfg), _finalize_aux(aux_total)
 
 
 def transformer_apply(params: dict, tokens: jnp.ndarray,
