@@ -405,6 +405,13 @@ def _add_generate(sub: argparse._SubParsersAction) -> None:
                    help="tokens to generate")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=None,
+                   help="sample only from the k highest-probability "
+                        "tokens (needs --temperature > 0)")
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling: smallest token set with "
+                        "cumulative probability >= p (needs "
+                        "--temperature > 0; composes with --top-k)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--raw", action="store_true",
                    help="print token ids instead of decoding bytes")
@@ -453,6 +460,23 @@ def _cmd_generate(args: argparse.Namespace) -> int:
         print(f"error: prompt ({len(ids)}) + --tokens ({args.tokens}) "
               f"exceeds --max-seq {max_seq}", file=sys.stderr)
         return 2
+    if args.temperature < 0.0:
+        print(f"error: --temperature must be >= 0, got "
+              f"{args.temperature}", file=sys.stderr)
+        return 2
+    if (args.top_k is not None or args.top_p is not None) \
+            and args.temperature == 0.0:
+        print("error: --top-k/--top-p need --temperature > 0 "
+              "(greedy ignores them)", file=sys.stderr)
+        return 2
+    if args.top_k is not None and args.top_k < 1:
+        print(f"error: --top-k must be >= 1, got {args.top_k}",
+              file=sys.stderr)
+        return 2
+    if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
+        print(f"error: --top-p must be in (0, 1], got {args.top_p}",
+              file=sys.stderr)
+        return 2
     mcfg = _build_model_config(args, max_seq)
     restored = _restore_params(args, mcfg)
     if isinstance(restored, int):
@@ -461,7 +485,8 @@ def _cmd_generate(args: argparse.Namespace) -> int:
     prompt = jnp.asarray(np.asarray(ids, np.int32))[None]
     out = generate(params, prompt, mcfg, steps=args.tokens,
                    key=jax.random.key(args.seed),
-                   temperature=args.temperature)
+                   temperature=args.temperature,
+                   top_k=args.top_k, top_p=args.top_p)
     toks = np.asarray(out)[0].tolist()
     if args.raw or args.prompt_tokens is not None:
         print(",".join(map(str, toks)))

@@ -64,10 +64,17 @@ def _cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
                       v_all: jnp.ndarray, pos: jnp.ndarray,
                       window: "int | None" = None) -> jnp.ndarray:
     """q: (b, 1, h, d); k_all/v_all: (b, max_seq, h_kv, d) with positions
-    <= pos valid. Masked softmax over the full static buffer — the causal
+    <= pos valid. Masked softmax over the static buffer — the causal
     mask IS the length mask at decode time. GQA (h_kv < h) runs as a
     grouped einsum against the NARROW cache: no repeated K/V is ever
-    materialised, so decode reads cache HBM at the reduced width."""
+    materialised, so decode reads cache HBM at the reduced width.
+
+    Sliding-window decode gathers only the last ``window`` cache
+    positions (a static-size ``dynamic_slice`` anchored at pos) before
+    the score einsum, so per-step cost is O(window), not O(max_seq) —
+    positions outside the window contribute exactly 0 to the softmax
+    either way (NEG_INF underflows to 0.0 in exp), so the slice changes
+    cost, not math."""
     # op-for-op the math of local_causal_attention (same scale form, f32
     # score/softmax, same cast points) so cached decode is bit-identical
     # to the full forward at every valid position
@@ -76,12 +83,21 @@ def _cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
     g = h // h_kv
     qg = q.reshape(b, one, h_kv, g, d)
     scale = d ** -0.5
+    if window is not None and window < k_all.shape[1]:
+        # clamp start into [0, max_seq - window]; early positions keep
+        # the full slice and mask the not-yet-written tail below
+        start = jnp.clip(pos - (window - 1), 0, k_all.shape[1] - window)
+        k_all = lax.dynamic_slice_in_dim(k_all, start, window, axis=1)
+        v_all = lax.dynamic_slice_in_dim(v_all, start, window, axis=1)
+        k_idx = start + jnp.arange(window)
+    else:
+        k_idx = jnp.arange(k_all.shape[1])
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all,
                         preferred_element_type=jnp.float32) * scale
-    k_idx = jnp.arange(k_all.shape[1])
+    # the slice construction guarantees every sliced position is within
+    # the window, so `k_idx <= pos` is the whole mask: it cuts the
+    # not-yet-written tail (and, pre-slice, positions beyond pos)
     valid = k_idx <= pos
-    if window is not None:  # sliding window: only the last `window` keys
-        valid = valid & (pos - k_idx < window)
     scores = jnp.where(valid[None, None, None, None, :], scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_all.dtype), v_all,
@@ -177,17 +193,48 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
     return new_cache, logits[:, 0, :]
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "temperature"))
+def _filter_top_k(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Keep the ``top_k`` largest logits per row, NEG_INF the rest (ties
+    at the threshold are kept — harmless, matches common practice)."""
+    vals = lax.top_k(logits, top_k)[0]
+    return jnp.where(logits < vals[..., -1:], NEG_INF, logits)
+
+
+def _filter_top_p(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
+    """Nucleus filter: keep the smallest set of tokens whose probability
+    mass reaches ``top_p``. The kept set is found on the descending sort
+    via an EXCLUSIVE cumulative sum (so the token that crosses the
+    boundary stays in — the set must REACH top_p), then applied to the
+    unsorted logits through the threshold logit, keeping shapes static
+    for the scan."""
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    drop = mass_before >= top_p  # never drops the first token
+    thresh = jnp.min(jnp.where(drop, jnp.inf, sorted_desc),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "temperature",
+                                   "top_k", "top_p"))
 def generate(params: dict, prompt: jnp.ndarray, cfg: TransformerConfig,
              steps: int, key: Optional[jax.Array] = None,
-             temperature: float = 0.0) -> jnp.ndarray:
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None) -> jnp.ndarray:
     """Generate ``steps`` tokens after ``prompt`` (b, t) int32. Greedy when
-    ``temperature == 0`` (key unused), else temperature sampling. Returns
-    (b, steps) int32. One compiled program: prefill scan + decode scan."""
+    ``temperature == 0`` (key unused), else temperature sampling with
+    optional top-k and/or top-p (nucleus) filtering — both static over the
+    sampling mode, so each (mode, shape) pair compiles exactly once.
+    Returns (b, steps) int32. One compiled program: prefill + decode scan."""
     if prompt.shape[1] + steps > cfg.max_seq:
         raise ValueError(
             f"prompt {prompt.shape[1]} + steps {steps} exceeds "
             f"max_seq {cfg.max_seq}")
+    if top_k is not None and not 1 <= top_k:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b = prompt.shape[0]
     cache = init_kv_cache(cfg, b)
     cache, logits = prefill(params, cache, prompt, cfg)
@@ -197,8 +244,12 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: TransformerConfig,
     def pick(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None and top_k < logits.shape[-1]:
+            logits = _filter_top_k(logits, top_k)
+        if top_p is not None and top_p < 1.0:
+            logits = _filter_top_p(logits, top_p)
+        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
     def one(carry, k):
         cache, logits = carry

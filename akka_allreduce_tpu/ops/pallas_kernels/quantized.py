@@ -123,9 +123,11 @@ def _quantize_prng_kernel(seed_ref, x_ref, scales_ref, values_ref):
     """Quantize with IN-KERNEL random bits (pltpu PRNG): no bits tensor
     ever exists in HBM, halving the kernel's input bandwidth — the cost
     that made the bits-input formulation lose its A/B. TPU-only (the
-    pltpu.prng_* primitives have no interpreter path); per-tile seeding
-    offsets the seed by the grid index so tiles draw distinct streams."""
-    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    pltpu.prng_* primitives have no interpreter path); seeding with
+    (seed, tile index) as two independent words keeps every (round, tile)
+    stream distinct — an additive offset would alias (seed s, tile j)
+    with (seed s+1, tile j-1) across rounds."""
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
     scaled = x_ref[:] / scales_ref[:]
     bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
     values_ref[:] = _stochastic_round(scaled, bits).astype(jnp.int8)
