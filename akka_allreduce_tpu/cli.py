@@ -282,6 +282,8 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--max-lag", type=int, default=1,
                    help="in-flight round window for the deadline pacer "
                         "(the reference's maxLag)")
+    p.add_argument("--log-every", type=int, default=10,
+                   help="print a progress line every N steps")
     p.add_argument("--data-file", default=None,
                    help="train on a real corpus: raw bytes (vocab 256) or "
                         "*.bin little-endian uint16 tokens (vocab 65536); "
@@ -514,17 +516,17 @@ def _cmd_train(args: argparse.Namespace) -> int:
         # the env var alone is overridden by site customization here)
         jax.config.update("jax_platforms", args.platform)
     if args.coordinator:
-        if args.deadline_ms:
-            print("error: --coordinator with --deadline-ms is not wired "
-                  "yet (the mask rows need global placement)",
-                  file=sys.stderr)
-            return 2
         from akka_allreduce_tpu.runtime.coordinator import \
             initialize_distributed
         initialize_distributed(args.coordinator, args.num_processes,
                                args.process_id)
+    # --coordinator + --deadline-ms = the hybrid topology: exact device
+    # collectives on each process's LOCAL mesh, deadline-gated masked
+    # sync ACROSS processes over DCN (runtime/dcn_train.py) — straggler
+    # processes are masked per round instead of stalling the cluster
+    hybrid = bool(args.coordinator) and args.deadline_ms > 0
     chatty = jax.process_index() == 0
-    n_dev = len(jax.devices())
+    n_dev = len(jax.local_devices()) if hybrid else len(jax.devices())
     model_par = args.tp * args.sp * args.pp * args.ep
     dp = args.dp or max(1, n_dev // model_par)
     if dp * model_par != n_dev:
@@ -532,7 +534,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
               f"{n_dev} devices", file=sys.stderr)
         return 2
     mesh = make_device_mesh(MeshSpec(dp=dp, tp=args.tp, sp=args.sp,
-                                     pp=args.pp, ep=args.ep))
+                                     pp=args.pp, ep=args.ep),
+                            devices=(jax.local_devices() if hybrid
+                                     else None))
     if args.microbatches > 1 and args.pp == 1:
         print("error: --microbatches requires --pp > 1 (microbatching "
               "only exists on the pipeline path)", file=sys.stderr)
@@ -567,7 +571,13 @@ def _cmd_train(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     micro = args.microbatches or (args.pp if args.pp > 1 else 1)
-    b = args.batch or 2 * dp * args.ep * micro
+    nprocs = jax.process_count()
+    b = args.batch or 2 * dp * args.ep * micro * (nprocs if hybrid else 1)
+    if hybrid and b % nprocs:
+        print(f"error: --batch {b} must divide evenly over "
+              f"{nprocs} processes (each feeds batch/{nprocs} rows to "
+              f"its local mesh)", file=sys.stderr)
+        return 2
     t = args.seq or 32 * args.sp
     corpus = None
     if args.data_file:
@@ -593,15 +603,23 @@ def _cmd_train(args: argparse.Namespace) -> int:
                       warmup_steps=args.warmup_steps,
                       total_steps=args.steps, clip_norm=args.clip_norm)
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
-    dynamic = args.deadline_ms > 0
-    # donate: the loop rebinds params/opt_state every step and the
-    # checkpoint manager saves the freshly-returned arrays, so the old
-    # buffers are never read again — donation halves their HBM residency.
-    # (Safe with async checkpointing: orbax copies device arrays to host
-    # BEFORE its save() returns; only the file write is async.)
-    step = make_train_step(cfg, mesh, opt, dynamic_valid=dynamic,
-                           donate=True)
+    dynamic = args.deadline_ms > 0 and not hybrid
     trainer = None
+    dcn = None
+    if hybrid:
+        from akka_allreduce_tpu.runtime.dcn_train import DcnDeadlineTrainer
+        dcn = DcnDeadlineTrainer(cfg, mesh, opt,
+                                 deadline_s=args.deadline_ms / 1e3)
+        step = None
+    else:
+        # donate: the loop rebinds params/opt_state every step and the
+        # checkpoint manager saves the freshly-returned arrays, so the old
+        # buffers are never read again — donation halves their HBM
+        # residency. (Safe with async checkpointing: orbax copies device
+        # arrays to host BEFORE its save() returns; only the file write
+        # is async.)
+        step = make_train_step(cfg, mesh, opt, dynamic_valid=dynamic,
+                               donate=True)
     if dynamic:
         from akka_allreduce_tpu.models.train import (data_rank_count,
                                                      dense_bucket_count)
@@ -625,6 +643,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
         if start and chatty:
             print(f"resumed from step {start - 1} "
                   f"(data position {extra.get('data_step', '?')})")
+        if hybrid and not chatty:
+            # hybrid params are replicated per process: every process
+            # restores, only process 0 writes (one writer per directory)
+            mgr.close()
+            mgr = None
 
     if chatty:
         print(f"mesh dp={dp} tp={args.tp} sp={args.sp} pp={args.pp} "
@@ -632,18 +655,80 @@ def _cmd_train(args: argparse.Namespace) -> int:
               + (f" moe_experts={args.moe_experts}" if mcfg.moe else "")
               + (f"; {jax.process_count()} processes" if
                  jax.process_count() > 1 else ""))
+    def build_batch(i):
+        # deterministic per-step data stream: a resumed run sees the
+        # same tokens the dead run would have
+        step_rng = np.random.default_rng(i)
+        if corpus is not None:
+            return step_rng, corpus.batch(i, b, t)
+        return step_rng, step_rng.integers(0, args.vocab, size=(b, t),
+                                           dtype=np.int32)
+
     tic = time.perf_counter()
     steps_in_window = 0
     try:
+        if hybrid:
+            # round-driven loop: a process that caught up after a stall
+            # advances several rounds per call, so the loop must stop at
+            # the same final ROUND everywhere — an iteration count would
+            # send the laggard past the master's last round, waiting for
+            # a mask that never comes
+            dcn.set_start_round(start)
+            rows = b // nprocs
+            rank = jax.process_index()
+            while True:
+                params, opt_state, replayed = dcn.catch_up(params,
+                                                           opt_state)
+                if replayed:
+                    # always narrated (not just on process 0): the
+                    # catching-up process is by definition a worker, and
+                    # this is the one event its operator needs to see
+                    print(f"process {rank}: caught up {replayed} "
+                          f"rounds from DCN retention (stall ended at "
+                          f"round {dcn.round})")
+                i = dcn.round
+                if i >= args.steps:
+                    break
+                step_rng, batch_np = build_batch(i)
+                # each process is a macro data rank: it feeds ITS slice
+                # of the global batch to its local mesh; the cross-
+                # process reduction is the DCN trainer's job
+                tokens = jnp.asarray(
+                    batch_np[rank * rows:(rank + 1) * rows])
+                if args.straggle_prob and rank > 0:
+                    # simulated straggling through the REAL wall clock:
+                    # this process simply publishes late (the master,
+                    # whose stall would stall everyone, never simulates)
+                    if step_rng.random(nprocs)[rank] < args.straggle_prob:
+                        time.sleep(1.5 * dcn.deadline_s)
+                params, opt_state, rep = dcn.run_round(
+                    params, opt_state, tokens)
+                if mgr is not None:
+                    mgr.maybe_save(i, params, opt_state, {"data_step": i})
+                steps_in_window += 1
+                if i == start or (i + 1) % args.log_every == 0:
+                    dt = time.perf_counter() - tic
+                    cu = (f" caught up {rep.caught_up} rounds"
+                          if rep.caught_up else "")
+                    if chatty:
+                        print(f"step {i + 1:4d}: loss {rep.loss:.4f} "
+                              f"({b * t * steps_in_window / dt:.0f} "
+                              f"tok/s) [masked {rep.n_masked}/{nprocs} "
+                              f"procs{cu}]")
+                    tic = time.perf_counter()
+                    steps_in_window = 0
+            if chatty:
+                print(f"lossy rounds: {dcn.masked_round_count}/"
+                      f"{len(dcn.reports)} had masked processes")
+            dcn.close()
+            if mgr is not None:
+                final = args.steps - 1
+                if args.steps > start and mgr.latest_step() != final:
+                    mgr.save(final, params, opt_state,
+                             {"data_step": final}, force=True)
+            return 0
         for i in range(start, args.steps):
-            # deterministic per-step data stream: a resumed run sees the
-            # same tokens the dead run would have
-            step_rng = np.random.default_rng(i)
-            if corpus is not None:
-                batch_np = corpus.batch(i, b, t)
-            else:
-                batch_np = step_rng.integers(0, args.vocab, size=(b, t),
-                                             dtype=np.int32)
+            step_rng, batch_np = build_batch(i)
             if jax.process_count() > 1:
                 # every process computed the same global batch; build the
                 # global array from per-process addressable shards
@@ -670,7 +755,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             if mgr is not None:
                 mgr.maybe_save(i, params, opt_state, {"data_step": i})
             steps_in_window += 1
-            if i == start or (i + 1) % 10 == 0:
+            if i == start or (i + 1) % args.log_every == 0:
                 loss = float(jax.block_until_ready(metrics["loss"]))
                 toks = float(metrics["tokens"])
                 dt = time.perf_counter() - tic

@@ -1,0 +1,395 @@
+"""Multi-host dynamic straggler tolerance: deadline-gated DCN gradient sync.
+
+This module composes the framework's two flagship halves — the multi-host
+deployment and the dynamic per-round straggler deadlines — into one
+training topology (the round-2 verdict's top integration ask):
+
+* **Within a process** the device plane runs exact: the jitted grad step
+  syncs gradients across the process's local mesh with XLA collectives
+  over ICI (models/train.py ``make_grad_step``).
+* **Across processes** the host plane runs the reference's protocol:
+  every round each process publishes its locally-reduced gradient vector
+  to the coordination-service KV store (the DCN fabric JAX already runs,
+  protocol/kv.py) and sends a ``CompleteAllreduce`` arrival report to the
+  master (process 0) over the :class:`KvRouter` — the exact worker->master
+  flow of the reference (reference: AllreduceMessage.scala:21,
+  AllreduceMaster.scala:54-63). The master feeds the reports into a
+  :class:`RoundClock` (runtime/pacer.py), closes the round early when
+  everyone arrived or at the deadline otherwise, and publishes the
+  resulting contribution mask. Survivors apply the masked,
+  count-rescaled mean — honest counts, unbiased scale-up, the TPU
+  rendering of thresholds < 1 (reference: ScatteredDataBuffer.scala:9-13,
+  ReducedDataBuffer.scala:40-48).
+
+A straggling process (SIGSTOP, GC pause, slow host) simply misses its
+deadlines: the cluster keeps training without it, every round's counts
+reporting the gap. When it wakes it **catches up deterministically** —
+missed rounds' masks and contributor payloads are retained in the KV
+store for ``retain_rounds``, so it replays the exact updates the
+survivors applied (its own stale contributions were masked out, so
+replay equals the survivors' history bit-for-bit) and rejoins the mask
+at the current round — the reference's maxLag catch-up re-imagined
+(reference: AllreduceWorker.scala:100-106). A stall beyond the retention
+window raises, directing the operator to checkpoint resume
+(runtime/checkpoint.py).
+
+The first round is a quorum barrier (no deadline): the master waits for
+every process once, like the reference master holding ``StartAllreduce``
+until ``totalWorkers`` joined (reference: AllreduceMaster.scala:39).
+
+The gradient payload crosses DCN as one f32 vector per process per round
+(header: local loss + token count). Chunking/fusion granularity lives in
+the device plane's bucketing; the host payload is the whole vector, like
+the reference worker's full ``dataSize`` contribution per round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from akka_allreduce_tpu.messages import CompleteAllreduce
+from akka_allreduce_tpu.models.train import make_grad_step
+from akka_allreduce_tpu.ops.bucketing import (
+    tree_bucket_spec,
+    tree_to_vector,
+    vector_to_tree,
+)
+from akka_allreduce_tpu.protocol.kv import KvRouter, _default_client
+from akka_allreduce_tpu.runtime.pacer import RoundClock
+
+_HDR = struct.Struct("<ff")  # local loss, local token count
+
+
+@dataclasses.dataclass
+class DcnRoundReport:
+    """One cross-process round as the host saw it."""
+
+    round: int
+    valid_peers: tuple[bool, ...]
+    n_masked: int
+    loss: float  # mean of contributors' local losses
+    caught_up: int = 0  # rounds replayed before this one (post-stall)
+
+
+class DcnDeadlineTrainer:
+    """Deadline-gated cross-process training rounds.
+
+    Use one instance per process, same constructor arguments everywhere
+    (process identity comes from ``jax.process_index()``). ``cfg`` /
+    ``mesh`` / ``opt`` describe the process-LOCAL training step — the mesh
+    must be built over this process's own devices only
+    (``jax.local_devices()``); the cross-process reduction is this
+    class's job, not XLA's.
+    """
+
+    def __init__(self, cfg, mesh, opt, *, deadline_s: float,
+                 namespace: str = "aatdcn", retain_rounds: int = 64,
+                 barrier_timeout_s: float = 300.0, client=None,
+                 rank: Optional[int] = None,
+                 num_processes: Optional[int] = None):
+        if deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        if retain_rounds < 2:
+            raise ValueError("retain_rounds must be >= 2")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.opt = opt
+        self.deadline_s = float(deadline_s)
+        self.retain = int(retain_rounds)
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self.rank = jax.process_index() if rank is None else int(rank)
+        self.nprocs = (jax.process_count() if num_processes is None
+                       else int(num_processes))
+        self.master = self.rank == 0
+        self.ns = namespace
+        self._kv = client if client is not None else _default_client()
+        # arrival reports ride the router (worker -> master messaging with
+        # per-sender FIFO); bulk gradient payloads ride plain KV entries
+        self.router = KvRouter(rank=self.rank,
+                               role="master" if self.master else "worker",
+                               namespace=f"{namespace}/msg",
+                               client=self._kv)
+        self._self_ref = self.router.register("trainer", self._on_message)
+        self.clock = RoundClock(self.nprocs, deadline_s=self.deadline_s) \
+            if self.master else None
+        self._round = 0
+        self._start_round = 0
+        self.reports: list[DcnRoundReport] = []
+        self._gstep = jax.jit(make_grad_step(cfg, mesh))
+        self._flat = jax.jit(lambda g: tree_to_vector(g, jnp.float32))
+        self._spec = None
+        self._apply = None
+
+    # -- keys ---------------------------------------------------------------
+
+    def _try_get(self, key: str) -> Optional[str]:
+        """try-get that treats a missing key as None (the service client
+        raises NOT_FOUND instead)."""
+        try:
+            return self._kv.key_value_try_get(key)
+        except Exception:
+            return None
+
+    def _gkey(self, r: int, p: int) -> str:
+        return f"{self.ns}/g/{r:012d}/{p:04d}"
+
+    def _maskkey(self, r: int) -> str:
+        return f"{self.ns}/mask/{r:012d}"
+
+    @property
+    def _roundkey(self) -> str:
+        return f"{self.ns}/round"
+
+    # -- master-side arrival handling ---------------------------------------
+
+    def _on_message(self, msg) -> None:
+        if self.master and isinstance(msg, CompleteAllreduce):
+            # reports for long-closed rounds land harmlessly: valid_peers
+            # reads only rounds the clock still has open state for
+            self.clock.report_arrival(msg.round, msg.src_id)
+
+    def _master_collect(self, r: int) -> list[bool]:
+        """Pump arrival reports; close early when all arrived, else at the
+        deadline. Round 0 is the quorum barrier: wait for everyone."""
+        deadline_at = self.clock.opened_at(r) + self.deadline_s
+        barrier_at = time.monotonic() + self.barrier_timeout_s
+        barrier = r == self._start_round
+        while True:
+            self.router.poll(0.005)
+            arrived = self.clock.arrival_count(r)
+            if arrived >= self.nprocs:
+                break
+            now = time.monotonic()
+            if barrier:
+                if now >= barrier_at:
+                    raise TimeoutError(
+                        f"quorum barrier: only {arrived}/"
+                        f"{self.nprocs} processes joined within "
+                        f"{self.barrier_timeout_s}s")
+            elif now >= deadline_at:
+                break
+        if barrier:
+            mask = [True] * self.nprocs
+        else:
+            mask = self.clock.valid_peers(r)
+            # the master pins itself in: it is the pacer, so its own
+            # contribution is the round's reference point — if even the
+            # master blew the deadline (a too-tight --deadline-ms or a
+            # slow step), the round simply ran long; masking the pacer
+            # would make the mask empty and zero the round
+            mask[0] = True
+        self._kv.key_value_set(self._maskkey(r),
+                               "".join("1" if v else "0" for v in mask),
+                               allow_overwrite=False)
+        self.clock.expire(r - 1)
+        return mask
+
+    def _read_mask(self, r: int) -> list[bool]:
+        """Wait for the master's mask with diagnosable failure modes: a
+        mask already deleted because we stalled past retention raises the
+        checkpoint-resume guidance (a process can stall INSIDE run_round,
+        where catch_up's identical check never runs), and a master that
+        stopped publishing altogether times out with its own message."""
+        deadline = time.monotonic() + self.deadline_s * 2 \
+            + self.barrier_timeout_s
+        while True:
+            s = self._try_get(self._maskkey(r))
+            if s is not None:
+                return [c == "1" for c in s]
+            cur_s = self._try_get(self._roundkey)
+            if cur_s is not None and int(cur_s) - r >= self.retain:
+                raise RuntimeError(
+                    f"stalled at round {r} while the cluster reached "
+                    f"{cur_s}, beyond the {self.retain}-round retention "
+                    f"window — resume from the last checkpoint instead "
+                    f"(runtime/checkpoint.py)")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no mask for round {r}: the master stopped "
+                    f"publishing (its death halts the run, like the "
+                    f"reference's master actor)")
+            time.sleep(0.01)
+
+    # -- the masked cross-process reduction ---------------------------------
+
+    def _ensure_apply(self, grads) -> None:
+        if self._apply is not None:
+            return
+        self._spec = tree_bucket_spec(grads, self.cfg.bucket_elems)
+        spec = self._spec
+        opt = self.opt
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def apply(params, opt_state, vec):
+            g = vector_to_tree(vec, spec)
+            updates, opt_state = opt.update(g, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state
+
+        self._apply = apply
+
+    def _get_payload(self, r: int, p: int) -> bytes:
+        """Fetch a contributor's payload, polling with a clear failure
+        mode: a missing key after the wait window names the round and
+        rank instead of surfacing an opaque KV timeout."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                return self._kv.key_value_try_get_bytes(self._gkey(r, p))
+            except Exception:
+                pass
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"round {r}: contributor {p}'s gradient payload is "
+                    f"missing from the KV store (masked-in but deleted? "
+                    f"stalled beyond the {self.retain}-round retention "
+                    f"window?) — resume from the last checkpoint")
+            time.sleep(0.02)
+
+    def _apply_round(self, params, opt_state, r: int, mask: list[bool],
+                     own: Optional[bytes], caught_up: int = 0):
+        """Mean the contributors' local-mean gradients (fixed rank order,
+        so every process computes the bit-identical reduction) and run
+        the jitted optimizer apply. Each payload is the gradient of that
+        process's LOCAL-batch mean loss (grad_local divides by the local
+        token count), so the mean over contributors estimates the global
+        batch-mean gradient — unbiased under masking, and identical to
+        the global-mesh gradient when everyone contributes (equal local
+        batch sizes)."""
+        total = None
+        losses = []
+        count = 0
+        for p in range(self.nprocs):
+            if not mask[p]:
+                continue
+            if p == self.rank and own is not None:
+                data = own
+            else:
+                data = self._get_payload(r, p)
+            loss_p, _toks = _HDR.unpack_from(data)
+            vec = np.frombuffer(data, np.float32, offset=_HDR.size)
+            total = vec.copy() if total is None else total + vec
+            losses.append(loss_p)
+            count += 1
+        assert count > 0, \
+            "mask can never be empty (the master pins itself in)"
+        total /= count
+        params, opt_state = self._apply(params, opt_state,
+                                        jnp.asarray(total))
+        rep = DcnRoundReport(
+            round=r, valid_peers=tuple(mask),
+            n_masked=self.nprocs - count,
+            loss=float(np.mean(losses)), caught_up=caught_up)
+        self.reports.append(rep)
+        return params, opt_state, rep
+
+    @property
+    def round(self) -> int:
+        """The next round this process will run (or replay). Drive the
+        training loop on THIS, not a loop counter: a process that caught
+        up after a stall advances several rounds per ``run_round`` call,
+        and everyone must stop at the same final round number or the
+        laggard waits for a mask the master will never publish."""
+        return self._round
+
+    def set_start_round(self, r: int) -> None:
+        """Start counting rounds at ``r`` (checkpoint resume). Must be
+        called before the first :meth:`run_round`; the quorum barrier
+        applies to the first round whatever its number."""
+        if self._round != self._start_round:
+            raise RuntimeError("set_start_round after rounds already ran")
+        self._round = self._start_round = int(r)
+
+    # -- catch-up after a stall ---------------------------------------------
+
+    def catch_up(self, params, opt_state) -> tuple[Any, Any, int]:
+        """Replay rounds the cluster completed while this process was
+        stalled. Masks/payloads are retained ``retain_rounds`` deep; our
+        own stale contributions were masked out of those rounds, so the
+        replayed updates equal the survivors' updates exactly."""
+        if self.master:
+            return params, opt_state, 0
+        cur_s = self._try_get(self._roundkey)
+        if cur_s is None:
+            return params, opt_state, 0
+        cur = int(cur_s)
+        if cur <= self._round:
+            return params, opt_state, 0
+        if self._round < cur - self.retain + 1:
+            raise RuntimeError(
+                f"stalled for {cur - self._round} rounds, beyond the "
+                f"{self.retain}-round retention window — resume from the "
+                f"last checkpoint instead (runtime/checkpoint.py)")
+        replayed = 0
+        while self._round < cur:
+            r = self._round
+            mask_s = self._try_get(self._maskkey(r))
+            if mask_s is None:
+                break  # master is mid-round r: rejoin the normal flow
+            mask = [c == "1" for c in mask_s]
+            params, opt_state, _ = self._apply_round(
+                params, opt_state, r, mask, own=None, caught_up=0)
+            self._round += 1
+            replayed += 1
+        if replayed:
+            self.reports[-1] = dataclasses.replace(self.reports[-1],
+                                                   caught_up=replayed)
+        return params, opt_state, replayed
+
+    # -- the public round ----------------------------------------------------
+
+    def run_round(self, params, opt_state, tokens):
+        """One cross-process training round: local grad step -> publish ->
+        arrival report -> mask -> masked mean -> optimizer apply. Returns
+        ``(params, opt_state, DcnRoundReport)``."""
+        params, opt_state, replayed = self.catch_up(params, opt_state)
+        r = self._round
+        if self.master:
+            self._kv.key_value_set(self._roundkey, str(r),
+                                   allow_overwrite=True)
+            self.clock.open_round(r)
+        grads, metrics = self._gstep(params, tokens, jnp.uint32(r))
+        self._ensure_apply(grads)
+        vec = np.asarray(self._flat(grads), np.float32)
+        loss = float(metrics["loss"])
+        payload = _HDR.pack(loss, float(metrics["tokens"])) + vec.tobytes()
+        self._kv.key_value_set_bytes(self._gkey(r, self.rank), payload)
+        if self.master:
+            self.clock.report_arrival(r, 0)
+            mask = self._master_collect(r)
+        else:
+            self.router.send(self.router.ref_of(0),
+                             CompleteAllreduce(src_id=self.rank, round=r))
+            mask = self._read_mask(r)
+        params, opt_state, rep = self._apply_round(
+            params, opt_state, r, mask, own=payload, caught_up=replayed)
+        self._round += 1
+        self._cleanup(r)
+        return params, opt_state, rep
+
+    def _cleanup(self, r: int) -> None:
+        old = r - self.retain
+        if old < 0:
+            return
+        try:
+            self._kv.key_value_delete(self._gkey(old, self.rank))
+            if self.master:
+                self._kv.key_value_delete(self._maskkey(old))
+        except Exception:
+            pass  # best-effort GC; missing keys are fine
+
+    @property
+    def masked_round_count(self) -> int:
+        return sum(1 for rep in self.reports if rep.n_masked)
+
+    def close(self) -> None:
+        self.router.close()

@@ -91,6 +91,14 @@ class RoundClock:
     def is_open(self, round_: int) -> bool:
         return round_ in self._round_open
 
+    def opened_at(self, round_: int) -> float:
+        """Monotonic time the round's deadline clock started."""
+        return self._round_open[round_]
+
+    def arrival_count(self, round_: int) -> int:
+        """How many peers have reported for the round (on time or not)."""
+        return len(self._arrivals.get(round_, ()))
+
     def report_arrival(self, round_: int, peer: int,
                        at: Optional[float] = None) -> None:
         self._arrivals.setdefault(round_, {})[peer] = \
@@ -118,7 +126,12 @@ class RoundClock:
 
     def expire(self, up_to_round: int) -> None:
         """Forget state for rounds below ``up_to_round`` (the ring
-        rotation)."""
+        rotation). Sweeps arrivals independently of open state: a late
+        report for an already-expired round re-creates an arrivals entry
+        (report_arrival's setdefault) with no matching open record, and
+        an open-keyed sweep alone would leak those forever under a
+        chronically straggling peer."""
         for r in [r for r in self._round_open if r < up_to_round]:
             del self._round_open[r]
-            self._arrivals.pop(r, None)
+        for r in [r for r in self._arrivals if r < up_to_round]:
+            del self._arrivals[r]

@@ -1,0 +1,135 @@
+"""Multi-host dynamic straggler deadlines: the two flagship halves composed.
+
+The round-2 verdict's top ask: `train --coordinator --deadline-ms` must
+run — exact device collectives on each process's local mesh, deadline-
+gated masked gradient sync across processes over the coordination-service
+KV fabric (runtime/dcn_train.py). The test SIGSTOPs a worker process
+mid-run: the survivors must keep training with masked rounds and honest
+counts (reference: AllreduceWorker.scala:100-106 straggler tolerance,
+application.conf:20 auto-down), and the resumed process must catch up
+(replaying retained rounds) and rejoin the mask.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from akka_allreduce_tpu.protocol.remote import free_port
+
+STEPS = 14
+
+
+def _spawn(port, i, extra=()):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    return subprocess.Popen(
+        [sys.executable, "-u", "-m", "akka_allreduce_tpu.cli", "train",
+         "--platform", "cpu",
+         "--coordinator", f"127.0.0.1:{port}",
+         "--num-processes", "3", "--process-id", str(i),
+         "--steps", str(STEPS), "--batch", "6", "--seq", "16",
+         "--d-model", "32", "--n-heads", "4", "--n-layers", "2",
+         "--d-ff", "64", "--dp", "2",
+         "--deadline-ms", "1500", "--log-every", "1", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        bufsize=1, env=env)
+
+
+@pytest.mark.slow
+@pytest.mark.xdist_group("cluster-procs")
+class TestDcnDeadlineChain:
+    def test_sigstop_worker_masked_then_rejoins(self):
+        """3 processes; SIGSTOP process 2 at step 4, SIGCONT at step 10.
+
+        Asserts the verdict's done-criteria: survivors keep training with
+        masked rounds (honest 1/3-masked narration), losses stay finite,
+        the stopped process catches up and exits cleanly, and post-resume
+        rounds run unmasked again."""
+        port = free_port()
+        procs = [_spawn(port, i) for i in range(3)]
+        lines: list[str] = []
+        state = {"stopped": False, "resumed": False}
+
+        def pump():
+            for line in procs[0].stdout:
+                lines.append(line.rstrip())
+                if "step    4" in line and not state["stopped"]:
+                    state["stopped"] = True
+                    os.kill(procs[2].pid, signal.SIGSTOP)
+                if "step   10" in line and state["stopped"] \
+                        and not state["resumed"]:
+                    state["resumed"] = True
+                    os.kill(procs[2].pid, signal.SIGCONT)
+
+        t = threading.Thread(target=pump)
+        t.start()
+        rcs = []
+        deadline = time.time() + 480
+        try:
+            for p in procs:
+                rcs.append(p.wait(timeout=max(5, deadline - time.time())))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        os.kill(p.pid, signal.SIGCONT)
+                    except OSError:
+                        pass
+                    p.kill()
+        t.join(timeout=15)
+        out = "\n".join(lines)
+        tails = [p.stdout.read() or "" for p in procs]
+        assert state["stopped"] and state["resumed"], out
+        assert rcs == [0, 0, 0], (rcs, out, tails[1][-800:],
+                                  tails[2][-800:])
+        # survivors trained through the stall with honest masked counts
+        masked = [ln for ln in lines if "[masked 1/3" in ln]
+        assert masked, out
+        # every narrated loss stayed finite
+        for ln in lines:
+            if "loss" in ln and "step" in ln:
+                val = float(ln.split("loss")[1].split()[0])
+                assert val == val and val < 1e9, ln
+        # the run completed all steps and summarised the lossy rounds
+        assert f"step   {STEPS}" in out, out
+        summary = [ln for ln in lines if "lossy rounds" in ln]
+        assert summary and int(summary[0].split(":")[1].split("/")[0]) >= 1
+        # after SIGCONT the cluster converged back to unmasked rounds:
+        # the LAST narrated round has everyone back in the mask
+        last_masked = [ln for ln in lines if "[masked" in ln][-1]
+        assert "[masked 0/3" in last_masked, out
+
+    def test_straggle_prob_simulation_runs(self):
+        """2 processes with --straggle-prob: simulated late publishes via
+        the real wall clock produce masked rounds without any signal
+        games; both processes exit cleanly."""
+        port = free_port()
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        procs = [subprocess.Popen(
+            [sys.executable, "-u", "-m", "akka_allreduce_tpu.cli",
+             "train", "--platform", "cpu",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--steps", "8", "--batch", "4", "--seq", "16",
+             "--d-model", "32", "--n-heads", "4", "--n-layers", "1",
+             "--d-ff", "64", "--dp", "2",
+             "--deadline-ms", "700", "--straggle-prob", "0.45",
+             "--log-every", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for i in range(2)]
+        outs = []
+        for i, p in enumerate(procs):
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+            assert p.returncode == 0, f"proc {i}:\n{out[-2000:]}"
+        # seeded straggle draws: with p=0.45 over 8 rounds the non-master
+        # process misses at least one deadline in practice; assert the
+        # machinery reported at least one masked round
+        assert "[masked 1/2" in outs[0], outs[0]
+        assert "lossy rounds" in outs[0]

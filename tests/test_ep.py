@@ -235,6 +235,7 @@ class TestScatterDispatch:
             f, has_aux=True)(params, x)
         return y, aux, grads
 
+    @pytest.mark.slow
     def test_outputs_and_aux_match_einsum(self):
         y_e, aux_e, _ = self._run("einsum")
         y_s, aux_s, _ = self._run("scatter")
@@ -297,6 +298,7 @@ class TestScatterDispatch:
         with pytest.raises(ValueError, match="dispatch"):
             moe_ffn(make_x(1, 4), params, cfg, axis_name=None)
 
+    @pytest.mark.slow
     def test_sharded_scatter_equals_local(self):
         ep = 4
         # generous capacity: sharded capacity is per-RANK (the documented

@@ -184,6 +184,7 @@ class TestTopKTopP:
                       key=jax.random.key(5), temperature=1.0, top_k=1)
         np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
 
+    @pytest.mark.slow
     def test_determinism_under_key(self):
         """Same key -> identical tokens; different key -> different, for
         both top-k and top-p modes (the VERDICT's asked-for pin)."""

@@ -124,6 +124,7 @@ class TestGQA:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_flash_gqa_gradients_match_oracle(self):
         """dk/dv must ACCUMULATE over the query group (the folded inner
         grid axis in the dkv kernel) — the bug a per-q-head grid would

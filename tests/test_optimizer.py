@@ -62,6 +62,7 @@ class TestSchedule:
         with pytest.raises(ValueError, match="lr_schedule"):
             make_lr_schedule(cfg)
 
+    @pytest.mark.slow
     def test_warmup_applies_in_train_step(self):
         """During warmup the effective LR is tiny: the first-step update
         under warmup must be far smaller than without it."""
@@ -86,6 +87,7 @@ class TestSchedule:
 
 
 class TestClipping:
+    @pytest.mark.slow
     def test_clip_bounds_update_under_huge_grads(self):
         """Scale the loss by 1e6: without clipping adam's first-step
         update is ~lr regardless, but the INNER clipped gradient must obey

@@ -126,6 +126,7 @@ class TestQuantizedAllreduce:
 
 
 class TestInt8GradSync:
+    @pytest.mark.slow
     def test_grad_sync_matches_f32_within_quant_error(self):
         mesh = single_axis_mesh("dp")
         grads = {"w": jnp.asarray(

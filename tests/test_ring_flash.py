@@ -142,6 +142,7 @@ class TestBackward:
                                        rtol=2e-3, atol=2e-4,
                                        err_msg=f"d{name} mismatch")
 
+    @pytest.mark.slow
     def test_grads_match_pure_jax_ring(self):
         """Same local-loss cotangents through both ring implementations
         must agree exactly (they share the schedule, not the code)."""
@@ -174,6 +175,7 @@ class TestBackward:
 
 
 class TestTrainIntegration:
+    @pytest.mark.slow
     def test_train_step_grads_match_pure_ring(self, monkeypatch):
         """FULL dp x sp train grad step with the ring-flash kernel forced
         (interpret mode) must match the pure-JAX-ring path."""

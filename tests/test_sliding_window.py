@@ -125,6 +125,7 @@ class TestModelIntegration:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0] - 0.2, losses
 
+    @pytest.mark.slow
     def test_forced_flash_window_matches_forced_local(self):
         from akka_allreduce_tpu.models.train import (TrainConfig,
                                                      make_grad_step,

@@ -126,6 +126,7 @@ class TestPPMoE:
 
 
 class TestPPTrainStep:
+    @pytest.mark.slow
     def test_full_step_runs_and_learns(self):
         mesh = make_device_mesh(MeshSpec(dp=2, pp=4))
         cfg = TrainConfig(model=MCFG, bucket_elems=256, microbatches=2)

@@ -43,6 +43,7 @@ class TestTying:
             np.asarray(transformer_apply(spliced, toks(), untied_cfg)),
             np.asarray(out), atol=1e-6)
 
+    @pytest.mark.slow
     def test_gradient_flows_from_both_ends(self):
         """The tied matrix receives gradient from the input gather AND
         the output matmul — its grad must differ from the untied embed
