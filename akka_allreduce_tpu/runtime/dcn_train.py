@@ -123,6 +123,7 @@ class DcnDeadlineTrainer:
             if self.master else None
         self._round = 0
         self._start_round = 0
+        self._cleaned_to = 0
         self.reports: list[DcnRoundReport] = []
         self._gstep = jax.jit(make_grad_step(cfg, mesh))
         self._flat = jax.jit(lambda g: tree_to_vector(g, jnp.float32))
@@ -159,7 +160,23 @@ class DcnDeadlineTrainer:
 
     def _master_collect(self, r: int) -> list[bool]:
         """Pump arrival reports; close early when all arrived, else at the
-        deadline. Round 0 is the quorum barrier: wait for everyone."""
+        deadline. The first round is the quorum barrier: wait for
+        everyone.
+
+        The deadline clock opens HERE — after the master's own grad step
+        and publish — not at round start: arrivals are timestamped when
+        the master's poll delivers them (CompleteAllreduce carries no
+        cross-process-comparable clock), and the master cannot poll while
+        its own step runs, so an open-at-round-start deadline would stamp
+        every worker that published during the master's compute at
+        open + master_step and falsely mask them all whenever the
+        master's step time approaches the deadline. Opening at collect
+        start makes the deadline mean what an operator expects: 'how long
+        the master waits for peers once ITS contribution is ready' — the
+        reference's master likewise paces rounds from its own state
+        (reference: AllreduceMaster.scala:54-63)."""
+        self.clock.open_round(r)
+        self.clock.report_arrival(r, 0)
         deadline_at = self.clock.opened_at(r) + self.deadline_s
         barrier_at = time.monotonic() + self.barrier_timeout_s
         barrier = r == self._start_round
@@ -237,11 +254,13 @@ class DcnDeadlineTrainer:
 
         self._apply = apply
 
-    def _get_payload(self, r: int, p: int) -> bytes:
+    def _get_payload(self, r: int, p: int, wait_s: float = 30.0) -> bytes:
         """Fetch a contributor's payload, polling with a clear failure
         mode: a missing key after the wait window names the round and
-        rank instead of surfacing an opaque KV timeout."""
-        deadline = time.monotonic() + 30.0
+        rank instead of surfacing an opaque KV timeout. Replay passes a
+        SHORT window — a replayed round's payloads either exist already
+        or were garbage-collected; nothing new will arrive."""
+        deadline = time.monotonic() + wait_s
         while True:
             try:
                 return self._kv.key_value_try_get_bytes(self._gkey(r, p))
@@ -256,7 +275,8 @@ class DcnDeadlineTrainer:
             time.sleep(0.02)
 
     def _apply_round(self, params, opt_state, r: int, mask: list[bool],
-                     own: Optional[bytes], caught_up: int = 0):
+                     own: Optional[bytes], caught_up: int = 0,
+                     replay: bool = False):
         """Mean the contributors' local-mean gradients (fixed rank order,
         so every process computes the bit-identical reduction) and run
         the jitted optimizer apply. Each payload is the gradient of that
@@ -274,7 +294,8 @@ class DcnDeadlineTrainer:
             if p == self.rank and own is not None:
                 data = own
             else:
-                data = self._get_payload(r, p)
+                data = self._get_payload(r, p,
+                                         wait_s=2.0 if replay else 30.0)
             loss_p, _toks = _HDR.unpack_from(data)
             vec = np.frombuffer(data, np.float32, offset=_HDR.size)
             total = vec.copy() if total is None else total + vec
@@ -307,7 +328,7 @@ class DcnDeadlineTrainer:
         applies to the first round whatever its number."""
         if self._round != self._start_round:
             raise RuntimeError("set_start_round after rounds already ran")
-        self._round = self._start_round = int(r)
+        self._round = self._start_round = self._cleaned_to = int(r)
 
     # -- catch-up after a stall ---------------------------------------------
 
@@ -324,7 +345,12 @@ class DcnDeadlineTrainer:
         cur = int(cur_s)
         if cur <= self._round:
             return params, opt_state, 0
-        if self._round < cur - self.retain + 1:
+        # margin of 4: survivors keep advancing (and garbage-collecting
+        # keys at cur - retain) WHILE we replay, so a wake exactly at the
+        # boundary would race their cleanup — better the clear
+        # checkpoint-resume error now than a deleted-payload error
+        # mid-replay
+        if self._round < cur - self.retain + 4:
             raise RuntimeError(
                 f"stalled for {cur - self._round} rounds, beyond the "
                 f"{self.retain}-round retention window — resume from the "
@@ -337,7 +363,8 @@ class DcnDeadlineTrainer:
                 break  # master is mid-round r: rejoin the normal flow
             mask = [c == "1" for c in mask_s]
             params, opt_state, _ = self._apply_round(
-                params, opt_state, r, mask, own=None, caught_up=0)
+                params, opt_state, r, mask, own=None, caught_up=0,
+                replay=True)
             self._round += 1
             replayed += 1
         if replayed:
@@ -350,13 +377,20 @@ class DcnDeadlineTrainer:
     def run_round(self, params, opt_state, tokens):
         """One cross-process training round: local grad step -> publish ->
         arrival report -> mask -> masked mean -> optimizer apply. Returns
-        ``(params, opt_state, DcnRoundReport)``."""
-        params, opt_state, replayed = self.catch_up(params, opt_state)
+        ``(params, opt_state, DcnRoundReport)``.
+
+        Runs exactly round ``self.round`` — build ``tokens`` for that
+        step index, and call :meth:`catch_up` first after a possible
+        stall (the CLI loop does): run_round itself never skips rounds,
+        so the batch a caller built always feeds the round it was built
+        for. A process that is merely behind (no catch_up) still
+        behaves correctly — its publish lands late, the retained mask
+        excludes it, and it applies the recorded update — catch_up just
+        skips the pointless gradient computation for those rounds."""
         r = self._round
         if self.master:
             self._kv.key_value_set(self._roundkey, str(r),
                                    allow_overwrite=True)
-            self.clock.open_round(r)
         grads, metrics = self._gstep(params, tokens, jnp.uint32(r))
         self._ensure_apply(grads)
         vec = np.asarray(self._flat(grads), np.float32)
@@ -364,28 +398,35 @@ class DcnDeadlineTrainer:
         payload = _HDR.pack(loss, float(metrics["tokens"])) + vec.tobytes()
         self._kv.key_value_set_bytes(self._gkey(r, self.rank), payload)
         if self.master:
-            self.clock.report_arrival(r, 0)
             mask = self._master_collect(r)
         else:
             self.router.send(self.router.ref_of(0),
                              CompleteAllreduce(src_id=self.rank, round=r))
             mask = self._read_mask(r)
         params, opt_state, rep = self._apply_round(
-            params, opt_state, r, mask, own=payload, caught_up=replayed)
+            params, opt_state, r, mask, own=payload)
         self._round += 1
         self._cleanup(r)
         return params, opt_state, rep
 
     def _cleanup(self, r: int) -> None:
+        """Delete every own payload (and, on the master, mask) that has
+        fallen out of retention — as a RANGE from the last sweep, not a
+        single round: catch_up can jump ``_round`` forward, and a
+        one-round-per-call sweep would orphan the payloads published just
+        before a stall (full f32 gradient vectors) in the KV store for
+        the rest of the job."""
         old = r - self.retain
-        if old < 0:
+        if old < self._cleaned_to:
             return
-        try:
-            self._kv.key_value_delete(self._gkey(old, self.rank))
-            if self.master:
-                self._kv.key_value_delete(self._maskkey(old))
-        except Exception:
-            pass  # best-effort GC; missing keys are fine
+        for rr in range(self._cleaned_to, old + 1):
+            try:
+                self._kv.key_value_delete(self._gkey(rr, self.rank))
+                if self.master:
+                    self._kv.key_value_delete(self._maskkey(rr))
+            except Exception:
+                pass  # best-effort GC; missing keys are fine
+        self._cleaned_to = old + 1
 
     @property
     def masked_round_count(self) -> int:

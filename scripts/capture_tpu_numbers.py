@@ -96,6 +96,14 @@ env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1"}
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env)
 """, 1500)
 
+    # canonical-scale configs 3/5 (64/256 workers, host plane — no TPU
+    # involved) + the 16/32-device dryrun sweep; ~40-50 GB peak RSS for
+    # the native runs, so this step runs LAST and alone
+    results["canonical"] = run("bench_canonical", """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_canonical.py"])
+""", 3600)
+
     with open(os.path.join(ROOT, "perf_tpu.json"), "w") as f:
         json.dump(results, f, indent=1)
 
@@ -110,7 +118,7 @@ subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env)
         "| metric | value | unit | note |",
         "|--------|-------|------|------|",
     ]
-    for section in ("headline", "mfu", "decode", "suite"):
+    for section in ("headline", "mfu", "decode", "suite", "canonical"):
         for row in results.get(section, []):
             lines.append(
                 f"| {row.get('metric', '?')} | {row.get('value', row.get('mfu_pct', ''))} "

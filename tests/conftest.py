@@ -24,3 +24,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# The test tiers are CORRECTNESS gates on a 1-core box where XLA compile
+# time dominates wall time; skipping XLA's optimization passes cuts the
+# fast tier by ~1/3 with identical semantics (tolerance-based asserts
+# absorb the fusion-level float differences). Set AATPU_TEST_FULL_OPTS=1
+# to run with full optimization (e.g. when chasing a numerics bug that
+# only reproduces under fusion).
+if not os.environ.get("AATPU_TEST_FULL_OPTS"):
+    jax.config.update("jax_disable_most_optimizations", True)

@@ -170,6 +170,7 @@ class TestRoundClock:
 
 
 class TestInt8Lossy:
+    @pytest.mark.slow
     def test_masked_round_keeps_int8_wire(self, mesh):
         """Round 1's ADVICE flagged the silent f32 fallback on lossy
         rounds; round 2 removed the fallback entirely — masked rounds keep

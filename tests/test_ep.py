@@ -246,6 +246,7 @@ class TestScatterDispatch:
         assert abs(float(aux_s["aux_loss"])
                    - float(aux_e["aux_loss"])) < 1e-6
 
+    @pytest.mark.slow
     def test_gradients_match_einsum(self):
         _, _, g_e = self._run("einsum")
         _, _, g_s = self._run("scatter")

@@ -46,6 +46,7 @@ def test_forward_matches_oracle_causal():
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_forward_matches_oracle_noncausal():
     q, k, v = _qkv(jax.random.key(1), t=128)
     got = flash_attention(q, k, v, False, 64, 64, True)
@@ -65,6 +66,7 @@ def test_uneven_block_sizes():
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_small_sequence_clamps_blocks():
     # t < block size: blocks clamp to t (single grid step per axis)
     q, k, v = _qkv(jax.random.key(3), t=32)
@@ -100,6 +102,7 @@ def test_gradients_match_oracle():
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.slow
 def test_gradients_match_oracle_noncausal():
     q, k, v = _qkv(jax.random.key(6), b=1, t=64, h=1, d=32)
 
@@ -116,6 +119,7 @@ def test_gradients_match_oracle_noncausal():
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.slow
 def test_bf16_inputs():
     q, k, v = _qkv(jax.random.key(7), t=128, dtype=jnp.bfloat16)
     got = flash_causal_attention(q, k, v, block_q=64, block_k=64,

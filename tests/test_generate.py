@@ -38,9 +38,10 @@ class TestDecodeParity:
         full = transformer_apply(params, toks, CFG)  # (b, t, vocab)
 
         cache = init_kv_cache(CFG, batch=2)
+        step = jax.jit(decode_step, static_argnames="cfg")  # 1 compile
         got = []
         for i in range(10):
-            cache, logits = decode_step(params, cache, toks[:, i], CFG)
+            cache, logits = step(params, cache, toks[:, i], CFG)
             got.append(logits)
         inc = jnp.stack(got, axis=1)
         np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
@@ -52,8 +53,9 @@ class TestDecodeParity:
         c1 = init_kv_cache(CFG, batch=2)
         c1, last = prefill(params, c1, toks, CFG)
         c2 = init_kv_cache(CFG, batch=2)
+        step = jax.jit(decode_step, static_argnames="cfg")
         for i in range(8):
-            c2, logits = decode_step(params, c2, toks[:, i], CFG)
+            c2, logits = step(params, c2, toks[:, i], CFG)
         # scan-traced vs eagerly-traced steps fuse differently; tolerances
         # cover the resulting float noise, not a semantic gap
         np.testing.assert_allclose(np.asarray(last), np.asarray(logits),
@@ -201,6 +203,7 @@ class TestTopKTopP:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
             assert (np.asarray(a) != np.asarray(c)).any(), kwargs
 
+    @pytest.mark.slow
     def test_noop_filters_match_plain_sampling(self):
         """top_k >= vocab and top_p = 1.0 must reproduce plain temperature
         sampling exactly (the filters compile away)."""
@@ -213,6 +216,7 @@ class TestTopKTopP:
                         top_k=CFG.vocab_size, top_p=1.0)
         np.testing.assert_array_equal(np.asarray(plain), np.asarray(noop))
 
+    @pytest.mark.slow
     def test_top_k_restricts_to_top_tokens(self):
         """With top_k=2 the first sampled token must be one of the two
         argmax candidates of the full forward's last-position logits."""

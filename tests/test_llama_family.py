@@ -37,6 +37,7 @@ def tokens_for(cfg, b=2, t=None, seed=0):
 
 
 class TestRope:
+    @pytest.mark.slow
     def test_rotation_preserves_norm(self):
         x = jax.random.normal(jax.random.key(0), (2, 8, 3, 16))
         y = apply_rope(x, jnp.arange(8))
@@ -62,6 +63,7 @@ class TestRope:
         np.testing.assert_allclose(np.asarray(s0), np.asarray(s7),
                                    atol=1e-4)
 
+    @pytest.mark.slow
     def test_no_pos_table_param(self):
         params = init_transformer(jax.random.key(0), LLAMA_CFG)
         assert "pos" not in params
@@ -101,6 +103,7 @@ class TestGQA:
         np.testing.assert_array_equal(np.asarray(ke[0, 0, :, 0]),
                                       np.ones(6))
 
+    @pytest.mark.slow
     def test_blockwise_gqa_matches_local(self):
         kq, kk, kv = jax.random.split(jax.random.key(4), 3)
         q = jax.random.normal(kq, (2, 64, 4, 16))
@@ -111,6 +114,7 @@ class TestGQA:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_flash_gqa_matches_oracle(self):
         from akka_allreduce_tpu.ops.pallas_kernels.attention import (
             flash_causal_attention)
@@ -170,6 +174,7 @@ class TestConfigValidation:
 
 
 class TestLlamaTraining:
+    @pytest.mark.slow
     def test_loss_gradient_finite_and_model_learns(self):
         from akka_allreduce_tpu.models.train import (
             TrainConfig, make_train_state, make_train_step)
@@ -223,6 +228,7 @@ class TestLlamaTraining:
 
 
 class TestLlamaDecode:
+    @pytest.mark.slow
     def test_incremental_decode_matches_full_forward(self):
         """Cached GQA+rope decode must reproduce the full-sequence forward
         logits position for position (the parity contract of

@@ -30,6 +30,7 @@ def make_tokens(cfg, b=2, t=16, seed=0):
 
 
 class TestMoELayerPattern:
+    @pytest.mark.slow
     def test_every_second_layer_is_moe(self):
         cfg = make_cfg()
         params = init_transformer(jax.random.key(0), cfg)

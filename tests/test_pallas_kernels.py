@@ -88,6 +88,8 @@ class TestQuantized:
         assert mean_err < 0.2 * step, (mean_err, step)
 
 
+@pytest.mark.slow  # EXPERIMENTAL kernel (ring.py): pending real
+# >=2-chip ICI hardware; its regression gate lives in the full tier
 class TestRingAllreduce:
     def test_single_rank_falls_back_to_psum(self):
         mesh1 = single_axis_mesh("dp", devices=jax.devices()[:1])

@@ -57,6 +57,7 @@ class TestForwardParity:
         np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_non_causal_matches_full_softmax(self, mesh):
         q, k, v = rand_qkv(1)
         scale = D ** -0.5

@@ -105,6 +105,7 @@ class TestModelIntegration:
         with pytest.raises(ValueError, match="sequence parallelism"):
             select_ring_attention(cfg)
 
+    @pytest.mark.slow
     def test_train_step_learns_with_window(self):
         from akka_allreduce_tpu.models.train import (
             TrainConfig, make_train_state, make_train_step)

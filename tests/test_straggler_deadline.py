@@ -166,6 +166,7 @@ class TestDeadlineTrainerEndToEnd:
         assert losses[-1] < losses[0] * 0.6, losses
         assert trainer.masked_round_count == 10
 
+    @pytest.mark.slow
     def test_all_masked_round_falls_back_to_exact(self):
         """If every peer misses the deadline the round must not zero the
         gradient (count-0 rescale): the driver keeps liveness by running

@@ -31,6 +31,7 @@ class TestTying:
         n_untied = sum(x.size for x in jax.tree.leaves(untied))
         assert n_untied - n_tied == 53 * 32  # exactly the vocab matrix
 
+    @pytest.mark.slow
     def test_logits_use_transposed_embedding(self):
         params = init_transformer(jax.random.key(1), TCFG)
         out = transformer_apply(params, toks(), TCFG)
@@ -66,6 +67,7 @@ class TestTying:
         # tied grad = untied embed grad + head grad^T; they must differ
         assert float(jnp.abs(g_tied - g_untied).max()) > 1e-4
 
+    @pytest.mark.slow
     def test_train_step_learns(self):
         from akka_allreduce_tpu.models.train import (
             TrainConfig, make_train_state, make_train_step)
