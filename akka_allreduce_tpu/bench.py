@@ -167,8 +167,10 @@ def measure_device_goodput(elems: int, bucket_elems: int,
     # time, so the cleanest run is the closest to the device's true
     # elapsed. Per-rep deltas give the spread for small payloads.
     per_round = (min(ts_hi) - min(ts_lo)) / (r_hi - r_lo)
+    # spread from MEASUREMENT-ORDER pairs: sorting both lists first would
+    # couple fastest-with-fastest and understate the real jitter
     deltas = sorted((th - tl) / (r_hi - r_lo)
-                    for th, tl in zip(sorted(ts_hi), sorted(ts_lo)))
+                    for th, tl in zip(ts_hi, ts_lo))
     if per_round <= 0:
         # relay jitter swamped the delta (small workloads): widen the span
         # until the signal dominates rather than publishing a negative
@@ -180,7 +182,7 @@ def measure_device_goodput(elems: int, bucket_elems: int,
         ts_hi = measure(wide_hi)
         per_round = (min(ts_hi) - min(ts_lo)) / (wide_hi - r_lo)
         deltas = sorted((th - tl) / (wide_hi - r_lo)
-                        for th, tl in zip(sorted(ts_hi), sorted(ts_lo)))
+                        for th, tl in zip(ts_hi, ts_lo))
     if per_round <= 0:
         raise RuntimeError(
             f"two-point timing failed twice (delta {per_round:.3e}s/round "
@@ -202,15 +204,22 @@ def measure_train_mfu(compute_dtype: str = "bf16",
                       d_model: int = 2048, n_layers: int = 8,
                       d_ff: int = 8192, vocab: int = 32768,
                       batch: Optional[int] = None, seq: int = 2048,
-                      steps_hi: int = 12, steps_lo: int = 4
-                      ) -> dict:
+                      steps_hi: int = 12, steps_lo: int = 4,
+                      scan_steps: bool = True) -> dict:
     """Single-chip train-step MFU on the flagship transformer.
 
     Useful FLOPs (models/flops.py: fwd matmuls + causal-half attention,
     backward = 2x fwd, remat recompute NOT counted) / step wall time / peak
-    chip FLOPs. Timing is two-point over jitted steps with donated buffers;
-    async dispatch keeps the per-call relay latency off the device timeline
-    and the two-point delta cancels what remains.
+    chip FLOPs.
+
+    ``scan_steps=True`` (the canonical measurement since round 3) runs the
+    k steps as ONE jitted ``lax.scan`` over the (params, opt_state) carry
+    — the same amortization the goodput bench uses — so this machine's
+    per-dispatch relay latency cannot ride the per-step time. The
+    loop-based form (``scan_steps=False``) issues one dispatch per step;
+    round-3 profiling measured it ~85 ms/step slower at identical device
+    work, i.e. it reports tunnel latency as if the chip were idle. Real
+    deployments run many steps per dispatch exactly like the scan.
     """
     from akka_allreduce_tpu.models.flops import (chip_peak_flops,
                                                  transformer_step_flops)
@@ -247,25 +256,76 @@ def measure_train_mfu(compute_dtype: str = "bf16",
 
     state = [params, opt_state]
 
-    def run(k):
-        # chained params serialize the steps on device; the scalar readback
-        # (NOT block_until_ready, which this machine's relay backend
-        # resolves before device completion) forces real execution, and the
-        # two-point delta cancels its round-trip constant
-        p, o = state
-        t0 = time.perf_counter()
-        m = None
-        for _ in range(k):
-            p, o, m = step(p, o, tokens)
-        np.asarray(m["loss"])
-        state[0], state[1] = p, o
-        return time.perf_counter() - t0
+    if scan_steps:
+        # the scan body IS the production step (make_train_step: same
+        # grad sync, same optimizer chain, quant seed from the adam step
+        # count) — re-implementing it inline here would let the
+        # benchmarked program drift from the trained one. Inner step
+        # un-donated: the scan carry aliases buffers itself; donation
+        # happens once at the outer jit boundary.
+        step_inner = make_train_step(cfg, mesh, opt, donate=False)
+
+        def scan_k(k):
+            @partial(jax.jit, donate_argnums=(0, 1),
+                     static_argnames="steps")
+            def run_steps(params, opt_state, tokens, steps):
+                def one(carry, _):
+                    p, o = carry
+                    p, o, metrics = step_inner(p, o, tokens)
+                    return (p, o), metrics["loss"]
+
+                (params, opt_state), losses = lax.scan(
+                    one, (params, opt_state), None, length=steps)
+                return params, opt_state, losses
+
+            p, o = state
+            t0 = time.perf_counter()
+            p, o, losses = run_steps(p, o, tokens, k)
+            np.asarray(losses[-1])  # force (see run() note below)
+            state[0], state[1] = p, o
+            return time.perf_counter() - t0
+
+        run = scan_k
+    else:
+        def run(k):
+            # chained params serialize the steps on device; the scalar
+            # readback (NOT block_until_ready, which this machine's relay
+            # backend resolves before device completion) forces real
+            # execution, and the two-point delta cancels its round-trip
+            # constant
+            p, o = state
+            t0 = time.perf_counter()
+            m = None
+            for _ in range(k):
+                p, o, m = step(p, o, tokens)
+            np.asarray(m["loss"])
+            state[0], state[1] = p, o
+            return time.perf_counter() - t0
 
     _log("mfu: compiling + warmup ...")
-    run(2)  # warmup/compile
+    if scan_steps:
+        # each scan length is its own compiled program: warm BOTH before
+        # timing or t_lo/t_hi would include a compile
+        run(steps_lo)
+        run(steps_hi)
+    else:
+        run(2)  # warmup/compile
     t_lo = run(steps_lo)
     t_hi = run(steps_hi)
     per_step = (t_hi - t_lo) / (steps_hi - steps_lo)
+    if per_step <= 0:
+        # noise swamped the delta (tiny configs / loaded host): widen the
+        # span once, then fail honestly rather than publish a negative
+        wide = 4 * steps_hi
+        _log(f"non-positive per-step delta; retrying with {wide} steps")
+        if scan_steps:
+            run(wide)
+        t_hi = run(wide)
+        per_step = (t_hi - t_lo) / (wide - steps_lo)
+    if per_step <= 0:
+        raise RuntimeError(
+            f"two-point step timing failed twice (delta {per_step:.3e}s)"
+            f" — host too noisy for this workload size")
     flops = transformer_step_flops(mcfg, batch, seq)
     peak = chip_peak_flops(devices[0])
     achieved = flops / per_step

@@ -708,13 +708,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 steps_in_window += 1
                 if i == start or (i + 1) % args.log_every == 0:
                     dt = time.perf_counter() - tic
-                    cu = (f" caught up {rep.caught_up} rounds"
-                          if rep.caught_up else "")
                     if chatty:
                         print(f"step {i + 1:4d}: loss {rep.loss:.4f} "
                               f"({b * t * steps_in_window / dt:.0f} "
                               f"tok/s) [masked {rep.n_masked}/{nprocs} "
-                              f"procs{cu}]")
+                              f"procs]")
                     tic = time.perf_counter()
                     steps_in_window = 0
             if chatty:

@@ -98,8 +98,11 @@ class DcnDeadlineTrainer:
                  num_processes: Optional[int] = None):
         if deadline_s <= 0:
             raise ValueError("deadline_s must be > 0")
-        if retain_rounds < 2:
-            raise ValueError("retain_rounds must be >= 2")
+        if retain_rounds < 8:
+            # catch_up keeps a 4-round safety margin against survivors'
+            # concurrent garbage collection; a window smaller than twice
+            # that cannot replay anything and is operationally useless
+            raise ValueError("retain_rounds must be >= 8")
         self.cfg = cfg
         self.mesh = mesh
         self.opt = opt
@@ -275,8 +278,7 @@ class DcnDeadlineTrainer:
             time.sleep(0.02)
 
     def _apply_round(self, params, opt_state, r: int, mask: list[bool],
-                     own: Optional[bytes], caught_up: int = 0,
-                     replay: bool = False):
+                     own: Optional[bytes], replay: bool = False):
         """Mean the contributors' local-mean gradients (fixed rank order,
         so every process computes the bit-identical reduction) and run
         the jitted optimizer apply. Each payload is the gradient of that
@@ -309,7 +311,7 @@ class DcnDeadlineTrainer:
         rep = DcnRoundReport(
             round=r, valid_peers=tuple(mask),
             n_masked=self.nprocs - count,
-            loss=float(np.mean(losses)), caught_up=caught_up)
+            loss=float(np.mean(losses)))
         self.reports.append(rep)
         return params, opt_state, rep
 
@@ -363,8 +365,7 @@ class DcnDeadlineTrainer:
                 break  # master is mid-round r: rejoin the normal flow
             mask = [c == "1" for c in mask_s]
             params, opt_state, _ = self._apply_round(
-                params, opt_state, r, mask, own=None, caught_up=0,
-                replay=True)
+                params, opt_state, r, mask, own=None, replay=True)
             self._round += 1
             replayed += 1
         if replayed:
