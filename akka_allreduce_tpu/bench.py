@@ -248,9 +248,6 @@ def measure_train_mfu(compute_dtype: str = "bf16",
     _log(f"mfu: init {compute_dtype} d={d_model} L={n_layers} ff={d_ff} "
          f"V={vocab} b={batch} t={seq} on {devices[0].device_kind}")
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
-    # donated params/opt_state: the step updates them in place, halving
-    # HBM pressure at this chip-filling size
-    step = make_train_step(cfg, mesh, opt, donate=True)
     tokens = jnp.asarray(np.random.default_rng(0).integers(
         0, vocab, size=(batch, seq), dtype=np.int32))
 
@@ -262,31 +259,34 @@ def measure_train_mfu(compute_dtype: str = "bf16",
         # count) — re-implementing it inline here would let the
         # benchmarked program drift from the trained one. Inner step
         # un-donated: the scan carry aliases buffers itself; donation
-        # happens once at the outer jit boundary.
+        # happens once at the outer jit boundary. run_steps is defined
+        # ONCE so its jit cache serves every scan length (a per-call
+        # wrapper would retrace+recompile on each timed run).
         step_inner = make_train_step(cfg, mesh, opt, donate=False)
 
-        def scan_k(k):
-            @partial(jax.jit, donate_argnums=(0, 1),
-                     static_argnames="steps")
-            def run_steps(params, opt_state, tokens, steps):
-                def one(carry, _):
-                    p, o = carry
-                    p, o, metrics = step_inner(p, o, tokens)
-                    return (p, o), metrics["loss"]
+        @partial(jax.jit, donate_argnums=(0, 1), static_argnames="steps")
+        def run_steps(params, opt_state, tokens, steps):
+            def one(carry, _):
+                p, o = carry
+                p, o, metrics = step_inner(p, o, tokens)
+                return (p, o), metrics["loss"]
 
-                (params, opt_state), losses = lax.scan(
-                    one, (params, opt_state), None, length=steps)
-                return params, opt_state, losses
+            (params, opt_state), losses = lax.scan(
+                one, (params, opt_state), None, length=steps)
+            return params, opt_state, losses
 
+        def run(k):
             p, o = state
             t0 = time.perf_counter()
             p, o, losses = run_steps(p, o, tokens, k)
-            np.asarray(losses[-1])  # force (see run() note below)
+            np.asarray(losses[-1])  # force (see loop-form note below)
             state[0], state[1] = p, o
             return time.perf_counter() - t0
-
-        run = scan_k
     else:
+        # donated params/opt_state: the step updates them in place,
+        # halving HBM pressure at this chip-filling size
+        step = make_train_step(cfg, mesh, opt, donate=True)
+
         def run(k):
             # chained params serialize the steps on device; the scalar
             # readback (NOT block_until_ready, which this machine's relay

@@ -608,8 +608,12 @@ def _cmd_train(args: argparse.Namespace) -> int:
     dcn = None
     if hybrid:
         from akka_allreduce_tpu.runtime.dcn_train import DcnDeadlineTrainer
-        dcn = DcnDeadlineTrainer(cfg, mesh, opt,
-                                 deadline_s=args.deadline_ms / 1e3)
+        # --int8-grads quantizes BOTH planes: the local mesh's collective
+        # transport (cfg.grad_transport above) and the cross-process DCN
+        # payloads (4x less DCN traffic per contribution)
+        dcn = DcnDeadlineTrainer(
+            cfg, mesh, opt, deadline_s=args.deadline_ms / 1e3,
+            wire="int8" if args.int8_grads else "f32")
         step = None
     else:
         # donate: the loop rebinds params/opt_state every step and the

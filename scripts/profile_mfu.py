@@ -72,6 +72,18 @@ def timed(fn, args, k_hi=12, k_lo=4, chain=None):
     return (t_hi - t_lo) / (k_hi - k_lo)
 
 
+def measure_dispatch_latency() -> float:
+    """Per-call dispatch cost of a trivial jitted fn through this
+    machine's device relay. Every per-call loop measurement below carries
+    this constant ON TOP of device time (the two-point form cancels
+    per-run constants, not per-call ones); components are corrected by
+    subtracting it, and multiples of it must never be attributed to a
+    kernel (attention x n_layers was exactly that trap)."""
+    x = jnp.ones((8, 128), jnp.float32)
+    noop = jax.jit(lambda x: x + 1.0)
+    return timed(noop, (x,), k_hi=24, k_lo=8)
+
+
 def main() -> int:
     dev = jax.devices()[0]
     print(f"[profile] device: {dev.device_kind}", file=sys.stderr)
@@ -107,20 +119,30 @@ def main() -> int:
             targets=targets, weights=weights, remat=cfg.remat)
         return loss_sum / weights.sum()
 
+    # --- per-call dispatch constant: measured first, subtracted from
+    # every per-call loop stage below (differences between stages cancel
+    # it anyway; absolute per-stage numbers and anything MULTIPLIED by a
+    # layer count must not carry it)
+    t_disp = measure_dispatch_latency()
+    emit("profile_dispatch_ms", t_disp * 1e3, "ms",
+         "per-call dispatch cost of a trivial jitted fn through the "
+         "device relay; subtracted from every per-call stage below")
+
     # --- components by subtraction (params/toks kept constant; the
     # loss output chains nothing, so rely on the readback per k-block;
     # each call is independent but the single device stream serializes)
     fwd_fn = jax.jit(loss_fn)
-    t_fwd = timed(fwd_fn, (params, tokens))
-    emit("profile_fwd_ms", t_fwd * 1e3, "ms", "forward loss only")
+    t_fwd = timed(fwd_fn, (params, tokens)) - t_disp
+    emit("profile_fwd_ms", t_fwd * 1e3, "ms",
+         "forward loss only (dispatch-corrected)")
 
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))
-    t_grad = timed(grad_fn, (params, tokens))
+    t_grad = timed(grad_fn, (params, tokens)) - t_disp
     emit("profile_fwd_bwd_ms", t_grad * 1e3, "ms",
          f"value_and_grad; bwd alone = {1e3 * (t_grad - t_fwd):.1f} ms")
 
     gstep = jax.jit(make_grad_step(cfg, mesh))
-    t_gstep = timed(gstep, (params, tokens, jnp.uint32(0)))
+    t_gstep = timed(gstep, (params, tokens, jnp.uint32(0))) - t_disp
     emit("profile_grad_step_ms", t_gstep * 1e3, "ms",
          f"grad + bucketed sync; sync alone = "
          f"{1e3 * (t_gstep - t_grad):.1f} ms (dp=1: pure bucketize/"
@@ -146,10 +168,10 @@ def main() -> int:
     run_full(2)
     t_lo_f = run_full(4)
     t_hi_f = run_full(12)
-    t_full = (t_hi_f - t_lo_f) / 8
+    t_full = (t_hi_f - t_lo_f) / 8 - t_disp
     emit("profile_full_step_ms", t_full * 1e3, "ms",
-         f"full donated train step; optimizer alone = "
-         f"{1e3 * (t_full - t_gstep):.1f} ms")
+         f"full donated train step (dispatch-corrected); optimizer "
+         f"alone = {1e3 * (t_full - t_gstep):.1f} ms")
 
     # --- attention share: the model's own attention callable (flash on
     # TPU via select_local_attention) standalone at model shapes
@@ -163,11 +185,14 @@ def main() -> int:
         _l, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
         return grads[0]
 
-    t_attn = timed(jax.jit(attn_fwd_bwd), (q, q, q))
-    attn_total = t_attn * N_LAYERS
+    # dispatch-corrected BEFORE the layer multiply: n_layers x the
+    # relay constant would otherwise masquerade as kernel time
+    t_attn = timed(jax.jit(attn_fwd_bwd), (q, q, q)) - t_disp
+    attn_total = max(t_attn, 0.0) * N_LAYERS
     emit("profile_attn_kernel_ms", attn_total * 1e3, "ms",
          f"flash fwd+bwd at (b={BATCH}, t={SEQ}, h={h}, d={hd}) x "
-         f"{N_LAYERS} layers (standalone; in-model fusion may differ)")
+         f"{N_LAYERS} layers (standalone, dispatch-corrected; in-model "
+         f"fusion may differ)")
 
     # --- attribution summary
     flops = transformer_step_flops(mcfg, BATCH, SEQ)
