@@ -280,8 +280,10 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "missing the deadline (demo/testing; real "
                         "deployments report arrivals over DCN)")
     p.add_argument("--max-lag", type=int, default=1,
-                   help="in-flight round window for the deadline pacer "
-                        "(the reference's maxLag)")
+                   help="extra rounds allowed in flight beyond the one "
+                        "being applied (0 = lockstep; the reference's "
+                        "maxLag). Same convention on the single-process "
+                        "deadline pacer and the multi-host hybrid")
     p.add_argument("--log-every", type=int, default=10,
                    help="print a progress line every N steps")
     p.add_argument("--data-file", default=None,
@@ -613,7 +615,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
         # payloads (4x less DCN traffic per contribution)
         dcn = DcnDeadlineTrainer(
             cfg, mesh, opt, deadline_s=args.deadline_ms / 1e3,
-            wire="int8" if args.int8_grads else "f32")
+            wire="int8" if args.int8_grads else "f32",
+            max_lag=args.max_lag)
         step = None
     else:
         # donate: the loop rebinds params/opt_state every step and the
@@ -707,18 +710,38 @@ def _cmd_train(args: argparse.Namespace) -> int:
                         time.sleep(1.5 * dcn.deadline_s)
                 params, opt_state, rep = dcn.run_round(
                     params, opt_state, tokens)
+                # rep is None while the max_lag window fills; params
+                # then reflect applies through rep.round only, so the
+                # checkpoint and narration follow the APPLIED frontier
+                if rep is None:
+                    continue
                 if mgr is not None:
-                    mgr.maybe_save(i, params, opt_state, {"data_step": i})
+                    mgr.maybe_save(rep.round, params, opt_state,
+                                   {"data_step": rep.round})
                 steps_in_window += 1
-                if i == start or (i + 1) % args.log_every == 0:
+                if rep.round == start \
+                        or (rep.round + 1) % args.log_every == 0:
                     dt = time.perf_counter() - tic
                     if chatty:
-                        print(f"step {i + 1:4d}: loss {rep.loss:.4f} "
+                        print(f"step {rep.round + 1:4d}: loss "
+                              f"{rep.loss:.4f} "
                               f"({b * t * steps_in_window / dt:.0f} "
                               f"tok/s) [masked {rep.n_masked}/{nprocs} "
                               f"procs]")
                     tic = time.perf_counter()
                     steps_in_window = 0
+            # drain one round at a time so every checkpoint pairs the
+            # round number with the params actually applied THROUGH it
+            # (a bulk drain would save final params under earlier steps)
+            while dcn.in_flight:
+                params, opt_state, rep = dcn.harvest(params, opt_state)
+                if mgr is not None:
+                    mgr.maybe_save(rep.round, params, opt_state,
+                                   {"data_step": rep.round})
+                if chatty:
+                    print(f"step {rep.round + 1:4d}: loss "
+                          f"{rep.loss:.4f} (drained) [masked "
+                          f"{rep.n_masked}/{nprocs} procs]")
             if chatty:
                 print(f"lossy rounds: {dcn.masked_round_count}/"
                       f"{len(dcn.reports)} had masked processes")

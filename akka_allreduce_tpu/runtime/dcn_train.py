@@ -147,11 +147,17 @@ class DcnDeadlineTrainer:
                  barrier_timeout_s: float = 300.0, client=None,
                  rank: Optional[int] = None,
                  num_processes: Optional[int] = None,
-                 wire: str = "f32"):
+                 wire: str = "f32", max_lag: int = 0):
         if deadline_s <= 0:
             raise ValueError("deadline_s must be > 0")
         if wire not in ("f32", "int8"):
             raise ValueError(f"wire must be 'f32' or 'int8', got {wire!r}")
+        if max_lag < 0:
+            raise ValueError("max_lag must be >= 0 (0 = lockstep)")
+        if max_lag + 1 > retain_rounds // 2:
+            raise ValueError(
+                f"max_lag={max_lag} must stay well inside the retention "
+                f"window ({retain_rounds})")
         if retain_rounds < 8:
             # catch_up keeps a 4-round safety margin against survivors'
             # concurrent garbage collection; a window smaller than twice
@@ -168,6 +174,17 @@ class DcnDeadlineTrainer:
                        else int(num_processes))
         self.master = self.rank == 0
         self.wire = wire
+        # max_lag follows the reference's (and RoundPacer's) convention:
+        # K EXTRA rounds may be in flight beyond the one being applied —
+        # 0 = lockstep, K = ring of K+1 rows
+        # (reference: AllReduceBuffer.scala:9-42)
+        self.max_lag = int(max_lag)
+        self._window = self.max_lag + 1
+        # published-but-not-yet-applied rounds: (round, own payload).
+        # Window > 1 is the reference's maxLag streaming in this
+        # topology — contributions for round r+k are computed from
+        # params that have only applied through round r
+        self._pending: list[tuple[int, bytes]] = []
         self.ns = namespace
         self._kv = client if client is not None else _default_client()
         # arrival reports ride the router (worker -> master messaging with
@@ -401,6 +418,11 @@ class DcnDeadlineTrainer:
         cur = int(cur_s)
         if cur <= self._round:
             return params, opt_state, 0
+        # flush in-flight rounds first: a worker that stalled mid-window
+        # still owes their applies, and their masks exist once the
+        # cluster has moved past them
+        while self._pending:
+            params, opt_state, _ = self.harvest(params, opt_state)
         # margin of 4: survivors keep advancing (and garbage-collecting
         # keys at cur - retain) WHILE we replay, so a wake exactly at the
         # boundary would race their cleanup — better the clear
@@ -441,7 +463,14 @@ class DcnDeadlineTrainer:
         for. A process that is merely behind (no catch_up) still
         behaves correctly — its publish lands late, the retained mask
         excludes it, and it applies the recorded update — catch_up just
-        skips the pointless gradient computation for those rounds."""
+        skips the pointless gradient computation for those rounds.
+
+        With ``max_lag > 0`` up to max_lag+1 rounds are in flight: this
+        call publishes round r and applies round r - max_lag, so the
+        gradient for r was computed from params max_lag applies stale
+        — the reference's bounded-staleness streaming. While the window
+        is FILLING the report is None (nothing applied yet); call
+        :meth:`drain` after the last round to apply the tail."""
         r = self._round
         if self.master:
             self._kv.key_value_set(self._roundkey, str(r),
@@ -458,17 +487,47 @@ class DcnDeadlineTrainer:
                                  self.wire,
                                  seed=r * self.nprocs + self.rank)
         self._kv.key_value_set_bytes(self._gkey(r, self.rank), payload)
-        if self.master:
-            mask = self._master_collect(r)
-        else:
+        if not self.master:
             self.router.send(self.router.ref_of(0),
                              CompleteAllreduce(src_id=self.rank, round=r))
-            mask = self._read_mask(r)
-        params, opt_state, rep = self._apply_round(
-            params, opt_state, r, mask, own=payload)
+        self._pending.append((r, payload))
         self._round += 1
-        self._cleanup(r)
+        rep = None
+        if len(self._pending) >= self._window:
+            params, opt_state, rep = self.harvest(params, opt_state)
         return params, opt_state, rep
+
+    @property
+    def in_flight(self) -> int:
+        """Rounds published but not yet applied."""
+        return len(self._pending)
+
+    def harvest(self, params, opt_state):
+        """Apply the oldest in-flight round: collect/read its mask, mean
+        the contributors, run the optimizer. Returns ``(params,
+        opt_state, DcnRoundReport)``. Callers that checkpoint per round
+        drain with this (one harvest = one applied round = one save);
+        :meth:`drain` is the convenience form for callers that only need
+        the final state."""
+        r0, payload0 = self._pending.pop(0)
+        if self.master:
+            mask = self._master_collect(r0)
+        else:
+            mask = self._read_mask(r0)
+        params, opt_state, rep = self._apply_round(
+            params, opt_state, r0, mask, own=payload0)
+        self._cleanup(r0)
+        return params, opt_state, rep
+
+    def drain(self, params, opt_state):
+        """Apply every still-in-flight round (call after the last
+        ``run_round``). Returns ``(params, opt_state, reports)`` for the
+        drained rounds."""
+        reps = []
+        while self._pending:
+            params, opt_state, rep = self.harvest(params, opt_state)
+            reps.append(rep)
+        return params, opt_state, reps
 
     def _cleanup(self, r: int) -> None:
         """Delete every own payload (and, on the master, mask) that has

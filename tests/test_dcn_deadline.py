@@ -160,6 +160,39 @@ class TestDcnDeadlineChain:
         last_masked = [ln for ln in lines if "[masked" in ln][-1]
         assert "[masked 0/3" in last_masked, out
 
+    def test_pipelined_max_lag_window(self):
+        """2 processes with --max-lag 3: up to 3 rounds in flight
+        (bounded-staleness streaming, the reference's maxLag in this
+        topology). All 10 rounds must apply — including the window tail
+        drained after the loop — with finite losses."""
+        port = free_port()
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        procs = [subprocess.Popen(
+            [sys.executable, "-u", "-m", "akka_allreduce_tpu.cli",
+             "train", "--platform", "cpu",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--steps", "10", "--batch", "4", "--seq", "16",
+             "--d-model", "32", "--n-heads", "4", "--n-layers", "1",
+             "--d-ff", "64", "--dp", "2", "--max-lag", "3",
+             "--deadline-ms", "2000", "--log-every", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for i in range(2)]
+        outs = []
+        for i, p in enumerate(procs):
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+            assert p.returncode == 0, f"proc {i}:\n{out[-2000:]}"
+        assert "step   10" in outs[0], outs[0]
+        # the tail of the window drains after the loop
+        assert "(drained)" in outs[0], outs[0]
+        assert "lossy rounds: 0/10" in outs[0], outs[0]
+        for ln in outs[0].splitlines():
+            if "loss" in ln and "step" in ln:
+                v = float(ln.split("loss")[1].split()[0])
+                assert v == v and v < 1e9, ln
+
     def test_straggle_prob_simulation_runs(self):
         """2 processes with --straggle-prob AND --int8-grads: simulated
         late publishes via the real wall clock produce masked rounds
