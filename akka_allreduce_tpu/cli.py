@@ -286,6 +286,12 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "deadline pacer and the multi-host hybrid")
     p.add_argument("--log-every", type=int, default=10,
                    help="print a progress line every N steps")
+    p.add_argument("--retain-rounds", type=int, default=64,
+                   help="hybrid (--coordinator --deadline-ms) only: how "
+                        "many rounds of masks/payloads stay in the KV "
+                        "store for straggler catch-up replay; beyond it "
+                        "a straggler rejoins via checkpoint snapshot "
+                        "(needs --ckpt-dir)")
     p.add_argument("--data-file", default=None,
                    help="train on a real corpus: raw bytes (vocab 256) or "
                         "*.bin little-endian uint16 tokens (vocab 65536); "
@@ -616,7 +622,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
         dcn = DcnDeadlineTrainer(
             cfg, mesh, opt, deadline_s=args.deadline_ms / 1e3,
             wire="int8" if args.int8_grads else "f32",
-            max_lag=args.max_lag)
+            max_lag=args.max_lag, retain_rounds=args.retain_rounds)
         step = None
     else:
         # donate: the loop rebinds params/opt_state every step and the
@@ -645,7 +651,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                                                            restore_or_init)
         start, params, opt_state, extra, mgr = restore_or_init(
             CheckpointConfig(args.ckpt_dir,
-                             save_interval_steps=args.ckpt_every),
+                             save_interval_steps=args.ckpt_every,
+                             single_process=hybrid),
             params, opt_state)
         if start and chatty:
             print(f"resumed from step {start - 1} "
@@ -683,9 +690,75 @@ def _cmd_train(args: argparse.Namespace) -> int:
             dcn.set_start_round(start)
             rows = b // nprocs
             rank = jax.process_index()
+
+            def serve_snapshot_requests(rep):
+                # master: a beyond-retention straggler asked to rejoin —
+                # force-save the checkpoint at the apply frontier and
+                # publish the step (the rejoin "InitWorkers"). Polled
+                # every 4th round: the request scan is a KV dir RPC, and
+                # a rejoiner (already stalled for >= retention rounds)
+                # doesn't feel a <=4-round answer latency — but the
+                # no-straggler hot path shouldn't pay the RPC each round
+                if not dcn.master or rep.round % 4:
+                    return
+                if not dcn.pending_snapshot_requests():
+                    return
+                if mgr is None:
+                    print("WARNING: rejoin snapshot requested but no "
+                          "--ckpt-dir; the straggler cannot recover",
+                          file=sys.stderr)
+                    return
+                mgr.save(rep.round, params, opt_state,
+                         {"data_step": rep.round}, force=True)
+                mgr.wait_until_finished()  # worker reads it immediately
+                dcn.publish_snapshot_step(rep.round)
+                print(f"served rejoin snapshot at step {rep.round}")
+
+            def rejoin_from_snapshot(exc):
+                # worker: stalled beyond retention — checkpoint-sync
+                from akka_allreduce_tpu.runtime.checkpoint import (
+                    CheckpointConfig, restore_or_init)
+                if not args.ckpt_dir:
+                    raise exc
+                print(f"process {rank}: {exc}; requesting rejoin "
+                      f"snapshot")
+                prev = dcn.request_snapshot()
+                dcn.wait_snapshot(prev)
+                # retry the restore: the master keeps saving while we
+                # read, and orbax's max_to_keep GC can delete the step
+                # we picked mid-restore — each retry re-reads latest
+                last_exc = None
+                for _attempt in range(3):
+                    try:
+                        s2, p2, o2, _extra, m2 = restore_or_init(
+                            CheckpointConfig(
+                                args.ckpt_dir,
+                                save_interval_steps=args.ckpt_every,
+                                single_process=True),
+                            params, opt_state)
+                        break
+                    except Exception as e:  # deleted-under-us race
+                        last_exc = e
+                        time.sleep(0.2)
+                else:
+                    raise RuntimeError(
+                        "rejoin restore kept racing the master's "
+                        "checkpoint GC") from last_exc
+                m2.close()  # restore-only: the master owns the writer
+                dcn.reset_to_round(s2)
+                print(f"process {rank}: elastic rejoin via checkpoint "
+                      f"snapshot at step {s2 - 1}")
+                return p2, o2
+
+            from akka_allreduce_tpu.runtime.dcn_train import \
+                StalledBeyondRetention
             while True:
-                params, opt_state, replayed = dcn.catch_up(params,
-                                                           opt_state)
+                try:
+                    params, opt_state, replayed = dcn.catch_up(params,
+                                                               opt_state)
+                except StalledBeyondRetention as exc:
+                    params, opt_state = rejoin_from_snapshot(exc)
+                    continue
                 if replayed:
                     # always narrated (not just on process 0): the
                     # catching-up process is by definition a worker, and
@@ -708,13 +781,20 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     # whose stall would stall everyone, never simulates)
                     if step_rng.random(nprocs)[rank] < args.straggle_prob:
                         time.sleep(1.5 * dcn.deadline_s)
-                params, opt_state, rep = dcn.run_round(
-                    params, opt_state, tokens)
+                try:
+                    params, opt_state, rep = dcn.run_round(
+                        params, opt_state, tokens)
+                except StalledBeyondRetention as exc:
+                    # a stall can strike INSIDE run_round (waiting for a
+                    # mask the master has since garbage-collected)
+                    params, opt_state = rejoin_from_snapshot(exc)
+                    continue
                 # rep is None while the max_lag window fills; params
                 # then reflect applies through rep.round only, so the
                 # checkpoint and narration follow the APPLIED frontier
                 if rep is None:
                     continue
+                serve_snapshot_requests(rep)
                 if mgr is not None:
                     mgr.maybe_save(rep.round, params, opt_state,
                                    {"data_step": rep.round})
@@ -735,6 +815,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             # (a bulk drain would save final params under earlier steps)
             while dcn.in_flight:
                 params, opt_state, rep = dcn.harvest(params, opt_state)
+                serve_snapshot_requests(rep)
                 if mgr is not None:
                     mgr.maybe_save(rep.round, params, opt_state,
                                    {"data_step": rep.round})

@@ -29,11 +29,19 @@ import orbax.checkpoint as ocp
 class CheckpointConfig:
     """``directory`` must be host-shared (e.g. GCS) in multi-host runs.
     ``keep`` bounds retained checkpoints; ``save_interval_steps`` is the
-    :meth:`CheckpointManager.maybe_save` cadence."""
+    :meth:`CheckpointManager.maybe_save` cadence.
+
+    ``single_process=True`` makes THIS process a one-member checkpoint
+    island inside a multi-process job: saves/restores run without
+    orbax's cross-process barriers. Required by the hybrid DCN topology,
+    where params are fully replicated per process and only the master
+    writes — a default (all-process) manager there deadlocks waiting for
+    peers that never call save."""
 
     directory: str
     keep: int = 3
     save_interval_steps: int = 100
+    single_process: bool = False
 
 
 class CheckpointManager:
@@ -46,12 +54,28 @@ class CheckpointManager:
 
     def __init__(self, config: CheckpointConfig):
         self.config = config
+        kw = {}
+        create = True
+        if config.single_process:
+            me = jax.process_index()
+            # orbax treats multiprocessing_options=None as "default
+            # object", so the kwarg is only passed when set
+            kw["multiprocessing_options"] = ocp.options.\
+                MultiprocessingOptions(
+                    primary_host=me, active_processes={me},
+                    barrier_sync_key_prefix=f"aat_sp_{me}")
+            # orbax refuses create=True with active_processes (it cannot
+            # coordinate the mkdir) — make the directory ourselves
+            create = False
+            import os
+            os.makedirs(config.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             config.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=config.keep,
                 save_interval_steps=config.save_interval_steps,
-                create=True,
+                create=create,
+                **kw,
             ),
         )
 
@@ -61,11 +85,17 @@ class CheckpointManager:
              extra: Optional[dict] = None, force: bool = False) -> bool:
         """Save unconditionally (``force``) or per the interval policy.
         Returns whether a save actually happened."""
+        state = {"params": params, "opt_state": opt_state}
+        if self.config.single_process:
+            # orbax refuses process-LOCAL device arrays in a multi-
+            # process job ("host local jax.Array"); the island's arrays
+            # are exactly that (local-mesh shardings), so ship them as
+            # host numpy — restore puts them back on the local mesh
+            state = jax.device_get(state)
         saved = self._mgr.save(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardSave(
-                    {"params": params, "opt_state": opt_state}),
+                state=ocp.args.StandardSave(state),
                 extra=ocp.args.JsonSave(extra or {}),
             ),
             force=force,
@@ -102,6 +132,10 @@ class CheckpointManager:
             # Keep the template's sharding on every leaf (scalars included)
             # so restore lands on the live mesh, never a single device.
             if isinstance(x, jax.Array):
+                if self.config.single_process:
+                    # island checkpoints hold host numpy (see save);
+                    # restore them shapeless of sharding, then place
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype)
                 return jax.ShapeDtypeStruct(x.shape, x.dtype,
                                             sharding=x.sharding)
             return x
@@ -115,6 +149,11 @@ class CheckpointManager:
             ),
         )
         state = out["state"]
+        if self.config.single_process:
+            state = jax.tree.map(
+                lambda t, x: jax.device_put(x, t.sharding)
+                if isinstance(t, jax.Array) else x,
+                template, state)
         return step, state["params"], state["opt_state"], dict(out["extra"])
 
     # -- lifecycle -----------------------------------------------------------

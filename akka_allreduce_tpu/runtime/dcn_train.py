@@ -120,6 +120,18 @@ def decode_payload(data: bytes) -> tuple[float, float, np.ndarray]:
     return loss, tokens, out
 
 
+class StalledBeyondRetention(RuntimeError):
+    """A process woke after the cluster advanced past the retention
+    window: replay is impossible (payloads garbage-collected). With a
+    checkpoint dir the CLI recovers via the snapshot-rejoin protocol
+    (request_snapshot/publish_snapshot_step + reset_to_round); without
+    one, this is fatal — resume the process from the last checkpoint."""
+
+    def __init__(self, msg: str, current_round: int):
+        super().__init__(msg)
+        self.current_round = current_round
+
+
 @dataclasses.dataclass
 class DcnRoundReport:
     """One cross-process round as the host saw it."""
@@ -225,6 +237,10 @@ class DcnDeadlineTrainer:
     def _roundkey(self) -> str:
         return f"{self.ns}/round"
 
+    @property
+    def _donekey(self) -> str:
+        return f"{self.ns}/done"
+
     # -- master-side arrival handling ---------------------------------------
 
     def _on_message(self, msg) -> None:
@@ -299,11 +315,14 @@ class DcnDeadlineTrainer:
                 return [c == "1" for c in s]
             cur_s = self._try_get(self._roundkey)
             if cur_s is not None and int(cur_s) - r >= self.retain:
-                raise RuntimeError(
+                # same condition catch_up detects — but a process can
+                # stall INSIDE run_round (right here, waiting for this
+                # mask), so the typed rejoin signal must fire from the
+                # wait loop too
+                raise StalledBeyondRetention(
                     f"stalled at round {r} while the cluster reached "
                     f"{cur_s}, beyond the {self.retain}-round retention "
-                    f"window — resume from the last checkpoint instead "
-                    f"(runtime/checkpoint.py)")
+                    f"window", current_round=int(cur_s))
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"no mask for round {r}: the master stopped "
@@ -403,6 +422,87 @@ class DcnDeadlineTrainer:
             raise RuntimeError("set_start_round after rounds already ran")
         self._round = self._start_round = self._cleaned_to = int(r)
 
+    # -- snapshot-rejoin protocol (beyond-retention elastic recovery) -------
+    #
+    # Worker side: request_snapshot() -> poll snapshot_step() -> restore
+    # the published checkpoint -> reset_to_round(step + 1) -> catch_up
+    # replays the (now within-retention) gap. Master side: the CLI sees
+    # pending_snapshot_requests() each applied round, force-saves its
+    # checkpoint at the apply frontier, and publish_snapshot_step()s it.
+    # The reference analog is a cold worker rejoining the cluster and
+    # being re-initialized by the master (reference:
+    # AllreduceWorker.scala:87-89, AllreduceSpec.scala:141-172) — here
+    # the "init payload" is the orbax checkpoint on shared storage.
+
+    @property
+    def _snapkey(self) -> str:
+        return f"{self.ns}/snap/step"
+
+    def request_snapshot(self) -> Optional[int]:
+        """Ask the master for a fresh checkpoint; returns the currently
+        published snapshot step (to wait for a CHANGE on)."""
+        prev = self._try_get(self._snapkey)
+        self._kv.key_value_set(f"{self.ns}/snapreq/{self.rank}", "1",
+                               allow_overwrite=True)
+        return int(prev) if prev is not None else None
+
+    def wait_snapshot(self, prev: Optional[int],
+                      timeout_s: float = 120.0) -> int:
+        """Block until the master publishes a snapshot step newer than
+        ``prev``; returns that step. Fails fast (not a full timeout)
+        when the master already finished the run — there is nobody left
+        to serve the request."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            s = self._try_get(self._snapkey)
+            if s is not None and (prev is None or int(s) != prev):
+                return int(s)
+            if self._try_get(self._donekey) is not None:
+                raise RuntimeError(
+                    "the master finished the run while this process was "
+                    "stalled — nobody can serve a rejoin snapshot; "
+                    "restart from the last checkpoint "
+                    "(runtime/checkpoint.py)")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    "master never published a rejoin snapshot — it "
+                    "either died or runs without --ckpt-dir; restart "
+                    "from the last checkpoint")
+            time.sleep(0.05)
+
+    def pending_snapshot_requests(self) -> list[int]:
+        """Master: ranks currently asking for a rejoin snapshot."""
+        try:
+            entries = self._kv.key_value_dir_get(f"{self.ns}/snapreq/")
+        except Exception:
+            return []
+        return [int(k.rsplit("/", 1)[-1]) for k, _ in entries]
+
+    def publish_snapshot_step(self, step: int) -> None:
+        """Master: announce a force-saved checkpoint at ``step`` and
+        clear the outstanding requests it serves."""
+        for rank in self.pending_snapshot_requests():
+            try:
+                self._kv.key_value_delete(f"{self.ns}/snapreq/{rank}")
+            except Exception:
+                pass
+        self._kv.key_value_set(self._snapkey, str(step),
+                               allow_overwrite=True)
+
+    def reset_to_round(self, r: int) -> None:
+        """Rebase this process at round ``r`` after a snapshot restore:
+        drops any stale in-flight window. The caller must have restored
+        params/opt_state from the checkpoint the master published for
+        this rebase.
+
+        Pre-stall payloads this rank published are NOT deleted here —
+        rounds inside the retention window may still be replayed by
+        OTHER within-retention stragglers (deleting them crashed such a
+        peer's replay); the untouched cleanup cursor ages them out
+        through the normal per-round sweep instead."""
+        self._pending.clear()
+        self._round = int(r)
+
     # -- catch-up after a stall ---------------------------------------------
 
     def catch_up(self, params, opt_state) -> tuple[Any, Any, int]:
@@ -429,10 +529,11 @@ class DcnDeadlineTrainer:
         # checkpoint-resume error now than a deleted-payload error
         # mid-replay
         if self._round < cur - self.retain + 4:
-            raise RuntimeError(
+            raise StalledBeyondRetention(
                 f"stalled for {cur - self._round} rounds, beyond the "
-                f"{self.retain}-round retention window — resume from the "
-                f"last checkpoint instead (runtime/checkpoint.py)")
+                f"{self.retain}-round retention window — rejoin needs a "
+                f"checkpoint (snapshot protocol via the CLI, or restart "
+                f"from the last checkpoint)", current_round=cur)
         replayed = 0
         while self._round < cur:
             r = self._round
@@ -553,4 +654,13 @@ class DcnDeadlineTrainer:
         return sum(1 for rep in self.reports if rep.n_masked)
 
     def close(self) -> None:
+        if self.master:
+            # end-of-run marker: a straggler waking after this fails
+            # fast with checkpoint guidance instead of waiting out the
+            # snapshot/mask timeouts on a cluster that no longer exists
+            try:
+                self._kv.key_value_set(self._donekey, "1",
+                                       allow_overwrite=True)
+            except Exception:
+                pass
         self.router.close()

@@ -193,6 +193,72 @@ class TestDcnDeadlineChain:
                 v = float(ln.split("loss")[1].split()[0])
                 assert v == v and v < 1e9, ln
 
+    def test_beyond_retention_rejoins_via_snapshot(self, tmp_path):
+        """SIGSTOP a worker LONGER than the retention window (retain 8,
+        stall spans ~14 masked rounds): replay is impossible, so the
+        woken worker must run the snapshot-rejoin protocol — request a
+        checkpoint, the master force-saves and publishes it, the worker
+        restores, rebases, replays the fresh tail, and rejoins the mask.
+        The reference analog: a cold worker re-initialized by the master
+        (AllreduceWorker.scala:87-89)."""
+        port = free_port()
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        ckpt = str(tmp_path / "ckpt")
+        procs = [subprocess.Popen(
+            [sys.executable, "-u", "-m", "akka_allreduce_tpu.cli",
+             "train", "--platform", "cpu",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--steps", "26", "--batch", "4", "--seq", "16",
+             "--d-model", "32", "--n-heads", "4", "--n-layers", "1",
+             "--d-ff", "64", "--dp", "2", "--retain-rounds", "8",
+             "--ckpt-dir", ckpt, "--ckpt-every", "4",
+             "--deadline-ms", "400", "--log-every", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            bufsize=1, env=env) for i in range(2)]
+        lines: list[str] = []
+        state = {"stopped": False, "resumed": False}
+
+        def pump():
+            for line in procs[0].stdout:
+                lines.append(line.rstrip())
+                if "step    4:" in line and not state["stopped"]:
+                    state["stopped"] = True
+                    os.kill(procs[1].pid, signal.SIGSTOP)
+                # stall across ~14 masked rounds — well past retain 8
+                if "step   18:" in line and state["stopped"] \
+                        and not state["resumed"]:
+                    state["resumed"] = True
+                    os.kill(procs[1].pid, signal.SIGCONT)
+
+        t = threading.Thread(target=pump)
+        t.start()
+        rcs = []
+        deadline = time.time() + 480
+        try:
+            for p in procs:
+                rcs.append(p.wait(timeout=max(5, deadline - time.time())))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        os.kill(p.pid, signal.SIGCONT)
+                    except OSError:
+                        pass
+                    p.kill()
+        t.join(timeout=15)
+        out0 = "\n".join(lines)
+        out1 = procs[1].stdout.read() or ""
+        assert state["stopped"] and state["resumed"], out0
+        assert rcs == [0, 0], (rcs, out0[-1500:], out1[-1500:])
+        # the master served the protocol; the worker rejoined through it
+        assert "served rejoin snapshot at step" in out0, out0
+        assert "elastic rejoin via checkpoint snapshot" in out1, out1
+        # post-rejoin rounds run unmasked again
+        last_masked = [ln for ln in lines if "[masked" in ln][-1]
+        assert "[masked 0/2" in last_masked, out0
+
     def test_straggle_prob_simulation_runs(self):
         """2 processes with --straggle-prob AND --int8-grads: simulated
         late publishes via the real wall clock produce masked rounds
