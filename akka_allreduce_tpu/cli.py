@@ -292,6 +292,10 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "store for straggler catch-up replay; beyond it "
                         "a straggler rejoins via checkpoint snapshot "
                         "(needs --ckpt-dir)")
+    p.add_argument("--trace-file", default=None,
+                   help="hybrid only: write the structured round trace "
+                        "(JSONL: round_complete/mask_published/catch_up/"
+                        "snapshot events, runtime/tracing.py) on exit")
     p.add_argument("--data-file", default=None,
                    help="train on a real corpus: raw bytes (vocab 256) or "
                         "*.bin little-endian uint16 tokens (vocab 65536); "
@@ -619,10 +623,15 @@ def _cmd_train(args: argparse.Namespace) -> int:
         # --int8-grads quantizes BOTH planes: the local mesh's collective
         # transport (cfg.grad_transport above) and the cross-process DCN
         # payloads (4x less DCN traffic per contribution)
+        tracer = None
+        if args.trace_file:
+            from akka_allreduce_tpu.runtime.tracing import Tracer
+            tracer = Tracer()
         dcn = DcnDeadlineTrainer(
             cfg, mesh, opt, deadline_s=args.deadline_ms / 1e3,
             wire="int8" if args.int8_grads else "f32",
-            max_lag=args.max_lag, retain_rounds=args.retain_rounds)
+            max_lag=args.max_lag, retain_rounds=args.retain_rounds,
+            tracer=tracer)
         step = None
     else:
         # donate: the loop rebinds params/opt_state every step and the
@@ -723,7 +732,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 print(f"process {rank}: {exc}; requesting rejoin "
                       f"snapshot")
                 prev = dcn.request_snapshot()
-                dcn.wait_snapshot(prev)
+                # serve latency scales with the deadline: the master
+                # polls requests every 4th APPLIED round and a stalled
+                # peer makes every round wait the full deadline
+                snap_step = dcn.wait_snapshot(
+                    prev, timeout_s=max(120.0, 8 * dcn.deadline_s + 60))
                 # retry the restore: the master keeps saving while we
                 # read, and orbax's max_to_keep GC can delete the step
                 # we picked mid-restore — each retry re-reads latest
@@ -745,6 +758,15 @@ def _cmd_train(args: argparse.Namespace) -> int:
                         "rejoin restore kept racing the master's "
                         "checkpoint GC") from last_exc
                 m2.close()  # restore-only: the master owns the writer
+                if s2 <= snap_step:
+                    # restore found nothing at/after the published step:
+                    # almost certainly a non-shared --ckpt-dir (each
+                    # process is its own CLI invocation). Fail fast with
+                    # the real problem instead of looping rejoin cycles
+                    raise RuntimeError(
+                        f"rejoin restore found step {s2 - 1} but the "
+                        f"master published {snap_step} — is --ckpt-dir "
+                        f"on storage shared with the master?")
                 dcn.reset_to_round(s2)
                 print(f"process {rank}: elastic rejoin via checkpoint "
                       f"snapshot at step {s2 - 1}")
@@ -826,6 +848,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
             if chatty:
                 print(f"lossy rounds: {dcn.masked_round_count}/"
                       f"{len(dcn.reports)} had masked processes")
+            if tracer is not None:
+                n = tracer.write_jsonl(args.trace_file)
+                print(f"wrote {n} trace events to {args.trace_file}")
             dcn.close()
             if mgr is not None:
                 final = args.steps - 1

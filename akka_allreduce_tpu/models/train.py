@@ -66,6 +66,7 @@ from akka_allreduce_tpu.parallel.ring_attention import (
     blockwise_causal_attention,
     local_causal_attention,
     ring_attention,
+    windowed_sp_attention,
 )
 from akka_allreduce_tpu.utils.vma import psum_all
 
@@ -333,10 +334,25 @@ def select_ring_attention(cfg: TrainConfig):
     impl = cfg.attn_impl
     if impl not in ("auto", "flash", "blockwise", "local"):
         raise ValueError(f"unknown attn_impl {impl!r}")
-    if cfg.model.attn_window is not None:
-        raise ValueError(
-            "attn_window does not compose with sequence parallelism "
-            "(sp > 1) yet — drop --sp or the window")
+    window = cfg.model.attn_window
+    if window is not None:
+        # windows compose with sp via ONE neighbor K/V-tail exchange
+        # (parallel/ring_attention.windowed_sp_attention) — the ring's
+        # rotation only exists to reach blocks the window never sees.
+        # Forced impls keep the sp=1 selector's contract: 'local' IS
+        # this pure-JAX path, 'blockwise'/'flash' raise rather than
+        # silently running something else
+        if impl == "flash":
+            raise ValueError(
+                "attn_impl='flash' with attn_window under sp > 1 is not "
+                "kernel-served yet; use 'auto' (the windowed neighbor-"
+                "exchange path)")
+        if impl == "blockwise":
+            raise ValueError(
+                "attn_impl='blockwise' does not support attn_window "
+                "(same contract as sp=1); use 'auto' or 'local'")
+        return partial(windowed_sp_attention, window=window,
+                       axis_name="sp")
     auto = impl == "auto"
     if not (impl == "flash" or (auto and use_pallas("ring_flash"))):
         return partial(ring_attention, axis_name="sp", causal=True)

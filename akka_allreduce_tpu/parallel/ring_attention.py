@@ -134,6 +134,60 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
+def windowed_sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          window: int, axis_name: str = "sp"
+                          ) -> jnp.ndarray:
+    """Sliding-window causal attention under sequence parallelism.
+
+    With ``window - 1 <= T_local`` a query's keys live in its own block
+    plus the tail of the PREVIOUS rank's block, so the composition needs
+    ONE neighbor exchange of ``window - 1`` K/V columns instead of the
+    full n-step ring — communication O(window), independent of the ring
+    size. That is the payoff of composing Mistral-style windows with
+    sequence parallelism: ring attention's rotation exists to reach
+    DISTANT blocks the window provably never looks at. K/V cross the
+    link at their narrow (GQA) head count, like the ring path.
+
+    Rank 0's incoming tail is the wrap-around garbage from the last
+    rank; its key positions compute negative and the mask drops them —
+    the same honesty trick as the zero-filled missing chunks of the
+    reference's reassembly (reference: ReducedDataBuffer.scala:40-48).
+    Same cast discipline as every attention path here: f32 scores and
+    softmax, inputs' dtype on the matmuls.
+    """
+    b, t, h, d = q.shape
+    tail = window - 1
+    if tail > t:
+        raise ValueError(
+            f"attn_window={window} under sequence parallelism needs "
+            f"window - 1 <= local sequence ({t}); raise --seq, lower "
+            f"--sp, or shrink the window")
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if tail > 0:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_prev = lax.ppermute(k[:, t - tail:], axis_name, perm)
+        v_prev = lax.ppermute(v[:, t - tail:], axis_name, perm)
+        k_cat = jnp.concatenate([k_prev, k], axis=1)
+        v_cat = jnp.concatenate([v_prev, v], axis=1)
+    else:
+        k_cat, v_cat = k, v
+    k_exp, v_exp = expand_kv_heads(q, k_cat, v_cat)
+    scale = d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_exp,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = idx * t + jnp.arange(t)
+    k_pos = idx * t - tail + jnp.arange(k_cat.shape[1])
+    mask = ((q_pos[:, None] >= k_pos[None, :])
+            & (q_pos[:, None] - k_pos[None, :] < window)
+            & (k_pos[None, :] >= 0))
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)  # own position always valid
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_exp.dtype), v_exp,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def blockwise_causal_attention(q: jnp.ndarray, k: jnp.ndarray,
                                v: jnp.ndarray, block_size: int = 512
                                ) -> jnp.ndarray:

@@ -159,7 +159,7 @@ class DcnDeadlineTrainer:
                  barrier_timeout_s: float = 300.0, client=None,
                  rank: Optional[int] = None,
                  num_processes: Optional[int] = None,
-                 wire: str = "f32", max_lag: int = 0):
+                 wire: str = "f32", max_lag: int = 0, tracer=None):
         if deadline_s <= 0:
             raise ValueError("deadline_s must be > 0")
         if wire not in ("f32", "int8"):
@@ -186,6 +186,7 @@ class DcnDeadlineTrainer:
                        else int(num_processes))
         self.master = self.rank == 0
         self.wire = wire
+        self.tracer = tracer  # runtime/tracing.Tracer or None
         # max_lag follows the reference's (and RoundPacer's) convention:
         # K EXTRA rounds may be in flight beyond the one being applied —
         # 0 = lockstep, K = ring of K+1 rows
@@ -218,6 +219,10 @@ class DcnDeadlineTrainer:
         self._apply = None
 
     # -- keys ---------------------------------------------------------------
+
+    def _trace(self, kind: str, **fields) -> None:
+        if self.tracer is not None:
+            self.tracer.record(kind, rank=self.rank, **fields)
 
     def _try_get(self, key: str) -> Optional[str]:
         """try-get that treats a missing key as None (the service client
@@ -298,6 +303,8 @@ class DcnDeadlineTrainer:
         self._kv.key_value_set(self._maskkey(r),
                                "".join("1" if v else "0" for v in mask),
                                allow_overwrite=False)
+        self._trace("mask_published", round=r,
+                    n_masked=sum(1 for v in mask if not v))
         self.clock.expire(r - 1)
         return mask
 
@@ -403,6 +410,8 @@ class DcnDeadlineTrainer:
             n_masked=self.nprocs - count,
             loss=float(np.mean(losses)))
         self.reports.append(rep)
+        self._trace("round_complete", round=r, n_masked=rep.n_masked,
+                    count=count, replay=replay)
         return params, opt_state, rep
 
     @property
@@ -488,6 +497,7 @@ class DcnDeadlineTrainer:
                 pass
         self._kv.key_value_set(self._snapkey, str(step),
                                allow_overwrite=True)
+        self._trace("snapshot_served", step=step)
 
     def reset_to_round(self, r: int) -> None:
         """Rebase this process at round ``r`` after a snapshot restore:
@@ -502,6 +512,7 @@ class DcnDeadlineTrainer:
         through the normal per-round sweep instead."""
         self._pending.clear()
         self._round = int(r)
+        self._trace("rejoin_rebase", round=int(r))
 
     # -- catch-up after a stall ---------------------------------------------
 
@@ -548,6 +559,8 @@ class DcnDeadlineTrainer:
         if replayed:
             self.reports[-1] = dataclasses.replace(self.reports[-1],
                                                    caught_up=replayed)
+            self._trace("catch_up", replayed=replayed,
+                        resumed_at=self._round)
         return params, opt_state, replayed
 
     # -- the public round ----------------------------------------------------
