@@ -215,7 +215,10 @@ class TestWindowedSP:
 
         return run(q, k, v)
 
-    @pytest.mark.parametrize("window", [1, 5, 16, 17])
+    @pytest.mark.parametrize("window", [
+        1, 5,
+        pytest.param(16, marks=pytest.mark.slow),
+        pytest.param(17, marks=pytest.mark.slow)])
     def test_forward_matches_windowed_oracle(self, mesh, window):
         """window spans: degenerate self-only, inside-block, exactly the
         block (tail = t_local - 1... tail 15), and tail == t_local."""
@@ -225,6 +228,7 @@ class TestWindowedSP:
         np.testing.assert_allclose(np.asarray(got), np.asarray(oracle),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_gqa_narrow_kv(self, mesh):
         q, k, v = self._qkv_sp(seed=3, h_kv=1)
         oracle = local_causal_attention(q, k, v, window=7)
@@ -232,6 +236,7 @@ class TestWindowedSP:
         np.testing.assert_allclose(np.asarray(got), np.asarray(oracle),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_match_oracle(self, mesh):
         """The neighbor ppermute must transpose correctly: dK/dV for the
         exchanged tail flow back to the owning rank."""
