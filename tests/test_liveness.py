@@ -148,15 +148,21 @@ class TestSigstopCluster:
              "--data-size", "1024", "--max-chunk-size", "128",
              "--max-lag", "2", "--th-allreduce", "0.75",
              "--th-reduce", "0.75", "--th-complete", "0.75",
-             "--max-round", str(rounds), "--timeout", "30",
-             "--heartbeat-interval", "0.4", "--unreachable-after", "4.0"],
+             "--max-round", str(rounds), "--timeout", "45",
+             "--heartbeat-interval", "0.4", "--unreachable-after", "6.0"],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         time.sleep(0.5)
         workers = [subprocess.Popen(
-            [sys.executable, "-m", "akka_allreduce_tpu.cli", "worker",
+            # -u: the first checkpoint line is the SIGSTOP trigger and
+            # must reach the pipe IMMEDIATELY — block-buffered stdout
+            # held it back ~8 KB (tens of seconds), landing the stop so
+            # late the down had no post-down rounds left to prove
+            # liveness against (the flake this comment buries)
+            [sys.executable, "-u", "-m", "akka_allreduce_tpu.cli",
+             "worker",
              "--master-port", str(port), "--data-size", "1024",
-             "--timeout", "35", "--verbose", "--checkpoint", "10",
-             "--heartbeat-interval", "0.4", "--unreachable-after", "4.0"],
+             "--timeout", "50", "--verbose", "--checkpoint", "10",
+             "--heartbeat-interval", "0.4", "--unreachable-after", "6.0"],
             # stdout piped ONLY to observe the first checkpoint line (the
             # SIGSTOP trigger); everything else is discarded — an
             # un-drained 64K pipe fills within seconds at --verbose round
@@ -184,7 +190,7 @@ class TestSigstopCluster:
             m_out, m_err = master.communicate(timeout=60)
             assert "downing unreachable peer" in m_err, (m_out, m_err)
             downs = re.findall(r"worker down at round (\d+)", m_out)
-            # a 4s window must only down the SIGSTOPped worker
+            # a 6s window must only down the SIGSTOPped worker
             # (2s false-downed healthy CPU-starved peers when the 1-core
             # box ran the full suite; the victim's stall is indefinite, so
             # widening costs only detection latency); more downs
