@@ -64,6 +64,7 @@ from akka_allreduce_tpu.ops.pallas_kernels.ring_flash import (
 )
 from akka_allreduce_tpu.parallel.ring_attention import (
     blockwise_causal_attention,
+    flash_windowed_sp_attention,
     local_causal_attention,
     ring_attention,
     windowed_sp_attention,
@@ -336,21 +337,36 @@ def select_ring_attention(cfg: TrainConfig):
         raise ValueError(f"unknown attn_impl {impl!r}")
     window = cfg.model.attn_window
     if window is not None:
-        # windows compose with sp via ONE neighbor K/V-tail exchange
-        # (parallel/ring_attention.windowed_sp_attention) — the ring's
-        # rotation only exists to reach blocks the window never sees.
-        # Forced impls keep the sp=1 selector's contract: 'local' IS
-        # this pure-JAX path, 'blockwise'/'flash' raise rather than
-        # silently running something else
-        if impl == "flash":
-            raise ValueError(
-                "attn_impl='flash' with attn_window under sp > 1 is not "
-                "kernel-served yet; use 'auto' (the windowed neighbor-"
-                "exchange path)")
+        # windows compose with sp via ONE neighbor K/V-tail exchange —
+        # the ring's rotation only exists to reach blocks the window
+        # never sees. 'auto' on TPU (and forced 'flash') serves it with
+        # the banded flash kernel on the concatenated neighbor block
+        # (flash_windowed_sp_attention); 'local' is the pure-JAX oracle
+        # path; 'blockwise' raises (same contract as sp=1)
         if impl == "blockwise":
             raise ValueError(
                 "attn_impl='blockwise' does not support attn_window "
-                "(same contract as sp=1); use 'auto' or 'local'")
+                "(same contract as sp=1); use 'auto', 'flash', or "
+                "'local'")
+        w_auto = impl == "auto"
+        if impl == "flash" or (w_auto and use_pallas("ring_flash")):
+            interp = jax.default_backend() != "tpu"
+
+            def flash_or_fallback(q, k, v):
+                want = cfg.attn_block_size or default_flash_block(q.dtype)
+                blk = pick_flash_block(q.shape[1], want)
+                if blk is None:
+                    if impl == "flash":
+                        raise ValueError(
+                            f"attn_impl='flash': no legal flash block "
+                            f"for local sequence {q.shape[1]} "
+                            f"(want <= {want})")
+                    return windowed_sp_attention(q, k, v, window, "sp")
+                return flash_windowed_sp_attention(
+                    q, k, v, window, "sp", block_q=blk, block_k=blk,
+                    interpret=interp)
+
+            return flash_or_fallback
         return partial(windowed_sp_attention, window=window,
                        axis_name="sp")
     auto = impl == "auto"

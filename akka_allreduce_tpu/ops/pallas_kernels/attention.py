@@ -56,19 +56,23 @@ def _causal_mask(iq, ik, blk_q, blk_k, q_off=0, k_off=0, window=None):
     return mask
 
 
-def _tile_live_local(iq, ik, blk_q, blk_k, causal, window=None):
+def _tile_live_local(iq, ik, blk_q, blk_k, causal, window=None,
+                     q_off=0, k_off=0):
     """Tile has at least one potentially-unmasked score: not entirely in
     the queries' future (causal) and not entirely fallen out of the
     sliding window. Skipped tiles cost nothing (~half the grid for plain
-    causal; all but ~window/blk_k tiles per query row under a window)."""
+    causal; all but ~window/blk_k tiles per query row under a window).
+    Offsets shift into the same frame _causal_mask uses (rectangular
+    attention: Tq != Tk with the query block starting at q_off)."""
     if not causal:
         return True
-    live = ik * blk_k <= iq * blk_q + blk_q - 1
+    live = ik * blk_k + k_off <= iq * blk_q + q_off + blk_q - 1
     if window is not None:
         # newest key in the tile must still be inside the OLDEST query's
         # window: max(k_pos) > min(q_pos) - window. & not `and`: the grid
         # indices are traced scalars inside the kernel.
-        live = live & (ik * blk_k + blk_k - 1 > iq * blk_q - window)
+        live = live & (ik * blk_k + k_off + blk_k - 1
+                       > iq * blk_q + q_off - window)
     return live
 
 
@@ -107,7 +111,8 @@ def _bwd_tile(q, k, v, do, lse, delta, mask, scale):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, blk_q, blk_k, causal, window):
+                *, scale, blk_q, blk_k, causal, window, q_off=0,
+                k_off=0):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -120,11 +125,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     # Causal skip: key block entirely in the queries' future — every score
     # masked, nothing to accumulate (same early-out as the ring/blockwise
     # paths; ~half the inner iterations vanish).
-    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window)
+    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window,
+                            q_off, k_off)
 
     @pl.when(live)
     def _step():
-        mask = _causal_mask(iq, ik, blk_q, blk_k, window=window) \
+        mask = _causal_mask(iq, ik, blk_q, blk_k, q_off, k_off,
+                            window=window) \
             if causal else None
         m_new, l_new, acc_new = _softmax_tile(
             q_ref[0, 0, :, :], k_ref[0, 0, :, :], v_ref[0, 0, :, :],
@@ -144,7 +151,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, blk_q, blk_k, causal, window):
+               dq_scr, *, scale, blk_q, blk_k, causal, window,
+               q_off=0, k_off=0):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -152,12 +160,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window)
+    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window,
+                            q_off, k_off)
 
     @pl.when(live)
     def _step():
         k = k_ref[0, 0, :, :]
-        mask = _causal_mask(iq, ik, blk_q, blk_k, window=window) \
+        mask = _causal_mask(iq, ik, blk_q, blk_k, q_off, k_off,
+                            window=window) \
             if causal else None
         _, ds = _bwd_tile(q_ref[0, 0, :, :], k, v_ref[0, 0, :, :],
                           do_ref[0, 0, :, :], lse_ref[0, 0, :, :],
@@ -173,7 +183,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, blk_q, blk_k, causal, nq, window):
+                *, scale, blk_q, blk_k, causal, nq, window,
+                q_off=0, k_off=0):
     # Swapped grid: (B, KV head, key-block, inner) where the innermost axis
     # enumerates (query head within the GQA group) x (query block),
     # jj = qh_local * nq + iq — scratch accumulates dk/dv across the whole
@@ -189,13 +200,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     # Skip query blocks entirely BEFORE this key block (they never attend
     # to it under causality).
-    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window)
+    live = _tile_live_local(iq, ik, blk_q, blk_k, causal, window,
+                            q_off, k_off)
 
     @pl.when(live)
     def _step():
         q = q_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        mask = _causal_mask(iq, ik, blk_q, blk_k, window=window) \
+        mask = _causal_mask(iq, ik, blk_q, blk_k, q_off, k_off,
+                            window=window) \
             if causal else None
         p, ds = _bwd_tile(q, k_ref[0, 0, :, :], v_ref[0, 0, :, :], do,
                           lse_ref[0, 0, :, :], delta_ref[0, 0, :, :],
@@ -214,15 +227,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _block_sizes(t: int, block_q: int, block_k: int) -> tuple[int, int]:
-    blk_q, blk_k = min(block_q, t), min(block_k, t)
-    if t % blk_q or t % blk_k:
+def _block_sizes(tq: int, tk: int, block_q: int, block_k: int
+                 ) -> tuple[int, int]:
+    blk_q, blk_k = min(block_q, tq), min(block_k, tk)
+    if tq % blk_q or tk % blk_k:
         raise ValueError(
-            f"sequence {t} not divisible by block sizes ({blk_q}, {blk_k})")
+            f"sequences ({tq}, {tk}) not divisible by block sizes "
+            f"({blk_q}, {blk_k})")
     return blk_q, blk_k
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None,
+         q_off=0, k_off=0):
     """q/k/v in kernel layout (B, H, T, D); returns (o (B,H,T,D), lse).
 
     Grouped-query attention is native: K/V may carry fewer heads than Q
@@ -230,9 +246,10 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
     the query-head grid index by the group factor, so the narrow heads are
     read directly from HBM with no materialised repeat."""
     b, h, t, d = q.shape
+    tk = k.shape[2]
     g = h // k.shape[1]
-    blk_q, blk_k = _block_sizes(t, block_q, block_k)
-    nq, nk = t // blk_q, t // blk_k
+    blk_q, blk_k = _block_sizes(t, tk, block_q, block_k)
+    nq, nk = t // blk_q, tk // blk_k
     scale = d ** -0.5
 
     def qspec():
@@ -247,7 +264,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, blk_q=blk_q,
-                          blk_k=blk_k, causal=causal, window=window),
+                          blk_k=blk_k, causal=causal, window=window,
+                          q_off=q_off, k_off=k_off),
         grid=(b, h, nq, nk),
         in_specs=[qspec(), kspec(), kspec()],
         out_shape=(
@@ -271,14 +289,15 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
 
 
 def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
-         window=None):
+         window=None, q_off=0, k_off=0):
     """All tensors in kernel layout (B, H, T, D); k/v may carry fewer
     (grouped) heads — see _fwd."""
     b, h, t, d = q.shape
+    tk = k.shape[2]
     g = h // k.shape[1]
     h_kv = k.shape[1]
-    blk_q, blk_k = _block_sizes(t, block_q, block_k)
-    nq, nk = t // blk_q, t // blk_k
+    blk_q, blk_k = _block_sizes(t, tk, block_q, block_k)
+    nq, nk = t // blk_q, tk // blk_k
     scale = d ** -0.5
     # delta_i = sum_d dO_i . O_i — the rowwise term of dsoftmax; one cheap
     # fused elementwise pass in XLA, saved layout (B, H, T) like lse
@@ -299,7 +318,8 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, blk_q=blk_q,
-                          blk_k=blk_k, causal=causal, window=window),
+                          blk_k=blk_k, causal=causal, window=window,
+                          q_off=q_off, k_off=k_off),
         grid=(b, h, nq, nk),
         in_specs=[tspec(blk_q, q_by_i), tspec(blk_k, k_by_j),
                   tspec(blk_k, k_by_j), tspec(blk_q, q_by_i),
@@ -325,7 +345,7 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, blk_q=blk_q,
                           blk_k=blk_k, causal=causal, nq=nq,
-                          window=window),
+                          window=window, q_off=q_off, k_off=k_off),
         grid=(b, h_kv, nk, g * nq),
         in_specs=[tspec(blk_q, q_by_jj), tspec(blk_k, k_by_i),
                   tspec(blk_k, k_by_i), tspec(blk_q, q_by_jj),
@@ -349,42 +369,54 @@ def _to_kernel_layout(x):
     return jnp.swapaxes(x, 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
-                    interpret=False, window=None):
-    """Fused attention. q/k/v: (B, T, H, D) -> (B, T, H, D).
+                    interpret=False, window=None, q_off=0, k_off=0):
+    """Fused attention. q: (B, Tq, H, D); k/v: (B, Tk, H_kv, D) ->
+    (B, Tq, H, D).
 
-    ``T`` must be divisible by the (clamped) block sizes; sequence lengths
-    here are static, so pick divisors — same contract as
-    :func:`parallel.ring_attention.blockwise_causal_attention`. ``interpret``
-    runs the kernels in Pallas interpreter mode (CPU-testable).
-    ``window`` (causal only, >= 1): sliding-window attention — each query
-    sees itself plus the window-1 preceding positions; tiles entirely
-    outside the band are skipped, so compute is O(T * window).
+    ``Tq``/``Tk`` may differ (rectangular attention — the windowed-SP
+    composition scores a concatenated neighbor block); each must be
+    divisible by its (clamped) block size. Sequence lengths are static,
+    so pick divisors — same contract as
+    :func:`parallel.ring_attention.blockwise_causal_attention`.
+    ``interpret`` runs the kernels in Pallas interpreter mode
+    (CPU-testable). ``window`` (causal only, >= 1): sliding-window
+    attention — each query sees itself plus the window-1 preceding
+    positions; tiles entirely outside the band are skipped, so compute
+    is O(T * window). ``q_off``/``k_off`` (static ints) shift the
+    query/key positions into a common frame for the causal and window
+    masks: query i sits at ``q_off + i``, key j at ``k_off + j`` —
+    offsets change MASKING only, so the caller owns making the geometry
+    meaningful (flash_windowed_sp_attention's front-pad layout is the
+    worked example).
     """
     if window is not None and (not causal or window < 1):
         raise ValueError("window needs causal=True and window >= 1")
     o, _ = _fwd(_to_kernel_layout(q), _to_kernel_layout(k),
                 _to_kernel_layout(v), causal, block_q, block_k, interpret,
-                window)
+                window, q_off, k_off)
     return _to_kernel_layout(o)
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret,
-                    window=None):
+                    window=None, q_off=0, k_off=0):
     if window is not None and (not causal or window < 1):
         raise ValueError("window needs causal=True and window >= 1")
     qt, kt, vt = (_to_kernel_layout(x) for x in (q, k, v))
-    o, lse = _fwd(qt, kt, vt, causal, block_q, block_k, interpret, window)
+    o, lse = _fwd(qt, kt, vt, causal, block_q, block_k, interpret, window,
+                  q_off, k_off)
     # residuals stay in kernel layout: the backward kernels consume them
     # directly, so only the cotangent pays a relayout
     return _to_kernel_layout(o), (qt, kt, vt, o, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, window, res, do):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, window,
+                    q_off, k_off, res, do):
     qt, kt, vt, ot, lse = res
     dq, dk, dv = _bwd(qt, kt, vt, ot, lse, _to_kernel_layout(do),
-                      causal, block_q, block_k, interpret, window)
+                      causal, block_q, block_k, interpret, window,
+                      q_off, k_off)
     return tuple(_to_kernel_layout(g) for g in (dq, dk, dv))
 
 

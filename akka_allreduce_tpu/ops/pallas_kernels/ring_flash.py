@@ -267,7 +267,7 @@ def ring_flash_attention(q, k, v, axis_name="sp", causal=True,
 def _ring_fwd(q, k, v, axis_name, causal, block_q, block_k, interpret):
     qt, kt, vt = _kl(q), _kl(k), _kl(v)
     b, h, t, d = qt.shape
-    blk_q, blk_k = _block_sizes(t, block_q, block_k)
+    blk_q, blk_k = _block_sizes(t, t, block_q, block_k)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -317,7 +317,7 @@ def _ring_bwd_rule(axis_name, causal, block_q, block_k, interpret, res,
     qt, kt, vt, ot, lse = res
     dot = _kl(do)
     b, h, t, d = qt.shape
-    blk_q, blk_k = _block_sizes(t, block_q, block_k)
+    blk_q, blk_k = _block_sizes(t, t, block_q, block_k)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]

@@ -134,6 +134,30 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
+def _neighbor_tail_exchange(k, v, tail: int, axis_name: str):
+    """Fetch the previous rank's last ``tail`` K/V columns (the one
+    exchange both windowed-SP paths share — keep the geometry in ONE
+    place so the kernel path can never desynchronize from its pure-JAX
+    oracle). Rank 0 receives the LAST rank's wrap-around tail; callers
+    mask or bypass it."""
+    t = k.shape[1]
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_prev = lax.ppermute(k[:, t - tail:], axis_name, perm)
+    v_prev = lax.ppermute(v[:, t - tail:], axis_name, perm)
+    return k_prev, v_prev
+
+
+def _check_window_fits(window: int, t: int) -> int:
+    tail = window - 1
+    if tail > t:
+        raise ValueError(
+            f"attn_window={window} under sequence parallelism needs "
+            f"window - 1 <= local sequence ({t}); raise --seq, lower "
+            f"--sp, or shrink the window")
+    return tail
+
+
 def windowed_sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           window: int, axis_name: str = "sp"
                           ) -> jnp.ndarray:
@@ -156,18 +180,10 @@ def windowed_sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     softmax, inputs' dtype on the matmuls.
     """
     b, t, h, d = q.shape
-    tail = window - 1
-    if tail > t:
-        raise ValueError(
-            f"attn_window={window} under sequence parallelism needs "
-            f"window - 1 <= local sequence ({t}); raise --seq, lower "
-            f"--sp, or shrink the window")
-    n = lax.axis_size(axis_name)
+    tail = _check_window_fits(window, t)
     idx = lax.axis_index(axis_name)
     if tail > 0:
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        k_prev = lax.ppermute(k[:, t - tail:], axis_name, perm)
-        v_prev = lax.ppermute(v[:, t - tail:], axis_name, perm)
+        k_prev, v_prev = _neighbor_tail_exchange(k, v, tail, axis_name)
         k_cat = jnp.concatenate([k_prev, k], axis=1)
         v_cat = jnp.concatenate([v_prev, v], axis=1)
     else:
@@ -186,6 +202,53 @@ def windowed_sp_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_exp.dtype), v_exp,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
+
+
+def flash_windowed_sp_attention(q: jnp.ndarray, k: jnp.ndarray,
+                                v: jnp.ndarray, window: int,
+                                axis_name: str = "sp",
+                                block_q: int = 128, block_k: int = 128,
+                                interpret: bool = False) -> jnp.ndarray:
+    """Kernel-served :func:`windowed_sp_attention`: the same one-neighbor
+    K/V-tail exchange, with the banded flash kernel scoring the
+    concatenated [prev-tail ++ local] block instead of a materialised
+    (T_local, T_local+tail) score matrix — O(T * window) compute and
+    O(block) memory, GQA-native.
+
+    Geometry: the concat is FRONT-padded to a block-size multiple and
+    the query block enters the kernel at ``q_off = pad + tail`` in the
+    key frame. Pad columns sit >= window positions before every query,
+    so the kernel's own window mask eliminates them — no extra mask
+    plumbing. Rank 0 has no previous block; its wrapped tail is garbage
+    at VALID window positions, so a ``lax.cond`` routes rank 0 to the
+    plain local windowed kernel (the ppermute stays outside the cond —
+    collectives may not sit under a device-varying predicate)."""
+    from akka_allreduce_tpu.ops.pallas_kernels.attention import \
+        flash_attention
+
+    b, t, h, d = q.shape
+    tail = _check_window_fits(window, t)
+    if tail == 0:
+        return flash_attention(q, k, v, True, block_q, block_k,
+                               interpret, window)
+    k_prev, v_prev = _neighbor_tail_exchange(k, v, tail, axis_name)
+    blk_k = min(block_k, t)
+    pad = (-(t + tail)) % blk_k
+    zeros = jnp.zeros((b, pad) + k.shape[2:], k.dtype)
+    k_cat = jnp.concatenate([zeros, k_prev, k], axis=1)
+    v_cat = jnp.concatenate([zeros, v_prev, v], axis=1)
+    q_off = pad + tail
+
+    def with_tail(_):
+        return flash_attention(q, k_cat, v_cat, True, block_q, blk_k,
+                               interpret, window, q_off, 0)
+
+    def rank0(_):
+        return flash_attention(q, k, v, True, block_q, block_k,
+                               interpret, window)
+
+    return lax.cond(lax.axis_index(axis_name) == 0, rank0, with_tail,
+                    None)
 
 
 def blockwise_causal_attention(q: jnp.ndarray, k: jnp.ndarray,

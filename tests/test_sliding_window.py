@@ -98,14 +98,13 @@ class TestModelIntegration:
         with pytest.raises(ValueError, match="attn_window"):
             TransformerConfig(attn_window=0)
 
-    def test_sp_forced_flash_with_window_rejected(self):
-        """The windowed sp path is pure-JAX (neighbor exchange); forcing
-        the flash kernel there is a clear error, like the other forced-
-        kernel contracts."""
+    def test_sp_forced_blockwise_with_window_rejected(self):
+        """'blockwise' cannot serve a window (same contract as sp=1);
+        'flash' is kernel-served now (TestFlashWindowedSP)."""
         from akka_allreduce_tpu.models.train import (TrainConfig,
                                                      select_ring_attention)
-        cfg = TrainConfig(model=WCFG, attn_impl="flash")
-        with pytest.raises(ValueError, match="kernel-served"):
+        cfg = TrainConfig(model=WCFG, attn_impl="blockwise")
+        with pytest.raises(ValueError, match="blockwise"):
             select_ring_attention(cfg)
 
     @pytest.mark.slow
@@ -302,3 +301,86 @@ class TestWindowedSP:
         l1 = loss_with(MeshSpec(dp=1))
         l2 = loss_with(MeshSpec(dp=1, sp=2))
         assert abs(l1 - l2) < 2e-4, (l1, l2)
+
+
+class TestFlashWindowedSP:
+    """Kernel-served windowed SP (flash on the concatenated neighbor
+    block) against the pure-JAX path and the oracle."""
+
+    N = 4
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from akka_allreduce_tpu.parallel.mesh import single_axis_mesh
+        return single_axis_mesh("sp", devices=jax.devices("cpu")[:self.N])
+
+    def _run(self, mesh, q, k, v, window, blk=16):
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from akka_allreduce_tpu.parallel.ring_attention import \
+            flash_windowed_sp_attention
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P(None, "sp"),
+                 out_specs=P(None, "sp"), check_vma=False)
+        def run(qs, ks, vs):
+            return flash_windowed_sp_attention(qs, ks, vs, window, "sp",
+                                               block_q=blk, block_k=blk,
+                                               interpret=True)
+
+        return run(q, k, v)
+
+    def test_matches_oracle_and_pure_path(self, mesh):
+        rng = np.random.default_rng(2)
+        mk = lambda hh: jnp.asarray(  # noqa: E731
+            rng.normal(size=(2, 64, 2, 8)).astype(np.float32)[:, :, :hh])
+        q, k, v = mk(2), mk(1), mk(1)  # GQA narrow K/V
+        window = 9
+        oracle = local_causal_attention(q, k, v, window=window)
+        got = self._run(mesh, q, k, v, window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(oracle),
+                                   rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.slow
+    def test_gradients_match_pure_path(self, mesh):
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from akka_allreduce_tpu.parallel.ring_attention import (
+            flash_windowed_sp_attention, windowed_sp_attention)
+
+        rng = np.random.default_rng(3)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.normal(size=(2, 64, 2, 8)).astype(np.float32))
+        q, k, v = mk(), mk(), mk()
+        window = 12
+
+        def make_loss(fn):
+            @partial(jax.shard_map, mesh=mesh, in_specs=P(None, "sp"),
+                     out_specs=P(None, "sp"), check_vma=False)
+            def attn(qs, ks, vs):
+                return fn(qs, ks, vs)
+
+            return lambda q, k, v: jnp.sum(attn(q, k, v) ** 2)
+
+        g_flash = jax.grad(make_loss(
+            lambda qs, ks, vs: flash_windowed_sp_attention(
+                qs, ks, vs, window, "sp", block_q=16, block_k=16,
+                interpret=True)), argnums=(0, 1, 2))(q, k, v)
+        g_pure = jax.grad(make_loss(
+            lambda qs, ks, vs: windowed_sp_attention(
+                qs, ks, vs, window, "sp")), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_pure):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_forced_flash_now_served(self):
+        """The sp+window+flash combination is kernel-served: the selector
+        returns a callable instead of raising."""
+        from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                     select_ring_attention)
+        cfg = TrainConfig(model=WCFG, attn_impl="flash",
+                          attn_block_size=16)
+        assert callable(select_ring_attention(cfg))
