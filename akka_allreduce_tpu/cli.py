@@ -292,6 +292,29 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "store for straggler catch-up replay; beyond it "
                         "a straggler rejoins via checkpoint snapshot "
                         "(needs --ckpt-dir)")
+    p.add_argument("--th-allreduce", type=float, default=1.0,
+                   help="hybrid only: completion fraction that closes a "
+                        "round EARLY (before the deadline) — the "
+                        "reference master's threshold advance; 1.0 = "
+                        "wait for every non-downed process until the "
+                        "deadline")
+    p.add_argument("--down-after", type=int, default=4,
+                   help="hybrid only: auto-down a process masked this "
+                        "many CONSECUTIVE rounds (stop waiting its "
+                        "deadline; it re-ups by reporting at the "
+                        "frontier). 0 = never down — a dead peer then "
+                        "costs the full deadline every round")
+    p.add_argument("--dcn-bucket-elems", type=int, default=0,
+                   help="hybrid only: chunk the cross-process gradient "
+                        "wire into buckets of N elements so a process "
+                        "cut mid-publish still contributes the buckets "
+                        "that landed (per-bucket masks + honest counts); "
+                        "0 = one whole-vector bucket")
+    p.add_argument("--master-timeout-s", type=float, default=10.0,
+                   help="hybrid only: workers fail once the master's "
+                        "heartbeat has been silent this long (the "
+                        "reference's 10s failure-detector window); "
+                        "0 disables the watch")
     p.add_argument("--trace-file", default=None,
                    help="hybrid only: write the structured round trace "
                         "(JSONL: round_complete/mask_published/catch_up/"
@@ -582,6 +605,13 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print("error: --straggle-prob needs --deadline-ms",
               file=sys.stderr)
         return 2
+    if not 0.0 < args.th_allreduce <= 1.0:
+        print("error: --th-allreduce must be in (0, 1]", file=sys.stderr)
+        return 2
+    if args.down_after < 0:
+        print("error: --down-after must be >= 0 (0 = never)",
+              file=sys.stderr)
+        return 2
     micro = args.microbatches or (args.pp if args.pp > 1 else 1)
     nprocs = jax.process_count()
     b = args.batch or 2 * dp * args.ep * micro * (nprocs if hybrid else 1)
@@ -631,6 +661,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
             cfg, mesh, opt, deadline_s=args.deadline_ms / 1e3,
             wire="int8" if args.int8_grads else "f32",
             max_lag=args.max_lag, retain_rounds=args.retain_rounds,
+            th_allreduce=args.th_allreduce, down_after=args.down_after,
+            dcn_bucket_elems=args.dcn_bucket_elems or None,
+            hb_timeout_s=args.master_timeout_s,
             tracer=tracer)
         step = None
     else:
@@ -774,6 +807,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
             from akka_allreduce_tpu.runtime.dcn_train import \
                 StalledBeyondRetention
+            last_downed = ()
             while True:
                 try:
                     params, opt_state, replayed = dcn.catch_up(params,
@@ -817,6 +851,13 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 if rep is None:
                     continue
                 serve_snapshot_requests(rep)
+                if chatty and rep.downed != last_downed:
+                    # membership changes always narrate (not log-every
+                    # paced): auto-down is the event an operator must see
+                    print(f"auto-downed processes now: "
+                          f"{list(rep.downed) or 'none'} "
+                          f"(round {rep.round + 1})")
+                    last_downed = rep.downed
                 if mgr is not None:
                     mgr.maybe_save(rep.round, params, opt_state,
                                    {"data_step": rep.round})
@@ -824,12 +865,14 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 if rep.round == start \
                         or (rep.round + 1) % args.log_every == 0:
                     dt = time.perf_counter() - tic
+                    partial = (f", {rep.n_partial} partial"
+                               if rep.n_partial else "")
                     if chatty:
                         print(f"step {rep.round + 1:4d}: loss "
                               f"{rep.loss:.4f} "
                               f"({b * t * steps_in_window / dt:.0f} "
                               f"tok/s) [masked {rep.n_masked}/{nprocs} "
-                              f"procs]")
+                              f"procs{partial}]")
                     tic = time.perf_counter()
                     steps_in_window = 0
             # drain one round at a time so every checkpoint pairs the
@@ -851,12 +894,23 @@ def _cmd_train(args: argparse.Namespace) -> int:
             if tracer is not None:
                 n = tracer.write_jsonl(args.trace_file)
                 print(f"wrote {n} trace events to {args.trace_file}")
-            dcn.close()
             if mgr is not None:
                 final = args.steps - 1
                 if args.steps > start and mgr.latest_step() != final:
                     mgr.save(final, params, opt_state,
                              {"data_step": final}, force=True)
+                # a straggler whose rejoin request landed during the
+                # master's LAST rounds would otherwise see the done
+                # marker and give up: hand it the final checkpoint on
+                # the way out (wait_snapshot re-checks the snapshot key
+                # before trusting the done key)
+                if dcn.master and args.steps > start \
+                        and dcn.pending_snapshot_requests():
+                    mgr.wait_until_finished()
+                    dcn.publish_snapshot_step(final)
+                    print(f"served rejoin snapshot at step {final} "
+                          f"(final)")
+            dcn.close()
             return 0
         for i in range(start, args.steps):
             step_rng, batch_np = build_batch(i)

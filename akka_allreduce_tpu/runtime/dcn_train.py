@@ -14,40 +14,62 @@ training topology (the round-2 verdict's top integration ask):
   master (process 0) over the :class:`KvRouter` — the exact worker->master
   flow of the reference (reference: AllreduceMessage.scala:21,
   AllreduceMaster.scala:54-63). The master feeds the reports into a
-  :class:`RoundClock` (runtime/pacer.py), closes the round early when
-  everyone arrived or at the deadline otherwise, and publishes the
-  resulting contribution mask. Survivors apply the masked,
+  :class:`RoundClock` (runtime/pacer.py), closes the round when a
+  **completion fraction** arrived (``th_allreduce``, the reference
+  master's ``numComplete >= totalWorkers * thAllreduce`` advance,
+  reference: AllreduceMaster.scala:58) or at the deadline otherwise, and
+  publishes the resulting contribution mask. Survivors apply the masked,
   count-rescaled mean — honest counts, unbiased scale-up, the TPU
   rendering of thresholds < 1 (reference: ScatteredDataBuffer.scala:9-13,
   ReducedDataBuffer.scala:40-48).
 
-A straggling process (SIGSTOP, GC pause, slow host) simply misses its
-deadlines: the cluster keeps training without it, every round's counts
-reporting the gap. When it wakes it **catches up deterministically** —
-missed rounds' masks and contributor payloads are retained in the KV
-store for ``retain_rounds``, so it replays the exact updates the
-survivors applied (its own stale contributions were masked out, so
-replay equals the survivors' history bit-for-bit) and rejoins the mask
-at the current round — the reference's maxLag catch-up re-imagined
-(reference: AllreduceWorker.scala:100-106). A stall beyond the retention
-window raises, directing the operator to checkpoint resume
-(runtime/checkpoint.py).
+Straggler semantics at three granularities, all reference-derived:
+
+* **Per bucket**: the gradient crosses DCN as ``dcn_bucket_elems``-sized
+  wire chunks (one KV entry each, the reference worker's ``maxChunkSize``
+  chunking of its block, reference: AllreduceWorker.scala:220-233). A
+  process that missed the round deadline still contributes the chunks
+  that physically landed — the mask and the contribution counts are
+  per-(process, bucket), like the reference's per-chunk thresholds.
+* **Per round**: a straggling process (SIGSTOP, GC pause, slow host)
+  misses its deadlines; the cluster keeps training without it, every
+  round's counts reporting the gap. When it wakes it **catches up
+  deterministically** — missed rounds' masks and contributor payloads are
+  retained in the KV store for ``retain_rounds``, so it replays the exact
+  updates the survivors applied and rejoins the mask — the reference's
+  maxLag catch-up re-imagined (reference: AllreduceWorker.scala:100-106).
+* **Permanently**: a peer masked ``down_after`` consecutive rounds is
+  **auto-downed** — removed from the master's wait set so no later round
+  waits its deadline on a corpse (the reference's
+  ``auto-down-unreachable-after`` member removal, reference:
+  application.conf:20). A downed peer that reports again near the
+  frontier (a SIGCONT'd straggler that caught up) is re-upped; one that
+  stalled beyond retention rejoins via the checkpoint-snapshot protocol.
+
+Liveness is symmetric: the master heartbeats a KV key from a background
+thread, and workers waiting on a mask or a snapshot fail within
+``hb_timeout_s`` of the last beat instead of spinning out a multi-minute
+barrier timeout — the reference's 10 s failure-detector window
+(reference: application.conf:20) rather than silence.
+
+Replica integrity: every ``check_every`` rounds each process publishes a
+CRC of its (replicated) params and the master cross-checks them, failing
+loudly on silent optimizer-replica divergence (heterogeneous hosts
+jitting different code would otherwise drift compound-style).
 
 The first round is a quorum barrier (no deadline): the master waits for
 every process once, like the reference master holding ``StartAllreduce``
 until ``totalWorkers`` joined (reference: AllreduceMaster.scala:39).
-
-The gradient payload crosses DCN as one f32 vector per process per round
-(header: local loss + token count). Chunking/fusion granularity lives in
-the device plane's bucketing; the host payload is the whole vector, like
-the reference worker's full ``dataSize`` contribution per round.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import struct
+import threading
 import time
+import zlib
 from functools import partial
 from typing import Any, Optional
 
@@ -56,8 +78,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from akka_allreduce_tpu.config import num_chunks
 from akka_allreduce_tpu.messages import CompleteAllreduce
-from akka_allreduce_tpu.models.train import make_grad_step
 from akka_allreduce_tpu.ops.bucketing import (
     tree_bucket_spec,
     tree_to_vector,
@@ -66,15 +88,25 @@ from akka_allreduce_tpu.ops.bucketing import (
 from akka_allreduce_tpu.protocol.kv import KvRouter, _default_client
 from akka_allreduce_tpu.runtime.pacer import RoundClock
 
-_HDR = struct.Struct("<ffBxxx")  # local loss, local tokens, wire format
+# local loss f32, local tokens u64 (exact — an f32 count would lose
+# precision above 2^24 tokens), wire format u8, 3 pad bytes
+_HDR = struct.Struct("<fQBxxx")
 _WIRE_F32, _WIRE_INT8 = 0, 1
 _INT8_CHUNK = 65536  # one f32 scale per chunk (the device wire's per-row
 #                      scale granularity, ops/pallas_kernels/quantized.py)
 
 
+def _is_not_found(exc: Exception) -> bool:
+    """True iff the coordination-service error means 'key missing'.
+    Transport/connectivity failures must PROPAGATE — swallowing them
+    made a dead KV client look like an endlessly-missing key."""
+    return "NOT_FOUND" in str(exc)
+
+
 def encode_payload(vec: np.ndarray, loss: float, tokens: float,
                    wire: str, seed: int = 0) -> bytes:
-    """Serialize one round's gradient vector for the DCN KV store.
+    """Serialize one wire chunk of a round's gradient for the DCN KV
+    store.
 
     ``wire="int8"`` is the host-plane rendering of the device plane's
     quantized transport: per-chunk symmetric int8 with stochastic
@@ -83,7 +115,7 @@ def encode_payload(vec: np.ndarray, loss: float, tokens: float,
     f32 scales (one per 64Ki chunk), int8 values."""
     vec = np.ascontiguousarray(vec, np.float32)
     if wire == "f32":
-        return _HDR.pack(loss, tokens, _WIRE_F32) + vec.tobytes()
+        return _HDR.pack(loss, int(tokens), _WIRE_F32) + vec.tobytes()
     if wire != "int8":
         raise ValueError(f"unknown wire {wire!r}")
     n = vec.size
@@ -96,7 +128,7 @@ def encode_payload(vec: np.ndarray, loss: float, tokens: float,
     rng = np.random.default_rng(seed)
     q = low + (scaled - low > rng.random(rows.shape, np.float32))
     values = np.clip(q, -127, 127).astype(np.int8).reshape(-1)[:n]
-    return (_HDR.pack(loss, tokens, _WIRE_INT8)  # pad never hits the wire
+    return (_HDR.pack(loss, int(tokens), _WIRE_INT8)  # pad never hits the wire
             + struct.pack("<Q", n) + scales.tobytes() + values.tobytes())
 
 
@@ -137,10 +169,13 @@ class DcnRoundReport:
     """One cross-process round as the host saw it."""
 
     round: int
-    valid_peers: tuple[bool, ...]
-    n_masked: int
+    valid_peers: tuple[bool, ...]  # per peer: contributed >= 1 bucket
+    n_masked: int  # peers that contributed NOTHING this round
     loss: float  # mean of contributors' local losses
     caught_up: int = 0  # rounds replayed before this one (post-stall)
+    bucket_counts: tuple[int, ...] = ()  # contributors per wire bucket
+    n_partial: int = 0  # peers that contributed SOME but not all buckets
+    downed: tuple[int, ...] = ()  # master only: the auto-downed set
 
 
 class DcnDeadlineTrainer:
@@ -152,6 +187,18 @@ class DcnDeadlineTrainer:
     must be built over this process's own devices only
     (``jax.local_devices()``); the cross-process reduction is this
     class's job, not XLA's.
+
+    Knobs beyond the deadline (all reference-derived, see module doc):
+    ``th_allreduce`` closes a round early at a completion fraction;
+    ``down_after`` auto-downs a peer masked that many consecutive rounds
+    (0 disables); ``dcn_bucket_elems`` chunks the DCN wire so partial
+    contributions count per bucket (None/0 = one whole-vector bucket);
+    ``check_every`` paces the replica-divergence CRC check (0 disables);
+    ``hb_timeout_s`` bounds how long workers trust a silent master.
+
+    ``grad_step`` overrides the compiled local step — any callable
+    ``(params, tokens, round) -> (grads, {"loss","tokens"})`` can ride
+    the DCN protocol (protocol tests drive it with a host-math stub).
     """
 
     def __init__(self, cfg, mesh, opt, *, deadline_s: float,
@@ -159,7 +206,12 @@ class DcnDeadlineTrainer:
                  barrier_timeout_s: float = 300.0, client=None,
                  rank: Optional[int] = None,
                  num_processes: Optional[int] = None,
-                 wire: str = "f32", max_lag: int = 0, tracer=None):
+                 wire: str = "f32", max_lag: int = 0, tracer=None,
+                 th_allreduce: float = 1.0, down_after: int = 4,
+                 dcn_bucket_elems: Optional[int] = None,
+                 check_every: Optional[int] = None,
+                 hb_interval_s: float = 0.5, hb_timeout_s: float = 10.0,
+                 grad_step=None):
         if deadline_s <= 0:
             raise ValueError("deadline_s must be > 0")
         if wire not in ("f32", "int8"):
@@ -175,6 +227,13 @@ class DcnDeadlineTrainer:
             # concurrent garbage collection; a window smaller than twice
             # that cannot replay anything and is operationally useless
             raise ValueError("retain_rounds must be >= 8")
+        if not 0.0 < th_allreduce <= 1.0:
+            raise ValueError(
+                f"th_allreduce must be in (0, 1], got {th_allreduce}")
+        if down_after < 0:
+            raise ValueError("down_after must be >= 0 (0 = never down)")
+        if dcn_bucket_elems is not None and dcn_bucket_elems <= 0:
+            dcn_bucket_elems = None
         self.cfg = cfg
         self.mesh = mesh
         self.opt = opt
@@ -187,17 +246,24 @@ class DcnDeadlineTrainer:
         self.master = self.rank == 0
         self.wire = wire
         self.tracer = tracer  # runtime/tracing.Tracer or None
+        self.th = float(th_allreduce)
+        self.down_after = int(down_after)
+        self.dcn_bucket_elems = dcn_bucket_elems
+        self.check_every = (self.retain if check_every is None
+                            else int(check_every))
+        self.hb_interval_s = float(hb_interval_s)
+        self.hb_timeout_s = float(hb_timeout_s)
         # max_lag follows the reference's (and RoundPacer's) convention:
         # K EXTRA rounds may be in flight beyond the one being applied —
         # 0 = lockstep, K = ring of K+1 rows
         # (reference: AllReduceBuffer.scala:9-42)
         self.max_lag = int(max_lag)
         self._window = self.max_lag + 1
-        # published-but-not-yet-applied rounds: (round, own payload).
+        # published-but-not-yet-applied rounds: (round, own bucket bytes).
         # Window > 1 is the reference's maxLag streaming in this
         # topology — contributions for round r+k are computed from
         # params that have only applied through round r
-        self._pending: list[tuple[int, bytes]] = []
+        self._pending: list[tuple[int, list[bytes]]] = []
         self.ns = namespace
         self._kv = client if client is not None else _default_client()
         # arrival reports ride the router (worker -> master messaging with
@@ -211,12 +277,27 @@ class DcnDeadlineTrainer:
             if self.master else None
         self._round = 0
         self._start_round = 0
+        self._frontier = 0
         self._cleaned_to = 0
+        self._downed: set[int] = set()
+        self._consec_missed: dict[int, int] = {}
         self.reports: list[DcnRoundReport] = []
-        self._gstep = jax.jit(make_grad_step(cfg, mesh))
+        if grad_step is None:
+            from akka_allreduce_tpu.models.train import make_grad_step
+            grad_step = jax.jit(make_grad_step(cfg, mesh))
+        self._gstep = grad_step
         self._flat = jax.jit(lambda g: tree_to_vector(g, jnp.float32))
         self._spec = None
         self._apply = None
+        self._chunk_elems = 0  # wire-chunk geometry, set at _ensure_wire
+        self._n_chunks = 0
+        self._hb_stop: Optional[threading.Event] = None
+        if self.master and self.hb_interval_s > 0:
+            self._hb_stop = threading.Event()
+            t = threading.Thread(target=self._hb_loop, daemon=True,
+                                 name="dcn-master-heartbeat")
+            t.start()
+            self._hb_thread = t
 
     # -- keys ---------------------------------------------------------------
 
@@ -225,18 +306,27 @@ class DcnDeadlineTrainer:
             self.tracer.record(kind, rank=self.rank, **fields)
 
     def _try_get(self, key: str) -> Optional[str]:
-        """try-get that treats a missing key as None (the service client
-        raises NOT_FOUND instead)."""
+        """try-get that treats a MISSING key as None; any other service
+        failure (connectivity, shutdown) propagates so callers see the
+        real problem instead of spinning on a 'missing' key."""
         try:
             return self._kv.key_value_try_get(key)
-        except Exception:
-            return None
+        except Exception as exc:
+            if _is_not_found(exc):
+                return None
+            raise
 
-    def _gkey(self, r: int, p: int) -> str:
-        return f"{self.ns}/g/{r:012d}/{p:04d}"
+    def _gkey(self, r: int, p: int, b: int) -> str:
+        return f"{self.ns}/g/{r:012d}/{p:04d}/{b:04d}"
+
+    def _gdir(self, r: int, p: int) -> str:
+        return f"{self.ns}/g/{r:012d}/{p:04d}/"
 
     def _maskkey(self, r: int) -> str:
         return f"{self.ns}/mask/{r:012d}"
+
+    def _chkkey(self, r: int, p: int) -> str:
+        return f"{self.ns}/chk/{r:012d}/{p:04d}"
 
     @property
     def _roundkey(self) -> str:
@@ -246,6 +336,59 @@ class DcnDeadlineTrainer:
     def _donekey(self) -> str:
         return f"{self.ns}/done"
 
+    @property
+    def _hbkey(self) -> str:
+        return f"{self.ns}/hb"
+
+    # -- master liveness ----------------------------------------------------
+
+    def _hb_loop(self) -> None:
+        """Master background thread: bump the heartbeat key every
+        ``hb_interval_s``. Runs from construction to close(), so beats
+        continue through the master's own long grad steps — a worker
+        timeout therefore measures master-process death, not master
+        compute."""
+        n = 0
+        while not self._hb_stop.wait(self.hb_interval_s):
+            n += 1
+            try:
+                self._kv.key_value_set(self._hbkey, str(n),
+                                       allow_overwrite=True)
+            except Exception:
+                # service going down: the main thread's own RPCs surface
+                # the real error; the beater must not crash the process
+                pass
+
+    def _hb_watch(self):
+        """A per-wait closure: call it inside poll loops; it raises once
+        the master's heartbeat has been silent for ``hb_timeout_s``.
+        Before the FIRST beat is seen it never fires (the master may
+        still be compiling) — the caller's own overall timeout governs
+        that phase."""
+        state = {"val": None, "at": time.monotonic(), "next": 0.0}
+        probe_every = min(1.0, max(self.hb_interval_s, 0.05))
+
+        def check() -> None:
+            if self.hb_timeout_s <= 0:
+                return
+            now = time.monotonic()
+            if now < state["next"]:
+                return
+            state["next"] = now + probe_every
+            v = self._try_get(self._hbkey)
+            if v is not None and v != state["val"]:
+                state["val"], state["at"] = v, now
+                return
+            if state["val"] is not None \
+                    and now - state["at"] > self.hb_timeout_s:
+                raise TimeoutError(
+                    f"master heartbeat silent for {self.hb_timeout_s:.0f}s"
+                    f" — the master process died (its death halts the "
+                    f"run, like the reference's master actor under the "
+                    f"10s failure detector); restart every process from "
+                    f"the last checkpoint")
+        return check
+
     # -- master-side arrival handling ---------------------------------------
 
     def _on_message(self, msg) -> None:
@@ -253,11 +396,54 @@ class DcnDeadlineTrainer:
             # reports for long-closed rounds land harmlessly: valid_peers
             # reads only rounds the clock still has open state for
             self.clock.report_arrival(msg.round, msg.src_id)
+            if (msg.src_id in self._downed
+                    and msg.round + self._window >= self._frontier):
+                # a downed peer reporting at (or within the streaming
+                # window of) the frontier has genuinely caught up — re-up
+                # it. Reports for long-dead rounds do NOT re-up: a peer
+                # still grinding through old rounds would drag every
+                # round back to the full deadline. The re-upped peer is
+                # on PROBATION: its miss counter restarts at
+                # down_after - 1, so a chronically-too-slow peer re-downs
+                # after a single further miss (one deadline burned per
+                # oscillation, not down_after) while a genuinely
+                # recovered peer clears the counter with its first
+                # in-mask round
+                self._downed.discard(msg.src_id)
+                if self.down_after > 1:
+                    self._consec_missed[msg.src_id] = self.down_after - 1
+                else:
+                    self._consec_missed.pop(msg.src_id, None)
+                self._trace("peer_rejoined", round=msg.round,
+                            peer=msg.src_id)
 
-    def _master_collect(self, r: int) -> list[bool]:
-        """Pump arrival reports; close early when all arrived, else at the
-        deadline. The first round is the quorum barrier: wait for
-        everyone.
+    def _probe_buckets(self, r: int, p: int) -> list[bool]:
+        """Which of peer ``p``'s wire chunks for round ``r`` physically
+        landed — the per-chunk contribution of a peer that missed the
+        round deadline (reference: a slow worker's arrived chunks still
+        count toward the per-chunk thresholds,
+        ScatteredDataBuffer.scala:9-13). One dir RPC; values ride along
+        and are discarded (this probe only runs for late peers)."""
+        try:
+            entries = self._kv.key_value_dir_get_bytes(self._gdir(r, p))
+        except Exception as exc:
+            if _is_not_found(exc):
+                return [False] * self._n_chunks
+            raise
+        present = set()
+        for key, _ in entries:
+            try:
+                present.add(int(key.rsplit("/", 1)[-1]))
+            except ValueError:
+                pass
+        return [b in present for b in range(self._n_chunks)]
+
+    def _master_collect(self, r: int) -> list[list[bool]]:
+        """Pump arrival reports; close the round at the completion
+        fraction (``arrived >= ceil(th_allreduce * active)``, the
+        reference master's threshold advance, AllreduceMaster.scala:58),
+        else at the deadline. Auto-downed peers are not waited on at
+        all. The first round is the quorum barrier: wait for everyone.
 
         The deadline clock opens HERE — after the master's own grad step
         and publish — not at round start: arrivals are timestamped when
@@ -273,53 +459,102 @@ class DcnDeadlineTrainer:
         (reference: AllreduceMaster.scala:54-63)."""
         self.clock.open_round(r)
         self.clock.report_arrival(r, 0)
+        self._frontier = r
         deadline_at = self.clock.opened_at(r) + self.deadline_s
         barrier_at = time.monotonic() + self.barrier_timeout_s
         barrier = r == self._start_round
         while True:
             self.router.poll(0.005)
-            arrived = self.clock.arrival_count(r)
-            if arrived >= self.nprocs:
-                break
-            now = time.monotonic()
+            active = [p for p in range(self.nprocs)
+                      if p not in self._downed]
+            arrived = sum(1 for p in active
+                          if self.clock.has_arrived(r, p))
             if barrier:
-                if now >= barrier_at:
+                if arrived >= self.nprocs:
+                    break
+                if time.monotonic() >= barrier_at:
                     raise TimeoutError(
                         f"quorum barrier: only {arrived}/"
                         f"{self.nprocs} processes joined within "
                         f"{self.barrier_timeout_s}s")
-            elif now >= deadline_at:
+                continue
+            required = max(1, math.ceil(self.th * len(active) - 1e-9))
+            if arrived >= required:
                 break
+            if time.monotonic() >= deadline_at:
+                break
+        B = self._n_chunks
         if barrier:
-            mask = [True] * self.nprocs
+            rows = [[True] * B for _ in range(self.nprocs)]
         else:
-            mask = self.clock.valid_peers(r)
-            # the master pins itself in: it is the pacer, so its own
-            # contribution is the round's reference point — if even the
-            # master blew the deadline (a too-tight --deadline-ms or a
-            # slow step), the round simply ran long; masking the pacer
-            # would make the mask empty and zero the round
-            mask[0] = True
-        self._kv.key_value_set(self._maskkey(r),
-                               "".join("1" if v else "0" for v in mask),
-                               allow_overwrite=False)
+            ontime = self.clock.valid_peers(r)
+            rows = []
+            for p in range(self.nprocs):
+                if p == 0:
+                    # the master pins itself in: it is the pacer, so its
+                    # own contribution is the round's reference point —
+                    # if even the master blew the deadline the round
+                    # simply ran long; masking the pacer would make the
+                    # mask empty and zero the round
+                    rows.append([True] * B)
+                elif ontime[p]:
+                    # a worker reports only AFTER its last bucket publish,
+                    # so an on-time report implies every bucket landed
+                    rows.append([True] * B)
+                elif p in self._downed:
+                    rows.append([False] * B)
+                else:
+                    rows.append(self._probe_buckets(r, p))
+            # auto-down bookkeeping: a peer that contributed NOTHING for
+            # down_after consecutive rounds stops being waited on
+            # (reference: auto-down-unreachable-after,
+            # application.conf:20); any partial contribution proves life
+            for p in range(1, self.nprocs):
+                if p in self._downed:
+                    continue
+                if any(rows[p]):
+                    self._consec_missed.pop(p, None)
+                    continue
+                c = self._consec_missed.get(p, 0) + 1
+                self._consec_missed[p] = c
+                if self.down_after and c >= self.down_after:
+                    self._downed.add(p)
+                    self._trace("peer_downed", round=r, peer=p,
+                                consecutive_missed=c)
+        try:
+            self._kv.key_value_set(
+                self._maskkey(r),
+                "".join("1" if v else "0" for row in rows for v in row),
+                allow_overwrite=False)
+        except Exception as exc:
+            if "ALREADY_EXISTS" in str(exc) or "overwrite" in str(exc):
+                raise RuntimeError(
+                    f"mask for round {r} already exists in the KV store "
+                    f"— a stale namespace from a previous run on the "
+                    f"same coordination-service incarnation; change "
+                    f"--namespace or restart the coordination service"
+                ) from exc
+            raise
         self._trace("mask_published", round=r,
-                    n_masked=sum(1 for v in mask if not v))
+                    n_masked=sum(1 for row in rows if not any(row)))
         self.clock.expire(r - 1)
-        return mask
+        return rows
 
-    def _read_mask(self, r: int) -> list[bool]:
+    def _read_mask(self, r: int) -> list[list[bool]]:
         """Wait for the master's mask with diagnosable failure modes: a
+        dead master trips the heartbeat watch within ``hb_timeout_s``; a
         mask already deleted because we stalled past retention raises the
         checkpoint-resume guidance (a process can stall INSIDE run_round,
-        where catch_up's identical check never runs), and a master that
-        stopped publishing altogether times out with its own message."""
+        where catch_up's identical check never runs); and a master that
+        stopped publishing without dying times out with its own
+        message."""
         deadline = time.monotonic() + self.deadline_s * 2 \
             + self.barrier_timeout_s
+        hb_check = self._hb_watch()
         while True:
             s = self._try_get(self._maskkey(r))
             if s is not None:
-                return [c == "1" for c in s]
+                return self._parse_mask(s)
             cur_s = self._try_get(self._roundkey)
             if cur_s is not None and int(cur_s) - r >= self.retain:
                 # same condition catch_up detects — but a process can
@@ -330,6 +565,7 @@ class DcnDeadlineTrainer:
                     f"stalled at round {r} while the cluster reached "
                     f"{cur_s}, beyond the {self.retain}-round retention "
                     f"window", current_round=int(cur_s))
+            hb_check()
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"no mask for round {r}: the master stopped "
@@ -337,12 +573,29 @@ class DcnDeadlineTrainer:
                     f"reference's master actor)")
             time.sleep(0.01)
 
+    def _parse_mask(self, s: str) -> list[list[bool]]:
+        """Mask wire format -> per-peer bucket rows (nprocs rows of
+        equal length)."""
+        B = len(s) // self.nprocs
+        assert B * self.nprocs == len(s), \
+            f"mask length {len(s)} not divisible by {self.nprocs} peers"
+        return [[c == "1" for c in s[p * B:(p + 1) * B]]
+                for p in range(self.nprocs)]
+
     # -- the masked cross-process reduction ---------------------------------
 
-    def _ensure_apply(self, grads) -> None:
+    def _ensure_apply(self, tree) -> None:
+        """Build the jitted optimizer apply + the wire-chunk geometry.
+        ``tree`` may be the grads OR the params pytree — they share one
+        structure, so a freshly-restored process can prime the apply path
+        from params before its first grad step (catch_up replays)."""
         if self._apply is not None:
             return
-        self._spec = tree_bucket_spec(grads, self.cfg.bucket_elems)
+        self._spec = tree_bucket_spec(tree, self.cfg.bucket_elems)
+        total = self._spec.total_size
+        self._chunk_elems = (self.dcn_bucket_elems
+                             if self.dcn_bucket_elems else total)
+        self._n_chunks = max(1, num_chunks(total, self._chunk_elems))
         spec = self._spec
         opt = self.opt
 
@@ -355,64 +608,166 @@ class DcnDeadlineTrainer:
 
         self._apply = apply
 
-    def _get_payload(self, r: int, p: int, wait_s: float = 30.0) -> bytes:
-        """Fetch a contributor's payload, polling with a clear failure
-        mode: a missing key after the wait window names the round and
-        rank instead of surfacing an opaque KV timeout. Replay passes a
-        SHORT window — a replayed round's payloads either exist already
-        or were garbage-collected; nothing new will arrive."""
+    def _chunk_bounds(self, b: int) -> tuple[int, int]:
+        lo = b * self._chunk_elems
+        return lo, min(self._spec.total_size, lo + self._chunk_elems)
+
+    def _fetch_peer_buckets(self, r: int, p: int) -> dict[int, bytes]:
+        """All landed wire chunks of peer ``p`` for round ``r`` in ONE
+        dir RPC — the hot-path fetch (a per-bucket get would serialize
+        n_chunks round-trips per peer per round)."""
+        try:
+            entries = self._kv.key_value_dir_get_bytes(self._gdir(r, p))
+        except Exception as exc:
+            if _is_not_found(exc):
+                return {}
+            raise
+        out = {}
+        for key, data in entries:
+            try:
+                out[int(key.rsplit("/", 1)[-1])] = data
+            except ValueError:
+                pass
+        return out
+
+    def _get_payload(self, r: int, p: int, b: int,
+                     wait_s: float = 30.0) -> bytes:
+        """Fetch one contributor wire chunk, polling with a clear failure
+        mode: a missing key after the wait window names the round, rank
+        and bucket instead of surfacing an opaque KV timeout. Replay
+        passes a SHORT window — a replayed round's payloads either exist
+        already or were garbage-collected; nothing new will arrive."""
         deadline = time.monotonic() + wait_s
         while True:
             try:
-                return self._kv.key_value_try_get_bytes(self._gkey(r, p))
-            except Exception:
-                pass
+                return self._kv.key_value_try_get_bytes(self._gkey(r, p, b))
+            except Exception as exc:
+                if not _is_not_found(exc):
+                    raise
             if time.monotonic() >= deadline:
                 raise RuntimeError(
-                    f"round {r}: contributor {p}'s gradient payload is "
+                    f"round {r}: contributor {p}'s gradient bucket {b} is "
                     f"missing from the KV store (masked-in but deleted? "
                     f"stalled beyond the {self.retain}-round retention "
                     f"window?) — resume from the last checkpoint")
             time.sleep(0.02)
 
-    def _apply_round(self, params, opt_state, r: int, mask: list[bool],
-                     own: Optional[bytes], replay: bool = False):
-        """Mean the contributors' local-mean gradients (fixed rank order,
-        so every process computes the bit-identical reduction) and run
-        the jitted optimizer apply. Each payload is the gradient of that
-        process's LOCAL-batch mean loss (grad_local divides by the local
-        token count), so the mean over contributors estimates the global
-        batch-mean gradient — unbiased under masking, and identical to
-        the global-mesh gradient when everyone contributes (equal local
-        batch sizes)."""
-        total = None
+    def _apply_round(self, params, opt_state, r: int,
+                     rows: list[list[bool]],
+                     own: Optional[list[bytes]], replay: bool = False):
+        """Mean the contributors' local-mean gradients PER WIRE BUCKET
+        (fixed rank order, so every process computes the bit-identical
+        reduction) and run the jitted optimizer apply. Each bucket's mean
+        divides by that bucket's own contributor count — a peer whose
+        publish was cut mid-round still feeds the buckets that landed,
+        with honest per-bucket counts (reference's per-chunk thresholds,
+        ReducedDataBuffer.scala:40-48). Each payload is the gradient of
+        that process's LOCAL-batch mean loss, so the per-bucket mean over
+        contributors estimates the global batch-mean gradient — unbiased
+        under masking, and identical to the global-mesh gradient when
+        everyone contributes (equal local batch sizes)."""
+        B = self._n_chunks
+        if rows and len(rows[0]) != B:
+            raise RuntimeError(
+                f"mask geometry mismatch: the master published "
+                f"{len(rows[0])}-bucket rows but this process chunks the "
+                f"wire into {B} buckets — --dcn-bucket-elems must be "
+                f"identical on every process")
+        totals: list[Optional[np.ndarray]] = [None] * B
+        counts = [0] * B
         losses = []
-        count = 0
         for p in range(self.nprocs):
-            if not mask[p]:
+            row = rows[p]
+            if not any(row):
                 continue
-            if p == self.rank and own is not None:
-                data = own
-            else:
-                data = self._get_payload(r, p,
-                                         wait_s=2.0 if replay else 30.0)
-            loss_p, _toks, vec = decode_payload(data)
-            total = vec.copy() if total is None else total + vec
-            losses.append(loss_p)
-            count += 1
-        assert count > 0, \
-            "mask can never be empty (the master pins itself in)"
-        total /= count
+            use_own = p == self.rank and own is not None
+            # one dir RPC fetches every landed bucket of a remote peer;
+            # the per-bucket poll below is only the fallback for a
+            # masked-in bucket the scan missed (publish/GC races)
+            fetched = {} if use_own else self._fetch_peer_buckets(r, p)
+            got_loss = False
+            for b in range(B):
+                if not row[b]:
+                    continue
+                if use_own:
+                    data = own[b]
+                else:
+                    data = fetched.get(b)
+                    if data is None:
+                        data = self._get_payload(
+                            r, p, b, wait_s=2.0 if replay else 30.0)
+                loss_p, _toks, vecb = decode_payload(data)
+                if totals[b] is None:
+                    totals[b] = vecb.copy()
+                else:
+                    totals[b] += vecb
+                counts[b] += 1
+                if not got_loss:
+                    losses.append(loss_p)
+                    got_loss = True
+        assert min(counts) > 0, \
+            "no bucket can be contributor-less (the master pins itself in)"
+        out = np.empty(self._spec.total_size, np.float32)
+        for b in range(B):
+            lo, hi = self._chunk_bounds(b)
+            out[lo:hi] = totals[b] / counts[b]
         params, opt_state = self._apply(params, opt_state,
-                                        jnp.asarray(total))
+                                        jnp.asarray(out))
+        full = [p for p in range(self.nprocs) if all(rows[p])]
+        contributed = [p for p in range(self.nprocs) if any(rows[p])]
         rep = DcnRoundReport(
-            round=r, valid_peers=tuple(mask),
-            n_masked=self.nprocs - count,
-            loss=float(np.mean(losses)))
+            round=r, valid_peers=tuple(any(row) for row in rows),
+            n_masked=self.nprocs - len(contributed),
+            loss=float(np.mean(losses)),
+            bucket_counts=tuple(counts),
+            n_partial=len(contributed) - len(full),
+            downed=tuple(sorted(self._downed)) if self.master else ())
         self.reports.append(rep)
         self._trace("round_complete", round=r, n_masked=rep.n_masked,
-                    count=count, replay=replay)
+                    n_partial=rep.n_partial, count=len(contributed),
+                    replay=replay)
+        self._publish_checksum(params, r)
         return params, opt_state, rep
+
+    # -- replica-divergence detection ---------------------------------------
+
+    def _publish_checksum(self, params, r: int) -> None:
+        """Every ``check_every`` applied rounds, publish a CRC of the
+        (replicated) params; the master cross-checks the PREVIOUS
+        checkpoint of checksums — by then even a round-lagged peer's CRC
+        has landed. Replays republish identical values (the replayed
+        updates are bit-identical), so the check composes with catch-up."""
+        if not self.check_every or (r + 1) % self.check_every:
+            return
+        vec = np.asarray(self._flat(params), np.float32)
+        crc = zlib.crc32(vec.tobytes())
+        self._kv.key_value_set(self._chkkey(r, self.rank), str(crc),
+                               allow_overwrite=True)
+        if self.master:
+            prev = r - self.check_every
+            if prev >= self._start_round:
+                self._verify_replicas(prev)
+
+    def _verify_replicas(self, r: int) -> None:
+        """Compare every published params CRC for round ``r``; absent
+        peers (stalled, downed) are simply not compared. A mismatch means
+        the independently-jitted optimizer applies are no longer
+        bit-identical across processes (heterogeneous hosts/compilers) —
+        silent compound drift, so fail loudly."""
+        try:
+            entries = self._kv.key_value_dir_get(f"{self.ns}/chk/{r:012d}/")
+        except Exception as exc:
+            if _is_not_found(exc):
+                return
+            raise
+        crcs = {int(k.rsplit("/", 1)[-1]): v for k, v in entries}
+        if len(set(crcs.values())) > 1:
+            raise RuntimeError(
+                f"replica divergence at round {r}: params checksums "
+                f"differ across processes ({crcs}) — the replicated "
+                f"optimizer applies are no longer bit-identical "
+                f"(heterogeneous hosts or compiler versions?); halt and "
+                f"restart every process from the last checkpoint")
 
     @property
     def round(self) -> int:
@@ -423,6 +778,11 @@ class DcnDeadlineTrainer:
         laggard waits for a mask the master will never publish."""
         return self._round
 
+    @property
+    def downed_peers(self) -> tuple[int, ...]:
+        """Master: the currently auto-downed ranks (empty on workers)."""
+        return tuple(sorted(self._downed))
+
     def set_start_round(self, r: int) -> None:
         """Start counting rounds at ``r`` (checkpoint resume). Must be
         called before the first :meth:`run_round`; the quorum barrier
@@ -430,6 +790,7 @@ class DcnDeadlineTrainer:
         if self._round != self._start_round:
             raise RuntimeError("set_start_round after rounds already ran")
         self._round = self._start_round = self._cleaned_to = int(r)
+        self._frontier = int(r)
 
     # -- snapshot-rejoin protocol (beyond-retention elastic recovery) -------
     #
@@ -458,20 +819,30 @@ class DcnDeadlineTrainer:
     def wait_snapshot(self, prev: Optional[int],
                       timeout_s: float = 120.0) -> int:
         """Block until the master publishes a snapshot step newer than
-        ``prev``; returns that step. Fails fast (not a full timeout)
-        when the master already finished the run — there is nobody left
-        to serve the request."""
+        ``prev``; returns that step. Fails fast when the master died
+        (heartbeat silent) or already finished the run — though a run
+        that ended AFTER serving a final snapshot still hands that
+        snapshot out (the CLI publishes its final checkpoint for exactly
+        this late-rejoiner race)."""
         deadline = time.monotonic() + timeout_s
+        hb_check = self._hb_watch()
         while True:
             s = self._try_get(self._snapkey)
             if s is not None and (prev is None or int(s) != prev):
                 return int(s)
             if self._try_get(self._donekey) is not None:
+                # the master may have served a final snapshot right
+                # before writing the done marker: re-check once before
+                # declaring the cluster gone
+                s = self._try_get(self._snapkey)
+                if s is not None and (prev is None or int(s) != prev):
+                    return int(s)
                 raise RuntimeError(
                     "the master finished the run while this process was "
                     "stalled — nobody can serve a rejoin snapshot; "
                     "restart from the last checkpoint "
                     "(runtime/checkpoint.py)")
+            hb_check()
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     "master never published a rejoin snapshot — it "
@@ -483,8 +854,10 @@ class DcnDeadlineTrainer:
         """Master: ranks currently asking for a rejoin snapshot."""
         try:
             entries = self._kv.key_value_dir_get(f"{self.ns}/snapreq/")
-        except Exception:
-            return []
+        except Exception as exc:
+            if _is_not_found(exc):
+                return []
+            raise
         return [int(k.rsplit("/", 1)[-1]) for k, _ in entries]
 
     def publish_snapshot_step(self, step: int) -> None:
@@ -520,7 +893,11 @@ class DcnDeadlineTrainer:
         """Replay rounds the cluster completed while this process was
         stalled. Masks/payloads are retained ``retain_rounds`` deep; our
         own stale contributions were masked out of those rounds, so the
-        replayed updates equal the survivors' updates exactly."""
+        replayed updates equal the survivors' updates exactly. Replay
+        skips the gradient computation entirely (fetch + apply), so a
+        woken straggler closes on the frontier FASTER than the cluster
+        advances — which is what re-ups an auto-downed peer: its first
+        at-frontier arrival report."""
         if self.master:
             return params, opt_state, 0
         cur_s = self._try_get(self._roundkey)
@@ -545,15 +922,19 @@ class DcnDeadlineTrainer:
                 f"{self.retain}-round retention window — rejoin needs a "
                 f"checkpoint (snapshot protocol via the CLI, or restart "
                 f"from the last checkpoint)", current_round=cur)
+        # a freshly-restored process replays before its first grad step:
+        # prime the apply path + wire geometry from the params pytree
+        # (same tree structure as the grads)
+        self._ensure_apply(params)
         replayed = 0
         while self._round < cur:
             r = self._round
             mask_s = self._try_get(self._maskkey(r))
             if mask_s is None:
                 break  # master is mid-round r: rejoin the normal flow
-            mask = [c == "1" for c in mask_s]
             params, opt_state, _ = self._apply_round(
-                params, opt_state, r, mask, own=None, replay=True)
+                params, opt_state, r, self._parse_mask(mask_s),
+                own=None, replay=True)
             self._round += 1
             replayed += 1
         if replayed:
@@ -566,9 +947,10 @@ class DcnDeadlineTrainer:
     # -- the public round ----------------------------------------------------
 
     def run_round(self, params, opt_state, tokens):
-        """One cross-process training round: local grad step -> publish ->
-        arrival report -> mask -> masked mean -> optimizer apply. Returns
-        ``(params, opt_state, DcnRoundReport)``.
+        """One cross-process training round: local grad step -> publish
+        wire chunks -> arrival report -> mask -> per-bucket masked mean
+        -> optimizer apply. Returns ``(params, opt_state,
+        DcnRoundReport)``.
 
         Runs exactly round ``self.round`` — build ``tokens`` for that
         step index, and call :meth:`catch_up` first after a possible
@@ -576,8 +958,9 @@ class DcnDeadlineTrainer:
         so the batch a caller built always feeds the round it was built
         for. A process that is merely behind (no catch_up) still
         behaves correctly — its publish lands late, the retained mask
-        excludes it, and it applies the recorded update — catch_up just
-        skips the pointless gradient computation for those rounds.
+        excludes it (or credits the buckets that landed), and it applies
+        the recorded update — catch_up just skips the pointless gradient
+        computation for those rounds.
 
         With ``max_lag > 0`` up to max_lag+1 rounds are in flight: this
         call publishes round r and applies round r - max_lag, so the
@@ -593,18 +976,25 @@ class DcnDeadlineTrainer:
         self._ensure_apply(grads)
         vec = np.asarray(self._flat(grads), np.float32)
         loss = float(metrics["loss"])
-        # per-(round, rank) rounding seed keeps the int8 wire's
-        # stochastic rounding unbiased ACROSS rounds (a fixed seed would
-        # make the error systematic — same argument as the device wire,
-        # parallel/dp.py)
-        payload = encode_payload(vec, loss, float(metrics["tokens"]),
-                                 self.wire,
-                                 seed=r * self.nprocs + self.rank)
-        self._kv.key_value_set_bytes(self._gkey(r, self.rank), payload)
+        toks = float(metrics["tokens"])
+        # publish bucket-by-bucket IN ORDER, report after the last one:
+        # a publish cut anywhere leaves a clean prefix of buckets the
+        # master's probe can still credit. Per-(round, rank, bucket)
+        # rounding seeds keep the int8 wire's stochastic rounding
+        # unbiased ACROSS rounds (a fixed seed would make the error
+        # systematic — same argument as the device wire, parallel/dp.py)
+        own: list[bytes] = []
+        for b in range(self._n_chunks):
+            lo, hi = self._chunk_bounds(b)
+            data = encode_payload(
+                vec[lo:hi], loss, toks, self.wire,
+                seed=(r * self.nprocs + self.rank) * self._n_chunks + b)
+            self._kv.key_value_set_bytes(self._gkey(r, self.rank, b), data)
+            own.append(data)
         if not self.master:
             self.router.send(self.router.ref_of(0),
                              CompleteAllreduce(src_id=self.rank, round=r))
-        self._pending.append((r, payload))
+        self._pending.append((r, own))
         self._round += 1
         rep = None
         if len(self._pending) >= self._window:
@@ -623,13 +1013,13 @@ class DcnDeadlineTrainer:
         drain with this (one harvest = one applied round = one save);
         :meth:`drain` is the convenience form for callers that only need
         the final state."""
-        r0, payload0 = self._pending.pop(0)
+        r0, own0 = self._pending.pop(0)
         if self.master:
-            mask = self._master_collect(r0)
+            rows = self._master_collect(r0)
         else:
-            mask = self._read_mask(r0)
+            rows = self._read_mask(r0)
         params, opt_state, rep = self._apply_round(
-            params, opt_state, r0, mask, own=payload0)
+            params, opt_state, r0, rows, own=own0)
         self._cleanup(r0)
         return params, opt_state, rep
 
@@ -644,22 +1034,31 @@ class DcnDeadlineTrainer:
         return params, opt_state, reps
 
     def _cleanup(self, r: int) -> None:
-        """Delete every own payload (and, on the master, mask) that has
-        fallen out of retention — as a RANGE from the last sweep, not a
-        single round: catch_up can jump ``_round`` forward, and a
-        one-round-per-call sweep would orphan the payloads published just
-        before a stall (full f32 gradient vectors) in the KV store for
-        the rest of the job."""
+        """Delete every own payload bucket, checksum (and, on the master,
+        mask) that has fallen out of retention — as a RANGE from the last
+        sweep, not a single round: catch_up can jump ``_round`` forward,
+        and a one-round-per-call sweep would orphan the payloads
+        published just before a stall (full f32 gradient vectors) in the
+        KV store for the rest of the job."""
         old = r - self.retain
         if old < self._cleaned_to:
             return
         for rr in range(self._cleaned_to, old + 1):
-            try:
-                self._kv.key_value_delete(self._gkey(rr, self.rank))
-                if self.master:
+            for b in range(self._n_chunks):
+                try:
+                    self._kv.key_value_delete(self._gkey(rr, self.rank, b))
+                except Exception:
+                    pass  # best-effort GC; missing keys are fine
+            if self.check_every and not (rr + 1) % self.check_every:
+                try:
+                    self._kv.key_value_delete(self._chkkey(rr, self.rank))
+                except Exception:
+                    pass
+            if self.master:
+                try:
                     self._kv.key_value_delete(self._maskkey(rr))
-            except Exception:
-                pass  # best-effort GC; missing keys are fine
+                except Exception:
+                    pass
         self._cleaned_to = old + 1
 
     @property
@@ -667,6 +1066,8 @@ class DcnDeadlineTrainer:
         return sum(1 for rep in self.reports if rep.n_masked)
 
     def close(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
         if self.master:
             # end-of-run marker: a straggler waking after this fails
             # fast with checkpoint guidance instead of waiting out the

@@ -99,6 +99,12 @@ class RoundClock:
         """How many peers have reported for the round (on time or not)."""
         return len(self._arrivals.get(round_, ()))
 
+    def has_arrived(self, round_: int, peer: int) -> bool:
+        """Whether ``peer`` has reported for the round (on time or not) —
+        the master's wait-set membership test under auto-down (it counts
+        arrivals over the ACTIVE peers only, runtime/dcn_train.py)."""
+        return peer in self._arrivals.get(round_, ())
+
     def report_arrival(self, round_: int, peer: int,
                        at: Optional[float] = None) -> None:
         self._arrivals.setdefault(round_, {})[peer] = \

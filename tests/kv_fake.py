@@ -1,0 +1,78 @@
+"""In-memory fake of the JAX coordination-service KV client.
+
+Implements exactly the surface KvRouter / DcnDeadlineTrainer use
+(protocol/kv.py, runtime/dcn_train.py), with the real client's error
+conventions: a missing key raises with ``NOT_FOUND`` in the message, a
+non-overwritable set on an existing key raises with ``ALREADY_EXISTS``.
+Thread-safe — protocol tests drive one fake from N trainer threads, the
+in-process rendering of the reference's forged-peer TestKit harness
+(reference: AllreduceSpec.scala; SURVEY.md §4).
+
+``on_set`` is the fault-injection hook: called (key) BEFORE each write
+lands, outside the lock, so a test can stall a publish mid-round (the
+per-bucket contribution tests cut a worker between two bucket writes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class FakeKvClient:
+    def __init__(self,
+                 on_set: Optional[Callable[[str], None]] = None):
+        self._store: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self.on_set = on_set
+
+    # -- writes --------------------------------------------------------------
+
+    def _set(self, key: str, value, allow_overwrite: bool) -> None:
+        if self.on_set is not None:
+            self.on_set(key)
+        with self._lock:
+            if not allow_overwrite and key in self._store:
+                raise RuntimeError(f"ALREADY_EXISTS: key {key} is "
+                                   f"already set")
+            self._store[key] = value
+
+    def key_value_set(self, key: str, value: str,
+                      allow_overwrite: bool = False) -> None:
+        self._set(key, str(value), allow_overwrite)
+
+    def key_value_set_bytes(self, key: str, value: bytes,
+                            allow_overwrite: bool = True) -> None:
+        self._set(key, bytes(value), allow_overwrite)
+
+    # -- reads ---------------------------------------------------------------
+
+    def key_value_try_get(self, key: str) -> str:
+        with self._lock:
+            if key not in self._store:
+                raise RuntimeError(f"NOT_FOUND: key {key}")
+            return self._store[key]
+
+    def key_value_try_get_bytes(self, key: str) -> bytes:
+        return self.key_value_try_get(key)
+
+    def _dir(self, prefix: str) -> list[tuple[str, object]]:
+        with self._lock:
+            out = [(k, v) for k, v in self._store.items()
+                   if k.startswith(prefix)]
+        if not out:
+            raise RuntimeError(f"NOT_FOUND: no keys under {prefix}")
+        return sorted(out)
+
+    def key_value_dir_get(self, prefix: str) -> list[tuple[str, str]]:
+        return self._dir(prefix)
+
+    def key_value_dir_get_bytes(self,
+                                prefix: str) -> list[tuple[str, bytes]]:
+        return self._dir(prefix)
+
+    # -- delete --------------------------------------------------------------
+
+    def key_value_delete(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)

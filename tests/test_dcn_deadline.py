@@ -259,6 +259,55 @@ class TestDcnDeadlineChain:
         last_masked = [ln for ln in lines if "[masked" in ln][-1]
         assert "[masked 0/2" in last_masked, out0
 
+    def test_killed_master_fails_workers_in_seconds(self):
+        """SIGKILL the master mid-run: workers must fail within seconds
+        — not spin out the multi-minute 2*deadline+barrier timeout. The
+        reference's master death halts the run through the 10 s failure
+        detector (application.conf:20); parity is failing FAST.
+
+        Two detectors cover this, whichever fires first: killing the
+        master here also kills the coordination service it hosts, so
+        JAX's own service failure detector terminates workers instantly;
+        when the service survives the master trainer (external service,
+        or a wedged master process), the trainer-level heartbeat watch
+        fires within --master-timeout-s instead (pinned in-process by
+        tests/test_dcn_protocol.py::TestMasterLiveness)."""
+        port = free_port()
+        procs = [_spawn(port, i, extra=("--master-timeout-s", "3"))
+                 for i in range(3)]
+        lines: list[str] = []
+        state = {"killed_at": 0.0}
+
+        def pump():
+            for line in procs[0].stdout:
+                lines.append(line.rstrip())
+                if "step    3" in line and not state["killed_at"]:
+                    state["killed_at"] = time.time()
+                    procs[0].kill()
+
+        t = threading.Thread(target=pump)
+        t.start()
+        outs = ["", ""]
+        try:
+            for i in (1, 2):
+                out, _ = procs[i].communicate(timeout=240)
+                outs[i - 1] = out
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        t.join(timeout=15)
+        died_at = time.time()
+        assert state["killed_at"], "\n".join(lines)
+        # workers exited non-zero, quickly, and said why
+        assert procs[1].returncode not in (0, None)
+        assert procs[2].returncode not in (0, None)
+        assert died_at - state["killed_at"] < 60, (
+            died_at - state["killed_at"])
+        assert any("heartbeat" in o  # trainer-level watch
+                   or "coordination service" in o  # JAX failure detector
+                   for o in outs), outs
+
     def test_straggle_prob_simulation_runs(self):
         """2 processes with --straggle-prob AND --int8-grads: simulated
         late publishes via the real wall clock produce masked rounds
