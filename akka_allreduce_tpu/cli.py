@@ -259,6 +259,14 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--clip-norm", type=float, default=0.0,
                    help="global-norm gradient clipping (0 = off)")
     p.add_argument("--bucket-elems", type=int, default=1 << 16)
+    p.add_argument("--pp-schedule", choices=("gpipe", "1f1b"),
+                   default="gpipe",
+                   help="pipeline schedule when --pp > 1: gpipe "
+                        "(forward scan + autodiff backward, "
+                        "O(microbatches) activation residency) or 1f1b "
+                        "(fused one-forward-one-backward, O(pp) "
+                        "residency — buys more microbatches/context on "
+                        "fixed HBM; dense layers only)")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute with f32 master weights")
     p.add_argument("--int8-grads", action="store_true",
@@ -638,12 +646,22 @@ def _cmd_train(args: argparse.Namespace) -> int:
     mcfg = _build_model_config(args, t)
     cfg = TrainConfig(model=mcfg, learning_rate=args.lr,
                       bucket_elems=args.bucket_elems, microbatches=micro,
+                      pp_schedule=args.pp_schedule,
                       compute_dtype="bf16" if args.bf16 else "f32",
                       grad_transport="int8" if args.int8_grads else "f32",
                       remat=args.remat,
                       lr_schedule=args.lr_schedule,
                       warmup_steps=args.warmup_steps,
                       total_steps=args.steps, clip_norm=args.clip_norm)
+    if args.pp > 1 and chatty:
+        from akka_allreduce_tpu.parallel.pp import pp_schedule_stats
+        st = pp_schedule_stats(args.pp, micro)
+        print(f"pp={args.pp} x {micro} microbatches, schedule "
+              f"{args.pp_schedule}: bubble gpipe "
+              f"{st['gpipe']['bubble_fraction']:.1%} (resident "
+              f"{st['gpipe']['resident_microbatches']} microbatches) | "
+              f"1f1b {st['1f1b']['bubble_fraction']:.1%} (resident "
+              f"{st['1f1b']['resident_microbatches']})")
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
     dynamic = args.deadline_ms > 0 and not hybrid
     trainer = None

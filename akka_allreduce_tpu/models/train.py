@@ -50,6 +50,7 @@ from akka_allreduce_tpu.parallel.mesh import place_tree
 from akka_allreduce_tpu.parallel.pp import (
     gpipe_apply,
     last_stage_only,
+    one_f_one_b,
     scan_blocks,
     stack_layer_params,
 )
@@ -81,6 +82,11 @@ class TrainConfig:
     # pipeline parallelism: microbatches per step (only read when the mesh
     # has pp > 1; the local batch must divide by it)
     microbatches: int = 1
+    # pipeline schedule: "gpipe" (forward scan, autodiff backward —
+    # O(microbatches) activation residency) or "1f1b" (fused
+    # one-forward-one-backward scan, O(pp) residency; dense layers only
+    # — see parallel/pp.py pp_schedule_stats for the economics)
+    pp_schedule: str = "gpipe"
     # gradient-sync wire format: "f32" or "int8" (quantized two-phase
     # allreduce — needs exactly one data axis of size > 1)
     grad_transport: str = "f32"
@@ -617,12 +623,84 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                                 derive_quant_key(quant_seed),
                                 valid=valid)
 
+    def grad_local_1f1b(params, tokens, quant_seed, valid=None):
+        """The pp path under the fused 1F1B schedule (parallel/pp.py
+        one_f_one_b): same loss and gradients as grad_local_pp, but the
+        backward interleaves with the forward tick-by-tick, bounding
+        activation residency at O(pp) instead of O(microbatches).
+        Dense layers only — the fused backward carries no aux channel,
+        so the MoE aux-loss path stays on gpipe."""
+        targets, weights, positions = targets_and_weights(tokens)
+        total_count = psum_all(weights.sum(), dense_axes)
+        m = cfg.microbatches
+        b_local, t_local = tokens.shape
+        if b_local % m:
+            raise ValueError(
+                f"local batch {b_local} must divide into "
+                f"microbatches={m}")
+        bm = b_local // m
+        tok_m = tokens.reshape(m, bm, t_local)
+        tgt_m = targets.reshape(m, bm, t_local)
+        w_m = weights.reshape(m, bm, t_local)
+
+        def block(lyr, h):
+            return transformer_block(lyr, h, mcfg, attn, tp_axis, ep_axis,
+                                     positions=positions)
+
+        if cfg.remat:
+            block = jax.checkpoint(block)
+
+        def stage(stacked, h):
+            # grads flow to the f32 masters THROUGH the cast, exactly as
+            # the gpipe path's whole-loss cast arranges
+            h, _aux = scan_blocks(cast_compute(stacked), h, block)
+            return h
+
+        def embed_fn(p, tok):
+            pc = cast_compute(p)
+            x = pc["embed"][tok]
+            if not mcfg.rope:
+                x = x + pc["pos"][positions]
+            return x
+
+        def head_fn(p, h, mb):
+            pc = cast_compute(p)
+            logits = lm_logits(pc, rmsnorm(h, pc["out_norm"]), mcfg)
+            tgt = lax.dynamic_index_in_dim(tgt_m, mb, 0, keepdims=False)
+            w = lax.dynamic_index_in_dim(w_m, mb, 0, keepdims=False)
+            ce_sum, _ = weighted_ce(logits, tgt, w)
+            return ce_sum / total_count
+
+        loss_sum, d_layers, d_other = one_f_one_b(
+            params["layers"], params, tok_m, stage, embed_fn, head_fn,
+            "pp")
+        grads = dict(d_other)
+        # head/embed vjps see the full pytree, so d_other carries a
+        # zero "layers" leaf tree — fold the real stage grads in
+        grads["layers"] = jax.tree.map(jnp.add, d_other["layers"],
+                                       d_layers)
+        aux = {"aux_loss": jnp.zeros((), jnp.float32),
+               "dispatch_fraction": jnp.ones((), jnp.float32)}
+        return sync_and_metrics(loss_sum, aux, grads, total_count,
+                                derive_quant_key(quant_seed),
+                                valid=valid)
+
     # check_vma=False: varying-axis tracking would auto-insert psums over
     # the data axes in the backward pass (pvary transpose), taking gradient
     # sync out of the framework's hands — the explicit Megatron boundary
     # (parallel/tp.py) plus allreduce_gradients carry it instead.
     batch_axes = ("dp", "ep") if "ep" in mesh.shape else "dp"
-    local_fn = grad_local_pp if has_pp else grad_local
+    if cfg.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}")
+    if has_pp and cfg.pp_schedule == "1f1b":
+        if has_moe:
+            raise ValueError(
+                "pp_schedule='1f1b' supports dense layers only (the "
+                "fused backward has no aux-loss channel) — use gpipe "
+                "for MoE pipelines")
+        local_fn = grad_local_1f1b
+    else:
+        local_fn = grad_local_pp if has_pp else grad_local
     if dynamic_valid:
         # the (n_data_ranks, num_buckets) mask shards one row per data
         # rank; tp/pp ranks within a data rank see the same row

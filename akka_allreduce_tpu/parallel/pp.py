@@ -129,6 +129,174 @@ def gpipe_apply(stage_params: Any, x_micro: jnp.ndarray, stage_fn: StageFn,
     return outputs, aux
 
 
+def pp_schedule_stats(s: int, m: int) -> dict:
+    """Analytic schedule economics for ``s`` stages x ``m`` microbatches.
+
+    * ``bubble_fraction`` — idle fraction of each rank's compute slots.
+      GPipe runs a forward phase then (via autodiff) a backward phase,
+      each with an (s-1)-tick fill/drain: bubble (s-1)/(m+s-1). The
+      fused 1F1B scan runs m + 2(s-1) combined ticks (each tick = one
+      F-unit + one B-unit per rank) with m useful per unit: bubble
+      (2s-2)/(m+2s-2).
+    * ``resident_microbatches`` — stage-input activations a rank holds
+      at peak. GPipe's forward scan saves one residual per tick for the
+      backward phase: m + s - 1. 1F1B consumes each saved input at most
+      2(s-1) ticks after it is produced: min(m, 2s-1).
+
+    The tradeoff this surfaces: per step, 1F1B trades an extra
+    (s-1)/(m+s-1) of bubble for O(s) instead of O(m) activation
+    residency — which is what lets m (and with it the bubble itself)
+    grow on a fixed-HBM chip. Pick gpipe when activations fit; pick
+    1f1b to buy more microbatches or longer context."""
+    return {
+        "gpipe": {
+            "bubble_fraction": (s - 1) / (m + s - 1),
+            "resident_microbatches": m + s - 1,
+        },
+        "1f1b": {
+            "bubble_fraction": (2 * s - 2) / (m + 2 * s - 2),
+            "resident_microbatches": min(m, 2 * s - 1),
+        },
+    }
+
+
+def one_f_one_b(stage_params: Any, other_params: Any,
+                tokens_micro: jnp.ndarray,
+                stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                embed_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                head_fn: Callable[[Any, jnp.ndarray, jnp.ndarray],
+                                  jnp.ndarray],
+                axis_name: str = "pp"):
+    """One-forward-one-backward (PipeDream-flush) pipelined train step.
+
+    Unlike :func:`gpipe_apply` (forward-only; the backward pipeline is
+    derived by autodiff, which forces the forward scan to keep EVERY
+    microbatch's residuals alive until the backward phase), this is a
+    fused schedule: one ``lax.scan`` whose every tick runs one forward
+    stage-eval AND one backward stage-eval per rank, with activations
+    rotating forward and cotangents rotating backward over ICI each
+    tick. A microbatch's saved stage input is consumed at most 2(s-1)
+    ticks after it is produced, so peak activation residency is O(s)
+    instead of O(m) — see :func:`pp_schedule_stats` for the exact
+    bubble/memory economics. The backward unit recomputes its stage
+    forward under ``jax.vjp`` (the same trade ``remat`` makes), which
+    is what keeps the carried state to raw stage inputs.
+
+    Rank-local (call inside ``shard_map``); SPMD-uniform — every rank
+    executes both units every tick, with fill/drain garbage masked out
+    of the accumulators, mirroring :func:`gpipe_apply`'s masking story.
+
+    Args:
+      stage_params: this rank's layer stack (pp-sharded leading dim).
+      other_params: the full replicated params pytree; ``embed_fn`` and
+        ``head_fn`` differentiate against it (leaves they don't touch
+        get zero cotangents). Rank 0 owns the embed gradient, rank s-1
+        the head gradient — callers psum non-layer grads over pp, same
+        as the GPipe path.
+      tokens_micro: (m, ...) integer microbatch inputs, replicated on
+        every pp rank; only rank 0's embedding is consumed.
+      stage_fn: ``(stage_params, h) -> h`` — aux-free (schedule the
+        MoE aux-loss path with gpipe; the fused backward has no aux
+        channel).
+      embed_fn: ``(other_params, tokens_mb) -> h`` stage-0 injection.
+      head_fn: ``(other_params, h_mb, mb_index) -> scalar`` per-
+        microbatch loss contribution (already globally scaled); the
+        index lets the caller slice its targets/weights.
+
+    Returns ``(loss_sum, d_stage, d_other)``: loss_sum is the summed
+    per-microbatch loss (nonzero only on rank s-1 — fold with
+    :func:`last_stage_only` semantics in mind); gradients are
+    fill/drain-masked accumulations ready for the caller's sync.
+    """
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = tokens_micro.shape[0]
+    n_ticks = m + 2 * (s - 1)
+    perm_fwd = [(i, (i + 1) % s) for i in range(s)]
+    perm_bwd = [(i, (i - 1) % s) for i in range(s)]
+    # ring depth = the advertised O(s) residency (pp_schedule_stats):
+    # rank idx reads microbatch mb's slot at tick mb + 2(s-1) - idx and
+    # the colliding write of mb + w lands at tick mb + w + idx, so
+    # w = 2s-1 makes every reuse strictly later than the read (the
+    # last stage's same-tick write happens before its read in the tick
+    # body); for m < 2s-1 no slot is ever reused
+    ring_w = max(1, min(m, 2 * s - 1))
+
+    h_struct = jax.eval_shape(embed_fn, other_params, tokens_micro[0])
+    zero_h = jnp.zeros(h_struct.shape, h_struct.dtype)
+
+    def zeros_like_tree(tree):
+        return jax.tree.map(lambda x: jnp.zeros(x.shape, x.dtype), tree)
+
+    def masked_add(acc, g, cond):
+        return jax.tree.map(
+            lambda a, b: a + jnp.where(cond, b, jnp.zeros_like(b)),
+            acc, g)
+
+    carry0 = (
+        zero_h,                                   # fwd_recv
+        zero_h,                                   # bwd_recv
+        jnp.zeros((ring_w,) + h_struct.shape, h_struct.dtype),  # ring
+        zeros_like_tree(stage_params),            # d_stage
+        zeros_like_tree(other_params),            # d_other
+        jnp.zeros((), jnp.float32),               # loss_sum
+    )
+
+    def tick(carry, t):
+        fwd_recv, bwd_recv, ring, d_stage, d_other, loss_sum = carry
+        # ---- forward unit: microbatch t - idx ----
+        mf = t - idx
+        valid_f = (mf >= 0) & (mf < m)
+        mf_c = jnp.clip(mf, 0, m - 1)
+        tok_f = lax.dynamic_index_in_dim(tokens_micro, mf_c, 0,
+                                         keepdims=False)
+        x_in = jnp.where(idx == 0, embed_fn(other_params, tok_f),
+                         fwd_recv)
+        y = stage_fn(stage_params, x_in)
+        # save the stage INPUT for the backward unit's recompute-vjp;
+        # fill/drain ticks must not clobber a slot a pending backward
+        # still needs, hence the masked write
+        slot_f = mf_c % ring_w
+        old = lax.dynamic_index_in_dim(ring, slot_f, 0, keepdims=False)
+        ring = lax.dynamic_update_index_in_dim(
+            ring, jnp.where(valid_f, x_in, old), slot_f, 0)
+
+        # ---- backward unit: microbatch t - (2(s-1) - idx) ----
+        # (on rank s-1 that equals the forward unit's microbatch: the
+        # freshly-produced y feeds the head's vjp in the same tick)
+        mb = t - (2 * (s - 1) - idx)
+        valid_b = (mb >= 0) & (mb < m)
+        mb_c = jnp.clip(mb, 0, m - 1)
+        is_last = idx == s - 1
+        is_first = idx == 0
+        loss_mb, head_vjp = jax.vjp(
+            lambda p, h: head_fn(p, h, mb_c), other_params, y)
+        d_oth_head, ct_head = head_vjp(jnp.ones((), jnp.float32))
+        ct_out = jnp.where(is_last, ct_head.astype(y.dtype), bwd_recv)
+        x_saved = lax.dynamic_index_in_dim(ring, mb_c % ring_w, 0,
+                                           keepdims=False)
+        _, stage_vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        d_st, dx = stage_vjp(ct_out)
+        tok_b = lax.dynamic_index_in_dim(tokens_micro, mb_c, 0,
+                                         keepdims=False)
+        _, embed_vjp = jax.vjp(embed_fn, other_params, tok_b)
+        (d_oth_emb,) = (embed_vjp(dx)[0],)
+
+        d_stage = masked_add(d_stage, d_st, valid_b)
+        d_other = masked_add(d_other, d_oth_head, valid_b & is_last)
+        d_other = masked_add(d_other, d_oth_emb, valid_b & is_first)
+        loss_sum = loss_sum + jnp.where(valid_b & is_last, loss_mb, 0.0)
+
+        fwd_next = lax.ppermute(y, axis_name, perm_fwd)
+        bwd_next = lax.ppermute(dx, axis_name, perm_bwd)
+        return (fwd_next, bwd_next, ring, d_stage, d_other,
+                loss_sum), None
+
+    (_, _, _, d_stage, d_other, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(n_ticks))
+    return loss_sum, d_stage, d_other
+
+
 def last_stage_only(value: jnp.ndarray, axis_name: str = "pp"
                     ) -> jnp.ndarray:
     """Zero ``value`` on all but the final pipeline stage — for folding the

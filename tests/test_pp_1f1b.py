@@ -1,0 +1,142 @@
+"""1F1B pipeline schedule: parity, economics, and guard rails.
+
+The fused one-forward-one-backward schedule (parallel/pp.py
+``one_f_one_b``) must produce the SAME loss and synced gradients as the
+GPipe path and as the unsharded single-device reference — 1F1B changes
+WHEN stage backwards run (bounding activation residency at O(pp)), never
+what they compute. ``pp_schedule_stats`` pins the analytic
+bubble/residency tradeoff both schedules are chosen by.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.train import (
+    TrainConfig,
+    make_grad_step,
+    make_train_state,
+    make_train_step,
+)
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+    next_token_loss_and_aux,
+)
+from akka_allreduce_tpu.parallel.ep import MoEConfig
+from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+from akka_allreduce_tpu.parallel.pp import pp_schedule_stats, stack_layer_params
+
+from test_train_pp import (  # reuse the gold-parity harness
+    MCFG,
+    assert_tree_close,
+    make_tokens,
+    reference_grads,
+)
+
+
+def test_schedule_stats_economics():
+    """The analytic tradeoff: 1F1B pays (s-1)/(m+s-1) extra bubble to
+    cut activation residency from O(m) to O(s)."""
+    st = pp_schedule_stats(s=4, m=8)
+    assert st["gpipe"]["bubble_fraction"] == pytest.approx(3 / 11)
+    assert st["gpipe"]["resident_microbatches"] == 11
+    assert st["1f1b"]["bubble_fraction"] == pytest.approx(6 / 14)
+    assert st["1f1b"]["resident_microbatches"] == 7
+    # with many microbatches both bubbles shrink and 1f1b residency
+    # stays flat — the property that lets m grow on fixed HBM
+    st_big = pp_schedule_stats(s=4, m=64)
+    assert st_big["1f1b"]["bubble_fraction"] < 0.09
+    assert st_big["1f1b"]["resident_microbatches"] == 7
+    assert st_big["gpipe"]["resident_microbatches"] == 67
+
+
+def test_moe_rejected_under_1f1b():
+    mcfg = TransformerConfig(
+        vocab_size=61, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        max_seq=64, moe=MoEConfig(n_experts=4, d_ff=64), moe_every=1)
+    mesh = make_device_mesh(MeshSpec(dp=2, pp=2, ep=2))
+    cfg = TrainConfig(model=mcfg, bucket_elems=256, microbatches=2,
+                      pp_schedule="1f1b")
+    with pytest.raises(ValueError, match="dense layers only"):
+        make_grad_step(cfg, mesh)
+
+
+def test_unknown_schedule_rejected():
+    mesh = make_device_mesh(MeshSpec(dp=4, pp=2))
+    cfg = TrainConfig(model=MCFG, bucket_elems=256, microbatches=2,
+                      pp_schedule="zigzag")
+    with pytest.raises(ValueError, match="pp_schedule"):
+        make_grad_step(cfg, mesh)
+
+
+@pytest.mark.slow
+class Test1F1BGradParity:
+    @pytest.mark.parametrize("spec,micro", [
+        (MeshSpec(dp=4, pp=2), 2),
+        (MeshSpec(dp=2, pp=4), 2),
+        (MeshSpec(pp=2, tp=2, sp=2), 2),
+    ])
+    def test_1f1b_grads_match_unsharded(self, spec, micro):
+        mesh = make_device_mesh(spec)
+        cfg = TrainConfig(model=MCFG, bucket_elems=256,
+                          microbatches=micro, pp_schedule="1f1b")
+        tokens = make_tokens(b=8, t=32)
+
+        full = init_transformer(jax.random.key(0), MCFG, tp=spec.tp)
+        ref = reference_grads(full, tokens, MCFG)
+        ref_stacked = dict(ref, layers=stack_layer_params(ref["layers"]))
+
+        params, _, _ = make_train_state(jax.random.key(0), cfg, mesh)
+        grads, metrics = jax.jit(make_grad_step(cfg, mesh))(params, tokens)
+
+        assert_tree_close(grads, ref_stacked)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_1f1b_matches_gpipe_and_reference_loss(self):
+        mesh = make_device_mesh(MeshSpec(dp=2, pp=4))
+        tokens = make_tokens(b=8, t=32, seed=3)
+        full = init_transformer(jax.random.key(0), MCFG)
+        ls, w, _ = next_token_loss_and_aux(full, tokens, MCFG)
+        ref_loss = float(ls / w)
+        losses = {}
+        for sched in ("gpipe", "1f1b"):
+            cfg = TrainConfig(model=MCFG, bucket_elems=256,
+                              microbatches=2, pp_schedule=sched)
+            params, _, _ = make_train_state(jax.random.key(0), cfg, mesh)
+            _, metrics = jax.jit(make_grad_step(cfg, mesh))(params,
+                                                            tokens)
+            losses[sched] = float(metrics["loss"])
+        assert losses["gpipe"] == pytest.approx(ref_loss, rel=1e-5)
+        assert losses["1f1b"] == pytest.approx(ref_loss, rel=1e-5)
+
+    def test_1f1b_composes_with_remat_and_bf16(self):
+        """The O(pp)-residency schedule composed with per-block remat
+        and bf16 compute — the long-context memory stack end to end."""
+        mesh = make_device_mesh(MeshSpec(dp=2, pp=4))
+        cfg = TrainConfig(model=MCFG, bucket_elems=256, microbatches=4,
+                          pp_schedule="1f1b", remat=True,
+                          compute_dtype="bf16")
+        tokens = make_tokens(b=8, t=32, seed=7)
+        params, _, _ = make_train_state(jax.random.key(0), cfg, mesh)
+        grads, metrics = jax.jit(make_grad_step(cfg, mesh))(params,
+                                                            tokens)
+        assert np.isfinite(float(metrics["loss"]))
+        flat = jax.tree.leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+
+    def test_full_step_runs_and_learns(self):
+        mesh = make_device_mesh(MeshSpec(dp=4, pp=2))
+        cfg = TrainConfig(model=MCFG, bucket_elems=256, microbatches=2,
+                          pp_schedule="1f1b")
+        tokens = make_tokens(b=8, t=32, seed=5)
+        params, opt_state, opt = make_train_state(
+            jax.random.key(2), cfg, mesh)
+        step = make_train_step(cfg, mesh, opt)
+        losses = []
+        for _ in range(3):
+            params, opt_state, metrics = step(params, opt_state, tokens)
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        assert params["layers"]["wq"].sharding.spec[0] == "pp"
