@@ -16,7 +16,8 @@ import subprocess
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_lib", "libaatpu.so")
 _SRCS = [os.path.join(_DIR, "src", f)
-         for f in ("transport.cpp", "cluster.cpp")]
+         for f in ("transport.cpp", "cluster.cpp", "remote_worker.cpp",
+                   "ring.h")]
 
 _lib: ctypes.CDLL | None = None
 
@@ -91,6 +92,11 @@ def load_library() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_long, ctypes.c_int, ctypes.c_int,
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_long)]
+
+    lib.aat_remote_worker_run.restype = ctypes.c_long
+    lib.aat_remote_worker_run.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int]
 
     _lib = lib
     return lib

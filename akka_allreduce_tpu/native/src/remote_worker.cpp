@@ -1,0 +1,736 @@
+// Cross-process native worker engine: the C++ protocol worker joined to
+// the C++ framed TCP transport (transport.cpp) with the binary wire
+// codec (protocol/wire.py) — the native engine running across real OS
+// process boundaries, in the role the reference's JVM worker plays under
+// Akka netty remoting (reference: AllreduceWorker.scala:303-346,
+// application.conf:5-11).
+//
+// The engine semantics are the SAME rules as the in-process engine
+// (cluster.cpp) and the Python spec (protocol/worker.py, pinned by
+// tests/test_protocol_worker.py): exactly-once == threshold fires,
+// stale-round drops, requeue-behind-self-Start for future rounds,
+// rank-staggered fan-out with self-delivery bypass, maxLag catch-up
+// force-completion, count piggyback, zero-filled flush. Peer-sum order
+// is ascending rank — bit-identical f32 reductions across the Python
+// and native engines, so both can serve one cluster interchangeably
+// (pinned by tests/test_native_remote.py's mixed-engine cluster).
+//
+// MAINTENANCE HAZARD: the state machine here deliberately mirrors
+// cluster.cpp's Worker (the deployments differ — in-proc FIFO queue vs
+// framed TCP + int64 rounds — but the protocol rules are one spec).
+// A rule change must land in BOTH, plus protocol/worker.py; the guard
+// rails are tests/test_native_cluster.py (in-proc vs Python agreement)
+// and tests/test_native_remote.py (cross-process vs Python agreement,
+// exact-equality sinks in one mixed cluster).
+//
+// Deployment protocol (protocol/tcp.py TcpRouter):
+//   dial master -> Hello(own listen addr, "worker") -> InitWorkers
+//   assigns rank + peer address book -> rounds run over lazily-dialed
+//   peer connections (each greeted with Hello) -> CompleteAllreduce to
+//   the master -> master disconnect = shutdown (the reference's
+//   clusters stop by killing the master). Pings go out every heartbeat
+//   interval so the master's failure detector (reference:
+//   application.conf:20) keeps seeing this worker alive.
+//
+// Build: part of libaatpu.so (native/Makefile). C ABI at the bottom.
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "ring.h"
+
+extern "C" {
+void* aat_create(const char* bind_host, int port);
+int aat_port(void* tp);
+int aat_connect(void* tp, const char* host, int port, int timeout_ms);
+int aat_send(void* tp, int peer, const uint8_t* buf, uint64_t len);
+int64_t aat_recv_len(void* tp);
+int64_t aat_recv_take(void* tp, uint8_t* buf, uint64_t cap, int* src_peer);
+int aat_poll_disconnect(void* tp);
+void aat_close_peer(void* tp, int peer);
+int aat_send_drained(void* tp, int peer);
+void aat_destroy(void* tp);
+}
+
+namespace {
+
+using aat::Ring;
+
+// ---- wire codec (must match protocol/wire.py byte-for-byte) -------------
+
+enum MsgType : uint8_t {
+    kHello = 0, kInit = 1, kStart = 2, kScatter = 3, kReduce = 4,
+    kComplete = 5, kPing = 6,
+};
+
+struct Addr {
+    std::string host;
+    uint32_t port = 0;
+    bool operator==(const Addr& o) const {
+        return port == o.port && host == o.host;
+    }
+    bool operator<(const Addr& o) const {
+        return host < o.host || (host == o.host && port < o.port);
+    }
+};
+
+// little-endian unaligned field readers/writers
+template <typename T>
+bool rd(const uint8_t* buf, size_t len, size_t& off, T* out) {
+    if (off + sizeof(T) > len) return false;
+    std::memcpy(out, buf + off, sizeof(T));
+    off += sizeof(T);
+    return true;
+}
+template <typename T>
+void wr(std::vector<uint8_t>& out, T v) {
+    size_t n = out.size();
+    out.resize(n + sizeof(T));
+    std::memcpy(out.data() + n, &v, sizeof(T));
+}
+
+bool rd_addr(const uint8_t* buf, size_t len, size_t& off, Addr* a) {
+    uint16_t hlen;
+    if (!rd(buf, len, off, &hlen)) return false;
+    if (off + hlen > len) return false;
+    a->host.assign(reinterpret_cast<const char*>(buf) + off, hlen);
+    off += hlen;
+    return rd(buf, len, off, &a->port);
+}
+void wr_addr(std::vector<uint8_t>& out, const Addr& a) {
+    wr<uint16_t>(out, static_cast<uint16_t>(a.host.size()));
+    out.insert(out.end(), a.host.begin(), a.host.end());
+    wr<uint32_t>(out, a.port);
+}
+
+std::vector<uint8_t> enc_hello(const Addr& self, const char* role) {
+    std::vector<uint8_t> out;
+    wr<uint8_t>(out, kHello);
+    wr_addr(out, self);
+    size_t rlen = std::strlen(role);
+    wr<uint8_t>(out, static_cast<uint8_t>(rlen));
+    out.insert(out.end(), role, role + rlen);
+    return out;
+}
+std::vector<uint8_t> enc_ping(double interval) {
+    std::vector<uint8_t> out;
+    wr<uint8_t>(out, kPing);
+    wr<double>(out, interval);
+    return out;
+}
+std::vector<uint8_t> enc_scatter(int src, int dest, int chunk,
+                                 int64_t round, const float* data,
+                                 size_t n) {
+    std::vector<uint8_t> out;
+    out.reserve(1 + 4 * 3 + 8 * 2 + n * 4);
+    wr<uint8_t>(out, kScatter);
+    wr<int32_t>(out, src);
+    wr<int32_t>(out, dest);
+    wr<int32_t>(out, chunk);
+    wr<int64_t>(out, round);
+    wr<uint64_t>(out, n * 4);
+    size_t at = out.size();
+    out.resize(at + n * 4);
+    std::memcpy(out.data() + at, data, n * 4);
+    return out;
+}
+std::vector<uint8_t> enc_reduce(int src, int dest, int chunk,
+                                int64_t round, int64_t count,
+                                const float* data, size_t n) {
+    std::vector<uint8_t> out;
+    out.reserve(1 + 4 * 3 + 8 * 3 + n * 4);
+    wr<uint8_t>(out, kReduce);
+    wr<int32_t>(out, src);
+    wr<int32_t>(out, dest);
+    wr<int32_t>(out, chunk);
+    wr<int64_t>(out, round);
+    wr<int64_t>(out, count);
+    wr<uint64_t>(out, n * 4);
+    size_t at = out.size();
+    out.resize(at + n * 4);
+    std::memcpy(out.data() + at, data, n * 4);
+    return out;
+}
+std::vector<uint8_t> enc_complete(int src, int64_t round) {
+    std::vector<uint8_t> out;
+    wr<uint8_t>(out, kComplete);
+    wr<int32_t>(out, src);
+    wr<int64_t>(out, round);
+    return out;
+}
+
+// decoded protocol message (scatter/reduce/start only — the self queue)
+struct PMsg {
+    uint8_t type = 0;
+    int src = 0, dest = 0, chunk = 0;
+    int64_t round = 0, count = 0;
+    std::vector<float> payload;
+};
+
+double now_s() {
+    using namespace std::chrono;
+    return duration<double>(steady_clock::now().time_since_epoch())
+        .count();
+}
+
+// ---- the engine ---------------------------------------------------------
+
+struct RemoteWorker {
+    void* tp = nullptr;
+    Addr self;
+    Addr master_addr;        // send target (Init-advertised once known)
+    Addr dialed_master;      // the addr we actually dialed (CLI flags)
+    bool master_known = false;
+    bool master_gone = false;
+    std::map<Addr, int> conn_of;
+    std::map<int, Addr> addr_of_conn;
+    int connect_timeout_ms = 10000;
+    double hb_interval = 2.0;
+    double last_ping = 0.0;
+    int verbose = 0;
+
+    // engine state (protocol/worker.py fields; cluster.cpp Worker)
+    int id = -1;
+    int peer_num = 0;
+    double th_reduce = 1.0, th_complete = 1.0;
+    int max_lag = 0;
+    int64_t round = -1, max_round = -1, max_scattered = -1;
+    std::set<int64_t> completed;
+    std::map<int, Addr> peers;  // rank -> listen addr (deathwatch prunes)
+
+    long data_size = 0;
+    int max_chunk = 1024;
+    std::vector<std::pair<long, long>> ranges;
+    long my_block = 0, max_block = 0;
+    Ring scatter_buf, reduce_buf;
+    std::vector<int> reduce_counts;
+    int scatter_gate = 0;
+    long completion_gate = 0, total_chunks = 0;
+    std::vector<float> source;  // constant arange input
+    std::vector<float> out_data;
+    std::vector<int> out_counts;
+
+    // sink (protocol/cluster.py ThroughputSink)
+    long outputs_flushed = 0;
+    int checkpoint = 10;
+    int assert_multiple = 0;
+    bool failed = false;
+    double window_t0 = 0.0;
+
+    std::deque<PMsg> self_q;  // requeue-behind-self-Start mail
+
+    // -- connections ------------------------------------------------------
+
+    int ensure_conn(const Addr& a) {
+        auto it = conn_of.find(a);
+        if (it != conn_of.end()) return it->second;
+        int c = aat_connect(tp, a.host.c_str(),
+                            static_cast<int>(a.port),
+                            connect_timeout_ms);
+        if (c < 0) return -1;
+        conn_of[a] = c;
+        addr_of_conn[c] = a;
+        auto hello = enc_hello(self, "worker");
+        aat_send(tp, c, hello.data(), hello.size());
+        return c;
+    }
+
+    void send_frame(const Addr& a, const std::vector<uint8_t>& f) {
+        int c = ensure_conn(a);
+        if (c < 0) return;  // dead peer: dead-letter drop
+        aat_send(tp, c, f.data(), f.size());
+    }
+
+    // -- init (protocol/worker.py _handle_init) ----------------------------
+
+    void on_init(const uint8_t* buf, size_t len, size_t off) {
+        int32_t dest_id;
+        uint32_t worker_num, lag32;
+        double thr, thc;
+        uint64_t dsz, chunk;
+        int64_t start_round;
+        if (!rd(buf, len, off, &dest_id) || !rd(buf, len, off, &worker_num)
+            || !rd(buf, len, off, &thr) || !rd(buf, len, off, &thc)
+            || !rd(buf, len, off, &lag32) || !rd(buf, len, off, &dsz)
+            || !rd(buf, len, off, &chunk)
+            || !rd(buf, len, off, &start_round))
+            return;
+        uint8_t has_master;
+        if (!rd(buf, len, off, &has_master)) return;
+        Addr maddr;
+        if (has_master && !rd_addr(buf, len, off, &maddr)) return;
+        if (has_master && !(maddr == dialed_master)) {
+            // the master's ADVERTISED addr (e.g. its bind IP) may differ
+            // from the host string we dialed: alias it to the dialed
+            // connection so CompleteAllreduce rides the existing socket
+            // instead of opening a duplicate that Hellos as a new member
+            auto dit = conn_of.find(dialed_master);
+            if (dit != conn_of.end()) conn_of.emplace(maddr, dit->second);
+        }
+        uint32_t count;
+        if (!rd(buf, len, off, &count)) return;
+        std::map<int, Addr> wmap;
+        for (uint32_t i = 0; i < count; ++i) {
+            int32_t rank;
+            Addr a;
+            if (!rd(buf, len, off, &rank) || !rd_addr(buf, len, off, &a))
+                return;
+            wmap[rank] = a;
+        }
+        if (id != -1) {  // re-init refreshes the peer map only
+            peers = std::move(wmap);
+            return;
+        }
+        id = dest_id;
+        if (has_master) { master_addr = maddr; master_known = true; }
+        peer_num = static_cast<int>(worker_num);
+        peers = std::move(wmap);
+        th_reduce = thr;
+        th_complete = thc;
+        max_lag = static_cast<int>(lag32);
+        round = start_round;
+        max_round = start_round - 1;
+        max_scattered = start_round - 1;
+        completed.clear();
+        data_size = static_cast<long>(dsz);
+        max_chunk = static_cast<int>(chunk);
+
+        long step = data_size > 0
+            ? (data_size + peer_num - 1) / peer_num : 0;
+        ranges.clear();
+        for (int i = 0; i < peer_num; ++i) {
+            long lo = step > 0 ? std::min((long)i * step, data_size)
+                               : data_size;
+            long hi = step > 0 ? std::min((long)(i + 1) * step, data_size)
+                               : data_size;
+            ranges.emplace_back(lo, hi);
+        }
+        my_block = ranges[id].second - ranges[id].first;
+        max_block = ranges[0].second - ranges[0].first;
+        scatter_buf.init((int)my_block, peer_num, max_lag + 1, max_chunk);
+        scatter_gate = peer_num > 0
+            ? std::max(1, (int)(th_reduce * peer_num)) : 0;
+        reduce_buf.init((int)max_block, peer_num, max_lag + 1, max_chunk);
+        reduce_counts.assign(
+            (size_t)(max_lag + 1) * peer_num *
+                (reduce_buf.nchunks ? reduce_buf.nchunks : 1), 0);
+        total_chunks = 0;
+        for (int i = 0; i < peer_num; ++i) {
+            long blk = ranges[i].second - ranges[i].first;
+            if (blk > 0)
+                total_chunks += (blk + max_chunk - 1) / max_chunk;
+        }
+        long gate = (long)(th_complete * total_chunks);
+        completion_gate = total_chunks > 0
+            ? std::min(std::max(1L, gate), total_chunks) : 0;
+        source.resize(data_size);
+        for (long i = 0; i < data_size; ++i) source[i] = (float)i;
+        out_data.resize(data_size);
+        out_counts.resize(data_size);
+        window_t0 = now_s();
+        if (verbose)
+            std::fprintf(stderr,
+                         "native worker %d: %d peers, block %ld\n", id,
+                         peer_num, my_block);
+    }
+
+    // -- round start + catch-up (protocol/worker.py _handle_start) ---------
+
+    void on_start(int64_t r) {
+        if (id == -1) {  // uninitialized: requeue behind init
+            PMsg m; m.type = kStart; m.round = r;
+            self_q.push_back(std::move(m));
+            return;
+        }
+        if (r > max_round) max_round = r;
+        while (round < max_round - max_lag) {
+            for (int k = 0; k < scatter_buf.nchunks; ++k) {
+                long start = (long)k * max_chunk;
+                long end = std::min(my_block, start + max_chunk);
+                int t = scatter_buf.tidx(0);
+                std::vector<float> red((size_t)(end - start), 0.f);
+                for (int p = 0; p < peer_num; ++p) {
+                    const float* row = scatter_buf.row_ptr(t, p);
+                    for (long e = start; e < end; ++e)
+                        red[e - start] += row[e];
+                }
+                int cnt = (int)scatter_buf.filled[
+                    (size_t)t * scatter_buf.nchunks + k];
+                broadcast(red.data(), red.size(), k, round, cnt);
+            }
+            complete(round, 0);
+        }
+        while (max_scattered < max_round) {
+            scatter_round(max_scattered + 1);
+            max_scattered += 1;
+        }
+        for (auto it = completed.begin(); it != completed.end();)
+            it = (*it < round) ? completed.erase(it) : ++it;
+    }
+
+    // -- scatter phase -----------------------------------------------------
+
+    void scatter_round(int64_t r) {
+        for (int i = 0; i < peer_num; ++i) {
+            int idx = (i + id) % peer_num;
+            auto pit = peers.find(idx);
+            if (pit == peers.end()) continue;  // dead peer gap
+            long lo = ranges[idx].first, hi = ranges[idx].second;
+            long blk = hi - lo;
+            long nch = blk > 0 ? (blk + max_chunk - 1) / max_chunk : 0;
+            for (long c = 0; c < nch; ++c) {
+                long cs = c * max_chunk;
+                long ce = std::min(blk, cs + max_chunk);
+                if (idx == id) {
+                    PMsg m; m.type = kScatter; m.src = id; m.dest = id;
+                    m.chunk = (int)c; m.round = r;
+                    m.payload.assign(source.begin() + lo + cs,
+                                     source.begin() + lo + ce);
+                    on_scatter(m);
+                } else {
+                    send_frame(pit->second,
+                               enc_scatter(id, idx, (int)c, r,
+                                           source.data() + lo + cs,
+                                           (size_t)(ce - cs)));
+                }
+            }
+        }
+    }
+
+    void on_scatter(const PMsg& m) {
+        if (m.round < round || completed.count(m.round)) return;  // stale
+        if (m.round <= max_round) {
+            int row = (int)(m.round - round);
+            if (!scatter_buf.store(m.payload.data(), m.payload.size(),
+                                   row, m.src, m.chunk))
+                return;
+            int t = scatter_buf.tidx(row);
+            if (scatter_buf.filled[(size_t)t * scatter_buf.nchunks +
+                                   m.chunk] == scatter_gate) {  // == once
+                long start = (long)m.chunk * max_chunk;
+                long end = std::min(my_block, start + max_chunk);
+                std::vector<float> red((size_t)(end - start), 0.f);
+                for (int p = 0; p < peer_num; ++p) {
+                    const float* rowp = scatter_buf.row_ptr(t, p);
+                    for (long e = start; e < end; ++e)
+                        red[e - start] += rowp[e];
+                }
+                broadcast(red.data(), red.size(), m.chunk, m.round,
+                          scatter_gate);
+            }
+        } else {
+            PMsg s; s.type = kStart; s.round = m.round;
+            self_q.push_back(std::move(s));
+            self_q.push_back(m);
+        }
+    }
+
+    // -- reduce / broadcast phase ------------------------------------------
+
+    void broadcast(const float* data, size_t len, int cid, int64_t r,
+                   int cnt) {
+        for (int i = 0; i < peer_num; ++i) {
+            int idx = (i + id) % peer_num;
+            auto pit = peers.find(idx);
+            if (pit == peers.end()) continue;
+            if (idx == id) {
+                PMsg m; m.type = kReduce; m.src = id; m.dest = id;
+                m.chunk = cid; m.round = r; m.count = cnt;
+                m.payload.assign(data, data + len);
+                on_reduce(m);
+            } else {
+                send_frame(pit->second,
+                           enc_reduce(id, idx, cid, r, cnt, data, len));
+            }
+        }
+    }
+
+    void on_reduce(const PMsg& m) {
+        if ((long)m.payload.size() > max_chunk) return;  // guard
+        if (m.round < round || completed.count(m.round)) return;  // stale
+        if (m.round <= max_round) {
+            int row = (int)(m.round - round);
+            if (!reduce_buf.store(m.payload.data(), m.payload.size(), row,
+                                  m.src, m.chunk))
+                return;
+            int t = reduce_buf.tidx(row);
+            reduce_counts[((size_t)t * peer_num + m.src) *
+                          reduce_buf.nchunks + m.chunk] = (int)m.count;
+            if (reduce_buf.total[t] == completion_gate)  // == : once
+                complete(m.round, row);
+        } else {
+            PMsg s; s.type = kStart; s.round = m.round;
+            self_q.push_back(std::move(s));
+            self_q.push_back(m);
+        }
+    }
+
+    // -- completion --------------------------------------------------------
+
+    void complete(int64_t r, int row) {
+        flush(r, row);
+        if (master_known)
+            send_frame(master_addr, enc_complete(id, r));
+        completed.insert(r);
+        if (round == r) {
+            for (;;) {
+                round += 1;
+                scatter_buf.up();
+                reduce_buf.up();
+                int t = reduce_buf.tidx(max_lag);
+                std::fill(
+                    reduce_counts.begin() +
+                        (size_t)t * peer_num * reduce_buf.nchunks,
+                    reduce_counts.begin() +
+                        (size_t)(t + 1) * peer_num * reduce_buf.nchunks,
+                    0);
+                if (!completed.count(round)) break;
+            }
+        }
+    }
+
+    void flush(int64_t r, int row) {
+        int t = reduce_buf.tidx(row);
+        long transferred = 0, count_transferred = 0;
+        for (int i = 0; i < peer_num; ++i) {
+            const float* block = reduce_buf.row_ptr(t, i);
+            long bs = std::min(data_size - transferred, max_block);
+            if (bs > 0)
+                std::memcpy(out_data.data() + transferred, block,
+                            (size_t)bs * sizeof(float));
+            for (int j = 0; j < reduce_buf.nchunks; ++j) {
+                long csz = std::min((long)max_chunk,
+                                    max_block - (long)max_chunk * j);
+                long take = std::min(data_size - count_transferred, csz);
+                if (take <= 0) break;
+                int cnt = reduce_counts[((size_t)t * peer_num + i) *
+                                        reduce_buf.nchunks + j];
+                std::fill(out_counts.begin() + count_transferred,
+                          out_counts.begin() + count_transferred + take,
+                          cnt);
+                count_transferred += take;
+            }
+            transferred += bs;
+        }
+        outputs_flushed += 1;
+        if (assert_multiple > 0) {
+            for (long e = 0; e < data_size; ++e) {
+                if (out_data[e] != (float)e * assert_multiple ||
+                    out_counts[e] != assert_multiple) {
+                    std::fprintf(stderr,
+                                 "native worker %d: ASSERT output[%ld]="
+                                 "%f count=%d != %d x input at round %lld"
+                                 "\n", id, e, out_data[e], out_counts[e],
+                                 assert_multiple, (long long)r);
+                    failed = true;
+                    return;
+                }
+            }
+        }
+        if (checkpoint > 0 && outputs_flushed % checkpoint == 0) {
+            double dt = now_s() - window_t0;
+            double mbs = dt > 0
+                ? (double)data_size * 4 * checkpoint / dt / 1e6 : 0.0;
+            std::printf("native worker %d: round %lld, %.2f MB/s\n", id,
+                        (long long)r, mbs);
+            std::fflush(stdout);
+            window_t0 = now_s();
+        }
+    }
+
+    // -- frame dispatch ----------------------------------------------------
+
+    void dispatch(const uint8_t* buf, size_t len, int conn) {
+        size_t off = 0;
+        uint8_t mtype;
+        if (!rd(buf, len, off, &mtype)) return;
+        switch (mtype) {
+            case kHello: {
+                Addr a;
+                if (!rd_addr(buf, len, off, &a)) return;
+                // map the inbound connection; prefer an existing dialed
+                // one for sending (protocol/tcp.py _handle_hello)
+                addr_of_conn[conn] = a;
+                conn_of.emplace(a, conn);
+                break;
+            }
+            case kInit:
+                on_init(buf, len, off);
+                break;
+            case kStart: {
+                int64_t r;
+                if (rd(buf, len, off, &r)) on_start(r);
+                break;
+            }
+            case kScatter: {
+                PMsg m; m.type = kScatter;
+                int32_t src, dest, chunk;
+                uint64_t nbytes;
+                if (!rd(buf, len, off, &src) || !rd(buf, len, off, &dest)
+                    || !rd(buf, len, off, &chunk)
+                    || !rd(buf, len, off, &m.round)
+                    || !rd(buf, len, off, &nbytes))
+                    return;
+                if (off + nbytes > len || nbytes % 4) return;
+                m.src = src; m.dest = dest; m.chunk = chunk;
+                m.payload.resize(nbytes / 4);
+                std::memcpy(m.payload.data(), buf + off, nbytes);
+                if (id == -1) self_q.push_back(std::move(m));
+                else on_scatter(m);
+                break;
+            }
+            case kReduce: {
+                PMsg m; m.type = kReduce;
+                int32_t src, dest, chunk;
+                uint64_t nbytes;
+                if (!rd(buf, len, off, &src) || !rd(buf, len, off, &dest)
+                    || !rd(buf, len, off, &chunk)
+                    || !rd(buf, len, off, &m.round)
+                    || !rd(buf, len, off, &m.count)
+                    || !rd(buf, len, off, &nbytes))
+                    return;
+                if (off + nbytes > len || nbytes % 4) return;
+                m.src = src; m.dest = dest; m.chunk = chunk;
+                m.payload.resize(nbytes / 4);
+                std::memcpy(m.payload.data(), buf + off, nbytes);
+                if (id == -1) self_q.push_back(std::move(m));
+                else on_reduce(m);
+                break;
+            }
+            case kPing:
+            case kComplete:
+            default:
+                break;  // liveness traffic / not for workers
+        }
+    }
+
+    void drain_self_q() {
+        // process only what was queued at entry (protocol/tcp.py
+        // _drain_local): a requeueing handler must not starve inbound
+        size_t n = self_q.size();
+        for (size_t i = 0; i < n && !self_q.empty(); ++i) {
+            PMsg m = std::move(self_q.front());
+            self_q.pop_front();
+            if (m.type == kStart) on_start(m.round);
+            else if (id == -1) self_q.push_back(std::move(m));
+            else if (m.type == kScatter) on_scatter(m);
+            else if (m.type == kReduce) on_reduce(m);
+        }
+    }
+
+    void drain_disconnects() {
+        for (;;) {
+            int c = aat_poll_disconnect(tp);
+            if (c < 0) return;
+            auto it = addr_of_conn.find(c);
+            if (it == addr_of_conn.end()) continue;
+            Addr a = it->second;
+            addr_of_conn.erase(it);
+            auto cit = conn_of.find(a);
+            if (cit != conn_of.end() && cit->second == c)
+                conn_of.erase(cit);
+            if ((master_known && a == master_addr)
+                || a == dialed_master) {
+                master_gone = true;  // master death = shutdown
+                continue;
+            }
+            // deathwatch: drop the dead rank; thresholds tolerate the
+            // gap (protocol/worker.py terminated)
+            for (auto pit = peers.begin(); pit != peers.end();) {
+                if (pit->second == a) pit = peers.erase(pit);
+                else ++pit;
+            }
+        }
+    }
+
+    void heartbeat() {
+        double now = now_s();
+        if (now - last_ping < hb_interval) return;
+        last_ping = now;
+        auto ping = enc_ping(hb_interval);
+        for (auto& [a, c] : conn_of)
+            aat_send(tp, c, ping.data(), ping.size());
+    }
+
+    long run(const char* master_host, int master_port, double timeout_s) {
+        tp = aat_create("127.0.0.1", 0);
+        if (!tp) return -3;
+        self.host = "127.0.0.1";
+        self.port = static_cast<uint32_t>(aat_port(tp));
+        dialed_master.host = master_host;
+        dialed_master.port = static_cast<uint32_t>(master_port);
+        master_addr = dialed_master;  // until InitWorkers advertises one
+        master_known = true;
+        // join-retry: the master may not be listening yet (seed-node
+        // join retries, protocol/remote.py run_worker)
+        double join_deadline = now_s() + timeout_s;
+        for (;;) {
+            int c = aat_connect(tp, master_host, master_port, 2000);
+            if (c >= 0) {
+                conn_of[master_addr] = c;
+                addr_of_conn[c] = master_addr;
+                auto hello = enc_hello(self, "worker");
+                aat_send(tp, c, hello.data(), hello.size());
+                break;
+            }
+            if (now_s() >= join_deadline) { aat_destroy(tp); return -3; }
+            usleep(200000);
+        }
+        std::vector<uint8_t> buf(1 << 20);
+        double deadline = now_s() + timeout_s;
+        while (!master_gone && !failed && now_s() < deadline) {
+            drain_self_q();
+            bool any = false;
+            for (;;) {
+                int64_t need = aat_recv_len(tp);
+                if (need < 0) break;
+                if ((size_t)need > buf.size()) buf.resize(need * 2);
+                int src = -1;
+                int64_t got = aat_recv_take(tp, buf.data(), buf.size(),
+                                            &src);
+                if (got < 0) break;
+                dispatch(buf.data(), (size_t)got, src);
+                any = true;
+            }
+            drain_disconnects();
+            heartbeat();
+            if (!any && self_q.empty()) usleep(200);
+        }
+        long rc = failed ? -1 : outputs_flushed;
+        aat_destroy(tp);
+        return rc;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Join the master at master_host:master_port as a native worker engine
+// over the C++ TCP transport; run until the master disconnects (normal
+// shutdown), the sink assertion fails, or timeout. Returns outputs
+// flushed (>= 0), -1 on assertion failure, -3 when the master was
+// never reachable.
+long aat_remote_worker_run(const char* master_host, int master_port,
+                           int checkpoint, int assert_multiple,
+                           double timeout_s, double hb_interval_s,
+                           int verbose) {
+    if (master_port <= 0 || timeout_s <= 0) return -3;
+    RemoteWorker w;
+    w.checkpoint = checkpoint;
+    w.assert_multiple = assert_multiple;
+    w.hb_interval = hb_interval_s > 0 ? hb_interval_s : 2.0;
+    w.verbose = verbose;
+    return w.run(master_host, master_port, timeout_s);
+}
+
+}  // extern "C"

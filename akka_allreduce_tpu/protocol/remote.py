@@ -122,6 +122,39 @@ def run_worker(master_host: str = "127.0.0.1", master_port: int = 2551,
     return sink.outputs_seen
 
 
+def run_worker_native(master_host: str = "127.0.0.1",
+                      master_port: int = 2551, checkpoint: int = 10,
+                      assert_multiple: int = 0, timeout_s: float = 120.0,
+                      verbose: bool = False,
+                      heartbeat_interval_s: float = 2.0) -> int:
+    """The C++ worker engine across process boundaries: protocol engine,
+    buffers, wire codec AND transport all native (native/src/
+    remote_worker.cpp) — the deployment shape of the reference's JVM
+    worker under netty remoting. Joins the same masters, speaks the same
+    frames, and produces bit-identical outputs to :func:`run_worker`
+    (ascending-rank f32 reduction order on both engines), so Python and
+    native workers can serve one cluster interchangeably. Returns
+    outputs flushed; raises on assertion failure or unreachable master.
+
+    The source geometry comes entirely from the master's ``InitWorkers``
+    (the synthetic arange source is a pure function of ``data_size``),
+    so there is no ``source_data_size`` parameter to keep in sync."""
+    from akka_allreduce_tpu.native import load_library
+
+    lib = load_library()
+    rc = lib.aat_remote_worker_run(
+        master_host.encode(), master_port, checkpoint, assert_multiple,
+        timeout_s, heartbeat_interval_s, 1 if verbose else 0)
+    if rc == -1:
+        raise AssertionError(
+            "native worker: output != N x input (sink assertion)")
+    if rc == -3:
+        raise ConnectionError(
+            f"native worker: master at {master_host}:{master_port} "
+            f"unreachable within {timeout_s}s")
+    return int(rc)
+
+
 def free_port(bind_host: str = "127.0.0.1") -> int:
     """Pick an ephemeral port (test convenience; races are acceptable on
     localhost)."""

@@ -9,7 +9,11 @@ every exit code. Each worker asserts ``output == 4 x input`` every 10
 rounds, so a zero exit means the full protocol ran correctly end-to-end
 across process boundaries.
 
-Usage: python scripts/smoke_cluster.py [maxRound=40]
+Usage: python scripts/smoke_cluster.py [maxRound=40] [--native]
+
+``--native`` runs the four workers on the C++ engine
+(native/src/remote_worker.cpp) over the same wire — the reference's
+JVM-native worker deployment, here all-native end to end.
 """
 
 import os
@@ -22,7 +26,9 @@ SCRIPTS = os.path.dirname(os.path.abspath(__file__))
 
 
 def main() -> int:
-    max_round = sys.argv[1] if len(sys.argv) > 1 else "40"
+    argv = [a for a in sys.argv[1:] if a != "--native"]
+    native = "--native" in sys.argv[1:]
+    max_round = argv[0] if argv else "40"
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
@@ -30,12 +36,11 @@ def main() -> int:
         [sys.executable, os.path.join(SCRIPTS, "test_allreduce_master.py"),
          max_round], env=env)
     time.sleep(1.0)  # let the listener bind before workers dial in
-    workers = [
-        subprocess.Popen(
-            [sys.executable,
-             os.path.join(SCRIPTS, "test_allreduce_worker.py")], env=env)
-        for _ in range(4)
-    ]
+    worker_cmd = [sys.executable,
+                  os.path.join(SCRIPTS, "test_allreduce_worker.py")]
+    if native:
+        worker_cmd.append("--native")
+    workers = [subprocess.Popen(worker_cmd, env=env) for _ in range(4)]
 
     procs = {"master": master, **{f"worker{i}": w
                                   for i, w in enumerate(workers)}}
@@ -52,8 +57,8 @@ def main() -> int:
     if failed:
         print(f"FAILED: {failed}", file=sys.stderr)
         return 1
-    print(f"cluster smoke OK: master + 4 workers, {max_round} rounds, "
-          f"output == 4 x input verified")
+    print(f"cluster smoke OK: master + 4 {'native ' if native else ''}"
+          f"workers, {max_round} rounds, output == 4 x input verified")
     return 0
 
 

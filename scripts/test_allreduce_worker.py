@@ -22,4 +22,5 @@ if __name__ == "__main__":
     sys.exit(main([
         "worker", "--master-port", "2551", "--data-size", "778",
         "--checkpoint", "10", "--assert-multiple", "4",
+        *sys.argv[1:],  # e.g. --native: the C++ engine, same wire
     ]))
