@@ -215,6 +215,10 @@ def _cmd_worker(args: argparse.Namespace) -> int:
                   "--native (the C++ engine downs peers on TCP "
                   "disconnect only; hung-but-connected peers are the "
                   "Python router's detector)", file=sys.stderr)
+        if args.data_size != 10:
+            print("note: --native derives the data geometry from the "
+                  "master's InitWorkers; --data-size is ignored",
+                  file=sys.stderr)
         outputs = run_worker_native(
             master_host=args.master_host, master_port=args.master_port,
             checkpoint=args.checkpoint,

@@ -407,6 +407,8 @@ struct RemoteWorker {
     }
 
     void on_scatter(const PMsg& m) {
+        if (m.dest != id) return;  // misrouted: the Python spec raises
+        //                            and drops (non-strict); never stage
         if (m.round < round || completed.count(m.round)) return;  // stale
         if (m.round <= max_round) {
             int row = (int)(m.round - round);
@@ -455,6 +457,7 @@ struct RemoteWorker {
     }
 
     void on_reduce(const PMsg& m) {
+        if (m.dest != id) return;  // misrouted (see on_scatter)
         if ((long)m.payload.size() > max_chunk) return;  // guard
         if (m.round < round || completed.count(m.round)) return;  // stale
         if (m.round <= max_round) {
@@ -580,7 +583,8 @@ struct RemoteWorker {
                     || !rd(buf, len, off, &m.round)
                     || !rd(buf, len, off, &nbytes))
                     return;
-                if (off + nbytes > len || nbytes % 4) return;
+                // subtraction form: off + nbytes could wrap the uint64
+                if (nbytes > len - off || nbytes % 4) return;
                 m.src = src; m.dest = dest; m.chunk = chunk;
                 m.payload.resize(nbytes / 4);
                 std::memcpy(m.payload.data(), buf + off, nbytes);
@@ -598,7 +602,8 @@ struct RemoteWorker {
                     || !rd(buf, len, off, &m.count)
                     || !rd(buf, len, off, &nbytes))
                     return;
-                if (off + nbytes > len || nbytes % 4) return;
+                // subtraction form: off + nbytes could wrap the uint64
+                if (nbytes > len - off || nbytes % 4) return;
                 m.src = src; m.dest = dest; m.chunk = chunk;
                 m.payload.resize(nbytes / 4);
                 std::memcpy(m.payload.data(), buf + off, nbytes);

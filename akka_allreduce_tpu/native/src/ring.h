@@ -33,9 +33,12 @@ struct Ring {
     }
     bool store(const float* data, size_t len, int row, int src, int cid) {
         long start = (long)cid * chunk;
-        if (start < 0 || start + (long)len > data_size || src < 0 ||
-            src >= peers)
+        if (cid < 0 || cid >= nchunks || start + (long)len > data_size ||
+            src < 0 || src >= peers)
             return false;  // python raises IndexError; count NOT bumped
+            // (cid bound matters independently of start: a zero-length
+            // payload at cid == nchunks would index filled[] one past
+            // its row — reachable from the network via remote_worker)
         int t = tidx(row);
         std::memcpy(row_ptr(t, src) + start, data, len * sizeof(float));
         filled[(size_t)t * nchunks + cid] += 1;

@@ -217,6 +217,7 @@ class TestAutoDown:
         finally:
             m.close()
 
+    @pytest.mark.slow  # wall-clock chain; the probation pin above is fast
     def test_caught_up_straggler_is_re_upped(self):
         """A downed peer that wakes, replays the retained masks and
         reports at the frontier is re-upped — the final rounds run
@@ -250,7 +251,8 @@ class TestAutoDown:
 
 
 class TestBucketGranularWire:
-    @pytest.mark.parametrize("wire", ["f32", "int8"])
+    @pytest.mark.parametrize("wire", [
+        "f32", pytest.param("int8", marks=pytest.mark.slow)])
     def test_mid_publish_cut_contributes_landed_buckets(self, wire):
         """Cut a worker between bucket 1 and bucket 2 of round 1: the
         master's probe credits the landed prefix — per-bucket mask rows,

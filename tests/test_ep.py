@@ -257,6 +257,7 @@ class TestScatterDispatch:
                                        atol=1e-5, rtol=1e-4,
                                        err_msg=str(pe))
 
+    @pytest.mark.slow  # second pin: dispatch=1.0 path stays fast
     def test_drops_match_under_tight_capacity(self):
         y_e, aux_e, _ = self._run("einsum",
                                   {"capacity_factor": 0.25}, seed=5)

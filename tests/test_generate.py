@@ -32,6 +32,7 @@ def tokens_for(cfg, b, t, seed=0):
 
 
 class TestDecodeParity:
+    @pytest.mark.slow  # greedy-argmax e2e pin stays in the fast tier
     def test_incremental_matches_full_forward(self):
         params = init_transformer(jax.random.key(0), CFG)
         toks = tokens_for(CFG, b=2, t=10)
@@ -47,6 +48,7 @@ class TestDecodeParity:
         np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_prefill_matches_stepwise(self):
         params = init_transformer(jax.random.key(1), CFG)
         toks = tokens_for(CFG, b=2, t=8, seed=3)

@@ -71,6 +71,7 @@ class TestRope:
 
 
 class TestGQA:
+    @pytest.mark.slow  # GQA correctness also pinned by ring-flash GQA
     def test_matches_repeated_head_oracle(self):
         """A GQA forward must equal an MHA forward whose wk/wv are the GQA
         shards repeated per group — grouped attention IS head sharing."""

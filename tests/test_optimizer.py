@@ -112,6 +112,7 @@ class TestClipping:
         # point is it is finite and small, not 1e6-scale
         assert float(gnorm) < 1.0
 
+    @pytest.mark.slow
     def test_training_still_learns_with_schedule_and_clip(self):
         mesh = make_device_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
         cfg = TrainConfig(model=MCFG, learning_rate=5e-3,

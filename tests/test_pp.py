@@ -57,7 +57,9 @@ class TestStacking:
 
 
 class TestGpipe:
-    @pytest.mark.parametrize("s,m", [(4, 4), (2, 6), (4, 1), (8, 3)])
+    @pytest.mark.parametrize("s,m", [
+        pytest.param(4, 4, marks=pytest.mark.slow),
+        (2, 6), (4, 1), (8, 3)])
     def test_pipeline_computes_product(self, s, m):
         mesh = pp_mesh(s)
         w = jnp.arange(1.0, s + 1)          # stage i multiplies by i+1

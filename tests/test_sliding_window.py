@@ -34,10 +34,10 @@ def _qkv(key, b=1, t=128, h=2, d=16):
 class TestKernelParity:
     @pytest.mark.parametrize("window,blk", [
         (8, 32),    # window far below the block: most tiles banded out
-        (32, 32),   # window == block
+        pytest.param(32, 32, marks=pytest.mark.slow),  # window == block
         (100, 32),  # window crosses several blocks, not a multiple
         (1, 32),    # degenerate: self-attention only
-        (128, 32),  # window >= T: equals plain causal
+        pytest.param(128, 32, marks=pytest.mark.slow),  # >= T: causal
     ])
     def test_forward_matches_windowed_oracle(self, window, blk):
         q, k, v = _qkv(jax.random.key(0))
@@ -56,6 +56,7 @@ class TestKernelParity:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-6)
 
+    @pytest.mark.slow  # second pin: forward parity is the fast gate
     def test_gradients_match_windowed_oracle(self):
         q, k, v = _qkv(jax.random.key(2), t=96, h=1)
 
@@ -154,6 +155,8 @@ class TestModelIntegration:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5, rtol=5e-3)
 
+    @pytest.mark.slow  # composition pin: the window kernels and the
+    # decode path each keep their own fast-tier pins
     def test_windowed_decode_matches_full_forward(self):
         from akka_allreduce_tpu.models.generate import (decode_step,
                                                         init_kv_cache)
@@ -215,7 +218,8 @@ class TestWindowedSP:
         return run(q, k, v)
 
     @pytest.mark.parametrize("window", [
-        1, 5,
+        1,
+        pytest.param(5, marks=pytest.mark.slow),
         pytest.param(16, marks=pytest.mark.slow),
         pytest.param(17, marks=pytest.mark.slow)])
     def test_forward_matches_windowed_oracle(self, mesh, window):
