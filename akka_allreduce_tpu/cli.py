@@ -181,8 +181,10 @@ def _add_worker(sub: argparse._SubParsersAction) -> None:
         "(reference: AllreduceWorker.scala:309-315)")
     p.add_argument("--master-host", default="127.0.0.1")
     p.add_argument("--master-port", type=int, default=2551)
-    p.add_argument("--data-size", type=int, default=10,
-                   help="synthetic source length (must match the master's)")
+    p.add_argument("--data-size", type=int, default=None,
+                   help="synthetic source length, default 10 (must match "
+                        "the master's; ignored with --native, which "
+                        "takes geometry from InitWorkers)")
     p.add_argument("--checkpoint", type=int, default=10,
                    help="throughput print interval in rounds")
     p.add_argument("--assert-multiple", type=int, default=0,
@@ -215,7 +217,7 @@ def _cmd_worker(args: argparse.Namespace) -> int:
                   "--native (the C++ engine downs peers on TCP "
                   "disconnect only; hung-but-connected peers are the "
                   "Python router's detector)", file=sys.stderr)
-        if args.data_size != 10:
+        if args.data_size is not None:
             print("note: --native derives the data geometry from the "
                   "master's InitWorkers; --data-size is ignored",
                   file=sys.stderr)
@@ -228,7 +230,8 @@ def _cmd_worker(args: argparse.Namespace) -> int:
     else:
         outputs = run_worker(master_host=args.master_host,
                              master_port=args.master_port,
-                             source_data_size=args.data_size,
+                             source_data_size=(10 if args.data_size is None
+                                               else args.data_size),
                              checkpoint=args.checkpoint,
                              assert_multiple=args.assert_multiple,
                              timeout_s=args.timeout, verbose=args.verbose,

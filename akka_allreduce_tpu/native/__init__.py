@@ -93,6 +93,13 @@ def load_library() -> ctypes.CDLL:
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_long)]
 
+    lib.aat_cluster_run_timed.restype = ctypes.c_long
+    lib.aat_cluster_run_timed.argtypes = [
+        ctypes.c_int, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_long]
+
     lib.aat_remote_worker_run.restype = ctypes.c_long
     lib.aat_remote_worker_run.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,

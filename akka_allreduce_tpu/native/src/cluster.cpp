@@ -26,6 +26,8 @@
 //
 // Build: part of libaatpu.so (native/Makefile). C ABI at the bottom.
 
+#include <time.h>
+
 #include <cstdint>
 #include <cstring>
 #include <deque>
@@ -105,6 +107,13 @@ struct Cluster {
     int m_round = -1;
     int m_num_complete = 0;
     long rounds_completed = 0;
+    std::vector<double> round_at;  // monotonic stamp per round advance
+
+    static double now_s() {
+        timespec ts{};
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        return (double)ts.tv_sec + ts.tv_nsec * 1e-9;
+    }
 
     void send(int dest, Msg&& m) {
         m.dest = dest;
@@ -117,6 +126,7 @@ struct Cluster {
         if ((double)m_num_complete >= n * th_allreduce &&
             m_round < max_round) {
             rounds_completed += 1;
+            round_at.push_back(now_s());
             m_round += 1;
             start_round();
         }
@@ -411,10 +421,16 @@ extern "C" {
 // Run a full in-process cluster; returns rounds completed, or -1 when the
 // correctness assertion (assert_multiple > 0) failed. out_flushed (may be
 // null) receives the total number of sink flushes across workers.
-long aat_cluster_run(int workers, long data_size, int max_chunk_size,
-                     int max_lag, double th_reduce, double th_complete,
-                     double th_allreduce, int max_round, int kill_rank,
-                     int assert_multiple, long* out_flushed) {
+// round_times (may be null, cap entries) receives per-round MONOTONIC
+// completion stamps — the per-round spread canonical-scale benchmarks
+// quote alongside the mean rate (scripts/bench_canonical.py).
+long aat_cluster_run_timed(int workers, long data_size,
+                           int max_chunk_size, int max_lag,
+                           double th_reduce, double th_complete,
+                           double th_allreduce, int max_round,
+                           int kill_rank, int assert_multiple,
+                           long* out_flushed, double* round_times,
+                           long times_cap) {
     if (workers <= 0 || data_size < 0 || max_chunk_size <= 0 ||
         max_lag < 0 || max_round < 0)
         return -2;
@@ -433,7 +449,22 @@ long aat_cluster_run(int workers, long data_size, int max_chunk_size,
     c.assert_multiple = assert_multiple;
     long rounds = c.run(kill_rank);
     if (out_flushed) *out_flushed = c.outputs_flushed;
+    if (round_times) {
+        long k = std::min<long>(times_cap, (long)c.round_at.size());
+        for (long i = 0; i < k; ++i) round_times[i] = c.round_at[i];
+    }
     return rounds;
+}
+
+long aat_cluster_run(int workers, long data_size, int max_chunk_size,
+                     int max_lag, double th_reduce, double th_complete,
+                     double th_allreduce, int max_round, int kill_rank,
+                     int assert_multiple, long* out_flushed) {
+    return aat_cluster_run_timed(workers, data_size, max_chunk_size,
+                                 max_lag, th_reduce, th_complete,
+                                 th_allreduce, max_round, kill_rank,
+                                 assert_multiple, out_flushed, nullptr,
+                                 0);
 }
 
 }  // extern "C"

@@ -21,9 +21,12 @@ from akka_allreduce_tpu.native import load_library
 
 def run_native_cluster(config: AllreduceConfig,
                        kill_rank: int | None = None,
-                       assert_multiple: int = 0) -> tuple[int, int]:
+                       assert_multiple: int = 0,
+                       with_round_times: bool = False):
     """Run the whole cluster natively; returns (rounds_completed,
-    outputs_flushed).
+    outputs_flushed), plus a list of per-round monotonic completion
+    stamps when ``with_round_times`` — the per-round spread the
+    canonical-scale benchmarks quote alongside the mean rate.
 
     ``assert_multiple > 0`` enables the reference sink's correctness
     invariant on EVERY flush (output == N x input, counts == N — valid
@@ -32,7 +35,9 @@ def run_native_cluster(config: AllreduceConfig,
     """
     lib = load_library()
     flushed = ctypes.c_long(0)
-    rounds = lib.aat_cluster_run(
+    cap = config.data.max_round + 1
+    times = (ctypes.c_double * cap)()
+    rounds = lib.aat_cluster_run_timed(
         config.workers.total_size,
         config.data.data_size,
         config.data.max_chunk_size,
@@ -44,6 +49,8 @@ def run_native_cluster(config: AllreduceConfig,
         -1 if kill_rank is None else kill_rank,
         assert_multiple,
         ctypes.byref(flushed),
+        times,
+        cap,
     )
     if rounds == -1:
         raise AssertionError(
@@ -51,4 +58,7 @@ def run_native_cluster(config: AllreduceConfig,
             "(output != N x input or counts != N)")
     if rounds < 0:
         raise ValueError(f"native cluster: bad configuration ({rounds})")
+    if with_round_times:
+        return (int(rounds), int(flushed.value),
+                [times[i] for i in range(min(int(rounds), cap))])
     return int(rounds), int(flushed.value)

@@ -44,7 +44,9 @@ def native_once(workers, data_size, max_chunk_size, max_lag, max_round,
                 th=(1.0, 1.0, 1.0)):
     """One full-scale native run (tiny warm run first so .so build/load
     stays out of the timing; no full-scale warm pass — at these
-    footprints one run IS the budget)."""
+    footprints one run IS the budget). Returns the mean rate plus the
+    per-round spread (median / IQR of per-round wall times from the
+    engine's own monotonic round stamps)."""
     from akka_allreduce_tpu.config import (AllreduceConfig, DataConfig,
                                            ThresholdConfig, WorkerConfig)
     from akka_allreduce_tpu.protocol.native_cluster import \
@@ -62,34 +64,56 @@ def native_once(workers, data_size, max_chunk_size, max_lag, max_round,
                         max_round=max_round),
         workers=WorkerConfig(total_size=workers, max_lag=max_lag))
     t0 = time.perf_counter()
-    rounds, flushed = run_native_cluster(config)
+    rounds, flushed, stamps = run_native_cluster(config,
+                                                 with_round_times=True)
     dt = time.perf_counter() - t0
-    return rounds / dt, rounds, flushed, dt
+    # per-round wall deltas over rounds 1..N-1 (stamp diffs exclude
+    # round 0 AND the pre-round-0 buffer allocation by construction,
+    # so every quoted delta — including the max — is steady state)
+    deltas = [b - a for a, b in zip(stamps, stamps[1:])]
+    return rps_stats(rounds / dt, rounds, flushed, dt, deltas)
 
 
-def config3():
+def rps_stats(rps, rounds, flushed, dt, deltas):
+    import statistics as st
+
+    if len(deltas) >= 4:
+        med = st.median(deltas)
+        q = st.quantiles(deltas, n=4)
+        spread = (f"per-round median {med:.2f}s (IQR {q[0]:.2f}-"
+                  f"{q[2]:.2f}s, min {min(deltas):.2f} max "
+                  f"{max(deltas):.2f} over {len(deltas)} steady rounds)"
+                  f", median rate {1 / med:.3f} rounds/s")
+    else:
+        spread = f"(too few rounds for spread: {len(deltas)} deltas)"
+    return rps, rounds, flushed, dt, spread
+
+
+def config3(rounds=24):
     workers, elems = 64, 25_000_000
-    rps, rounds, flushed, dt = native_once(
-        workers, elems, max_chunk_size=65_536, max_lag=1, max_round=8)
+    rps, rounds, flushed, dt, spread = native_once(
+        workers, elems, max_chunk_size=65_536, max_lag=1,
+        max_round=rounds)
     payload = elems * 4 / 1e6
     emit("config3_25M_f32_64w_native", rps, "rounds/s",
          f"CANONICAL scale (BASELINE.md config 3): 64 workers x 25M f32 "
          f"({payload:.0f} MB payload/round), maxChunkSize 65536 "
          f"(6 chunks/block), maxLag=1, {rounds} rounds in {dt:.1f}s, "
-         f"{flushed} flushes; native C++ engine, single machine "
-         f"(1 core), ~40 GB buffer footprint")
+         f"{flushed} flushes; {spread}; native C++ engine, single "
+         f"machine (1 core), ~40 GB buffer footprint")
 
 
-def config5():
+def config5(rounds=20):
     workers, elems = 256, BERT_LARGE_BUCKET_ELEMS
-    rps, rounds, flushed, dt = native_once(
-        workers, elems, max_chunk_size=16_384, max_lag=4, max_round=6)
+    rps, rounds, flushed, dt, spread = native_once(
+        workers, elems, max_chunk_size=16_384, max_lag=4,
+        max_round=rounds)
     emit("config5_bertlarge_bucket_256w_native", rps, "rounds/s",
          f"CANONICAL scale (BASELINE.md config 5): 256 workers x "
          f"{elems} f32 (16 MiB BERT-large gradient bucket/round), "
          f"maxLag=4 streaming, maxChunkSize 16384, {rounds} rounds in "
-         f"{dt:.1f}s, {flushed} flushes; native C++ engine, single "
-         f"machine (1 core), ~50 GB buffer footprint")
+         f"{dt:.1f}s, {flushed} flushes; {spread}; native C++ engine, "
+         f"single machine (1 core), ~50 GB buffer footprint")
 
 
 def dryrun_sweep(sizes=(16, 32)):

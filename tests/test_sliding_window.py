@@ -218,8 +218,9 @@ class TestWindowedSP:
         return run(q, k, v)
 
     @pytest.mark.parametrize("window", [
-        1,
-        pytest.param(5, marks=pytest.mark.slow),
+        5,  # the fast pin must FEED the neighbor-tail exchange: window=1
+        #     is self-attention only and passes under a broken ppermute
+        pytest.param(1, marks=pytest.mark.slow),
         pytest.param(16, marks=pytest.mark.slow),
         pytest.param(17, marks=pytest.mark.slow)])
     def test_forward_matches_windowed_oracle(self, mesh, window):
