@@ -138,6 +138,11 @@ def _add_master(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--th-reduce", type=float, default=1.0)
     p.add_argument("--th-complete", type=float, default=0.8)
     p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--native", action="store_true",
+                   help="run the C++ master engine (native/src/"
+                        "remote_master.cpp): same wire, so Python and "
+                        "native workers join it interchangeably. "
+                        "--trace-file is a Python-engine feature")
     _add_liveness_flags(p)
 
 
@@ -156,7 +161,8 @@ def _add_liveness_flags(p: argparse.ArgumentParser) -> None:
 def _cmd_master(args: argparse.Namespace) -> int:
     from akka_allreduce_tpu.config import (AllreduceConfig, DataConfig,
                                            ThresholdConfig, WorkerConfig)
-    from akka_allreduce_tpu.protocol.remote import run_master
+    from akka_allreduce_tpu.protocol.remote import (run_master,
+                                                    run_master_native)
 
     data_size = args.workers * 5 if args.data_size is None else args.data_size
     config = AllreduceConfig(
@@ -167,11 +173,22 @@ def _cmd_master(args: argparse.Namespace) -> int:
                         max_round=args.max_round),
         workers=WorkerConfig(total_size=args.workers, max_lag=args.max_lag),
     )
-    rounds = run_master(config, bind_host=args.bind_host, port=args.port,
-                        timeout_s=args.timeout,
-                        heartbeat_interval_s=args.heartbeat_interval,
-                        unreachable_after_s=args.unreachable_after or None,
-                        trace_file=args.trace_file)
+    if args.native:
+        if args.trace_file:
+            print("warning: --trace-file is a Python-engine feature; "
+                  "the native master writes no trace", file=sys.stderr)
+        rounds = run_master_native(
+            config, bind_host=args.bind_host, port=args.port,
+            timeout_s=args.timeout,
+            heartbeat_interval_s=args.heartbeat_interval,
+            unreachable_after_s=args.unreachable_after or None)
+    else:
+        rounds = run_master(
+            config, bind_host=args.bind_host, port=args.port,
+            timeout_s=args.timeout,
+            heartbeat_interval_s=args.heartbeat_interval,
+            unreachable_after_s=args.unreachable_after or None,
+            trace_file=args.trace_file)
     return 0 if rounds == args.max_round else 1
 
 

@@ -17,7 +17,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_lib", "libaatpu.so")
 _SRCS = [os.path.join(_DIR, "src", f)
          for f in ("transport.cpp", "cluster.cpp", "remote_worker.cpp",
-                   "ring.h")]
+                   "remote_master.cpp", "ring.h", "wire_codec.h")]
 
 _lib: ctypes.CDLL | None = None
 
@@ -103,6 +103,13 @@ def load_library() -> ctypes.CDLL:
     lib.aat_remote_worker_run.restype = ctypes.c_long
     lib.aat_remote_worker_run.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int]
+
+    lib.aat_remote_master_run.restype = ctypes.c_long
+    lib.aat_remote_master_run.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_uint, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_uint, ctypes.c_double, ctypes.c_double,
+        ctypes.c_double, ctypes.c_int64, ctypes.c_double,
         ctypes.c_double, ctypes.c_double, ctypes.c_int]
 
     _lib = lib

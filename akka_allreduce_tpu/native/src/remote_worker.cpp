@@ -47,6 +47,7 @@
 #include <vector>
 
 #include "ring.h"
+#include "wire_codec.h"
 
 extern "C" {
 void* aat_create(const char* bind_host, int port);
@@ -63,110 +64,22 @@ void aat_destroy(void* tp);
 
 namespace {
 
+using aat::Addr;
 using aat::Ring;
-
-// ---- wire codec (must match protocol/wire.py byte-for-byte) -------------
-
-enum MsgType : uint8_t {
-    kHello = 0, kInit = 1, kStart = 2, kScatter = 3, kReduce = 4,
-    kComplete = 5, kPing = 6,
-};
-
-struct Addr {
-    std::string host;
-    uint32_t port = 0;
-    bool operator==(const Addr& o) const {
-        return port == o.port && host == o.host;
-    }
-    bool operator<(const Addr& o) const {
-        return host < o.host || (host == o.host && port < o.port);
-    }
-};
-
-// little-endian unaligned field readers/writers
-template <typename T>
-bool rd(const uint8_t* buf, size_t len, size_t& off, T* out) {
-    if (off + sizeof(T) > len) return false;
-    std::memcpy(out, buf + off, sizeof(T));
-    off += sizeof(T);
-    return true;
-}
-template <typename T>
-void wr(std::vector<uint8_t>& out, T v) {
-    size_t n = out.size();
-    out.resize(n + sizeof(T));
-    std::memcpy(out.data() + n, &v, sizeof(T));
-}
-
-bool rd_addr(const uint8_t* buf, size_t len, size_t& off, Addr* a) {
-    uint16_t hlen;
-    if (!rd(buf, len, off, &hlen)) return false;
-    if (off + hlen > len) return false;
-    a->host.assign(reinterpret_cast<const char*>(buf) + off, hlen);
-    off += hlen;
-    return rd(buf, len, off, &a->port);
-}
-void wr_addr(std::vector<uint8_t>& out, const Addr& a) {
-    wr<uint16_t>(out, static_cast<uint16_t>(a.host.size()));
-    out.insert(out.end(), a.host.begin(), a.host.end());
-    wr<uint32_t>(out, a.port);
-}
-
-std::vector<uint8_t> enc_hello(const Addr& self, const char* role) {
-    std::vector<uint8_t> out;
-    wr<uint8_t>(out, kHello);
-    wr_addr(out, self);
-    size_t rlen = std::strlen(role);
-    wr<uint8_t>(out, static_cast<uint8_t>(rlen));
-    out.insert(out.end(), role, role + rlen);
-    return out;
-}
-std::vector<uint8_t> enc_ping(double interval) {
-    std::vector<uint8_t> out;
-    wr<uint8_t>(out, kPing);
-    wr<double>(out, interval);
-    return out;
-}
-std::vector<uint8_t> enc_scatter(int src, int dest, int chunk,
-                                 int64_t round, const float* data,
-                                 size_t n) {
-    std::vector<uint8_t> out;
-    out.reserve(1 + 4 * 3 + 8 * 2 + n * 4);
-    wr<uint8_t>(out, kScatter);
-    wr<int32_t>(out, src);
-    wr<int32_t>(out, dest);
-    wr<int32_t>(out, chunk);
-    wr<int64_t>(out, round);
-    wr<uint64_t>(out, n * 4);
-    size_t at = out.size();
-    out.resize(at + n * 4);
-    std::memcpy(out.data() + at, data, n * 4);
-    return out;
-}
-std::vector<uint8_t> enc_reduce(int src, int dest, int chunk,
-                                int64_t round, int64_t count,
-                                const float* data, size_t n) {
-    std::vector<uint8_t> out;
-    out.reserve(1 + 4 * 3 + 8 * 3 + n * 4);
-    wr<uint8_t>(out, kReduce);
-    wr<int32_t>(out, src);
-    wr<int32_t>(out, dest);
-    wr<int32_t>(out, chunk);
-    wr<int64_t>(out, round);
-    wr<int64_t>(out, count);
-    wr<uint64_t>(out, n * 4);
-    size_t at = out.size();
-    out.resize(at + n * 4);
-    std::memcpy(out.data() + at, data, n * 4);
-    return out;
-}
-std::vector<uint8_t> enc_complete(int src, int64_t round) {
-    std::vector<uint8_t> out;
-    wr<uint8_t>(out, kComplete);
-    wr<int32_t>(out, src);
-    wr<int64_t>(out, round);
-    return out;
-}
+using aat::enc_complete;
+using aat::enc_hello;
+using aat::enc_ping;
+using aat::enc_reduce;
+using aat::enc_scatter;
+using aat::kComplete;
+using aat::kHello;
+using aat::kInit;
+using aat::kPing;
+using aat::kReduce;
+using aat::kScatter;
+using aat::kStart;
+using aat::rd;
+using aat::rd_addr;
 
 // decoded protocol message (scatter/reduce/start only — the self queue)
 struct PMsg {

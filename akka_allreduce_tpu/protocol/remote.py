@@ -155,6 +155,33 @@ def run_worker_native(master_host: str = "127.0.0.1",
     return int(rc)
 
 
+def run_master_native(config: AllreduceConfig,
+                      bind_host: str = "127.0.0.1", port: int = 2551,
+                      timeout_s: float = 120.0,
+                      heartbeat_interval_s: float = 2.0,
+                      unreachable_after_s: Optional[float] = 10.0) -> int:
+    """The C++ master engine (native/src/remote_master.cpp): membership,
+    rank seats (with reuse on rejoin), InitWorkers, thAllreduce round
+    pacing, and a fixed-window silent-peer detector — same wire as
+    :func:`run_master`, so Python and native workers join it
+    interchangeably. Returns rounds completed."""
+    from akka_allreduce_tpu.native import load_library
+
+    lib = load_library()
+    rounds = lib.aat_remote_master_run(
+        bind_host.encode(), port, config.workers.total_size,
+        config.data.data_size, config.data.max_chunk_size,
+        config.workers.max_lag, config.thresholds.th_reduce,
+        config.thresholds.th_complete, config.thresholds.th_allreduce,
+        config.data.max_round, timeout_s, heartbeat_interval_s,
+        0.0 if unreachable_after_s is None else unreachable_after_s, 0)
+    if rounds == -3:
+        raise OSError(f"native master: cannot bind {bind_host}:{port}")
+    if rounds < 0:
+        raise ValueError(f"native master: bad configuration ({rounds})")
+    return int(rounds)
+
+
 def free_port(bind_host: str = "127.0.0.1") -> int:
     """Pick an ephemeral port (test convenience; races are acceptable on
     localhost)."""

@@ -11,9 +11,10 @@ across process boundaries.
 
 Usage: python scripts/smoke_cluster.py [maxRound=40] [--native]
 
-``--native`` runs the four workers on the C++ engine
-(native/src/remote_worker.cpp) over the same wire — the reference's
-JVM-native worker deployment, here all-native end to end.
+``--native`` swaps EVERY process to the C++ engines — the four workers
+(native/src/remote_worker.cpp) AND the master
+(native/src/remote_master.cpp) — over the same wire: the reference's
+JVM-native cluster deployment, here all-native end to end.
 """
 
 import os
@@ -32,9 +33,12 @@ def main() -> int:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
-    master = subprocess.Popen(
-        [sys.executable, os.path.join(SCRIPTS, "test_allreduce_master.py"),
-         max_round], env=env)
+    master_cmd = [sys.executable,
+                  os.path.join(SCRIPTS, "test_allreduce_master.py"),
+                  max_round]
+    if native:
+        master_cmd.append("--native")
+    master = subprocess.Popen(master_cmd, env=env)
     time.sleep(1.0)  # let the listener bind before workers dial in
     worker_cmd = [sys.executable,
                   os.path.join(SCRIPTS, "test_allreduce_worker.py")]

@@ -19,10 +19,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from akka_allreduce_tpu.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    max_round = sys.argv[1] if len(sys.argv) > 1 else "100"
+    native = "--native" in sys.argv[1:]
+    argv = [a for a in sys.argv[1:] if a != "--native"]
+    max_round = argv[0] if argv else "100"
     sys.exit(main([
         "master", "--port", "2551", "--workers", "4",
         "--data-size", "778", "--max-chunk-size", "3", "--max-lag", "3",
         "--th-allreduce", "1.0", "--th-reduce", "1.0",
         "--th-complete", "1.0", "--max-round", max_round,
+        *(["--native"] if native else []),
     ]))

@@ -28,32 +28,35 @@ from akka_allreduce_tpu.protocol.remote import free_port
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn_master(port, rounds, workers=4):
-    return subprocess.Popen(
-        [sys.executable, "-m", "akka_allreduce_tpu.cli", "master",
-         "--port", str(port), "--workers", str(workers),
-         "--data-size", "778", "--max-chunk-size", "3",
-         "--max-lag", "3", "--th-allreduce", "1.0", "--th-reduce", "1.0",
-         "--th-complete", "1.0", "--max-round", str(rounds)],
-        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True)
-
-
-def _spawn_worker(port, native):
-    cmd = [sys.executable, "-m", "akka_allreduce_tpu.cli", "worker",
-           "--master-port", str(port), "--data-size", "778",
-           "--checkpoint", "10", "--assert-multiple", "4"]
+def _spawn_master(port, rounds, workers=4, native=False):
+    cmd = [sys.executable, "-m", "akka_allreduce_tpu.cli", "master",
+           "--port", str(port), "--workers", str(workers),
+           "--data-size", "778", "--max-chunk-size", "3",
+           "--max-lag", "3", "--th-allreduce", "1.0", "--th-reduce", "1.0",
+           "--th-complete", "1.0", "--max-round", str(rounds)]
     if native:
         cmd.append("--native")
     return subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
 
 
-def _run_cluster(natives, rounds=12):
+def _spawn_worker(port, native, n_workers=4):
+    cmd = [sys.executable, "-m", "akka_allreduce_tpu.cli", "worker",
+           "--master-port", str(port), "--data-size", "778",
+           "--checkpoint", "10", "--assert-multiple", str(n_workers)]
+    if native:
+        cmd.append("--native")
+    return subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _run_cluster(natives, rounds=12, master_native=False):
     port = free_port()
-    master = _spawn_master(port, rounds, workers=len(natives))
+    master = _spawn_master(port, rounds, workers=len(natives),
+                           native=master_native)
     time.sleep(1.0)
-    workers = [_spawn_worker(port, nat) for nat in natives]
+    workers = [_spawn_worker(port, nat, n_workers=len(natives))
+               for nat in natives]
     procs = [master] + workers
     outs = []
     try:
@@ -85,3 +88,14 @@ class TestNativeRemoteWorker:
         exact-equality sink passes on outputs both engines contributed
         to — wire compatibility AND bit-identical reduction."""
         _run_cluster([True, False, True, False])
+
+    def test_all_native_cluster_including_master(self):
+        """The reference's deployment shape end to end: five OS
+        processes — native master (remote_master.cpp) + four native
+        workers — nothing but C++ engines on the wire."""
+        _run_cluster([True, True, True, True], master_native=True)
+
+    def test_native_master_serves_python_workers(self):
+        """The native master's membership/init/pacing against the
+        PYTHON worker engine: same wire both directions."""
+        _run_cluster([False, False], master_native=True)
