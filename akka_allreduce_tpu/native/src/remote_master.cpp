@@ -142,14 +142,17 @@ struct RemoteMaster {
     }
 
     void seat_down(int conn) {
+        // dispatch() stamps last_heard for ANY conn that sent a frame
+        // (rejected joiners included): sweep those maps even for
+        // unseated conns, or worker churn leaks entries forever
+        last_heard.erase(conn);
+        peer_interval.erase(conn);
         auto it = rank_of_conn.find(conn);
         if (it == rank_of_conn.end()) return;
         int rank = it->second;
         rank_of_conn.erase(it);
         conn_of_rank.erase(rank);
         workers.erase(rank);
-        last_heard.erase(conn);
-        peer_interval.erase(conn);
         std::printf("master: worker down at round %ld\n",
                     rounds_completed);
         std::fflush(stdout);

@@ -86,7 +86,18 @@ class TcpRouter:
         self._hb_interval = heartbeat_interval_s
         self._unreachable_after = unreachable_after_s
         self._last_ping_sent = 0.0
-        self._last_heard: dict[int, float] = {}
+        # Liveness is tracked PER ADDRESS, not per connection: when two
+        # peers dial each other simultaneously (certain at round 0 —
+        # every worker scatters at once) the pair carries TWO TCP
+        # connections, each side sending on the one it dialed and
+        # receiving on the inbound one. A per-connection tracker then
+        # watches the dialed conn — which never receives a frame — and
+        # falsely downs every such peer exactly one unreachable window
+        # after the first exchange, dismembering a healthy cluster (the
+        # SIGSTOP cluster test caught this as a stall: all three
+        # survivors downed each other in one sweep). Any frame from any
+        # conn mapped to the addr proves the PEER alive.
+        self._last_heard: dict[wire.Addr, float] = {}
         # optional runtime/tracing.Tracer: liveness events (peer downs,
         # disconnects) join the same structured stream the engines write
         self.tracer = tracer
@@ -94,7 +105,7 @@ class TcpRouter:
         # down check widens its window to 2x this for slow-pinging peers,
         # so asymmetric intervals can't produce false downs — the local
         # 2x-interval ctor guard only covers symmetric deployments
-        self._peer_interval: dict[int, float] = {}
+        self._peer_interval: dict[wire.Addr, float] = {}
 
         self._local: dict[ActorRef, Callable] = {}
         self._primary: Optional[ActorRef] = None
@@ -203,16 +214,16 @@ class TcpRouter:
         ping = wire.encode(wire.Ping(self._hb_interval), self._addr_for)
         buf = (ctypes.c_uint8 * len(ping)).from_buffer_copy(ping)
         for addr, conn in list(self._conn_of.items()):
-            heard = self._last_heard.get(conn)
+            heard = self._last_heard.get(addr)
             if heard is None:
-                self._last_heard[conn] = now
+                self._last_heard[addr] = now
             elif self._unreachable_after is not None:
                 # a slow-pinging (but alive) peer legitimately goes quiet
                 # for its whole interval: never down inside 2x its cadence
                 # — but cap the widening at 5x the local window, so one
                 # misconfigured peer advertising a huge interval cannot
                 # opt itself out of failure detection entirely
-                widened = min(2 * self._peer_interval.get(conn, 0.0),
+                widened = min(2 * self._peer_interval.get(addr, 0.0),
                               5 * self._unreachable_after)
                 window = max(self._unreachable_after, widened)
                 if now - heard > window:
@@ -224,17 +235,20 @@ class TcpRouter:
                                            host=addr[0], port=addr[1],
                                            silent_s=round(now - heard, 3),
                                            window_s=round(window, 3))
-                    self._down_conn(conn, addr)
+                    self._down_addr(addr)
                     continue
             self._lib.aat_send(self._t, conn, buf, len(ping))
 
-    def _down_conn(self, conn: int, addr: wire.Addr) -> None:
-        self._lib.aat_close_peer(self._t, conn)
-        self._last_heard.pop(conn, None)
-        self._peer_interval.pop(conn, None)
-        self._addr_of_conn.pop(conn, None)
-        if self._conn_of.get(addr) == conn:
-            del self._conn_of[addr]
+    def _down_addr(self, addr: wire.Addr) -> None:
+        """Down a PEER: close every connection mapped to its address (a
+        mutually-dialed pair carries two) and fire deathwatch once."""
+        for conn, a in list(self._addr_of_conn.items()):
+            if a == addr:
+                self._lib.aat_close_peer(self._t, conn)
+                self._addr_of_conn.pop(conn, None)
+        self._last_heard.pop(addr, None)
+        self._peer_interval.pop(addr, None)
+        self._conn_of.pop(addr, None)
         if self.on_terminated is not None and addr in self._refs:
             self.on_terminated(self._refs[addr])
 
@@ -276,16 +290,21 @@ class TcpRouter:
                 log.exception("dropping undecodable frame from conn %d",
                               src.value)
                 continue
-            # any frame proves the peer alive for the failure detector
-            self._last_heard[src.value] = time.monotonic()
+            if isinstance(msg, wire.Hello):
+                self._handle_hello(msg, src.value)
+            # any frame proves the PEER alive for the failure detector —
+            # keyed by address so it counts whichever of a duplicated
+            # pair's connections the peer actually writes on (the Hello
+            # above maps the conn before the lookup)
+            addr = self._addr_of_conn.get(src.value)
+            if addr is not None:
+                self._last_heard[addr] = time.monotonic()
             if isinstance(msg, wire.Ping):
                 # heartbeat only — never delivered to engines; remember
                 # the sender's cadence for the adaptive down window
-                if msg.interval > 0:
-                    self._peer_interval[src.value] = msg.interval
-            elif isinstance(msg, wire.Hello):
-                self._handle_hello(msg, src.value)
-            else:
+                if msg.interval > 0 and addr is not None:
+                    self._peer_interval[addr] = msg.interval
+            elif not isinstance(msg, wire.Hello):
                 if self._primary is not None:
                     self._local[self._primary](msg)
             n += 1
@@ -305,13 +324,21 @@ class TcpRouter:
             conn = self._lib.aat_poll_disconnect(self._t)
             if conn < 0:
                 return
-            self._last_heard.pop(conn, None)
-            self._peer_interval.pop(conn, None)
             addr = self._addr_of_conn.pop(conn, None)
             if addr is None:
                 continue
             if self._conn_of.get(addr) == conn:
                 del self._conn_of[addr]
+            # a mutually-dialed pair carries two connections: losing ONE
+            # is not peer death. Remap sends to a survivor if any —
+            # deathwatch fires only when the LAST conn for the addr drops
+            survivors = [c for c, a in self._addr_of_conn.items()
+                         if a == addr]
+            if survivors:
+                self._conn_of.setdefault(addr, survivors[0])
+                continue
+            self._last_heard.pop(addr, None)
+            self._peer_interval.pop(addr, None)
             if self.tracer is not None:
                 self.tracer.record("peer_disconnect",
                                    host=addr[0], port=addr[1])

@@ -127,6 +127,58 @@ class TestHeartbeatDetector:
 
 @pytest.mark.slow
 @pytest.mark.xdist_group("cluster-procs")
+class TestMutualDialLiveness:
+    """A mutually-dialed pair carries TWO TCP connections (each side
+    sends on the one it dialed, receives on the inbound one) — the
+    round-0 scatter burst makes this the NORMAL worker-worker topology.
+    Liveness must be per-PEER, not per-connection: a per-conn tracker
+    watches the never-written dialed conn and falsely downs every such
+    peer one unreachable window after the first exchange (caught as the
+    whole-cluster stall in the SIGSTOP test below: all three survivors
+    downed each other in a single detector sweep)."""
+
+    def test_mutually_dialed_pair_survives_a_quiet_stretch(self):
+        downs = []
+        a = TcpRouter(role="a", heartbeat_interval_s=0.2,
+                      unreachable_after_s=0.6,
+                      on_terminated=lambda ref: downs.append(("a", ref)))
+        b = TcpRouter(role="b", heartbeat_interval_s=0.2,
+                      unreachable_after_s=0.6,
+                      on_terminated=lambda ref: downs.append(("b", ref)))
+        try:
+            a.dial(b.addr)
+            b.dial(a.addr)  # duplicate pair: 2 conns, asymmetric writes
+            deadline = time.monotonic() + 2.0  # >3 unreachable windows
+            while time.monotonic() < deadline:
+                a.poll(0.01)
+                b.poll(0.01)
+            assert downs == [], downs  # pings alone must keep the pair up
+        finally:
+            a.close()
+            b.close()
+
+    def test_dead_peer_with_duplicate_conns_is_downed_once(self):
+        downs = []
+        a = TcpRouter(role="a", heartbeat_interval_s=0.2,
+                      unreachable_after_s=0.6,
+                      on_terminated=downs.append)
+        b = TcpRouter(role="b", heartbeat_interval_s=0.2,
+                      unreachable_after_s=0.6)
+        a.dial(b.addr)
+        b.dial(a.addr)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 0.5:
+            a.poll(0.01)
+            b.poll(0.01)
+        b.close()  # real death: BOTH of the pair's conns drop
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not downs:
+            a.poll(0.01)
+        a.close()
+        # exactly one deathwatch fire for the peer, not one per conn
+        assert [d.addr for d in downs] == [tuple(b.addr)], downs
+
+
 class TestSigstopCluster:
     def test_lossy_cluster_survives_sigstopped_worker(self):
         """4 workers, thresholds 0.75, one worker SIGSTOPped mid-run: all
