@@ -109,6 +109,27 @@ struct RemoteMaster {
     // -- membership (protocol/master.py member_up / terminated) ------------
 
     void member_up(const Addr& a, int conn) {
+        // idempotent by address: workers RE-Hello until inited (their
+        // cold-start self-healing — a first Hello lost in the join
+        // burst must not strand them), and a repeat must refresh, not
+        // burn a second seat
+        for (const auto& [r, seated] : workers) {
+            if (seated == a) {
+                auto prev = conn_of_rank.find(r);
+                if (prev != conn_of_rank.end() && prev->second != conn)
+                    // same-addr refresh on a NEW conn: unmap the old
+                    // one, or its later disconnect unseats the live
+                    // worker we just re-registered
+                    rank_of_conn.erase(prev->second);
+                conn_of_rank[r] = conn;
+                rank_of_conn[conn] = r;
+                if (round >= 0) {
+                    init_workers(round);
+                    send_rank(r, enc_start(round));
+                }
+                return;
+            }
+        }
         int free_seat = -1;
         for (int r = 0; r < (int)cfg.worker_num; ++r)
             if (!workers.count(r)) { free_seat = r; break; }
@@ -255,7 +276,12 @@ struct RemoteMaster {
         double deadline = now_s() + timeout_s;
         while (rounds_completed < max_round && now_s() < deadline) {
             bool any = false;
-            for (;;) {
+            // BOUNDED drain: under load the transport thread refills
+            // the queue faster than the engine empties it, so an
+            // until-empty loop starves the disconnect sweep and the
+            // heartbeat below indefinitely — a killed worker's seat
+            // then never frees and this master never pings
+            for (int burst = 0; burst < 512; ++burst) {
                 int64_t need = aat_recv_len(tp);
                 if (need < 0) break;
                 if ((size_t)need > buf.size()) buf.resize(need * 2);

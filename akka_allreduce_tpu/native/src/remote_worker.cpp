@@ -577,6 +577,17 @@ struct RemoteWorker {
         auto ping = enc_ping(hb_interval);
         for (auto& [a, c] : conn_of)
             aat_send(tp, c, ping.data(), ping.size());
+        if (id == -1) {
+            // cold-start self-healing: until InitWorkers arrives, keep
+            // re-greeting the master (idempotent there) — a Hello lost
+            // in the simultaneous join burst must not strand this
+            // worker waiting forever
+            auto it = conn_of.find(master_addr);
+            if (it != conn_of.end()) {
+                auto hello = enc_hello(self, "worker");
+                aat_send(tp, it->second, hello.data(), hello.size());
+            }
+        }
     }
 
     long run(const char* master_host, int master_port, double timeout_s) {
@@ -608,7 +619,12 @@ struct RemoteWorker {
         while (!master_gone && !failed && now_s() < deadline) {
             drain_self_q();
             bool any = false;
-            for (;;) {
+            // BOUNDED drain (see remote_master.cpp): an until-empty
+            // loop under sustained traffic starves the disconnect
+            // sweep and the outbound heartbeat — the master's failure
+            // detector would then falsely down a flooded-but-healthy
+            // worker, and a dead master would go unnoticed
+            for (int burst = 0; burst < 512; ++burst) {
                 int64_t need = aat_recv_len(tp);
                 if (need < 0) break;
                 if ((size_t)need > buf.size()) buf.resize(need * 2);

@@ -113,6 +113,13 @@ class TcpRouter:
         self._refs: dict[wire.Addr, RemoteRef] = {}
         self._conn_of: dict[wire.Addr, int] = {}
         self._addr_of_conn: dict[int, wire.Addr] = {}
+        # addrs whose Hello already fired on_member: Akka fires MemberUp
+        # once per member, and native workers RE-Hello until initialized
+        # (cold-start self-healing) — repeats must not re-announce a
+        # live member. Cleared on termination so a REJOINER announces
+        # again (and so a genuinely-lost first Hello still fires on the
+        # retry: a lost frame never entered this set).
+        self._greeted: set[wire.Addr] = set()
         self._recv_buf = (ctypes.c_uint8 * (1 << 20))()
 
     # -- Router surface (what the engines call) -----------------------------
@@ -249,6 +256,7 @@ class TcpRouter:
         self._last_heard.pop(addr, None)
         self._peer_interval.pop(addr, None)
         self._conn_of.pop(addr, None)
+        self._greeted.discard(addr)
         if self.on_terminated is not None and addr in self._refs:
             self.on_terminated(self._refs[addr])
 
@@ -316,6 +324,9 @@ class TcpRouter:
         # inbound one is bidirectional TCP — reply on it.
         self._conn_of.setdefault(addr, conn)
         ref = self.ref_of(addr)  # intern now so deathwatch can resolve it
+        if addr in self._greeted:
+            return  # repeat greeting from a live member (see ctor note)
+        self._greeted.add(addr)
         if self.on_member is not None and isinstance(ref, RemoteRef):
             self.on_member(ref, hello.role)
 
@@ -330,15 +341,20 @@ class TcpRouter:
             if self._conn_of.get(addr) == conn:
                 del self._conn_of[addr]
             # a mutually-dialed pair carries two connections: losing ONE
-            # is not peer death. Remap sends to a survivor if any —
-            # deathwatch fires only when the LAST conn for the addr drops
+            # is not peer death. Suppress deathwatch only when an OLDER
+            # conn survives (conn ids are monotonic): the pair's conns
+            # predate each other's drops, while a same-addr RESTART's
+            # fresh conn is NEWER than the dying one — suppressing on it
+            # would leave the engine trusting a state-less new process
+            # as the old live member
             survivors = [c for c, a in self._addr_of_conn.items()
-                         if a == addr]
+                         if a == addr and c < conn]
             if survivors:
                 self._conn_of.setdefault(addr, survivors[0])
                 continue
             self._last_heard.pop(addr, None)
             self._peer_interval.pop(addr, None)
+            self._greeted.discard(addr)
             if self.tracer is not None:
                 self.tracer.record("peer_disconnect",
                                    host=addr[0], port=addr[1])

@@ -127,6 +127,8 @@ class TestHeartbeatDetector:
 
 @pytest.mark.slow
 @pytest.mark.xdist_group("cluster-procs")
+@pytest.mark.slow
+@pytest.mark.xdist_group("cluster-procs")
 class TestMutualDialLiveness:
     """A mutually-dialed pair carries TWO TCP connections (each side
     sends on the one it dialed, receives on the inbound one) — the
@@ -179,6 +181,8 @@ class TestMutualDialLiveness:
         assert [d.addr for d in downs] == [tuple(b.addr)], downs
 
 
+@pytest.mark.slow
+@pytest.mark.xdist_group("cluster-procs")
 class TestSigstopCluster:
     def test_lossy_cluster_survives_sigstopped_worker(self):
         """4 workers, thresholds 0.75, one worker SIGSTOPped mid-run: all

@@ -17,8 +17,10 @@ AllreduceWorker.scala:303-346, application.conf:5-11). Two pins:
 """
 
 import os
+import re
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
@@ -99,3 +101,71 @@ class TestNativeRemoteWorker:
         """The native master's membership/init/pacing against the
         PYTHON worker engine: same wire both directions."""
         _run_cluster([False, False], master_native=True)
+
+    def test_native_master_survives_kill_and_rejoin(self):
+        """The native master's deathwatch + seat-reuse rejoin
+        (remote_master.cpp mirroring protocol/master.py member_up):
+        SIGKILL a worker mid-run in a lossy (th=0.75) cluster — rounds
+        must keep completing without it — then start a replacement,
+        which must take the freed seat, get a full init at the CURRENT
+        round, and serve the rest of the run."""
+        port = free_port()
+        rounds = 400_000  # unbounded: the master runs out its clock
+        master = subprocess.Popen(
+            [sys.executable, "-u", "-m", "akka_allreduce_tpu.cli",
+             "master", "--port", str(port), "--workers", "4",
+             "--data-size", "1024", "--max-chunk-size", "128",
+             "--max-lag", "2", "--th-allreduce", "0.75",
+             "--th-reduce", "0.75", "--th-complete", "0.75",
+             "--max-round", str(rounds), "--timeout", "25", "--native"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        time.sleep(0.8)
+
+        def native_worker():
+            return subprocess.Popen(
+                [sys.executable, "-m", "akka_allreduce_tpu.cli",
+                 "worker", "--master-port", str(port), "--timeout", "30",
+                 "--native"],
+                cwd=REPO, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+
+        workers = [native_worker() for _ in range(4)]
+        lines: list[str] = []
+        state = {"killed": False, "rejoiner": None}
+
+        def pump():
+            # event-driven choreography off the master's narration: kill
+            # only once the cluster demonstrably runs (quorum formed),
+            # spawn the replacement only once the death was detected
+            for line in master.stdout:
+                lines.append(line.rstrip())
+                if "up, 4/4" in line and not state["killed"]:
+                    state["killed"] = True
+                    workers[1].kill()  # real death: socket closes
+                if "worker down at round" in line \
+                        and state["rejoiner"] is None:
+                    state["rejoiner"] = native_worker()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            master.wait(timeout=60)
+            t.join(timeout=10)
+        finally:
+            if state["rejoiner"] is not None:
+                workers.append(state["rejoiner"])
+            for w in workers:
+                if w.poll() is None:
+                    w.kill()
+            if master.poll() is None:
+                master.kill()
+        m_out = "\n".join(lines)
+        assert state["killed"], m_out
+        assert "worker down at round" in m_out, m_out
+        assert "worker rejoined as rank" in m_out, m_out
+        down_at = int(re.search(r"worker down at round (\d+)",
+                                m_out).group(1))
+        final = int(re.search(r"(\d+)/\d+ rounds", m_out).group(1))
+        # the cluster ran through the death AND past the rejoin
+        assert final > down_at, (down_at, final, m_out)
