@@ -17,7 +17,8 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_lib", "libaatpu.so")
 _SRCS = [os.path.join(_DIR, "src", f)
          for f in ("transport.cpp", "cluster.cpp", "remote_worker.cpp",
-                   "remote_master.cpp", "ring.h", "wire_codec.h")]
+                   "remote_master.cpp", "ring.h", "wire_codec.h",
+                   "worker_core.h")]
 
 _lib: ctypes.CDLL | None = None
 

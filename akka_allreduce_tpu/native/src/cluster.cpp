@@ -35,6 +35,7 @@
 #include <vector>
 
 #include "ring.h"
+#include "worker_core.h"
 
 namespace {
 
@@ -52,39 +53,29 @@ struct Msg {
 
 struct Cluster;
 
+// In-process Env for the shared worker state machine (worker_core.h):
+// sends become FIFO-queue messages, deferred messages re-enter the
+// queue behind a self Start, and the sink is the reference's benchmark
+// assertion (output == N x input, counts == N).
 struct Worker {
     Cluster* cl = nullptr;
-    int id = -1;
-    int peer_num = 0;
-    double th_reduce = 1.0, th_complete = 1.0;
-    int max_lag = 0;
-    int round = -1, max_round = -1, max_scattered = -1;
-    std::set<int> completed;
-
-    long data_size = 0;
-    int max_chunk = 1024;
-    std::vector<std::pair<long, long>> ranges;
-    long my_block = 0, max_block = 0;
-
-    Ring scatter_buf;   // my block: peers' scattered chunks
-    Ring reduce_buf;    // all owners' reduced chunks (+ counts)
-    std::vector<int> reduce_counts;  // depth * peers * nchunks piggyback
-    int scatter_gate = 0;            // max(1, int(th_reduce * peers))
-    long completion_gate = 0;        // clamp(int(th_complete * total))
-    long total_chunks = 0;
-
-    // scratch
-    std::vector<float> out_data;
-    std::vector<int> out_counts;
+    aat::WorkerCore<Worker> core;  // core.id is THE rank (no duplicate)
 
     void init(Cluster* c, int rank);
-    void on_start(int r);
-    void on_scatter(const Msg& m);
-    void on_reduce(const Msg& m);
-    void scatter_round(int r);
-    void broadcast(const float* data, size_t len, int cid, int r, int cnt);
-    void complete(int r, int row);
-    void flush(int r, int row);
+    bool rank_alive(int rank);
+    const float* source();
+    void send_scatter(int dest, int chunk, int64_t round, const float* d,
+                      size_t n);
+    void send_reduce(int dest, int chunk, int64_t round, int64_t count,
+                     const float* d, size_t n);
+    void send_complete(int64_t round);
+    void defer_start(int64_t round);
+    void defer_scatter(int src, int chunk, int64_t round, const float* d,
+                       size_t n);
+    void defer_reduce(int src, int chunk, int64_t round, int64_t count,
+                      const float* d, size_t n);
+    void flush_sink(int64_t round, const float* out, const int* counts,
+                    long n);
 };
 
 struct Cluster {
@@ -151,9 +142,17 @@ struct Cluster {
         if (!alive[m.dest]) return;  // dead letter
         Worker& w = workers[m.dest];
         switch (m.type) {
-            case Msg::kStart:   w.on_start(m.round); break;
-            case Msg::kScatter: w.on_scatter(m); break;
-            case Msg::kReduce:  w.on_reduce(m); break;
+            case Msg::kStart:
+                w.core.on_start(m.round);
+                break;
+            case Msg::kScatter:
+                w.core.on_scatter(m.src, m.chunk, m.round,
+                                  m.payload.data(), m.payload.size());
+                break;
+            case Msg::kReduce:
+                w.core.on_reduce(m.src, m.chunk, m.round, m.count,
+                                 m.payload.data(), m.payload.size());
+                break;
             default: break;
         }
     }
@@ -172,7 +171,7 @@ struct Cluster {
         // runaway cap scaled to the workload (protocol/cluster.py
         // _message_budget)
         long chunks = workers.empty() ? 1
-            : (workers[0].max_block + max_chunk - 1) / max_chunk;
+            : (workers[0].core.max_block + max_chunk - 1) / max_chunk;
         if (chunks < 1) chunks = 1;
         long per_round = (long)n * n * 2 * chunks + 4L * n;
         long budget = 16L * per_round * (max_round + max_lag + 2);
@@ -189,224 +188,67 @@ struct Cluster {
 
 void Worker::init(Cluster* c, int rank) {
     cl = c;
-    id = rank;
-    peer_num = c->n;
-    th_reduce = c->th_reduce;
-    th_complete = c->th_complete;
-    max_lag = c->max_lag;
-    round = 0;
-    max_round = -1;
-    max_scattered = -1;
-    data_size = c->data_size;
-    max_chunk = c->max_chunk;
-
-    long step = data_size > 0
-        ? (data_size + peer_num - 1) / peer_num : 0;
-    ranges.clear();
-    for (int i = 0; i < peer_num; ++i) {
-        long lo = step > 0 ? std::min((long)i * step, data_size)
-                           : data_size;
-        long hi = step > 0 ? std::min((long)(i + 1) * step, data_size)
-                           : data_size;
-        if (lo > data_size) { lo = data_size; hi = data_size; }
-        ranges.emplace_back(lo, hi);
-    }
-    my_block = ranges[id].second - ranges[id].first;
-    max_block = ranges[0].second - ranges[0].first;
-
-    scatter_buf.init((int)my_block, peer_num, max_lag + 1, max_chunk);
-    scatter_gate = peer_num > 0
-        ? std::max(1, (int)(th_reduce * peer_num)) : 0;
-
-    reduce_buf.init((int)max_block, peer_num, max_lag + 1, max_chunk);
-    reduce_counts.assign(
-        (size_t)(max_lag + 1) * peer_num *
-            (reduce_buf.nchunks ? reduce_buf.nchunks : 1), 0);
-    total_chunks = 0;
-    for (int i = 0; i < peer_num; ++i) {
-        long blk = ranges[i].second - ranges[i].first;
-        if (blk > 0) total_chunks += (blk + max_chunk - 1) / max_chunk;
-    }
-    long gate = (long)(th_complete * total_chunks);
-    completion_gate = total_chunks > 0
-        ? std::min(std::max(1L, gate), total_chunks) : 0;
-
-    out_data.resize(data_size);
-    out_counts.resize(data_size);
+    core.init(this, rank, c->n, c->th_reduce, c->th_complete, c->max_lag,
+              c->data_size, c->max_chunk, /*start_round=*/0);
 }
 
-void Worker::on_start(int r) {
-    if (r > max_round) max_round = r;
-    // catch-up: force-complete rounds fallen out of the maxLag window
-    // (reference: AllreduceWorker.scala:100-106)
-    while (round < max_round - max_lag) {
-        for (int k = 0; k < scatter_buf.nchunks; ++k) {
-            long start = (long)k * max_chunk;
-            long end = std::min(my_block, start + max_chunk);
-            int t = scatter_buf.tidx(0);
-            std::vector<float> red((size_t)(end - start), 0.f);
-            for (int p = 0; p < peer_num; ++p) {
-                const float* row = scatter_buf.row_ptr(t, p);
-                for (long e = start; e < end; ++e)
-                    red[e - start] += row[e];
-            }
-            int cnt = (int)scatter_buf.filled[(size_t)t *
-                                              scatter_buf.nchunks + k];
-            broadcast(red.data(), red.size(), k, round, cnt);
-        }
-        complete(round, 0);
-    }
-    // pipeline scatters up to the newest round
-    while (max_scattered < max_round) {
-        scatter_round(max_scattered + 1);
-        max_scattered += 1;
-    }
-    // prune completions below the window
-    for (auto it = completed.begin(); it != completed.end();)
-        it = (*it < round) ? completed.erase(it) : ++it;
+bool Worker::rank_alive(int rank) { return cl->alive[rank] != 0; }
+
+const float* Worker::source() { return cl->source.data(); }
+
+void Worker::send_scatter(int dest, int chunk, int64_t round,
+                          const float* d, size_t n) {
+    Msg m; m.type = Msg::kScatter; m.round = (int)round; m.src = core.id;
+    m.chunk = chunk;
+    m.payload.assign(d, d + n);
+    cl->send(dest, std::move(m));
 }
 
-void Worker::scatter_round(int r) {
-    // rank-staggered fan-out, self-delivery bypass
-    // (reference: AllreduceWorker.scala:212-238)
-    for (int i = 0; i < peer_num; ++i) {
-        int idx = (i + id) % peer_num;
-        if (!cl->alive[idx]) continue;
-        long lo = ranges[idx].first, hi = ranges[idx].second;
-        long blk = hi - lo;
-        long nch = blk > 0 ? (blk + max_chunk - 1) / max_chunk : 0;
-        for (long c = 0; c < nch; ++c) {
-            long cs = c * max_chunk;
-            long ce = std::min(blk, cs + max_chunk);
-            Msg m; m.type = Msg::kScatter; m.round = r; m.src = id;
-            m.chunk = (int)c;
-            m.payload.assign(cl->source.begin() + lo + cs,
-                             cl->source.begin() + lo + ce);
-            if (idx == id) { m.dest = id; on_scatter(m); }
-            else cl->send(idx, std::move(m));
-        }
-    }
+void Worker::send_reduce(int dest, int chunk, int64_t round,
+                         int64_t count, const float* d, size_t n) {
+    Msg m; m.type = Msg::kReduce; m.round = (int)round; m.src = core.id;
+    m.chunk = chunk; m.count = (int)count;
+    m.payload.assign(d, d + n);
+    cl->send(dest, std::move(m));
 }
 
-void Worker::on_scatter(const Msg& m) {
-    if (m.round < round || completed.count(m.round)) return;  // stale
-    if (m.round <= max_round) {
-        int row = m.round - round;
-        if (!scatter_buf.store(m.payload.data(), m.payload.size(), row,
-                               m.src, m.chunk))
-            return;
-        int t = scatter_buf.tidx(row);
-        if (scatter_buf.filled[(size_t)t * scatter_buf.nchunks + m.chunk]
-            == scatter_gate) {  // == : exactly-once fire
-            long start = (long)m.chunk * max_chunk;
-            long end = std::min(my_block, start + max_chunk);
-            std::vector<float> red((size_t)(end - start), 0.f);
-            for (int p = 0; p < peer_num; ++p) {
-                const float* rowp = scatter_buf.row_ptr(t, p);
-                for (long e = start; e < end; ++e)
-                    red[e - start] += rowp[e];
-            }
-            broadcast(red.data(), red.size(), m.chunk, m.round,
-                      scatter_gate);
-        }
-    } else {
-        // not started for this round yet: requeue behind a self Start
-        Msg s; s.type = Msg::kStart; s.round = m.round;
-        cl->send(id, std::move(s));
-        Msg copy = m;
-        cl->send(id, std::move(copy));
-    }
-}
-
-void Worker::broadcast(const float* data, size_t len, int cid, int r,
-                       int cnt) {
-    for (int i = 0; i < peer_num; ++i) {
-        int idx = (i + id) % peer_num;
-        if (!cl->alive[idx]) continue;
-        Msg m; m.type = Msg::kReduce; m.round = r; m.src = id;
-        m.chunk = cid; m.count = cnt;
-        m.payload.assign(data, data + len);
-        if (idx == id) { m.dest = id; on_reduce(m); }
-        else cl->send(idx, std::move(m));
-    }
-}
-
-void Worker::on_reduce(const Msg& m) {
-    if ((long)m.payload.size() > max_chunk) return;  // guard (strict=no)
-    if (m.round < round || completed.count(m.round)) return;  // stale
-    if (m.round <= max_round) {
-        int row = m.round - round;
-        if (!reduce_buf.store(m.payload.data(), m.payload.size(), row,
-                              m.src, m.chunk))
-            return;
-        int t = reduce_buf.tidx(row);
-        reduce_counts[((size_t)t * peer_num + m.src) *
-                      reduce_buf.nchunks + m.chunk] = m.count;
-        if (reduce_buf.total[t] == completion_gate)  // == : exactly once
-            complete(m.round, row);
-    } else {
-        Msg s; s.type = Msg::kStart; s.round = m.round;
-        cl->send(id, std::move(s));
-        Msg copy = m;
-        cl->send(id, std::move(copy));
-    }
-}
-
-void Worker::complete(int r, int row) {
-    flush(r, row);
-    Msg c; c.type = Msg::kComplete; c.round = r; c.src = id;
+void Worker::send_complete(int64_t round) {
+    Msg c; c.type = Msg::kComplete; c.round = (int)round; c.src = core.id;
     cl->send(-1, std::move(c));
-    completed.insert(r);
-    if (round == r) {
-        for (;;) {
-            round += 1;
-            scatter_buf.up();
-            reduce_buf.up();
-            // retire the rotated-out reduce_counts row
-            int t = reduce_buf.tidx(max_lag);
-            std::fill(reduce_counts.begin() +
-                          (size_t)t * peer_num * reduce_buf.nchunks,
-                      reduce_counts.begin() +
-                          (size_t)(t + 1) * peer_num * reduce_buf.nchunks,
-                      0);
-            if (!completed.count(round)) break;
-        }
-    }
 }
 
-void Worker::flush(int r, int row) {
-    // reassemble output + per-element counts, zero-filling missing chunks
-    // (reference: ReducedDataBuffer.scala:26-53)
-    (void)r;
-    int t = reduce_buf.tidx(row);
-    long transferred = 0, count_transferred = 0;
-    for (int i = 0; i < peer_num; ++i) {
-        const float* block = reduce_buf.row_ptr(t, i);
-        long bs = std::min(data_size - transferred, max_block);
-        if (bs > 0)
-            std::memcpy(out_data.data() + transferred, block,
-                        (size_t)bs * sizeof(float));
-        for (int j = 0; j < reduce_buf.nchunks; ++j) {
-            long csz = std::min((long)max_chunk,
-                                max_block - (long)max_chunk * j);
-            long take = std::min(data_size - count_transferred, csz);
-            if (take <= 0) break;
-            int cnt = reduce_counts[((size_t)t * peer_num + i) *
-                                    reduce_buf.nchunks + j];
-            std::fill(out_counts.begin() + count_transferred,
-                      out_counts.begin() + count_transferred + take, cnt);
-            count_transferred += take;
-        }
-        transferred += bs;
-    }
+void Worker::defer_start(int64_t round) {
+    Msg s; s.type = Msg::kStart; s.round = (int)round;
+    cl->send(core.id, std::move(s));
+}
+
+void Worker::defer_scatter(int src, int chunk, int64_t round,
+                           const float* d, size_t n) {
+    Msg m; m.type = Msg::kScatter; m.round = (int)round; m.src = src;
+    m.chunk = chunk;
+    m.payload.assign(d, d + n);
+    cl->send(core.id, std::move(m));
+}
+
+void Worker::defer_reduce(int src, int chunk, int64_t round,
+                          int64_t count, const float* d, size_t n) {
+    Msg m; m.type = Msg::kReduce; m.round = (int)round; m.src = src;
+    m.chunk = chunk; m.count = (int)count;
+    m.payload.assign(d, d + n);
+    cl->send(core.id, std::move(m));
+}
+
+void Worker::flush_sink(int64_t round, const float* out,
+                        const int* counts, long n) {
+    (void)round;
     cl->outputs_flushed += 1;
     if (cl->assert_multiple > 0) {
         // the reference's benchmark sink invariant: output == N x input,
         // counts == N (valid when all thresholds are 1.0; reference:
         // AllreduceWorker.scala:337-339)
         int nmul = cl->assert_multiple;
-        for (long e = 0; e < data_size; ++e) {
-            if (out_data[e] != (float)e * nmul || out_counts[e] != nmul) {
+        for (long e = 0; e < n; ++e) {
+            if (out[e] != (float)e * nmul || counts[e] != nmul) {
                 cl->failed = true;
                 return;
             }

@@ -1,27 +1,19 @@
-// Cross-process native worker engine: the C++ protocol worker joined to
-// the C++ framed TCP transport (transport.cpp) with the binary wire
-// codec (protocol/wire.py) — the native engine running across real OS
-// process boundaries, in the role the reference's JVM worker plays under
-// Akka netty remoting (reference: AllreduceWorker.scala:303-346,
-// application.conf:5-11).
+// Cross-process native worker engine: the shared C++ protocol worker
+// (worker_core.h — ONE state machine for both native deployments)
+// joined to the C++ framed TCP transport (transport.cpp) with the
+// binary wire codec (protocol/wire.py) — the native engine running
+// across real OS process boundaries, in the role the reference's JVM
+// worker plays under Akka netty remoting (reference:
+// AllreduceWorker.scala:303-346, application.conf:5-11).
 //
-// The engine semantics are the SAME rules as the in-process engine
-// (cluster.cpp) and the Python spec (protocol/worker.py, pinned by
-// tests/test_protocol_worker.py): exactly-once == threshold fires,
-// stale-round drops, requeue-behind-self-Start for future rounds,
-// rank-staggered fan-out with self-delivery bypass, maxLag catch-up
-// force-completion, count piggyback, zero-filled flush. Peer-sum order
-// is ascending rank — bit-identical f32 reductions across the Python
-// and native engines, so both can serve one cluster interchangeably
+// The protocol rules live in worker_core.h (mirroring the Python spec
+// protocol/worker.py, pinned by tests/test_protocol_worker.py); this
+// file is the DEPLOYMENT: transport dials, Hello/InitWorkers
+// membership, the self-queue for deferred rounds, heartbeats, the
+// throughput sink, and master-death shutdown. Peer-sum order is
+// ascending rank — bit-identical f32 reductions across the Python and
+// native engines, so both can serve one cluster interchangeably
 // (pinned by tests/test_native_remote.py's mixed-engine cluster).
-//
-// MAINTENANCE HAZARD: the state machine here deliberately mirrors
-// cluster.cpp's Worker (the deployments differ — in-proc FIFO queue vs
-// framed TCP + int64 rounds — but the protocol rules are one spec).
-// A rule change must land in BOTH, plus protocol/worker.py; the guard
-// rails are tests/test_native_cluster.py (in-proc vs Python agreement)
-// and tests/test_native_remote.py (cross-process vs Python agreement,
-// exact-equality sinks in one mixed cluster).
 //
 // Deployment protocol (protocol/tcp.py TcpRouter):
 //   dial master -> Hello(own listen addr, "worker") -> InitWorkers
@@ -30,7 +22,9 @@
 //   the master -> master disconnect = shutdown (the reference's
 //   clusters stop by killing the master). Pings go out every heartbeat
 //   interval so the master's failure detector (reference:
-//   application.conf:20) keeps seeing this worker alive.
+//   application.conf:20) keeps seeing this worker alive; until
+//   InitWorkers arrives the greeting is re-sent each beat (cold-start
+//   self-healing — a Hello lost in the join burst must not strand us).
 //
 // Build: part of libaatpu.so (native/Makefile). C ABI at the bottom.
 
@@ -42,12 +36,11 @@
 #include <cstring>
 #include <deque>
 #include <map>
-#include <set>
 #include <string>
 #include <vector>
 
-#include "ring.h"
 #include "wire_codec.h"
+#include "worker_core.h"
 
 extern "C" {
 void* aat_create(const char* bind_host, int port);
@@ -65,7 +58,6 @@ void aat_destroy(void* tp);
 namespace {
 
 using aat::Addr;
-using aat::Ring;
 using aat::enc_complete;
 using aat::enc_hello;
 using aat::enc_ping;
@@ -95,7 +87,7 @@ double now_s() {
         .count();
 }
 
-// ---- the engine ---------------------------------------------------------
+// ---- the deployment (Env for worker_core.h) -----------------------------
 
 struct RemoteWorker {
     void* tp = nullptr;
@@ -111,26 +103,9 @@ struct RemoteWorker {
     double last_ping = 0.0;
     int verbose = 0;
 
-    // engine state (protocol/worker.py fields; cluster.cpp Worker)
-    int id = -1;
-    int peer_num = 0;
-    double th_reduce = 1.0, th_complete = 1.0;
-    int max_lag = 0;
-    int64_t round = -1, max_round = -1, max_scattered = -1;
-    std::set<int64_t> completed;
+    aat::WorkerCore<RemoteWorker> core;  // the shared state machine
     std::map<int, Addr> peers;  // rank -> listen addr (deathwatch prunes)
-
-    long data_size = 0;
-    int max_chunk = 1024;
-    std::vector<std::pair<long, long>> ranges;
-    long my_block = 0, max_block = 0;
-    Ring scatter_buf, reduce_buf;
-    std::vector<int> reduce_counts;
-    int scatter_gate = 0;
-    long completion_gate = 0, total_chunks = 0;
-    std::vector<float> source;  // constant arange input
-    std::vector<float> out_data;
-    std::vector<int> out_counts;
+    std::vector<float> source_vec;  // constant arange input
 
     // sink (protocol/cluster.py ThroughputSink)
     long outputs_flushed = 0;
@@ -161,6 +136,82 @@ struct RemoteWorker {
         int c = ensure_conn(a);
         if (c < 0) return;  // dead peer: dead-letter drop
         aat_send(tp, c, f.data(), f.size());
+    }
+
+    // -- Env interface consumed by WorkerCore ------------------------------
+
+    bool rank_alive(int rank) { return peers.count(rank) > 0; }
+
+    const float* source() { return source_vec.data(); }
+
+    void send_scatter(int dest, int chunk, int64_t round, const float* d,
+                      size_t n) {
+        auto pit = peers.find(dest);
+        if (pit == peers.end()) return;
+        send_frame(pit->second,
+                   enc_scatter(core.id, dest, chunk, round, d, n));
+    }
+
+    void send_reduce(int dest, int chunk, int64_t round, int64_t count,
+                     const float* d, size_t n) {
+        auto pit = peers.find(dest);
+        if (pit == peers.end()) return;
+        send_frame(pit->second,
+                   enc_reduce(core.id, dest, chunk, round, count, d, n));
+    }
+
+    void send_complete(int64_t round) {
+        if (master_known)
+            send_frame(master_addr, enc_complete(core.id, round));
+    }
+
+    void defer_start(int64_t round) {
+        PMsg s; s.type = kStart; s.round = round;
+        self_q.push_back(std::move(s));
+    }
+
+    void defer_scatter(int src, int chunk, int64_t round, const float* d,
+                       size_t n) {
+        PMsg m; m.type = kScatter; m.src = src; m.dest = core.id;
+        m.chunk = chunk; m.round = round;
+        m.payload.assign(d, d + n);
+        self_q.push_back(std::move(m));
+    }
+
+    void defer_reduce(int src, int chunk, int64_t round, int64_t count,
+                      const float* d, size_t n) {
+        PMsg m; m.type = kReduce; m.src = src; m.dest = core.id;
+        m.chunk = chunk; m.round = round; m.count = count;
+        m.payload.assign(d, d + n);
+        self_q.push_back(std::move(m));
+    }
+
+    void flush_sink(int64_t r, const float* out, const int* counts,
+                    long n) {
+        outputs_flushed += 1;
+        if (assert_multiple > 0) {
+            for (long e = 0; e < n; ++e) {
+                if (out[e] != (float)e * assert_multiple ||
+                    counts[e] != assert_multiple) {
+                    std::fprintf(stderr,
+                                 "native worker %d: ASSERT output[%ld]="
+                                 "%f count=%d != %d x input at round %lld"
+                                 "\n", core.id, e, out[e], counts[e],
+                                 assert_multiple, (long long)r);
+                    failed = true;
+                    return;
+                }
+            }
+        }
+        if (checkpoint > 0 && outputs_flushed % checkpoint == 0) {
+            double dt = now_s() - window_t0;
+            double mbs = dt > 0
+                ? (double)n * 4 * checkpoint / dt / 1e6 : 0.0;
+            std::printf("native worker %d: round %lld, %.2f MB/s\n",
+                        core.id, (long long)r, mbs);
+            std::fflush(stdout);
+            window_t0 = now_s();
+        }
     }
 
     // -- init (protocol/worker.py _handle_init) ----------------------------
@@ -199,268 +250,21 @@ struct RemoteWorker {
                 return;
             wmap[rank] = a;
         }
-        if (id != -1) {  // re-init refreshes the peer map only
+        if (core.id != -1) {  // re-init refreshes the peer map only
             peers = std::move(wmap);
             return;
         }
-        id = dest_id;
         if (has_master) { master_addr = maddr; master_known = true; }
-        peer_num = static_cast<int>(worker_num);
         peers = std::move(wmap);
-        th_reduce = thr;
-        th_complete = thc;
-        max_lag = static_cast<int>(lag32);
-        round = start_round;
-        max_round = start_round - 1;
-        max_scattered = start_round - 1;
-        completed.clear();
-        data_size = static_cast<long>(dsz);
-        max_chunk = static_cast<int>(chunk);
-
-        long step = data_size > 0
-            ? (data_size + peer_num - 1) / peer_num : 0;
-        ranges.clear();
-        for (int i = 0; i < peer_num; ++i) {
-            long lo = step > 0 ? std::min((long)i * step, data_size)
-                               : data_size;
-            long hi = step > 0 ? std::min((long)(i + 1) * step, data_size)
-                               : data_size;
-            ranges.emplace_back(lo, hi);
-        }
-        my_block = ranges[id].second - ranges[id].first;
-        max_block = ranges[0].second - ranges[0].first;
-        scatter_buf.init((int)my_block, peer_num, max_lag + 1, max_chunk);
-        scatter_gate = peer_num > 0
-            ? std::max(1, (int)(th_reduce * peer_num)) : 0;
-        reduce_buf.init((int)max_block, peer_num, max_lag + 1, max_chunk);
-        reduce_counts.assign(
-            (size_t)(max_lag + 1) * peer_num *
-                (reduce_buf.nchunks ? reduce_buf.nchunks : 1), 0);
-        total_chunks = 0;
-        for (int i = 0; i < peer_num; ++i) {
-            long blk = ranges[i].second - ranges[i].first;
-            if (blk > 0)
-                total_chunks += (blk + max_chunk - 1) / max_chunk;
-        }
-        long gate = (long)(th_complete * total_chunks);
-        completion_gate = total_chunks > 0
-            ? std::min(std::max(1L, gate), total_chunks) : 0;
-        source.resize(data_size);
-        for (long i = 0; i < data_size; ++i) source[i] = (float)i;
-        out_data.resize(data_size);
-        out_counts.resize(data_size);
+        source_vec.resize(dsz);
+        for (uint64_t i = 0; i < dsz; ++i) source_vec[i] = (float)i;
+        core.init(this, dest_id, (int)worker_num, thr, thc, (int)lag32,
+                  (long)dsz, (int)chunk, start_round);
         window_t0 = now_s();
         if (verbose)
             std::fprintf(stderr,
-                         "native worker %d: %d peers, block %ld\n", id,
-                         peer_num, my_block);
-    }
-
-    // -- round start + catch-up (protocol/worker.py _handle_start) ---------
-
-    void on_start(int64_t r) {
-        if (id == -1) {  // uninitialized: requeue behind init
-            PMsg m; m.type = kStart; m.round = r;
-            self_q.push_back(std::move(m));
-            return;
-        }
-        if (r > max_round) max_round = r;
-        while (round < max_round - max_lag) {
-            for (int k = 0; k < scatter_buf.nchunks; ++k) {
-                long start = (long)k * max_chunk;
-                long end = std::min(my_block, start + max_chunk);
-                int t = scatter_buf.tidx(0);
-                std::vector<float> red((size_t)(end - start), 0.f);
-                for (int p = 0; p < peer_num; ++p) {
-                    const float* row = scatter_buf.row_ptr(t, p);
-                    for (long e = start; e < end; ++e)
-                        red[e - start] += row[e];
-                }
-                int cnt = (int)scatter_buf.filled[
-                    (size_t)t * scatter_buf.nchunks + k];
-                broadcast(red.data(), red.size(), k, round, cnt);
-            }
-            complete(round, 0);
-        }
-        while (max_scattered < max_round) {
-            scatter_round(max_scattered + 1);
-            max_scattered += 1;
-        }
-        for (auto it = completed.begin(); it != completed.end();)
-            it = (*it < round) ? completed.erase(it) : ++it;
-    }
-
-    // -- scatter phase -----------------------------------------------------
-
-    void scatter_round(int64_t r) {
-        for (int i = 0; i < peer_num; ++i) {
-            int idx = (i + id) % peer_num;
-            auto pit = peers.find(idx);
-            if (pit == peers.end()) continue;  // dead peer gap
-            long lo = ranges[idx].first, hi = ranges[idx].second;
-            long blk = hi - lo;
-            long nch = blk > 0 ? (blk + max_chunk - 1) / max_chunk : 0;
-            for (long c = 0; c < nch; ++c) {
-                long cs = c * max_chunk;
-                long ce = std::min(blk, cs + max_chunk);
-                if (idx == id) {
-                    PMsg m; m.type = kScatter; m.src = id; m.dest = id;
-                    m.chunk = (int)c; m.round = r;
-                    m.payload.assign(source.begin() + lo + cs,
-                                     source.begin() + lo + ce);
-                    on_scatter(m);
-                } else {
-                    send_frame(pit->second,
-                               enc_scatter(id, idx, (int)c, r,
-                                           source.data() + lo + cs,
-                                           (size_t)(ce - cs)));
-                }
-            }
-        }
-    }
-
-    void on_scatter(const PMsg& m) {
-        if (m.dest != id) return;  // misrouted: the Python spec raises
-        //                            and drops (non-strict); never stage
-        if (m.round < round || completed.count(m.round)) return;  // stale
-        if (m.round <= max_round) {
-            int row = (int)(m.round - round);
-            if (!scatter_buf.store(m.payload.data(), m.payload.size(),
-                                   row, m.src, m.chunk))
-                return;
-            int t = scatter_buf.tidx(row);
-            if (scatter_buf.filled[(size_t)t * scatter_buf.nchunks +
-                                   m.chunk] == scatter_gate) {  // == once
-                long start = (long)m.chunk * max_chunk;
-                long end = std::min(my_block, start + max_chunk);
-                std::vector<float> red((size_t)(end - start), 0.f);
-                for (int p = 0; p < peer_num; ++p) {
-                    const float* rowp = scatter_buf.row_ptr(t, p);
-                    for (long e = start; e < end; ++e)
-                        red[e - start] += rowp[e];
-                }
-                broadcast(red.data(), red.size(), m.chunk, m.round,
-                          scatter_gate);
-            }
-        } else {
-            PMsg s; s.type = kStart; s.round = m.round;
-            self_q.push_back(std::move(s));
-            self_q.push_back(m);
-        }
-    }
-
-    // -- reduce / broadcast phase ------------------------------------------
-
-    void broadcast(const float* data, size_t len, int cid, int64_t r,
-                   int cnt) {
-        for (int i = 0; i < peer_num; ++i) {
-            int idx = (i + id) % peer_num;
-            auto pit = peers.find(idx);
-            if (pit == peers.end()) continue;
-            if (idx == id) {
-                PMsg m; m.type = kReduce; m.src = id; m.dest = id;
-                m.chunk = cid; m.round = r; m.count = cnt;
-                m.payload.assign(data, data + len);
-                on_reduce(m);
-            } else {
-                send_frame(pit->second,
-                           enc_reduce(id, idx, cid, r, cnt, data, len));
-            }
-        }
-    }
-
-    void on_reduce(const PMsg& m) {
-        if (m.dest != id) return;  // misrouted (see on_scatter)
-        if ((long)m.payload.size() > max_chunk) return;  // guard
-        if (m.round < round || completed.count(m.round)) return;  // stale
-        if (m.round <= max_round) {
-            int row = (int)(m.round - round);
-            if (!reduce_buf.store(m.payload.data(), m.payload.size(), row,
-                                  m.src, m.chunk))
-                return;
-            int t = reduce_buf.tidx(row);
-            reduce_counts[((size_t)t * peer_num + m.src) *
-                          reduce_buf.nchunks + m.chunk] = (int)m.count;
-            if (reduce_buf.total[t] == completion_gate)  // == : once
-                complete(m.round, row);
-        } else {
-            PMsg s; s.type = kStart; s.round = m.round;
-            self_q.push_back(std::move(s));
-            self_q.push_back(m);
-        }
-    }
-
-    // -- completion --------------------------------------------------------
-
-    void complete(int64_t r, int row) {
-        flush(r, row);
-        if (master_known)
-            send_frame(master_addr, enc_complete(id, r));
-        completed.insert(r);
-        if (round == r) {
-            for (;;) {
-                round += 1;
-                scatter_buf.up();
-                reduce_buf.up();
-                int t = reduce_buf.tidx(max_lag);
-                std::fill(
-                    reduce_counts.begin() +
-                        (size_t)t * peer_num * reduce_buf.nchunks,
-                    reduce_counts.begin() +
-                        (size_t)(t + 1) * peer_num * reduce_buf.nchunks,
-                    0);
-                if (!completed.count(round)) break;
-            }
-        }
-    }
-
-    void flush(int64_t r, int row) {
-        int t = reduce_buf.tidx(row);
-        long transferred = 0, count_transferred = 0;
-        for (int i = 0; i < peer_num; ++i) {
-            const float* block = reduce_buf.row_ptr(t, i);
-            long bs = std::min(data_size - transferred, max_block);
-            if (bs > 0)
-                std::memcpy(out_data.data() + transferred, block,
-                            (size_t)bs * sizeof(float));
-            for (int j = 0; j < reduce_buf.nchunks; ++j) {
-                long csz = std::min((long)max_chunk,
-                                    max_block - (long)max_chunk * j);
-                long take = std::min(data_size - count_transferred, csz);
-                if (take <= 0) break;
-                int cnt = reduce_counts[((size_t)t * peer_num + i) *
-                                        reduce_buf.nchunks + j];
-                std::fill(out_counts.begin() + count_transferred,
-                          out_counts.begin() + count_transferred + take,
-                          cnt);
-                count_transferred += take;
-            }
-            transferred += bs;
-        }
-        outputs_flushed += 1;
-        if (assert_multiple > 0) {
-            for (long e = 0; e < data_size; ++e) {
-                if (out_data[e] != (float)e * assert_multiple ||
-                    out_counts[e] != assert_multiple) {
-                    std::fprintf(stderr,
-                                 "native worker %d: ASSERT output[%ld]="
-                                 "%f count=%d != %d x input at round %lld"
-                                 "\n", id, e, out_data[e], out_counts[e],
-                                 assert_multiple, (long long)r);
-                    failed = true;
-                    return;
-                }
-            }
-        }
-        if (checkpoint > 0 && outputs_flushed % checkpoint == 0) {
-            double dt = now_s() - window_t0;
-            double mbs = dt > 0
-                ? (double)data_size * 4 * checkpoint / dt / 1e6 : 0.0;
-            std::printf("native worker %d: round %lld, %.2f MB/s\n", id,
-                        (long long)r, mbs);
-            std::fflush(stdout);
-            window_t0 = now_s();
-        }
+                         "native worker %d: %d peers, block %ld\n",
+                         core.id, core.peer_num, core.my_block);
     }
 
     // -- frame dispatch ----------------------------------------------------
@@ -484,7 +288,9 @@ struct RemoteWorker {
                 break;
             case kStart: {
                 int64_t r;
-                if (rd(buf, len, off, &r)) on_start(r);
+                if (!rd(buf, len, off, &r)) break;
+                if (core.id == -1) defer_start(r);
+                else core.on_start(r);
                 break;
             }
             case kScatter: {
@@ -501,8 +307,10 @@ struct RemoteWorker {
                 m.src = src; m.dest = dest; m.chunk = chunk;
                 m.payload.resize(nbytes / 4);
                 std::memcpy(m.payload.data(), buf + off, nbytes);
-                if (id == -1) self_q.push_back(std::move(m));
-                else on_scatter(m);
+                if (core.id == -1) self_q.push_back(std::move(m));
+                else if (m.dest == core.id)  // misrouted frames dropped
+                    core.on_scatter(m.src, m.chunk, m.round,
+                                    m.payload.data(), m.payload.size());
                 break;
             }
             case kReduce: {
@@ -520,8 +328,10 @@ struct RemoteWorker {
                 m.src = src; m.dest = dest; m.chunk = chunk;
                 m.payload.resize(nbytes / 4);
                 std::memcpy(m.payload.data(), buf + off, nbytes);
-                if (id == -1) self_q.push_back(std::move(m));
-                else on_reduce(m);
+                if (core.id == -1) self_q.push_back(std::move(m));
+                else if (m.dest == core.id)  // misrouted frames dropped
+                    core.on_reduce(m.src, m.chunk, m.round, m.count,
+                                   m.payload.data(), m.payload.size());
                 break;
             }
             case kPing:
@@ -538,10 +348,22 @@ struct RemoteWorker {
         for (size_t i = 0; i < n && !self_q.empty(); ++i) {
             PMsg m = std::move(self_q.front());
             self_q.pop_front();
-            if (m.type == kStart) on_start(m.round);
-            else if (id == -1) self_q.push_back(std::move(m));
-            else if (m.type == kScatter) on_scatter(m);
-            else if (m.type == kReduce) on_reduce(m);
+            if (m.type == kStart) {
+                if (core.id == -1) self_q.push_back(std::move(m));
+                else core.on_start(m.round);
+            } else if (core.id == -1) {
+                self_q.push_back(std::move(m));
+            } else if (m.dest != core.id) {
+                // pre-init-queued frame addressed to another rank (e.g.
+                // a reused listen port): drop, same as the dispatch-path
+                // misroute guard — never stage foreign payloads
+            } else if (m.type == kScatter) {
+                core.on_scatter(m.src, m.chunk, m.round,
+                                m.payload.data(), m.payload.size());
+            } else if (m.type == kReduce) {
+                core.on_reduce(m.src, m.chunk, m.round, m.count,
+                               m.payload.data(), m.payload.size());
+            }
         }
     }
 
@@ -577,7 +399,7 @@ struct RemoteWorker {
         auto ping = enc_ping(hb_interval);
         for (auto& [a, c] : conn_of)
             aat_send(tp, c, ping.data(), ping.size());
-        if (id == -1) {
+        if (core.id == -1) {
             // cold-start self-healing: until InitWorkers arrives, keep
             // re-greeting the master (idempotent there) — a Hello lost
             // in the simultaneous join burst must not strand this
@@ -605,8 +427,8 @@ struct RemoteWorker {
         for (;;) {
             int c = aat_connect(tp, master_host, master_port, 2000);
             if (c >= 0) {
-                conn_of[master_addr] = c;
-                addr_of_conn[c] = master_addr;
+                conn_of[dialed_master] = c;
+                addr_of_conn[c] = dialed_master;
                 auto hello = enc_hello(self, "worker");
                 aat_send(tp, c, hello.data(), hello.size());
                 break;

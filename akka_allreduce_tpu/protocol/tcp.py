@@ -120,6 +120,12 @@ class TcpRouter:
         # again (and so a genuinely-lost first Hello still fires on the
         # retry: a lost frame never entered this set).
         self._greeted: set[wire.Addr] = set()
+        # deathwatch latch: a peer we have sighted (greeted us, or we
+        # dialed it) whose death has not fired yet. A mutually-dialed
+        # pair's TWO connections produce TWO disconnect events on real
+        # death — on_terminated must fire exactly once per incarnation,
+        # whichever event order the kernel delivers.
+        self._alive_addrs: set[wire.Addr] = set()
         self._recv_buf = (ctypes.c_uint8 * (1 << 20))()
 
     # -- Router surface (what the engines call) -----------------------------
@@ -176,6 +182,7 @@ class TcpRouter:
             return None
         self._conn_of[addr] = conn
         self._addr_of_conn[conn] = addr
+        self._alive_addrs.add(addr)
         # Greet so the remote can map this connection back to our address.
         data = wire.encode(wire.Hello(self.addr, self.role), self._addr_for)
         buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
@@ -257,6 +264,9 @@ class TcpRouter:
         self._peer_interval.pop(addr, None)
         self._conn_of.pop(addr, None)
         self._greeted.discard(addr)
+        if addr not in self._alive_addrs:
+            return  # this incarnation's death already fired
+        self._alive_addrs.discard(addr)
         if self.on_terminated is not None and addr in self._refs:
             self.on_terminated(self._refs[addr])
 
@@ -323,6 +333,7 @@ class TcpRouter:
         # Prefer an existing (dialed) connection for sending; otherwise the
         # inbound one is bidirectional TCP — reply on it.
         self._conn_of.setdefault(addr, conn)
+        self._alive_addrs.add(addr)
         ref = self.ref_of(addr)  # intern now so deathwatch can resolve it
         if addr in self._greeted:
             return  # repeat greeting from a live member (see ctor note)
@@ -355,6 +366,9 @@ class TcpRouter:
             self._last_heard.pop(addr, None)
             self._peer_interval.pop(addr, None)
             self._greeted.discard(addr)
+            if addr not in self._alive_addrs:
+                continue  # this incarnation's death already fired
+            self._alive_addrs.discard(addr)
             if self.tracer is not None:
                 self.tracer.record("peer_disconnect",
                                    host=addr[0], port=addr[1])
