@@ -155,6 +155,7 @@ def main() -> int:
 
     ab_pallas_vs_xla()
     ab_flash_attention()
+    ab_windowed_sp()
     ab_moe_dispatch()
     mfu_lines()
     return 0
@@ -266,6 +267,77 @@ def ab_flash_attention():
     if on_tpu:
         win = max(results, key=results.get)
         emit("ab_attn_winner", results[win], "TFLOP/s", win)
+
+
+def ab_windowed_sp():
+    """A/B the banded flash kernel serving windowed-SP attention against
+    the pure masked-XLA path (parallel/ring_attention.py), fwd+bwd, via
+    the REAL public entry points under a 1-device "sp" mesh — at sp=1
+    both functions reduce to single-rank sliding-window attention at the
+    exact production geometry (the tail exchange is an identity permute;
+    the pure path's k_pos >= 0 mask drops the wrapped columns), so one
+    chip measures the kernel the multi-rank composition serves. Useful
+    FLOPs charge each query only its live window, identically for both
+    impls, so the TFLOP/s ratio exposes the pure path's O(T x (T+tail))
+    wasted compute + materialised score matrix."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from akka_allreduce_tpu.parallel.ring_attention import (
+        flash_windowed_sp_attention, windowed_sp_attention)
+
+    plat = jax.devices()[0].platform
+    on_tpu = plat == "tpu"
+    if on_tpu:
+        b, t, h, d, window, blk = 2, 4096, 16, 128, 1024, 512
+    else:
+        b, t, h, d, window, blk = 1, 256, 2, 64, 64, 128
+    shape = (b, t, h, d)
+    n_bufs = 2
+    qkvs = [tuple(jax.random.normal(jax.random.key(101 + 3 * i + j),
+                                    shape, jnp.bfloat16) for j in range(3))
+            for i in range(n_bufs)]
+    # live keys per query: min(window, pos+1); 2 matmuls x 2bhd each, x3 bwd
+    live = sum(min(window, i + 1) for i in range(t))
+    flops = 3 * 2 * 2 * b * h * d * live
+
+    mesh = Mesh(jax.devices()[:1], ("sp",))
+    impls = {
+        "flash": lambda q, k, v: flash_windowed_sp_attention(
+            q, k, v, window, "sp", block_q=blk, block_k=blk,
+            interpret=not on_tpu),
+        "pure": lambda q, k, v: windowed_sp_attention(q, k, v, window,
+                                                      "sp"),
+    }
+    results = {}
+    for name, attn in impls.items():
+        sharded = partial(jax.shard_map, mesh=mesh,
+                          in_specs=P(None, "sp"),
+                          out_specs=P(None, "sp"), check_vma=False)(attn)
+
+        def fwd_bwd(q, k, v, c):
+            def loss(q, k, v):
+                o = sharded(q, k, v)
+                return jnp.sum(o.astype(jnp.float32) * 1e-3) + c
+            val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            val = val + sum(
+                jnp.sum(g[0, 0, 0, :8].astype(jnp.float32)) * 1e-9
+                for g in grads)
+            return val, grads
+        t_step = _time_device_fn(jax.jit(fwd_bwd), qkvs,
+                                 k_hi=40 if on_tpu else 8,
+                                 k_lo=10 if on_tpu else 2)
+        results[name] = flops / t_step / 1e12
+        emit(f"ab_windowed_sp_{name}_{plat}", results[name], "TFLOP/s",
+             f"fwd+bwd sliding-window, B={b} T={t} H={h} D={d} "
+             f"window={window} bf16, blk={blk}, sp=1 mesh (useful "
+             f"banded FLOPs for both impls)")
+    if on_tpu:
+        win = max(results, key=results.get)
+        emit("ab_windowed_sp_winner", results[win], "TFLOP/s", win)
 
 
 def mfu_lines():

@@ -228,6 +228,16 @@ class TestBlockSelection:
         q = jax.random.normal(jax.random.key(0), (1, 4097, 1, 8))
         with pytest.raises(ValueError, match="no legal flash block"):
             attn(q, q, q)
+
+    @pytest.mark.slow  # second pin: block-geometry parity lives in
+    # test_uneven_block_sizes on the fast tier; this adds the odd-t
+    # single-block case
+    def test_forced_flash_odd_t_single_block_parity(self):
+        from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                     select_local_attention)
+        from akka_allreduce_tpu.models.transformer import TransformerConfig
+        cfg = TrainConfig(model=TransformerConfig(), attn_impl="flash")
+        attn = select_local_attention(cfg)
         # t <= the block budget is always a single legal block, even odd
         q = jax.random.normal(jax.random.key(0), (1, 129, 1, 8))
         out = attn(q, q, q)

@@ -59,7 +59,11 @@ class TestStacking:
 class TestGpipe:
     @pytest.mark.parametrize("s,m", [
         pytest.param(4, 4, marks=pytest.mark.slow),
-        (2, 6), (4, 1), (8, 3)])
+        (2, 6), (4, 1),
+        # s=8 is the full-mesh geometry: the widest compile in this
+        # file, and the s=2/s=4 rows already pin fill/steady/drain at
+        # m>s and m=1 — full tier re-pins it (fast-tier budget)
+        pytest.param(8, 3, marks=pytest.mark.slow)])
     def test_pipeline_computes_product(self, s, m):
         mesh = pp_mesh(s)
         w = jnp.arange(1.0, s + 1)          # stage i multiplies by i+1
