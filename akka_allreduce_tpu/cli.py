@@ -346,6 +346,15 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "deadline pacer and the multi-host hybrid")
     p.add_argument("--log-every", type=int, default=10,
                    help="print a progress line every N steps")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="run N train steps inside one jitted lax.scan "
+                        "per host dispatch (models/train.py "
+                        "make_multi_step) — amortizes host->device "
+                        "dispatch latency, the production shape of a "
+                        "training loop. Single-process exact path only: "
+                        "deadline masking and the DCN hybrid need the "
+                        "host at every round boundary; checkpoints land "
+                        "at chunk boundaries")
     p.add_argument("--retain-rounds", type=int, default=64,
                    help="hybrid (--coordinator --deadline-ms) only: how "
                         "many rounds of masks/payloads stay in the KV "
@@ -665,6 +674,19 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print("error: --straggle-prob needs --deadline-ms",
               file=sys.stderr)
         return 2
+    if args.steps_per_dispatch < 1:
+        print("error: --steps-per-dispatch must be >= 1",
+              file=sys.stderr)
+        return 2
+    if args.steps_per_dispatch > 1 and (args.deadline_ms > 0
+                                        or jax.process_count() > 1):
+        # deadline masking and the hybrid interact with the host every
+        # round (arrival clocks, DCN publish/apply); a scanned chunk has
+        # no host-visible round boundary inside it
+        print("error: --steps-per-dispatch > 1 needs the single-process "
+              "exact path (no --deadline-ms / --coordinator)",
+              file=sys.stderr)
+        return 2
     if not 0.0 < args.th_allreduce <= 1.0:
         print("error: --th-allreduce must be in (0, 1]", file=sys.stderr)
         return 2
@@ -982,7 +1004,54 @@ def _cmd_train(args: argparse.Namespace) -> int:
                           f"(final)")
             dcn.close()
             return 0
-        for i in range(start, args.steps):
+        loop_start = start
+        if args.steps_per_dispatch > 1:
+            from akka_allreduce_tpu.models.train import make_multi_step
+            spd = args.steps_per_dispatch
+            multi = make_multi_step(cfg, mesh, opt)
+            i = start
+            while i < args.steps:
+                n = min(spd, args.steps - i)
+                if n == spd:
+                    chunk_np = np.stack(
+                        [build_batch(j)[1] for j in range(i, i + n)])
+                    params, opt_state, ms = multi(
+                        params, opt_state, jnp.asarray(chunk_np))
+                else:
+                    # tail shorter than the compiled scan length: the
+                    # per-step program instead of a second scan compile
+                    for j in range(i, i + n):
+                        params, opt_state, m1 = step(
+                            params, opt_state,
+                            jnp.asarray(build_batch(j)[1]))
+                    ms = jax.tree.map(lambda x: x[None], m1)
+                last = i + n - 1
+                if mgr is not None and (i // args.ckpt_every
+                                        != (last + 1) // args.ckpt_every):
+                    # the cadence gate must run at CHUNK granularity:
+                    # boundary indices (spd-1, 2*spd-1, ...) are almost
+                    # never multiples of --ckpt-every, so maybe_save's
+                    # step % interval == 0 rule would silently never
+                    # fire. Force-save at the chunk end whenever the
+                    # chunk crossed an interval line — the step index
+                    # stays paired with the params actually holding it
+                    mgr.save(last, params, opt_state,
+                             {"data_step": last}, force=True)
+                steps_in_window += n
+                if i == start or (i // args.log_every
+                                  != (last + 1) // args.log_every):
+                    loss = float(np.asarray(ms["loss"])[-1])
+                    toks = float(np.asarray(ms["tokens"])[-1])
+                    dt = time.perf_counter() - tic
+                    if chatty:
+                        print(f"step {last + 1:4d}: loss {loss:.4f} "
+                              f"({toks * steps_in_window / dt:.0f} "
+                              f"tok/s)")
+                    tic = time.perf_counter()
+                    steps_in_window = 0
+                i += n
+            loop_start = args.steps  # per-step loop below fully consumed
+        for i in range(loop_start, args.steps):
             step_rng, batch_np = build_batch(i)
             if jax.process_count() > 1:
                 # every process computed the same global batch; build the

@@ -805,6 +805,48 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
     return step_dynamic if dynamic_valid else step
 
 
+def make_multi_step(cfg: TrainConfig, mesh: Mesh,
+                    opt: optax.GradientTransformation):
+    """``n`` production train steps inside ONE jitted ``lax.scan`` — the
+    dispatch-amortized training loop (``cli.py train
+    --steps-per-dispatch``).
+
+    Real deployments run many steps per host dispatch; a per-step
+    Python loop pays the host->device dispatch latency every step (on
+    a relay-attached chip that is ~90 ms/step against a ~250 ms step —
+    the gap round-3 profiling measured between the per-call stage
+    times and the loop-measured MFU). The scan body is
+    :func:`make_train_step`'s step — same gradient sync, optimizer
+    chain, and int8 quant seeding from the adam counter — so a chunked
+    run is step-for-step the program the per-step loop runs; only the
+    dispatch count changes.
+
+    Tokens arrive stacked ``(n, batch, seq)``: each scan tick consumes
+    a fresh batch (the bench's fixed-batch scan is a measurement
+    device; training must stream data). Metrics come back stacked
+    along axis 0. The inner step is un-donated — the scan carry
+    aliases its buffers — and donation happens once at the outer jit
+    boundary, so callers rebind ``params``/``opt_state`` from the
+    return exactly like the per-step loop. One compile serves every
+    chunk of the same length; run tail remainders through the
+    per-step path rather than compiling a second scan length.
+    """
+    step_inner = make_train_step(cfg, mesh, opt, donate=False)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run_chunk(params, opt_state, tokens_stacked):
+        def one(carry, tokens):
+            p, o = carry
+            p, o, metrics = step_inner(p, o, tokens)
+            return (p, o), metrics
+
+        (params, opt_state), metrics = lax.scan(
+            one, (params, opt_state), tokens_stacked)
+        return params, opt_state, metrics
+
+    return run_chunk
+
+
 def data_rank_count(cfg: TrainConfig, mesh: Mesh) -> int:
     """How many data ranks contribute to the dense gradient sync — the row
     count of a dynamic ``valid`` mask (dp x sp, x ep when the mesh has

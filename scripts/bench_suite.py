@@ -271,23 +271,34 @@ def ab_flash_attention():
 
 def ab_windowed_sp():
     """A/B the banded flash kernel serving windowed-SP attention against
-    the pure masked-XLA path (parallel/ring_attention.py), fwd+bwd, via
-    the REAL public entry points under a 1-device "sp" mesh — at sp=1
-    both functions reduce to single-rank sliding-window attention at the
-    exact production geometry (the tail exchange is an identity permute;
-    the pure path's k_pos >= 0 mask drops the wrapped columns), so one
-    chip measures the kernel the multi-rank composition serves. Useful
-    FLOPs charge each query only its live window, identically for both
-    impls, so the TFLOP/s ratio exposes the pure path's O(T x (T+tail))
-    wasted compute + materialised score matrix."""
+    the pure masked-XLA path (parallel/ring_attention.py), fwd+bwd, at
+    one rank's shard shape. The kernel row times the **rank>0 program**
+    of flash_windowed_sp_attention — the banded kernel over the
+    front-padded [prev-tail ++ local] concat with the query block
+    entering at q_off — with the local tail standing in for the
+    neighbor's (identical shapes, geometry, and block masks; n-1 of n
+    ranks run exactly this program, and it is the one whose
+    block_q/block_k choice matters; the rank-0 branch is plain banded
+    flash, already covered by ab_flash_attention). The pure row runs
+    windowed_sp_attention through its real shard_map entry under a
+    1-device "sp" mesh (identity tail permute; its k_pos >= 0 mask
+    drops the wrapped columns). Useful FLOPs charge each row its OWN
+    live query-key pairs — the rank>0 program has a full window live
+    for every query (the tail supplies window-1 real keys before
+    position 0); the pure sp=1 row ramps in over the first window-1
+    queries — so each TFLOP/s is that program's genuine useful
+    throughput, and the gap still exposes the pure path's
+    O(T x (T+tail)) wasted compute + materialised score matrix."""
     import jax
     import jax.numpy as jnp
     from functools import partial
 
     from jax.sharding import Mesh, PartitionSpec as P
 
-    from akka_allreduce_tpu.parallel.ring_attention import (
-        flash_windowed_sp_attention, windowed_sp_attention)
+    from akka_allreduce_tpu.ops.pallas_kernels.attention import \
+        flash_attention
+    from akka_allreduce_tpu.parallel.ring_attention import \
+        windowed_sp_attention
 
     plat = jax.devices()[0].platform
     on_tpu = plat == "tpu"
@@ -300,23 +311,35 @@ def ab_windowed_sp():
     qkvs = [tuple(jax.random.normal(jax.random.key(101 + 3 * i + j),
                                     shape, jnp.bfloat16) for j in range(3))
             for i in range(n_bufs)]
-    # live keys per query: min(window, pos+1); 2 matmuls x 2bhd each, x3 bwd
-    live = sum(min(window, i + 1) for i in range(t))
-    flops = 3 * 2 * 2 * b * h * d * live
+    # live keys per query; 2 matmuls x 2bhd each, x3 for bwd
+    live_by = {"flash": t * window,  # tail => full window at every query
+               "pure": sum(min(window, i + 1) for i in range(t))}
+    flops_by = {name: 3 * 2 * 2 * b * h * d * live
+                for name, live in live_by.items()}
+
+    tail = window - 1
+    blk_k = min(blk, t)
+    pad = (-(t + tail)) % blk_k
+
+    def flash_rank_gt0(q, k, v):
+        # the with_tail branch's exact geometry
+        # (parallel/ring_attention.py flash_windowed_sp_attention)
+        zeros = jnp.zeros((b, pad) + k.shape[2:], k.dtype)
+        k_cat = jnp.concatenate([zeros, k[:, t - tail:], k], axis=1)
+        v_cat = jnp.concatenate([zeros, v[:, t - tail:], v], axis=1)
+        return flash_attention(q, k_cat, v_cat, True, blk, blk_k,
+                               not on_tpu, window, pad + tail, 0)
 
     mesh = Mesh(jax.devices()[:1], ("sp",))
     impls = {
-        "flash": lambda q, k, v: flash_windowed_sp_attention(
-            q, k, v, window, "sp", block_q=blk, block_k=blk,
-            interpret=not on_tpu),
-        "pure": lambda q, k, v: windowed_sp_attention(q, k, v, window,
-                                                      "sp"),
+        "flash": flash_rank_gt0,
+        "pure": partial(jax.shard_map,
+                        mesh=mesh, in_specs=P(None, "sp"),
+                        out_specs=P(None, "sp"), check_vma=False)(
+            lambda q, k, v: windowed_sp_attention(q, k, v, window, "sp")),
     }
     results = {}
-    for name, attn in impls.items():
-        sharded = partial(jax.shard_map, mesh=mesh,
-                          in_specs=P(None, "sp"),
-                          out_specs=P(None, "sp"), check_vma=False)(attn)
+    for name, sharded in impls.items():
 
         def fwd_bwd(q, k, v, c):
             def loss(q, k, v):
@@ -330,11 +353,13 @@ def ab_windowed_sp():
         t_step = _time_device_fn(jax.jit(fwd_bwd), qkvs,
                                  k_hi=40 if on_tpu else 8,
                                  k_lo=10 if on_tpu else 2)
-        results[name] = flops / t_step / 1e12
+        results[name] = flops_by[name] / t_step / 1e12
+        kind = ("rank>0 tail-concat kernel program"
+                if name == "flash" else "shard_map sp=1 mesh")
         emit(f"ab_windowed_sp_{name}_{plat}", results[name], "TFLOP/s",
              f"fwd+bwd sliding-window, B={b} T={t} H={h} D={d} "
-             f"window={window} bf16, blk={blk}, sp=1 mesh (useful "
-             f"banded FLOPs for both impls)")
+             f"window={window} bf16, blk={blk}, {kind} (charged its own "
+             f"live query-key pairs: {live_by[name]})")
     if on_tpu:
         win = max(results, key=results.get)
         emit("ab_windowed_sp_winner", results[win], "TFLOP/s", win)
