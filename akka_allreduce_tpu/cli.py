@@ -401,10 +401,41 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "all hosts' devices and collectives ride ICI/DCN")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    _add_backend_args(p)
+
+
+
+def _add_backend_args(p: argparse.ArgumentParser) -> None:
+    """Backend flags shared by every device-touching command; applied by
+    :func:`_apply_backend_flags` BEFORE backend init."""
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu) before backend "
                         "init — for tests and CPU-mesh rehearsals")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory: "
+                        "repeat invocations at the same shapes skip "
+                        "compilation entirely (first TPU compiles run "
+                        "20-40s; a warmed cache makes restarts, elastic "
+                        "rejoins, and preemption resumes start in "
+                        "seconds)")
 
+
+def _apply_backend_flags(args: argparse.Namespace) -> None:
+    """--platform / --compile-cache must land before any backend
+    initializes (site customization overrides the env var on some
+    hosts — the reason these are flags, not env documentation)."""
+    import jax
+
+    if getattr(args, "platform", None):
+        jax.config.update("jax_platforms", args.platform)
+    if getattr(args, "compile_cache", None):
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        # cache every program: the knob exists for the 20-40s monsters,
+        # but a restart replays the SMALL programs too, and the default
+        # min-compile-time gate would silently skip them
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
 def _add_model_args(p: argparse.ArgumentParser) -> None:
@@ -521,18 +552,12 @@ def _add_generate(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--raw", action="store_true",
                    help="print token ids instead of decoding bytes")
-    p.add_argument("--platform", default=None,
-                   help="force a JAX platform (e.g. cpu) before backend "
-                        "init")
+    _add_backend_args(p)
 
 
 def _cmd_generate(args: argparse.Namespace) -> int:
+    _apply_backend_flags(args)
     import jax
-
-    if args.platform:
-        # before any backend init (site customization overrides the env
-        # var on some hosts — same reason train has the flag)
-        jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
     import numpy as np
 
@@ -615,10 +640,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
                                                   make_device_mesh,
                                                   place_global_batch)
 
-    if args.platform:
-        # must land before any backend initializes (tests/conftest.py:
-        # the env var alone is overridden by site customization here)
-        jax.config.update("jax_platforms", args.platform)
+    _apply_backend_flags(args)
     if args.coordinator:
         from akka_allreduce_tpu.runtime.coordinator import \
             initialize_distributed
@@ -1026,8 +1048,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
                             jnp.asarray(build_batch(j)[1]))
                     ms = jax.tree.map(lambda x: x[None], m1)
                 last = i + n - 1
-                if mgr is not None and (i // args.ckpt_every
-                                        != (last + 1) // args.ckpt_every):
+                # --ckpt-every 0 means save-every-step on the per-step
+                # path (orbax's steps-since-last >= 0); the chunk
+                # rendering is save-every-chunk, i.e. an interval of 1
+                ce = max(1, args.ckpt_every)
+                if mgr is not None and (i // ce != (last + 1) // ce):
                     # the cadence gate must run at CHUNK granularity:
                     # boundary indices (spd-1, 2*spd-1, ...) are almost
                     # never multiples of --ckpt-every, so maybe_save's
@@ -1149,14 +1174,12 @@ def _add_eval(sub: argparse._SubParsersAction) -> None:
                    help="windows per device batch")
     p.add_argument("--max-windows", type=int, default=0,
                    help="stop after this many windows (0 = whole corpus)")
-    p.add_argument("--platform", default=None)
+    _add_backend_args(p)
 
 
 def _cmd_eval(args: argparse.Namespace) -> int:
+    _apply_backend_flags(args)
     import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
     import math
 
     import jax.numpy as jnp

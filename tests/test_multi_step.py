@@ -10,9 +10,12 @@ production rendering of the bench's scan-steps measurement
 repeated batch.
 """
 
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from akka_allreduce_tpu.models.train import (
     TrainConfig,
@@ -73,4 +76,41 @@ class TestMultiStepParity:
         cnt = [np.asarray(x) for x in jax.tree.leaves(o_chk)
                if np.asarray(x).dtype == np.int32]
         assert any((c == k).all() for c in cnt)
+
+
+@pytest.mark.slow
+class TestChunkedCliCheckpoints:
+    """cli train --steps-per-dispatch: checkpoints land at chunk
+    boundaries whenever a chunk crosses a --ckpt-every line (the plain
+    step%interval gate would never fire on boundary indices), and a
+    resumed run continues from the saved frontier."""
+
+    BASE = ["aat", "train", "--d-model", "16", "--n-layers", "1",
+            "--d-ff", "32", "--vocab", "31", "--seq", "8", "--batch",
+            "8", "--log-every", "100", "--ckpt-every", "10",
+            "--steps-per-dispatch", "4"]
+
+    def _run(self, monkeypatch, ckpt_dir, steps, capsys):
+        from akka_allreduce_tpu.cli import main
+        monkeypatch.setattr(sys, "argv", self.BASE + [
+            "--ckpt-dir", str(ckpt_dir), "--steps", str(steps)])
+        assert main() == 0
+        return capsys.readouterr().out
+
+    def test_chunk_boundary_saves_and_resume(self, monkeypatch, tmp_path,
+                                             capsys):
+        # chunks [0-3] [4-7] [8-11]: only the third crosses a multiple
+        # of 10, saving at its boundary step 11 (also the final step)
+        self._run(monkeypatch, tmp_path, 12, capsys)
+        steps = {int(d) for d in (p.name for p in tmp_path.iterdir())
+                 if d.isdigit()}
+        assert steps == {11}
+        # resume: chunks [12-15] [16-19], tail [20-21] per-step; the
+        # second chunk crosses 20 -> saves at 19; the final forced save
+        # lands at 21
+        out = self._run(monkeypatch, tmp_path, 22, capsys)
+        assert "resumed from step 11" in out
+        steps = {int(d) for d in (p.name for p in tmp_path.iterdir())
+                 if d.isdigit()}
+        assert {19, 21} <= steps
 
