@@ -700,6 +700,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print("error: --steps-per-dispatch must be >= 1",
               file=sys.stderr)
         return 2
+    # every loop below takes `% log_every` / `// log_every`; 0 (a
+    # plausible "never log" spelling) must not divide-by-zero — treat it
+    # as log-every-step, the least surprising reading
+    args.log_every = max(1, args.log_every)
     if args.steps_per_dispatch > 1 and (args.deadline_ms > 0
                                         or jax.process_count() > 1):
         # deadline masking and the hybrid interact with the host every

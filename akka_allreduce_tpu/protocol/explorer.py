@@ -1,0 +1,156 @@
+"""Schedule exploration — a race detector for the protocol plane.
+
+The deterministic router (transport.py) makes every run REPRODUCIBLE;
+this module makes the cross-actor delivery order an INPUT. The protocol's
+correctness story rests on order-independent invariants — the ``==``
+exactly-once threshold fires, ``output == N x input`` at full thresholds,
+honest sub-N counts under loss, no round stalls from any legal
+interleaving — and those claims are only as strong as the set of
+orderings they were checked under. The reference exercises exactly one
+ordering (its AllreduceSpec runs under Akka's single-threaded test
+dispatcher; reference: AllreduceSpec.scala:1-30), so a message race that
+only bites when worker B's scatter overtakes worker A's reduce would
+pass its suite. Here the same cluster runs under families of adversarial
+schedules (``Router.pump_scheduled``):
+
+* **random**: seeded uniform choice among ready actors — a different
+  full-cluster interleaving per seed;
+* **starvation**: one actor's mail is delayed as long as ANY other actor
+  has work — the message-plane rendering of a GC-paused / descheduled /
+  slow-NIC peer (the same adversary the deadline machinery exists for);
+* **exhaustive prefixes**: every possible delivery choice for the first
+  K steps — the window where registration, quorum formation, and the
+  round-0 scatter race — then deterministic rotation.
+
+A failure reproduces by construction: the schedule is the label.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from itertools import product
+from typing import Callable, Iterable, Iterator, Optional
+
+from akka_allreduce_tpu.protocol.transport import ActorRef
+
+# choose(ready_actors, step_index) -> the actor that delivers next
+Chooser = Callable[[list, int], ActorRef]
+
+
+def random_schedule(seed: int) -> Chooser:
+    """Uniform choice among ready actors, deterministic in ``seed``."""
+    rng = random.Random(seed)
+
+    def choose(ready: list, _step: int) -> ActorRef:
+        return ready[rng.randrange(len(ready))]
+
+    return choose
+
+
+def starvation_schedule(victim_name: str) -> Chooser:
+    """Deliver to ``victim_name`` only when nobody else has mail: the
+    victim's handler runs as late as a fair dispatcher could ever make
+    it, so anything that silently assumed its timeliness breaks."""
+
+    def choose(ready: list, _step: int) -> ActorRef:
+        for ref in ready:
+            if ref.name != victim_name:
+                return ref
+        return ready[0]
+
+    return choose
+
+
+def rotation_schedule(stride: int) -> Chooser:
+    """Fixed rotation with a stride through the ready set — cheap
+    structured coverage between random seeds (stride 1 is close to the
+    production round-robin pump)."""
+
+    def choose(ready: list, step: int) -> ActorRef:
+        return ready[(step * stride) % len(ready)]
+
+    return choose
+
+
+def prefix_schedule(prefix: tuple) -> Chooser:
+    """Scripted first ``len(prefix)`` choices (each an index into the
+    ready set, modulo its size), rotation after. With
+    :func:`exhaustive_prefixes` this enumerates EVERY reachable delivery
+    order over the first K steps."""
+
+    def choose(ready: list, step: int) -> ActorRef:
+        if step < len(prefix):
+            return ready[prefix[step] % len(ready)]
+        return ready[step % len(ready)]
+
+    return choose
+
+
+def exhaustive_prefixes(depth: int, width: int
+                        ) -> Iterator[tuple[str, Chooser]]:
+    """All ``width ** depth`` scripted prefixes of length ``depth``.
+    ``width`` bounds the ready-set size worth distinguishing (a cluster
+    of master + n workers has at most n+1 ready actors; indices wrap, so
+    width >= the true maximum loses nothing and duplicates nothing that
+    changes behavior)."""
+    for p in product(range(width), repeat=depth):
+        yield f"prefix{p}", prefix_schedule(p)
+
+
+def standard_schedules(actor_names: Iterable[str], seeds: int = 50
+                       ) -> Iterator[tuple[str, Chooser]]:
+    """The default battery: per-actor starvation, a stride sweep, and
+    ``seeds`` random interleavings."""
+    for name in actor_names:
+        yield f"starve:{name}", starvation_schedule(name)
+    for stride in (1, 2, 3, 5, 7):
+        yield f"rotation:stride{stride}", rotation_schedule(stride)
+    for s in range(seeds):
+        yield f"random:seed{s}", random_schedule(s)
+
+
+@dataclasses.dataclass
+class ScheduleFailure:
+    """One schedule under which the cluster violated an invariant."""
+    label: str
+    error: str
+
+    def __str__(self) -> str:  # pragma: no cover - repr sugar
+        return f"[{self.label}] {self.error}"
+
+
+def explore(make_cluster: Callable[[], object],
+            schedules: Iterable[tuple[str, Chooser]],
+            validate: Callable[[object], None],
+            prepare: Optional[Callable[[object], None]] = None,
+            budget: Optional[int] = None) -> list[ScheduleFailure]:
+    """Run a fresh cluster under every schedule and collect invariant
+    violations.
+
+    ``make_cluster`` builds a LocalCluster (or anything with ``start()``
+    and a ``router``); ``prepare`` runs after registration (kill a
+    worker, inject a probe); ``validate`` raises on any violated
+    invariant after the pump drains. Exceptions from handlers themselves
+    (a gate double-fired, an assertion inside a sink) are failures of
+    that schedule too, not of the harness — they land in the returned
+    list with the schedule's reproducing label. The runaway cap defaults
+    to the cluster's own workload-scaled ``_message_budget()`` (a fixed
+    cap would cry wolf on big healthy configs whose legitimate traffic
+    exceeds it — that is exactly why LocalCluster scales its budget).
+    """
+    failures = []
+    for label, chooser in schedules:
+        cluster = make_cluster()
+        cap = budget if budget is not None else getattr(
+            cluster, "_message_budget", lambda: 1_000_000)()
+        try:
+            cluster.start()
+            if prepare is not None:
+                prepare(cluster)
+            cluster.router.pump_scheduled(chooser, max_messages=cap)
+            validate(cluster)
+        except Exception as exc:
+            failures.append(ScheduleFailure(
+                label, f"{type(exc).__name__}: {exc}"))
+    return failures

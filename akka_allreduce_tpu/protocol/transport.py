@@ -107,6 +107,39 @@ class Router:
                 return processed
 
 
+    def pump_scheduled(self, choose: Callable[[list, int], "ActorRef"],
+                       max_messages: int = 1_000_000,
+                       strict: bool = True) -> int:
+        """Adversarial-schedule pump: at every step ``choose(ready, step)``
+        picks WHICH actor delivers its next message, from the list of
+        actors with non-empty handler-owned mailboxes (registration
+        order). FIFO per mailbox — the delivery guarantee the protocol
+        relies on — is preserved; only the cross-actor interleaving
+        varies, which is exactly the nondeterminism a concurrent actor
+        dispatcher exhibits in production and the round-robin
+        :meth:`pump` hides. The schedule explorer
+        (protocol/explorer.py) drives this with random, starvation, and
+        exhaustive-prefix schedules to check protocol invariants across
+        orderings. Runs until quiescent; budget semantics match
+        :meth:`pump`."""
+        processed = 0
+        while True:
+            ready = [r for r in self._order
+                     if self._handlers.get(r) is not None
+                     and self._mailboxes.get(r)]
+            if not ready:
+                return processed
+            ref = choose(ready, processed)
+            self._handlers[ref](self._mailboxes[ref].popleft())
+            processed += 1
+            if processed >= max_messages:
+                if strict:
+                    raise RuntimeError(
+                        f"scheduled pump exceeded {max_messages} "
+                        "messages — likely a re-queue loop")
+                return processed
+
+
 class Probe:
     """A recording endpoint for protocol tests: poses as any number of peers
     and exposes what the unit under test sent

@@ -1,0 +1,208 @@
+"""Race detection across message interleavings (protocol/explorer.py).
+
+The reference's suite checks ONE delivery order (Akka's single-threaded
+test dispatcher); these tests check the protocol invariants across
+hundreds of adversarial orderings. SURVEY §5 row 'race detection'; the
+invariants are §3a's: exactly-once gates, output == N x input with full
+counts at thresholds 1.0, honest sub-N counts with a dead worker, and no
+stalls from any legal interleaving.
+"""
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    DataConfig,
+    ThresholdConfig,
+    WorkerConfig,
+)
+from akka_allreduce_tpu.protocol.cluster import (
+    LocalCluster,
+    constant_range_source,
+)
+from akka_allreduce_tpu.protocol.explorer import (
+    ScheduleFailure,
+    exhaustive_prefixes,
+    explore,
+    prefix_schedule,
+    random_schedule,
+    standard_schedules,
+    starvation_schedule,
+)
+
+
+def make_config(n, data_size, chunk, max_lag=1, max_round=5,
+                th=(1.0, 1.0, 1.0)):
+    return AllreduceConfig(
+        thresholds=ThresholdConfig(*th),
+        data=DataConfig(data_size=data_size, max_chunk_size=chunk,
+                        max_round=max_round),
+        workers=WorkerConfig(total_size=n, max_lag=max_lag),
+    )
+
+
+def make_exact_cluster(outputs, n=2, data_size=10, max_round=5):
+    config = make_config(n, data_size, chunk=2, max_lag=1,
+                         max_round=max_round)
+    for r in range(n):
+        outputs[r] = []
+    return LocalCluster(
+        config,
+        source_factory=lambda r: constant_range_source(data_size),
+        sink_factory=lambda r: outputs[r].append,
+    )
+
+
+def exact_validator(outputs, n, data_size, max_round):
+    """thresholds=1.0 invariants (reference: AllreduceWorker.scala
+    benchmark assert): every flush is exactly N x input with counts N,
+    and every round completes under every legal ordering."""
+    expected = np.arange(data_size, dtype=np.float32) * n
+
+    def validate(cluster):
+        if len(cluster.completed_rounds) != max_round:
+            raise AssertionError(
+                f"completed {len(cluster.completed_rounds)} rounds, "
+                f"wanted {max_round}")
+        for r in range(n):
+            if len(outputs[r]) != max_round + 1:  # rounds 0..max inclusive
+                raise AssertionError(
+                    f"worker {r} flushed {len(outputs[r])} outputs")
+            for out in outputs[r]:
+                np.testing.assert_array_equal(out.data, expected)
+                assert (out.count == n).all()
+
+    return validate
+
+
+class TestExactInvariantsAcrossSchedules:
+    def test_standard_battery_2workers(self):
+        n, ds, rounds = 2, 10, 5
+        outputs = {}
+        names = ["master"] + [f"worker-{r}" for r in range(n)]
+        failures = explore(
+            lambda: make_exact_cluster(outputs, n, ds, rounds),
+            standard_schedules(names, seeds=60),
+            exact_validator(outputs, n, ds, rounds))
+        assert not failures, "\n".join(map(str, failures))
+
+    def test_exhaustive_startup_prefixes_2workers(self):
+        """EVERY delivery order over the first 7 steps (3^7 = 2187
+        schedules): registration, quorum, InitWorkers and the round-0
+        scatter all race inside that window."""
+        n, ds, rounds = 2, 4, 2
+        outputs = {}
+        failures = explore(
+            lambda: make_exact_cluster(outputs, n, ds, rounds),
+            exhaustive_prefixes(depth=7, width=3),
+            exact_validator(outputs, n, ds, rounds))
+        assert not failures, "\n".join(map(str, failures[:5]))
+
+    @pytest.mark.slow
+    def test_standard_battery_4workers_script_config(self):
+        """The reference's canonical 4w/778/chunk-3 script config under
+        the full battery (reference: scripts/testAllreduceMaster.sc)."""
+        n, ds, rounds = 4, 778, 4
+        outputs = {}
+        config = make_config(n, ds, chunk=3, max_lag=3, max_round=rounds)
+
+        def make():
+            for r in range(n):
+                outputs[r] = []
+            return LocalCluster(
+                config,
+                source_factory=lambda r: constant_range_source(ds),
+                sink_factory=lambda r: outputs[r].append,
+            )
+
+        names = ["master"] + [f"worker-{r}" for r in range(n)]
+        failures = explore(
+            make, standard_schedules(names, seeds=40),
+            exact_validator(outputs, n, ds, rounds))
+        assert not failures, "\n".join(map(str, failures))
+
+
+class TestLossyInvariantsAcrossSchedules:
+    def test_dead_worker_honest_counts_all_orderings(self):
+        """Kill rank 1 after registration; under EVERY schedule the
+        survivors' rounds complete with honest counts (the dead rank
+        contributes nothing; nobody inflates N)."""
+        n, ds, rounds = 4, 16, 4
+        outputs = {}
+        config = make_config(n, ds, chunk=4, max_lag=2, max_round=rounds,
+                             th=(0.7, 0.7, 0.7))
+
+        def make():
+            for r in range(n):
+                outputs[r] = []
+            return LocalCluster(
+                config,
+                source_factory=lambda r: constant_range_source(ds),
+                sink_factory=lambda r: outputs[r].append,
+            )
+
+        def validate(cluster):
+            if len(cluster.completed_rounds) != rounds:
+                raise AssertionError(
+                    f"{len(cluster.completed_rounds)} rounds != {rounds}")
+            base = np.arange(ds, dtype=np.float32)
+            flushed = 0
+            for r in (0, 2, 3):  # rank 1 is dead
+                for out in outputs[r]:
+                    flushed += 1
+                    assert (out.count <= n).all()
+                    assert (out.count >= 1).any()
+                    # chunk-constant counts: each element's value is its
+                    # contributor count x input (honest accounting)
+                    np.testing.assert_allclose(
+                        out.data, base * out.count, rtol=1e-6)
+            if not flushed:
+                raise AssertionError("no survivor flushed anything")
+
+        names = ["master"] + [f"worker-{r}" for r in range(n)]
+        failures = explore(
+            make, standard_schedules(names, seeds=40), validate,
+            prepare=lambda c: c.kill_worker(1))
+        assert not failures, "\n".join(map(str, failures[:5]))
+
+
+class TestScheduleMachinery:
+    def test_random_schedule_is_deterministic_in_seed(self):
+        a, b = random_schedule(7), random_schedule(7)
+        c = random_schedule(8)
+        ready = list(range(5))  # any indexable works
+        pa = [a(ready, i) for i in range(50)]
+        pb = [b(ready, i) for i in range(50)]
+        pc = [c(ready, i) for i in range(50)]
+        assert pa == pb
+        assert pa != pc
+
+    def test_starvation_schedule_prefers_others(self):
+        class R:
+            def __init__(self, name):
+                self.name = name
+
+        v, o = R("victim"), R("other")
+        s = starvation_schedule("victim")
+        assert s([v, o], 0) is o
+        assert s([v], 1) is v
+
+    def test_prefix_schedule_wraps_indices(self):
+        s = prefix_schedule((5,))
+        ready = ["a", "b", "c"]
+        assert s(ready, 0) == ready[5 % 3]
+        assert s(ready, 1) == ready[1]  # rotation past the prefix
+
+    def test_exhaustive_prefix_count(self):
+        assert sum(1 for _ in exhaustive_prefixes(3, 2)) == 8
+
+    def test_failure_label_reproduces(self):
+        # a validator that always fails must surface every schedule label
+        outputs = {}
+        failures = explore(
+            lambda: make_exact_cluster(outputs, 2, 4, 1),
+            [("random:seed0", random_schedule(0))],
+            lambda cluster: (_ for _ in ()).throw(AssertionError("boom")))
+        assert failures == [ScheduleFailure("random:seed0",
+                                            "AssertionError: boom")]
