@@ -1146,7 +1146,16 @@ def _cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
-def _cmd_info(_args: argparse.Namespace) -> int:
+def _cmd_info(args: argparse.Namespace) -> int:
+    if getattr(args, "scaling", False):
+        # no backend init needed: the curve is a model, not a probe
+        from akka_allreduce_tpu.parallel.scaling import (format_table,
+                                                         scaling_table)
+        rows = scaling_table(
+            payload_floats=args.payload_mfloats * 1e6,
+            measured_1chip_goodput_gbps=args.goodput_gbps)
+        print(format_table(rows))
+        return 0
     from akka_allreduce_tpu.runtime.coordinator import topology_summary
 
     t = topology_summary()
@@ -1252,7 +1261,23 @@ def main(argv: list[str] | None = None) -> int:
     _add_train(sub)
     _add_generate(sub)
     _add_eval(sub)
-    sub.add_parser("info", help="topology summary")
+    p_info = sub.add_parser("info", help="topology summary; --scaling "
+                            "prints the analytic ICI scaling curve")
+    p_info.add_argument("--scaling", action="store_true",
+                        help="print the modeled ring-allreduce bus-"
+                             "bandwidth curve 8->256 chips "
+                             "(parallel/scaling.py; BASELINE.md north "
+                             "star) — a MODEL over public ICI specs, "
+                             "floored by this repo's measured 1-chip "
+                             "overhead, not a fleet measurement")
+    p_info.add_argument("--payload-mfloats", type=float, default=100.0,
+                        help="allreduce payload in millions of f32 "
+                             "(north-star config: 100)")
+    p_info.add_argument("--goodput-gbps", type=float, default=305.46,
+                        help="measured 1-chip full-sync-path goodput "
+                             "GB/s used as the overhead floor (default: "
+                             "PERF.md allreduce_goodput_25M_f32_1chip, "
+                             "the 2026-07-31 capture)")
     sub.add_parser("bench", help="device-plane goodput benchmark")
     args = parser.parse_args(argv)
     return {"emulate": _cmd_emulate, "master": _cmd_master,

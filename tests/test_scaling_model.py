@@ -1,0 +1,111 @@
+"""Pins for the analytic ICI scaling model (parallel/scaling.py).
+
+The model is the single-chip-honest rendering of BASELINE.md's 256-chip
+north star; these tests pin its algebra (the claims are only auditable
+if the formulas cannot drift) and the labeled-prediction framing.
+"""
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.parallel.scaling import (
+    IciSpec,
+    default_spec,
+    format_table,
+    predict,
+    ring_wire_seconds,
+    scaling_table,
+)
+
+
+class TestRingAlgebra:
+    def test_wire_formula_exact(self):
+        spec = IciSpec(link_gbytes_s=50.0, ring_directions=2, rings=1,
+                       hop_latency_s=0.0)
+        # n=4: 2(n-1)=6 steps of S/4 bytes at 100 GB/s
+        s = ring_wire_seconds(400e6, 4, spec)
+        assert s == pytest.approx(6 * 100e6 / 100e9)
+
+    def test_single_chip_is_free(self):
+        assert ring_wire_seconds(1e9, 1, IciSpec()) == 0.0
+
+    def test_hop_latency_term(self):
+        spec = IciSpec(link_gbytes_s=50.0, hop_latency_s=2e-6)
+        base = IciSpec(link_gbytes_s=50.0, hop_latency_s=0.0)
+        n = 8
+        extra = (ring_wire_seconds(4e6, n, spec)
+                 - ring_wire_seconds(4e6, n, base))
+        assert extra == pytest.approx(2 * (n - 1) * 2e-6)
+
+    def test_busbw_approaches_ring_ceiling_for_large_payload(self):
+        """busbw -> ring bandwidth as the payload swamps latency and
+        overhead — the property that makes 'efficiency' meaningful."""
+        spec = IciSpec(link_gbytes_s=45.0)
+        row = predict(4e12, 256, spec)  # 1T floats: latency negligible
+        assert row.efficiency == pytest.approx(1.0, abs=1e-3)
+
+    def test_overhead_floor_adds_not_maxes(self):
+        spec = IciSpec()
+        free = predict(400e6, 8, spec)
+        floored = predict(400e6, 8, spec,
+                          measured_1chip_goodput_gbps=305.0)
+        assert floored.overhead_s == pytest.approx(400e6 / 305e9)
+        assert floored.total_s == pytest.approx(
+            free.total_s + floored.overhead_s)
+        assert floored.efficiency < free.efficiency
+
+
+class TestNorthStar:
+    def test_256chip_100m_floats_above_80pct(self):
+        """The BASELINE.md north-star row AS A PREDICTION: >= 80% ring
+        efficiency at 256 chips on 100M f32, including this repo's
+        measured 1-chip overhead floor. If a framework change drags the
+        measured goodput low enough to break this, the model (and this
+        pin) says so before any fleet does."""
+        rows = scaling_table(100e6, chips=(256,),
+                             measured_1chip_goodput_gbps=305.0)
+        assert rows[0].efficiency >= 0.80
+
+    def test_efficiency_erodes_with_chips_at_fixed_payload(self):
+        effs = [r.efficiency for r in scaling_table(
+            100e6, chips=(8, 64, 256),
+            measured_1chip_goodput_gbps=305.0)]
+        # the hop-latency term grows with n while moved bytes saturate
+        assert effs[0] > effs[-1]
+
+    def test_table_is_labeled_a_model(self):
+        txt = format_table(scaling_table(100e6, chips=(8, 256)))
+        assert "MODEL" in txt
+        assert "256" in txt
+
+
+class TestOverrides:
+    def test_env_override_hits_default_spec_only(self, monkeypatch):
+        monkeypatch.setenv("AATPU_ICI_GBPS", "90")
+        assert default_spec().ring_gbytes_s == pytest.approx(180.0)
+        # an EXPLICIT spec always means what it says: ambient env must
+        # not silently rewrite an explicit argument
+        assert IciSpec(link_gbytes_s=50.0).ring_gbytes_s == \
+            pytest.approx(100.0)
+        monkeypatch.delenv("AATPU_ICI_GBPS")
+        assert default_spec().ring_gbytes_s == pytest.approx(90.0)
+
+    @pytest.mark.parametrize("bad", ["0", "-3", "fast"])
+    def test_env_garbage_fails_at_the_boundary(self, monkeypatch, bad):
+        monkeypatch.setenv("AATPU_ICI_GBPS", bad)
+        with pytest.raises(ValueError, match="AATPU_ICI_GBPS"):
+            default_spec()
+
+    def test_second_torus_ring_halves_wire_time(self):
+        one = IciSpec(rings=1, hop_latency_s=0.0)
+        two = IciSpec(rings=2, hop_latency_s=0.0)
+        assert ring_wire_seconds(4e8, 16, two) == pytest.approx(
+            ring_wire_seconds(4e8, 16, one) / 2)
+
+    def test_moved_bytes_factor(self):
+        """busbw / algobw == 2(n-1)/n exactly — the NCCL convention."""
+        row = predict(4e8, 8, IciSpec(),
+                      measured_1chip_goodput_gbps=300.0)
+        assert row.busbw_gbytes_s / row.algobw_gbytes_s == pytest.approx(
+            2 * 7 / 8)
+        assert np.isfinite(row.total_s)
