@@ -1151,9 +1151,16 @@ def _cmd_info(args: argparse.Namespace) -> int:
         # no backend init needed: the curve is a model, not a probe
         from akka_allreduce_tpu.parallel.scaling import (format_table,
                                                          scaling_table)
+        if args.payload_mfloats <= 0:
+            print("error: --payload-mfloats must be > 0", file=sys.stderr)
+            return 2
+        if args.goodput_gbps < 0:
+            print("error: --goodput-gbps must be >= 0 (0 = no overhead "
+                  "floor)", file=sys.stderr)
+            return 2
         rows = scaling_table(
             payload_floats=args.payload_mfloats * 1e6,
-            measured_1chip_goodput_gbps=args.goodput_gbps)
+            measured_1chip_goodput_gbps=args.goodput_gbps or None)
         print(format_table(rows))
         return 0
     from akka_allreduce_tpu.runtime.coordinator import topology_summary

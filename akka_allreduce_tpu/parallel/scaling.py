@@ -95,6 +95,7 @@ class ScalingRow:
     busbw_gbytes_s: float
     algobw_gbytes_s: float
     efficiency: float  # busbw / ring wire ceiling
+    spec: IciSpec  # the spec these numbers were computed against
 
 
 def predict(payload_bytes: float, n: int, spec: Optional[IciSpec] = None,
@@ -111,6 +112,16 @@ def predict(payload_bytes: float, n: int, spec: Optional[IciSpec] = None,
     — the pessimistic composition, chosen deliberately.
     """
     spec = spec or default_spec()
+    if measured_1chip_goodput_gbps is not None \
+            and measured_1chip_goodput_gbps <= 0:
+        # same boundary discipline as AATPU_ICI_GBPS: a nonsense floor
+        # must fail here, not print inf%-efficiency rows (None — not 0 —
+        # is the spelling for "no overhead floor")
+        raise ValueError(
+            f"measured_1chip_goodput_gbps must be > 0 (or None for no "
+            f"overhead floor), got {measured_1chip_goodput_gbps}")
+    if payload_bytes <= 0:
+        raise ValueError(f"payload_bytes must be > 0, got {payload_bytes}")
     wire = ring_wire_seconds(payload_bytes, n, spec)
     overhead = (payload_bytes / (measured_1chip_goodput_gbps * 1e9)
                 if measured_1chip_goodput_gbps else 0.0)
@@ -119,7 +130,7 @@ def predict(payload_bytes: float, n: int, spec: Optional[IciSpec] = None,
     busbw = moved / total / 1e9 if total > 0 else float("inf")
     algobw = payload_bytes / total / 1e9 if total > 0 else float("inf")
     eff = busbw / spec.ring_gbytes_s
-    return ScalingRow(n, wire, overhead, total, busbw, algobw, eff)
+    return ScalingRow(n, wire, overhead, total, busbw, algobw, eff, spec)
 
 
 def scaling_table(payload_floats: float = 100e6,
@@ -133,9 +144,11 @@ def scaling_table(payload_floats: float = 100e6,
             for n in chips]
 
 
-def format_table(rows: Sequence[ScalingRow], spec: Optional[IciSpec] = None
-                 ) -> str:
-    spec = spec or default_spec()
+def format_table(rows: Sequence[ScalingRow]) -> str:
+    """Render rows under the spec THEY were computed against (stamped on
+    each row by :func:`predict` — a separately-derived header spec could
+    silently contradict the efficiency column)."""
+    spec = rows[0].spec if rows else default_spec()
     out = [
         f"ring allreduce over {spec.name} ICI "
         f"(ring bw {spec.ring_gbytes_s:.0f} GB/s, "

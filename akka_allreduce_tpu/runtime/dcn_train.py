@@ -543,7 +543,9 @@ class DcnDeadlineTrainer:
     def _read_mask(self, r: int) -> list[list[bool]]:
         """Wait for the master's mask with diagnosable failure modes: a
         dead master trips the heartbeat watch within ``hb_timeout_s``; a
-        mask already deleted because we stalled past retention raises the
+        master that exited — cleanly or crashed, even BEFORE its first
+        heartbeat — trips the done-marker probe within ~0.25 s; a mask
+        already deleted because we stalled past retention raises the
         checkpoint-resume guidance (a process can stall INSIDE run_round,
         where catch_up's identical check never runs); and a master that
         stopped publishing without dying times out with its own
@@ -551,6 +553,7 @@ class DcnDeadlineTrainer:
         deadline = time.monotonic() + self.deadline_s * 2 \
             + self.barrier_timeout_s
         hb_check = self._hb_watch()
+        done_next = 0.0
         while True:
             s = self._try_get(self._maskkey(r))
             if s is not None:
@@ -565,6 +568,28 @@ class DcnDeadlineTrainer:
                     f"stalled at round {r} while the cluster reached "
                     f"{cur_s}, beyond the {self.retain}-round retention "
                     f"window", current_round=int(cur_s))
+            now = time.monotonic()
+            if now >= done_next:
+                # the done marker is set UNCONDITIONALLY by the master's
+                # close() — crash paths included — so it catches the one
+                # death the heartbeat watch cannot: a master that died
+                # before its FIRST beat ever published (the watch
+                # deliberately never fires on no-beat-yet, and the
+                # fallback was the full 2*deadline + barrier slow path).
+                # Checked AFTER the retention branch: a stalled-beyond-
+                # retention worker must take the typed rejoin signal (its
+                # snapshot protocol has a final-checkpoint grace path
+                # with a closing master) rather than this terminal error.
+                # The mask re-check closes the publish-then-close race.
+                done_next = now + 0.25
+                if self._try_get(self._donekey) is not None:
+                    s = self._try_get(self._maskkey(r))
+                    if s is not None:
+                        return self._parse_mask(s)
+                    raise TimeoutError(
+                        f"no mask for round {r}: the master already "
+                        f"closed (finished or died) — restart every "
+                        f"process from the last checkpoint")
             hb_check()
             if time.monotonic() >= deadline:
                 raise TimeoutError(

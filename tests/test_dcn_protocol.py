@@ -108,7 +108,10 @@ def run_cluster(trainers, steps, **per_rank_kw):
         t.start()
     for t in threads:
         t.join(timeout=60)
-        assert not t.is_alive(), "cluster thread hung"
+        if t.is_alive():  # dump WHERE it hangs before failing
+            import faulthandler
+            faulthandler.dump_traceback()
+            raise AssertionError("cluster thread hung")
     return results, errors
 
 
@@ -364,6 +367,30 @@ class TestReplicaDivergence:
         assert 1 in errors and isinstance(errors[1],
                                           (TimeoutError, RuntimeError)), \
             errors
+
+    def test_master_dead_before_first_beat_fails_fast(self):
+        """A master that crashes before its heartbeat thread ever
+        publishes (hb_interval here outlives the run) leaves NOTHING for
+        the worker's hb watch to observe — the watch deliberately never
+        fires on no-beat-yet. The unconditional done marker from the
+        master's close() must catch that death, or the worker waits the
+        full 2*deadline + barrier_timeout slow path (the load-induced
+        hang this pins: under GIL contention a FakeKv run can finish
+        before the 0.1s first beat)."""
+        client = FakeKvClient()
+        t0 = time.monotonic()
+        master = make_trainer(0, 2, client, deadline_s=2.0,
+                              check_every=2, hb_interval_s=3600.0)
+        worker = make_trainer(1, 2, client, deadline_s=2.0,
+                              check_every=2, opt_lr=0.2,
+                              hb_timeout_s=0.5)
+        results, errors = run_cluster([master, worker], 8)
+        assert 0 in errors and "replica divergence" in str(errors[0]), \
+            errors
+        assert 1 in errors and isinstance(errors[1],
+                                          (TimeoutError, RuntimeError)), \
+            errors
+        assert time.monotonic() - t0 < 30  # not the 304s slow path
 
     def test_identical_replicas_pass(self):
         client = FakeKvClient()
