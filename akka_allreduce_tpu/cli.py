@@ -346,6 +346,16 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "deadline pacer and the multi-host hybrid")
     p.add_argument("--log-every", type=int, default=10,
                    help="print a progress line every N steps")
+    p.add_argument("--xprof-dir", default=None, metavar="DIR",
+                   help="write a jax.profiler device trace "
+                        "(TensorBoard/XProf-viewable: per-op device "
+                        "timeline, HLO, memory) covering K steps "
+                        "starting at step 2 — step 1 is excluded so "
+                        "compile does not drown the timeline. The "
+                        "device-plane sibling of --trace-file's "
+                        "host-plane protocol events")
+    p.add_argument("--xprof-steps", type=int, default=3, metavar="K",
+                   help="how many steps the --xprof-dir trace covers")
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="run N train steps inside one jitted lax.scan "
                         "per host dispatch (models/train.py "
@@ -436,6 +446,35 @@ def _apply_backend_flags(args: argparse.Namespace) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+class _XprofWindow:
+    """Device-trace window for ``train --xprof-dir``: opens at
+    ``start_step`` (skipping step 0's compile), closes ``n_steps``
+    later or at run end, whichever first. ``tick(i)`` is called with
+    the step index about to execute; ``close()`` is crash-safe so a
+    preempted run still flushes a viewable trace."""
+
+    def __init__(self, log_dir, start_step: int = 1, n_steps: int = 3):
+        self.dir, self.start, self.n = log_dir, start_step, n_steps
+        self._state = 0 if log_dir else 2  # 0 idle, 1 tracing, 2 done
+
+    def tick(self, i: int) -> None:
+        if self._state == 2:
+            return
+        import jax
+        if self._state == 0 and i >= self.start:
+            jax.profiler.start_trace(self.dir)
+            self._state = 1
+        elif self._state == 1 and i >= self.start + self.n:
+            jax.profiler.stop_trace()
+            self._state = 2
+
+    def close(self) -> None:
+        if self._state == 1:
+            import jax
+            jax.profiler.stop_trace()
+            self._state = 2
 
 
 def _add_model_args(p: argparse.ArgumentParser) -> None:
@@ -840,6 +879,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
     tic = time.perf_counter()
     steps_in_window = 0
+    xprof = _XprofWindow(args.xprof_dir, start_step=start + 1,
+                         n_steps=args.xprof_steps)
     try:
         if hybrid:
             # round-driven loop: a process that caught up after a stall
@@ -943,6 +984,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 i = dcn.round
                 if i >= args.steps:
                     break
+                xprof.tick(i)
                 step_rng, batch_np = build_batch(i)
                 # each process is a macro data rank: it feeds ITS slice
                 # of the global batch to its local mesh; the cross-
@@ -1037,6 +1079,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
             multi = make_multi_step(cfg, mesh, opt)
             i = start
             while i < args.steps:
+                xprof.tick(i)  # chunk granularity: whole chunks traced
                 n = min(spd, args.steps - i)
                 if n == spd:
                     chunk_np = np.stack(
@@ -1081,6 +1124,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 i += n
             loop_start = args.steps  # per-step loop below fully consumed
         for i in range(loop_start, args.steps):
+            xprof.tick(i)
             step_rng, batch_np = build_batch(i)
             if jax.process_count() > 1:
                 # every process computed the same global batch; build the
@@ -1139,7 +1183,16 @@ def _cmd_train(args: argparse.Namespace) -> int:
                          {"data_step": final}, force=True)
     finally:
         # Preemption/SIGINT is this feature's target scenario: always let
-        # an in-flight async save land before the process dies.
+        # an in-flight async save land (and any open device trace flush)
+        # before the process dies. The trace flush must not be able to
+        # take the checkpoint flush down with it (disk-full on
+        # --xprof-dir would otherwise drop the save AND mask the
+        # original exception).
+        try:
+            xprof.close()
+        except Exception as exc:
+            print(f"WARNING: device trace flush failed: {exc}",
+                  file=sys.stderr)
         if mgr is not None:
             mgr.wait_until_finished()
             mgr.close()

@@ -292,6 +292,19 @@ class DcnDeadlineTrainer:
         self._chunk_elems = 0  # wire-chunk geometry, set at _ensure_wire
         self._n_chunks = 0
         self._hb_stop: Optional[threading.Event] = None
+        if self.master:
+            # a PREVIOUS run's liveness keys in a reused namespace are
+            # poison: a stale done marker insta-kills fresh workers'
+            # mask waits, and a stale frozen heartbeat value trips their
+            # watch as a false master death. Clear both before any
+            # worker can probe them (masters construct before workers
+            # publish; the remaining start-order race is covered by the
+            # stale-namespace guidance in the worker's error message)
+            for key in (self._donekey, self._hbkey):
+                try:
+                    self._kv.key_value_delete(key)
+                except Exception:
+                    pass  # usually just "not found" on a fresh namespace
         if self.master and self.hb_interval_s > 0:
             self._hb_stop = threading.Event()
             t = threading.Thread(target=self._hb_loop, daemon=True,
@@ -589,7 +602,12 @@ class DcnDeadlineTrainer:
                     raise TimeoutError(
                         f"no mask for round {r}: the master already "
                         f"closed (finished or died) — restart every "
-                        f"process from the last checkpoint")
+                        f"process from the last checkpoint. If this "
+                        f"fires at startup, a stale namespace from a "
+                        f"previous run is the likely cause (the master "
+                        f"clears it on boot, but a worker racing ahead "
+                        f"of the master's construction can still read "
+                        f"it): change --namespace")
             hb_check()
             if time.monotonic() >= deadline:
                 raise TimeoutError(

@@ -411,6 +411,22 @@ class TestWireFormat:
         _, toks, _ = decode_payload(encode_payload(vec, 0.0, big, "f32"))
         assert toks == 2 ** 33 + 7
 
+    def test_stale_liveness_keys_cleared_on_master_boot(self):
+        """A reused namespace holding a previous run's done marker and
+        frozen heartbeat must not poison a fresh run: the master clears
+        both at construction, so workers neither insta-die on the stale
+        done key nor false-detect master death on the frozen beat."""
+        client = FakeKvClient()
+        client.key_value_set("aatdcn/done", "1")
+        client.key_value_set("aatdcn/hb", "999")
+        n, steps = 2, 4
+        trainers = [make_trainer(i, n, client, deadline_s=2.0,
+                                 hb_timeout_s=0.5 if i else 0.0)
+                    for i in range(n)]
+        results, errors = run_cluster(trainers, steps)
+        assert not errors, errors
+        np.testing.assert_array_equal(results[0], results[1])
+
     def test_stale_namespace_guidance(self):
         """A mask key left over from a previous run on the same
         coordination-service incarnation produces actionable guidance,

@@ -79,6 +79,28 @@ class TestMultiStepParity:
 
 
 @pytest.mark.slow
+class TestXprofTrace:
+    """train --xprof-dir writes a TensorBoard-viewable device trace
+    (the device-plane sibling of --trace-file's host protocol events;
+    SURVEY §5 tracing row)."""
+
+    def test_trace_written_and_crash_safe_window(self, monkeypatch,
+                                                 tmp_path, capsys):
+        from akka_allreduce_tpu.cli import main
+        monkeypatch.setattr(sys, "argv", [
+            "aat", "train", "--steps", "4", "--xprof-steps", "2",
+            "--xprof-dir", str(tmp_path / "prof"), "--d-model", "16",
+            "--n-layers", "1", "--d-ff", "32", "--vocab", "31", "--seq",
+            "8", "--batch", "8", "--log-every", "100"])
+        assert main() == 0
+        capsys.readouterr()
+        runs = list((tmp_path / "prof" / "plugins" / "profile").iterdir())
+        assert len(runs) == 1
+        names = {p.name for p in runs[0].iterdir()}
+        assert any(n.endswith(".xplane.pb") for n in names), names
+
+
+@pytest.mark.slow
 class TestChunkedCliCheckpoints:
     """cli train --steps-per-dispatch: checkpoints land at chunk
     boundaries whenever a chunk crosses a --ckpt-every line (the plain
