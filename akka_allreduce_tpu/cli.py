@@ -346,6 +346,16 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "deadline pacer and the multi-host hybrid")
     p.add_argument("--log-every", type=int, default=10,
                    help="print a progress line every N steps")
+    p.add_argument("--optimizer", default="adamw",
+                   choices=["adamw", "adafactor", "sgd", "lion"],
+                   help="optimizer family (models/train.py "
+                        "make_optimizer): adafactor = factored second "
+                        "moments, the TPU-classic optimizer-memory "
+                        "saver; lion = half the state of adam; sgd = "
+                        "momentum via --sgd-momentum")
+    p.add_argument("--sgd-momentum", type=float, default=0.9,
+                   help="sgd only: momentum coefficient (0 disables; "
+                        "> 0 uses nesterov)")
     p.add_argument("--xprof-dir", default=None, metavar="DIR",
                    help="write a jax.profiler device trace "
                         "(TensorBoard/XProf-viewable: per-op device "
@@ -474,6 +484,18 @@ class _XprofWindow:
         if self._state == 1:
             import jax
             jax.profiler.stop_trace()
+            self._state = 2
+        elif self._state == 0:
+            # the user asked for a trace and no step ever reached the
+            # window (e.g. --steps-per-dispatch covering the whole run
+            # in one chunk: ticks happen at chunk STARTS, and chunk 0
+            # holds the compile the window exists to exclude) — an
+            # empty directory with no explanation would look like a
+            # profiler bug
+            print(f"WARNING: --xprof-dir {self.dir}: no steps reached "
+                  f"the trace window (opens at step {self.start + 1}); "
+                  f"lower --steps-per-dispatch or raise --steps",
+                  file=sys.stderr)
             self._state = 2
 
 
@@ -791,7 +813,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                       remat=args.remat,
                       lr_schedule=args.lr_schedule,
                       warmup_steps=args.warmup_steps,
-                      total_steps=args.steps, clip_norm=args.clip_norm)
+                      total_steps=args.steps, clip_norm=args.clip_norm,
+                      optimizer=args.optimizer,
+                      sgd_momentum=args.sgd_momentum)
     if args.pp > 1 and chatty:
         from akka_allreduce_tpu.parallel.pp import pp_schedule_stats
         st = pp_schedule_stats(args.pp, micro)

@@ -28,7 +28,7 @@ from __future__ import annotations
 import dataclasses
 import math
 from functools import partial
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -112,6 +112,14 @@ class TrainConfig:
     warmup_steps: int = 0
     total_steps: int = 0
     clip_norm: float = 0.0
+    # Optimizer family: "adamw" (default); "adafactor" — factored second
+    # moments, the TPU-classic optimizer-memory saver (O(r+c) instead of
+    # O(r*c) state per 2D param, the lever that lets chip-filling configs
+    # keep their batch); "sgd" (momentum via sgd_momentum, nesterov when
+    # > 0); "lion" (sign-of-momentum updates, adam-like quality at half
+    # the optimizer state)
+    optimizer: str = "adamw"
+    sgd_momentum: float = 0.9
     # Attention implementation: "auto" consults the measured per-chip
     # dispatch table (ops/pallas_kernels/dispatch.py) — on TPU that means
     # the fused Pallas flash kernel, and under sequence parallelism
@@ -231,11 +239,58 @@ def make_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh
         _validate_pp(cfg.model, pp)
         full = dict(full, layers=stack_layer_params(full["layers"]))
     params = shard_params(full, param_specs(cfg.model, pp=pp), mesh)
-    opt = optax.adamw(make_lr_schedule(cfg))
-    if cfg.clip_norm > 0:
-        opt = optax.chain(optax.clip_by_global_norm(cfg.clip_norm), opt)
+    opt = make_optimizer(cfg)
     opt_state = place_opt_state(opt, jax.jit(opt.init)(params), params, mesh)
     return params, opt_state, opt
+
+
+class StepCounterState(NamedTuple):
+    """State of :func:`step_counter` — a guaranteed per-step counter."""
+    count: jnp.ndarray
+
+
+def step_counter() -> optax.GradientTransformation:
+    """A no-op transform whose only job is a family-independent step
+    counter. The int8 gradient transport seeds its stochastic rounding
+    from the optimizer's step count; adam carries one, sgd does not —
+    pinning the counter to its own chain slot keeps make_train_step
+    agnostic of which family is running (and of optax's internal state
+    classes)."""
+
+    def init(_params):
+        return StepCounterState(jnp.zeros((), jnp.int32))
+
+    def update(updates, state, params=None):
+        del params
+        return updates, StepCounterState(state.count + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    """The training chain: step counter, optional global-norm clip, then
+    the configured family. Families beyond adamw are beyond-reference
+    surface; adafactor is the TPU-native default for optimizer-memory-
+    bound configs (factored second moments)."""
+    lr = make_lr_schedule(cfg)
+    fam = cfg.optimizer
+    if fam == "adamw":
+        core = optax.adamw(lr)
+    elif fam == "adafactor":
+        core = optax.adafactor(learning_rate=lr)
+    elif fam == "sgd":
+        core = optax.sgd(lr, momentum=cfg.sgd_momentum or None,
+                         nesterov=cfg.sgd_momentum > 0)
+    elif fam == "lion":
+        core = optax.lion(lr)
+    else:
+        raise ValueError(
+            f"unknown optimizer {fam!r}: adamw | adafactor | sgd | lion")
+    parts = [step_counter()]
+    if cfg.clip_norm > 0:
+        parts.append(optax.clip_by_global_norm(cfg.clip_norm))
+    parts.append(core)
+    return optax.chain(*parts)
 
 
 def make_lr_schedule(cfg: TrainConfig):
@@ -271,10 +326,18 @@ def place_opt_state(opt: optax.GradientTransformation, opt_state: Any,
     also what checkpoint restore uses as its sharding template
     (runtime/checkpoint.py)."""
     replicated = NamedSharding(mesh, P())
+
+    def place(s, p):
+        # adam moments are param-SHAPED and adopt the param's sharding;
+        # adafactor's factored second moments are param-ASSOCIATED but
+        # rank-reduced (row/col vectors for a 2D param), where the 2D
+        # spec is illegal — bookkeeping-sized, so they replicate
+        if getattr(s, "shape", None) == p.shape:
+            return jax.device_put(s, p.sharding)
+        return jax.device_put(s, replicated)
+
     return optax.tree_map_params(
-        opt,
-        lambda s, p: jax.device_put(s, p.sharding),
-        opt_state, params,
+        opt, place, opt_state, params,
         transform_non_params=lambda x: jax.device_put(x, replicated))
 
 
@@ -762,14 +825,16 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
     donate_args = (0, 1) if donate else ()
 
     def step_count(opt_state):
-        """The adam step counter. tree_get by key alone is ambiguous once
-        the optimizer chain carries several counters (the schedule state
-        counts too), so walk the (static) state structure for
-        ScaleByAdamState directly."""
+        """The chain's guaranteed step counter (make_optimizer pins a
+        StepCounterState slot for every family — adam's internal count
+        would tie this to one optimizer's state classes). tree_get by
+        key alone is ambiguous once the chain carries several counters
+        (the schedule state counts too), so walk the (static) state
+        structure for the dedicated type."""
         found = []
 
         def walk(node):
-            if isinstance(node, optax.ScaleByAdamState):
+            if isinstance(node, StepCounterState):
                 found.append(node.count)
             elif isinstance(node, (tuple, list)):
                 for x in node:
@@ -780,7 +845,9 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
 
         walk(opt_state)
         if not found:
-            raise ValueError("optimizer state has no ScaleByAdamState")
+            raise ValueError(
+                "optimizer state has no StepCounterState — build the "
+                "optimizer with make_optimizer (or chain step_counter())")
         return found[0]
 
     @partial(jax.jit, donate_argnums=donate_args)

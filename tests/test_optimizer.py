@@ -129,3 +129,72 @@ class TestClipping:
             losses.append(float(m["loss"]))
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0], losses
+
+
+class TestOptimizerFamilies:
+    """--optimizer families (models/train.py make_optimizer). adamw's
+    learning behavior is pinned throughout this file; these cover the
+    beyond-reference families and the family-independent step counter
+    the int8 transport's quant seed rides on."""
+
+    def _losses(self, fam, lr=5e-3, steps=10, **cfg_kw):
+        mesh = make_device_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
+        cfg = TrainConfig(model=MCFG, learning_rate=lr, bucket_elems=256,
+                          grad_axes=("dp",), optimizer=fam, **cfg_kw)
+        params, opt_state, opt = make_train_state(jax.random.key(1), cfg,
+                                                  mesh)
+        step = make_train_step(cfg, mesh, opt)
+        toks = tokens(b=4)
+        losses = []
+        for _ in range(steps):
+            params, opt_state, m = step(params, opt_state, toks)
+            losses.append(float(m["loss"]))
+        return losses, opt_state
+
+    @pytest.mark.parametrize("fam,lr", [
+        ("adafactor", 5e-3),
+        pytest.param("sgd", 5e-2, marks=pytest.mark.slow),
+        pytest.param("lion", 1e-3, marks=pytest.mark.slow),
+    ])
+    def test_family_learns(self, fam, lr):
+        losses, _ = self._losses(fam, lr=lr)
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+
+    def test_adafactor_state_is_factored(self):
+        """The point of adafactor: second-moment state is O(r+c) per 2D
+        param, not O(r*c) — total optimizer-state bytes must land far
+        under adamw's 2x-params."""
+        mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+
+        def state_bytes(fam):
+            cfg = TrainConfig(model=MCFG, optimizer=fam)
+            params, opt_state, _ = make_train_state(jax.random.key(0),
+                                                    cfg, mesh)
+            return sum(np.asarray(x).nbytes
+                       for x in jax.tree.leaves(opt_state)), params
+
+        ada, params = state_bytes("adafactor")
+        adam, _ = state_bytes("adamw")
+        psize = sum(np.asarray(x).nbytes for x in jax.tree.leaves(params))
+        assert adam >= 2 * psize          # m and v, param-shaped
+        assert ada < 0.75 * adam, (ada, adam)
+
+    def test_unknown_family_rejected(self):
+        from akka_allreduce_tpu.models.train import make_optimizer
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            make_optimizer(TrainConfig(model=MCFG, optimizer="adagrab"))
+
+    @pytest.mark.slow
+    def test_int8_transport_counter_with_sgd(self):
+        """sgd has no adam count; the chain's own StepCounterState must
+        seed the int8 transport — the family composes with the
+        quantized wire and the counter advances."""
+        from akka_allreduce_tpu.models.train import StepCounterState
+        losses, opt_state = self._losses("sgd", lr=5e-2, steps=6,
+                                         grad_transport="int8")
+        assert all(np.isfinite(losses))
+        counts = [np.asarray(s.count) for s in jax.tree.leaves(
+            opt_state, is_leaf=lambda x: isinstance(x, StepCounterState))
+            if isinstance(s, StepCounterState)]
+        assert counts and counts[0] == 6
