@@ -346,6 +346,13 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "deadline pacer and the multi-host hybrid")
     p.add_argument("--log-every", type=int, default=10,
                    help="print a progress line every N steps")
+    p.add_argument("--grad-accum", type=int, default=1, metavar="K",
+                   help="gradient accumulation: scan K microbatches "
+                        "accumulating LOCAL grads, sync once — "
+                        "activation memory of one microbatch at one "
+                        "collective per step (big-batch training on "
+                        "small chips). Non-pp path only; the pipeline "
+                        "has --microbatches")
     p.add_argument("--optimizer", default="adamw",
                    choices=["adamw", "adafactor", "sgd", "lion"],
                    help="optimizer family (models/train.py "
@@ -761,6 +768,14 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print("error: --steps-per-dispatch must be >= 1",
               file=sys.stderr)
         return 2
+    if args.grad_accum < 1:
+        print("error: --grad-accum must be >= 1", file=sys.stderr)
+        return 2
+    if args.grad_accum > 1 and args.pp > 1:
+        print("error: --grad-accum does not compose with --pp (the "
+              "pipeline path has its own --microbatches)",
+              file=sys.stderr)
+        return 2
     # every loop below takes `% log_every` / `// log_every`; 0 (a
     # plausible "never log" spelling) must not divide-by-zero — treat it
     # as log-every-step, the least surprising reading
@@ -783,7 +798,19 @@ def _cmd_train(args: argparse.Namespace) -> int:
         return 2
     micro = args.microbatches or (args.pp if args.pp > 1 else 1)
     nprocs = jax.process_count()
-    b = args.batch or 2 * dp * args.ep * micro * (nprocs if hybrid else 1)
+    b = args.batch or (2 * dp * args.ep * micro * args.grad_accum
+                       * (nprocs if hybrid else 1))
+    if args.grad_accum > 1:
+        # fail at the flag layer with the mesh math spelled out, not at
+        # trace time with only the local number
+        local_b = b // (nprocs if hybrid else 1) // (dp * args.ep)
+        if local_b % args.grad_accum:
+            print(f"error: --grad-accum {args.grad_accum} must divide "
+                  f"the per-rank batch {local_b} (= batch {b} / "
+                  f"{dp * args.ep} data ranks"
+                  + (f" / {nprocs} processes" if hybrid else "") + ")",
+                  file=sys.stderr)
+            return 2
     if hybrid and b % nprocs:
         print(f"error: --batch {b} must divide evenly over "
               f"{nprocs} processes (each feeds batch/{nprocs} rows to "
@@ -815,7 +842,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                       warmup_steps=args.warmup_steps,
                       total_steps=args.steps, clip_norm=args.clip_norm,
                       optimizer=args.optimizer,
-                      sgd_momentum=args.sgd_momentum)
+                      sgd_momentum=args.sgd_momentum,
+                      grad_accum=args.grad_accum)
     if args.pp > 1 and chatty:
         from akka_allreduce_tpu.parallel.pp import pp_schedule_stats
         st = pp_schedule_stats(args.pp, micro)

@@ -120,6 +120,16 @@ class TrainConfig:
     # the optimizer state)
     optimizer: str = "adamw"
     sgd_momentum: float = 0.9
+    # Gradient accumulation (non-pp path): split the local batch into K
+    # microbatches, scan them accumulating LOCAL gradients, then run the
+    # bucketed cross-rank sync ONCE — activation memory drops to one
+    # microbatch's while the collective cost stays one sync per step
+    # (accumulating synced grads would pay K collectives). Loss and
+    # dense gradients are bitwise the linearity identity; MoE aux-loss /
+    # capacity become per-microbatch (standard microbatching semantics,
+    # same as the pp path's). pp > 1 has its own microbatching — the two
+    # do not compose.
+    grad_accum: int = 1
     # Attention implementation: "auto" consults the measured per-chip
     # dispatch table (ops/pallas_kernels/dispatch.py) — on TPU that means
     # the fused Pallas flash kernel, and under sequence parallelism
@@ -617,21 +627,62 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         }
         return grads_out, metrics
 
+    accum = max(1, cfg.grad_accum)
+    if cfg.grad_accum > 1 and has_pp:
+        raise ValueError(
+            "grad_accum > 1 does not compose with pp > 1 — the pipeline "
+            "path has its own microbatching (cfg.microbatches)")
+
     def grad_local(params, tokens, quant_seed, valid=None):
         targets, weights, positions = targets_and_weights(tokens)
         total_count = psum_all(weights.sum(), dense_axes)
 
-        def loss_fn(p):
-            loss_sum, _, aux = next_token_loss_and_aux(
-                cast_compute(p), tokens, mcfg, positions, attn, tp_axis,
-                ep_axis, targets=targets, weights=weights,
-                remat=cfg.remat)
-            # exact global-mean scaling: psum of these local losses (and of
-            # their grads) is the global mean loss (and its gradient)
-            return loss_sum / total_count, aux
+        def mb_value_and_grad(tok, tgt, w):
+            def loss_fn(p):
+                loss_sum, _, aux = next_token_loss_and_aux(
+                    cast_compute(p), tok, mcfg, positions, attn, tp_axis,
+                    ep_axis, targets=tgt, weights=w, remat=cfg.remat)
+                # exact global-mean scaling: psum of these local losses
+                # (and of their grads) is the global mean loss (and its
+                # gradient) — and with accumulation the per-microbatch
+                # pieces SUM to the same thing (total_count is the full
+                # batch's, so no rescaling on the way back together)
+                return loss_sum / total_count, aux
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params)
+        if accum == 1:
+            (loss, aux), grads = mb_value_and_grad(tokens, targets,
+                                                   weights)
+        else:
+            b_local = tokens.shape[0]
+            if b_local % accum:
+                raise ValueError(
+                    f"local batch {b_local} must divide into "
+                    f"grad_accum={accum} microbatches")
+            mb = lambda x: x.reshape(  # noqa: E731
+                (accum, b_local // accum) + x.shape[1:])
+            tok_m, tgt_m, w_m = mb(tokens), mb(targets), mb(weights)
+            # zeros carry shaped by eval_shape (no second traced copy of
+            # the forward+backward — tracing microbatch 0 outside the
+            # scan would double the compiled program); the scan folds
+            # every microbatch in, so peak memory is one microbatch's
+            # activations plus a single grads-sized carry — which is the
+            # entire point of accumulating
+            (l_s, aux_s), g_s = jax.eval_shape(
+                mb_value_and_grad, tok_m[0], tgt_m[0], w_m[0])
+            zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 (l_s, aux_s, g_s))
+
+            def body(carry, xs):
+                la, auxa, ga = carry
+                (l, aux), g = mb_value_and_grad(*xs)
+                return (la + l, jax.tree.map(jnp.add, auxa, aux),
+                        jax.tree.map(jnp.add, ga, g)), None
+
+            (loss, aux, grads), _ = lax.scan(
+                body, zeros, (tok_m, tgt_m, w_m))
+            # aux terms are per-microbatch diagnostics: report the mean
+            aux = jax.tree.map(lambda x: x / accum, aux)
         return sync_and_metrics(loss, aux, grads, total_count,
                                 derive_quant_key(quant_seed),
                                 valid=valid)
