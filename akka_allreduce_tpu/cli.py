@@ -802,8 +802,17 @@ def _cmd_train(args: argparse.Namespace) -> int:
                        * (nprocs if hybrid else 1))
     if args.grad_accum > 1:
         # fail at the flag layer with the mesh math spelled out, not at
-        # trace time with only the local number
-        local_b = b // (nprocs if hybrid else 1) // (dp * args.ep)
+        # trace time with only the local number. The batch must divide
+        # over processes x data ranks EXACTLY before the per-rank
+        # quotient means anything (floor division would state false
+        # arithmetic in the message and shadow the b % nprocs check)
+        shards = (nprocs if hybrid else 1) * dp * args.ep
+        if b % shards:
+            print(f"error: --batch {b} must divide over {shards} "
+                  f"(processes x data ranks) before --grad-accum can "
+                  f"split what is left", file=sys.stderr)
+            return 2
+        local_b = b // shards
         if local_b % args.grad_accum:
             print(f"error: --grad-accum {args.grad_accum} must divide "
                   f"the per-rank batch {local_b} (= batch {b} / "

@@ -627,8 +627,10 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         }
         return grads_out, metrics
 
-    accum = max(1, cfg.grad_accum)
-    if cfg.grad_accum > 1 and has_pp:
+    accum = cfg.grad_accum
+    if accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {accum}")
+    if accum > 1 and has_pp:
         raise ValueError(
             "grad_accum > 1 does not compose with pp > 1 — the pipeline "
             "path has its own microbatching (cfg.microbatches)")

@@ -161,6 +161,8 @@ class TestOptimizerFamilies:
         assert all(np.isfinite(losses)), losses
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow  # property pin (state-size accounting), not an
+    # edit-loop gate: the fast tier keeps the adafactor learning pin
     def test_adafactor_state_is_factored(self):
         """The point of adafactor: second-moment state is O(r+c) per 2D
         param, not O(r*c) — total optimizer-state bytes must land far
