@@ -363,6 +363,11 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--sgd-momentum", type=float, default=0.9,
                    help="sgd only: momentum coefficient (0 disables; "
                         "> 0 uses nesterov)")
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="keep an EMA of the post-update params "
+                        "(ema = d*ema + (1-d)*params per step), saved "
+                        "as the checkpoint's own 'ema' item — decode "
+                        "or eval them with --use-ema. 0 disables")
     p.add_argument("--xprof-dir", default=None, metavar="DIR",
                    help="write a jax.profiler device trace "
                         "(TensorBoard/XProf-viewable: per-op device "
@@ -509,6 +514,10 @@ class _XprofWindow:
 def _add_model_args(p: argparse.ArgumentParser) -> None:
     """Model-shape flags shared by every checkpoint-consuming command
     (generate/eval must describe the trained model exactly)."""
+    p.add_argument("--use-ema", action="store_true",
+                   help="restore the checkpoint's EMA (Polyak-averaged) "
+                        "weights instead of the raw ones (needs a run "
+                        "trained with --ema-decay)")
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--n-heads", type=int, default=4)
@@ -554,38 +563,36 @@ def _build_model_config(args: argparse.Namespace, max_seq: int):
 
 
 def _restore_params(args: argparse.Namespace, mcfg) -> "tuple | int":
-    """Build a 1-device state and restore args.ckpt_dir into it. Returns
-    (step0, params) or an exit code int on failure (message printed)."""
+    """Build a 1-device params template and restore args.ckpt_dir's
+    weights into it — params ONLY (CheckpointManager.restore_params), so
+    decode/eval work on checkpoints from any --optimizer family or
+    --ema-decay setting without knowing the training chain, at a third
+    of a full-state restore's I/O. With ``--use-ema`` the checkpoint's
+    'ema' item (the Polyak-averaged weights) is restored instead.
+    Returns (step0, params) or an exit code int (message printed)."""
     import jax
 
-    from akka_allreduce_tpu.models.train import (TrainConfig,
-                                                 make_train_state)
-    from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+    from akka_allreduce_tpu.models.transformer import init_transformer
     from akka_allreduce_tpu.runtime.checkpoint import (CheckpointConfig,
-                                                       restore_or_init)
+                                                       CheckpointManager)
 
-    cfg = TrainConfig(model=mcfg)
-    mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
-    # NOTE: restores opt_state too (tripling restore I/O) — the installed
-    # orbax's StandardRestore has no per-leaf placeholder support for
-    # params-only partial restore (verified); acceptable at CLI scale.
-    params, opt_state, _opt = make_train_state(jax.random.key(0), cfg,
-                                               mesh)
+    params = init_transformer(jax.random.key(0), mcfg)
+    item = "ema" if getattr(args, "use_ema", False) else "params"
     try:
-        step0, params, _, _, mgr = restore_or_init(
-            CheckpointConfig(args.ckpt_dir), params, opt_state)
-    except Exception as e:
-        print(f"error: cannot restore {args.ckpt_dir} with the declared "
-              f"model shape (wrong --d-model/--vocab/--max-seq/...?): "
-              f"{e}", file=sys.stderr)
-        return 2
-    if mgr is not None:
-        mgr.close()  # restore-only use: release orbax's async machinery
-    if step0 == 0:
+        with CheckpointManager(CheckpointConfig(args.ckpt_dir)) as mgr:
+            step0, params, _extra = mgr.restore_params(params, item=item)
+            step0 += 1  # restore_or_init convention: resume step index
+    except FileNotFoundError:
         print(f"error: no checkpoint found in {args.ckpt_dir}",
               file=sys.stderr)
         return 2
-    print(f"restored step {step0 - 1} from {args.ckpt_dir}",
+    except Exception as e:
+        hint = ("trained without --ema-decay?" if item == "ema" else
+                "wrong --d-model/--vocab/--max-seq/...?")
+        print(f"error: cannot restore item {item!r} from "
+              f"{args.ckpt_dir} ({hint}): {e}", file=sys.stderr)
+        return 2
+    print(f"restored step {step0 - 1} ({item}) from {args.ckpt_dir}",
           file=sys.stderr)
     return step0, params
 
@@ -852,7 +859,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                       total_steps=args.steps, clip_norm=args.clip_norm,
                       optimizer=args.optimizer,
                       sgd_momentum=args.sgd_momentum,
-                      grad_accum=args.grad_accum)
+                      grad_accum=args.grad_accum,
+                      ema_decay=args.ema_decay)
     if args.pp > 1 and chatty:
         from akka_allreduce_tpu.parallel.pp import pp_schedule_stats
         st = pp_schedule_stats(args.pp, micro)
@@ -863,6 +871,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
               f"1f1b {st['1f1b']['bubble_fraction']:.1%} (resident "
               f"{st['1f1b']['resident_microbatches']})")
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
+    if args.ema_decay > 0:
+        from akka_allreduce_tpu.models.train import get_ema_params
+        ema_of = get_ema_params  # extraction only — no copy
+    else:
+        ema_of = lambda _o: None  # noqa: E731
     dynamic = args.deadline_ms > 0 and not hybrid
     trainer = None
     dcn = None
@@ -971,7 +984,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                           file=sys.stderr)
                     return
                 mgr.save(rep.round, params, opt_state,
-                         {"data_step": rep.round}, force=True)
+                         {"data_step": rep.round}, force=True,
+                         ema=ema_of(opt_state))
                 mgr.wait_until_finished()  # worker reads it immediately
                 dcn.publish_snapshot_step(rep.round)
                 print(f"served rejoin snapshot at step {rep.round}")
@@ -1081,7 +1095,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     last_downed = rep.downed
                 if mgr is not None:
                     mgr.maybe_save(rep.round, params, opt_state,
-                                   {"data_step": rep.round})
+                                   {"data_step": rep.round},
+                                   ema=ema_of(opt_state))
                 steps_in_window += 1
                 if rep.round == start \
                         or (rep.round + 1) % args.log_every == 0:
@@ -1104,7 +1119,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 serve_snapshot_requests(rep)
                 if mgr is not None:
                     mgr.maybe_save(rep.round, params, opt_state,
-                                   {"data_step": rep.round})
+                                   {"data_step": rep.round},
+                                   ema=ema_of(opt_state))
                 if chatty:
                     print(f"step {rep.round + 1:4d}: loss "
                           f"{rep.loss:.4f} (drained) [masked "
@@ -1119,7 +1135,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 final = args.steps - 1
                 if args.steps > start and mgr.latest_step() != final:
                     mgr.save(final, params, opt_state,
-                             {"data_step": final}, force=True)
+                             {"data_step": final}, force=True,
+                             ema=ema_of(opt_state))
                 # a straggler whose rejoin request landed during the
                 # master's LAST rounds would otherwise see the done
                 # marker and give up: hand it the final checkpoint on
@@ -1169,7 +1186,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     # chunk crossed an interval line — the step index
                     # stays paired with the params actually holding it
                     mgr.save(last, params, opt_state,
-                             {"data_step": last}, force=True)
+                             {"data_step": last}, force=True,
+                             ema=ema_of(opt_state))
                 steps_in_window += n
                 if i == start or (i // args.log_every
                                   != (last + 1) // args.log_every):
@@ -1211,7 +1229,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
             else:
                 params, opt_state, metrics = step(params, opt_state, tokens)
             if mgr is not None:
-                mgr.maybe_save(i, params, opt_state, {"data_step": i})
+                mgr.maybe_save(i, params, opt_state, {"data_step": i},
+                               ema=ema_of(opt_state))
             steps_in_window += 1
             if i == start or (i + 1) % args.log_every == 0:
                 loss = float(jax.block_until_ready(metrics["loss"]))
@@ -1241,7 +1260,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
             final = args.steps - 1
             if args.steps > start and mgr.latest_step() != final:
                 mgr.save(final, params, opt_state,
-                         {"data_step": final}, force=True)
+                         {"data_step": final}, force=True,
+                         ema=ema_of(opt_state))
     finally:
         # Preemption/SIGINT is this feature's target scenario: always let
         # an in-flight async save land (and any open device trace flush)
@@ -1331,6 +1351,14 @@ def _cmd_eval(args: argparse.Namespace) -> int:
         print(f"error: no such corpus {args.data_file}", file=sys.stderr)
         return 2
     mcfg = _build_model_config(args, args.max_seq)
+    if corpus.max_token() >= mcfg.vocab_size:
+        # same scan train does: out-of-range ids would index garbage
+        # embeddings and report NaN perplexity with no explanation
+        print(f"error: corpus holds token id {corpus.max_token()} but "
+              f"the model's vocab is {mcfg.vocab_size} — wrong "
+              f"--vocab for this checkpoint, or wrong corpus",
+              file=sys.stderr)
+        return 2
     restored = _restore_params(args, mcfg)
     if isinstance(restored, int):
         return restored

@@ -130,6 +130,13 @@ class TrainConfig:
     # same as the pp path's). pp > 1 has its own microbatching — the two
     # do not compose.
     grad_accum: int = 1
+    # Polyak/EMA weight averaging: > 0 keeps an exponential moving
+    # average of the POST-update params in the optimizer chain's state
+    # (ema = d*ema + (1-d)*params each step) — the eval/serving weights
+    # many recipes report, checkpointed as their own item so generate
+    # --use-ema restores them without knowing the optimizer family. 0
+    # disables (no extra param-sized state).
+    ema_decay: float = 0.0
     # Attention implementation: "auto" consults the measured per-chip
     # dispatch table (ops/pallas_kernels/dispatch.py) — on TPU that means
     # the fused Pallas flash kernel, and under sequence parallelism
@@ -277,6 +284,59 @@ def step_counter() -> optax.GradientTransformation:
     return optax.GradientTransformation(init, update)
 
 
+class EmaState(NamedTuple):
+    """State of :func:`param_ema`: the averaged params."""
+    ema: Any
+
+
+def param_ema(decay: float) -> optax.GradientTransformation:
+    """LAST slot of the training chain: tracks an EMA of the
+    POST-update params. At that position ``params + updates`` IS the
+    value apply_updates produces, so the shadow tree never needs a
+    second pass over the step."""
+
+    def init(params):
+        return EmaState(jax.tree.map(jnp.asarray, params))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("param_ema needs params in opt.update")
+        new_ema = jax.tree.map(
+            lambda e, p, u: decay * e + (1.0 - decay) * (p + u),
+            state.ema, params, updates)
+        return updates, EmaState(new_ema)
+
+    return optax.GradientTransformation(init, update)
+
+
+def find_chain_state(opt_state, state_type) -> Optional[Any]:
+    """First node of ``state_type`` in an optimizer-state tree (walks
+    tuples/lists/dicts — the containers optax chains states in). The
+    one walk serving every typed-state lookup (step counter, ema):
+    container handling diverging between copies is how lookups silently
+    break."""
+    if isinstance(opt_state, state_type):
+        return opt_state
+    if isinstance(opt_state, (tuple, list)):
+        for x in opt_state:
+            found = find_chain_state(x, state_type)
+            if found is not None:
+                return found
+    elif isinstance(opt_state, dict):
+        for x in opt_state.values():
+            found = find_chain_state(x, state_type)
+            if found is not None:
+                return found
+    return None
+
+
+def get_ema_params(opt_state) -> Any:
+    """The EMA weights from a chain built with ``ema_decay > 0`` (the
+    checkpoint's ``ema`` item), or None when the chain has none."""
+    state = find_chain_state(opt_state, EmaState)
+    return state.ema if state is not None else None
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """The training chain: step counter, optional global-norm clip, then
     the configured family. Families beyond adamw are beyond-reference
@@ -296,10 +356,15 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     else:
         raise ValueError(
             f"unknown optimizer {fam!r}: adamw | adafactor | sgd | lion")
+    if not 0.0 <= cfg.ema_decay < 1.0:
+        raise ValueError(
+            f"ema_decay must be in [0, 1), got {cfg.ema_decay}")
     parts = [step_counter()]
     if cfg.clip_norm > 0:
         parts.append(optax.clip_by_global_norm(cfg.clip_norm))
     parts.append(core)
+    if cfg.ema_decay > 0:
+        parts.append(param_ema(cfg.ema_decay))  # must be LAST (see doc)
     return optax.chain(*parts)
 
 
@@ -884,24 +949,12 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
         key alone is ambiguous once the chain carries several counters
         (the schedule state counts too), so walk the (static) state
         structure for the dedicated type."""
-        found = []
-
-        def walk(node):
-            if isinstance(node, StepCounterState):
-                found.append(node.count)
-            elif isinstance(node, (tuple, list)):
-                for x in node:
-                    walk(x)
-            elif isinstance(node, dict):
-                for x in node.values():
-                    walk(x)
-
-        walk(opt_state)
-        if not found:
+        state = find_chain_state(opt_state, StepCounterState)
+        if state is None:
             raise ValueError(
                 "optimizer state has no StepCounterState — build the "
                 "optimizer with make_optimizer (or chain step_counter())")
-        return found[0]
+        return state.count
 
     @partial(jax.jit, donate_argnums=donate_args)
     def step(params, opt_state, tokens):

@@ -82,30 +82,45 @@ class CheckpointManager:
     # -- save ----------------------------------------------------------------
 
     def save(self, step: int, params: Any, opt_state: Any,
-             extra: Optional[dict] = None, force: bool = False) -> bool:
+             extra: Optional[dict] = None, force: bool = False,
+             ema: Any = None) -> bool:
         """Save unconditionally (``force``) or per the interval policy.
-        Returns whether a save actually happened."""
-        state = {"params": params, "opt_state": opt_state}
+        Returns whether a save actually happened.
+
+        ``params``, ``opt_state`` (and ``ema`` when given) are SEPARATE
+        composite items: consumers that only need weights (generate/
+        eval) restore params alone — no optimizer-state template, so the
+        restore is independent of which ``--optimizer`` family (or
+        ema setting) trained the checkpoint, and pays a third of the
+        I/O. The EMA tree is deliberately stored twice — once embedded
+        in ``opt_state`` (what resume needs, structure intact) and once
+        as the ``ema`` item (what template-free consumers read); the
+        ``ema`` item is authoritative for consumers, and the cost is one
+        params-sized tree per retained checkpoint."""
+        items = {"params": params, "opt_state": opt_state}
+        if ema is not None:
+            items["ema"] = ema
         if self.config.single_process:
             # orbax refuses process-LOCAL device arrays in a multi-
             # process job ("host local jax.Array"); the island's arrays
             # are exactly that (local-mesh shardings), so ship them as
             # host numpy — restore puts them back on the local mesh
-            state = jax.device_get(state)
+            items = jax.device_get(items)
         saved = self._mgr.save(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
                 extra=ocp.args.JsonSave(extra or {}),
+                **{k: ocp.args.StandardSave(v) for k, v in items.items()},
             ),
             force=force,
         )
         return bool(saved)
 
     def maybe_save(self, step: int, params: Any, opt_state: Any,
-                   extra: Optional[dict] = None) -> bool:
+                   extra: Optional[dict] = None, ema: Any = None) -> bool:
         """Interval-gated save — safe to call every round."""
-        return self.save(step, params, opt_state, extra, force=False)
+        return self.save(step, params, opt_state, extra, force=False,
+                         ema=ema)
 
     # -- restore -------------------------------------------------------------
 
@@ -121,12 +136,43 @@ class CheckpointManager:
         initialised state from :func:`make_train_state` and the checkpoint
         lands directly on the mesh, no host round-trip.
         """
+        try:
+            step, out = self._restore_items(
+                {"params": params_like, "opt_state": opt_state_like},
+                step)
+        except Exception as exc:
+            # orbax's missing-item message, verbatim (matching narrowly:
+            # a shape/structure mismatch must NOT silently fall back)
+            if "was not found in the checkpoint" not in str(exc):
+                raise
+            # legacy layout (pre-item-split): one 'state' composite item
+            # holding {params, opt_state} — a preempted run checkpointed
+            # by the previous code must still resume
+            step, out = self._restore_items(
+                {"state": {"params": params_like,
+                           "opt_state": opt_state_like}}, step)
+            out = {"extra": out["extra"], **out["state"]}
+        return (step, out["params"], out["opt_state"],
+                dict(out["extra"]))
+
+    def restore_params(self, params_like: Any,
+                       step: Optional[int] = None, item: str = "params"
+                       ) -> tuple[int, Any, dict]:
+        """Restore weights WITHOUT an optimizer-state template — the
+        consumer path (generate/eval): works on a checkpoint from any
+        ``--optimizer`` family or ema setting, at a third of the full
+        restore's I/O. ``item="ema"`` selects the EMA weights a
+        ``--ema-decay`` run saves alongside the raw ones."""
+        step, out = self._restore_items({item: params_like}, step)
+        return step, out[item], dict(out["extra"])
+
+    def _restore_items(self, templates: dict,
+                       step: Optional[int]) -> tuple[int, dict]:
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint under {self.config.directory}")
-        template = {"params": params_like, "opt_state": opt_state_like}
 
         def abstract_leaf(x):
             # Keep the template's sharding on every leaf (scalars included)
@@ -140,21 +186,23 @@ class CheckpointManager:
                                             sharding=x.sharding)
             return x
 
-        abstract = jax.tree.map(abstract_leaf, template)
         out = self._mgr.restore(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
                 extra=ocp.args.JsonRestore(),
+                **{k: ocp.args.StandardRestore(
+                    jax.tree.map(abstract_leaf, t))
+                   for k, t in templates.items()},
             ),
         )
-        state = out["state"]
+        out = dict(out)
         if self.config.single_process:
-            state = jax.tree.map(
-                lambda t, x: jax.device_put(x, t.sharding)
-                if isinstance(t, jax.Array) else x,
-                template, state)
-        return step, state["params"], state["opt_state"], dict(out["extra"])
+            for k, t in templates.items():
+                out[k] = jax.tree.map(
+                    lambda tl, x: jax.device_put(x, tl.sharding)
+                    if isinstance(tl, jax.Array) else x,
+                    t, out[k])
+        return step, out
 
     # -- lifecycle -----------------------------------------------------------
 
