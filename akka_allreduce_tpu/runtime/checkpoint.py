@@ -163,7 +163,29 @@ class CheckpointManager:
         ``--optimizer`` family or ema setting, at a third of the full
         restore's I/O. ``item="ema"`` selects the EMA weights a
         ``--ema-decay`` run saves alongside the raw ones."""
-        step, out = self._restore_items({item: params_like}, step)
+        try:
+            step, out = self._restore_items({item: params_like}, step)
+        except Exception as exc:
+            # str(KeyError) is the repr of its message (inner quotes
+            # come back escaped), so match on names, not quoting: a
+            # checkpoint whose available items lack 'params' entirely is
+            # the legacy layout (which stored one 'state' item); a NEW
+            # checkpoint missing only e.g. 'ema' still lists 'params'
+            avail = str(exc).split("Available items:")[-1]
+            if ("was not found in the checkpoint" in str(exc)
+                    and "params" not in avail):
+                # legacy single-'state' layout: weights-only restore is
+                # structurally impossible there (StandardRestore needs
+                # the whole item, optimizer state included — the reason
+                # the layout was split). Say so, with the way out.
+                raise ValueError(
+                    "checkpoint uses the legacy single-'state' layout "
+                    "(written before the per-item split): weights-only "
+                    "restore needs the split layout — resume the run "
+                    "once with `train --ckpt-dir ...` under the "
+                    "original training flags (it re-saves in the new "
+                    "layout), then retry") from exc
+            raise
         return step, out[item], dict(out["extra"])
 
     def _restore_items(self, templates: dict,

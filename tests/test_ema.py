@@ -132,6 +132,12 @@ class TestCheckpointItems:
         assert step == 3 and extra["data_step"] == 3
         for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(got_p)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # weights-only restore from the legacy layout is structurally
+        # impossible — the error must say so instead of hinting at
+        # wrong model shapes
+        with CheckpointManager(CheckpointConfig(str(tmp_path))) as mgr:
+            with pytest.raises(ValueError, match="legacy single-'state'"):
+                mgr.restore_params(params2)
 
     def test_missing_ema_item_fails_with_item_name(self, tmp_path):
         from akka_allreduce_tpu.runtime.checkpoint import (
