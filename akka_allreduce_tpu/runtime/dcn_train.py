@@ -171,7 +171,7 @@ class DcnRoundReport:
     round: int
     valid_peers: tuple[bool, ...]  # per peer: contributed >= 1 bucket
     n_masked: int  # peers that contributed NOTHING this round
-    loss: float  # mean of contributors' local losses
+    loss: float  # token-weighted mean of contributors' local losses
     caught_up: int = 0  # rounds replayed before this one (post-stall)
     bucket_counts: tuple[int, ...] = ()  # contributors per wire bucket
     n_partial: int = 0  # peers that contributed SOME but not all buckets
@@ -698,17 +698,20 @@ class DcnDeadlineTrainer:
     def _apply_round(self, params, opt_state, r: int,
                      rows: list[list[bool]],
                      own: Optional[list[bytes]], replay: bool = False):
-        """Mean the contributors' local-mean gradients PER WIRE BUCKET
-        (fixed rank order, so every process computes the bit-identical
-        reduction) and run the jitted optimizer apply. Each bucket's mean
-        divides by that bucket's own contributor count — a peer whose
-        publish was cut mid-round still feeds the buckets that landed,
-        with honest per-bucket counts (reference's per-chunk thresholds,
-        ReducedDataBuffer.scala:40-48). Each payload is the gradient of
-        that process's LOCAL-batch mean loss, so the per-bucket mean over
-        contributors estimates the global batch-mean gradient — unbiased
-        under masking, and identical to the global-mesh gradient when
-        everyone contributes (equal local batch sizes)."""
+        """TOKEN-WEIGHTED mean of the contributors' local-mean gradients
+        PER WIRE BUCKET (fixed rank order, so every process computes the
+        bit-identical reduction) and the jitted optimizer apply. Each
+        payload is the gradient of that process's LOCAL-batch mean loss
+        over ``tokens_p`` tokens, so the exact global batch-mean gradient
+        is ``sum_p tokens_p * g_p / sum_p tokens_p`` — with equal local
+        batches this reduces to the plain mean, and with uneven ones
+        (ragged final batches, heterogeneous hosts) the plain mean would
+        bias toward small-batch processes; the header's u64 token count
+        exists for exactly this weighting. Masking composes per bucket: a
+        peer whose publish was cut mid-round still feeds the buckets that
+        landed, with honest per-bucket counts (reference's per-chunk
+        thresholds, ReducedDataBuffer.scala:40-48), and the weighted mean
+        runs over that bucket's contributors."""
         B = self._n_chunks
         if rows and len(rows[0]) != B:
             raise RuntimeError(
@@ -718,6 +721,7 @@ class DcnDeadlineTrainer:
                 f"identical on every process")
         totals: list[Optional[np.ndarray]] = [None] * B
         counts = [0] * B
+        wsum = [0.0] * B
         losses = []
         for p in range(self.nprocs):
             row = rows[p]
@@ -739,29 +743,47 @@ class DcnDeadlineTrainer:
                     if data is None:
                         data = self._get_payload(
                             r, p, b, wait_s=2.0 if replay else 30.0)
-                loss_p, _toks, vecb = decode_payload(data)
+                loss_p, toks, vecb = decode_payload(data)
+                w = float(toks)
+                if w <= 0.0:
+                    # an empty local batch carries no gradient (its
+                    # local-mean grad — and loss — is 0/0): weight it
+                    # OUT entirely. Multiplying by 0 would not do it:
+                    # 0 * NaN poisons the weighted sum, and its NaN
+                    # loss would poison the reported mean
+                    continue
                 if totals[b] is None:
-                    totals[b] = vecb.copy()
+                    totals[b] = w * vecb
                 else:
-                    totals[b] += vecb
+                    totals[b] += w * vecb
                 counts[b] += 1
+                wsum[b] += w
                 if not got_loss:
-                    losses.append(loss_p)
+                    losses.append((w, loss_p))
                     got_loss = True
-        assert min(counts) > 0, \
-            "no bucket can be contributor-less (the master pins itself in)"
+        if min(counts) == 0:
+            raise RuntimeError(
+                "a wire bucket has no token-bearing contributor — either "
+                "the mask let nobody in (the master pins itself, so this "
+                "is a protocol bug) or every contributor reported 0 "
+                "tokens (empty local batches cannot carry a gradient; "
+                "check the data pipeline)")
         out = np.empty(self._spec.total_size, np.float32)
         for b in range(B):
             lo, hi = self._chunk_bounds(b)
-            out[lo:hi] = totals[b] / counts[b]
+            out[lo:hi] = totals[b] / wsum[b]
         params, opt_state = self._apply(params, opt_state,
                                         jnp.asarray(out))
         full = [p for p in range(self.nprocs) if all(rows[p])]
         contributed = [p for p in range(self.nprocs) if any(rows[p])]
+        lw = sum(w for w, _ in losses)
         rep = DcnRoundReport(
             round=r, valid_peers=tuple(any(row) for row in rows),
             n_masked=self.nprocs - len(contributed),
-            loss=float(np.mean(losses)),
+            # same token weights as the gradient: the reported loss is
+            # the global batch-mean loss, not a per-process mean biased
+            # toward small batches
+            loss=float(sum(w * l for w, l in losses) / lw),
             bucket_counts=tuple(counts),
             n_partial=len(contributed) - len(full),
             downed=tuple(sorted(self._downed)) if self.master else ())

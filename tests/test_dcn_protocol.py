@@ -49,19 +49,21 @@ DIM = 64
 
 
 def make_trainer(rank, n, client, *, lr=0.1, grad=None, step_sleep=0.0,
-                 **kw):
+                 tokens=8.0, **kw):
     """A trainer whose local compute plane is host math: rank-dependent
     constant gradients (rank+1 everywhere unless ``grad`` overrides),
-    optionally slowed by ``step_sleep`` to script per-peer pacing."""
+    optionally slowed by ``step_sleep`` to script per-peer pacing;
+    ``tokens`` is this rank's reported local token count (the
+    token-weighted DCN mean's weight)."""
     cfg = SimpleNamespace(bucket_elems=1024)
     opt = optax.sgd(kw.pop("opt_lr", lr))
 
-    def gstep(params, tokens, r):
+    def gstep(params, toks, r):
         if step_sleep:
             time.sleep(step_sleep)
         g = (grad(rank, int(r)) if grad is not None
              else np.full(DIM, float(rank + 1), np.float32))
-        return {"w": g}, {"loss": float(rank + 1), "tokens": 8.0}
+        return {"w": g}, {"loss": float(rank + 1), "tokens": tokens}
 
     kw.setdefault("retain_rounds", 16)
     kw.setdefault("hb_interval_s", 0.1)
@@ -400,6 +402,58 @@ class TestReplicaDivergence:
         results, errors = run_cluster(trainers, steps)
         assert not errors, errors
         np.testing.assert_array_equal(results[0], results[1])
+
+
+class TestTokenWeightedMean:
+    def test_uneven_batches_average_by_token_count(self):
+        """rank 0 reports 8 tokens with grad 1s, rank 1 reports 24 with
+        grad 2s: the applied gradient must be the token-weighted mean
+        (8*1 + 24*2)/32 = 1.75, not the plain mean 1.5 — the exact
+        global batch-mean gradient for uneven local batches (the u64
+        wire tokens field's whole purpose)."""
+        client = FakeKvClient()
+        n = 2
+        trainers = [make_trainer(i, n, client, deadline_s=5.0, lr=1.0,
+                                 tokens=8.0 if i == 0 else 24.0,
+                                 grad=lambda rk, r: np.full(
+                                     DIM, float(rk + 1), np.float32))
+                    for i in range(n)]
+        results, errors = run_cluster(trainers, 1)
+        assert not errors, errors
+        # sgd lr=1, params start at 0: params == -weighted_mean_grad
+        np.testing.assert_allclose(results[0], -1.75, rtol=1e-6)
+        np.testing.assert_array_equal(results[0], results[1])
+
+    def test_zero_token_nan_contributor_weighted_out(self):
+        """An empty local batch's gradient is 0/0 = NaN; its zero token
+        weight must exclude it ENTIRELY (0 * NaN would still poison the
+        sum) — survivors' weighted mean applies clean, and the reported
+        loss ignores the NaN too."""
+        client = FakeKvClient()
+        n = 2
+
+        def grads(rk, r):
+            if rk == 1:
+                return np.full(DIM, np.nan, np.float32)
+            return np.full(DIM, 2.0, np.float32)
+
+        trainers = [make_trainer(i, n, client, deadline_s=5.0, lr=1.0,
+                                 tokens=8.0 if i == 0 else 0.0,
+                                 grad=grads) for i in range(n)]
+        results, errors = run_cluster(trainers, 1)
+        assert not errors, errors
+        np.testing.assert_allclose(results[0], -2.0, rtol=1e-6)
+        for tr in trainers:
+            assert np.isfinite(tr.reports[-1].loss)
+
+    def test_zero_token_round_fails_loudly(self):
+        client = FakeKvClient()
+        n = 2
+        trainers = [make_trainer(i, n, client, deadline_s=5.0,
+                                 tokens=0.0) for i in range(n)]
+        results, errors = run_cluster(trainers, 1)
+        assert errors, "all-zero token counts must not apply silently"
+        assert any("0 tokens" in str(e) for e in errors.values()), errors
 
 
 class TestWireFormat:
