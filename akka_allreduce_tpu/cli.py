@@ -50,6 +50,14 @@ def _add_emulate(sub: argparse._SubParsersAction) -> None:
                    help="assert output == N x input (needs thresholds 1.0)")
     p.add_argument("--kill-rank", type=int, default=None,
                    help="kill this rank after registration (fault demo)")
+    p.add_argument("--fuzz", type=int, default=0, metavar="N",
+                   help="race-detect THIS config instead of running it "
+                        "once: replay it under N seeded-random message "
+                        "interleavings plus per-actor starvation and "
+                        "rotation schedules (protocol/explorer.py),"
+                        " checking rounds complete and — with "
+                        "--assert-multiple — exact outputs under every "
+                        "ordering; python engine only")
     p.add_argument("--trace-file", default=None,
                    help="write the structured protocol trace (JSONL: "
                         "rounds, members, deaths) here on exit")
@@ -82,6 +90,87 @@ def _cmd_emulate(args: argparse.Namespace) -> int:
                         max_round=args.max_round),
         workers=WorkerConfig(total_size=args.workers, max_lag=args.max_lag),
     )
+    if args.kill_rank is not None \
+            and not 0 <= args.kill_rank < args.workers:
+        print(f"error: --kill-rank {args.kill_rank} is not a worker "
+              f"seat (0..{args.workers - 1})", file=sys.stderr)
+        return 2
+    if args.fuzz > 0:
+        if args.engine == "native":
+            print("error: --fuzz schedules the python engine's "
+                  "deterministic router; the native engine has its own "
+                  "loop (drop --engine native)", file=sys.stderr)
+            return 2
+        if args.trace_file:
+            print("error: --fuzz runs many clusters and writes no "
+                  "trace; drop --trace-file (re-run the single failing "
+                  "schedule without --fuzz to trace it)",
+                  file=sys.stderr)
+            return 2
+        if args.kill_rank is not None and max(
+                args.th_allreduce, args.th_reduce,
+                args.th_complete) >= 1.0:
+            print("error: --fuzz --kill-rank needs every threshold < "
+                  "1.0 — at 1.0 nothing can complete with a dead "
+                  "worker, so there is no invariant to check",
+                  file=sys.stderr)
+            return 2
+        import numpy as np
+
+        from akka_allreduce_tpu.protocol.explorer import (
+            explore, standard_schedules)
+
+        outputs: dict = {}
+
+        def make():
+            for r in range(args.workers):
+                outputs[r] = []
+            return LocalCluster(
+                config,
+                source_factory=lambda r: constant_range_source(data_size),
+                sink_factory=lambda r: outputs[r].append)
+
+        def validate(cluster):
+            # every legal ordering must complete every paced round
+            # (lossy thresholds make that true even with the killed
+            # worker), every SURVIVOR must flush every round, and each
+            # flush must carry honest chunk-constant counts
+            if len(cluster.completed_rounds) != args.max_round:
+                raise AssertionError(
+                    f"{len(cluster.completed_rounds)}/{args.max_round} "
+                    f"rounds completed")
+            base = np.arange(data_size, dtype=np.float32)
+            for r in range(args.workers):
+                if r == args.kill_rank:
+                    continue
+                if len(outputs[r]) != args.max_round + 1:
+                    raise AssertionError(
+                        f"worker {r} flushed {len(outputs[r])} outputs, "
+                        f"wanted {args.max_round + 1}")
+                for out in outputs[r]:
+                    if args.assert_multiple:
+                        assert (out.count == args.assert_multiple).all()
+                    np.testing.assert_allclose(
+                        out.data, base * out.count, rtol=1e-6)
+
+        names = ["master"] + [f"worker-{r}" for r in range(args.workers)]
+        prepare = None
+        if args.kill_rank is not None:
+            prepare = lambda c: c.kill_worker(args.kill_rank)  # noqa: E731
+        scheds = list(standard_schedules(names, seeds=args.fuzz))
+        t0 = time.perf_counter()
+        failures = explore(make, scheds, validate, prepare=prepare)
+        dt = time.perf_counter() - t0
+        if failures:
+            for f in failures[:10]:
+                print(f"FAIL {f}", file=sys.stderr)
+            print(f"{len(failures)}/{len(scheds)} schedules violated "
+                  f"invariants", file=sys.stderr)
+            return 1
+        print(f"fuzz: {len(scheds)} schedules x {args.max_round} rounds "
+              f"each, 0 violations ({dt:.2f}s)")
+        return 0
+
     if args.engine == "native":
         if args.trace_file:
             print("error: --engine native does not produce traces "

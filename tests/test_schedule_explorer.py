@@ -167,6 +167,31 @@ class TestLossyInvariantsAcrossSchedules:
         assert not failures, "\n".join(map(str, failures[:5]))
 
 
+class TestEmulateFuzzCli:
+    """The operator surface: `emulate --fuzz N` runs the explorer over
+    the user's own config."""
+
+    def _run(self, monkeypatch, argv):
+        import sys
+
+        from akka_allreduce_tpu.cli import main
+        monkeypatch.setattr(sys, "argv", ["aat"] + argv)
+        return main()
+
+    def test_fuzz_exact_config_passes(self, monkeypatch, capsys):
+        rc = self._run(monkeypatch, [
+            "emulate", "--fuzz", "10", "--assert-multiple", "2",
+            "--th-complete", "1.0", "--max-round", "3"])
+        assert rc == 0
+        assert "0 violations" in capsys.readouterr().out
+
+    def test_fuzz_rejects_native_engine(self, monkeypatch, capsys):
+        rc = self._run(monkeypatch, [
+            "emulate", "--fuzz", "5", "--engine", "native"])
+        assert rc == 2
+        assert "--fuzz" in capsys.readouterr().err
+
+
 class TestScheduleMachinery:
     def test_random_schedule_is_deterministic_in_seed(self):
         a, b = random_schedule(7), random_schedule(7)
