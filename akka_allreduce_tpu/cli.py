@@ -411,7 +411,15 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute with f32 master weights")
     p.add_argument("--int8-grads", action="store_true",
-                   help="int8-quantized gradient allreduce transport")
+                   help="int8-quantized gradient allreduce transport "
+                        "(4x less wire traffic; stochastic rounding, "
+                        "single data axis)")
+    p.add_argument("--bf16-grads", action="store_true",
+                   help="bf16 gradient allreduce transport: half the "
+                        "wire traffic with plain rounding — no "
+                        "quantizer state, works over any axis "
+                        "combination (int8 needs a single data axis); "
+                        "masters/optimizer stay f32")
     p.add_argument("--remat", action="store_true",
                    help="rematerialise activations per block (long-context"
                         " memory saver)")
@@ -839,6 +847,12 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print("error: --deadline-ms must be >= 0 (0 disables deadlines)",
               file=sys.stderr)
         return 2
+    if args.int8_grads and args.bf16_grads:
+        print("error: pick ONE gradient wire: --int8-grads or "
+              "--bf16-grads", file=sys.stderr)
+        return 2
+    grad_wire = ("int8" if args.int8_grads
+                 else "bf16" if args.bf16_grads else "f32")
     if args.int8_grads:
         # fail at the flag layer, not deep inside shard_map tracing: the
         # int8 transport needs exactly one >1 data axis whose size divides
@@ -941,7 +955,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
                       bucket_elems=args.bucket_elems, microbatches=micro,
                       pp_schedule=args.pp_schedule,
                       compute_dtype="bf16" if args.bf16 else "f32",
-                      grad_transport="int8" if args.int8_grads else "f32",
+                      grad_transport=grad_wire,
                       remat=args.remat,
                       lr_schedule=args.lr_schedule,
                       warmup_steps=args.warmup_steps,
@@ -970,16 +984,16 @@ def _cmd_train(args: argparse.Namespace) -> int:
     dcn = None
     if hybrid:
         from akka_allreduce_tpu.runtime.dcn_train import DcnDeadlineTrainer
-        # --int8-grads quantizes BOTH planes: the local mesh's collective
+        # --int8-grads/--bf16-grads compress BOTH planes: the local mesh's
         # transport (cfg.grad_transport above) and the cross-process DCN
-        # payloads (4x less DCN traffic per contribution)
+        # payloads (4x less DCN traffic for int8, 2x for bf16)
         tracer = None
         if args.trace_file:
             from akka_allreduce_tpu.runtime.tracing import Tracer
             tracer = Tracer()
         dcn = DcnDeadlineTrainer(
             cfg, mesh, opt, deadline_s=args.deadline_ms / 1e3,
-            wire="int8" if args.int8_grads else "f32",
+            wire=grad_wire,
             max_lag=args.max_lag, retain_rounds=args.retain_rounds,
             th_allreduce=args.th_allreduce, down_after=args.down_after,
             dcn_bucket_elems=args.dcn_bucket_elems or None,

@@ -97,10 +97,15 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     being unbiased across rounds).
     """
     buckets, spec = bucketize(grads, config.bucket_elems)
+    # axes that actually move bytes: size-1 axes reduce to identity and
+    # need no wire format — compressed transports bypass themselves there
+    # (rounding gradients for zero wire savings would be pure loss)
+    live_axes = [a for a in _axis_tuple(config.axis_name)
+                 if lax.axis_size(a) > 1]
+    use_bf16 = config.transport == "bf16" and bool(live_axes)
     if config.transport == "int8":
         # shared int8 preconditions (exact and masked paths)
-        int8_axes = [a for a in _axis_tuple(config.axis_name)
-                     if lax.axis_size(a) > 1]
+        int8_axes = live_axes
         if len(int8_axes) > 1:
             raise ValueError(
                 f"int8 transport needs a single (>1) data axis, "
@@ -110,7 +115,7 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
                 "int8 transport needs quant_key, varied per round — a "
                 "fixed key makes the stochastic-rounding error systematic "
                 "instead of zero-mean across rounds")
-    elif config.transport != "f32":
+    elif config.transport not in ("f32", "bf16"):
         raise ValueError(f"unknown transport {config.transport!r}")
     if valid is None:
         # Exact path (thresholds = 1.0): every rank contributes every
@@ -123,6 +128,14 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
             summed = buckets if not int8_axes else \
                 quantized_two_phase_allreduce(buckets, quant_key,
                                               int8_axes[0])
+        elif use_bf16:
+            # the collective's payload dtype IS its wire format: casting
+            # the operand halves the bytes every hop moves; the f32
+            # master grads/optimizer never see bf16 (cast back before
+            # rescale). Works over ANY axis set — no reduce_scatter
+            # geometry to satisfy, unlike int8's two-phase
+            summed = psum_all(buckets.astype(jnp.bfloat16),
+                              config.axis_name).astype(jnp.float32)
         else:
             summed = psum_all(buckets, config.axis_name)
         group = 1
@@ -144,6 +157,16 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
             summed = contrib if not int8_axes else \
                 quantized_two_phase_allreduce(contrib, quant_key,
                                               int8_axes[0])
+            bucket_counts = psum_all(valid.astype(jnp.int32),
+                                     config.axis_name)
+        elif use_bf16:
+            # masked rows are exact zeros in bf16 too, so masking
+            # commutes with the cast; counts stay on an exact int32 psum
+            # (the honesty contract tolerates no rounding)
+            contrib = (buckets * valid.astype(buckets.dtype)[:, None]
+                       ).astype(jnp.bfloat16)
+            summed = psum_all(contrib,
+                              config.axis_name).astype(jnp.float32)
             bucket_counts = psum_all(valid.astype(jnp.int32),
                                      config.axis_name)
         else:

@@ -1,0 +1,146 @@
+"""The bf16 gradient wire (transport="bf16" / --bf16-grads): half the
+collective payload bytes with plain rounding.
+
+Unlike int8's two-phase reduce_scatter, the bf16 wire is just the
+collective's operand dtype, so it works over ANY axis combination; the
+f32 masters and optimizer never see bf16 (cast back before rescale),
+and lossy rounds keep exact int32 counts. The DCN host wire carries the
+same format (runtime/dcn_train.py encode_payload wire="bf16").
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.parallel.dp import (
+    GradSyncConfig,
+    allreduce_gradients,
+)
+from akka_allreduce_tpu.parallel.mesh import (
+    MeshSpec,
+    make_device_mesh,
+    single_axis_mesh,
+)
+
+N = 8
+
+
+class TestBf16Transport:
+    def test_close_to_f32_and_actually_rounds(self):
+        mesh = single_axis_mesh("dp")
+        cfg16 = GradSyncConfig(bucket_elems=128, transport="bf16",
+                               return_elem_counts=False)
+        cfg32 = GradSyncConfig(bucket_elems=128,
+                               return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")), check_vma=False)
+        def f(xs):
+            g = {"w": xs[0]}
+            r16 = allreduce_gradients(g, cfg16)
+            r32 = allreduce_gradients(g, cfg32)
+            return r16.grads["w"][None], r32.grads["w"][None]
+
+        stacked = jnp.asarray(np.random.default_rng(4).normal(
+            size=(N, 64, 16)).astype(np.float32))
+        g16, g32 = f(stacked)
+        err = np.abs(np.asarray(g16[0]) - np.asarray(g32[0])).max()
+        scale = np.abs(np.asarray(g32[0])).max()
+        assert err < 0.02 * scale  # ~2^-8 relative per value, x8 sum
+        assert err > 0  # the wire really was bf16
+
+    def test_multi_axis_allowed_unlike_int8(self):
+        """The bf16 wire's advantage over int8: no reduce_scatter
+        geometry, so dp x sp (two >1 data axes) just works."""
+        mesh = make_device_mesh(MeshSpec(dp=2, sp=2),
+                                devices=jax.devices()[:4])
+        cfg = GradSyncConfig(bucket_elems=32, transport="bf16",
+                             axis_name=("dp", "sp"),
+                             return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=P(("dp", "sp")), out_specs=P(("dp", "sp")),
+                 check_vma=False)
+        def f(xs):
+            res = allreduce_gradients({"w": xs[0]}, cfg)
+            return res.grads["w"][None]
+
+        vals = jnp.asarray(np.arange(4, dtype=np.float32)[:, None]
+                           * np.ones((4, 8), np.float32))
+        out = f(vals)
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   np.mean(np.arange(4)), rtol=1e-2)
+
+    def test_size1_axes_bypass_the_cast_entirely(self):
+        """A size-1 data axis moves no bytes, so there is nothing to
+        compress: the bf16 wire must be BITWISE the f32 path there
+        (rounding gradients for zero wire savings would be pure loss —
+        same bypass the int8 branch documents)."""
+        mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+        out = {}
+        for name in ("bf16", "f32"):
+            cfg = GradSyncConfig(bucket_elems=32, transport=name,
+                                 return_elem_counts=False)
+
+            @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                     out_specs=P("dp"), check_vma=False)
+            def f(xs):
+                return allreduce_gradients({"w": xs[0]},
+                                           cfg).grads["w"][None]
+
+            vals = jnp.asarray(np.random.default_rng(7).normal(
+                size=(1, 64)).astype(np.float32))
+            out[name] = np.asarray(f(vals))
+        np.testing.assert_array_equal(out["bf16"], out["f32"])
+
+    def test_masked_counts_exact_values_close(self):
+        mesh = single_axis_mesh("dp")
+        cfg = GradSyncConfig(bucket_elems=64, transport="bf16",
+                             return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                 out_specs=(P("dp"), P("dp")), check_vma=False)
+        def f(xs, valid):
+            res = allreduce_gradients({"w": xs[0]}, cfg,
+                                      valid=valid[0])
+            return res.grads["w"][None], res.bucket_counts[None]
+
+        xs = jnp.ones((N, 64), jnp.float32) * (
+            1 + jnp.arange(N, dtype=jnp.float32))[:, None]
+        valid = jnp.ones((N, 1), jnp.float32).at[3, 0].set(0.0)
+        out, counts = f(xs, valid)
+        assert int(np.asarray(counts)[0, 0]) == N - 1
+        # mean over contributors 1,2,3,5..8 (rank 3 -> value 4 dropped)
+        want = (sum(range(1, N + 1)) - 4) / (N - 1)
+        np.testing.assert_allclose(np.asarray(out)[0], want, rtol=2e-2)
+
+
+class TestBf16DcnWire:
+    def test_roundtrip_close_and_half_size(self):
+        from akka_allreduce_tpu.runtime.dcn_train import (
+            decode_payload, encode_payload)
+        vec = np.random.default_rng(0).normal(size=2048).astype(np.float32)
+        b16 = encode_payload(vec, 1.5, 64.0, "bf16")
+        b32 = encode_payload(vec, 1.5, 64.0, "f32")
+        assert len(b16) - 16 == (len(b32) - 16) // 2  # header is 16B
+        loss, toks, out = decode_payload(b16)
+        assert loss == 1.5 and toks == 64
+        np.testing.assert_allclose(out, vec, rtol=2**-7, atol=1e-6)
+        assert np.abs(out - vec).max() > 0  # genuinely rounded
+
+    def test_hybrid_runs_on_bf16_wire(self):
+        from kv_fake import FakeKvClient
+        from test_dcn_protocol import make_trainer, run_cluster
+        client = FakeKvClient()
+        n = 2
+        trainers = [make_trainer(i, n, client, deadline_s=5.0, lr=1.0,
+                                 wire="bf16") for i in range(n)]
+        results, errors = run_cluster(trainers, 2)
+        assert not errors, errors
+        np.testing.assert_array_equal(results[0], results[1])
+        # grads are rank+1 constants -> mean 1.5; two sgd lr=1 steps
+        np.testing.assert_allclose(results[0], -3.0, rtol=2e-2)
