@@ -87,8 +87,9 @@ class TrainConfig:
     # one-forward-one-backward scan, O(pp) residency; dense layers only
     # — see parallel/pp.py pp_schedule_stats for the economics)
     pp_schedule: str = "gpipe"
-    # gradient-sync wire format: "f32" or "int8" (quantized two-phase
-    # allreduce — needs exactly one data axis of size > 1)
+    # gradient-sync wire format: "f32"; "bf16" (half the collective
+    # bytes, plain rounding, any axis combination); or "int8" (quantized
+    # two-phase allreduce — needs exactly one data axis of size > 1)
     grad_transport: str = "f32"
     # "bf16" runs the model compute (matmuls, activations) in bfloat16 on
     # the MXU while master weights, gradients, and the optimizer stay f32
@@ -637,8 +638,8 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         data-dependent may enter the key. Each sync call folds in its own
         tag (sync_and_metrics) so the dense and expert collectives draw
         uncorrelated noise in the same round."""
-        if cfg.grad_transport == "f32":
-            return None
+        if cfg.grad_transport != "int8":
+            return None  # only the int8 wire rounds stochastically
         return jax.random.fold_in(jax.random.key(17), quant_seed)
 
     def sync_and_metrics(loss, aux, grads, total_count, quant_key,

@@ -56,11 +56,13 @@ class GradSyncConfig:
     # (an extra HBM pass); callers that only need the per-bucket counts
     # (training loops, benchmarks) turn it off and read bucket_counts.
     return_elem_counts: bool = True
-    # Wire format of the collective: "f32" (stock psum) or "int8"
-    # (quantized two-phase allreduce, ops/collectives.py — 4x less ICI/DCN
-    # traffic, one stochastic-rounding error per hop). int8 requires a
-    # single data axis and bucket_elems divisible by its size. Lossy
-    # (masked) rounds keep the int8 wire: masked contributions quantize to
+    # Wire format of the collective: "f32" (stock psum); "bf16" (the
+    # operand dtype IS the wire — half the ICI/DCN bytes with plain
+    # rounding, any axis combination, size-1 axes bypass the cast); or
+    # "int8" (quantized two-phase allreduce, ops/collectives.py — 4x less
+    # traffic, one stochastic-rounding error per hop; requires a single
+    # data axis and bucket_elems divisible by its size). Lossy (masked)
+    # rounds keep the compressed wire: masked contributions round to
     # exact zeros and the per-bucket counts ride a separate exact int32
     # psum.
     transport: str = "f32"

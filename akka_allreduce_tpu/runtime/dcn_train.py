@@ -120,9 +120,9 @@ def encode_payload(vec: np.ndarray, loss: float, tokens: float,
     if wire == "f32":
         return _HDR.pack(loss, int(tokens), _WIRE_F32) + vec.tobytes()
     if wire == "bf16":
-        import ml_dtypes
+        # jnp.bfloat16 IS the ml_dtypes numpy dtype — no extra import
         return (_HDR.pack(loss, int(tokens), _WIRE_BF16)
-                + vec.astype(ml_dtypes.bfloat16).tobytes())
+                + vec.astype(jnp.bfloat16).tobytes())
     if wire != "int8":
         raise ValueError(f"unknown wire {wire!r}")
     n = vec.size
@@ -146,9 +146,8 @@ def decode_payload(data: bytes) -> tuple[float, float, np.ndarray]:
     if wire == _WIRE_F32:
         return loss, tokens, np.frombuffer(data, np.float32, offset=off)
     if wire == _WIRE_BF16:
-        import ml_dtypes
         return loss, tokens, np.frombuffer(
-            data, ml_dtypes.bfloat16, offset=off).astype(np.float32)
+            data, jnp.bfloat16, offset=off).astype(np.float32)
     if wire != _WIRE_INT8:
         raise ValueError(f"unknown wire flag {wire}")
     (n,) = struct.unpack_from("<Q", data, off)

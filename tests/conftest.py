@@ -33,3 +33,17 @@ jax.config.update("jax_platforms", "cpu")
 # only reproduces under fusion).
 if not os.environ.get("AATPU_TEST_FULL_OPTS"):
     jax.config.update("jax_disable_most_optimizations", True)
+
+# Persistent XLA compilation cache, repo-local and gitignored: identical
+# programs skip compilation on repeat runs (the tier's wall time is
+# compile-dominated on this 1-core box), with ZERO semantic change — a
+# cache hit replays the exact executable a cold run would have built, so
+# every assertion sees identical numerics. A code edit invalidates only
+# the programs it changes. AATPU_TEST_NO_COMPILE_CACHE=1 disables (e.g.
+# to measure true cold-compile time).
+if not os.environ.get("AATPU_TEST_NO_COMPILE_CACHE"):
+    _cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
