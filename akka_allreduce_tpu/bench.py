@@ -85,7 +85,8 @@ def _log(msg: str) -> None:
 def measure_device_goodput(elems: int, bucket_elems: int,
                            r_hi: int = R_HI, r_lo: int = R_LO,
                            valid_fraction: float = 1.0,
-                           reps: int = 3, return_stats: bool = False):
+                           reps: int = 3, return_stats: bool = False,
+                           transport: str = "f32"):
     """Goodput (payload GB/s) of the full device sync path on all available
     real devices. ``valid_fraction < 1`` exercises the lossy masked path
     (BASELINE.md config #4): that fraction of buckets contributes per round
@@ -96,6 +97,14 @@ def measure_device_goodput(elems: int, bucket_elems: int,
     GB/s — the stable way to report SMALL payloads, whose per-round time
     (~0.02 ms at 1M floats) sits below the relay's run-to-run jitter when
     expressed as bandwidth (round-2 verdict, weak #2)."""
+    if transport not in ("f32", "bf16"):
+        # int8 needs a per-round quant key this harness does not thread;
+        # its wire has dedicated A/B rows (bench_suite ab_pallas_vs_xla).
+        # Checked BEFORE backend init: a flag error must not hang on an
+        # unhealthy chip
+        raise ValueError(
+            f"measure_device_goodput supports transport f32|bf16, got "
+            f"{transport!r}")
     _log("initializing backend (jax.devices()) ...")
     devices = jax.devices()
     n = len(devices)
@@ -107,7 +116,7 @@ def measure_device_goodput(elems: int, bucket_elems: int,
     lossy = valid_fraction < 1.0
     cfg = GradSyncConfig(bucket_elems=bucket_elems, average=True,
                          rescale_target=float(n) if lossy else 1.0,
-                         return_elem_counts=False)
+                         return_elem_counts=False, transport=transport)
     base_valid = None
     if lossy:
         n_valid = max(1, int(round(valid_fraction * num_buckets)))

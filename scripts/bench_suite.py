@@ -156,6 +156,7 @@ def main() -> int:
     ab_pallas_vs_xla()
     ab_flash_attention()
     ab_windowed_sp()
+    ab_bf16_cast()
     ab_moe_dispatch()
     mfu_lines()
     return 0
@@ -370,6 +371,35 @@ def ab_windowed_sp():
         emit("ab_windowed_sp_winner", results[win], "TFLOP/s",
              f"{win} ({times[win] * 1e3:.2f} ms/step vs "
              f"{max(times.values()) * 1e3:.2f})")
+
+
+def ab_bf16_cast():
+    """The bf16 gradient wire's device-side overhead: f32->bf16->f32
+    round-trip bandwidth at gradient-bucket scale. On one chip the wire
+    itself is invisible (size-1 axes bypass the cast — pinned in
+    tests/test_bf16_wire.py), so the honest single-chip number is what
+    a pod PAYS around its halved ICI bytes: two extra HBM passes of
+    cast. Payload GB/s (f32 bytes processed / time)."""
+    import jax
+    import jax.numpy as jnp
+
+    plat = jax.devices()[0].platform
+    on_tpu = plat == "tpu"
+    elems = 25_000_000 if on_tpu else 250_000
+    xs = [jax.random.uniform(jax.random.key(i), (elems,), jnp.float32)
+          for i in range(2)]
+
+    def f(x, c):
+        y = (x + c * 1e-30).astype(jnp.bfloat16).astype(jnp.float32)
+        return jnp.sum(y[:8]) * 1e-9 + c, y
+
+    t = _time_device_fn(jax.jit(f), [(x,) for x in xs],
+                        k_hi=160 if on_tpu else 16,
+                        k_lo=40 if on_tpu else 4)
+    emit(f"ab_bf16_cast_roundtrip_{plat}", elems * 4 / t / 1e9, "GB/s",
+         f"f32->bf16->f32 round-trip, {elems} elems (the bf16 wire's "
+         f"per-hop device overhead; the 2x ICI-byte saving itself needs "
+         f"a multi-chip wire to show)")
 
 
 def mfu_lines():
