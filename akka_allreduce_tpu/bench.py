@@ -363,7 +363,8 @@ def main() -> None:
                             the recipe tests/conftest.py documents; this
                             environment's default TPU backend can hang for
                             tens of minutes before failing UNAVAILABLE).
-      AATPU_BENCH_ELEMS / AATPU_BENCH_BUCKET_ELEMS / AATPU_BENCH_R_HI /
+      AATPU_BENCH_ELEMS / AATPU_BENCH_BUCKET_ELEMS / AATPU_BENCH_TRANSPORT
+      (f32|bf16 collective wire) / AATPU_BENCH_R_HI /
       AATPU_BENCH_R_LO / AATPU_BENCH_REPS  measurement sizing.
     """
     platform = os.environ.get("AATPU_BENCH_PLATFORM", "default")
@@ -389,10 +390,12 @@ def main() -> None:
     r_hi = int(os.environ.get("AATPU_BENCH_R_HI", R_HI))
     r_lo = int(os.environ.get("AATPU_BENCH_R_LO", R_LO))
     reps = int(os.environ.get("AATPU_BENCH_REPS", 3))
+    transport = os.environ.get("AATPU_BENCH_TRANSPORT", "f32")
     if not 0 < r_lo < r_hi:
         raise SystemExit(f"need 0 < R_LO < R_HI, got {r_lo}/{r_hi}")
     goodput_gbps = measure_device_goodput(elems, bucket_elems,
-                                          r_hi=r_hi, r_lo=r_lo, reps=reps)
+                                          r_hi=r_hi, r_lo=r_lo, reps=reps,
+                                          transport=transport)
     n = len(jax.devices())
     dev = jax.devices()[0]
     plat = dev.platform
@@ -423,7 +426,7 @@ def main() -> None:
         # bound (HBM passes through the sync path), not collective traffic
         note = "1-device: framework overhead bound (psum=identity); " + note
     print(json.dumps({
-        "metric": f"allreduce_goodput_{mega}M_f32_{n}{label}",
+        "metric": f"allreduce_goodput_{mega}M_{transport}_{n}{label}",
         "value": round(goodput_gbps, 2),
         "unit": "GB/s",
         "vs_baseline": vs,

@@ -167,6 +167,74 @@ class TestLossyInvariantsAcrossSchedules:
         assert not failures, "\n".join(map(str, failures[:5]))
 
 
+@pytest.mark.slow
+class TestConfigSpaceFuzz:
+    """Config-space fuzzing x schedule fuzzing: random (workers,
+    data_size, chunk, maxLag, thresholds, rounds) draws, each run under
+    a battery of adversarial schedules, checked against the invariants
+    that hold for EVERY all-alive config: all paced rounds complete,
+    every worker flushes every round, and each flush is honest —
+    ``data == arange * count`` elementwise with ``0 <= count <= N``.
+    Count 0 is REACHABLE under lossy thresholds even with everyone
+    alive: an adversarial ordering can fire the (exactly-once)
+    completion gate while some block's reduce never reached threshold,
+    and that block flushes zero-filled with count 0 — the reference's
+    missing-chunk semantics (ReducedDataBuffer.scala:40-48). This
+    fuzzer FOUND that reachability (first written with count >= 1; the
+    failure label reproduced it deterministically)."""
+
+    def test_random_configs_under_random_schedules(self):
+        import random as pyrandom
+        rng = pyrandom.Random(20260731)
+        for trial in range(10):
+            n = rng.choice([2, 3, 4, 5])
+            data_size = rng.randint(n, 48)
+            chunk = rng.randint(1, max(1, data_size // 2))
+            lag = rng.choice([1, 2, 4])
+            rounds = rng.randint(1, 5)
+            th = rng.choice([(1.0, 1.0, 1.0), (0.7, 0.8, 0.7),
+                             (0.5, 0.9, 0.8)])
+            config = make_config(n, data_size, chunk=chunk, max_lag=lag,
+                                 max_round=rounds, th=th)
+            outputs = {}
+
+            def make(config=config, n=n, ds=data_size, outputs=outputs):
+                for r in range(n):
+                    outputs[r] = []
+                return LocalCluster(
+                    config,
+                    source_factory=lambda r: constant_range_source(ds),
+                    sink_factory=lambda r: outputs[r].append)
+
+            def validate(cluster, n=n, ds=data_size, rounds=rounds,
+                         outputs=outputs):
+                assert len(cluster.completed_rounds) == rounds, \
+                    (len(cluster.completed_rounds), rounds)
+                base = np.arange(ds, dtype=np.float32)
+                for r in range(n):
+                    assert len(outputs[r]) == rounds + 1, \
+                        (r, len(outputs[r]))
+                    for out in outputs[r]:
+                        if th == (1.0, 1.0, 1.0):
+                            # exact thresholds: nothing may be dropped
+                            # under ANY ordering — the file's
+                            # exact_validator contract
+                            assert (out.count == n).all()
+                        else:
+                            assert (out.count >= 0).all()
+                            assert (out.count <= n).all()
+                        np.testing.assert_allclose(
+                            out.data, base * out.count, rtol=1e-6)
+
+            names = ["master"] + [f"worker-{r}" for r in range(n)]
+            failures = explore(
+                make, standard_schedules(names, seeds=12), validate)
+            assert not failures, (
+                f"trial {trial} (n={n} ds={data_size} chunk={chunk} "
+                f"lag={lag} th={th} rounds={rounds}):\n"
+                + "\n".join(map(str, failures[:5])))
+
+
 class TestEmulateFuzzCli:
     """The operator surface: `emulate --fuzz N` runs the explorer over
     the user's own config."""
