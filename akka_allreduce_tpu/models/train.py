@@ -121,6 +121,14 @@ class TrainConfig:
     # the optimizer state)
     optimizer: str = "adamw"
     sgd_momentum: float = 0.9
+    # adamw/lion weight decay, applied through a MASK to rank >= 2
+    # parameters only (weight matrices, embeddings, stacked expert /
+    # pipeline tensors): decaying rmsnorm gains and other 1D vectors
+    # toward zero is a known quality bug, not regularisation — the
+    # standard recipe exempts them. adafactor keeps its own
+    # weight_decay_rate semantics (relative to parameter scale) and the
+    # same mask.
+    weight_decay: float = 1e-4
     # Gradient accumulation (non-pp path): split the local batch into K
     # microbatches, scan them accumulating LOCAL gradients, then run the
     # bucketed cross-rank sync ONCE — activation memory drops to one
@@ -257,7 +265,7 @@ def make_train_state(key: jax.Array, cfg: TrainConfig, mesh: Mesh
         _validate_pp(cfg.model, pp)
         full = dict(full, layers=stack_layer_params(full["layers"]))
     params = shard_params(full, param_specs(cfg.model, pp=pp), mesh)
-    opt = make_optimizer(cfg)
+    opt = make_optimizer(cfg, stacked_layers=pp > 1)
     opt_state = place_opt_state(opt, jax.jit(opt.init)(params), params, mesh)
     return params, opt_state, opt
 
@@ -338,22 +346,46 @@ def get_ema_params(opt_state) -> Any:
     return state.ema if state is not None else None
 
 
-def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+def make_optimizer(cfg: TrainConfig, stacked_layers: bool = False
+                   ) -> optax.GradientTransformation:
     """The training chain: step counter, optional global-norm clip, then
     the configured family. Families beyond adamw are beyond-reference
     surface; adafactor is the TPU-native default for optimizer-memory-
-    bound configs (factored second moments)."""
+    bound configs (factored second moments).
+
+    ``stacked_layers`` must be True when the params tree carries
+    pipeline-STACKED layers (make_train_state with pp > 1): stacking
+    adds a leading layer axis, so a per-layer rmsnorm gain (d,) arrives
+    as (L, d) and a naive rank rule would decay it — the exact bug the
+    mask exists to prevent. The mask therefore ranks layer leaves by
+    their UNSTACKED shape."""
     lr = make_lr_schedule(cfg)
     fam = cfg.optimizer
+
+    def decay_mask(params):
+        # decay rank >= 2 tensors only (see TrainConfig.weight_decay),
+        # measured on the per-layer shape when layers are stacked
+        def mark(path, p):
+            nd = p.ndim
+            if stacked_layers and any(
+                    getattr(k, "key", None) == "layers" for k in path):
+                nd -= 1
+            return nd >= 2
+        return jax.tree_util.tree_map_with_path(mark, params)
+
     if fam == "adamw":
-        core = optax.adamw(lr)
+        core = optax.adamw(lr, weight_decay=cfg.weight_decay,
+                           mask=decay_mask)
     elif fam == "adafactor":
-        core = optax.adafactor(learning_rate=lr)
+        core = optax.adafactor(learning_rate=lr,
+                               weight_decay_rate=cfg.weight_decay or None,
+                               weight_decay_mask=decay_mask)
     elif fam == "sgd":
         core = optax.sgd(lr, momentum=cfg.sgd_momentum or None,
                          nesterov=cfg.sgd_momentum > 0)
     elif fam == "lion":
-        core = optax.lion(lr)
+        core = optax.lion(lr, weight_decay=cfg.weight_decay,
+                          mask=decay_mask)
     else:
         raise ValueError(
             f"unknown optimizer {fam!r}: adamw | adafactor | sgd | lion")
