@@ -1,5 +1,9 @@
 #!/usr/bin/env python
-"""One-shot real-chip measurement capture -> PERF.md + perf_tpu.json.
+"""One-shot real-chip measurement capture -> PERF_capture.md + perf_tpu.json.
+
+PERF.md itself is hand-maintained (narrative sections, per-row caveats,
+the chip log) — this script writes the raw capture to PERF_capture.md
+for MANUAL merge so a capture can never clobber the curated analysis.
 
 The TPU backend on this machine is intermittently unreachable (it can hang
 for hours — round-1 postmortem in VERDICT.md, reproduced round 2), so every
@@ -124,9 +128,10 @@ subprocess.run([sys.executable, "-u", "scripts/bench_canonical.py"])
                 f"| {row.get('metric', '?')} | {row.get('value', row.get('mfu_pct', ''))} "
                 f"| {row.get('unit', '%' if 'mfu_pct' in row else '')} "
                 f"| {row.get('note', row.get('compute_dtype', ''))} |")
-    with open(os.path.join(ROOT, "PERF.md"), "w") as f:
+    with open(os.path.join(ROOT, "PERF_capture.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
-    print("[capture] wrote PERF.md + perf_tpu.json", file=sys.stderr)
+    print("[capture] wrote PERF_capture.md + perf_tpu.json — merge the "
+          "rows into the hand-maintained PERF.md", file=sys.stderr)
     return 0
 
 
