@@ -105,13 +105,66 @@ def _attempt(platform: str, timeout_s: float) -> "dict | None":
     return None
 
 
+def _fast_probe(timeout_s: float = 90.0) -> bool:
+    """Small-matmul probe of the default backend in a budgeted subprocess.
+
+    Round-4 verdict #6: the default-platform attempt burns its full
+    watchdog budget (270-420 s) discovering the relay is dead before the
+    CPU fallback even starts. A 90 s probe answers the same question at a
+    fraction of the budget; an in-process call would hang for hours
+    (round-1 postmortem)."""
+    code = ("import jax, jax.numpy as jnp; x = jnp.ones((512, 512)); "
+            "print('PROBE_OK', float((x @ x).sum()))")
+    proc = subprocess.Popen([sys.executable, "-c", code], cwd=REPO_ROOT,
+                            stdout=subprocess.PIPE, stderr=sys.stderr,
+                            text=True, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.communicate()
+        return False
+    return "PROBE_OK" in (out or "")
+
+
+def _last_banked_note() -> str:
+    """Cite the last committed on-chip capture so a CPU-fallback round
+    still points the reader at real TPU evidence (round-4 verdict #6)."""
+    try:
+        with open(os.path.join(REPO_ROOT, "perf_tpu.json")) as f:
+            perf = json.load(f)
+        when = (perf.get("captured_at") or "?")[:19]
+        rows = perf.get("headline") or []
+        head = next((r for r in rows if "metric" in r), None)
+        if head is not None:
+            return (f"last banked on-chip capture {when}: "
+                    f"{head['metric']}={head.get('value')} "
+                    f"{head.get('unit', '')} (perf_tpu.json, committed)")
+        return f"last banked on-chip capture {when} (perf_tpu.json)"
+    except (OSError, json.JSONDecodeError, KeyError):
+        return "no banked on-chip capture found (perf_tpu.json missing)"
+
+
 def main() -> None:
     timeout_s = float(os.environ.get("AATPU_BENCH_TIMEOUT_S", "270"))
     platforms = os.environ.get("AATPU_BENCH_PLATFORMS", "default,cpu")
     errors = []
     for platform in [p.strip() for p in platforms.split(",") if p.strip()]:
+        if platform != "cpu" and not _fast_probe():
+            _log(f"fast probe: default backend unreachable in 90s; "
+                 f"skipping platform={platform}")
+            errors.append(f"{platform}: fast-probe unreachable")
+            continue
         result = _attempt(platform, timeout_s)
         if result is not None:
+            if platform == "cpu":
+                # a CPU number is a liveness proof, not the perf claim —
+                # point at the banked TPU rows
+                result["note"] = (result.get("note", "") +
+                                  "; " + _last_banked_note()).lstrip("; ")
             print(json.dumps(result), flush=True)
             return
         errors.append(f"{platform}: timeout/crash/no-json")
@@ -121,6 +174,7 @@ def main() -> None:
         "unit": "GB/s",
         "vs_baseline": 0.0,
         "error": "; ".join(errors) or "no platforms attempted",
+        "note": _last_banked_note(),
     }), flush=True)
 
 

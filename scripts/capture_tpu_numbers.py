@@ -1,19 +1,31 @@
 #!/usr/bin/env python
-"""One-shot real-chip measurement capture -> PERF_capture.md + perf_tpu.json.
+"""Real-chip measurement capture -> perf_capture/*.json + PERF_capture.md.
 
 PERF.md itself is hand-maintained (narrative sections, per-row caveats,
-the chip log) — this script writes the raw capture to PERF_capture.md
-for MANUAL merge so a capture can never clobber the curated analysis.
+the chip log) — this script banks RAW rows for manual merge so a capture
+can never clobber the curated analysis.
 
-The TPU backend on this machine is intermittently unreachable (it can hang
-for hours — round-1 postmortem in VERDICT.md, reproduced round 2), so every
-number-gathering step runs as a subprocess under its own wall-clock budget:
-whatever lands, lands; a hung step cannot take the capture down with it.
-Run whenever the backend is healthy:
+The TPU backend on this machine is intermittently unreachable for hours
+(round-1/3/4 postmortems in VERDICT.md; round 4's healthy window was 23
+minutes), so the capture is designed for short random windows:
 
-    python scripts/capture_tpu_numbers.py
+* every step runs as a subprocess under its own wall-clock budget — a
+  hung step cannot take the capture down with it;
+* steps run OPEN-CLAIMS-FIRST (round-4 verdict #1): the measurements a
+  verdict is waiting on come before re-captures of already-banked
+  numbers, so 20 minutes of chip banks what matters;
+* each step that produces rows is banked to ``perf_capture/<step>.json``
+  immediately and SKIPPED on re-runs — the capture is resumable across
+  health windows (run it as often as the chip comes up; ``--force`` or
+  ``--steps a,b`` override).
+
+``scripts/tpu_watcher.py`` probes the relay every few minutes and invokes
+this script on the first healthy probe, then commits whatever landed.
+
+Exit: 0 = all chip steps banked; 1 = backend unreachable; 2 = partial.
 """
 
+import argparse
 import datetime
 import json
 import os
@@ -22,11 +34,80 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAP_DIR = os.path.join(ROOT, "perf_capture")
+
+# (name, section, budget_s, code). ORDER IS THE CONTRACT: open claims
+# first (round-4 verdict #1). Sections mirror the legacy perf_tpu.json
+# layout so PERF.md merges stay mechanical.
+STEPS = [
+    # 1. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
+    # defaults True in measure_train_mfu — this is the rework that never
+    # got chip time
+    ("scan_mfu_bf16", "mfu", 1500, """
+import json
+from akka_allreduce_tpu.bench import measure_train_mfu
+r = measure_train_mfu(compute_dtype="bf16")
+print(json.dumps({"metric": "mfu_train_bf16", "scan_steps": True, **r}),
+      flush=True)
+"""),
+    # 2. the reworked windowed-SP A/B (round-4 verdict weak #4: zero
+    # on-chip rows; the old 29.7 TFLOP/s quote is from a flawed harness)
+    ("windowed_sp", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "ab_windowed_sp"], check=False)
+"""),
+    # 3. headline goodput as median-of-5 two-point deltas with spread
+    # (round-4 verdict weak #3: three single-shot captures spread
+    # 305-341 GB/s with no methodology)
+    ("headline_median", "headline", 700, """
+import os, subprocess, sys
+env = {**os.environ, "AATPU_BENCH_PLATFORM": "default",
+       "AATPU_BENCH_REPS": "5", "AATPU_BENCH_STATS": "1"}
+subprocess.run([sys.executable, "-m", "akka_allreduce_tpu.bench"],
+               env=env, check=False)
+"""),
+    # 4. f32 MFU companion row
+    ("scan_mfu_f32", "mfu", 1200, """
+import json
+from akka_allreduce_tpu.bench import measure_train_mfu
+r = measure_train_mfu(compute_dtype="f32")
+print(json.dumps({"metric": "mfu_train_f32", "scan_steps": True, **r}),
+      flush=True)
+"""),
+    # 5. decode bench
+    ("decode", "decode", 600, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_decode.py"],
+               check=False)
+"""),
+    # 6. the rest of the suite (MFU and windowed-SP skipped — steps 1/4
+    # and 2 own those rows; a re-run here would bank duplicates)
+    ("suite", "suite", 1800, """
+import os, subprocess, sys
+env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1",
+       "AATPU_SUITE_SKIP": "ab_windowed_sp"}
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env,
+               check=False)
+"""),
+]
+
+# canonical-scale configs (64/256 workers) are HOST-plane — no TPU
+# involved, ~40-50 GB peak RSS, ~1 h — so they are not gated on chip
+# health and only run when asked for explicitly.
+HOST_STEPS = [
+    ("canonical", "canonical", 3600, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_canonical.py"],
+               check=False)
+"""),
+]
 
 
 def run(tag, code, budget_s):
-    """Run `code` in a subprocess; return parsed JSON lines from stdout."""
-    print(f"[capture] {tag} (budget {budget_s}s)", file=sys.stderr)
+    """Run `code` in a subprocess; return parsed JSON rows from stdout."""
+    print(f"[capture] {tag} (budget {budget_s}s)", file=sys.stderr,
+          flush=True)
     proc = subprocess.Popen([sys.executable, "-c", code], cwd=ROOT,
                             stdout=subprocess.PIPE, stderr=sys.stderr,
                             text=True, start_new_session=True)
@@ -38,7 +119,7 @@ def run(tag, code, budget_s):
         except ProcessLookupError:
             pass
         out, _ = proc.communicate()
-        print(f"[capture] {tag}: TIMED OUT", file=sys.stderr)
+        print(f"[capture] {tag}: TIMED OUT", file=sys.stderr, flush=True)
     rows = []
     for line in (out or "").splitlines():
         try:
@@ -47,91 +128,191 @@ def run(tag, code, budget_s):
             continue
         if isinstance(row, dict):
             rows.append(row)
-    print(f"[capture] {tag}: {len(rows)} rows", file=sys.stderr)
+    print(f"[capture] {tag}: {len(rows)} rows", file=sys.stderr, flush=True)
     return rows
 
 
+def banked(step):
+    path = os.path.join(CAP_DIR, f"{step}.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            art = json.load(f)
+        return art if art.get("rows") else None
+    except (json.JSONDecodeError, OSError):
+        return None
+
+
+def bank(step, section, rows, device):
+    os.makedirs(CAP_DIR, exist_ok=True)
+    art = {
+        "step": step,
+        "section": section,
+        "captured_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "device": device,
+        "rows": rows,
+    }
+    path = os.path.join(CAP_DIR, f"{step}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(art, f, indent=1)
+    os.replace(tmp, path)  # a mid-write kill must not corrupt the bank
+    return art
+
+
+def aggregate():
+    """Merge every banked artifact (+ the legacy perf_tpu.json sections
+    nothing has re-captured yet) into perf_tpu.json + PERF_capture.md."""
+    legacy_path = os.path.join(ROOT, "perf_tpu.json")
+    merged = {}
+    if os.path.exists(legacy_path):
+        try:
+            with open(legacy_path) as f:
+                old = json.load(f)
+            # prefer the per-section record (it keeps each section's OWN
+            # capture date); the flat top-level stamp is only correct
+            # for a true round-3-era single-capture file — re-reading
+            # our own output through the flat path would re-stamp stale
+            # sections with the newest artifact's date
+            old_secs = old.get("sections") or {}
+            for sec in ("headline", "mfu", "decode", "suite", "canonical"):
+                if sec in old_secs and old_secs[sec].get("rows"):
+                    merged[sec] = old_secs[sec]
+                elif old.get(sec):
+                    merged[sec] = {"rows": old[sec],
+                                   "captured_at": old.get("captured_at"),
+                                   "device": old.get("device")}
+        except (json.JSONDecodeError, OSError):
+            pass
+    arts = []
+    if os.path.isdir(CAP_DIR):
+        for fn in sorted(os.listdir(CAP_DIR)):
+            if fn.endswith(".json"):
+                try:
+                    with open(os.path.join(CAP_DIR, fn)) as f:
+                        arts.append(json.load(f))
+                except (json.JSONDecodeError, OSError):
+                    continue
+    # newer artifacts override legacy sections; two artifacts in one
+    # section (the two MFU steps; windowed_sp + suite) concatenate
+    by_section = {}
+    for art in arts:
+        by_section.setdefault(art["section"], []).append(art)
+    for sec, sec_arts in by_section.items():
+        rows, newest = [], ""
+        for art in sec_arts:
+            rows.extend(art["rows"])
+            newest = max(newest, art.get("captured_at") or "")
+        merged[sec] = {"rows": rows, "captured_at": newest,
+                       "device": sec_arts[0].get("device")}
+    if not merged:
+        return
+    out = {
+        "captured_at": max(v.get("captured_at") or "" for v in
+                           merged.values()),
+        "device": next((v["device"] for v in merged.values()
+                        if v.get("device")), None),
+        "sections": merged,
+    }
+    # legacy flat layout too, so older readers/diffs stay comparable
+    for sec, v in merged.items():
+        out[sec] = v["rows"]
+    with open(legacy_path, "w") as f:
+        json.dump(out, f, indent=1)
+
+    lines = [
+        "# PERF capture — raw banked rows",
+        "",
+        f"Latest row banked {out['captured_at']} "
+        f"(resumable per-step capture; see scripts/capture_tpu_numbers.py; "
+        f"artifacts in perf_capture/*.json). Merge rows into the "
+        f"hand-maintained PERF.md.",
+        "",
+        "| metric | value | unit | captured | note |",
+        "|--------|-------|------|----------|------|",
+    ]
+    for sec in ("mfu", "headline", "decode", "suite", "canonical"):
+        v = merged.get(sec)
+        if not v:
+            continue
+        when = (v.get("captured_at") or "?")[:16]
+        for row in v["rows"]:
+            val = row.get("value", row.get("mfu_pct", ""))
+            lines.append(
+                f"| {row.get('metric', '?')} | {val} "
+                f"| {row.get('unit', '%' if 'mfu_pct' in row else '')} "
+                f"| {when} "
+                f"| {row.get('note', row.get('compute_dtype', ''))} |")
+    with open(os.path.join(ROOT, "PERF_capture.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
 def main():
-    probe = run("probe", """
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", default="",
+                    help="comma list; default = every un-banked chip step")
+    ap.add_argument("--force", action="store_true",
+                    help="re-run steps even if already banked")
+    ap.add_argument("--host", action="store_true",
+                    help="also run host-plane steps (canonical; ~1 h, "
+                         "~40-50 GB RSS — no chip needed)")
+    args = ap.parse_args()
+
+    steps = list(STEPS) + (list(HOST_STEPS) if args.host else [])
+    if args.steps:
+        want = set(args.steps.split(","))
+        known = {s[0] for s in steps} | {s[0] for s in HOST_STEPS}
+        unknown = want - known
+        if unknown:
+            print(f"[capture] unknown steps {sorted(unknown)}; have "
+                  f"{sorted(known)}", file=sys.stderr)
+            return 1
+        steps = [s for s in list(STEPS) + list(HOST_STEPS)
+                 if s[0] in want]
+
+    todo = [s for s in steps
+            if args.force or banked(s[0]) is None]
+    if not todo:
+        print("[capture] every requested step already banked "
+              "(--force to re-run)", file=sys.stderr)
+        aggregate()
+        return 0
+
+    chip_needed = any(name not in {h[0] for h in HOST_STEPS}
+                      for name, *_ in todo)
+    device = None
+    if chip_needed:
+        probe = run("probe", """
 import json, jax, jax.numpy as jnp
 x = jnp.ones((512, 512))
 float((x @ x).sum())
 d = jax.devices()[0]
 print(json.dumps({"platform": d.platform, "device_kind": d.device_kind}))
 """, 90)
-    if not probe:
-        print("[capture] backend unreachable; nothing captured",
-              file=sys.stderr)
-        return 1
+        if not probe:
+            print("[capture] backend unreachable; nothing captured",
+                  file=sys.stderr)
+            return 1
+        device = probe[0]
 
-    results = {"captured_at": datetime.datetime.now(
-        datetime.timezone.utc).isoformat(), "device": probe[0]}
-
-    results["headline"] = run("headline bench.py", """
-import subprocess, sys
-# explicit keys LAST so ambient shell exports cannot redirect a capture
-# labeled real-chip onto the CPU fallback or outlive the outer budget
-subprocess.run([sys.executable, "bench.py"],
-               env={**__import__("os").environ,
-                    "AATPU_BENCH_PLATFORMS": "default",
-                    "AATPU_BENCH_TIMEOUT_S": "420"})
-""", 500)
-
-    results["mfu"] = run("train MFU", """
-import json
-from akka_allreduce_tpu.bench import measure_train_mfu
-for dtype in ("bf16", "f32"):
-    r = measure_train_mfu(compute_dtype=dtype)
-    # flush: a later hung step's SIGKILL must not eat this row from the
-    # pipe's block buffer
-    print(json.dumps({"metric": f"mfu_train_{dtype}", **r}), flush=True)
-""", 1800)
-
-    results["decode"] = run("bench_decode", """
-import subprocess, sys
-subprocess.run([sys.executable, "-u", "scripts/bench_decode.py"])
-""", 600)
-
-    results["suite"] = run("bench_suite", """
-import os, subprocess, sys
-# -u: line-buffer the child so budget kills keep completed rows;
-# skip the suite's own MFU pass — the dedicated step above measured it
-env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1"}
-subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env)
-""", 1500)
-
-    # canonical-scale configs 3/5 (64/256 workers, host plane — no TPU
-    # involved) + the 16/32-device dryrun sweep; ~40-50 GB peak RSS for
-    # the native runs, so this step runs LAST and alone
-    results["canonical"] = run("bench_canonical", """
-import subprocess, sys
-subprocess.run([sys.executable, "-u", "scripts/bench_canonical.py"])
-""", 3600)
-
-    with open(os.path.join(ROOT, "perf_tpu.json"), "w") as f:
-        json.dump(results, f, indent=1)
-
-    lines = [
-        "# PERF — real-chip measurements",
-        "",
-        f"Captured {results['captured_at']} on "
-        f"{results['device']['device_kind']} "
-        f"(driver-independent capture; see scripts/capture_tpu_numbers.py; "
-        f"raw rows in perf_tpu.json).",
-        "",
-        "| metric | value | unit | note |",
-        "|--------|-------|------|------|",
-    ]
-    for section in ("headline", "mfu", "decode", "suite", "canonical"):
-        for row in results.get(section, []):
-            lines.append(
-                f"| {row.get('metric', '?')} | {row.get('value', row.get('mfu_pct', ''))} "
-                f"| {row.get('unit', '%' if 'mfu_pct' in row else '')} "
-                f"| {row.get('note', row.get('compute_dtype', ''))} |")
-    with open(os.path.join(ROOT, "PERF_capture.md"), "w") as f:
-        f.write("\n".join(lines) + "\n")
-    print("[capture] wrote PERF_capture.md + perf_tpu.json — merge the "
-          "rows into the hand-maintained PERF.md", file=sys.stderr)
+    missing = 0
+    for name, section, budget, code in todo:
+        rows = run(name, code, budget)
+        if rows:
+            bank(name, section, rows, device)
+            aggregate()  # bank incrementally: a later wedge keeps this
+        else:
+            missing += 1
+    aggregate()
+    if missing:
+        print(f"[capture] partial: {missing}/{len(todo)} steps produced "
+              f"no rows (re-run when the chip is healthy — banked steps "
+              f"skip)", file=sys.stderr)
+        return 2
+    print("[capture] all requested steps banked; PERF_capture.md + "
+          "perf_tpu.json refreshed — merge into PERF.md", file=sys.stderr)
     return 0
 
 

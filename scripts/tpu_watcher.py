@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Background TPU-health watcher: auto-trigger the capture, commit rows.
+
+Round-4 verdict #1: the relay's healthy windows are short and random (23
+minutes in round 4) and captures only banked when a human noticed the
+chip was up. This watcher closes that loop:
+
+* probe the backend every ``--interval`` seconds (default 240) with a
+  small matmul in a 90 s-budget subprocess (an in-process call on a dead
+  relay hangs forever — round-1 postmortem);
+* on a healthy probe, run ``scripts/capture_tpu_numbers.py`` — it banks
+  each step to ``perf_capture/<step>.json`` as it lands, skips already-
+  banked steps, and orders open claims first, so even a minutes-long
+  window makes progress;
+* after every capture attempt, ``git commit`` JUST the capture artifacts
+  (path-scoped commit: concurrent work in the repo is never swept in);
+* exit once every chip step is banked (capture rc 0).
+
+Run it detached for a whole session:
+
+    nohup python scripts/tpu_watcher.py >> watcher.log 2>&1 &
+"""
+
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE = """
+import jax, jax.numpy as jnp
+x = jnp.ones((512, 512))
+print("PROBE_OK", float((x @ x).sum()), jax.devices()[0].device_kind)
+"""
+
+
+def log(msg):
+    now = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    print(f"[watcher {now}] {msg}", file=sys.stderr, flush=True)
+
+
+def probe_healthy(timeout_s=90):
+    # process-group kill like bench.py's _fast_probe: a probe wedged in
+    # uninterruptible backend I/O survives a plain kill, and an unreaped
+    # child would hang this unattended watcher for the whole session
+    import signal
+
+    proc = subprocess.Popen([sys.executable, "-c", PROBE], cwd=ROOT,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, OSError):
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # wedged beyond SIGKILL: abandon, keep watching
+        return False
+    return proc.returncode == 0 and "PROBE_OK" in (out or "")
+
+
+def commit_artifacts(note):
+    """Path-scoped commit of capture outputs only; no-op when unchanged."""
+    paths = ["perf_capture", "perf_tpu.json", "PERF_capture.md"]
+    existing = [p for p in paths if os.path.exists(os.path.join(ROOT, p))]
+    if not existing:
+        return
+    subprocess.run(["git", "add", "--"] + existing, cwd=ROOT, check=False)
+    diff = subprocess.run(["git", "diff", "--cached", "--quiet", "--"]
+                          + existing, cwd=ROOT)
+    if diff.returncode == 0:
+        return
+    subprocess.run(["git", "commit", "-m",
+                    f"Bank TPU capture rows ({note})", "--"] + existing,
+                   cwd=ROOT, check=False)
+    log(f"committed capture artifacts ({note})")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=240.0)
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    attempt = 0
+    while time.time() < deadline:
+        if probe_healthy():
+            attempt += 1
+            log(f"chip HEALTHY — launching capture (attempt {attempt})")
+            rc = subprocess.run(
+                [sys.executable, "scripts/capture_tpu_numbers.py"],
+                cwd=ROOT).returncode
+            commit_artifacts(f"watcher attempt {attempt}, capture rc={rc}")
+            if rc == 0:
+                log("all chip steps banked — watcher done")
+                return 0
+            log(f"capture rc={rc} (partial/unreachable); keep watching")
+        else:
+            log("chip down")
+        time.sleep(args.interval)
+    log("max watch time reached; exiting")
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
