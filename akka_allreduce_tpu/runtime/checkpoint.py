@@ -22,7 +22,125 @@ import dataclasses
 from typing import Any, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
+
+
+def _place_like(like: Any, raw: Any) -> Any:
+    """Put a template-free-restored host value back onto the live
+    template's dtype + sharding (scalars/aux pass through). Shape
+    mismatches raise — jax.device_put would accept any shape and defer
+    the failure to an obscure XLA error much later."""
+    arr = np.asarray(raw)
+    shape = getattr(like, "shape", None)
+    if shape is not None and tuple(shape) != arr.shape:
+        raise ValueError(
+            f"restored leaf shape {arr.shape} != template {tuple(shape)}")
+    dtype = getattr(like, "dtype", None)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    sharding = getattr(like, "sharding", None)
+    if sharding is not None:
+        return jax.device_put(arr, sharding)
+    return arr
+
+
+def _graft_legacy_opt_state(raw: Any, fresh: Any) -> Any:
+    """Transplant the recognisable optimizer states of a legacy
+    checkpoint into a freshly initialised current-chain state.
+
+    ``raw`` is the template-free orbax restore of an opt_state written
+    by an OLDER optimizer chain (namedtuples come back as lists/dicts,
+    EmptyState as None) — its tree structure no longer matches the
+    current chain (round-4 advisor, medium: the chain gained a step-
+    counter slot and a masked decay node, so a template restore fails).
+    ``fresh`` must be the freshly initialised state of the CURRENT
+    chain. Moment-bearing states (adam/lion mu/nu, sgd trace, adafactor
+    factored second moments) are matched by field set + sub-tree
+    structure and transplanted; every unmatched slot keeps its fresh
+    init; the chain's step counter (single-field ``count`` namedtuple)
+    adopts the restored count so schedules and quant seeds continue
+    rather than restart."""
+    candidates: list = []
+
+    def collect(node):
+        if isinstance(node, dict):
+            candidates.append(node)
+            for v in node.values():
+                collect(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                collect(v)
+
+    collect(raw)
+    used: set = set()
+    restored_count: list = []
+
+    def match(node):
+        fields = set(node._fields)
+        for cand in candidates:
+            if id(cand) in used or set(cand.keys()) != fields:
+                continue
+            ok = True
+            for f in fields:
+                like_sub = getattr(node, f)
+                try:
+                    if (jax.tree.structure(cand[f])
+                            != jax.tree.structure(like_sub)):
+                        ok = False
+                        break
+                    shapes_raw = [np.shape(x) for x in
+                                  jax.tree.leaves(cand[f])]
+                    shapes_like = [tuple(getattr(x, "shape", ()))
+                                   for x in jax.tree.leaves(like_sub)]
+                    if shapes_raw != shapes_like:
+                        ok = False
+                        break
+                except Exception:
+                    ok = False
+                    break
+            if ok:
+                return cand
+        return None
+
+    MOMENT_FIELDS = {"mu", "nu", "trace", "v_row", "v_col"}
+
+    def graft(node):
+        if hasattr(node, "_fields"):  # an optax NamedTuple state
+            if MOMENT_FIELDS & set(node._fields):
+                cand = match(node)
+                if cand is None:
+                    return node  # keep fresh init; nothing to rescue
+                used.add(id(cand))
+                if "count" in node._fields:
+                    restored_count.append(np.asarray(cand["count"]))
+                return type(node)(*[
+                    jax.tree.map(_place_like, getattr(node, f), cand[f])
+                    for f in node._fields])
+            return type(node)(*[graft(x) for x in node])
+        if isinstance(node, tuple):
+            return tuple(graft(x) for x in node)
+        if isinstance(node, list):
+            return [graft(x) for x in node]
+        return node
+
+    out = graft(fresh)
+    if restored_count:
+        count = restored_count[0]
+
+        def set_counter(node):
+            if hasattr(node, "_fields"):
+                if node._fields == ("count",):
+                    return type(node)(_place_like(node.count, count))
+                return type(node)(*[set_counter(x) for x in node])
+            if isinstance(node, tuple):
+                return tuple(set_counter(x) for x in node)
+            if isinstance(node, list):
+                return [set_counter(x) for x in node]
+            return node
+
+        out = set_counter(out)
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -127,6 +245,32 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def _resolve_step(self, step: Optional[int]) -> int:
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.config.directory}")
+        return step
+
+    def _item_names(self, step: int) -> Optional[set]:
+        """The checkpoint's item names from orbax metadata — the
+        STRUCTURAL layout detector (round-4 advisor, low: branching on
+        orbax's error-message text silently broke on rewording). None
+        when metadata is unavailable (caller falls back to probing)."""
+        try:
+            names = set(self._mgr.item_metadata(step).keys())
+        except Exception:
+            return None
+        return names or None
+
+    _LEGACY_PARAMS_ONLY_MSG = (
+        "checkpoint uses the legacy single-'state' layout (written "
+        "before the per-item split): weights-only restore needs the "
+        "split layout — resume the run once with `train --ckpt-dir ...` "
+        "under the original training flags (it re-saves in the new "
+        "layout), then retry")
+
     def restore(self, params_like: Any, opt_state_like: Any,
                 step: Optional[int] = None) -> tuple[int, Any, Any, dict]:
         """Restore ``(step, params, opt_state, extra)``.
@@ -135,23 +279,75 @@ class CheckpointManager:
         shardings + dtypes the restored arrays adopt — pass the freshly
         initialised state from :func:`make_train_state` and the checkpoint
         lands directly on the mesh, no host round-trip.
+
+        Legacy (pre-item-split) checkpoints restore through the single
+        'state' item. When even that template mismatches — the
+        checkpoint predates the optimizer-chain rework (step-counter
+        slot, masked decay) — the state is raw-restored and grafted:
+        moment states transplant into the fresh chain, new slots keep
+        their init (see :func:`_graft_legacy_opt_state`). For that path
+        ``opt_state_like`` must be the freshly initialised state of the
+        current chain, which is exactly what :func:`restore_or_init`
+        passes.
         """
+        step = self._resolve_step(step)
+        names = self._item_names(step)
+        if names is None or "params" in names:
+            try:
+                step, out = self._restore_items(
+                    {"params": params_like, "opt_state": opt_state_like},
+                    step)
+                return (step, out["params"], out["opt_state"],
+                        dict(out["extra"]))
+            except Exception as exc:
+                # metadata said the split layout exists -> any failure
+                # is real. Metadata unavailable -> probe: only orbax's
+                # missing-item error may fall through to legacy.
+                if (names is not None
+                        or "was not found in the checkpoint"
+                        not in str(exc)):
+                    raise
+        # legacy layout (pre-item-split): one 'state' composite item
+        # holding {params, opt_state} — a preempted old run must resume
         try:
-            step, out = self._restore_items(
-                {"params": params_like, "opt_state": opt_state_like},
-                step)
-        except Exception as exc:
-            # orbax's missing-item message, verbatim (matching narrowly:
-            # a shape/structure mismatch must NOT silently fall back)
-            if "was not found in the checkpoint" not in str(exc):
-                raise
-            # legacy layout (pre-item-split): one 'state' composite item
-            # holding {params, opt_state} — a preempted run checkpointed
-            # by the previous code must still resume
             step, out = self._restore_items(
                 {"state": {"params": params_like,
                            "opt_state": opt_state_like}}, step)
             out = {"extra": out["extra"], **out["state"]}
+        except Exception as template_exc:
+            # Probably a pre-rework optimizer chain (round-4 advisor,
+            # medium): raw-restore and graft onto the fresh chain — but
+            # ONLY when the saved params agree with the template
+            # structurally. A params mismatch means wrong model
+            # geometry, and swallowing that would replace an
+            # informative error with a silent moment-loss graft.
+            try:
+                out = self._mgr.restore(step, args=ocp.args.Composite(
+                    extra=ocp.args.JsonRestore(),
+                    state=ocp.args.StandardRestore()))
+            except Exception:
+                # the raw probe failing means the checkpoint is not a
+                # graftable legacy layout at all — the template error
+                # is the diagnostic one, keep it
+                raise template_exc
+            raw = out["state"]
+            try:
+                params_ok = (
+                    jax.tree.structure(raw["params"])
+                    == jax.tree.structure(params_like)
+                    and [np.shape(x) for x in
+                         jax.tree.leaves(raw["params"])]
+                    == [tuple(getattr(x, "shape", ()))
+                        for x in jax.tree.leaves(params_like)])
+            except Exception:
+                params_ok = False
+            if not params_ok:
+                raise template_exc
+            params = jax.tree.map(_place_like, params_like, raw["params"])
+            opt_state = _graft_legacy_opt_state(raw["opt_state"],
+                                                opt_state_like)
+            out = {"extra": out["extra"], "params": params,
+                   "opt_state": opt_state}
         return (step, out["params"], out["opt_state"],
                 dict(out["extra"]))
 
@@ -163,28 +359,31 @@ class CheckpointManager:
         ``--optimizer`` family or ema setting, at a third of the full
         restore's I/O. ``item="ema"`` selects the EMA weights a
         ``--ema-decay`` run saves alongside the raw ones."""
-        try:
-            step, out = self._restore_items({item: params_like}, step)
-        except Exception as exc:
-            # str(KeyError) is the repr of its message (inner quotes
-            # come back escaped), so match on names, not quoting: a
-            # checkpoint whose available items lack 'params' entirely is
-            # the legacy layout (which stored one 'state' item); a NEW
-            # checkpoint missing only e.g. 'ema' still lists 'params'
-            avail = str(exc).split("Available items:")[-1]
-            if ("was not found in the checkpoint" in str(exc)
-                    and "params" not in avail):
+        step = self._resolve_step(step)
+        names = self._item_names(step)
+        if names is not None and item not in names:
+            if "params" not in names and "state" in names:
                 # legacy single-'state' layout: weights-only restore is
                 # structurally impossible there (StandardRestore needs
                 # the whole item, optimizer state included — the reason
                 # the layout was split). Say so, with the way out.
-                raise ValueError(
-                    "checkpoint uses the legacy single-'state' layout "
-                    "(written before the per-item split): weights-only "
-                    "restore needs the split layout — resume the run "
-                    "once with `train --ckpt-dir ...` under the "
-                    "original training flags (it re-saves in the new "
-                    "layout), then retry") from exc
+                raise ValueError(self._LEGACY_PARAMS_ONLY_MSG)
+            raise KeyError(
+                f"item {item!r} not in checkpoint step {step}; "
+                f"available items: {sorted(names)}")
+        try:
+            step, out = self._restore_items({item: params_like}, step)
+        except Exception as exc:
+            # metadata-unavailable fallback: str(KeyError) is the repr
+            # of its message (inner quotes come back escaped), so match
+            # on names, not quoting: a checkpoint whose available items
+            # lack 'params' entirely is the legacy layout (which stored
+            # one 'state' item); a NEW checkpoint missing only e.g.
+            # 'ema' still lists 'params'
+            avail = str(exc).split("Available items:")[-1]
+            if ("was not found in the checkpoint" in str(exc)
+                    and "params" not in avail):
+                raise ValueError(self._LEGACY_PARAMS_ONLY_MSG) from exc
             raise
         return step, out[item], dict(out["extra"])
 
