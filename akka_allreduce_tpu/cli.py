@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -107,14 +108,28 @@ def _cmd_emulate(args: argparse.Namespace) -> int:
                   "schedule without --fuzz to trace it)",
                   file=sys.stderr)
             return 2
-        if args.kill_rank is not None and max(
-                args.th_allreduce, args.th_reduce,
-                args.th_complete) >= 1.0:
-            print("error: --fuzz --kill-rank needs every threshold < "
-                  "1.0 — at 1.0 nothing can complete with a dead "
-                  "worker, so there is no invariant to check",
-                  file=sys.stderr)
-            return 2
+        if args.kill_rank is not None:
+            # reachability at the flag layer (round-4 advisor): the
+            # validator demands every round complete with N-1 live
+            # workers, so each threshold's required count ceil(th*N)
+            # must be satisfiable by N-1 — otherwise every schedule
+            # "fails" and a config impossibility is presented as a race
+            # (e.g. th 0.9 with 4 workers needs ceil(3.6)=4 arrivals)
+            import math
+            unreachable = [
+                f"{flag} {th} needs ceil({th}*{args.workers})="
+                f"{math.ceil(th * args.workers)} workers"
+                for flag, th in (("--th-allreduce", args.th_allreduce),
+                                 ("--th-reduce", args.th_reduce),
+                                 ("--th-complete", args.th_complete))
+                if math.ceil(th * args.workers) > args.workers - 1]
+            if unreachable:
+                print("error: --fuzz --kill-rank runs with "
+                      f"{args.workers - 1} live workers, but "
+                      + "; ".join(unreachable)
+                      + " — lower the threshold(s) or raise --workers",
+                      file=sys.stderr)
+                return 2
         import numpy as np
 
         from akka_allreduce_tpu.protocol.explorer import (
@@ -285,8 +300,19 @@ def _add_worker(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "worker", help="run a worker process over the native TCP transport "
         "(reference: AllreduceWorker.scala:309-315)")
-    p.add_argument("--master-host", default="127.0.0.1")
+    p.add_argument("--master-host", default="127.0.0.1",
+                   help="master address, or a comma list of seed "
+                        "addresses host[:port] tried in order — ANY "
+                        "seed admits the worker, mirroring the "
+                        "reference's seed-node list "
+                        "(application.conf:14-16); entries without a "
+                        "port use --master-port")
     p.add_argument("--master-port", type=int, default=2551)
+    p.add_argument("--rejoin-timeout", type=float, default=0.0,
+                   help="> 0: treat a master disconnect as a possible "
+                        "restart instead of shutdown — cold-reset and "
+                        "redial through the seed list for up to this "
+                        "many seconds (Python engine only)")
     p.add_argument("--data-size", type=int, default=None,
                    help="synthetic source length, default 10 (must match "
                         "the master's; ignored with --native, which "
@@ -310,10 +336,28 @@ def _add_worker(sub: argparse._SubParsersAction) -> None:
     _add_liveness_flags(p)
 
 
+def _parse_seeds(master_host: str, master_port: int) -> list:
+    """``host[:port],host2[:port2],...`` -> [(host, port), ...]."""
+    seeds = []
+    for entry in master_host.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" in entry:
+            host, _, port_s = entry.rpartition(":")
+            seeds.append((host, int(port_s)))
+        else:
+            seeds.append((entry, master_port))
+    if not seeds:
+        raise SystemExit("--master-host: no seed addresses given")
+    return seeds
+
+
 def _cmd_worker(args: argparse.Namespace) -> int:
     from akka_allreduce_tpu.protocol.remote import (run_worker,
                                                     run_worker_native)
 
+    seeds = _parse_seeds(args.master_host, args.master_port)
     if args.native:
         if args.trace_file:
             print("warning: --trace-file is a Python-engine feature; "
@@ -327,16 +371,45 @@ def _cmd_worker(args: argparse.Namespace) -> int:
             print("note: --native derives the data geometry from the "
                   "master's InitWorkers; --data-size is ignored",
                   file=sys.stderr)
+        if args.rejoin_timeout > 0:
+            print("warning: --rejoin-timeout is a Python-engine "
+                  "feature; the native worker treats master disconnect "
+                  "as shutdown", file=sys.stderr)
+        # multi-seed JOIN for the native engine: pick a live seed with a
+        # cheap socket probe, then hand the C++ engine the REMAINING
+        # budget intact — its timeout_s covers the whole run, not just
+        # the join, so splitting the budget across seeds would truncate
+        # a successfully-joined session (mid-run failover stays
+        # Python-only)
+        import socket
+        import time as _time
+
+        deadline = _time.monotonic() + args.timeout
+        live = None
+        while live is None and _time.monotonic() < deadline:
+            for host, port in seeds:
+                try:
+                    socket.create_connection((host, port),
+                                             timeout=2.0).close()
+                    live = (host, port)
+                    break
+                except OSError:
+                    continue
+            else:
+                _time.sleep(0.2)
+        if live is None:
+            print(f"error: no master reachable among {seeds}",
+                  file=sys.stderr)
+            return 1
         outputs = run_worker_native(
-            master_host=args.master_host, master_port=args.master_port,
+            master_host=live[0], master_port=live[1],
             checkpoint=args.checkpoint,
             assert_multiple=args.assert_multiple,
-            timeout_s=args.timeout, verbose=args.verbose,
+            timeout_s=max(1.0, deadline - _time.monotonic()),
+            verbose=args.verbose,
             heartbeat_interval_s=args.heartbeat_interval)
     else:
-        outputs = run_worker(master_host=args.master_host,
-                             master_port=args.master_port,
-                             source_data_size=(10 if args.data_size is None
+        outputs = run_worker(source_data_size=(10 if args.data_size is None
                                                else args.data_size),
                              checkpoint=args.checkpoint,
                              assert_multiple=args.assert_multiple,
@@ -344,8 +417,50 @@ def _cmd_worker(args: argparse.Namespace) -> int:
                              heartbeat_interval_s=args.heartbeat_interval,
                              unreachable_after_s=args.unreachable_after
                              or None,
-                             trace_file=args.trace_file)
+                             trace_file=args.trace_file,
+                             seeds=seeds,
+                             rejoin_timeout_s=args.rejoin_timeout)
     return 0 if outputs > 0 else 1
+
+
+def _coordinated_survivor_exit(dcn, nprocs: int) -> None:
+    """os._exit(0) without the coordination-service shutdown barrier —
+    COORDINATED, because process 0 hosts the service: if it exited
+    first, a surviving worker's error-poller thread would see the
+    connection reset and FATAL the process mid-teardown. Each survivor
+    announces its exit through the (still-alive) KV store and leaves
+    immediately; process 0 waits for every non-downed peer's
+    announcement (bounded) before taking the service down with it."""
+    import jax
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    me = jax.process_index()
+    if client is not None:
+        try:
+            client.key_value_set(f"aat/exit/{me}", "1",
+                                 allow_overwrite=True)
+        except Exception:
+            pass
+        if me == 0:
+            waiting = [r for r in range(1, nprocs)
+                       if r not in dcn.downed_peers]
+            give_up = time.monotonic() + 10.0
+            while waiting and time.monotonic() < give_up:
+                still = []
+                for r in waiting:
+                    try:
+                        if client.key_value_try_get(f"aat/exit/{r}") \
+                                is None:
+                            still.append(r)
+                    except Exception:
+                        still.append(r)
+                waiting = still
+                if waiting:
+                    time.sleep(0.1)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
 
 
 def _add_train(sub: argparse._SubParsersAction) -> None:
@@ -816,8 +931,17 @@ def _cmd_train(args: argparse.Namespace) -> int:
     if args.coordinator:
         from akka_allreduce_tpu.runtime.coordinator import \
             initialize_distributed
+        # elastic hybrid runs (--deadline-ms + --down-after) survive
+        # member death by DESIGN; the coordination service's 100 s
+        # gang-failure detector would undo that mid-run, so it is
+        # effectively disabled and the trainer's deadline masks +
+        # auto-down + --master-timeout-s watch carry liveness instead
+        hb = None
+        if args.deadline_ms > 0 and args.down_after > 0:
+            hb = 24 * 3600
         initialize_distributed(args.coordinator, args.num_processes,
-                               args.process_id)
+                               args.process_id,
+                               heartbeat_timeout_s=hb)
     # --coordinator + --deadline-ms = the hybrid topology: exact device
     # collectives on each process's LOCAL mesh, deadline-gated masked
     # sync ACROSS processes over DCN (runtime/dcn_train.py) — straggler
@@ -1252,6 +1376,26 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     print(f"served rejoin snapshot at step {final} "
                           f"(final)")
             dcn.close()
+            # Survivor exit: if the FINAL round still had masked
+            # processes, some peer is dead/stalled and the coordination
+            # service's Shutdown barrier (run in backend teardown) is
+            # doomed — it would fail against the absent task and the
+            # error poller would FATAL this process after it already
+            # finished all its work. The mask is replicated consensus
+            # state, so every survivor takes this same branch and none
+            # is left waiting on a barrier peers skipped. A chronically
+            # slow-but-alive straggler then fails its own barrier and
+            # exits nonzero, which is honest: it did not finish.
+            if dcn.reports and dcn.reports[-1].n_masked > 0:
+                if mgr is not None:
+                    mgr.wait_until_finished()
+                if chatty:
+                    print("note: skipping the coordination-service "
+                          "shutdown barrier — "
+                          f"{dcn.reports[-1].n_masked} process(es) "
+                          "still masked at the final round would fail "
+                          "it (survivor exit after member death)")
+                _coordinated_survivor_exit(dcn, nprocs)
             return 0
         loop_start = start
         if args.steps_per_dispatch > 1:

@@ -83,12 +83,28 @@ def run_worker(master_host: str = "127.0.0.1", master_port: int = 2551,
                port: int = 0, timeout_s: float = 120.0,
                verbose: bool = False, heartbeat_interval_s: float = 2.0,
                unreachable_after_s: Optional[float] = 10.0,
-               trace_file: Optional[str] = None) -> int:
-    """Join the master, run the worker engine until the master disconnects
-    (shutdown) or timeout. Returns outputs flushed to the sink."""
+               trace_file: Optional[str] = None,
+               seeds: Optional[list] = None,
+               rejoin_timeout_s: float = 0.0) -> int:
+    """Join a master, run the worker engine until the master disconnects
+    (shutdown) or timeout. Returns outputs flushed to the sink.
+
+    ``seeds`` — list of ``(host, port)`` master addresses, tried in
+    order (the reference's seed-node list: ANY seed admits a joiner,
+    application.conf:14-16). Defaults to the single
+    ``(master_host, master_port)``.
+
+    ``rejoin_timeout_s > 0`` changes master-disconnect semantics from
+    "cluster shutdown" to "master may have restarted": the worker
+    resets its engine to the cold state and redials through the seed
+    list for up to that long before giving up — so a master restarted
+    on a DIFFERENT seed address picks its workers back up. The restart
+    is a new master epoch (fresh seats, rounds from 0), exactly like an
+    Akka cluster reformed through its remaining seeds."""
     sink = ThroughputSink(source_data_size, checkpoint=checkpoint,
                           assert_multiple=assert_multiple, verbose=verbose)
-    alive = {"up": True}
+    seeds = [tuple(s) for s in (seeds or [(master_host, master_port)])]
+    state = {"up": True, "master": None}
     with tracer_to_file(trace_file) as tracer, \
          TcpRouter(bind_host=bind_host, port=port, role="worker",
                     heartbeat_interval_s=heartbeat_interval_s,
@@ -96,26 +112,60 @@ def run_worker(master_host: str = "127.0.0.1", master_port: int = 2551,
                     tracer=tracer) as router:
         worker = AllreduceWorker(router, constant_range_source(
             source_data_size), sink, tracer=tracer)
-        # Join-retry: the master may not be listening yet (workers and
-        # master start concurrently, like Akka seed-node join retries).
-        join_deadline = time.monotonic() + timeout_s
-        while True:
-            try:
-                master_ref = router.dial((master_host, master_port))
-                break
-            except ConnectionError:
-                if time.monotonic() >= join_deadline:
-                    raise
-                time.sleep(0.2)
+
+        def dial_any(window_s):
+            # Join-retry: the master may not be listening yet (workers
+            # and master start concurrently, like Akka seed-node join
+            # retries) — cycle the seed list until one admits us.
+            # Polling between attempts keeps the router draining: on the
+            # REJOIN path (worker.discard_blocks set) that is what
+            # actually discards stale old-epoch blocks — frames left to
+            # queue up here would only be delivered after the flag is
+            # cleared, re-queued, and replayed into the new epoch.
+            give_up = time.monotonic() + window_s
+            while True:
+                for addr in seeds:
+                    try:
+                        return router.dial(addr)
+                    except ConnectionError:
+                        continue
+                if time.monotonic() >= give_up:
+                    raise ConnectionError(
+                        f"no master reachable among seeds {seeds}")
+                router.poll(0.2)
+
+        state["master"] = dial_any(timeout_s)
 
         def on_terminated(ref):
             worker.terminated(ref)
-            if ref is master_ref:
-                alive["up"] = False
+            if ref is state["master"]:
+                state["master"] = None
+                if rejoin_timeout_s <= 0:
+                    state["up"] = False
 
         router.on_terminated = on_terminated
         deadline = time.monotonic() + timeout_s
-        while alive["up"] and time.monotonic() < deadline:
+        while state["up"] and time.monotonic() < deadline:
+            if state["master"] is None:
+                # master epoch ended: cold-reset and rejoin through the
+                # seeds (a restarted master reforms the cluster); old-
+                # epoch self-sends must not replay into the new one
+                worker.reset()
+                router.purge_local()
+                try:
+                    state["master"] = dial_any(
+                        min(rejoin_timeout_s,
+                            max(0.1, deadline - time.monotonic())))
+                    # joined the new epoch: block traffic from here on
+                    # is legitimately new (or a pre-init race to
+                    # re-queue); see AllreduceWorker.reset()
+                    worker.discard_blocks = False
+                    if verbose:
+                        print(f"worker: rejoined master at "
+                              f"{state['master'].addr}", flush=True)
+                except ConnectionError:
+                    state["up"] = False
+                    continue
             router.poll(0.05)
     if verbose:
         print(f"worker {worker.id}: {sink.outputs_seen} outputs")
@@ -159,26 +209,38 @@ def run_master_native(config: AllreduceConfig,
                       bind_host: str = "127.0.0.1", port: int = 2551,
                       timeout_s: float = 120.0,
                       heartbeat_interval_s: float = 2.0,
-                      unreachable_after_s: Optional[float] = 10.0) -> int:
+                      unreachable_after_s: Optional[float] = 10.0,
+                      with_round_times: bool = False):
     """The C++ master engine (native/src/remote_master.cpp): membership,
     rank seats (with reuse on rejoin), InitWorkers, thAllreduce round
     pacing, and a fixed-window silent-peer detector — same wire as
     :func:`run_master`, so Python and native workers join it
-    interchangeably. Returns rounds completed."""
+    interchangeably. Returns rounds completed, or ``(rounds, stamps)``
+    with per-round monotonic completion stamps when
+    ``with_round_times`` (the canonical-wire benchmark's spread
+    methodology, same contract as run_native_cluster's)."""
+    import ctypes
+
     from akka_allreduce_tpu.native import load_library
 
     lib = load_library()
-    rounds = lib.aat_remote_master_run(
+    cap = int(config.data.max_round)
+    stamps = (ctypes.c_double * max(cap, 1))()
+    rounds = lib.aat_remote_master_run_timed(
         bind_host.encode(), port, config.workers.total_size,
         config.data.data_size, config.data.max_chunk_size,
         config.workers.max_lag, config.thresholds.th_reduce,
         config.thresholds.th_complete, config.thresholds.th_allreduce,
         config.data.max_round, timeout_s, heartbeat_interval_s,
-        0.0 if unreachable_after_s is None else unreachable_after_s, 0)
+        0.0 if unreachable_after_s is None else unreachable_after_s, 0,
+        stamps if with_round_times else None,
+        cap if with_round_times else 0)
     if rounds == -3:
         raise OSError(f"native master: cannot bind {bind_host}:{port}")
     if rounds < 0:
         raise ValueError(f"native master: bad configuration ({rounds})")
+    if with_round_times:
+        return int(rounds), list(stamps[:max(int(rounds), 0)])
     return int(rounds)
 
 

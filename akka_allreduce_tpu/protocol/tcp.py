@@ -195,6 +195,14 @@ class TcpRouter:
             raise ConnectionError(f"cannot reach {addr}")
         return self.ref_of(tuple(addr))
 
+    def purge_local(self) -> int:
+        """Drop every queued local self-send. The multi-seed rejoin path
+        calls this at engine reset: re-queued blocks from the old master
+        epoch must not replay into the new one."""
+        n = len(self._local_mail)
+        self._local_mail.clear()
+        return n
+
     # -- event pump ----------------------------------------------------------
 
     def poll(self, timeout_s: float = 0.0) -> int:

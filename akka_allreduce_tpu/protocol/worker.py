@@ -65,7 +65,33 @@ class AllreduceWorker:
         self.strict = strict
         self.tracer = tracer
         self.ref = router.register(name or "worker", handler=self.receive)
+        self.generation = -1  # reset() below brings the cold start to 0
+        self.reset()
+        # Cold start: re-queue pre-init block races (a peer inited first
+        # may scatter before our InitWorkers lands). Only the multi-seed
+        # REJOIN path flips this to drop — see reset().
+        self.discard_blocks = False
 
+    def reset(self) -> None:
+        """Return to the cold pre-init state (rank unassigned, buffers
+        empty). The multi-seed rejoin path uses this to enter a NEW
+        master epoch: a restarted master paces from round 0 with fresh
+        seat assignment, so retained round/rank state would deadlock the
+        worker against it (the reference's seed-list join admits a
+        worker to whatever cluster incarnation is alive,
+        application.conf:14-16).
+
+        Until the rejoin dial succeeds, inbound Scatter/Reduce blocks
+        are DROPPED, not re-queued: they are old-epoch leftovers (a
+        peer cannot send new-epoch traffic before THIS worker joins —
+        the new master only inits workers at full quorum, which needs
+        our Hello), and re-queueing them would poison the new epoch's
+        buffers with old-round chunks. The caller must clear
+        ``discard_blocks`` once its redial succeeds; blocks that slip
+        through between the redial and the new InitWorkers are fenced
+        by round plausibility instead (:meth:`_stale_epoch_round`)."""
+        self.discard_blocks = True
+        self.generation += 1
         # Protocol state (reference: AllreduceWorker.scala:10-31)
         self.id = -1
         self.master: Optional[ActorRef] = None
@@ -101,14 +127,22 @@ class AllreduceWorker:
                 self._handle_start(msg)
             elif isinstance(msg, ScatterBlock):
                 if self.id == -1:
-                    log.warning("worker not initialized; re-queueing scatter")
-                    self.router.send(self.ref, msg)
+                    if self.discard_blocks:
+                        log.info("dropping stale pre-rejoin scatter")
+                    else:
+                        log.warning(
+                            "worker not initialized; re-queueing scatter")
+                        self.router.send(self.ref, msg)
                 else:
                     self.handle_scatter_block(msg)
             elif isinstance(msg, ReduceBlock):
                 if self.id == -1:
-                    log.warning("worker not initialized; re-queueing reduce")
-                    self.router.send(self.ref, msg)
+                    if self.discard_blocks:
+                        log.info("dropping stale pre-rejoin reduce")
+                    else:
+                        log.warning(
+                            "worker not initialized; re-queueing reduce")
+                        self.router.send(self.ref, msg)
                 else:
                     self.handle_reduce_block(msg)
             else:
@@ -125,6 +159,26 @@ class AllreduceWorker:
         for idx, peer in list(self.peers.items()):
             if peer is ref:
                 del self.peers[idx]
+
+    def _stale_epoch_round(self, block_round: int) -> bool:
+        """Epoch fence for the block-implied round jump. A block whose
+        round exceeds the newest Start by more than the in-flight window
+        cannot belong to the current master epoch — within one epoch a
+        peer runs at most ``max_lag`` rounds ahead of the pacing we will
+        also receive. After a multi-seed rejoin (``generation > 0``)
+        such a block is an old-epoch leftover that slipped past the
+        discard window: self-starting its round (the cold-start
+        catch-up path below) would jump this worker decades ahead of
+        the restarted master and stall the cluster. Never fences the
+        cold-start generation — its catch-up jumps are the reference's
+        own semantics (AllreduceWorker.scala:183-184)."""
+        if self.generation > 0 \
+                and block_round > self.max_round + self.max_lag + 1:
+            log.info("worker %d: dropping old-epoch block round %d "
+                     "(newest start %d, lag %d)", self.id, block_round,
+                     self.max_round, self.max_lag)
+            return True
+        return False
 
     # -- init ---------------------------------------------------------------
 
@@ -231,6 +285,8 @@ class AllreduceWorker:
         else:
             # A round we haven't been started for: requeue behind a
             # self-sent start (reference: AllreduceWorker.scala:183-184).
+            if self._stale_epoch_round(s.round):
+                return
             self.router.send(self.ref, StartAllreduce(s.round))
             self.router.send(self.ref, s)
 
@@ -279,6 +335,8 @@ class AllreduceWorker:
             if self.reduce_block_buf.reach_completion_threshold(row):
                 self._complete(r.round, row)
         else:
+            if self._stale_epoch_round(r.round):
+                return
             self.router.send(self.ref, StartAllreduce(r.round))
             self.router.send(self.ref, r)
 

@@ -44,22 +44,38 @@ class TopologySummary:
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> None:
+                           process_id: Optional[int] = None,
+                           heartbeat_timeout_s: Optional[int] = None
+                           ) -> None:
     """Join the multi-host coordination service (the master's quorum step).
 
     No-ops when single-process and no coordinator is configured. On TPU pods
     the three arguments are discoverable from the environment and may be
     omitted (jax.distributed reads the TPU metadata); explicit values
     support CPU/GPU fleets and tests.
+
+    ``heartbeat_timeout_s`` overrides the service's own failure detector
+    window (jax default 100 s). ELASTIC runs (the hybrid's
+    ``--down-after``) must raise it to run length: the service gang-fails
+    every task when one stops heartbeating — the exact opposite of
+    surviving member death — while the trainer's deadline masks +
+    auto-down are the failure detector by design. A dead MASTER still
+    fails workers fast regardless: it hosts the service, so worker RPCs
+    fail on connection, and the trainer's own --master-timeout-s
+    heartbeat watch covers a wedged master.
     """
     explicit = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
     if explicit is None and num_processes is None:
         log.debug("single-process run; skipping jax.distributed.initialize")
         return
+    kw = {}
+    if heartbeat_timeout_s is not None:
+        kw["heartbeat_timeout_seconds"] = int(heartbeat_timeout_s)
     jax.distributed.initialize(
         coordinator_address=explicit,
         num_processes=num_processes,
         process_id=process_id,
+        **kw,
     )
 
 

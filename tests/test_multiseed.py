@@ -1,0 +1,113 @@
+"""Multi-seed join redundancy (round-4 verdict #7, reference missing #2).
+
+The reference's workers join through a LIST of seed nodes — any seed
+admits a joiner (reference: application.conf:14-16) — so a master
+restarted on a different address does not strand the fleet. Here:
+``run_worker(seeds=[...], rejoin_timeout_s>0)`` cycles the seed list on
+join AND on master disconnect (cold-reset + redial = joining the new
+master epoch).
+"""
+
+import threading
+import time
+
+import pytest
+
+from akka_allreduce_tpu.config import (
+    AllreduceConfig,
+    DataConfig,
+    ThresholdConfig,
+    WorkerConfig,
+)
+from akka_allreduce_tpu.protocol.remote import (
+    free_port,
+    run_master,
+    run_worker,
+)
+
+
+def _config(max_round):
+    return AllreduceConfig(
+        thresholds=ThresholdConfig(1.0, 1.0, 1.0),
+        data=DataConfig(data_size=24, max_chunk_size=4,
+                        max_round=max_round),
+        workers=WorkerConfig(total_size=2, max_lag=1))
+
+
+@pytest.mark.slow
+class TestMultiSeedJoin:
+    def test_workers_survive_master_restart_on_second_seed(self):
+        """Epoch 1: master on seed A completes 4 rounds and exits.
+        Workers (seeded with [A, B], rejoin window on) cold-reset and
+        redial; epoch 2's master binds seed B and reforms the cluster;
+        every worker flushes outputs in BOTH epochs with the exactness
+        assert (output == 2 x input) intact throughout."""
+        port_a, port_b = free_port(), free_port()
+        seeds = [("127.0.0.1", port_a), ("127.0.0.1", port_b)]
+        rounds_each = 4
+        results = {}
+
+        def worker(idx):
+            results[idx] = run_worker(
+                source_data_size=24, checkpoint=2, assert_multiple=2,
+                timeout_s=90, seeds=seeds, rejoin_timeout_s=12,
+                heartbeat_interval_s=0.5)
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+
+        got_a = run_master(_config(rounds_each), port=port_a,
+                           timeout_s=60, verbose=False,
+                           heartbeat_interval_s=0.5)
+        assert got_a == rounds_each
+        # the gap: workers are now cycling the seed list (A is dead)
+        time.sleep(0.5)
+        got_b = run_master(_config(rounds_each), port=port_b,
+                           timeout_s=60, verbose=False,
+                           heartbeat_interval_s=0.5)
+        assert got_b == rounds_each
+
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "worker thread hung"
+        # a single epoch flushes at most rounds+1 outputs; more than
+        # that proves the worker produced verified outputs in BOTH
+        # epochs, i.e. it genuinely rejoined through the second seed
+        for idx, outputs in results.items():
+            assert outputs > rounds_each + 1, (
+                f"worker {idx}: {outputs} outputs — no post-restart "
+                f"progress")
+
+    def test_single_seed_disconnect_still_means_shutdown(self):
+        """Default semantics unchanged: without a rejoin window, master
+        disconnect ends the worker (the reference's observed behavior —
+        clusters are stopped by killing the master)."""
+        port = free_port()
+        results = {}
+
+        def worker():
+            t0 = time.monotonic()
+            results["outputs"] = run_worker(
+                source_data_size=24, checkpoint=2, assert_multiple=2,
+                timeout_s=60, seeds=[("127.0.0.1", port)],
+                heartbeat_interval_s=0.5)
+            results["dt"] = time.monotonic() - t0
+
+        threads = [threading.Thread(target=worker, daemon=True)]
+        other = threading.Thread(
+            target=lambda: run_worker(
+                source_data_size=24, checkpoint=2, assert_multiple=2,
+                timeout_s=60, seeds=[("127.0.0.1", port)],
+                heartbeat_interval_s=0.5), daemon=True)
+        threads.append(other)
+        for t in threads:
+            t.start()
+        got = run_master(_config(3), port=port, timeout_s=60,
+                         verbose=False, heartbeat_interval_s=0.5)
+        assert got == 3
+        threads[0].join(timeout=30)
+        assert not threads[0].is_alive()
+        assert results["outputs"] > 0
+        assert results["dt"] < 45  # exited on disconnect, not timeout

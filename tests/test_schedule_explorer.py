@@ -259,6 +259,33 @@ class TestEmulateFuzzCli:
         assert rc == 2
         assert "--fuzz" in capsys.readouterr().err
 
+    def test_fuzz_kill_rank_passes_with_reachable_thresholds(
+            self, monkeypatch, capsys):
+        """The kill-rank fuzz path end to end (round-4 advisor: it had
+        zero CLI coverage): 4 workers, rank 3 dead, thresholds
+        satisfiable by the 3 survivors — schedules must all validate."""
+        rc = self._run(monkeypatch, [
+            "emulate", "--fuzz", "6", "--workers", "4",
+            "--data-size", "8", "--max-chunk-size", "2",
+            "--kill-rank", "3", "--max-round", "3",
+            "--th-allreduce", "0.6", "--th-reduce", "0.6",
+            "--th-complete", "0.6"])
+        assert rc == 0
+        assert "0 violations" in capsys.readouterr().out
+
+    def test_fuzz_kill_rank_rejects_unreachable_threshold(
+            self, monkeypatch, capsys):
+        """ceil(0.9 * 4) = 4 > 3 survivors: a config impossibility must
+        be rejected at the flag layer, not reported as a race (round-4
+        advisor)."""
+        rc = self._run(monkeypatch, [
+            "emulate", "--fuzz", "5", "--workers", "4",
+            "--kill-rank", "3", "--th-allreduce", "0.9",
+            "--th-reduce", "0.6", "--th-complete", "0.6"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "--th-allreduce" in err and "ceil" in err
+
 
 class TestScheduleMachinery:
     def test_random_schedule_is_deterministic_in_seed(self):
