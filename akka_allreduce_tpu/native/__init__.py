@@ -113,5 +113,10 @@ def load_library() -> ctypes.CDLL:
         ctypes.c_double, ctypes.c_int64, ctypes.c_double,
         ctypes.c_double, ctypes.c_double, ctypes.c_int]
 
+    lib.aat_remote_master_run_timed.restype = ctypes.c_long
+    lib.aat_remote_master_run_timed.argtypes = \
+        lib.aat_remote_master_run.argtypes + [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long]
+
     _lib = lib
     return lib

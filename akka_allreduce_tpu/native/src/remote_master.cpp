@@ -83,6 +83,7 @@ struct RemoteMaster {
     int num_complete = 0;
     long rounds_completed = 0;
     double last_ping = 0.0;
+    std::vector<double> round_at;  // monotonic stamp per completed round
 
     void send_rank(int rank, const std::vector<uint8_t>& f) {
         auto it = conn_of_rank.find(rank);
@@ -187,6 +188,7 @@ struct RemoteMaster {
         if ((double)num_complete >= cfg.worker_num * th_allreduce &&
             round < max_round) {
             rounds_completed += 1;
+            round_at.push_back(now_s());
             round += 1;
             start_allreduce();
         }
@@ -314,14 +316,19 @@ extern "C" {
 
 // Serve membership + round pacing natively until max_round rounds
 // complete (or timeout); returns rounds completed, or -3 when the
-// listen socket could not bind.
-long aat_remote_master_run(const char* bind_host, int port,
-                           unsigned total_workers, uint64_t data_size,
-                           uint64_t max_chunk_size, unsigned max_lag,
-                           double th_reduce, double th_complete,
-                           double th_allreduce, int64_t max_round,
-                           double timeout_s, double hb_interval_s,
-                           double unreachable_after_s, int verbose) {
+// listen socket could not bind. round_times (may be null, cap entries)
+// receives per-round MONOTONIC completion stamps — the per-round
+// spread the canonical-scale WIRE benchmarks quote (same contract as
+// aat_cluster_run_timed in cluster.cpp).
+long aat_remote_master_run_timed(const char* bind_host, int port,
+                                 unsigned total_workers,
+                                 uint64_t data_size,
+                                 uint64_t max_chunk_size, unsigned max_lag,
+                                 double th_reduce, double th_complete,
+                                 double th_allreduce, int64_t max_round,
+                                 double timeout_s, double hb_interval_s,
+                                 double unreachable_after_s, int verbose,
+                                 double* round_times, long cap) {
     if (total_workers == 0 || max_round < 0 || timeout_s <= 0) return -2;
     RemoteMaster m;
     m.cfg.worker_num = total_workers;
@@ -335,7 +342,26 @@ long aat_remote_master_run(const char* bind_host, int port,
     m.hb_interval = hb_interval_s > 0 ? hb_interval_s : 2.0;
     m.unreachable_after = unreachable_after_s;
     m.verbose = verbose;
-    return m.run(bind_host, port, timeout_s);
+    long rounds = m.run(bind_host, port, timeout_s);
+    if (round_times && rounds > 0) {
+        long k = std::min(cap, (long)m.round_at.size());
+        for (long i = 0; i < k; ++i) round_times[i] = m.round_at[i];
+    }
+    return rounds;
+}
+
+long aat_remote_master_run(const char* bind_host, int port,
+                           unsigned total_workers, uint64_t data_size,
+                           uint64_t max_chunk_size, unsigned max_lag,
+                           double th_reduce, double th_complete,
+                           double th_allreduce, int64_t max_round,
+                           double timeout_s, double hb_interval_s,
+                           double unreachable_after_s, int verbose) {
+    return aat_remote_master_run_timed(
+        bind_host, port, total_workers, data_size, max_chunk_size,
+        max_lag, th_reduce, th_complete, th_allreduce, max_round,
+        timeout_s, hb_interval_s, unreachable_after_s, verbose,
+        nullptr, 0);
 }
 
 }  // extern "C"

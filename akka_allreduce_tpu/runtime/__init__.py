@@ -26,6 +26,7 @@ __all__ = [
     "shrink_spec",
     "reform_mesh",
     "reshard",
+    "HostResourceSampler",
 ]
 
 _SUBMODULE = {
@@ -43,6 +44,7 @@ _SUBMODULE = {
     "shrink_spec": "elastic",
     "reform_mesh": "elastic",
     "reshard": "elastic",
+    "HostResourceSampler": "metrics",
 }
 
 

@@ -1,0 +1,145 @@
+"""Host resource sampling: RSS + CPU% spans for canonical-scale runs.
+
+The reference wires Akka's ClusterMetricsExtension + Sigar to sample host
+CPU/memory (reference: application.conf:26-34, build.sbt:26) — unused by
+its application code, but the capability exists. This is the TPU
+framework's equivalent, built on /proc (no external deps): a background
+thread samples RSS and CPU utilisation for a set of processes (self and,
+for multi-process clusters, the worker children) and reports peaks/means.
+Samples optionally land in a :class:`~.tracing.Tracer` as
+``host_resources`` events, so a trace of a 40-50 GB canonical run carries
+its memory story alongside the protocol events.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, Sequence
+
+
+def _read_rss_kb(pid: int) -> Optional[int]:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
+def _read_hwm_kb(pid: int) -> Optional[int]:
+    """VmHWM — the kernel's own RSS high-water mark (catches spikes
+    between samples)."""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
+def _read_cpu_ticks(pid: int) -> Optional[int]:
+    """utime + stime (+ children on wait) from /proc/<pid>/stat."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        # fields after comm: state is parts[0]; utime/stime are
+        # canonical stat fields 14/15 -> offsets 11/12 here
+        return int(parts[11]) + int(parts[12])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+class HostResourceSampler:
+    """Background sampler over one or more PIDs.
+
+    ``summary()`` (also returned by ``stop()``):
+
+    * ``peak_rss_mb`` — max across samples of the SUMMED RSS, plus each
+      pid's kernel VmHWM folded in for self-only runs (spikes between
+      samples still count)
+    * ``mean_cpu_pct`` / ``max_cpu_pct`` — summed CPU utilisation across
+      the pids, in percent of one core
+    * ``samples`` — number of samples taken
+
+    Use as a context manager::
+
+        with HostResourceSampler(tracer=tracer) as sampler:
+            run()
+        print(sampler.summary()["peak_rss_mb"])
+    """
+
+    def __init__(self, pids: Optional[Sequence[int]] = None,
+                 interval_s: float = 1.0, tracer=None):
+        self.pids = list(pids) if pids else [os.getpid()]
+        self.interval_s = interval_s
+        self.tracer = tracer
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._peak_rss_kb = 0
+        self._cpu_pcts: list[float] = []
+        self._samples = 0
+        self._clk = os.sysconf("SC_CLK_TCK") or 100
+
+    def _sample_once(self, last_ticks, last_t):
+        now = time.monotonic()
+        rss = sum(filter(None, (_read_rss_kb(p) for p in self.pids)))
+        ticks = sum(filter(None, (_read_cpu_ticks(p) for p in self.pids)))
+        cpu_pct = None
+        if last_ticks is not None and now > last_t:
+            cpu_pct = (ticks - last_ticks) / self._clk / (now - last_t) * 100
+            self._cpu_pcts.append(cpu_pct)
+        self._peak_rss_kb = max(self._peak_rss_kb, rss)
+        self._samples += 1
+        if self.tracer is not None:
+            fields = {"rss_mb": round(rss / 1024, 1),
+                      "pids": len(self.pids)}
+            if cpu_pct is not None:
+                fields["cpu_pct"] = round(cpu_pct, 1)
+            self.tracer.record("host_resources", **fields)
+        return ticks, now
+
+    def _run(self):
+        ticks, t = self._sample_once(None, 0.0)
+        while not self._stop.wait(self.interval_s):
+            ticks, t = self._sample_once(ticks, t)
+
+    def start(self) -> "HostResourceSampler":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        # fold in the kernel's high-water mark (single-pid sums only:
+        # per-pid HWMs peak at different times, so summing them would
+        # overstate a multi-process peak)
+        if len(self.pids) == 1:
+            hwm = _read_hwm_kb(self.pids[0])
+            if hwm:
+                self._peak_rss_kb = max(self._peak_rss_kb, hwm)
+        return self.summary()
+
+    def summary(self) -> dict:
+        return {
+            "peak_rss_mb": round(self._peak_rss_kb / 1024, 1),
+            "mean_cpu_pct": round(
+                sum(self._cpu_pcts) / len(self._cpu_pcts), 1)
+            if self._cpu_pcts else None,
+            "max_cpu_pct": round(max(self._cpu_pcts), 1)
+            if self._cpu_pcts else None,
+            "samples": self._samples,
+        }
+
+    def __enter__(self) -> "HostResourceSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -51,6 +51,7 @@ def native_once(workers, data_size, max_chunk_size, max_lag, max_round,
                                            ThresholdConfig, WorkerConfig)
     from akka_allreduce_tpu.protocol.native_cluster import \
         run_native_cluster
+    from akka_allreduce_tpu.runtime.metrics import HostResourceSampler
 
     warm = AllreduceConfig(
         thresholds=ThresholdConfig(1.0, 1.0, 1.0),
@@ -64,14 +65,21 @@ def native_once(workers, data_size, max_chunk_size, max_lag, max_round,
                         max_round=max_round),
         workers=WorkerConfig(total_size=workers, max_lag=max_lag))
     t0 = time.perf_counter()
-    rounds, flushed, stamps = run_native_cluster(config,
-                                                 with_round_times=True)
+    with HostResourceSampler(interval_s=2.0) as sampler:
+        rounds, flushed, stamps = run_native_cluster(config,
+                                                     with_round_times=True)
     dt = time.perf_counter() - t0
+    res = sampler.summary()
     # per-round wall deltas over rounds 1..N-1 (stamp diffs exclude
     # round 0 AND the pre-round-0 buffer allocation by construction,
     # so every quoted delta — including the max — is steady state)
     deltas = [b - a for a, b in zip(stamps, stamps[1:])]
-    return rps_stats(rounds / dt, rounds, flushed, dt, deltas)
+    rps, rounds, flushed, dt, spread = rps_stats(rounds / dt, rounds,
+                                                 flushed, dt, deltas)
+    spread += (f"; peak RSS {res['peak_rss_mb'] / 1024:.1f} GB, mean CPU "
+               f"{res['mean_cpu_pct']}% (host sampler, "
+               f"{res['samples']} samples)")
+    return rps, rounds, flushed, dt, spread
 
 
 def rps_stats(rps, rounds, flushed, dt, deltas):

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Composed DCN-hybrid stress rates (round-4 verdict #4's PERF row).
+
+Runs the hybrid with EVERY knob on — deadline pacing, fraction gate
+(th_allreduce 0.75), auto-down, bucket-granular wire, bf16 gradient
+wire — as 3 OS processes over the coordination-service KV fabric on
+this box's virtual CPU devices, once clean and once with the built-in
+straggle simulator (--straggle-prob: real wall-clock late publishes).
+Emits rounds/s for both, so PERF.md can quote the price of straggling
+under the full composition (the reference's thresholds exist to pay
+that price gracefully: AllreduceMaster.scala:58).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+STEPS = 12
+
+
+def emit(metric, value, unit, note):
+    print(json.dumps({"metric": metric, "value": round(value, 3),
+                      "unit": unit, "note": note}), flush=True)
+
+
+def run_cluster(straggle_prob=0.0, nprocs=3, timeout_s=600):
+    from akka_allreduce_tpu.protocol.remote import free_port
+
+    port = free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    extra = []
+    if straggle_prob > 0:
+        extra = ["--straggle-prob", str(straggle_prob)]
+    procs = [subprocess.Popen(
+        [sys.executable, "-u", "-m", "akka_allreduce_tpu.cli", "train",
+         "--platform", "cpu",
+         "--coordinator", f"127.0.0.1:{port}",
+         "--num-processes", str(nprocs), "--process-id", str(i),
+         "--steps", str(STEPS), "--batch", str(2 * nprocs),
+         "--seq", "16", "--d-model", "32", "--n-heads", "4",
+         "--n-layers", "1", "--d-ff", "64", "--dp", "2",
+         "--deadline-ms", "900", "--th-allreduce", "0.75",
+         "--down-after", "3", "--dcn-bucket-elems", "16384",
+         "--bf16-grads", "--log-every", "1", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(nprocs)]
+    t0 = time.perf_counter()
+    outs, rcs = [], []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+        rcs.append(p.returncode)
+    dt = time.perf_counter() - t0
+    lossy = 0
+    m = re.search(r"lossy rounds: (\d+)/", outs[0])
+    if m:
+        lossy = int(m.group(1))
+    ok = all(rc == 0 for rc in rcs) and f"step   {STEPS}" in outs[0]
+    return STEPS / dt, lossy, ok, dt
+
+
+def main() -> int:
+    knobs = ("deadline-ms 900 + th-allreduce 0.75 + down-after 3 + "
+             "dcn-bucket-elems 16384 + bf16-grads")
+    rps, lossy, ok, dt = run_cluster(0.0)
+    emit("dcn_stress_composed_rounds_per_s", rps, "rounds/s",
+         f"3-process hybrid, ALL knobs composed ({knobs}); clean run: "
+         f"{STEPS} rounds in {dt:.1f}s, {lossy} lossy; "
+         f"{'OK' if ok else 'FAILED'}; wall clock includes process "
+         f"startup + compile (1-core box, virtual CPU devices — "
+         f"protocol pacing, not device speed)")
+    rps_s, lossy_s, ok_s, dt_s = run_cluster(0.4)
+    emit("dcn_stress_composed_straggled_rounds_per_s", rps_s, "rounds/s",
+         f"same composition + --straggle-prob 0.4 (real wall-clock late "
+         f"publishes): {STEPS} rounds in {dt_s:.1f}s, {lossy_s} lossy "
+         f"rounds absorbed by the fraction gate; "
+         f"{'OK' if ok_s else 'FAILED'}")
+    return 0 if ok and ok_s else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

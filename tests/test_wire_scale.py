@@ -1,0 +1,64 @@
+"""Canonical-scale payloads across the real cross-process wire.
+
+Round-4 verdict #3: the all-native cluster (C++ engines + framed TCP
+transport, OS process per worker — the deployment shape of the
+reference's netty remoting, reference: application.conf:5-11) had only
+ever carried 778 floats. This pins a >=1M-element payload crossing real
+process boundaries with the sink's exactness contract intact: every
+worker asserts ``output == N x input`` (ThroughputSink semantics,
+reference: AllreduceWorker.scala:329-343) and exits nonzero otherwise.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_megascale_payload_crosses_real_wire():
+    """4 OS worker processes x 1,048,576 f32 (4 MiB payload/round), all
+    engines C++, loopback TCP: rounds complete and every worker's sink
+    asserts output == 4 x input at checkpoint cadence."""
+    from akka_allreduce_tpu.config import (AllreduceConfig, DataConfig,
+                                           ThresholdConfig, WorkerConfig)
+    from akka_allreduce_tpu.native import build_library
+    from akka_allreduce_tpu.protocol.remote import (free_port,
+                                                    run_master_native)
+
+    build_library()  # before the workers race to build it
+    port = free_port()
+    workers, elems, rounds = 4, 1_048_576, 6
+    config = AllreduceConfig(
+        thresholds=ThresholdConfig(1.0, 1.0, 1.0),
+        data=DataConfig(data_size=elems, max_chunk_size=16_384,
+                        max_round=rounds),
+        workers=WorkerConfig(total_size=workers, max_lag=1))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "import sys\n"
+        "from akka_allreduce_tpu.protocol.remote import "
+        "run_worker_native\n"
+        f"n = run_worker_native(master_port={port}, checkpoint=2, "
+        f"assert_multiple={workers}, timeout_s=240)\n"
+        "sys.exit(0 if n > 0 else 4)\n")
+    procs = [subprocess.Popen([sys.executable, "-c", code], env=env,
+                              cwd=ROOT) for _ in range(workers)]
+    try:
+        got, stamps = run_master_native(config, port=port, timeout_s=240,
+                                        with_round_times=True)
+        rcs = [p.wait(timeout=90) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert got == rounds, f"master completed {got}/{rounds} rounds"
+    assert len(stamps) == rounds and all(
+        b >= a for a, b in zip(stamps, stamps[1:]))
+    # exit 0 == the C++ sink verified output == 4 x input every
+    # checkpoint AND flushed outputs; 4 == ran but flushed nothing
+    assert rcs == [0] * workers, f"worker exit codes {rcs}"
