@@ -15,11 +15,13 @@ BASELINE-shaped payloads on it:
 
 Methodology matches bench_canonical.py: per-round spread from the
 master engine's own monotonic round stamps (median / IQR over steady
-rounds), plus the mean rate. Every worker asserts output == N x input
-each checkpoint (ThroughputSink contract, reference:
-AllreduceWorker.scala:329-343), so a quoted rate is also a correctness
-proof at scale. Single machine, 1 core, loopback TCP — the numbers
-bound protocol+transport cost, not network bandwidth.
+rounds), plus the mean rate. The sink's exactness contract
+(output == N x input, reference: AllreduceWorker.scala:329-343) is
+pinned by tests/test_wire_scale.py at 1M elements — mathematically the
+largest regime where f32 keeps every partial sum integer-exact; at
+these payload sizes the assert is off by necessity (see wire_run).
+Single machine, 1 core, loopback TCP — the numbers bound
+protocol+transport cost, not network bandwidth.
 """
 
 import json
@@ -40,11 +42,18 @@ def emit(metric, value, unit, note):
 
 
 def wire_run(workers, data_size, max_chunk_size, max_lag, max_round,
-             timeout_s=900.0, checkpoint=4):
+             timeout_s=900.0, checkpoint=4, assert_multiple=0):
     """One cross-process all-native run. Spawns ``workers`` OS worker
-    processes (C++ engine, asserting output == N x input), runs the C++
-    master in this process with round stamps, and returns
-    (rounds, stamps, worker_rcs, dt)."""
+    processes (C++ engine), runs the C++ master in this process with
+    round stamps, and returns (rounds, stamps, worker_rcs, dt, rss).
+
+    ``assert_multiple`` is 0 at these payload sizes BY NECESSITY, not
+    laxness: the arange source's values exceed f32's 2^24 integer-exact
+    range (25M elems) and the partial sums do at 16 MiB too, so
+    elementwise ``output == N x input`` equality is mathematically
+    unavailable — the sink correctly fails it. The exactness contract is
+    pinned by tests/test_wire_scale.py at 1M elements, where every
+    partial sum stays integer-exact in f32."""
     from akka_allreduce_tpu.config import (AllreduceConfig, DataConfig,
                                            ThresholdConfig, WorkerConfig)
     from akka_allreduce_tpu.native import build_library
@@ -64,7 +73,7 @@ def wire_run(workers, data_size, max_chunk_size, max_lag, max_round,
         "import sys\n"
         "from akka_allreduce_tpu.protocol.remote import run_worker_native\n"
         f"n = run_worker_native(master_port={port}, "
-        f"checkpoint={checkpoint}, assert_multiple={workers}, "
+        f"checkpoint={checkpoint}, assert_multiple={assert_multiple}, "
         f"timeout_s={timeout_s})\n"
         "sys.exit(0 if n > 0 else 4)\n")
     procs = [subprocess.Popen([sys.executable, "-c", worker_code],
@@ -76,8 +85,13 @@ def wire_run(workers, data_size, max_chunk_size, max_lag, max_round,
     with HostResourceSampler(
             pids=[os.getpid()] + [p.pid for p in procs],
             interval_s=2.0) as sampler:
+        # liveness window scaled to the box: 9 CPU-bound processes on 1
+        # core legitimately starve a worker of scheduling for >10 s at
+        # 100 MB payloads — the default detector would down healthy
+        # workers mid-benchmark
         rounds, stamps = run_master_native(config, port=port,
                                            timeout_s=timeout_s,
+                                           unreachable_after_s=300.0,
                                            with_round_times=True)
     dt = time.perf_counter() - t0
     rcs = []
@@ -121,8 +135,10 @@ def config3_wire(rounds=10):
          f"workers scaled 64->8 for one box): 8 worker processes x 25M "
          f"f32 (100 MB payload/round) over the framed TCP transport on "
          f"loopback, maxChunkSize 65536, maxLag=1; {got}/{rounds} "
-         f"rounds in {dt:.1f}s; {spread(stamps)}; every worker asserted "
-         f"output == 8 x input (exit codes {rcs}); {_rss_note(res)}; "
+         f"rounds in {dt:.1f}s; {spread(stamps)}; worker exit codes {rcs} "
+         f"(exactness pinned separately at 1M elems, tests/"
+         f"test_wire_scale.py — arange exceeds f32 integer-exact range "
+         f"at 25M); {_rss_note(res)}; "
          f"{'OK' if ok else 'FAILED'}; 1-core box")
     return ok
 
@@ -139,8 +155,9 @@ def config5_wire(rounds=16):
          f"8 worker processes x {elems} f32 (16 MiB BERT-large bucket/"
          f"round) over loopback TCP, maxLag=4 streaming, maxChunkSize "
          f"16384; {got}/{rounds} rounds in {dt:.1f}s; {spread(stamps)}; "
-         f"every worker asserted output == 8 x input (exit codes "
-         f"{rcs}); {_rss_note(res)}; {'OK' if ok else 'FAILED'}; "
+         f"worker exit codes {rcs} (exactness pinned separately at 1M "
+         f"elems, tests/test_wire_scale.py — beyond f32 integer-exact "
+         f"range here); {_rss_note(res)}; {'OK' if ok else 'FAILED'}; "
          f"1-core box")
     return ok
 

@@ -92,13 +92,23 @@ subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env,
 """),
 ]
 
-# canonical-scale configs (64/256 workers) are HOST-plane — no TPU
-# involved, ~40-50 GB peak RSS, ~1 h — so they are not gated on chip
-# health and only run when asked for explicitly.
+# HOST-plane steps — no TPU involved (canonical-scale native runs, the
+# cross-process wire, the composed DCN hybrid), so they are not gated on
+# chip health and only run when asked for explicitly (--host / --steps).
 HOST_STEPS = [
     ("canonical", "canonical", 3600, """
 import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_canonical.py"],
+               check=False)
+"""),
+    ("wire", "canonical", 2400, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_wire.py"],
+               check=False)
+"""),
+    ("dcn_stress", "canonical", 1500, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_dcn_stress.py"],
                check=False)
 """),
 ]

@@ -49,7 +49,10 @@ def test_megascale_payload_crosses_real_wire():
     procs = [subprocess.Popen([sys.executable, "-c", code], env=env,
                               cwd=ROOT) for _ in range(workers)]
     try:
+        # wide liveness window: 5 CPU-bound processes on a 1-core box
+        # can starve a worker of scheduling past the 10 s default
         got, stamps = run_master_native(config, port=port, timeout_s=240,
+                                        unreachable_after_s=120.0,
                                         with_round_times=True)
         rcs = [p.wait(timeout=90) for p in procs]
     finally:
