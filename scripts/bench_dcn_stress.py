@@ -29,7 +29,7 @@ def emit(metric, value, unit, note):
                       "unit": unit, "note": note}), flush=True)
 
 
-def run_cluster(straggle_prob=0.0, nprocs=3, timeout_s=600):
+def run_cluster(straggle_prob=0.0, nprocs=3, timeout_s=600, wire="bf16"):
     from akka_allreduce_tpu.protocol.remote import free_port
 
     port = free_port()
@@ -38,6 +38,11 @@ def run_cluster(straggle_prob=0.0, nprocs=3, timeout_s=600):
     extra = []
     if straggle_prob > 0:
         extra = ["--straggle-prob", str(straggle_prob)]
+    if wire == "bf16":
+        extra += ["--bf16-grads"]
+    elif wire == "int8":
+        # int8 needs bucket_elems divisible by the local dp axis
+        extra += ["--int8-grads", "--bucket-elems", "65536"]
     procs = [subprocess.Popen(
         [sys.executable, "-u", "-m", "akka_allreduce_tpu.cli", "train",
          "--platform", "cpu",
@@ -48,7 +53,7 @@ def run_cluster(straggle_prob=0.0, nprocs=3, timeout_s=600):
          "--n-layers", "1", "--d-ff", "64", "--dp", "2",
          "--deadline-ms", "900", "--th-allreduce", "0.75",
          "--down-after", "3", "--dcn-bucket-elems", "16384",
-         "--bf16-grads", "--log-every", "1", *extra],
+         "--log-every", "1", *extra],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env) for i in range(nprocs)]
     t0 = time.perf_counter()
@@ -86,7 +91,14 @@ def main() -> int:
          f"publishes): {STEPS} rounds in {dt_s:.1f}s, {lossy_s} lossy "
          f"rounds absorbed by the fraction gate; "
          f"{'OK' if ok_s else 'FAILED'}")
-    return 0 if ok and ok_s else 1
+    rps_i, lossy_i, ok_i, dt_i = run_cluster(0.4, wire="int8")
+    emit("dcn_stress_composed_int8_straggled_rounds_per_s", rps_i,
+         "rounds/s",
+         f"the SAME composition on the int8 quantized wire (4x less DCN "
+         f"traffic, per-chunk stochastic rounding) + --straggle-prob "
+         f"0.4: {STEPS} rounds in {dt_i:.1f}s, {lossy_i} lossy rounds; "
+         f"{'OK' if ok_i else 'FAILED'}")
+    return 0 if ok and ok_s and ok_i else 1
 
 
 if __name__ == "__main__":

@@ -8,6 +8,8 @@ for the dense model, plus composition with dp sync, bf16 compute, and
 the int8 wire's per-step quant seeding.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -22,7 +24,9 @@ from akka_allreduce_tpu.models.train import (
 from akka_allreduce_tpu.models.transformer import TransformerConfig
 from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
 
-MCFG = TransformerConfig(vocab_size=41, d_model=32, n_heads=4, n_layers=2,
+# 1 layer: the accumulation identity is layer-count-agnostic and this
+# file's two train-step compiles sit on the fast tier's cold budget
+MCFG = TransformerConfig(vocab_size=41, d_model=32, n_heads=4, n_layers=1,
                          d_ff=64, max_seq=16)
 
 
@@ -81,7 +85,9 @@ class TestAccumulationIdentity:
     def test_pp_composition_rejected(self):
         mesh = make_device_mesh(MeshSpec(dp=2, pp=2),
                                 devices=jax.devices()[:4])
-        cfg = TrainConfig(model=MCFG, bucket_elems=256, grad_accum=2,
+        # pp=2 needs a stackable layer count (2), unlike the 1-layer MCFG
+        mcfg2 = dataclasses.replace(MCFG, n_layers=2)
+        cfg = TrainConfig(model=mcfg2, bucket_elems=256, grad_accum=2,
                           microbatches=2)
         with pytest.raises(ValueError, match="grad_accum"):
             make_grad_step(cfg, mesh)

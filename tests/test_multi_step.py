@@ -26,7 +26,9 @@ from akka_allreduce_tpu.models.train import (
 from akka_allreduce_tpu.models.transformer import TransformerConfig
 from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
 
-MCFG = TransformerConfig(vocab_size=61, d_model=32, n_heads=4, n_layers=2,
+# 1 layer: chunked-vs-sequential parity is layer-count-agnostic and this
+# file compiles both the per-step and the scan program on the fast tier
+MCFG = TransformerConfig(vocab_size=61, d_model=32, n_heads=4, n_layers=1,
                          d_ff=64, max_seq=16)
 
 

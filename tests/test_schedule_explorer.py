@@ -259,6 +259,7 @@ class TestEmulateFuzzCli:
         assert rc == 2
         assert "--fuzz" in capsys.readouterr().err
 
+    @pytest.mark.slow
     def test_fuzz_kill_rank_passes_with_reachable_thresholds(
             self, monkeypatch, capsys):
         """The kill-rank fuzz path end to end (round-4 advisor: it had

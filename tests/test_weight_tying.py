@@ -87,6 +87,9 @@ class TestTying:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0] - 0.2, losses
 
+    @pytest.mark.slow  # decode-under-tying composition pin; the fast
+    # tier keeps test_no_lm_head_param (tying) and test_generate's
+    # greedy e2e pin (decode) — this second pin rides the full tier
     def test_decode_parity(self):
         from akka_allreduce_tpu.models.generate import (decode_step,
                                                         init_kv_cache)
