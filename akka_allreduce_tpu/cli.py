@@ -839,6 +839,22 @@ def _add_generate(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--raw", action="store_true",
                    help="print token ids instead of decoding bytes")
+    p.add_argument("--draft-ckpt-dir", default=None,
+                   help="enable speculative decoding (greedy only): a "
+                        "small DRAFT model proposes --speculate-k "
+                        "tokens per round and the target verifies them "
+                        "in one batched pass — identical output, fewer "
+                        "target passes (models/speculate.py). The "
+                        "draft's geometry comes from the --draft-* "
+                        "flags (unset ones inherit the target's); it "
+                        "must share the target's vocab")
+    p.add_argument("--draft-d-model", type=int, default=0)
+    p.add_argument("--draft-n-layers", type=int, default=0)
+    p.add_argument("--draft-n-heads", type=int, default=0)
+    p.add_argument("--draft-d-ff", type=int, default=0)
+    p.add_argument("--draft-kv-heads", type=int, default=0)
+    p.add_argument("--speculate-k", type=int, default=4,
+                   help="draft proposals verified per target pass")
     _add_backend_args(p)
 
 
@@ -895,16 +911,60 @@ def _cmd_generate(args: argparse.Namespace) -> int:
         print(f"error: --top-p must be in (0, 1], got {args.top_p}",
               file=sys.stderr)
         return 2
+    if args.draft_ckpt_dir and args.temperature != 0.0:
+        print("error: speculative decoding is greedy-only (the "
+              "accept test compares argmaxes); drop --temperature or "
+              "--draft-ckpt-dir", file=sys.stderr)
+        return 2
+    if args.draft_ckpt_dir and args.speculate_k < 1:
+        print(f"error: --speculate-k must be >= 1, got "
+              f"{args.speculate_k}", file=sys.stderr)
+        return 2
+    if args.draft_ckpt_dir \
+            and len(ids) + args.tokens + args.speculate_k > max_seq:
+        print(f"error: speculation needs --speculate-k headroom: "
+              f"prompt ({len(ids)}) + --tokens ({args.tokens}) + k "
+              f"({args.speculate_k}) exceeds --max-seq {max_seq}",
+              file=sys.stderr)
+        return 2
     mcfg = _build_model_config(args, max_seq)
     restored = _restore_params(args, mcfg)
     if isinstance(restored, int):
         return restored
     _step0, params = restored
     prompt = jnp.asarray(np.asarray(ids, np.int32))[None]
-    out = generate(params, prompt, mcfg, steps=args.tokens,
-                   key=jax.random.key(args.seed),
-                   temperature=args.temperature,
-                   top_k=args.top_k, top_p=args.top_p)
+    if args.draft_ckpt_dir:
+        import dataclasses
+
+        from akka_allreduce_tpu.models.speculate import \
+            speculative_generate
+
+        dcfg = dataclasses.replace(
+            mcfg,
+            d_model=args.draft_d_model or mcfg.d_model,
+            n_layers=args.draft_n_layers or mcfg.n_layers,
+            n_heads=args.draft_n_heads or mcfg.n_heads,
+            d_ff=args.draft_d_ff or mcfg.d_ff,
+            n_kv_heads=args.draft_kv_heads or mcfg.n_kv_heads)
+        d_restored = _restore_params(
+            argparse.Namespace(ckpt_dir=args.draft_ckpt_dir,
+                               use_ema=False), dcfg)
+        if isinstance(d_restored, int):
+            return d_restored
+        _d_step, draft_params = d_restored
+        out, stats = speculative_generate(
+            params, draft_params, prompt, mcfg, dcfg,
+            steps=args.tokens, k=args.speculate_k)
+        print(f"speculative: {int(stats['rounds'])} target passes for "
+              f"{args.tokens} tokens (plain greedy would take "
+              f"{args.tokens}); acceptance "
+              f"{int(stats['accepted'])}/{int(stats['drafted'])} "
+              f"drafted", file=sys.stderr)
+    else:
+        out = generate(params, prompt, mcfg, steps=args.tokens,
+                       key=jax.random.key(args.seed),
+                       temperature=args.temperature,
+                       top_k=args.top_k, top_p=args.top_p)
     toks = np.asarray(out)[0].tolist()
     if args.raw or args.prompt_tokens is not None:
         print(",".join(map(str, toks)))

@@ -11,6 +11,10 @@ collectives, tp-sharded projections, and ring-attention sequence parallelism
 """
 
 from akka_allreduce_tpu.models.mlp import init_mlp, mlp_apply
+from akka_allreduce_tpu.models.speculate import (
+    extend,
+    speculative_generate,
+)
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
     init_transformer,
@@ -23,4 +27,6 @@ __all__ = [
     "TransformerConfig",
     "init_transformer",
     "transformer_apply",
+    "extend",
+    "speculative_generate",
 ]

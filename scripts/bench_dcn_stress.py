@@ -94,9 +94,13 @@ def main() -> int:
     rps_i, lossy_i, ok_i, dt_i = run_cluster(0.4, wire="int8")
     emit("dcn_stress_composed_int8_straggled_rounds_per_s", rps_i,
          "rounds/s",
-         f"the SAME composition on the int8 quantized wire (4x less DCN "
-         f"traffic, per-chunk stochastic rounding) + --straggle-prob "
-         f"0.4: {STEPS} rounds in {dt_i:.1f}s, {lossy_i} lossy rounds; "
+         f"the composed knobs on the int8 quantized wire (2x less DCN "
+         f"traffic than the bf16 row above, 4x less than f32; per-chunk "
+         f"stochastic rounding; --bucket-elems 65536 replaces the "
+         f"default to satisfy int8's divisibility constraint, so this "
+         f"is a configuration the composition must SURVIVE, not a pure "
+         f"wire A/B) + --straggle-prob 0.4: {STEPS} rounds in "
+         f"{dt_i:.1f}s, {lossy_i} lossy rounds; "
          f"{'OK' if ok_i else 'FAILED'}")
     return 0 if ok and ok_s and ok_i else 1
 

@@ -1,0 +1,203 @@
+"""Speculative decoding (models/speculate.py): draft proposes, target
+verifies in one extend pass; greedy output must be BIT-IDENTICAL to the
+target decoding alone — the draft changes latency, never tokens."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.generate import (
+    decode_step,
+    generate,
+    init_kv_cache,
+    prefill,
+)
+from akka_allreduce_tpu.models.speculate import (
+    extend,
+    speculative_generate,
+)
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+
+TCFG = TransformerConfig(vocab_size=37, d_model=32, n_heads=4,
+                         n_layers=2, d_ff=64, max_seq=64)
+DCFG = TransformerConfig(vocab_size=37, d_model=16, n_heads=2,
+                         n_layers=1, d_ff=32, max_seq=64)
+
+
+def prompt(t=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, 37, size=(1, t), dtype=np.int32))
+
+
+class TestExtendParity:
+    def test_extend_matches_sequential_decode_steps(self):
+        """The verification primitive: extend over a block must produce
+        the same logits (and cache) as feeding the block token by token
+        — it is chunked prefill, not a different model."""
+        params = init_transformer(jax.random.key(0), TCFG)
+        pr = prompt()
+        block = jnp.asarray([[3, 17, 8, 25]], jnp.int32)
+
+        cache_a, _ = prefill(params, init_kv_cache(TCFG, 1), pr, TCFG)
+        cache_b = jax.tree.map(jnp.copy, cache_a)
+
+        logits_seq = []
+        for j in range(block.shape[1]):
+            cache_a, lg = decode_step(params, cache_a, block[:, j], TCFG)
+            logits_seq.append(lg)
+        cache_b, logits_blk = extend(params, cache_b, block, TCFG)
+
+        assert int(cache_b["pos"]) == int(cache_a["pos"])
+        for j, lg in enumerate(logits_seq):
+            np.testing.assert_allclose(
+                np.asarray(logits_blk[:, j]), np.asarray(lg),
+                rtol=2e-5, atol=2e-6, err_msg=f"block position {j}")
+        # the written cache agrees too (next rounds read it)
+        for name in ("k", "v"):
+            np.testing.assert_allclose(np.asarray(cache_b[name]),
+                                       np.asarray(cache_a[name]),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_extend_matches_under_sliding_window(self):
+        cfg = dataclasses.replace(TCFG, attn_window=4)
+        params = init_transformer(jax.random.key(1), cfg)
+        pr = prompt(t=7, seed=2)
+        block = jnp.asarray([[1, 2, 3]], jnp.int32)
+        cache_a, _ = prefill(params, init_kv_cache(cfg, 1), pr, cfg)
+        cache_b = jax.tree.map(jnp.copy, cache_a)
+        seq = []
+        for j in range(block.shape[1]):
+            cache_a, lg = decode_step(params, cache_a, block[:, j], cfg)
+            seq.append(lg)
+        _, blk = extend(params, cache_b, block, cfg)
+        for j, lg in enumerate(seq):
+            np.testing.assert_allclose(np.asarray(blk[:, j]),
+                                       np.asarray(lg),
+                                       rtol=2e-5, atol=2e-6)
+
+
+class TestGreedyEquivalence:
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_independent_draft_emits_target_greedy_exactly(self, k):
+        """The core contract: with an unrelated (differently-sized,
+        differently-seeded) draft, the emitted tokens equal target-only
+        greedy decode bit for bit, for every speculation depth."""
+        target = init_transformer(jax.random.key(0), TCFG)
+        draft = init_transformer(jax.random.key(7), DCFG)
+        steps = 12
+        ref = generate(target, prompt(), TCFG, steps)
+        got, stats = speculative_generate(target, draft, prompt(),
+                                          TCFG, DCFG, steps, k=k)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        assert int(stats["rounds"]) >= 1
+        assert int(stats["drafted"]) == int(stats["rounds"]) * k
+        assert 0 <= int(stats["accepted"]) <= int(stats["drafted"])
+
+    def test_self_draft_accepts_everything(self):
+        """Draft == target: every proposal matches, so each round
+        accepts all k and rounds collapse to ~steps/k target passes —
+        the mechanism's best case, and a strong pin on the acceptance
+        bookkeeping."""
+        target = init_transformer(jax.random.key(0), TCFG)
+        steps, k = 12, 4
+        ref = generate(target, prompt(), TCFG, steps)
+        got, stats = speculative_generate(target, target, prompt(),
+                                          TCFG, TCFG, steps, k=k)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        assert int(stats["accepted"]) == int(stats["drafted"])
+        # ceil((steps-1)/k) rounds: the first token comes from prefill
+        assert int(stats["rounds"]) == -(-(steps - 1) // k)
+
+    def test_windowed_model_equivalence(self):
+        cfg_t = dataclasses.replace(TCFG, attn_window=4)
+        cfg_d = dataclasses.replace(DCFG, attn_window=4)
+        target = init_transformer(jax.random.key(3), cfg_t)
+        draft = init_transformer(jax.random.key(4), cfg_d)
+        steps = 10
+        ref = generate(target, prompt(seed=5), cfg_t, steps)
+        got, _ = speculative_generate(target, draft, prompt(seed=5),
+                                      cfg_t, cfg_d, steps, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.slow
+class TestSpeculativeCli:
+    def test_generate_with_draft_matches_plain_greedy(self, monkeypatch,
+                                                      tmp_path, capsys):
+        """The operator surface: train two tiny checkpoints (target +
+        smaller draft), decode with --draft-ckpt-dir, and pin the token
+        stream against plain greedy decode of the same checkpoint."""
+        import sys as _sys
+
+        from akka_allreduce_tpu.cli import main
+
+        def run(argv):
+            monkeypatch.setattr(_sys, "argv", ["aat"] + argv)
+            return main()
+
+        tgt, drf = str(tmp_path / "t"), str(tmp_path / "d")
+        common = ["--platform", "cpu", "--steps", "2",
+                  "--batch", "8", "--seq", "16", "--vocab", "64",
+                  "--n-heads", "2", "--lr", "1e-3"]
+        assert run(["train", *common, "--d-model", "16", "--n-layers",
+                    "2", "--d-ff", "32", "--ckpt-dir", tgt]) == 0
+        assert run(["train", *common, "--d-model", "8", "--n-layers",
+                    "1", "--d-ff", "16", "--ckpt-dir", drf]) == 0
+        capsys.readouterr()
+
+        gen_common = ["generate", "--platform", "cpu", "--ckpt-dir",
+                      tgt, "--max-seq", "16", "--vocab", "64",
+                      "--d-model", "16", "--n-layers", "2", "--n-heads",
+                      "2", "--d-ff", "32", "--prompt-tokens", "5,9,2",
+                      "--tokens", "8", "--raw"]
+        assert run(gen_common) == 0
+        plain = capsys.readouterr().out.strip().splitlines()[-1]
+        assert run(gen_common + [
+            "--draft-ckpt-dir", drf, "--draft-d-model", "8",
+            "--draft-n-layers", "1", "--draft-d-ff", "16",
+            "--speculate-k", "3"]) == 0
+        cap = capsys.readouterr()
+        spec = cap.out.strip().splitlines()[-1]
+        assert spec == plain  # identical token stream
+        assert "speculative:" in cap.err and "acceptance" in cap.err
+
+
+class TestValidation:
+    def test_batch_gt_one_rejected(self):
+        target = init_transformer(jax.random.key(0), TCFG)
+        with pytest.raises(ValueError, match="batch"):
+            speculative_generate(target, target,
+                                 jnp.zeros((2, 4), jnp.int32),
+                                 TCFG, TCFG, 4)
+
+    def test_vocab_mismatch_rejected(self):
+        target = init_transformer(jax.random.key(0), TCFG)
+        bad = dataclasses.replace(DCFG, vocab_size=99)
+        draft = init_transformer(jax.random.key(1), bad)
+        with pytest.raises(ValueError, match="vocab"):
+            speculative_generate(target, draft, prompt(), TCFG, bad, 4)
+
+    def test_target_cache_needs_k_headroom(self):
+        """A final round can write k positions past the emitted
+        frontier; without headroom dynamic_update_slice would CLAMP
+        the write onto live prefix entries and silently corrupt the
+        output — so the boundary must reject, not clamp."""
+        tight = dataclasses.replace(TCFG, max_seq=5 + 12)  # prompt+steps
+        target = init_transformer(jax.random.key(0), tight)
+        draft = init_transformer(jax.random.key(1), DCFG)
+        with pytest.raises(ValueError, match="headroom|write up to"):
+            speculative_generate(target, draft, prompt(), tight, DCFG,
+                                 steps=12, k=4)
+        # exactly enough headroom is accepted and stays bit-identical
+        ok_cfg = dataclasses.replace(TCFG, max_seq=5 + 12 + 3)
+        target2 = init_transformer(jax.random.key(0), ok_cfg)
+        ref = generate(target2, prompt(), ok_cfg, 12)
+        got, _ = speculative_generate(target2, draft, prompt(), ok_cfg,
+                                      DCFG, steps=12, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
