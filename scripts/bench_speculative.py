@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Speculative-decoding mechanics on the chip: what verification buys.
+
+Speculation's win is structural: k draft tokens are verified by ONE
+batched ``extend`` pass instead of k sequential single-token decode
+steps. With UNTRAINED random weights the draft cannot predict the
+target (acceptance ~1/vocab), so an end-to-end tokens/s claim here
+would be dishonest — what CAN be measured honestly on random weights:
+
+* plain batch-1 decode rate (the baseline speculation must beat),
+* ``extend``-k throughput on the same model — positions verified per
+  second; its ratio to sequential decode bounds the best-case gain,
+* the full speculative loop with a small draft, labeled as the
+  OVERHEAD BOUND (every round pays k draft steps + one extend and
+  emits ~1 token at the acceptance floor).
+
+Emits one JSON line per row (capture step 'speculative').
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def emit(metric, value, unit, note):
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit, "note": note}), flush=True)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.models.generate import (generate,
+                                                    init_kv_cache,
+                                                    prefill)
+    from akka_allreduce_tpu.models.speculate import (extend,
+                                                     speculative_generate)
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+
+    plat = jax.devices()[0].platform
+    on_tpu = plat == "tpu"
+    if on_tpu:
+        tdim, tl, tff, vocab, plen, steps, k = 2048, 8, 8192, 32768, \
+            128, 256, 4
+        ddim, dl, dff = 512, 2, 2048
+    else:  # exercise the path off-TPU, no perf claim
+        tdim, tl, tff, vocab, plen, steps, k = 128, 2, 256, 256, 16, \
+            24, 3
+        ddim, dl, dff = 64, 1, 128
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    tcfg = TransformerConfig(vocab_size=vocab, d_model=tdim,
+                             n_heads=tdim // 128 if on_tpu else 4,
+                             n_layers=tl, d_ff=tff,
+                             max_seq=plen + steps + k, rope=True,
+                             dtype=dtype)
+    dcfg = TransformerConfig(vocab_size=vocab, d_model=ddim,
+                             n_heads=max(2, ddim // 128), n_layers=dl,
+                             d_ff=dff, max_seq=plen + steps + k,
+                             rope=True, dtype=dtype)
+    target = jax.device_put(init_transformer(jax.random.key(0), tcfg))
+    draft = jax.device_put(init_transformer(jax.random.key(1), dcfg))
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, vocab, size=(1, plen), dtype=np.int32))
+
+    def timed(fn, reps=3):
+        fn()  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # 1. plain batch-1 sequential decode (two-point to cancel prefill)
+    t_hi = timed(lambda: np.asarray(
+        generate(target, prompt, tcfg, steps)[:, -1]))
+    t_lo = timed(lambda: np.asarray(
+        generate(target, prompt, tcfg, steps // 4)[:, -1]))
+    per_step = (t_hi - t_lo) / (steps - steps // 4)
+    if per_step <= 0:
+        # two-point noise swamped the tiny off-TPU smoke: fall back to
+        # the single-span mean so the derived rows stay printable
+        per_step = t_hi / steps
+    emit(f"spec_plain_decode_b1_{plat}", 1 / per_step, "tok/s",
+         f"sequential batch-1 greedy decode, {tdim}d x {tl}L target, "
+         f"{per_step * 1e3:.2f} ms/token (the baseline speculation "
+         f"must beat)")
+
+    # 2. extend-k verification throughput on the target: k positions
+    # scored per pass vs k sequential steps — the structural win
+    cache0, _ = prefill(target, init_kv_cache(tcfg, 1), prompt, tcfg)
+    block = jnp.asarray(np.random.default_rng(1).integers(
+        0, vocab, size=(1, k), dtype=np.int32))
+    # standalone extend must be jitted here (inside speculative_generate
+    # it already runs under the jitted while_loop)
+    extend_jit = jax.jit(extend, static_argnames="cfg")
+
+    def run_extend():
+        _, lg = extend_jit(target, cache0, block, cfg=tcfg)
+        np.asarray(lg[0, -1, :4])
+
+    t_ext = timed(run_extend, reps=5)
+    emit(f"spec_extend_k{k}_pass_{plat}", t_ext * 1e3, "ms/pass",
+         f"ONE batched verify of {k} positions vs {k} sequential steps "
+         f"({k * per_step * 1e3:.2f} ms): best-case round gain "
+         f"{k * per_step / t_ext:.2f}x when the draft predicts well")
+
+    # 3. end-to-end loop at the acceptance floor (untrained models):
+    # the honest overhead bound, not a speedup claim
+    def run_spec():
+        toks, stats = speculative_generate(target, draft, prompt, tcfg,
+                                           dcfg, steps, k=k)
+        np.asarray(toks[:, -1])
+        return stats
+
+    run_spec()
+    t0 = time.perf_counter()
+    stats = run_spec()
+    dt = time.perf_counter() - t0
+    acc = int(stats["accepted"]) / max(1, int(stats["drafted"]))
+    emit(f"spec_e2e_floor_{plat}", steps / dt, "tok/s",
+         f"full loop, UNTRAINED {ddim}d x {dl}L draft (acceptance "
+         f"{acc:.1%} = the ~1/vocab floor): every round pays {k} draft "
+         f"steps + one extend for ~1 token — the overhead bound; "
+         f"trained draft/target pairs move toward the extend gain "
+         f"above, output bit-identical either way")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,6 +90,13 @@ env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1",
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env,
                check=False)
 """),
+    # 7. speculative-decoding mechanics (new in round 5; last — never
+    # ahead of the open claims)
+    ("speculative", "decode", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_speculative.py"],
+               check=False)
+"""),
 ]
 
 # HOST-plane steps — no TPU involved (canonical-scale native runs, the
