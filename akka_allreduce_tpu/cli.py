@@ -840,14 +840,17 @@ def _add_generate(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--raw", action="store_true",
                    help="print token ids instead of decoding bytes")
     p.add_argument("--draft-ckpt-dir", default=None,
-                   help="enable speculative decoding (greedy only): a "
-                        "small DRAFT model proposes --speculate-k "
-                        "tokens per round and the target verifies them "
-                        "in one batched pass — identical output, fewer "
-                        "target passes (models/speculate.py). The "
-                        "draft's geometry comes from the --draft-* "
-                        "flags (unset ones inherit the target's); it "
-                        "must share the target's vocab")
+                   help="enable speculative decoding: a small DRAFT "
+                        "model proposes --speculate-k tokens per round "
+                        "and the target verifies them in one batched "
+                        "pass (models/speculate.py). Greedy output is "
+                        "bit-identical; with --temperature > 0 the "
+                        "modified-rejection scheme keeps emitted "
+                        "tokens distributed exactly as target-only "
+                        "sampling (top-k/top-p compose). The draft's "
+                        "geometry comes from the --draft-* flags "
+                        "(unset ones inherit the target's); it must "
+                        "share the target's vocab")
     p.add_argument("--draft-d-model", type=int, default=0)
     p.add_argument("--draft-n-layers", type=int, default=0)
     p.add_argument("--draft-n-heads", type=int, default=0)
@@ -911,11 +914,6 @@ def _cmd_generate(args: argparse.Namespace) -> int:
         print(f"error: --top-p must be in (0, 1], got {args.top_p}",
               file=sys.stderr)
         return 2
-    if args.draft_ckpt_dir and args.temperature != 0.0:
-        print("error: speculative decoding is greedy-only (the "
-              "accept test compares argmaxes); drop --temperature or "
-              "--draft-ckpt-dir", file=sys.stderr)
-        return 2
     if args.draft_ckpt_dir and args.speculate_k < 1:
         print(f"error: --speculate-k must be >= 1, got "
               f"{args.speculate_k}", file=sys.stderr)
@@ -936,8 +934,10 @@ def _cmd_generate(args: argparse.Namespace) -> int:
     if args.draft_ckpt_dir:
         import dataclasses
 
-        from akka_allreduce_tpu.models.speculate import \
-            speculative_generate
+        from akka_allreduce_tpu.models.speculate import (
+            speculative_generate,
+            speculative_sample,
+        )
 
         dcfg = dataclasses.replace(
             mcfg,
@@ -952,11 +952,20 @@ def _cmd_generate(args: argparse.Namespace) -> int:
         if isinstance(d_restored, int):
             return d_restored
         _d_step, draft_params = d_restored
-        out, stats = speculative_generate(
-            params, draft_params, prompt, mcfg, dcfg,
-            steps=args.tokens, k=args.speculate_k)
+        if args.temperature == 0.0:
+            out, stats = speculative_generate(
+                params, draft_params, prompt, mcfg, dcfg,
+                steps=args.tokens, k=args.speculate_k)
+        else:
+            # modified-rejection speculative sampling: emitted tokens
+            # distributed exactly as target-only sampling
+            out, stats = speculative_sample(
+                params, draft_params, prompt, mcfg, dcfg,
+                steps=args.tokens, key=jax.random.key(args.seed),
+                k=args.speculate_k, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p)
         print(f"speculative: {int(stats['rounds'])} target passes for "
-              f"{args.tokens} tokens (plain greedy would take "
+              f"{args.tokens} tokens (plain decode would take "
               f"{args.tokens}); acceptance "
               f"{int(stats['accepted'])}/{int(stats['drafted'])} "
               f"drafted", file=sys.stderr)

@@ -14,6 +14,7 @@ from akka_allreduce_tpu.models.mlp import init_mlp, mlp_apply
 from akka_allreduce_tpu.models.speculate import (
     extend,
     speculative_generate,
+    speculative_sample,
 )
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
@@ -29,4 +30,5 @@ __all__ = [
     "transformer_apply",
     "extend",
     "speculative_generate",
+    "speculative_sample",
 ]

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from akka_allreduce_tpu.models.generate import (
+    _filter_top_k,
+    _filter_top_p,
     decode_step,
     init_kv_cache,
     prefill,
@@ -240,6 +242,155 @@ def speculative_generate(target_params: dict, draft_params: dict,
             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
             jnp.asarray(0, jnp.int32))
     (_, _, out, _, _, rounds, drafted, accepted) = lax.while_loop(
+        cond, round_body, init)
+    stats = {"rounds": rounds, "drafted": drafted, "accepted": accepted}
+    return out[:steps][None], stats
+
+
+def _residual_resample(p: jnp.ndarray, q: jnp.ndarray,
+                       key: jax.Array) -> jnp.ndarray:
+    """Sample from the rejection residual ``norm(max(p - q, 0))`` — the
+    distribution that makes draft-accept/resample EXACTLY equivalent to
+    sampling from ``p`` (for every token x: q(x)·min(1, p/q) plus the
+    total rejection mass times residual(x) sums to p(x); pinned
+    analytically in tests/test_speculative.py). Falls back to ``p``
+    itself in the measure-zero q==p case (zero residual)."""
+    res = jnp.maximum(p - q, 0.0)
+    total = jnp.sum(res)
+    safe = jnp.where(total > 0, res / jnp.maximum(total, 1e-30), p)
+    return jax.random.categorical(key, jnp.log(jnp.maximum(safe, 1e-30)))
+
+
+def _filtered_probs(logits: jnp.ndarray, temperature: float,
+                    top_k: Optional[int],
+                    top_p: Optional[float]) -> jnp.ndarray:
+    """logits (vocab,) -> the filtered sampling distribution — the SAME
+    pipeline generate() samples from, so speculative sampling preserves
+    exactly the distribution plain sampling uses."""
+    x = logits[None] / temperature
+    if top_k is not None and top_k < x.shape[-1]:
+        x = _filter_top_k(x, top_k)
+    if top_p is not None and top_p < 1.0:
+        x = _filter_top_p(x, top_p)
+    return jax.nn.softmax(x, axis=-1)[0]
+
+
+@partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",
+                                   "k", "temperature", "top_k", "top_p"))
+def speculative_sample(target_params: dict, draft_params: dict,
+                       prompt: jnp.ndarray,
+                       target_cfg: TransformerConfig,
+                       draft_cfg: TransformerConfig,
+                       steps: int, key: jax.Array, k: int = 4,
+                       temperature: float = 1.0,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None
+                       ) -> tuple[jnp.ndarray, dict]:
+    """Speculative SAMPLING (temperature > 0): the draft proposes k
+    tokens from its filtered distribution q; the target verifies in one
+    extend; proposal j is accepted with probability
+    ``min(1, p_j(x_j) / q_j(x_j))`` and the first rejection resamples
+    from ``norm(max(p - q, 0))`` — the modified-rejection scheme whose
+    emitted tokens are distributed EXACTLY as sampling from the target
+    alone (same temperature/top-k/top-p pipeline as generate()). Greedy
+    is the separate bit-exact path (:func:`speculative_generate`).
+
+    Same loop shape, cache-rewind trick, batch-1 restriction, and stats
+    as the greedy path."""
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            "speculative decode is the batch-1 latency path; run the "
+            f"plain decode scan for batch {prompt.shape[0]}")
+    if not 1 <= k:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if temperature <= 0.0:
+        raise ValueError(
+            "speculative_sample needs temperature > 0; use "
+            "speculative_generate for greedy")
+    if draft_cfg.vocab_size != target_cfg.vocab_size:
+        raise ValueError(
+            f"draft and target must share a vocabulary: "
+            f"{draft_cfg.vocab_size} != {target_cfg.vocab_size}")
+    if prompt.shape[1] + steps + k > target_cfg.max_seq:
+        raise ValueError(
+            f"target max_seq {target_cfg.max_seq} must cover prompt + "
+            f"steps + k = {prompt.shape[1] + steps + k}")
+    if prompt.shape[1] + steps + k > draft_cfg.max_seq:
+        raise ValueError(
+            f"draft max_seq {draft_cfg.max_seq} must cover prompt + "
+            f"steps + k = {prompt.shape[1] + steps + k}")
+
+    t_cache = init_kv_cache(target_cfg, 1)
+    d_cache = init_kv_cache(draft_cfg, 1)
+    t_cache, t_logits = prefill(target_params, t_cache, prompt,
+                                target_cfg)
+    d_cache, _ = prefill(draft_params, d_cache, prompt, draft_cfg)
+    key, k0 = jax.random.split(key)
+    p0 = _filtered_probs(t_logits[0], temperature, top_k, top_p)
+    cur0 = jax.random.categorical(
+        k0, jnp.log(jnp.maximum(p0, 1e-30)))[None].astype(jnp.int32)
+
+    buf_len = steps + k + 1
+    out0 = jnp.zeros((buf_len,), jnp.int32).at[0].set(cur0[0])
+
+    def round_body(carry):
+        (t_cache, d_cache, out, n_out, cur, key, rounds, drafted,
+         accepted) = carry
+        key, kd, ka, kr = jax.random.split(key, 4)
+
+        # -- draft: k sampled proposals, recording each q distribution
+        def draft_one(c, kj):
+            dc, tok = c
+            dc, dl = decode_step(draft_params, dc, tok, draft_cfg)
+            qj = _filtered_probs(dl[0], temperature, top_k, top_p)
+            nxt = jax.random.categorical(
+                kj, jnp.log(jnp.maximum(qj, 1e-30)))[None].astype(
+                    jnp.int32)
+            return (dc, nxt), (nxt[0], qj)
+
+        (d_cache, _), (props, qs) = lax.scan(
+            draft_one, (d_cache, cur), jax.random.split(kd, k))
+
+        # -- target: one extend over [cur, g_1..g_{k-1}]
+        block = jnp.concatenate([cur, props[:-1]])[None]
+        t_cache, t_block_logits = extend(target_params, t_cache, block,
+                                         target_cfg)
+        ps = jax.vmap(
+            lambda lg: _filtered_probs(lg, temperature, top_k, top_p))(
+                t_block_logits[0])                       # (k, vocab)
+
+        # -- accept test per proposal: u < p(x)/q(x)
+        idx = jnp.arange(k)
+        p_at = ps[idx, props]
+        q_at = qs[idx, props]
+        u = jax.random.uniform(ka, (k,))
+        ok = u * q_at < p_at                # u < p/q, q>0 where sampled
+        n_acc = jnp.argmin(jnp.concatenate(
+            [ok, jnp.zeros((1,), bool)]).astype(jnp.int32))
+
+        # first rejection resamples from the residual at that position
+        n_res = jnp.minimum(n_acc, k - 1)
+        resample = _residual_resample(ps[n_res], qs[n_res], kr).astype(
+            jnp.int32)
+        emit_vec = jnp.where(idx < n_acc, props, resample)
+        emit_len = jnp.minimum(n_acc + 1, k)
+        out = lax.dynamic_update_slice(out, emit_vec, (n_out,))
+        new_cur = emit_vec[emit_len - 1][None]
+        n_out = n_out + emit_len
+
+        frontier = t_cache["pos"] - k + emit_len
+        t_cache = {**t_cache, "pos": frontier}
+        d_cache = {**d_cache, "pos": frontier}
+        return (t_cache, d_cache, out, n_out, new_cur, key, rounds + 1,
+                drafted + k, accepted + n_acc)
+
+    def cond(carry):
+        return carry[3] < steps
+
+    init = (t_cache, d_cache, out0, jnp.asarray(1, jnp.int32), cur0,
+            key, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(0, jnp.int32))
+    (_, _, out, _, _, _, rounds, drafted, accepted) = lax.while_loop(
         cond, round_body, init)
     stats = {"rounds": rounds, "drafted": drafted, "accepted": accepted}
     return out[:steps][None], stats

@@ -126,6 +126,82 @@ class TestGreedyEquivalence:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+class TestSpeculativeSampling:
+    def test_accept_resample_identity_is_exact(self):
+        """The scheme's theorem, pinned numerically on random (p, q):
+        P(emit x) = q(x)·min(1, p(x)/q(x)) + P(reject)·residual(x)
+        must equal p(x) exactly — acceptance + residual resampling IS
+        sampling from the target."""
+        rng = np.random.default_rng(0)
+        for _ in range(5):
+            p = rng.dirichlet(np.full(23, 0.3))
+            q = rng.dirichlet(np.full(23, 0.3))
+            acc = np.minimum(1.0, p / np.maximum(q, 1e-30))
+            reject_mass = float(np.sum(q * (1 - acc)))
+            res = np.maximum(p - q, 0.0)
+            res = res / res.sum()
+            emit = q * acc + reject_mass * res
+            np.testing.assert_allclose(emit, p, rtol=1e-10, atol=1e-12)
+
+    def test_topk1_sampling_equals_greedy_bitwise(self):
+        """top_k=1 collapses the filtered distribution to the argmax,
+        so speculative SAMPLING must reproduce greedy speculative (and
+        plain greedy) output bit for bit at any temperature — a
+        deterministic end-to-end pin on the sampling path."""
+        from akka_allreduce_tpu.models.speculate import \
+            speculative_sample
+
+        target = init_transformer(jax.random.key(0), TCFG)
+        draft = init_transformer(jax.random.key(7), DCFG)
+        steps = 10
+        ref = generate(target, prompt(), TCFG, steps)
+        got, stats = speculative_sample(
+            target, draft, prompt(), TCFG, DCFG, steps,
+            key=jax.random.key(11), k=3, temperature=0.7, top_k=1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        assert int(stats["rounds"]) >= 1
+
+    def test_self_draft_accepts_everything_when_sampling(self):
+        """q == p makes the accept probability exactly 1 (u < 1 always),
+        so a self-draft run accepts every proposal."""
+        from akka_allreduce_tpu.models.speculate import \
+            speculative_sample
+
+        target = init_transformer(jax.random.key(0), TCFG)
+        _, stats = speculative_sample(
+            target, target, prompt(), TCFG, TCFG, 12,
+            key=jax.random.key(3), k=4, temperature=1.0)
+        assert int(stats["accepted"]) == int(stats["drafted"])
+
+    @pytest.mark.slow
+    def test_first_token_distribution_matches_target(self):
+        """Statistical pin of the code path (not just the theorem): the
+        first emitted token's empirical distribution over many keys must
+        match the target's filtered distribution within a total-
+        variation budget sized to the sample count."""
+        from akka_allreduce_tpu.models.generate import init_kv_cache
+        from akka_allreduce_tpu.models.generate import prefill
+        from akka_allreduce_tpu.models.speculate import (
+            _filtered_probs, speculative_sample)
+
+        target = init_transformer(jax.random.key(0), TCFG)
+        draft = init_transformer(jax.random.key(7), DCFG)
+        pr = prompt()
+        _, logits = prefill(target, init_kv_cache(TCFG, 1), pr, TCFG)
+        p_ref = np.asarray(_filtered_probs(logits[0], 1.0, None, None))
+
+        n = 1500
+        counts = np.zeros(TCFG.vocab_size)
+        for s in range(n):
+            toks, _ = speculative_sample(
+                target, draft, pr, TCFG, DCFG, steps=1,
+                key=jax.random.key(100 + s), k=2, temperature=1.0)
+            counts[int(np.asarray(toks)[0, 0])] += 1
+        tv = 0.5 * np.abs(counts / n - p_ref).sum()
+        # E[TV] for n samples over V cats ~ sqrt(V / (pi*n/2)) ~= 0.09
+        assert tv < 0.15, f"total variation {tv:.3f}"
+
+
 @pytest.mark.slow
 class TestSpeculativeCli:
     def test_generate_with_draft_matches_plain_greedy(self, monkeypatch,
@@ -166,6 +242,19 @@ class TestSpeculativeCli:
         spec = cap.out.strip().splitlines()[-1]
         assert spec == plain  # identical token stream
         assert "speculative:" in cap.err and "acceptance" in cap.err
+        # sampling path through the same CLI (no equality claim — the
+        # guarantee is distributional; top_k=1 would collapse it to
+        # greedy, pinned at the API level)
+        assert run(gen_common + [
+            "--draft-ckpt-dir", drf, "--draft-d-model", "8",
+            "--draft-n-layers", "1", "--draft-d-ff", "16",
+            "--speculate-k", "3", "--temperature", "0.8",
+            "--top-p", "0.9"]) == 0
+        cap2 = capsys.readouterr()
+        toks = [int(x) for x in
+                cap2.out.strip().splitlines()[-1].split(",")]
+        assert len(toks) == 8 and all(0 <= t < 64 for t in toks)
+        assert "acceptance" in cap2.err
 
 
 class TestValidation:
