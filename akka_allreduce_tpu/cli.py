@@ -312,7 +312,7 @@ def _add_worker(sub: argparse._SubParsersAction) -> None:
                    help="> 0: treat a master disconnect as a possible "
                         "restart instead of shutdown — cold-reset and "
                         "redial through the seed list for up to this "
-                        "many seconds (Python engine only)")
+                        "many seconds (both engines)")
     p.add_argument("--data-size", type=int, default=None,
                    help="synthetic source length, default 10 (must match "
                         "the master's; ignored with --native, which "
@@ -371,43 +371,21 @@ def _cmd_worker(args: argparse.Namespace) -> int:
             print("note: --native derives the data geometry from the "
                   "master's InitWorkers; --data-size is ignored",
                   file=sys.stderr)
-        if args.rejoin_timeout > 0:
-            print("warning: --rejoin-timeout is a Python-engine "
-                  "feature; the native worker treats master disconnect "
-                  "as shutdown", file=sys.stderr)
-        # multi-seed JOIN for the native engine: pick a live seed with a
-        # cheap socket probe, then hand the C++ engine the REMAINING
-        # budget intact — its timeout_s covers the whole run, not just
-        # the join, so splitting the budget across seeds would truncate
-        # a successfully-joined session (mid-run failover stays
-        # Python-only)
-        import socket
-        import time as _time
-
-        deadline = _time.monotonic() + args.timeout
-        live = None
-        while live is None and _time.monotonic() < deadline:
-            for host, port in seeds:
-                try:
-                    socket.create_connection((host, port),
-                                             timeout=2.0).close()
-                    live = (host, port)
-                    break
-                except OSError:
-                    continue
-            else:
-                _time.sleep(0.2)
-        if live is None:
-            print(f"error: no master reachable among {seeds}",
-                  file=sys.stderr)
+        # the C++ engine carries the seed list AND the rejoin window
+        # natively (aat_remote_worker_run_seeds): engine parity with the
+        # Python worker's master-restart failover
+        try:
+            outputs = run_worker_native(
+                checkpoint=args.checkpoint,
+                assert_multiple=args.assert_multiple,
+                timeout_s=args.timeout, verbose=args.verbose,
+                heartbeat_interval_s=args.heartbeat_interval,
+                seeds=seeds, rejoin_timeout_s=args.rejoin_timeout)
+        except (ConnectionError, ValueError) as exc:
+            # ValueError = malformed seed list (e.g. an empty host the
+            # flag parser let through) — same clean-exit convention
+            print(f"error: {exc}", file=sys.stderr)
             return 1
-        outputs = run_worker_native(
-            master_host=live[0], master_port=live[1],
-            checkpoint=args.checkpoint,
-            assert_multiple=args.assert_multiple,
-            timeout_s=max(1.0, deadline - _time.monotonic()),
-            verbose=args.verbose,
-            heartbeat_interval_s=args.heartbeat_interval)
     else:
         outputs = run_worker(source_data_size=(10 if args.data_size is None
                                                else args.data_size),

@@ -106,6 +106,11 @@ def load_library() -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_double, ctypes.c_double, ctypes.c_int]
 
+    lib.aat_remote_worker_run_seeds.restype = ctypes.c_long
+    lib.aat_remote_worker_run_seeds.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int]
+
     lib.aat_remote_master_run.restype = ctypes.c_long
     lib.aat_remote_master_run.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_uint, ctypes.c_uint64,

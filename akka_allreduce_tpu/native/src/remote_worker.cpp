@@ -33,6 +33,7 @@
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -103,6 +104,14 @@ struct RemoteWorker {
     double last_ping = 0.0;
     int verbose = 0;
 
+    // multi-seed failover (protocol/remote.py run_worker semantics):
+    // any seed admits the joiner; rejoin_timeout > 0 turns master
+    // disconnect into a cold-reset + redial through the seed list
+    std::vector<Addr> seeds;
+    double rejoin_timeout = 0.0;
+    int generation = 0;       // epochs joined - 1 (fence gate)
+    bool discarding = false;  // reset->rejoin window: drop stale blocks
+
     aat::WorkerCore<RemoteWorker> core;  // the shared state machine
     std::map<int, Addr> peers;  // rank -> listen addr (deathwatch prunes)
     std::vector<float> source_vec;  // constant arange input
@@ -165,13 +174,27 @@ struct RemoteWorker {
             send_frame(master_addr, enc_complete(core.id, round));
     }
 
+    // Epoch fence (protocol/worker.py _stale_epoch_round): after a
+    // multi-seed rejoin, a block whose round exceeds the newest Start by
+    // more than the in-flight window cannot belong to the current master
+    // epoch — self-starting it (the cold-start catch-up below) would
+    // jump this worker decades ahead of the restarted master. Never
+    // fences generation 0: catch-up jumps are the reference's own
+    // semantics (AllreduceWorker.scala:183-184).
+    bool stale_epoch_round(int64_t round) const {
+        return generation > 0
+            && round > core.max_round + core.max_lag + 1;
+    }
+
     void defer_start(int64_t round) {
+        if (stale_epoch_round(round)) return;
         PMsg s; s.type = kStart; s.round = round;
         self_q.push_back(std::move(s));
     }
 
     void defer_scatter(int src, int chunk, int64_t round, const float* d,
                        size_t n) {
+        if (stale_epoch_round(round)) return;
         PMsg m; m.type = kScatter; m.src = src; m.dest = core.id;
         m.chunk = chunk; m.round = round;
         m.payload.assign(d, d + n);
@@ -180,6 +203,7 @@ struct RemoteWorker {
 
     void defer_reduce(int src, int chunk, int64_t round, int64_t count,
                       const float* d, size_t n) {
+        if (stale_epoch_round(round)) return;
         PMsg m; m.type = kReduce; m.src = src; m.dest = core.id;
         m.chunk = chunk; m.round = round; m.count = count;
         m.payload.assign(d, d + n);
@@ -238,7 +262,9 @@ struct RemoteWorker {
             // connection so CompleteAllreduce rides the existing socket
             // instead of opening a duplicate that Hellos as a new member
             auto dit = conn_of.find(dialed_master);
-            if (dit != conn_of.end()) conn_of.emplace(maddr, dit->second);
+            // assignment, not emplace: a stale alias from a previous
+            // epoch (same advertised addr, dead conn) must be replaced
+            if (dit != conn_of.end()) conn_of[maddr] = dit->second;
         }
         uint32_t count;
         if (!rd(buf, len, off, &count)) return;
@@ -289,8 +315,11 @@ struct RemoteWorker {
             case kStart: {
                 int64_t r;
                 if (!rd(buf, len, off, &r)) break;
-                if (core.id == -1) defer_start(r);
-                else core.on_start(r);
+                if (core.id == -1) {
+                    if (!discarding) defer_start(r);
+                } else {
+                    core.on_start(r);
+                }
                 break;
             }
             case kScatter: {
@@ -307,10 +336,14 @@ struct RemoteWorker {
                 m.src = src; m.dest = dest; m.chunk = chunk;
                 m.payload.resize(nbytes / 4);
                 std::memcpy(m.payload.data(), buf + off, nbytes);
-                if (core.id == -1) self_q.push_back(std::move(m));
-                else if (m.dest == core.id)  // misrouted frames dropped
+                if (core.id == -1) {
+                    // pre-rejoin window: old-epoch leftovers are
+                    // DROPPED, not queued (protocol/worker.py reset())
+                    if (!discarding) self_q.push_back(std::move(m));
+                } else if (m.dest == core.id) {  // misroutes dropped
                     core.on_scatter(m.src, m.chunk, m.round,
                                     m.payload.data(), m.payload.size());
+                }
                 break;
             }
             case kReduce: {
@@ -328,10 +361,12 @@ struct RemoteWorker {
                 m.src = src; m.dest = dest; m.chunk = chunk;
                 m.payload.resize(nbytes / 4);
                 std::memcpy(m.payload.data(), buf + off, nbytes);
-                if (core.id == -1) self_q.push_back(std::move(m));
-                else if (m.dest == core.id)  // misrouted frames dropped
+                if (core.id == -1) {
+                    if (!discarding) self_q.push_back(std::move(m));
+                } else if (m.dest == core.id) {  // misroutes dropped
                     core.on_reduce(m.src, m.chunk, m.round, m.count,
                                    m.payload.data(), m.payload.size());
+                }
                 break;
             }
             case kPing:
@@ -375,9 +410,15 @@ struct RemoteWorker {
             if (it == addr_of_conn.end()) continue;
             Addr a = it->second;
             addr_of_conn.erase(it);
-            auto cit = conn_of.find(a);
-            if (cit != conn_of.end() && cit->second == c)
-                conn_of.erase(cit);
+            // sweep EVERY conn_of entry riding this conn, aliases
+            // included: the master's advertised addr is aliased onto
+            // the dialed conn (on_init), and a stale alias surviving a
+            // failover would silently swallow the new epoch's
+            // CompleteAllreduce sends
+            for (auto cit = conn_of.begin(); cit != conn_of.end();) {
+                if (cit->second == c) cit = conn_of.erase(cit);
+                else ++cit;
+            }
             if ((master_known && a == master_addr)
                 || a == dialed_master) {
                 master_gone = true;  // master death = shutdown
@@ -412,54 +453,113 @@ struct RemoteWorker {
         }
     }
 
-    long run(const char* master_host, int master_port, double timeout_s) {
+    // ONE bounded recv loop serving both the run loop and the rejoin
+    // gap (a hand-maintained second copy would drift): hands each frame
+    // to `handle`, returns whether anything arrived
+    template <typename F>
+    bool recv_burst(std::vector<uint8_t>& buf, F&& handle) {
+        bool any = false;
+        for (int burst = 0; burst < 512; ++burst) {
+            int64_t need = aat_recv_len(tp);
+            if (need < 0) break;
+            if ((size_t)need > buf.size()) buf.resize(need * 2);
+            int src = -1;
+            int64_t got = aat_recv_take(tp, buf.data(), buf.size(), &src);
+            if (got < 0) break;
+            handle(buf.data(), (size_t)got, src);
+            any = true;
+        }
+        return any;
+    }
+
+    // drain-and-drop during the rejoin gap: stale frames queued in the
+    // transport must not survive into the new epoch (only peer Hellos
+    // keep their conn mapping current)
+    void drain_discard(std::vector<uint8_t>& buf) {
+        recv_burst(buf, [&](const uint8_t* d, size_t len, int src) {
+            size_t off = 0;
+            uint8_t mtype;
+            if (rd(d, len, off, &mtype) && mtype == kHello)
+                dispatch(d, len, src);
+        });
+        drain_disconnects();
+    }
+
+    void reset_epoch() {
+        core = aat::WorkerCore<RemoteWorker>();
+        peers.clear();
+        self_q.clear();
+        master_known = false;
+        master_gone = false;
+        dialed_master = Addr{};
+        generation += 1;
+        discarding = true;
+    }
+
+    // cycle the seed list until one master admits us (any seed admits a
+    // joiner — the reference's seed-node semantics)
+    bool dial_any(double give_up, std::vector<uint8_t>& buf) {
+        for (;;) {
+            for (const auto& s : seeds) {
+                int c = aat_connect(tp, s.host.c_str(),
+                                    static_cast<int>(s.port), 2000);
+                if (c >= 0) {
+                    dialed_master = s;
+                    master_addr = s;
+                    master_known = true;
+                    conn_of[s] = c;
+                    addr_of_conn[c] = s;
+                    auto hello = enc_hello(self, "worker");
+                    aat_send(tp, c, hello.data(), hello.size());
+                    discarding = false;  // joined: new-epoch traffic now
+                    return true;
+                }
+            }
+            if (now_s() >= give_up) return false;
+            drain_discard(buf);
+            usleep(200000);
+        }
+    }
+
+    long run(double timeout_s) {
         tp = aat_create("127.0.0.1", 0);
         if (!tp) return -3;
         self.host = "127.0.0.1";
         self.port = static_cast<uint32_t>(aat_port(tp));
-        dialed_master.host = master_host;
-        dialed_master.port = static_cast<uint32_t>(master_port);
-        master_addr = dialed_master;  // until InitWorkers advertises one
-        master_known = true;
-        // join-retry: the master may not be listening yet (seed-node
-        // join retries, protocol/remote.py run_worker)
-        double join_deadline = now_s() + timeout_s;
-        for (;;) {
-            int c = aat_connect(tp, master_host, master_port, 2000);
-            if (c >= 0) {
-                conn_of[dialed_master] = c;
-                addr_of_conn[c] = dialed_master;
-                auto hello = enc_hello(self, "worker");
-                aat_send(tp, c, hello.data(), hello.size());
-                break;
-            }
-            if (now_s() >= join_deadline) { aat_destroy(tp); return -3; }
-            usleep(200000);
-        }
         std::vector<uint8_t> buf(1 << 20);
         double deadline = now_s() + timeout_s;
-        while (!master_gone && !failed && now_s() < deadline) {
-            drain_self_q();
-            bool any = false;
-            // BOUNDED drain (see remote_master.cpp): an until-empty
-            // loop under sustained traffic starves the disconnect
-            // sweep and the outbound heartbeat — the master's failure
-            // detector would then falsely down a flooded-but-healthy
-            // worker, and a dead master would go unnoticed
-            for (int burst = 0; burst < 512; ++burst) {
-                int64_t need = aat_recv_len(tp);
-                if (need < 0) break;
-                if ((size_t)need > buf.size()) buf.resize(need * 2);
-                int src = -1;
-                int64_t got = aat_recv_take(tp, buf.data(), buf.size(),
-                                            &src);
-                if (got < 0) break;
-                dispatch(buf.data(), (size_t)got, src);
-                any = true;
+        // join-retry: the master may not be listening yet (seed-node
+        // join retries, protocol/remote.py run_worker)
+        if (!dial_any(deadline, buf)) { aat_destroy(tp); return -3; }
+        for (;;) {
+            while (!master_gone && !failed && now_s() < deadline) {
+                drain_self_q();
+                // BOUNDED drain (see remote_master.cpp): an until-empty
+                // loop under sustained traffic starves the disconnect
+                // sweep and the outbound heartbeat — the master's
+                // failure detector would then falsely down a flooded-
+                // but-healthy worker, and a dead master go unnoticed
+                bool any = recv_burst(
+                    buf, [&](const uint8_t* d, size_t len, int src) {
+                        dispatch(d, len, src);
+                    });
+                drain_disconnects();
+                heartbeat();
+                if (!any && self_q.empty()) usleep(200);
             }
-            drain_disconnects();
-            heartbeat();
-            if (!any && self_q.empty()) usleep(200);
+            if (master_gone && rejoin_timeout > 0 && !failed
+                && now_s() < deadline) {
+                // master epoch ended: cold-reset and rejoin through the
+                // seeds (a restarted master reforms the cluster)
+                if (verbose)
+                    std::fprintf(stderr, "native worker: master gone, "
+                                 "redialing %zu seed(s)\n", seeds.size());
+                reset_epoch();
+                double window = now_s() + rejoin_timeout;
+                if (window > deadline) window = deadline;
+                if (dial_any(window, buf)) continue;
+            }
+            break;
         }
         long rc = failed ? -1 : outputs_flushed;
         aat_destroy(tp);
@@ -471,22 +571,62 @@ struct RemoteWorker {
 
 extern "C" {
 
-// Join the master at master_host:master_port as a native worker engine
-// over the C++ TCP transport; run until the master disconnects (normal
-// shutdown), the sink assertion fails, or timeout. Returns outputs
-// flushed (>= 0), -1 on assertion failure, -3 when the master was
-// never reachable.
+// Join a master from the seed list (comma-separated "host:port" pairs;
+// any seed admits a joiner) as a native worker engine over the C++ TCP
+// transport; run until the master disconnects (normal shutdown — or,
+// with rejoin_timeout_s > 0, cold-reset and redial through the seeds:
+// master-restart failover, engine parity with protocol/remote.py
+// run_worker), the sink assertion fails, or timeout. Returns outputs
+// flushed (>= 0), -1 on assertion failure, -3 when no master was ever
+// reachable, -2 on a bad seed list.
+long aat_remote_worker_run_seeds(const char* seeds_csv, int checkpoint,
+                                 int assert_multiple, double timeout_s,
+                                 double rejoin_timeout_s,
+                                 double hb_interval_s, int verbose) {
+    if (!seeds_csv || timeout_s <= 0) return -2;
+    RemoteWorker w;
+    std::string csv(seeds_csv);
+    size_t pos = 0;
+    while (pos <= csv.size()) {
+        size_t comma = csv.find(',', pos);
+        std::string entry = csv.substr(
+            pos, comma == std::string::npos ? std::string::npos
+                                            : comma - pos);
+        if (!entry.empty()) {
+            size_t colon = entry.rfind(':');
+            if (colon == std::string::npos || colon + 1 >= entry.size())
+                return -2;
+            Addr a;
+            a.host = entry.substr(0, colon);
+            long p = std::strtol(entry.c_str() + colon + 1, nullptr, 10);
+            if (p <= 0 || p > 65535 || a.host.empty()) return -2;
+            a.port = static_cast<uint32_t>(p);
+            w.seeds.push_back(std::move(a));
+        }
+        if (comma == std::string::npos) break;
+        pos = comma + 1;
+    }
+    if (w.seeds.empty()) return -2;
+    w.checkpoint = checkpoint;
+    w.assert_multiple = assert_multiple;
+    w.rejoin_timeout = rejoin_timeout_s > 0 ? rejoin_timeout_s : 0.0;
+    w.hb_interval = hb_interval_s > 0 ? hb_interval_s : 2.0;
+    w.verbose = verbose;
+    return w.run(timeout_s);
+}
+
+// Single-seed compatibility entry (no failover).
 long aat_remote_worker_run(const char* master_host, int master_port,
                            int checkpoint, int assert_multiple,
                            double timeout_s, double hb_interval_s,
                            int verbose) {
-    if (master_port <= 0 || timeout_s <= 0) return -3;
-    RemoteWorker w;
-    w.checkpoint = checkpoint;
-    w.assert_multiple = assert_multiple;
-    w.hb_interval = hb_interval_s > 0 ? hb_interval_s : 2.0;
-    w.verbose = verbose;
-    return w.run(master_host, master_port, timeout_s);
+    if (!master_host || master_port <= 0) return -3;
+    std::string csv = std::string(master_host) + ":"
+        + std::to_string(master_port);
+    long rc = aat_remote_worker_run_seeds(
+        csv.c_str(), checkpoint, assert_multiple, timeout_s, 0.0,
+        hb_interval_s, verbose);
+    return rc == -2 ? -3 : rc;
 }
 
 }  // extern "C"

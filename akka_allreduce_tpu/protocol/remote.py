@@ -176,7 +176,9 @@ def run_worker_native(master_host: str = "127.0.0.1",
                       master_port: int = 2551, checkpoint: int = 10,
                       assert_multiple: int = 0, timeout_s: float = 120.0,
                       verbose: bool = False,
-                      heartbeat_interval_s: float = 2.0) -> int:
+                      heartbeat_interval_s: float = 2.0,
+                      seeds: Optional[list] = None,
+                      rejoin_timeout_s: float = 0.0) -> int:
     """The C++ worker engine across process boundaries: protocol engine,
     buffers, wire codec AND transport all native (native/src/
     remote_worker.cpp) — the deployment shape of the reference's JVM
@@ -186,22 +188,32 @@ def run_worker_native(master_host: str = "127.0.0.1",
     native workers can serve one cluster interchangeably. Returns
     outputs flushed; raises on assertion failure or unreachable master.
 
+    ``seeds`` / ``rejoin_timeout_s`` mirror :func:`run_worker`'s
+    multi-seed failover IN THE C++ ENGINE: any seed admits the joiner,
+    and with a rejoin window a master disconnect cold-resets the engine
+    (epoch fence included) and redials through the list.
+
     The source geometry comes entirely from the master's ``InitWorkers``
     (the synthetic arange source is a pure function of ``data_size``),
     so there is no ``source_data_size`` parameter to keep in sync."""
     from akka_allreduce_tpu.native import load_library
 
     lib = load_library()
-    rc = lib.aat_remote_worker_run(
-        master_host.encode(), master_port, checkpoint, assert_multiple,
-        timeout_s, heartbeat_interval_s, 1 if verbose else 0)
+    seed_list = [tuple(s) for s in (seeds or
+                                    [(master_host, master_port)])]
+    csv = ",".join(f"{h}:{p}" for h, p in seed_list)
+    rc = lib.aat_remote_worker_run_seeds(
+        csv.encode(), checkpoint, assert_multiple, timeout_s,
+        rejoin_timeout_s, heartbeat_interval_s, 1 if verbose else 0)
     if rc == -1:
         raise AssertionError(
             "native worker: output != N x input (sink assertion)")
+    if rc == -2:
+        raise ValueError(f"native worker: bad seed list {csv!r}")
     if rc == -3:
         raise ConnectionError(
-            f"native worker: master at {master_host}:{master_port} "
-            f"unreachable within {timeout_s}s")
+            f"native worker: no master reachable among {seed_list} "
+            f"within {timeout_s}s")
     return int(rc)
 
 
