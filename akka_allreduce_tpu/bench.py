@@ -86,7 +86,9 @@ def measure_device_goodput(elems: int, bucket_elems: int,
                            r_hi: int = R_HI, r_lo: int = R_LO,
                            valid_fraction: float = 1.0,
                            reps: int = 3, return_stats: bool = False,
-                           transport: str = "f32"):
+                           transport: str = "f32",
+                           transport_schedule: str = "fused",
+                           num_windows: int = 1):
     """Goodput (payload GB/s) of the full device sync path on all available
     real devices. ``valid_fraction < 1`` exercises the lossy masked path
     (BASELINE.md config #4): that fraction of buckets contributes per round
@@ -96,7 +98,13 @@ def measure_device_goodput(elems: int, bucket_elems: int,
     distribution across reps (median/min/max ms) alongside the headline
     GB/s — the stable way to report SMALL payloads, whose per-round time
     (~0.02 ms at 1M floats) sits below the relay's run-to-run jitter when
-    expressed as bandwidth (round-2 verdict, weak #2)."""
+    expressed as bandwidth (round-2 verdict, weak #2).
+
+    ``transport_schedule="windowed"`` + ``num_windows`` route the sync
+    through the software-pipelined schedule (ops/collectives.
+    pipelined_two_phase_allreduce) — the ``ab_overlap`` A/B's windowed
+    arm. ``bucket_elems`` must then be divisible by the device count
+    (the two-phase geometry)."""
     if transport not in ("f32", "bf16"):
         # int8 needs a per-round quant key this harness does not thread;
         # its wire has dedicated A/B rows (bench_suite ab_pallas_vs_xla).
@@ -116,7 +124,9 @@ def measure_device_goodput(elems: int, bucket_elems: int,
     lossy = valid_fraction < 1.0
     cfg = GradSyncConfig(bucket_elems=bucket_elems, average=True,
                          rescale_target=float(n) if lossy else 1.0,
-                         return_elem_counts=False, transport=transport)
+                         return_elem_counts=False, transport=transport,
+                         transport_schedule=transport_schedule,
+                         num_windows=num_windows)
     base_valid = None
     if lossy:
         n_valid = max(1, int(round(valid_fraction * num_buckets)))
@@ -216,6 +226,145 @@ def measure_device_goodput(elems: int, bucket_elems: int,
         "per_round_ms_max": deltas[-1] * 1e3,
         "reps": reps,
     }
+
+
+AB_OVERLAP_WINDOWS = (1, 2, 4, 8)
+# canonical A/B payloads: the small (2.5M float, 10 MB) and the
+# ResNet-50-sized (25M float, 100 MB) rows, bucketed lane-aligned AND
+# power-of-two-divisible so every window count in AB_OVERLAP_WINDOWS and
+# every power-of-two device count satisfies the two-phase geometry
+AB_OVERLAP_PAYLOADS = ((2_500_000, 327_680),
+                       (25_000_000, BUCKET_ELEMS_ALIGNED))
+
+
+def measure_ab_overlap(windows=AB_OVERLAP_WINDOWS,
+                       payloads=AB_OVERLAP_PAYLOADS,
+                       r_hi: Optional[int] = None,
+                       r_lo: Optional[int] = None,
+                       reps: Optional[int] = None,
+                       flags_live: Optional[bool] = None):
+    """Fused vs windowed schedule A/B: the measurement behind
+    ``GradSyncConfig.transport_schedule``. YIELDS one JSON-able row per
+    (payload, schedule) config as each measurement completes — fused
+    (monolithic psum) first, then the windowed pipeline at each W — in
+    the single-line format the BENCH_r*.json harness parses. A generator
+    so callers print/bank each row immediately: the harness's primary
+    failure mode is its watchdog SIGKILL mid-suite, which a materialized
+    list would turn into zero banked rows after ~19 min of good
+    measurements.
+
+    Only meaningful with the latency-hiding flags installed
+    (runtime/xla_flags.py) on a multi-chip TPU mesh; elsewhere the rows
+    still bank honestly with the degradation named in the note (n=1
+    bypasses the schedule entirely; CPU serializes it).
+
+    ``flags_live=False`` tells the note the LIBTPU_INIT_ARGS flags were
+    installed AFTER the backend initialized (libtpu reads the variable
+    once at load, so they are not in effect) — only the caller can know
+    that; the env alone cannot distinguish stale from live. ``None``
+    infers from the env, correct whenever this process started with the
+    flags already set (the capture harness's fresh-subprocess path)."""
+    _log("ab_overlap: initializing backend ...")
+    devices = jax.devices()
+    n = len(devices)
+    plat = devices[0].platform
+    label = "chip" if plat == "tpu" else plat
+    on_tpu = plat == "tpu"
+    if r_hi is None and r_lo is None:
+        r_hi, r_lo = (R_HI, R_LO) if on_tpu else (12, 4)
+    elif r_hi is None:
+        # r_lo alone was overridden: keep it, and keep the two-point
+        # span valid around the platform default high point
+        r_hi = max(R_HI if on_tpu else 12, 2 * r_lo)
+    elif r_lo is None:
+        # only r_hi was overridden: keep the default ~4:1 two-point span
+        r_lo = max(1, r_hi // 4)
+    if not on_tpu:
+        # CPU keeps the path exercised without burning the budget on a
+        # perf claim the platform cannot make (payloads are not an
+        # operator knob; reps shrink only when left to default)
+        payloads = payloads[:1]
+    if reps is None:
+        reps = 3 if on_tpu else 2
+    flags_note = ""
+    if on_tpu:
+        # the flag's VALUE decides, not its presence: an operator opt-out
+        # (...=false, preserved by install_overlap_flags by design) must
+        # not read as the scheduler being live — the helper owns the
+        # flag name and absl's bool-spelling rule in one place
+        from akka_allreduce_tpu.runtime.xla_flags import (
+            latency_hiding_scheduler_requested)
+        present = latency_hiding_scheduler_requested()
+        if present and flags_live is not False:
+            flags_note = "; latency-hiding flags in LIBTPU_INIT_ARGS"
+        elif present:
+            # set in the env, but after libtpu read it: the banked rows
+            # must not claim a scheduler that never ran
+            flags_note = ("; latency-hiding flags in LIBTPU_INIT_ARGS "
+                          "but installed AFTER backend init — NOT live; "
+                          "windowed can only tie fused")
+        else:
+            flags_note = ("; latency-hiding flags NOT live in "
+                          "LIBTPU_INIT_ARGS — windowed can only tie "
+                          "fused")
+    # with one device there are no live axes: the 'windowed' arm runs
+    # the IDENTICAL fused path (dp.py's size-1 bypass), so every row —
+    # not just the fused one — must say its deltas are pure jitter
+    ident = ("; 1-device: schedule identity — windowed IS the fused "
+             "path, deltas are jitter" if n == 1 else "")
+    for elems, bucket in payloads:
+        mega = f"{elems / 1_000_000:g}"
+        try:
+            base = measure_device_goodput(elems, bucket, r_hi=r_hi,
+                                          r_lo=r_lo, reps=reps)
+        except Exception as e:  # noqa: BLE001 — bank the failure, move on
+            # one jitter-killed payload must not discard the other
+            # payload's rows (the 2.5M row is exactly the size the
+            # two-point timing documents as jitter-prone)
+            yield {"metric": f"ab_overlap_fused_{mega}M_{n}{label}",
+                   "value": 0.0, "unit": "GB/s",
+                   "error": f"{type(e).__name__}: {e}"}
+            continue
+        yield {"metric": f"ab_overlap_fused_{mega}M_{n}{label}",
+               "value": round(base, 3), "unit": "GB/s",
+               "note": f"fused psum, buckets of {bucket}"
+                       + ident + flags_note}
+        if bucket % max(n, 1):
+            yield {
+                "metric": f"ab_overlap_windowed_{mega}M_{n}{label}",
+                "value": 0.0, "unit": "GB/s",
+                "error": f"bucket_elems {bucket} not divisible by "
+                         f"{n} devices: two-phase geometry unsatisfied; "
+                         f"no windowed rows"}
+            continue
+        best_w, best_g = None, 0.0
+        for w in windows:
+            try:
+                g = measure_device_goodput(elems, bucket, r_hi=r_hi,
+                                           r_lo=r_lo, reps=reps,
+                                           transport_schedule="windowed",
+                                           num_windows=w)
+            except Exception as e:  # noqa: BLE001 — keep the other rows
+                yield {
+                    "metric":
+                        f"ab_overlap_windowed_w{w}_{mega}M_{n}{label}",
+                    "value": 0.0, "unit": "GB/s",
+                    "error": f"{type(e).__name__}: {e}"}
+                continue
+            if g > best_g:
+                best_w, best_g = w, g
+            yield {
+                "metric": f"ab_overlap_windowed_w{w}_{mega}M_{n}{label}",
+                "value": round(g, 3), "unit": "GB/s",
+                "note": f"pipelined two-phase, {w} windows, buckets of "
+                        f"{bucket}" + ident + flags_note}
+        if best_w is not None:
+            yield {
+                "metric": f"ab_overlap_best_{mega}M_{n}{label}",
+                "value": round(best_g, 3), "unit": "GB/s",
+                "note": f"best windowed W={best_w}: {best_g / base:.3f}x "
+                        f"the fused psum ({base:.2f} GB/s)" + ident
+                        + flags_note}
 
 
 def measure_train_mfu(compute_dtype: str = "bf16",
@@ -375,6 +524,10 @@ def main() -> None:
       AATPU_BENCH_ELEMS / AATPU_BENCH_BUCKET_ELEMS / AATPU_BENCH_TRANSPORT
       (f32|bf16 collective wire) / AATPU_BENCH_R_HI /
       AATPU_BENCH_R_LO / AATPU_BENCH_REPS  measurement sizing.
+      AATPU_BENCH_AB_OVERLAP=1  also emit the fused-vs-windowed
+                            ``ab_overlap`` rows (measure_ab_overlap, one
+                            JSON line each) before the headline — the
+                            headline stays the last line for the driver.
     """
     platform = os.environ.get("AATPU_BENCH_PLATFORM", "default")
     if platform == "cpu":
@@ -407,6 +560,32 @@ def main() -> None:
     # single-shot min-based captures spread 305-341 GB/s across rounds
     # with no way to tell jitter from regression
     stats_mode = os.environ.get("AATPU_BENCH_STATS") == "1"
+    if os.environ.get("AATPU_BENCH_AB_OVERLAP") == "1":
+        # fused-vs-windowed A/B rows, one JSON line each, BEFORE the
+        # headline: the driver's parser takes the LAST line, so the
+        # headline metric name/position stay the contract. The A/B
+        # honors the same sizing knobs as the headline when the operator
+        # set them (≈10 extra goodput measurements ride inside the
+        # driver's per-attempt watchdog — the knobs are how a tight
+        # budget shrinks them); unset, measure_ab_overlap keeps its
+        # per-platform defaults
+        ab_kw = {}
+        if "AATPU_BENCH_R_HI" in os.environ:
+            ab_kw["r_hi"] = r_hi
+        if "AATPU_BENCH_R_LO" in os.environ:
+            ab_kw["r_lo"] = r_lo
+        if "AATPU_BENCH_REPS" in os.environ:
+            ab_kw["reps"] = reps
+        try:
+            for row in measure_ab_overlap(**ab_kw):
+                print(json.dumps(row), flush=True)
+        except Exception as e:  # noqa: BLE001 — headline must still land
+            # the headline row is the driver contract ("a JSON line lands
+            # no matter what the backend does"); a jittery A/B measurement
+            # must not abort the process before it prints
+            print(json.dumps({
+                "metric": "ab_overlap_error", "value": 0.0, "unit": "GB/s",
+                "error": f"{type(e).__name__}: {e}"}), flush=True)
     res = measure_device_goodput(elems, bucket_elems,
                                  r_hi=r_hi, r_lo=r_lo, reps=reps,
                                  transport=transport,

@@ -513,6 +513,31 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "quantizer state, works over any axis "
                         "combination (int8 needs a single data axis); "
                         "masters/optimizer stay f32")
+    p.add_argument("--grad-schedule", choices=("fused", "windowed"),
+                   default="fused",
+                   help="gradient-collective schedule: fused (one "
+                        "monolithic collective per sync) or windowed "
+                        "(bucket axis split into --grad-windows windows "
+                        "issued on the software-pipelined schedule of "
+                        "ops/collectives.pipelined_two_phase_allreduce "
+                        "so one window's all-gather overlaps the next's "
+                        "reduce-scatter; pair with --xla-overlap on "
+                        "TPU). Needs a single >1 data axis; f32/bf16 "
+                        "wires need --bucket-elems divisible by its "
+                        "size")
+    p.add_argument("--grad-windows", type=int, default=4, metavar="W",
+                   help="window count for --grad-schedule windowed "
+                        "(the bucket axis pads to a multiple of W)")
+    p.add_argument("--accum-schedule", choices=("deferred", "overlap"),
+                   default="deferred",
+                   help="with --grad-accum K > 1: deferred = one sync "
+                        "after the microbatch scan (fewest collectives, "
+                        "fully serialized); overlap = sync each "
+                        "microbatch's grads as produced, double-buffered "
+                        "through the scan carry so microbatch k's wire "
+                        "time hides behind microbatch k+1's compute "
+                        "(pair with --xla-overlap on TPU; losses match "
+                        "deferred to f32 summation order)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialise activations per block (long-context"
                         " memory saver)")
@@ -640,12 +665,52 @@ def _add_backend_args(p: argparse.ArgumentParser) -> None:
                         "20-40s; a warmed cache makes restarts, elastic "
                         "rejoins, and preemption resumes start in "
                         "seconds)")
+    p.add_argument("--xla-overlap", action="store_true",
+                   help="install XLA's latency-hiding-scheduler / "
+                        "async-collective flags into LIBTPU_INIT_ARGS "
+                        "before backend init (runtime/xla_flags.py) — "
+                        "what lets --grad-schedule windowed and "
+                        "--accum-schedule overlap actually hide wire "
+                        "time behind compute on TPU (no-op off-TPU; "
+                        "flags already set in the env are never "
+                        "overridden)")
+    p.add_argument("--xla-overlap-mem-pct", type=int, default=0,
+                   metavar="PCT",
+                   help="with --xla-overlap: cap the scheduler's extra "
+                        "live-range memory at PCT%% (overlap "
+                        "double-buffers cost HBM; lower this if an "
+                        "overlapped program OOMs where the serial one "
+                        "fit). 0 = scheduler default")
 
 
 def _apply_backend_flags(args: argparse.Namespace) -> None:
-    """--platform / --compile-cache must land before any backend
-    initializes (site customization overrides the env var on some
+    """--platform / --compile-cache / --xla-overlap must land before any
+    backend initializes (site customization overrides the env var on some
     hosts — the reason these are flags, not env documentation)."""
+    pct = getattr(args, "xla_overlap_mem_pct", 0)
+    if not 0 <= pct <= 100:
+        # range first, dependency second: one failed invocation reports
+        # the deepest problem, not a two-step error chase
+        print(f"error: --xla-overlap-mem-pct must be in [0, 100] "
+              f"(0 = scheduler default), got {pct}", file=sys.stderr)
+        raise SystemExit(2)
+    if pct and not getattr(args, "xla_overlap", False):
+        # silently accepting the cap with no scheduler to cap would let
+        # the operator believe an HBM bound is in effect
+        print("error: --xla-overlap-mem-pct only takes effect with "
+              "--xla-overlap (it bounds the latency-hiding scheduler "
+              "that flag turns on)", file=sys.stderr)
+        raise SystemExit(2)
+    if getattr(args, "xla_overlap", False):
+        # env merge first — LIBTPU_INIT_ARGS is read once at libtpu load,
+        # which the jax import below can trigger
+        from akka_allreduce_tpu.runtime.xla_flags import (
+            install_overlap_flags)
+        added = install_overlap_flags(scheduler_mem_limit_pct=pct or None)
+        if added:
+            print(f"xla-overlap: +{len(added)} LIBTPU_INIT_ARGS flags "
+                  f"(latency-hiding scheduler + async collectives)",
+                  file=sys.stderr)
     import jax
 
     if getattr(args, "platform", None):
@@ -961,6 +1026,27 @@ def _cmd_generate(args: argparse.Namespace) -> int:
     return 0
 
 
+def _two_phase_geometry_error(feature: str, data_axes: dict,
+                              bucket_elems: int, remedy: str,
+                              check_divisibility: bool = True,
+                              wire: str = "") -> "str | None":
+    """Validate the two-phase (reduce-scatter + all-gather) collective
+    geometry a train flag demands: exactly one >1 data axis and, when
+    ``check_divisibility`` (the wire scatters bucket rows directly), a
+    bucket length that axis's size divides. Returns the error message to
+    print, or None when the geometry holds."""
+    wide = [f"{k}={v}" for k, v in data_axes.items() if v > 1]
+    if len(wide) > 1:
+        return (f"{feature} needs a single >1 data axis, got "
+                f"{' '.join(wide)}; {remedy}")
+    axis_size = max(data_axes.values())
+    if check_divisibility and axis_size > 1 and bucket_elems % axis_size:
+        return (f"{feature}{f' with a {wire} wire' if wire else ''} needs "
+                f"--bucket-elems divisible by the data-axis size "
+                f"{axis_size}, got {bucket_elems}")
+    return None
+
+
 def _cmd_train(args: argparse.Namespace) -> int:
     import jax
     import jax.numpy as jnp
@@ -1024,22 +1110,34 @@ def _cmd_train(args: argparse.Namespace) -> int:
         return 2
     grad_wire = ("int8" if args.int8_grads
                  else "bf16" if args.bf16_grads else "f32")
+    # fail at the flag layer with the mesh math spelled out, not deep
+    # inside shard_map tracing: both the int8 transport and the windowed
+    # schedule run the two-phase (reduce-scatter + all-gather) geometry —
+    # exactly one >1 data axis and, when the wire scatters bucket rows,
+    # a bucket length its size divides (parallel/dp.py, ops/collectives.py)
+    data_axes = {"dp": dp, "sp": args.sp, "ep": args.ep}
     if args.int8_grads:
-        # fail at the flag layer, not deep inside shard_map tracing: the
-        # int8 transport needs exactly one >1 data axis whose size divides
-        # the bucket length (parallel/dp.py, ops/collectives.py)
-        data_axes = {"dp": dp, "sp": args.sp, "ep": args.ep}
-        wide = [f"{k}={v}" for k, v in data_axes.items() if v > 1]
-        if len(wide) > 1:
-            print(f"error: --int8-grads needs a single >1 data axis, got "
-                  f"{' '.join(wide)}; use f32 transport or fold the "
-                  f"parallelism into dp", file=sys.stderr)
+        err = _two_phase_geometry_error(
+            "--int8-grads", data_axes, args.bucket_elems,
+            remedy="use f32 transport or fold the parallelism into dp")
+        if err:
+            print(f"error: {err}", file=sys.stderr)
             return 2
-        axis_size = max(data_axes.values())
-        if axis_size > 1 and args.bucket_elems % axis_size:
-            print(f"error: --int8-grads needs --bucket-elems divisible by "
-                  f"the data-axis size {axis_size}, got "
-                  f"{args.bucket_elems}", file=sys.stderr)
+    if args.grad_windows < 1:
+        print(f"error: --grad-windows must be >= 1, got "
+              f"{args.grad_windows}", file=sys.stderr)
+        return 2
+    if args.grad_schedule == "windowed":
+        err = _two_phase_geometry_error(
+            "--grad-schedule windowed", data_axes, args.bucket_elems,
+            remedy="fold the parallelism into dp or use "
+                   "--grad-schedule fused",
+            # the int8 wire pads its own rows; only f32/bf16 scatter
+            # bucket rows directly and need the divisibility
+            check_divisibility=grad_wire != "int8",
+            wire=grad_wire)
+        if err:
+            print(f"error: {err}", file=sys.stderr)
             return 2
     if args.straggle_prob and not args.deadline_ms:
         print("error: --straggle-prob needs --deadline-ms",
@@ -1134,6 +1232,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                       optimizer=args.optimizer,
                       sgd_momentum=args.sgd_momentum,
                       grad_accum=args.grad_accum,
+                      accum_schedule=args.accum_schedule,
+                      transport_schedule=args.grad_schedule,
+                      num_windows=args.grad_windows,
                       ema_decay=args.ema_decay)
     if args.pp > 1 and chatty:
         from akka_allreduce_tpu.parallel.pp import pp_schedule_stats

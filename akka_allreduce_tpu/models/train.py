@@ -91,6 +91,16 @@ class TrainConfig:
     # bytes, plain rounding, any axis combination); or "int8" (quantized
     # two-phase allreduce — needs exactly one data axis of size > 1)
     grad_transport: str = "f32"
+    # Collective schedule for the gradient sync (GradSyncConfig.
+    # transport_schedule): "fused" issues one monolithic collective per
+    # sync; "windowed" splits the bucket axis into num_windows windows
+    # and software-pipelines them (ops/collectives.
+    # pipelined_two_phase_allreduce) so one window's all-gather overlaps
+    # the next's reduce-scatter under XLA's latency-hiding scheduler
+    # (runtime/xla_flags.py). Windowed needs a single (>1) data axis and,
+    # for f32/bf16 wires, bucket_elems divisible by its size.
+    transport_schedule: str = "fused"
+    num_windows: int = 4
     # "bf16" runs the model compute (matmuls, activations) in bfloat16 on
     # the MXU while master weights, gradients, and the optimizer stay f32
     # (loss/softmax/norm statistics are f32 internally regardless); "f32"
@@ -139,6 +149,24 @@ class TrainConfig:
     # same as the pp path's). pp > 1 has its own microbatching — the two
     # do not compose.
     grad_accum: int = 1
+    # How the accumulated gradients meet the collective (grad_accum > 1
+    # only): "deferred" is the shape above — one sync after the scan, the
+    # cheapest in collective count but fully serialized (all compute,
+    # THEN all wire). "overlap" syncs each microbatch's gradients as they
+    # are produced and double-buffers the in-flight reduced buckets
+    # through the scan carry: microbatch k's collective is issued at the
+    # end of scan tick k and its result is not consumed until tick k+1,
+    # so the wire time hides behind the next microbatch's entire
+    # forward+backward (XLA's collective pipeliner + latency-hiding
+    # scheduler, runtime/xla_flags.py — the classic DDP bucketed-overlap
+    # shape rendered as a scan). Pays K collectives, each 1/1-sized but
+    # overlappable; gradients equal the deferred path's up to f32
+    # summation order (sum-of-psums vs psum-of-sums), and losses are
+    # step-for-step identical within float tolerance — pinned by
+    # tests/test_accum_overlap.py. Composes with transport_schedule
+    # ("windowed" pipelines each microbatch's sync internally too) and
+    # every wire format (int8 draws per-microbatch rounding keys).
+    accum_schedule: str = "deferred"
     # Polyak/EMA weight averaging: > 0 keeps an exponential moving
     # average of the POST-update params in the optimizer chain's state
     # (ema = d*ema + (1-d)*params each step) — the eval/serving weights
@@ -608,12 +636,16 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                           axis_name=dense_axes, average=True,
                           rescale_target=float(n_dense_ranks),
                           return_elem_counts=False,
-                          transport=cfg.grad_transport)
+                          transport=cfg.grad_transport,
+                          transport_schedule=cfg.transport_schedule,
+                          num_windows=cfg.num_windows)
     gcfg_expert = GradSyncConfig(bucket_elems=cfg.bucket_elems,
                                  axis_name=cfg.grad_axes, average=True,
                                  rescale_target=float(n_expert_ranks),
                                  return_elem_counts=False,
-                                 transport=cfg.grad_transport)
+                                 transport=cfg.grad_transport,
+                                 transport_schedule=cfg.transport_schedule,
+                                 num_windows=cfg.num_windows)
 
     def targets_and_weights(tokens):
         """Per-token next-token targets and loss weights; under sp the
@@ -674,8 +706,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             return None  # only the int8 wire rounds stochastically
         return jax.random.fold_in(jax.random.key(17), quant_seed)
 
-    def sync_and_metrics(loss, aux, grads, total_count, quant_key,
-                         valid=None):
+    def sync_grads(grads, quant_key, valid=None):
         # Gradient sync over the data axes: the framework's bucketed,
         # counted collective — THE allreduce the reference exists for.
         # Gradients for tp shards need no sync (tp_grad_boundary completed
@@ -714,7 +745,10 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                                       quant_key=k_dense)
             grads_out = res.grads
             min_count = res.bucket_counts.min()
-        metrics = {
+        return grads_out, min_count
+
+    def make_metrics(loss, aux, total_count, min_count):
+        return {
             "loss": psum_all(loss, metric_axes),
             "tokens": total_count,
             "min_bucket_count": min_count,
@@ -723,7 +757,11 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             "dispatch_fraction": psum_all(aux["dispatch_fraction"],
                                           metric_axes) / disp_norm,
         }
-        return grads_out, metrics
+
+    def sync_and_metrics(loss, aux, grads, total_count, quant_key,
+                         valid=None):
+        grads_out, min_count = sync_grads(grads, quant_key, valid=valid)
+        return grads_out, make_metrics(loss, aux, total_count, min_count)
 
     accum = cfg.grad_accum
     if accum < 1:
@@ -732,6 +770,11 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         raise ValueError(
             "grad_accum > 1 does not compose with pp > 1 — the pipeline "
             "path has its own microbatching (cfg.microbatches)")
+    if cfg.accum_schedule not in ("deferred", "overlap"):
+        raise ValueError(
+            f"unknown accum_schedule {cfg.accum_schedule!r}: 'deferred' "
+            f"(one sync after the microbatch scan) or 'overlap' "
+            f"(per-microbatch syncs double-buffered through the carry)")
 
     def grad_local(params, tokens, quant_seed, valid=None):
         targets, weights, positions = targets_and_weights(tokens)
@@ -772,6 +815,51 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                 mb_value_and_grad, tok_m[0], tgt_m[0], w_m[0])
             zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                                  (l_s, aux_s, g_s))
+
+            if cfg.accum_schedule == "overlap":
+                # Comm-compute overlap: each microbatch's gradients are
+                # synced AS PRODUCED, and the in-flight collective result
+                # rides the carry one tick before being folded in — the
+                # add that consumes tick k's collective sits in tick k+1,
+                # so a whole microbatch of forward+backward stands
+                # between issue and use. XLA's collective pipeliner /
+                # latency-hiding scheduler (runtime/xla_flags.py) can
+                # then hoist the collective across the loop boundary and
+                # run it concurrently with the next microbatch's compute
+                # — the classic DDP bucketed-overlap shape as a scan.
+                # The sum of per-microbatch syncs equals the deferred
+                # path's single sync of the summed grads: the sync is
+                # linear in its payload (psum / two-phase; the masked
+                # rescale factor is identical every tick because the
+                # valid mask is per-ROUND), so only f32 summation order
+                # differs. Costs one extra grads-sized carry (the
+                # double buffer) and K collectives instead of 1.
+                quant_key = derive_quant_key(quant_seed)
+                zero_l, zero_aux, zero_g = zeros
+
+                def body(carry, xs):
+                    la, auxa, acc, fly, mc = carry
+                    tok, tgt, w, i = xs
+                    (l, aux), g = mb_value_and_grad(tok, tgt, w)
+                    # per-microbatch rounding keys: K int8 syncs in one
+                    # round must draw uncorrelated noise
+                    kq = None if quant_key is None else \
+                        jax.random.fold_in(quant_key, i)
+                    synced, min_c = sync_grads(g, kq, valid=valid)
+                    # fold the PREVIOUS tick's in-flight result only now
+                    acc = jax.tree.map(jnp.add, acc, fly)
+                    return (la + l, jax.tree.map(jnp.add, auxa, aux),
+                            acc, synced, jnp.minimum(mc, min_c)), None
+
+                init = (zero_l, zero_aux, zero_g, zero_g,
+                        jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32))
+                (loss, aux, acc, fly, min_count), _ = lax.scan(
+                    body, init, (tok_m, tgt_m, w_m,
+                                 jnp.arange(accum, dtype=jnp.uint32)))
+                synced_grads = jax.tree.map(jnp.add, acc, fly)
+                aux = jax.tree.map(lambda x: x / accum, aux)
+                return synced_grads, make_metrics(loss, aux, total_count,
+                                                  min_count)
 
             def body(carry, xs):
                 la, auxa, ga = carry

@@ -12,6 +12,12 @@ SURVEY.md §5.8). On TPU both phases lower to single XLA collectives over ICI:
   leading axis from ops/bucketing.py.
 * :func:`psum_allreduce` — the fused fast path when thresholds are 1.0
   (the reference's whole protocol degenerates to one sum).
+* :func:`pipelined_two_phase_allreduce` — the two phases windowed along
+  the bucket axis and issued on an interleaved (double-buffered)
+  schedule, so window i's all-gather can overlap window i+1's
+  reduce-scatter under XLA's latency-hiding scheduler
+  (runtime/xla_flags.py). Bitwise identical to the fused two-phase op;
+  selected via ``GradSyncConfig.transport_schedule = "windowed"``.
 * :func:`quantized_two_phase_allreduce` — the same two phases with int8
   payloads on the wire (EQuARX direction, PAPERS.md): contributions are
   symmetric-int8 quantized with stochastic rounding before each hop, so
@@ -50,6 +56,20 @@ def psum_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
     return lax.psum(x, axis_name)
 
 
+def _check_scatter_geometry(x: jnp.ndarray, axis_name: str) -> None:
+    """The two-phase geometry precondition, shared by the fused and
+    windowed forms so the error reads identically however the caller
+    routed here: psum_scatter tiles the last axis across the group."""
+    n = lax.axis_size(axis_name)
+    if x.shape[-1] % n != 0:
+        raise ValueError(
+            f"last axis {x.shape[-1]} not divisible by group size {n} "
+            f"(= lax.axis_size({axis_name!r}), the mesh extent of the "
+            f"{axis_name!r} axis this collective reduces over); choose "
+            f"bucket_elems as a multiple of that axis size, or pad the "
+            f"last axis with zeros (they sum harmlessly)")
+
+
 def two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
     """Reduce-scatter + all-gather along the *last* axis. Rank-local.
 
@@ -57,14 +77,76 @@ def two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
     bucket_elems that are a multiple of the group size (pad otherwise;
     ops/bucketing pads with zeros which sum harmlessly).
     """
-    n = lax.axis_size(axis_name)
-    if x.shape[-1] % n != 0:
-        raise ValueError(
-            f"last axis {x.shape[-1]} not divisible by group size {n}; "
-            "choose bucket_elems as a multiple of the dp axis size")
+    _check_scatter_geometry(x, axis_name)
     scattered = lax.psum_scatter(x, axis_name, scatter_dimension=x.ndim - 1,
                                  tiled=True)
     return lax.all_gather(scattered, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def pipelined_two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp",
+                                  num_windows: int = 2) -> jnp.ndarray:
+    """Windowed (software-pipelined) two-phase allreduce. Rank-local.
+
+    ``x``: ``(num_buckets, bucket_elems)`` — the bucket matrix from
+    ops/bucketing.py. The bucket axis is split into ``num_windows``
+    windows and each window runs the same reduce-scatter + all-gather
+    as :func:`two_phase_allreduce`, issued on an **unrolled interleaved
+    schedule**: window *i+1*'s reduce-scatter is traced before window
+    *i*'s all-gather, so the two sit adjacent in the program with no
+    data dependency between them. Under XLA's latency-hiding scheduler
+    with async collectives (runtime/xla_flags.py) the gather of window
+    *i* then overlaps the scatter of window *i+1* on the wire — the
+    software pipelining of "Optimal Reduce-scatter and Allreduce"
+    (arxiv 2410.14234) / Swing (arxiv 2401.09356, PAPERS.md) rendered
+    as issue order; without those flags the schedule degrades to the
+    fused op's serial order, never to something slower.
+
+    Exactness: every element still traverses exactly one psum_scatter
+    and one all_gather over the same ranks in the same reduction order
+    as the fused op, so the result is bitwise identical to
+    :func:`two_phase_allreduce` for any window count (windows only
+    partition rows; no element's reduction tree changes).
+
+    ``num_windows`` must divide the bucket count — callers that cannot
+    guarantee that pad the bucket axis with zero rows and slice them
+    back off (parallel/dp.py does; zero rows sum harmlessly).
+    """
+    if x.ndim != 2:
+        raise ValueError(
+            f"expected (num_buckets, bucket_elems), got {x.shape}")
+    if num_windows < 1:
+        raise ValueError(f"num_windows must be >= 1, got {num_windows}")
+    b = x.shape[0]
+    if b % num_windows != 0:
+        raise ValueError(
+            f"num_windows={num_windows} does not divide num_buckets={b}: "
+            f"pad the bucket axis with zero rows to a multiple of "
+            f"num_windows (they sum harmlessly and slice back off — "
+            f"parallel/dp.py's windowed path does this), or pick "
+            f"num_windows from the divisors of {b}")
+    _check_scatter_geometry(x, axis_name)
+    if num_windows == 1:
+        return two_phase_allreduce(x, axis_name)
+    wb = b // num_windows
+    windows = [x[i * wb:(i + 1) * wb] for i in range(num_windows)]
+
+    def scatter(w):
+        return lax.psum_scatter(w, axis_name, scatter_dimension=w.ndim - 1,
+                                tiled=True)
+
+    def gather(s):
+        return lax.all_gather(s, axis_name, axis=s.ndim - 1, tiled=True)
+
+    # double-buffered issue order: scatter(i+1) between scatter(i) and
+    # gather(i) — the independent pair the scheduler can overlap
+    out = [None] * num_windows
+    scattered = scatter(windows[0])
+    for i in range(1, num_windows):
+        next_scattered = scatter(windows[i])
+        out[i - 1] = gather(scattered)
+        scattered = next_scattered
+    out[num_windows - 1] = gather(scattered)
+    return jnp.concatenate(out, axis=0)
 
 
 def _quantize_rows(x2d: jnp.ndarray, key: jax.Array
@@ -107,7 +189,8 @@ def _dequantize_rows(values: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
 
 
 def quantized_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
-                                  axis_name: str = "dp") -> jnp.ndarray:
+                                  axis_name: str = "dp",
+                                  num_windows: int = 1) -> jnp.ndarray:
     """Reduce-scatter + all-gather with int8 wire payloads. Rank-local.
 
     ``buckets``: (num_buckets, bucket_elems) f32 — ONE quantization scale
@@ -123,10 +206,27 @@ def quantized_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
     happens in f32 after dequantization (one quantization error per hop,
     zero-mean thanks to the stochastic rounding, PROVIDED the key varies
     per round).
+
+    ``num_windows > 1`` windows the bucket axis like
+    :func:`pipelined_two_phase_allreduce` and issues window *i+1*'s
+    phase-1 quantization between window *i*'s collectives — on TPU with
+    the latency-hiding flags the VPU quantize of the next window hides
+    behind the ICI transfer of the current one. Rows pad to a multiple
+    of the group exactly as the fused form does, and the windows carve
+    the resulting owner row-GROUPS into near-equal contiguous chunks
+    (each a whole number of groups, so every window still
+    block-distributes evenly) — never padding beyond the fused op's
+    rows, so windowing never moves more bytes on the wire; when there
+    are fewer groups than windows the window count silently degrades to
+    the group count. Per-row quantization is window-local by
+    construction (scales are per row), so windowing changes only WHICH
+    stochastic-rounding bits a row draws, never the error envelope.
     """
     if buckets.ndim != 2:
         raise ValueError(
             f"expected (num_buckets, bucket_elems), got {buckets.shape}")
+    if num_windows < 1:
+        raise ValueError(f"num_windows must be >= 1, got {num_windows}")
     n = lax.axis_size(axis_name)
     if n == 1:
         return buckets
@@ -136,26 +236,63 @@ def quantized_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
         buckets = jnp.concatenate(
             [buckets, jnp.zeros((pad_rows, e), buckets.dtype)], axis=0)
     bp = b + pad_rows
-    rows_per_rank = bp // n
     # decorrelate rounding noise across ranks and phases
     key = jax.random.fold_in(key, lax.axis_index(axis_name))
-    k1, k2 = jax.random.split(key)
 
-    # phase 1 — scatter+reduce: my version of rank j's bucket rows goes to
-    # rank j (int8); I receive every rank's version of MY rows and reduce
-    # them in f32
-    values, scales = _quantize_rows(buckets, k1)
-    values = values.reshape(n, rows_per_rank, e)
-    scales = scales.reshape(n, rows_per_rank, 1)
-    recv_v = lax.all_to_all(values, axis_name, split_axis=0, concat_axis=0)
-    recv_s = lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0)
-    reduced = jnp.sum(recv_v.astype(jnp.float32) * recv_s, axis=0)
+    def phase1(win, k1):
+        # scatter+reduce: my version of rank j's bucket rows goes to
+        # rank j (int8); I receive every rank's version of MY rows and
+        # reduce them in f32
+        rows_per_rank = win.shape[0] // n
+        values, scales = _quantize_rows(win, k1)
+        values = values.reshape(n, rows_per_rank, e)
+        scales = scales.reshape(n, rows_per_rank, 1)
+        recv_v = lax.all_to_all(values, axis_name, split_axis=0,
+                                concat_axis=0)
+        recv_s = lax.all_to_all(scales, axis_name, split_axis=0,
+                                concat_axis=0)
+        return jnp.sum(recv_v.astype(jnp.float32) * recv_s, axis=0)
 
-    # phase 2 — broadcast: my reduced rows to everyone (int8 again)
-    out_v, out_s = _quantize_rows(reduced, k2)
-    all_v = lax.all_gather(out_v, axis_name, axis=0, tiled=True)
-    all_s = lax.all_gather(out_s, axis_name, axis=0, tiled=True)
-    return _dequantize_rows(all_v, all_s)[:b]
+    def phase2(reduced, k2):
+        # broadcast: my reduced rows to everyone (int8 again)
+        out_v, out_s = _quantize_rows(reduced, k2)
+        all_v = lax.all_gather(out_v, axis_name, axis=0, tiled=True)
+        all_s = lax.all_gather(out_s, axis_name, axis=0, tiled=True)
+        return _dequantize_rows(all_v, all_s)
+
+    # windows carve the bp//n owner row-groups into near-equal contiguous
+    # chunks — never pad beyond the fused op's rows (windowing must not
+    # move MORE bytes than the schedule it is meant to beat), so fewer
+    # groups than windows means fewer windows
+    num_windows = min(num_windows, bp // n)
+    if num_windows == 1:
+        k1, k2 = jax.random.split(key)
+        return phase2(phase1(buckets, k1), k2)[:b]
+
+    m = bp // n
+    sizes = [(m // num_windows + (i < m % num_windows)) * n
+             for i in range(num_windows)]
+    offs = [0]
+    for s in sizes:
+        offs.append(offs[-1] + s)
+    wins = [buckets[offs[i]:offs[i + 1]] for i in range(num_windows)]
+    # per-window keys: windows of one round must draw uncorrelated
+    # rounding noise or their errors stop cancelling across the round
+    keys = [jax.random.split(jax.random.fold_in(key, i))
+            for i in range(num_windows)]
+    # software pipeline, unrolled: phase1(i+1) — whose quantize is pure
+    # VPU work — issues between phase1(i) and phase2(i), giving the
+    # scheduler an independent compute chain to overlap with window i's
+    # wire time (and phase2(i)'s all-gather with phase1(i+1)'s
+    # all_to_all, the same rs/ag overlap as the f32 pipeline)
+    out = [None] * num_windows
+    reduced = phase1(wins[0], keys[0][0])
+    for i in range(1, num_windows):
+        next_reduced = phase1(wins[i], keys[i][0])
+        out[i - 1] = phase2(reduced, keys[i - 1][1])
+        reduced = next_reduced
+    out[num_windows - 1] = phase2(reduced, keys[num_windows - 1][1])
+    return jnp.concatenate(out, axis=0)[:b]
 
 
 def exact_allreduce(stacked: jnp.ndarray, mesh: Mesh, axis_name: str = "dp",

@@ -30,7 +30,8 @@ from jax import lax
 
 from akka_allreduce_tpu.ops.bucketing import BucketSpec, bucketize, \
     debucketize, vector_to_tree
-from akka_allreduce_tpu.ops.collectives import quantized_two_phase_allreduce
+from akka_allreduce_tpu.ops.collectives import \
+    pipelined_two_phase_allreduce, quantized_two_phase_allreduce
 from akka_allreduce_tpu.ops.masked import expand_bucket_counts, \
     masked_allreduce
 from akka_allreduce_tpu.utils.vma import _axis_tuple, psum_all
@@ -66,6 +67,23 @@ class GradSyncConfig:
     # exact zeros and the per-bucket counts ride a separate exact int32
     # psum.
     transport: str = "f32"
+    # Collective schedule: "fused" issues one monolithic collective per
+    # sync (psum, or the single two-phase pair for int8); "windowed"
+    # splits the bucket axis into num_windows windows and issues them on
+    # the software-pipelined schedule of
+    # ops/collectives.pipelined_two_phase_allreduce, so window i's
+    # all-gather can overlap window i+1's reduce-scatter (and, for int8,
+    # window i+1's quantization) under XLA's latency-hiding scheduler
+    # (runtime/xla_flags.py). Exactness-preserving for f32 (bitwise the
+    # fused two-phase result); bf16/int8 stay inside their wire's error
+    # envelope. Needs a single (>1) data axis whose size divides
+    # bucket_elems (the two-phase geometry); the bucket axis pads with
+    # zero rows to a multiple of the window count (sliced back off,
+    # degrading the count when padding would exceed one window's rows),
+    # and lossy rounds keep their per-bucket counts on ONE exact int32
+    # psum — never per-window.
+    transport_schedule: str = "fused"
+    num_windows: int = 4
 
 
 @dataclasses.dataclass
@@ -105,6 +123,54 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     live_axes = [a for a in _axis_tuple(config.axis_name)
                  if lax.axis_size(a) > 1]
     use_bf16 = config.transport == "bf16" and bool(live_axes)
+    if config.transport_schedule not in ("fused", "windowed"):
+        raise ValueError(
+            f"unknown transport_schedule {config.transport_schedule!r}: "
+            f"'fused' (one monolithic collective) or 'windowed' (the "
+            f"software-pipelined schedule)")
+    windowed = config.transport_schedule == "windowed" and bool(live_axes)
+    if windowed:
+        if config.num_windows < 1:
+            raise ValueError(
+                f"num_windows must be >= 1, got {config.num_windows}")
+        if len(live_axes) > 1:
+            raise ValueError(
+                f"transport_schedule='windowed' runs the two-phase "
+                f"(reduce-scatter + all-gather) geometry, which needs a "
+                f"single (>1) data axis; got {live_axes} — fold the "
+                f"parallelism into one axis or use the fused schedule")
+        win_axis = live_axes[0]
+        if config.transport != "int8" \
+                and config.bucket_elems % lax.axis_size(win_axis):
+            raise ValueError(
+                f"transport_schedule='windowed' with a {config.transport} "
+                f"wire scatters each bucket row across the "
+                f"{win_axis!r} axis (size "
+                f"{lax.axis_size(win_axis)} = lax.axis_size"
+                f"({win_axis!r})); choose bucket_elems as a multiple of "
+                f"that size (got {config.bucket_elems})")
+
+    def windowed_sum(mat: jnp.ndarray) -> jnp.ndarray:
+        """Pipelined two-phase sum of a bucket matrix, padding the bucket
+        axis with zero rows to a multiple of the window count (sliced
+        back off; zero rows sum harmlessly — the window-axis analog of
+        ops/bucketing's rank-dimension pad). The window count degrades
+        until the pad is < one window's rows (e.g. 5 buckets at 4
+        windows would pad 3 zero rows — 60% more wire bytes — so it runs
+        3 windows padding 1 instead): awkward bucket counts degrade the
+        window count, never multiply the wire bytes — the same
+        guarantee the int8 path's row-group carve makes."""
+        rows = mat.shape[0]
+        w = min(config.num_windows, rows)
+        while w > 1 and (-rows) % w >= -(-rows // w):
+            w -= 1
+        pad = (-rows) % w
+        if pad:
+            mat = jnp.concatenate(
+                [mat, jnp.zeros((pad, mat.shape[1]), mat.dtype)], axis=0)
+        out = pipelined_two_phase_allreduce(mat, win_axis, w)
+        return out[:rows]
+
     if config.transport == "int8":
         # shared int8 preconditions (exact and masked paths)
         int8_axes = live_axes
@@ -128,16 +194,22 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         if config.transport == "int8":
             # size-1 axes reduce to identity and don't need a wire format
             summed = buckets if not int8_axes else \
-                quantized_two_phase_allreduce(buckets, quant_key,
-                                              int8_axes[0])
+                quantized_two_phase_allreduce(
+                    buckets, quant_key, int8_axes[0],
+                    num_windows=config.num_windows if windowed else 1)
         elif use_bf16:
             # the collective's payload dtype IS its wire format: casting
             # the operand halves the bytes every hop moves; the f32
             # master grads/optimizer never see bf16 (cast back before
-            # rescale). Works over ANY axis set — no reduce_scatter
-            # geometry to satisfy, unlike int8's two-phase
-            summed = psum_all(buckets.astype(jnp.bfloat16),
-                              config.axis_name).astype(jnp.float32)
+            # rescale). The fused form works over ANY axis set — no
+            # reduce_scatter geometry to satisfy, unlike int8's
+            # two-phase; the windowed form trades that freedom for the
+            # pipelined schedule (single axis, validated above)
+            wire = buckets.astype(jnp.bfloat16)
+            summed = (windowed_sum(wire) if windowed else
+                      psum_all(wire, config.axis_name)).astype(jnp.float32)
+        elif windowed:
+            summed = windowed_sum(buckets)
         else:
             summed = psum_all(buckets, config.axis_name)
         group = 1
@@ -157,8 +229,9 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
             # AllreduceMessage.scala:20) tolerates no rounding.
             contrib = buckets * valid.astype(buckets.dtype)[:, None]
             summed = contrib if not int8_axes else \
-                quantized_two_phase_allreduce(contrib, quant_key,
-                                              int8_axes[0])
+                quantized_two_phase_allreduce(
+                    contrib, quant_key, int8_axes[0],
+                    num_windows=config.num_windows if windowed else 1)
             bucket_counts = psum_all(valid.astype(jnp.int32),
                                      config.axis_name)
         elif use_bf16:
@@ -167,8 +240,20 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
             # (the honesty contract tolerates no rounding)
             contrib = (buckets * valid.astype(buckets.dtype)[:, None]
                        ).astype(jnp.bfloat16)
-            summed = psum_all(contrib,
-                              config.axis_name).astype(jnp.float32)
+            summed = (windowed_sum(contrib) if windowed else
+                      psum_all(contrib,
+                               config.axis_name)).astype(jnp.float32)
+            bucket_counts = psum_all(valid.astype(jnp.int32),
+                                     config.axis_name)
+        elif windowed:
+            # lossy + windowed: the masked payload rides the pipelined
+            # schedule, but the per-bucket counts stay on ONE exact
+            # int32 psum over the full bucket axis — windowing the
+            # honesty contract would buy nothing (counts are tiny) and
+            # fragment the one collective whose exactness is the
+            # contract
+            summed = windowed_sum(
+                buckets * valid.astype(buckets.dtype)[:, None])
             bucket_counts = psum_all(valid.astype(jnp.int32),
                                      config.axis_name)
         else:

@@ -23,7 +23,12 @@ from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+try:  # JAX >= 0.5: meshes carry axis types (Explicit is the new default)
+    from jax.sharding import AxisType
+except ImportError:  # 0.4.x: every mesh is Auto-typed; nothing to pin
+    AxisType = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +81,8 @@ def make_device_mesh(spec: Optional[MeshSpec] = None,
         raise ValueError(
             f"mesh of {sizes} needs {total} devices, have {len(devices)}")
     dev_array = np.asarray(devices).reshape(sizes)
+    if AxisType is None:  # 0.4.x Mesh has no axis_types (all Auto)
+        return Mesh(dev_array, names)
     return Mesh(dev_array, names,
                 axis_types=(AxisType.Auto,) * len(names))
 
@@ -110,7 +117,22 @@ def place_tree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     preserved — only placement/sharding changes. The one canonical placement
     helper: initial sharding of host-built state (models/train.py) and
     post-churn resharding (runtime/elastic.py) both route here."""
+    def place(x, s):
+        sharding = NamedSharding(mesh, s)
+        if not sharding.is_fully_addressable and \
+                getattr(x, "is_fully_addressable", True):
+            # multi-process mesh, host-replicated value (every process
+            # built the same tree — the deterministic-init contract):
+            # supply only this process's shards. jax.device_put would be
+            # equivalent on current JAX, but 0.4.x routes uncommitted
+            # host arrays through multihost_utils.assert_equal, whose
+            # broadcast psum the multi-process CPU backend (the dryrun /
+            # test topology) cannot run
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx: x[idx])
+        return jax.device_put(x, sharding)
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        tree, specs,
+        place, tree, specs,
         is_leaf=lambda x: isinstance(x, PartitionSpec))

@@ -27,6 +27,9 @@ __all__ = [
     "reform_mesh",
     "reshard",
     "HostResourceSampler",
+    "install_overlap_flags",
+    "overlap_flags",
+    "OVERLAP_LIBTPU_FLAGS",
 ]
 
 _SUBMODULE = {
@@ -45,6 +48,9 @@ _SUBMODULE = {
     "reform_mesh": "elastic",
     "reshard": "elastic",
     "HostResourceSampler": "metrics",
+    "install_overlap_flags": "xla_flags",
+    "overlap_flags": "xla_flags",
+    "OVERLAP_LIBTPU_FLAGS": "xla_flags",
 }
 
 

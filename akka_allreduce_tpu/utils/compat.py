@@ -1,0 +1,67 @@
+"""JAX version compatibility: one shim, installed once, no-op on new JAX.
+
+The framework is written against the current JAX surface —
+``jax.shard_map`` (top-level, ``check_vma=`` keyword),
+``jax.sharding.AxisType``, ``jax.tree.flatten_with_path`` — but must
+also run on 0.4.x boxes where those names live elsewhere or do not
+exist (``shard_map`` is ``jax.experimental.shard_map.shard_map`` with a
+``check_rep=`` keyword; meshes take no ``axis_types``; the with-path
+helpers only exist under ``jax.tree_util``).
+
+Rather than scatter try/imports across every call site (~60 of them,
+half in tests that exist precisely to read like production code),
+:func:`install` grafts the missing attributes onto ``jax`` itself at
+package import. Rules that keep this safe:
+
+* **add-only** — an attribute that already exists is never replaced, so
+  on a current JAX the whole function is a no-op;
+* **semantics-preserving** — the ``shard_map`` wrapper maps
+  ``check_vma`` to ``check_rep=False`` (the old replication checker is
+  a strictly-optional validator with known false positives on tiled
+  collectives; the vma type system it was replaced by does not exist to
+  emulate);
+* **import-time only** — :func:`install` runs from the package
+  ``__init__`` before any backend initializes, so there is no window
+  where half the API is patched.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def _shard_map_compat():
+    """A ``jax.shard_map`` lookalike over the 0.4.x experimental API."""
+    from jax.experimental.shard_map import shard_map as _old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None, **_ignored):
+        # check_vma has no 0.4.x equivalent; check_rep=False because the
+        # old replication checker rejects patterns the vma checker
+        # accepts (and the framework's collective layer manages its own
+        # replication explicitly — see models/train.py check_vma=False)
+        del check_vma, axis_names
+        return _old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=False)
+
+    return shard_map
+
+
+def install() -> None:
+    """Graft missing current-JAX names onto an 0.4.x ``jax``. Idempotent;
+    no-op when the running JAX already provides them."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat()
+    if not hasattr(lax, "axis_size"):
+        # psum of a unit constant is JAX's long-standing axis-size idiom:
+        # it constant-folds to the (static) extent of the named axis, so
+        # shape arithmetic built on it stays trace-time static
+        def axis_size(axis_name):
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
+    if not hasattr(jax.tree, "flatten_with_path"):
+        jax.tree.flatten_with_path = jax.tree_util.tree_flatten_with_path
+    if not hasattr(jax.tree, "map_with_path"):
+        jax.tree.map_with_path = jax.tree_util.tree_map_with_path

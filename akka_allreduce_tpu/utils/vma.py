@@ -28,10 +28,14 @@ def _axis_tuple(axis_name: Axes) -> tuple[str, ...]:
 
 def cast_varying(x, axes: tuple[str, ...]):
     """invariant -> varying cast, on whichever spelling this JAX has
-    (``lax.pvary`` is deprecated in favor of ``lax.pcast``)."""
+    (``lax.pvary`` is deprecated in favor of ``lax.pcast``). On pre-vma
+    JAX (0.4.x) there is no varying/invariant distinction to cast
+    between, so the cast is the identity."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
 
 
 def ensure_varying(x, axis_name: Axes):

@@ -87,7 +87,7 @@ def main(only=None) -> int:
     if only:
         fns = {f.__name__: f for f in
                (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
-                ab_bf16_cast, ab_moe_dispatch, mfu_lines)}
+                ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -169,10 +169,50 @@ def main(only=None) -> int:
 
     skip = set(os.environ.get("AATPU_SUITE_SKIP", "").split(","))
     for fn in (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
-               ab_bf16_cast, ab_moe_dispatch, mfu_lines):
+               ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines):
         if fn.__name__ not in skip:
             fn()
     return 0
+
+
+def ab_overlap():
+    """A/B the fused (monolithic psum) gradient collective against the
+    windowed software-pipelined schedule at W in {1, 2, 4, 8} on the
+    canonical 2.5M/25M payloads — the measurement behind
+    ``GradSyncConfig.transport_schedule`` (ops/collectives.
+    pipelined_two_phase_allreduce). Installs the latency-hiding /
+    async-collective flags first (runtime/xla_flags.py): without them
+    the windowed schedule legally serializes and the A/B answers a
+    different question (the note records whether they were live)."""
+    # snapshot BEFORE the akka import below: the package __init__ itself
+    # imports jax (utils/compat.py), so testing sys.modules afterwards
+    # would flag the fresh `--only ab_overlap` process too
+    jax_preloaded = "jax" in sys.modules
+
+    from akka_allreduce_tpu.runtime.xla_flags import install_overlap_flags
+
+    # before any device touch in this process; a no-op off-TPU and when
+    # the operator already set the flags
+    added = install_overlap_flags()
+    stale = bool(added and jax_preloaded)
+    if stale:
+        # libtpu reads LIBTPU_INIT_ARGS once at load: on the full-suite
+        # path the backend is already up and the added flags are NOT
+        # live — the capture harness runs `--only ab_overlap` in a fresh
+        # subprocess precisely so they are
+        print("[suite] ab_overlap: flags added after backend init — "
+              "not live; prefer --only ab_overlap in a fresh "
+              "process", file=sys.stderr)
+
+    from akka_allreduce_tpu.bench import measure_ab_overlap
+
+    # flags_live=False routes the staleness into the banked rows' note
+    # — the permanent record, not just this process's stderr.
+    # measure_ab_overlap is a generator and the flush is per-row: a
+    # watchdog SIGKILL mid-suite then loses at most the in-flight
+    # measurement, not the banked ones
+    for row in measure_ab_overlap(flags_live=False if stale else None):
+        print(json.dumps(row), flush=True)
 
 
 def ab_moe_dispatch():

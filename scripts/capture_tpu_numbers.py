@@ -40,6 +40,16 @@ CAP_DIR = os.path.join(ROOT, "perf_capture")
 # first (round-4 verdict #1). Sections mirror the legacy perf_tpu.json
 # layout so PERF.md merges stay mechanical.
 STEPS = [
+    # 0. the fused-vs-windowed overlap A/B (this round's open claim):
+    # runs via --only in a FRESH subprocess so the latency-hiding /
+    # async-collective flags (runtime/xla_flags.py) land in
+    # LIBTPU_INIT_ARGS before the backend initializes — the suite's
+    # in-process path cannot guarantee that
+    ("ab_overlap", "suite", 1200, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "ab_overlap"], check=False)
+"""),
     # 1. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time
@@ -81,12 +91,13 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_decode.py"],
                check=False)
 """),
-    # 6. the rest of the suite (MFU and windowed-SP skipped — steps 1/4
-    # and 2 own those rows; a re-run here would bank duplicates)
+    # 6. the rest of the suite (MFU, windowed-SP, and overlap skipped —
+    # steps 1/4, 2, and 0 own those rows; a re-run here would bank
+    # duplicates, and ab_overlap needs its own fresh process anyway)
     ("suite", "suite", 1800, """
 import os, subprocess, sys
 env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1",
-       "AATPU_SUITE_SKIP": "ab_windowed_sp"}
+       "AATPU_SUITE_SKIP": "ab_windowed_sp,ab_overlap"}
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env,
                check=False)
 """),

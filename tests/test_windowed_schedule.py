@@ -1,0 +1,329 @@
+"""Windowed (software-pipelined) collective schedule tests.
+
+The overlap layer's exactness contract (ISSUE 1): windowing only
+partitions bucket ROWS across separately-issued collectives — no
+element's reduction tree changes — so the f32 windowed schedule must be
+BITWISE the fused result (and ``lax.psum``'s), at any window count,
+including the masked/lossy path; compressed wires stay inside their
+existing error envelopes. Validation errors must name the actual axis
+size source and the pad-or-raise rule for window counts.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.ops.collectives import (
+    pipelined_two_phase_allreduce,
+    two_phase_allreduce,
+)
+from akka_allreduce_tpu.parallel.dp import GradSyncConfig, allreduce_gradients
+from akka_allreduce_tpu.parallel.mesh import single_axis_mesh
+
+N = 8
+
+
+def _run_windowed_vs_psum(n, num_buckets, bucket_elems, num_windows):
+    """(windowed, psum) bucket sums on an n-device dp mesh; every rank
+    contributes a distinct random bucket matrix."""
+    mesh = single_axis_mesh("dp", devices=jax.devices()[:n])
+    rng = np.random.default_rng(17 * n + num_windows)
+    stacked = jnp.asarray(
+        rng.normal(size=(n, num_buckets, bucket_elems)).astype(np.float32))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=(P("dp"), P("dp")), check_vma=False)
+    def run(b):
+        w = pipelined_two_phase_allreduce(b[0], "dp", num_windows)
+        p = lax.psum(b[0], "dp")
+        return w[None], p[None]
+
+    w, p = run(stacked)
+    return np.asarray(w), np.asarray(p)
+
+
+class TestPipelinedExactness:
+    """Acceptance: bitwise vs ``lax.psum`` for f32 at n=4 and n=8."""
+
+    @pytest.mark.parametrize("n", [4, 8])
+    @pytest.mark.parametrize("num_windows", [1, 2, 4])
+    def test_bitwise_vs_psum(self, n, num_windows):
+        w, p = _run_windowed_vs_psum(n, num_buckets=8, bucket_elems=2 * n,
+                                     num_windows=num_windows)
+        np.testing.assert_array_equal(w, p)
+
+    def test_window_of_one_is_the_fused_two_phase(self):
+        mesh = single_axis_mesh("dp")
+        rng = np.random.default_rng(3)
+        stacked = jnp.asarray(
+            rng.normal(size=(N, 4, 16)).astype(np.float32))
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=(P("dp"), P("dp")), check_vma=False)
+        def run(b):
+            return (pipelined_two_phase_allreduce(b[0], "dp", 1)[None],
+                    two_phase_allreduce(b[0], "dp")[None])
+
+        w, t = run(stacked)
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(t))
+
+    def test_all_ranks_identical(self):
+        w, _ = _run_windowed_vs_psum(4, num_buckets=4, bucket_elems=8,
+                                     num_windows=2)
+        for r in range(1, 4):
+            np.testing.assert_array_equal(w[0], w[r])
+
+
+class TestPipelinedValidation:
+    def test_window_count_must_divide_buckets(self):
+        mesh = single_axis_mesh("dp")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(b):
+            return pipelined_two_phase_allreduce(b[0], "dp", 5)[None]
+
+        with pytest.raises(ValueError, match="pad the bucket axis"):
+            run(jnp.ones((N, 6, 16), jnp.float32))
+
+    def test_nonpositive_window_count_rejected(self):
+        mesh = single_axis_mesh("dp")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(b):
+            return pipelined_two_phase_allreduce(b[0], "dp", 0)[None]
+
+        with pytest.raises(ValueError, match="num_windows"):
+            run(jnp.ones((N, 4, 16), jnp.float32))
+
+    def test_divisibility_error_names_axis_size_source(self):
+        """Satellite: the error must say WHERE the group size came from
+        (lax.axis_size of the named mesh axis), not just the number."""
+        mesh = single_axis_mesh("dp")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(b):
+            return two_phase_allreduce(b[0], "dp")[None]
+
+        with pytest.raises(ValueError, match=r"lax\.axis_size\('dp'\)"):
+            run(jnp.ones((N, 4, 10), jnp.float32))
+
+    def test_windowed_divisibility_error_names_axis_size_source(self):
+        mesh = single_axis_mesh("dp")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(b):
+            return pipelined_two_phase_allreduce(b[0], "dp", 2)[None]
+
+        with pytest.raises(ValueError, match=r"lax\.axis_size\('dp'\)"):
+            run(jnp.ones((N, 4, 10), jnp.float32))
+
+
+def _sync(grads, cfg, valid=None, key=None, n=N):
+    mesh = single_axis_mesh("dp", devices=jax.devices()[:n])
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P()),
+             out_specs=(P(), P()), check_vma=False)
+    def run(offset, k):
+        # rank-varying grads: base + rank offset keeps ranks distinct
+        local = jax.tree.map(
+            lambda g: g + offset[0] * lax.axis_index("dp"), grads)
+        res = allreduce_gradients(local, cfg, valid=valid, quant_key=k)
+        return res.grads, res.bucket_counts
+
+    key = jax.random.key(0) if key is None else key
+    return run(jnp.ones((n, 1), jnp.float32) * 0.25, key)
+
+
+class TestGradSyncWindowed:
+    """dp-level: transport_schedule='windowed' through
+    allreduce_gradients, exact and masked, all wire formats."""
+
+    GRADS = None
+
+    @pytest.fixture()
+    def grads(self):
+        rng = np.random.default_rng(11)
+        return {
+            "dense": jnp.asarray(rng.normal(size=(24, 12)).astype(
+                np.float32)),
+            "bias": jnp.asarray(rng.normal(size=(40,)).astype(np.float32)),
+        }
+
+    def _pair(self, grads, valid=None, transport="f32", num_windows=4,
+              key=None):
+        fused = GradSyncConfig(bucket_elems=64, axis_name="dp",
+                               average=True, rescale_target=float(N),
+                               return_elem_counts=False,
+                               transport=transport)
+        windowed = GradSyncConfig(bucket_elems=64, axis_name="dp",
+                                  average=True, rescale_target=float(N),
+                                  return_elem_counts=False,
+                                  transport=transport,
+                                  transport_schedule="windowed",
+                                  num_windows=num_windows)
+        gf, cf = _sync(grads, fused, valid=valid, key=key)
+        gw, cw = _sync(grads, windowed, valid=valid, key=key)
+        return gf, cf, gw, cw
+
+    def test_f32_exact_path_bitwise(self, grads):
+        gf, cf, gw, cw = self._pair(grads)
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gw)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(cf), np.asarray(cw))
+
+    def test_f32_window_pad_path_bitwise(self, grads):
+        # bucket count (ceil(328/64) = 6) not divisible by 4: the dp
+        # layer pads zero rows and slices them back off
+        gf, _, gw, _ = self._pair(grads, num_windows=4)
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gw)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_f32_masked_path_bitwise(self, grads):
+        # this rank masks bucket 0 (all ranks share the mask row here;
+        # counts drop to 0 for it and the rescale zeroes it)
+        nb = 6
+        valid = jnp.ones((nb,), jnp.float32).at[0].set(0.0)
+        gf, cf, gw, cw = self._pair(grads, valid=valid)
+        np.testing.assert_array_equal(np.asarray(cf), np.asarray(cw))
+        assert int(np.asarray(cw)[0]) == 0
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gw)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_masked_path_bitwise_vs_psum_small_mesh(self, n):
+        """Acceptance: masked windowed == masked fused, n=4 and n=8."""
+        grads = {"w": jnp.asarray(np.random.default_rng(5).normal(
+            size=(16, 8)).astype(np.float32))}
+        valid = jnp.ones((2,), jnp.float32).at[1].set(0.0)
+        fused = GradSyncConfig(bucket_elems=64, axis_name="dp",
+                               average=True, rescale_target=float(n),
+                               return_elem_counts=False)
+        windowed = GradSyncConfig(bucket_elems=64, axis_name="dp",
+                                  average=True, rescale_target=float(n),
+                                  return_elem_counts=False,
+                                  transport_schedule="windowed",
+                                  num_windows=2)
+        gf, cf = _sync(grads, fused, valid=valid, n=n)
+        gw, cw = _sync(grads, windowed, valid=valid, n=n)
+        np.testing.assert_array_equal(np.asarray(cf), np.asarray(cw))
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gw)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_bf16_windowed_inside_wire_envelope(self, grads):
+        # bf16 wire: fused and windowed round identically per element
+        # EXCEPT for f32 accumulation order; bound both against the f32
+        # exact result by the bf16 mantissa step
+        exact = GradSyncConfig(bucket_elems=64, axis_name="dp",
+                               average=True, rescale_target=float(N),
+                               return_elem_counts=False)
+        ge, _ = _sync(grads, exact)
+        _, _, gw, _ = self._pair(grads, transport="bf16")
+        for a, b in zip(jax.tree.leaves(ge), jax.tree.leaves(gw)):
+            a, b = np.asarray(a), np.asarray(b)
+            tol = np.maximum(np.abs(a), 1e-3) * (2.0 ** -7)
+            np.testing.assert_allclose(b, a, atol=float(tol.max()))
+
+    @pytest.mark.slow
+    def test_int8_windowed_inside_wire_envelope(self, grads):
+        exact = GradSyncConfig(bucket_elems=64, axis_name="dp",
+                               average=True, rescale_target=float(N),
+                               return_elem_counts=False)
+        ge, _ = _sync(grads, exact)
+        _, _, gw, _ = self._pair(grads, transport="int8",
+                                 key=jax.random.key(9))
+        # two quantize hops, ~2/127 of the row abs-max each (the same
+        # envelope tests/test_quantized_collective.py pins for the fused
+        # int8 wire); windowing only re-keys the stochastic rounding
+        scale = max(float(np.abs(np.asarray(g)).max())
+                    for g in jax.tree.leaves(grads)) + 0.25 * N
+        for a, b in zip(jax.tree.leaves(ge), jax.tree.leaves(gw)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=3 * 2 / 127 * N * scale)
+
+    @pytest.mark.slow
+    def test_int8_masked_windowed_counts_exact(self, grads):
+        nb = 6
+        valid = jnp.ones((nb,), jnp.float32).at[2].set(0.0)
+        cfg = GradSyncConfig(bucket_elems=64, axis_name="dp",
+                             average=True, rescale_target=float(N),
+                             return_elem_counts=False, transport="int8",
+                             transport_schedule="windowed", num_windows=2)
+        _, counts = _sync(grads, cfg, valid=valid, key=jax.random.key(4))
+        # the honesty contract: counts ride ONE exact int32 psum even
+        # when the payload is windowed+quantized
+        counts = np.asarray(counts)
+        assert counts[2] == 0
+        assert (np.delete(counts, 2) == N).all()
+
+    def test_multi_live_axes_rejected(self):
+        from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                      make_device_mesh)
+        mesh = make_device_mesh(MeshSpec(dp=4, sp=2))
+        cfg = GradSyncConfig(bucket_elems=64, axis_name=("dp", "sp"),
+                             average=True, rescale_target=8.0,
+                             return_elem_counts=False,
+                             transport_schedule="windowed")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P(),
+                 out_specs=P(), check_vma=False)
+        def run(g):
+            return allreduce_gradients(g, cfg).grads["w"]
+
+        with pytest.raises(ValueError, match="single"):
+            run({"w": jnp.ones((8, 8), jnp.float32)})
+
+    def test_unknown_schedule_rejected(self):
+        mesh = single_axis_mesh("dp")
+        cfg = GradSyncConfig(transport_schedule="pipelined")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P(),
+                 out_specs=P(), check_vma=False)
+        def run(g):
+            return allreduce_gradients(g, cfg).grads["w"]
+
+        with pytest.raises(ValueError, match="transport_schedule"):
+            run({"w": jnp.ones((8,), jnp.float32)})
+
+    def test_indivisible_bucket_elems_rejected(self):
+        mesh = single_axis_mesh("dp")
+        cfg = GradSyncConfig(bucket_elems=60, axis_name="dp",
+                             average=True, rescale_target=float(N),
+                             return_elem_counts=False,
+                             transport_schedule="windowed")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P(),
+                 out_specs=P(), check_vma=False)
+        def run(g):
+            return allreduce_gradients(g, cfg).grads["w"]
+
+        with pytest.raises(ValueError, match="bucket_elems"):
+            run({"w": jnp.ones((120,), jnp.float32)})
+
+    def test_size_one_axis_bypasses_schedule(self):
+        """live_axes empty => the schedule reduces to identity exactly
+        like every other transport's size-1 bypass."""
+        mesh = single_axis_mesh("dp", devices=jax.devices()[:1])
+        cfg = GradSyncConfig(bucket_elems=64, axis_name="dp",
+                             average=True, rescale_target=1.0,
+                             return_elem_counts=False,
+                             transport_schedule="windowed")
+        g = {"w": jnp.asarray(np.random.default_rng(0).normal(
+            size=(32,)).astype(np.float32))}
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P(),
+                 out_specs=P(), check_vma=False)
+        def run(g):
+            return allreduce_gradients(g, cfg).grads
+
+        out = run(g)
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(g["w"]))
