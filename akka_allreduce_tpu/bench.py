@@ -511,6 +511,105 @@ def measure_train_mfu(compute_dtype: str = "bf16",
     }
 
 
+def measure_serving_throughput(d_model: int = 512, n_layers: int = 4,
+                               d_ff: int = 2048, vocab: int = 2048,
+                               n_requests: int = 8, prompt_len: int = 16,
+                               steps: int = 32,
+                               slot_counts: "tuple[int, ...]" = (2, 4),
+                               reps: int = 3, seed: int = 0) -> list:
+    """Continuous-batching engine vs sequential per-request decode.
+
+    The serving-plane A/B (ISSUE 2 acceptance): N identical-budget
+    requests decoded (a) one ``generate()`` call per request — the
+    pre-serving workflow, one batch-1 decode scan each — and (b) through
+    ``serving/engine.py`` at each slot count. Same model, same prompts,
+    same token count both sides; the engine's win is batching decode
+    steps across requests (a batch-S step costs far less than S batch-1
+    steps on any backend whose decode is overhead- or bandwidth-bound),
+    bought WITHOUT the static-batch barrier — requests stream through
+    slots, so the win survives ragged budgets (the load the serve CLI
+    generates).
+
+    Timed runs follow one warm run per program shape (compile excluded,
+    the repo-wide rule); best-of-``reps`` wall time. Returns rows
+    ``serving_sequential_tok_s`` / ``serving_engine_s{S}_tok_s`` /
+    ``serving_throughput_speedup_s{S}``.
+    """
+    from akka_allreduce_tpu.models.generate import generate
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.serving import (EngineConfig, Request,
+                                            RequestScheduler,
+                                            SchedulerConfig,
+                                            ServingEngine, serve_loop)
+
+    plat = jax.devices()[0].platform
+    mcfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model,
+        n_heads=max(1, d_model // 64), n_layers=n_layers, d_ff=d_ff,
+        max_seq=prompt_len + steps)
+    params = init_transformer(jax.random.key(seed), mcfg)
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, vocab, size=(n_requests, prompt_len),
+                           dtype=np.int32)
+    total_tokens = n_requests * steps
+
+    def run_sequential():
+        for p in prompts:
+            np.asarray(generate(params, jnp.asarray(p)[None], mcfg,
+                                steps=steps))
+
+    _log(f"serving: sequential baseline ({n_requests} x {steps} tokens)")
+    run_sequential()  # compile + warm (one program: fixed shapes)
+    t_seq = min(_timed(run_sequential) for _ in range(reps))
+    seq_tok_s = total_tokens / t_seq
+    rows = [{"metric": f"serving_sequential_tok_s_{plat}",
+             "value": round(seq_tok_s, 1), "unit": "tok/s",
+             "note": f"{n_requests} requests x {steps} tokens, one "
+                     f"generate() scan each, d_model={d_model} "
+                     f"L={n_layers} vocab={vocab}"}]
+
+    def build_engine(slots):
+        # construction (KV-cache allocation, request setup) happens out
+        # here so the timed region is decode work only — the sequential
+        # arm's generate() calls likewise pay no per-rep setup
+        engine = ServingEngine(params, mcfg,
+                               EngineConfig(num_slots=slots))
+        sched = RequestScheduler(SchedulerConfig(), num_slots=slots)
+        for rid, p in enumerate(prompts):
+            sched.submit(Request(rid=rid, prompt=tuple(int(x) for x in p),
+                                 max_new_tokens=steps, submitted_at=0.0))
+        return engine, sched
+
+    def run_engine(pair):
+        serve_loop(*pair, max_dispatches=total_tokens + n_requests + 8)
+
+    for slots in slot_counts:
+        _log(f"serving: engine at {slots} slots")
+        run_engine(build_engine(slots))  # compile + warm the programs
+        t_eng = float("inf")
+        for _ in range(reps):
+            pair = build_engine(slots)
+            t_eng = min(t_eng, _timed(lambda: run_engine(pair)))
+        eng_tok_s = total_tokens / t_eng
+        rows.append({"metric": f"serving_engine_s{slots}_tok_s_{plat}",
+                     "value": round(eng_tok_s, 1), "unit": "tok/s",
+                     "note": f"continuous batching, {slots} slots, "
+                             f"same {n_requests} requests"})
+        rows.append({"metric": f"serving_throughput_speedup_s{slots}",
+                     "value": round(eng_tok_s / seq_tok_s, 3),
+                     "unit": "x",
+                     "note": f"engine@{slots} slots vs sequential "
+                             f"generate() ({plat})"})
+    return rows
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def main() -> None:
     """One measurement attempt on one platform; the repo-root ``bench.py``
     orchestrates attempts under a watchdog so a JSON line always lands.

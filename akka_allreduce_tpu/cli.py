@@ -16,6 +16,9 @@ the CLI surface maps as:
   AllreduceWorker.scala:309-315).
 * ``train`` — the flagship workload: dp x tp x sp transformer training on
   the available devices.
+* ``serve`` — the inference workload: the continuous-batching engine
+  (serving/) under a synthetic closed/open-loop load generator, with a
+  ``--selfcheck`` parity smoke for CI.
 * ``bench`` — the device-plane goodput benchmark (bench.py).
 * ``info`` — topology summary: the master's membership view, hardware
   edition.
@@ -1707,6 +1710,292 @@ def _cmd_bench(_args: argparse.Namespace) -> int:
     return 0
 
 
+def _add_serve(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "serve", help="continuous-batching inference engine "
+        "(serving/engine.py): slot-based KV caches, threshold-gated "
+        "scheduler, synthetic load generator; one JSON metrics line on "
+        "stdout")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="serve a trained checkpoint (model shape from "
+                        "the --d-model/... flags); omitted = fresh "
+                        "random weights from --seed (load-test / "
+                        "selfcheck mode — throughput and scheduling "
+                        "behavior do not depend on trained values)")
+    _add_model_args(p)
+    p.add_argument("--max-seq", type=int, default=128,
+                   help="KV-cache length per slot; every request needs "
+                        "prompt + max-new-tokens <= this")
+    # -- engine
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slots (the fixed batch width; occupancy "
+                        "is a metric, not a shape)")
+    p.add_argument("--kv-cache", choices=("model", "int8"),
+                   default="model",
+                   help="per-slot KV cache format: model dtype, or "
+                        "int8 (4x less cache HBM per slot at a bounded "
+                        "logit error; models/generate.py quantize_kv)")
+    p.add_argument("--prefill-buckets", default="",
+                   help="comma list of prompt-length buckets (prompts "
+                        "pad up to the next bucket, bounding compiled-"
+                        "program count); empty = one exact-length "
+                        "program per distinct prompt length (the "
+                        "bitwise-parity mode)")
+    # -- scheduler
+    p.add_argument("--queue-depth", type=int, default=256,
+                   help="admission-queue bound: submits beyond it are "
+                        "rejected (backpressure at the edge)")
+    p.add_argument("--policy", choices=("fifo", "deadline"),
+                   default="fifo",
+                   help="admission order: arrival order, or earliest "
+                        "absolute deadline first")
+    p.add_argument("--th-step", type=float, default=0.0,
+                   help="occupancy fraction gating a decode step — the "
+                        "protocol plane's threshold dial pointed at the "
+                        "batch: 0.0 never waits (continuous batching), "
+                        "1.0 reconstructs the full-batch barrier "
+                        "(A/B baseline). The gate only ever waits for "
+                        "work that is actually due")
+    # -- synthetic load
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--load", choices=("closed", "open"), default="closed",
+                   help="closed = all requests queued at t0 (throughput "
+                        "regime); open = Poisson arrivals at "
+                        "--arrival-rate (latency-under-load regime)")
+    p.add_argument("--arrival-rate", type=float, default=0.0,
+                   help="open loop: mean arrivals per second")
+    p.add_argument("--prompt-len", default="4:16", metavar="MIN:MAX",
+                   help="synthetic prompt length range (uniform)")
+    p.add_argument("--max-new-tokens", type=int, default=32,
+                   help="decode budget per request")
+    p.add_argument("--eos-token", type=int, default=None,
+                   help="attach this EOS id to every synthetic request "
+                        "(sequences end early when the model emits it)")
+    p.add_argument("--deadline-slack-s", type=float, default=0.0,
+                   help="with --policy deadline: synthetic per-request "
+                        "deadline = arrival + slack")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace-file", default=None,
+                   help="write serve_* lifecycle events + prefill/step "
+                        "spans (JSONL, runtime/tracing.py) here on exit")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="CI smoke: tiny fixed model, 8 synthetic "
+                        "requests (half with an EOS), asserts every "
+                        "request's tokens equal standalone generate() "
+                        "and throughput is nonzero; exit 1 on any "
+                        "mismatch")
+    _add_backend_args(p)
+
+
+def _serve_selfcheck(args: argparse.Namespace) -> int:
+    """The tier-1 CI smoke: engine-vs-generate parity on a tiny model
+    under slot churn, plus liveness of the metrics plane. Deliberately
+    ignores the model-shape flags — the check must stay cheap and
+    deterministic no matter how the command is invoked."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.models.generate import generate
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.serving import (EngineConfig, Request,
+                                            RequestScheduler,
+                                            SchedulerConfig, ServingEngine,
+                                            ServingMetrics, serve_loop)
+
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=24)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(7)
+    eos = 5
+    reqs = []
+    for rid in range(8):
+        plen = int(rng.integers(2, 7))
+        reqs.append(Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in rng.integers(0, cfg.vocab_size,
+                                                      size=plen)),
+            max_new_tokens=int(rng.integers(4, 9)),
+            eos_token=eos if rid % 2 else None))
+    engine = ServingEngine(params, cfg, EngineConfig(num_slots=3))
+    sched = RequestScheduler(SchedulerConfig(), num_slots=3)
+    metrics = ServingMetrics()
+    for r in reqs:
+        metrics.on_submit(r.rid)
+        sched.submit(r)
+    results = serve_loop(engine, sched, metrics=metrics,
+                         max_dispatches=200)
+    failures = []
+    for r in reqs:
+        prompt = jnp.asarray(r.prompt, jnp.int32)[None]
+        if r.eos_token is None:
+            want = np.asarray(generate(params, prompt, cfg,
+                                       steps=r.max_new_tokens))[0]
+        else:
+            toks, lengths = generate(params, prompt, cfg,
+                                     steps=r.max_new_tokens,
+                                     eos_token=r.eos_token)
+            want = np.asarray(toks)[0][:int(lengths[0])]
+        got = np.asarray(results[r.rid][0], np.int32)
+        if not np.array_equal(got, want):
+            failures.append(f"rid={r.rid}: engine {got.tolist()} != "
+                            f"generate {want.tolist()}")
+    tput = metrics.decode_tokens_per_s or 0.0
+    if tput <= 0.0:
+        failures.append(f"throughput not positive: {tput}")
+    print(json.dumps({
+        "selfcheck": "ok" if not failures else "FAIL",
+        "requests": len(reqs),
+        "decode_tokens_per_s": round(tput, 1),
+        "decode_dispatches": engine.decode_dispatches,
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    _apply_backend_flags(args)
+    if args.selfcheck:
+        return _serve_selfcheck(args)
+    import jax
+    import numpy as np
+
+    from akka_allreduce_tpu.runtime.tracing import tracer_to_file
+    from akka_allreduce_tpu.serving import (EngineConfig, QueueFull,
+                                            Request, RequestScheduler,
+                                            SchedulerConfig, ServingEngine,
+                                            ServingMetrics, serve_loop)
+
+    try:
+        lo, _, hi = args.prompt_len.partition(":")
+        p_lo, p_hi = int(lo), int(hi or lo)
+    except ValueError:
+        print(f"error: bad --prompt-len {args.prompt_len!r} "
+              f"(want MIN:MAX)", file=sys.stderr)
+        return 2
+    if not 1 <= p_lo <= p_hi:
+        print(f"error: --prompt-len needs 1 <= MIN <= MAX, got "
+              f"{p_lo}:{p_hi}", file=sys.stderr)
+        return 2
+    if args.max_new_tokens < 1:
+        print(f"error: --max-new-tokens must be >= 1, got "
+              f"{args.max_new_tokens}", file=sys.stderr)
+        return 2
+    if p_hi + args.max_new_tokens > args.max_seq:
+        print(f"error: --prompt-len max {p_hi} + --max-new-tokens "
+              f"{args.max_new_tokens} exceeds --max-seq {args.max_seq}",
+              file=sys.stderr)
+        return 2
+    if args.requests < 1:
+        print("error: --requests must be >= 1", file=sys.stderr)
+        return 2
+    if args.load == "open" and args.arrival_rate <= 0:
+        print("error: --load open needs --arrival-rate > 0",
+              file=sys.stderr)
+        return 2
+    if args.eos_token is not None \
+            and not 0 <= args.eos_token < args.vocab:
+        print(f"error: --eos-token {args.eos_token} out of vocab "
+              f"[0, {args.vocab})", file=sys.stderr)
+        return 2
+    try:
+        buckets = tuple(int(b) for b in args.prefill_buckets.split(",")
+                        if b.strip())
+    except ValueError:
+        print(f"error: bad --prefill-buckets "
+              f"{args.prefill_buckets!r}", file=sys.stderr)
+        return 2
+    if buckets and max(buckets) < p_hi:
+        print(f"error: largest prefill bucket {max(buckets)} smaller "
+              f"than --prompt-len max {p_hi}", file=sys.stderr)
+        return 2
+
+    mcfg = _build_model_config(args, args.max_seq)
+    if args.ckpt_dir:
+        restored = _restore_params(args, mcfg)
+        if isinstance(restored, int):
+            return restored
+        _step0, params = restored
+    else:
+        from akka_allreduce_tpu.models.transformer import init_transformer
+        params = init_transformer(jax.random.key(args.seed), mcfg)
+
+    rng = np.random.default_rng(args.seed)
+    arrivals = np.zeros(args.requests)
+    if args.load == "open":
+        arrivals = np.cumsum(rng.exponential(1.0 / args.arrival_rate,
+                                             size=args.requests))
+    t0 = time.monotonic()
+    reqs = []
+    for rid in range(args.requests):
+        plen = int(rng.integers(p_lo, p_hi + 1))
+        arrival = t0 + float(arrivals[rid])
+        reqs.append(Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in rng.integers(
+                0, args.vocab, size=plen)),
+            max_new_tokens=args.max_new_tokens,
+            eos_token=args.eos_token,
+            arrival=arrival,
+            deadline=(arrival + args.deadline_slack_s
+                      if args.deadline_slack_s > 0 else None),
+            submitted_at=arrival))
+
+    with tracer_to_file(args.trace_file) as tracer:
+        metrics = ServingMetrics(tracer=tracer)
+        try:
+            engine = ServingEngine(
+                params, mcfg,
+                EngineConfig(
+                    num_slots=args.slots, prefill_buckets=buckets,
+                    kv_dtype="int8" if args.kv_cache == "int8"
+                    else None),
+                tracer=tracer)
+            sched = RequestScheduler(
+                SchedulerConfig(max_queue_depth=args.queue_depth,
+                                policy=args.policy,
+                                th_step=args.th_step),
+                num_slots=args.slots,
+                # open-loop overload: a request ARRIVING to a full
+                # queue is shed at the edge — the rejection count is
+                # the result, not an error (the scheduler applies the
+                # depth bound at arrival time, so future-dated submits
+                # below never reject here)
+                on_reject=metrics.on_reject)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        for r in reqs:
+            metrics.on_submit(r.rid)
+            try:
+                sched.submit(r)
+            except QueueFull:
+                pass  # counted via on_reject
+        with metrics.host_sampler() as sampler:
+            results = serve_loop(engine, sched, metrics=metrics)
+    report = {
+        "config": {"slots": args.slots, "requests": args.requests,
+                   "load": args.load, "policy": args.policy,
+                   "th_step": args.th_step, "kv_cache": args.kv_cache,
+                   "prefill_buckets": list(buckets),
+                   "max_new_tokens": args.max_new_tokens},
+        "completed_reasons": {
+            reason: sum(1 for toks, r in results.values()
+                        if r == reason)
+            for reason in {r for _, r in results.values()}},
+        "prefill_dispatches": engine.prefill_dispatches,
+        "prefill_programs": len(engine.prefill_shapes),
+        "kv_cache_mb": round(engine.kv_cache_bytes() / 1e6, 2),
+        "host": sampler.summary(),
+        **metrics.summary(),
+    }
+    if args.trace_file:
+        print(f"trace -> {args.trace_file}", file=sys.stderr)
+    print(json.dumps(report))
+    return 0
+
+
 
 def _add_eval(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
@@ -1804,6 +2093,7 @@ def main(argv: list[str] | None = None) -> int:
     _add_worker(sub)
     _add_train(sub)
     _add_generate(sub)
+    _add_serve(sub)
     _add_eval(sub)
     p_info = sub.add_parser("info", help="topology summary; --scaling "
                             "prints the analytic ICI scaling curve")
@@ -1826,7 +2116,8 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     return {"emulate": _cmd_emulate, "master": _cmd_master,
             "worker": _cmd_worker, "train": _cmd_train,
-            "generate": _cmd_generate, "eval": _cmd_eval,
+            "generate": _cmd_generate, "serve": _cmd_serve,
+            "eval": _cmd_eval,
             "info": _cmd_info, "bench": _cmd_bench}[args.cmd](args)
 
 

@@ -44,20 +44,65 @@ from akka_allreduce_tpu.parallel.ring_attention import (
 )
 
 
-def init_kv_cache(cfg: TransformerConfig, batch: int) -> dict:
+def init_kv_cache(cfg: TransformerConfig, batch: int,
+                  kv_dtype: "str | None" = None) -> dict:
     """Static-shape cache: one (batch, max_seq, kv_heads, head_dim) K and V
     buffer per layer, plus the write position. Buffers use the model's
     compute dtype — the parity contract (and, for bf16 models, half the
     cache HBM) depends on the cached K/V matching what the full forward's
     attention consumed. Under grouped-query attention the cache holds only
     the kv_heads — the GQA decode win: cache HBM shrinks by the group
-    factor."""
+    factor.
+
+    ``kv_dtype="int8"`` switches to a quantized cache: K/V are stored as
+    symmetric int8 with one f32 scale per written (position, head) vector
+    (the chunk granularity of ops/pallas_kernels/quantized.py, here the
+    head is the chunk), quartering (bf16: halving) cache HBM at a bounded
+    logit error (pinned by tests/test_generate.py::TestQuantizedKV).
+    Scales ride in ``k_scale``/``v_scale`` entries; every cache consumer
+    (decode_step / prefill / extend / the serving engine) branches on
+    their presence, so the pytree structure IS the format switch."""
     shape = (cfg.n_layers, batch, cfg.max_seq, cfg.kv_heads, cfg.head_dim)
+    if kv_dtype is None:
+        return {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((), jnp.int32),
+        }
+    if str(kv_dtype) not in ("int8",):
+        raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                         f"(None = model dtype, or 'int8')")
+    scale_shape = shape[:-1]
     return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.zeros(scale_shape, jnp.float32),
+        "v_scale": jnp.zeros(scale_shape, jnp.float32),
         "pos": jnp.zeros((), jnp.int32),
     }
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(..., head_dim) f32/bf16 -> (int8 values, f32 scales (...,)).
+
+    The quantized.py idiom at KV granularity: symmetric per-chunk scale
+    (abs-max / 127, floored at 1e-30 so all-zero vectors divide cleanly),
+    clip to [-127, 127] — but ROUND-TO-NEAREST instead of stochastic:
+    a cache entry is re-read every step, so the rounding must be
+    deterministic (stochastic rounding buys unbiasedness across many
+    independent sums, which gradient transport has and a KV reuse does
+    not)."""
+    xf = x.astype(jnp.float32)
+    abs_max = jnp.max(jnp.abs(xf), axis=-1)
+    scales = jnp.maximum(abs_max / 127.0, 1e-30)
+    q = jnp.clip(jnp.round(xf / scales[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scales
+
+
+def dequantize_kv(values: jnp.ndarray, scales: jnp.ndarray,
+                  dtype) -> jnp.ndarray:
+    """Inverse of :func:`quantize_kv`, cast to the compute ``dtype``."""
+    return (values.astype(jnp.float32) * scales[..., None]).astype(dtype)
 
 
 def _cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
@@ -116,11 +161,14 @@ def decode_step(params: dict, cache: dict, token: jnp.ndarray,
     """
     b = token.shape[0]
     pos = cache["pos"]
+    quantized = "k_scale" in cache
     x = params["embed"][token][:, None, :]
     if not cfg.rope:
         x = x + lax.dynamic_slice_in_dim(params["pos"], pos, 1,
                                          axis=0)[None]
     k_cache, v_cache = cache["k"], cache["v"]
+    if quantized:
+        k_scales, v_scales = cache["k_scale"], cache["v_scale"]
     for i, layer in enumerate(params["layers"]):
         h = rmsnorm(x, layer["ln1"])
         q = (h @ layer["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
@@ -129,11 +177,26 @@ def decode_step(params: dict, cache: dict, token: jnp.ndarray,
         if cfg.rope:
             q = apply_rope(q, pos[None], cfg.rope_theta)
             k = apply_rope(k, pos[None], cfg.rope_theta)
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k[None].astype(k_cache.dtype), (i, 0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v[None].astype(v_cache.dtype), (i, 0, pos, 0, 0))
-        attn = _cached_attention(q, k_cache[i], v_cache[i], pos,
+        if quantized:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_cache = lax.dynamic_update_slice(
+                k_cache, kq[None], (i, 0, pos, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, vq[None], (i, 0, pos, 0, 0))
+            k_scales = lax.dynamic_update_slice(
+                k_scales, ks[None], (i, 0, pos, 0))
+            v_scales = lax.dynamic_update_slice(
+                v_scales, vs[None], (i, 0, pos, 0))
+            k_all = dequantize_kv(k_cache[i], k_scales[i], cfg.dtype)
+            v_all = dequantize_kv(v_cache[i], v_scales[i], cfg.dtype)
+        else:
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k[None].astype(k_cache.dtype), (i, 0, pos, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v[None].astype(v_cache.dtype), (i, 0, pos, 0, 0))
+            k_all, v_all = k_cache[i], v_cache[i]
+        attn = _cached_attention(q, k_all, v_all, pos,
                                  window=cfg.attn_window)
         x = x + attn.reshape(b, 1, -1) @ layer["wo"]
 
@@ -148,20 +211,36 @@ def decode_step(params: dict, cache: dict, token: jnp.ndarray,
             x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
     logits = lm_logits(params, rmsnorm(x, params["out_norm"]), cfg)
     new_cache = {"k": k_cache, "v": v_cache, "pos": pos + 1}
+    if quantized:
+        new_cache["k_scale"], new_cache["v_scale"] = k_scales, v_scales
     return new_cache, logits[:, 0, :]
 
 
 def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
-            cfg: TransformerConfig) -> tuple[dict, jnp.ndarray]:
+            cfg: TransformerConfig,
+            logit_pos: "jnp.ndarray | int | None" = None
+            ) -> tuple[dict, jnp.ndarray]:
     """Fill the cache from the prompt (b, t) in ONE batched forward —
     full-width matmuls on the MXU instead of t sequential single-token
     steps — and return (cache after the prompt, last-position logits).
-    Same block math as decode_step/transformer_apply (parity-pinned)."""
+    Same block math as decode_step/transformer_apply (parity-pinned).
+
+    ``logit_pos`` (dynamic) returns the logits at that prompt position
+    instead of the last — the bucketed-prefill hook (serving/engine.py):
+    a prompt of true length n padded to bucket length t reads its
+    next-token logits at n-1, while causality keeps positions < n
+    untouched by the padding (pad K/V beyond n is garbage the decode
+    position mask never admits, and is overwritten as decode advances).
+    The returned cache's ``pos`` is always t; bucketed callers own the
+    true frontier."""
     b, t = prompt.shape
+    quantized = "k_scale" in cache
     x = params["embed"][prompt]
     if not cfg.rope:
         x = x + params["pos"][:t][None]
     k_cache, v_cache = cache["k"], cache["v"]
+    if quantized:
+        k_scales, v_scales = cache["k_scale"], cache["v_scale"]
     for i, layer in enumerate(params["layers"]):
         h = rmsnorm(x, layer["ln1"])
         q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
@@ -170,10 +249,25 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
         if cfg.rope:
             q = apply_rope(q, jnp.arange(t), cfg.rope_theta)
             k = apply_rope(k, jnp.arange(t), cfg.rope_theta)
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k[None].astype(k_cache.dtype), (i, 0, 0, 0, 0))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v[None].astype(v_cache.dtype), (i, 0, 0, 0, 0))
+        if quantized:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_cache = lax.dynamic_update_slice(
+                k_cache, kq[None], (i, 0, 0, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, vq[None], (i, 0, 0, 0, 0))
+            k_scales = lax.dynamic_update_slice(
+                k_scales, ks[None], (i, 0, 0, 0))
+            v_scales = lax.dynamic_update_slice(
+                v_scales, vs[None], (i, 0, 0, 0))
+        else:
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k[None].astype(k_cache.dtype), (i, 0, 0, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v[None].astype(v_cache.dtype), (i, 0, 0, 0, 0))
+        # prompt positions attend the freshly-computed block K/V, not the
+        # cache, so prefill logits are identical under either cache
+        # format — quantization error enters at decode-time REREADS only
         attn = local_causal_attention(q, k, v, window=cfg.attn_window)
         x = x + attn.reshape(b, t, -1) @ layer["wo"]
 
@@ -186,10 +280,13 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
                      * (h @ layer["w3"])) @ layer["w2"]
         else:
             x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
-    logits = lm_logits(params, rmsnorm(x[:, -1:], params["out_norm"]),
-                       cfg)
+    x_last = (x[:, -1:] if logit_pos is None
+              else lax.dynamic_slice_in_dim(x, logit_pos, 1, axis=1))
+    logits = lm_logits(params, rmsnorm(x_last, params["out_norm"]), cfg)
     new_cache = {"k": k_cache, "v": v_cache,
                  "pos": jnp.asarray(t, jnp.int32)}
+    if quantized:
+        new_cache["k_scale"], new_cache["v_scale"] = k_scales, v_scales
     return new_cache, logits[:, 0, :]
 
 
@@ -217,16 +314,32 @@ def _filter_top_p(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "temperature",
-                                   "top_k", "top_p"))
+                                   "top_k", "top_p", "eos_token",
+                                   "kv_dtype"))
 def generate(params: dict, prompt: jnp.ndarray, cfg: TransformerConfig,
              steps: int, key: Optional[jax.Array] = None,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             top_p: Optional[float] = None) -> jnp.ndarray:
+             top_p: Optional[float] = None,
+             eos_token: Optional[int] = None,
+             kv_dtype: Optional[str] = None):
     """Generate ``steps`` tokens after ``prompt`` (b, t) int32. Greedy when
     ``temperature == 0`` (key unused), else temperature sampling with
     optional top-k and/or top-p (nucleus) filtering — both static over the
     sampling mode, so each (mode, shape) pair compiles exactly once.
-    Returns (b, steps) int32. One compiled program: prefill + decode scan."""
+    Returns (b, steps) int32. One compiled program: prefill + decode scan.
+
+    ``eos_token`` turns on per-sequence early termination: a sequence
+    that emits it is DONE — every later step emits ``eos_token`` again
+    (the scan keeps its static shape; the done-mask rides the carry) —
+    and the return becomes ``(tokens (b, steps), lengths (b,))`` where
+    ``lengths[i]`` counts tokens through the first EOS (``steps`` when
+    none fired). Finished sequences still occupy their decode lane: the
+    scan is the fixed-batch regime; reclaiming the lane for new work is
+    the serving engine's job (serving/engine.py).
+
+    ``kv_dtype="int8"`` decodes against the quantized KV cache
+    (:func:`init_kv_cache`) — same program shape, a bounded logit error
+    (tests/test_generate.py::TestQuantizedKV)."""
     if prompt.shape[1] + steps > cfg.max_seq:
         raise ValueError(
             f"prompt {prompt.shape[1]} + steps {steps} exceeds "
@@ -235,8 +348,11 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: TransformerConfig,
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if eos_token is not None and not 0 <= eos_token < cfg.vocab_size:
+        raise ValueError(f"eos_token {eos_token} out of vocab "
+                         f"[0, {cfg.vocab_size})")
     b = prompt.shape[0]
-    cache = init_kv_cache(cfg, b)
+    cache = init_kv_cache(cfg, b, kv_dtype=kv_dtype)
     cache, logits = prefill(params, cache, prompt, cfg)
     if key is None:
         key = jax.random.key(0)
@@ -252,11 +368,23 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: TransformerConfig,
         return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
     def one(carry, k):
-        cache, logits = carry
+        cache, logits, done = carry
         tok = pick(logits, k)
+        if eos_token is not None:
+            # an already-done row keeps emitting EOS (stable padding);
+            # rows finishing THIS step keep their freshly-picked EOS
+            tok = jnp.where(done, jnp.int32(eos_token), tok)
+            done = done | (tok == eos_token)
         cache, logits = decode_step(params, cache, tok, cfg)
-        return (cache, logits), tok
+        return (cache, logits, done), tok
 
     keys = jax.random.split(key, steps)
-    _, tokens = lax.scan(one, (cache, logits), keys)
-    return tokens.T  # (b, steps)
+    done0 = jnp.zeros((b,), bool)
+    _, tokens = lax.scan(one, (cache, logits, done0), keys)
+    tokens = tokens.T  # (b, steps)
+    if eos_token is None:
+        return tokens
+    hit = tokens == eos_token
+    lengths = jnp.where(hit.any(axis=1),
+                        jnp.argmax(hit, axis=1) + 1, steps)
+    return tokens, lengths.astype(jnp.int32)

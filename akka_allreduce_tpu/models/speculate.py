@@ -41,8 +41,10 @@ from akka_allreduce_tpu.models.generate import (
     _filter_top_k,
     _filter_top_p,
     decode_step,
+    dequantize_kv,
     init_kv_cache,
     prefill,
+    quantize_kv,
 )
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
@@ -93,11 +95,14 @@ def extend(params: dict, cache: dict, tokens: jnp.ndarray,
     sequential decode_step calls is pinned by tests/test_speculative.py."""
     b, t = tokens.shape
     pos = cache["pos"]
+    quantized = "k_scale" in cache
     x = params["embed"][tokens]
     if not cfg.rope:
         x = x + lax.dynamic_slice_in_dim(params["pos"], pos, t,
                                          axis=0)[None]
     k_cache, v_cache = cache["k"], cache["v"]
+    if quantized:
+        k_scales, v_scales = cache["k_scale"], cache["v_scale"]
     positions = pos + jnp.arange(t)
     for i, layer in enumerate(params["layers"]):
         h = rmsnorm(x, layer["ln1"])
@@ -107,11 +112,26 @@ def extend(params: dict, cache: dict, tokens: jnp.ndarray,
         if cfg.rope:
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k[None].astype(k_cache.dtype), (i, 0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v[None].astype(v_cache.dtype), (i, 0, pos, 0, 0))
-        attn = _block_cached_attention(q, k_cache[i], v_cache[i], pos,
+        if quantized:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_cache = lax.dynamic_update_slice(
+                k_cache, kq[None], (i, 0, pos, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, vq[None], (i, 0, pos, 0, 0))
+            k_scales = lax.dynamic_update_slice(
+                k_scales, ks[None], (i, 0, pos, 0))
+            v_scales = lax.dynamic_update_slice(
+                v_scales, vs[None], (i, 0, pos, 0))
+            k_all = dequantize_kv(k_cache[i], k_scales[i], cfg.dtype)
+            v_all = dequantize_kv(v_cache[i], v_scales[i], cfg.dtype)
+        else:
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k[None].astype(k_cache.dtype), (i, 0, pos, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v[None].astype(v_cache.dtype), (i, 0, pos, 0, 0))
+            k_all, v_all = k_cache[i], v_cache[i]
+        attn = _block_cached_attention(q, k_all, v_all, pos,
                                        window=cfg.attn_window)
         x = x + attn.reshape(b, t, -1) @ layer["wo"]
 
@@ -126,16 +146,19 @@ def extend(params: dict, cache: dict, tokens: jnp.ndarray,
             x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
     logits = lm_logits(params, rmsnorm(x, params["out_norm"]), cfg)
     new_cache = {"k": k_cache, "v": v_cache, "pos": pos + t}
+    if quantized:
+        new_cache["k_scale"], new_cache["v_scale"] = k_scales, v_scales
     return new_cache, logits
 
 
 @partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",
-                                   "k"))
+                                   "k", "eos_token"))
 def speculative_generate(target_params: dict, draft_params: dict,
                          prompt: jnp.ndarray,
                          target_cfg: TransformerConfig,
                          draft_cfg: TransformerConfig,
-                         steps: int, k: int = 4
+                         steps: int, k: int = 4,
+                         eos_token: Optional[int] = None
                          ) -> tuple[jnp.ndarray, dict]:
     """Greedy speculative decode: ``steps`` tokens after ``prompt``
     (1, t), bit-identical to ``generate(temperature=0)`` on the target
@@ -143,6 +166,16 @@ def speculative_generate(target_params: dict, draft_params: dict,
     ``rounds`` (target extend passes) and ``drafted``/``accepted``
     totals — acceptance_rate = accepted / drafted; speedup comes from
     rounds << steps when the draft predicts the target well.
+
+    ``eos_token`` adds early termination: the while_loop's condition
+    gains a done flag, so a sequence that emits EOS stops spending
+    target passes IMMEDIATELY (batch is 1, so unlike generate()'s
+    fixed-shape scan this is a real wall-clock saving, not just
+    bookkeeping). The output pads positions after the first EOS with
+    ``eos_token`` — the same padding generate() emits, keeping the
+    bit-identical contract through the padded tail — and stats gains
+    ``length`` (tokens through the first EOS, = steps when none
+    fired).
 
     Per round: the draft proposes g_1..g_k (k cheap steps from the last
     emitted token ``cur``); the target consumes [cur, g_1..g_{k-1}] in
@@ -159,6 +192,10 @@ def speculative_generate(target_params: dict, draft_params: dict,
             f"plain decode scan for batch {prompt.shape[0]}")
     if not 1 <= k:
         raise ValueError(f"k must be >= 1, got {k}")
+    if eos_token is not None \
+            and not 0 <= eos_token < target_cfg.vocab_size:
+        raise ValueError(f"eos_token {eos_token} out of vocab "
+                         f"[0, {target_cfg.vocab_size})")
     if draft_cfg.vocab_size != target_cfg.vocab_size:
         raise ValueError(
             f"draft and target must share a vocabulary: "
@@ -194,8 +231,8 @@ def speculative_generate(target_params: dict, draft_params: dict,
     out0 = out0.at[0].set(cur0[0])
 
     def round_body(carry):
-        t_cache, d_cache, out, n_out, cur, rounds, drafted, accepted = \
-            carry
+        (t_cache, d_cache, out, n_out, cur, done, rounds, drafted,
+         accepted) = carry
 
         # -- draft: k greedy proposals from cur (k cheap steps)
         def draft_one(c, _):
@@ -226,25 +263,42 @@ def speculative_generate(target_params: dict, draft_params: dict,
         out = lax.dynamic_update_slice(out, emit_vec, (n_out,))
         new_cur = emit_vec[emit_len - 1][None]
         n_out = n_out + emit_len
+        if eos_token is not None:
+            done = done | ((emit_vec == eos_token)
+                           & (jnp.arange(k) < emit_len)).any()
 
         # rewind both caches to the emitted frontier: consumed tokens
         # must equal emitted-1 (cur is emitted but not yet consumed)
         frontier = t_cache["pos"] - k + emit_len
         t_cache = {**t_cache, "pos": frontier}
         d_cache = {**d_cache, "pos": frontier}
-        return (t_cache, d_cache, out, n_out, new_cur, rounds + 1,
+        return (t_cache, d_cache, out, n_out, new_cur, done, rounds + 1,
                 drafted + k, accepted + n_acc)
 
     def cond(carry):
-        return carry[3] < steps
+        return (carry[3] < steps) & ~carry[5]
 
+    done0 = (jnp.asarray(False) if eos_token is None
+             else cur0[0] == eos_token)
     init = (t_cache, d_cache, out0, jnp.asarray(1, jnp.int32), cur0,
-            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+            done0, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
             jnp.asarray(0, jnp.int32))
-    (_, _, out, _, _, rounds, drafted, accepted) = lax.while_loop(
+    (_, _, out, n_out, _, _, rounds, drafted, accepted) = lax.while_loop(
         cond, round_body, init)
     stats = {"rounds": rounds, "drafted": drafted, "accepted": accepted}
-    return out[:steps][None], stats
+    out = out[:steps]
+    if eos_token is not None:
+        # a final round can overshoot: accepted draft tokens past the
+        # EOS are already in the buffer. Mask everything after the
+        # first EOS to EOS — exactly generate()'s done-row padding —
+        # so parity holds through the tail
+        hit = out == eos_token
+        length = jnp.where(hit.any(), jnp.argmax(hit) + 1,
+                           jnp.minimum(n_out, steps))
+        out = jnp.where(jnp.arange(steps) < length, out,
+                        jnp.int32(eos_token))
+        stats["length"] = length.astype(jnp.int32)
+    return out[None], stats
 
 
 def _residual_resample(p: jnp.ndarray, q: jnp.ndarray,

@@ -1,0 +1,51 @@
+"""Serving plane: continuous-batching inference over the decode stack.
+
+The reference (and the training planes built on it) stops at single-shot
+decoding; this package is the first user-facing WORKLOAD layer — the part
+of the north star that actually "serves heavy traffic". Its organizing
+idea is the paper's: progress is THRESHOLD-GATED, never barriered on the
+slowest participant. A classic batch server waits until a full batch of
+requests has arrived (the all-arrivals barrier, the moral twin of a
+threshold-1.0 allreduce round); the continuous-batching engine instead
+admits whatever requests are ready into whatever decode slots are free
+and steps the batch it has (scheduler.py's ``th_step`` is the same dial
+as the protocol plane's ``ThresholdConfig`` fractions — 0.0 = never
+wait, 1.0 = the full-batch barrier, kept only as the A/B baseline).
+
+Modules:
+
+* ``engine.py`` — the device plane: fixed-slot batch, per-slot KV caches,
+  one jitted step advancing every occupied slot (static shapes, compiles
+  once), slot-granular prefill refill.
+* ``scheduler.py`` — the admission plane: FIFO / earliest-deadline queue,
+  max-depth backpressure, per-request budgets, slot accounting.
+* ``metrics.py`` — TTFT/TPOT/queue-depth/occupancy histograms, wired
+  into runtime/tracing.py spans and runtime/metrics.py host sampling.
+
+Entry point: ``python -m akka_allreduce_tpu.cli serve`` (cli.py).
+"""
+
+from akka_allreduce_tpu.serving.engine import (
+    EngineConfig,
+    ServingEngine,
+    serve_loop,
+)
+from akka_allreduce_tpu.serving.metrics import Histogram, ServingMetrics
+from akka_allreduce_tpu.serving.scheduler import (
+    QueueFull,
+    Request,
+    RequestScheduler,
+    SchedulerConfig,
+)
+
+__all__ = [
+    "EngineConfig",
+    "ServingEngine",
+    "serve_loop",
+    "Histogram",
+    "ServingMetrics",
+    "QueueFull",
+    "Request",
+    "RequestScheduler",
+    "SchedulerConfig",
+]

@@ -1,0 +1,497 @@
+"""Continuous-batching decode engine: fixed slots, per-slot KV caches.
+
+The device plane of the serving stack. A classic batch server decodes a
+batch of requests in lockstep from prompt to finish: every request waits
+for the slowest in its batch (the all-participants barrier the paper's
+threshold protocol exists to break). This engine instead holds a FIXED
+array of decode slots; one jitted step advances every occupied slot one
+token at its OWN position, a finished slot (EOS / stop token / budget)
+is freed immediately, and a freed slot is refilled by prefilling the
+next queued prompt — requests stream through the batch instead of
+defining it.
+
+Static-shape discipline (the TPU rule: the program must compile once):
+
+* The slot batch never changes shape. Free slots keep computing — their
+  lanes produce garbage the host ignores — because a data-dependent
+  batch size would mean a recompile per membership change. Occupancy is
+  an efficiency metric (serving/metrics.py), not a shape.
+* Per-slot positions are a host-owned ``(slots,)`` vector fed to the
+  one compiled step; attention masks by position against the static
+  cache buffer exactly as models/generate.py decodes (``k_idx <= pos``
+  — the causal mask IS the length mask), so slot churn never changes
+  the program.
+* Prefill is slot-granular and length-keyed: each distinct prompt
+  length (or bucket, with ``prefill_buckets``) is its own compiled
+  program, reused for every request at that length. The default —
+  exact-length programs — runs literally the jaxpr ``generate()`` runs
+  for its prefill, which is what makes the engine's greedy parity
+  contract BITWISE (tests/test_serving_engine.py): padding a prompt to
+  a bucket perturbs prefill logits at the ulp level (reduction lengths
+  change), which greedy argmax absorbs in practice but the contract
+  does not promise.
+
+The decode step is ``decode_step``'s block math with the batch-wide
+position scalar generalized to a per-slot vector (``_slot_decode_step``
+— same op sequence at the same reduction lengths per row; an earlier
+vmap-of-decode_step formulation was correct but lowered the per-slot
+cache writes to scatters ~1.5x slower than the batched program). A
+request's tokens therefore do not depend on which slot it landed in or
+who shares the batch (same caveat as generate.py: MoE capacity binds
+per-batch — run serving MoE with generous ``capacity_factor``).
+
+The host loop costs one dispatch + one (slots,) readback per token —
+the continuous-batching shape; amortizing dispatches by scanning
+multiple steps between admission checks is a latency/occupancy trade
+the bench can explore later.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from akka_allreduce_tpu.models.generate import (
+    dequantize_kv,
+    init_kv_cache,
+    prefill,
+    quantize_kv,
+)
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    lm_logits,
+    rmsnorm,
+)
+from akka_allreduce_tpu.parallel.ep import moe_ffn
+from akka_allreduce_tpu.parallel.ring_attention import NEG_INF
+from akka_allreduce_tpu.serving.scheduler import Request, RequestScheduler
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine shape knobs.
+
+    ``prefill_buckets``: sorted prompt-length buckets; a prompt pads up
+    to the smallest covering bucket, bounding the compiled-program count
+    at the cost of ulp-level prefill drift (see module docstring).
+    Empty (default) = one exact-length program per distinct prompt
+    length — unbounded program count, bitwise parity.
+
+    ``kv_dtype="int8"``: quantized per-slot KV cache
+    (models/generate.py ``init_kv_cache``), 4x (bf16: 2x) less cache
+    HBM per slot — i.e. 4x the slots per chip at a bounded logit error.
+    """
+
+    num_slots: int = 4
+    prefill_buckets: tuple = ()
+    kv_dtype: Optional[str] = None
+
+    def __post_init__(self):
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, "
+                             f"got {self.num_slots}")
+        if list(self.prefill_buckets) != sorted(set(
+                self.prefill_buckets)) or any(
+                b < 1 for b in self.prefill_buckets):
+            raise ValueError(
+                f"prefill_buckets must be strictly increasing positive "
+                f"lengths, got {self.prefill_buckets}")
+
+
+_KV_KEYS = ("k", "v", "k_scale", "v_scale")
+
+
+def _rope_slots(x: jnp.ndarray, positions: jnp.ndarray,
+                theta: float) -> jnp.ndarray:
+    """apply_rope (models/transformer.py) with a PER-ROW position:
+    x (slots, 1, heads, d), positions (slots,). Same formula, f32
+    phases, half-split pairing, cast points — the angle for row b here
+    is bitwise the angle decode_step computes for its whole batch at
+    scalar pos = positions[b], so per-slot rope output matches the
+    standalone decode exactly."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[:, None, None, :]  # (slots, 1, 1, D/2)
+    sin = jnp.sin(angles)[:, None, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
+
+
+def _slot_cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
+                           v_all: jnp.ndarray, pos: jnp.ndarray,
+                           window: "int | None" = None) -> jnp.ndarray:
+    """models/generate.py ``_cached_attention`` with the scalar decode
+    position generalized to (slots,): row b masks by ITS ``pos[b]``.
+    Same einsum structure, f32 score/softmax, and cast points; the
+    contraction runs over the full static ``max_seq`` buffer for every
+    row (the mask is per-row data, the shape is not), which is exactly
+    the no-window standalone program — so per-row outputs are bitwise
+    equal to a batch-1 ``decode_step`` at that position. Sliding-window
+    decode keeps the mask-only form (positions outside the window mask
+    to NEG_INF; exp underflows to exactly 0.0): per-step cost stays
+    O(max_seq) rather than generate()'s O(window) slice, a trade for
+    per-row window offsets that only shows at long max_seq."""
+    b, one, h, d = q.shape
+    h_kv = k_all.shape[2]
+    g = h // h_kv
+    qg = q.reshape(b, one, h_kv, g, d)
+    scale = d ** -0.5
+    k_idx = jnp.arange(k_all.shape[1])
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all,
+                        preferred_element_type=jnp.float32) * scale
+    valid = k_idx[None, :] <= pos[:, None]  # (slots, max_seq)
+    if window is not None:
+        valid &= k_idx[None, :] > pos[:, None] - window
+    scores = jnp.where(valid[:, None, None, None, :], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_all.dtype), v_all,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, one, h, d).astype(q.dtype)
+
+
+def _write_slot_rows(cache: jnp.ndarray, layer: int, vals: jnp.ndarray,
+                     pos: jnp.ndarray) -> jnp.ndarray:
+    """Write ``vals[s]`` at ``cache[layer, s, pos[s]]`` for every slot.
+    An unrolled loop of ``dynamic_update_slice`` (slots is small and
+    static) rather than one ``.at[layer, rows, pos].set`` scatter: with
+    the engine state donated, DUS updates the buffer in place, and the
+    XLA:CPU scatter lowering measured ~5x slower per write. Placement
+    only — the written values are identical either way."""
+    for s in range(vals.shape[0]):
+        cache = lax.dynamic_update_slice(
+            cache, vals[s][None, None, None],
+            (layer, s, pos[s]) + (0,) * (vals.ndim - 1))
+    return cache
+
+
+def _slot_decode_step(params: dict, kv: dict, token: jnp.ndarray,
+                      pos: jnp.ndarray, cfg: TransformerConfig):
+    """models/generate.py ``decode_step`` with the batch-wide position
+    scalar generalized to a per-slot vector — the engine's one compiled
+    decode program. Mirrors the block math op-for-op (same projections,
+    norms, residual order, cast points); only the cache-write placement
+    (per-slot positions instead of one shared slice) and the mask
+    source differ, neither of which touches a row's arithmetic. kv: k/v
+    (layers, slots, max_seq, kv_heads, head_dim) [+ scales]; token/pos
+    (slots,). Returns (new kv, logits (slots, vocab))."""
+    s = token.shape[0]
+    quantized = "k_scale" in kv
+    x = params["embed"][token][:, None, :]
+    if not cfg.rope:
+        x = x + params["pos"][pos][:, None, :]
+    k_cache, v_cache = kv["k"], kv["v"]
+    if quantized:
+        k_scales, v_scales = kv["k_scale"], kv["v_scale"]
+    for i, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["ln1"])
+        q = (h @ layer["wq"]).reshape(s, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(s, 1, cfg.kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(s, 1, cfg.kv_heads, cfg.head_dim)
+        if cfg.rope:
+            q = _rope_slots(q, pos, cfg.rope_theta)
+            k = _rope_slots(k, pos, cfg.rope_theta)
+        if quantized:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_cache = _write_slot_rows(k_cache, i, kq[:, 0], pos)
+            v_cache = _write_slot_rows(v_cache, i, vq[:, 0], pos)
+            k_scales = _write_slot_rows(k_scales, i, ks[:, 0], pos)
+            v_scales = _write_slot_rows(v_scales, i, vs[:, 0], pos)
+            k_all = dequantize_kv(k_cache[i], k_scales[i], cfg.dtype)
+            v_all = dequantize_kv(v_cache[i], v_scales[i], cfg.dtype)
+        else:
+            k_cache = _write_slot_rows(
+                k_cache, i, k[:, 0].astype(k_cache.dtype), pos)
+            v_cache = _write_slot_rows(
+                v_cache, i, v[:, 0].astype(v_cache.dtype), pos)
+            k_all, v_all = k_cache[i], v_cache[i]
+        attn = _slot_cached_attention(q, k_all, v_all, pos,
+                                      window=cfg.attn_window)
+        x = x + attn.reshape(s, 1, -1) @ layer["wo"]
+
+        h = rmsnorm(x, layer["ln2"])
+        if "router" in layer:
+            y, _aux = moe_ffn(h, layer, cfg.moe, axis_name=None)
+            x = x + y
+        elif "w3" in layer:
+            x = x + (jax.nn.silu(h @ layer["w1"])
+                     * (h @ layer["w3"])) @ layer["w2"]
+        else:
+            x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    logits = lm_logits(params, rmsnorm(x, params["out_norm"]), cfg)
+    new_kv = {"k": k_cache, "v": v_cache}
+    if quantized:
+        new_kv["k_scale"], new_kv["v_scale"] = k_scales, v_scales
+    return new_kv, logits[:, 0, :]
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _engine_step(params: dict, state: dict, pos: jnp.ndarray,
+                 cfg: TransformerConfig):
+    """One decode step for every slot: pick each slot's next token from
+    the carried logits (greedy — the parity mode), then advance every
+    slot's cache at its own position in one batched program. ``state``:
+    k/v (layers, slots, max_seq, kv_heads, head_dim) [+ scales] +
+    ``logits`` (slots, vocab); ``pos``: (slots,) next write position per
+    slot (free lanes park at 0; their writes land in a region the next
+    prefill overwrites wholesale).
+
+    Returns (new state, emitted tokens (slots,)). The state is donated:
+    the caches update in place instead of doubling slot HBM per step.
+    """
+    tok = jnp.argmax(state["logits"], axis=-1).astype(jnp.int32)
+    kv = {n: state[n] for n in state if n != "logits"}
+    new_kv, logits = _slot_decode_step(params, kv, tok, pos, cfg)
+    return {**new_kv, "logits": logits}, tok
+
+
+@partial(jax.jit, static_argnames=("cfg", "gather"), donate_argnums=(1,))
+def _engine_prefill(params: dict, state: dict, prompt: jnp.ndarray,
+                    true_len: jnp.ndarray, slot: jnp.ndarray,
+                    cfg: TransformerConfig, gather: bool):
+    """Prefill ``prompt`` (1, L) into ``slot``'s lane. L is static, so
+    jit's shape cache IS the per-bucket program cache. ``gather``
+    (static) selects the bucketed variant whose next-token logits are
+    read at ``true_len - 1``; the exact-length path (gather=False) runs
+    the same program shape ``generate()`` prefills with. The fresh
+    per-slot buffer overwrites the lane's ENTIRE row — stale K/V from
+    the previous occupant is cleared, not merely masked."""
+    quant = "k_scale" in state
+    one = init_kv_cache(cfg, 1, kv_dtype="int8" if quant else None)
+    cache, logits = prefill(
+        params, one, prompt, cfg,
+        logit_pos=true_len - 1 if gather else None)
+    out = dict(state)
+    for n in _KV_KEYS:
+        if n in cache:
+            out[n] = lax.dynamic_update_slice(
+                state[n], cache[n],
+                (0, slot) + (0,) * (cache[n].ndim - 2))
+    out["logits"] = lax.dynamic_update_slice(
+        state["logits"], logits.astype(state["logits"].dtype),
+        (slot, 0))
+    return out
+
+
+@dataclasses.dataclass
+class _SlotState:
+    """Host-side bookkeeping for one occupied slot."""
+
+    req: Request
+    emitted: list
+
+
+class ServingEngine:
+    """Slot owner + device-state holder. The scheduler decides WHAT runs
+    (serving/scheduler.py); the engine runs it."""
+
+    def __init__(self, params: dict, cfg: TransformerConfig,
+                 ecfg: EngineConfig = EngineConfig(),
+                 metrics=None, tracer=None):
+        self.params = params
+        self.cfg = cfg
+        self.ecfg = ecfg
+        self.metrics = metrics
+        self.tracer = tracer
+        if ecfg.prefill_buckets and ecfg.prefill_buckets[-1] > cfg.max_seq:
+            raise ValueError(
+                f"largest prefill bucket {ecfg.prefill_buckets[-1]} "
+                f"exceeds max_seq {cfg.max_seq}")
+        base = init_kv_cache(cfg, ecfg.num_slots, kv_dtype=ecfg.kv_dtype)
+        del base["pos"]  # per-slot positions live host-side
+        self._state = {**base, "logits": jnp.zeros(
+            (ecfg.num_slots, cfg.vocab_size), cfg.dtype)}
+        self._pos = np.zeros((ecfg.num_slots,), np.int32)
+        self._slots: list[Optional[_SlotState]] = [None] * ecfg.num_slots
+        self.decode_dispatches = 0
+        self.prefill_dispatches = 0
+        # distinct (padded length, gather) pairs = compiled prefill
+        # programs — the quantity prefill_buckets exists to bound
+        self.prefill_shapes: set = set()
+
+    # -- slot introspection -------------------------------------------
+
+    @property
+    def num_slots(self) -> int:
+        return self.ecfg.num_slots
+
+    @property
+    def occupied(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def free_slot_count(self) -> int:
+        return self.num_slots - self.occupied
+
+    def kv_cache_bytes(self) -> int:
+        return sum(int(self._state[n].size * self._state[n].dtype.itemsize)
+                   for n in _KV_KEYS if n in self._state)
+
+    # -- admission (prefill) ------------------------------------------
+
+    def _bucket_len(self, n: int) -> int:
+        buckets = self.ecfg.prefill_buckets
+        if not buckets:
+            return n
+        i = bisect.bisect_left(buckets, n)
+        if i == len(buckets):
+            raise ValueError(
+                f"prompt length {n} exceeds largest prefill bucket "
+                f"{buckets[-1]}")
+        return buckets[i]
+
+    def admit(self, req: Request) -> int:
+        """Prefill ``req`` into a free slot; returns the slot index."""
+        n = len(req.prompt)
+        if n < 1:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.rid}: max_new_tokens must be >= 1")
+        if n + req.max_new_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"request {req.rid}: prompt {n} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds max_seq {self.cfg.max_seq}")
+        for t in (req.stop_tokens or ()) + (
+                (req.eos_token,) if req.eos_token is not None else ()):
+            if not 0 <= t < self.cfg.vocab_size:
+                raise ValueError(f"request {req.rid}: stop/eos token {t} "
+                                 f"out of vocab [0, {self.cfg.vocab_size})")
+        try:
+            slot = self._slots.index(None)
+        except ValueError:
+            raise RuntimeError("no free slot (admit gated on "
+                               "free_slot_count)") from None
+        length = self._bucket_len(n)
+        padded = np.zeros((1, length), np.int32)
+        padded[0, :n] = req.prompt
+        span = (self.tracer.span("serve_prefill", rid=req.rid, slot=slot,
+                                 prompt_len=n, bucket=length)
+                if self.tracer is not None else _null_span())
+        with span:
+            self._state = _engine_prefill(
+                self.params, self._state, jnp.asarray(padded),
+                jnp.asarray(n, jnp.int32), jnp.asarray(slot, jnp.int32),
+                self.cfg, gather=length != n)
+        self.prefill_dispatches += 1
+        self.prefill_shapes.add((length, length != n))
+        self._pos[slot] = n
+        self._slots[slot] = _SlotState(req=req, emitted=[])
+        if self.metrics is not None:
+            self.metrics.on_admit(req.rid, slot, n)
+        return slot
+
+    # -- decode ---------------------------------------------------------
+
+    def step(self) -> list[tuple[int, Request, list, str]]:
+        """Advance every occupied slot one token. Returns completions as
+        ``(slot, request, tokens, reason)`` with reason one of
+        ``eos`` / ``stop`` / ``max_tokens``; completed slots are freed
+        before returning (the same dispatch that emitted the finishing
+        token — a slot never idles occupied)."""
+        span = (self.tracer.span("serve_step", occupied=self.occupied)
+                if self.tracer is not None else _null_span())
+        with span:
+            self._state, tok = _engine_step(
+                self.params, self._state, jnp.asarray(self._pos),
+                self.cfg)
+            toks = np.asarray(tok)  # the one host readback per token
+        self.decode_dispatches += 1
+        finished = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            t = int(toks[i])
+            slot.emitted.append(t)
+            self._pos[i] += 1
+            req = slot.req
+            if self.metrics is not None:
+                self.metrics.on_token(req.rid, req.submitted_at)
+            reason = None
+            if req.eos_token is not None and t == req.eos_token:
+                reason = "eos"
+            elif t in (req.stop_tokens or ()):
+                reason = "stop"
+            elif len(slot.emitted) >= req.max_new_tokens:
+                reason = "max_tokens"
+            if reason is not None:
+                finished.append((i, req, slot.emitted, reason))
+                self._slots[i] = None
+                self._pos[i] = 0  # park the free lane at position 0
+                if self.metrics is not None:
+                    self.metrics.on_complete(req.rid, len(slot.emitted),
+                                             reason)
+        return finished
+
+
+class _null_span:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
+               metrics=None, max_dispatches: Optional[int] = None
+               ) -> dict:
+    """Drive engine + scheduler until both drain. Returns
+    ``{rid: (tokens, reason)}``.
+
+    Loop shape per iteration: admit every ARRIVED request into free
+    slots, then step — unless occupancy is below the scheduler's
+    threshold quorum AND more work is actually due, in which case wait
+    for the earlier work instead of burning a thin batch (the liveness
+    rule: the threshold only ever waits for work that is coming;
+    a drained queue always steps).
+
+    ``max_dispatches`` bounds total decode dispatches (tests / selfcheck
+    watchdog) — exceeding it raises instead of hanging."""
+    results: dict = {}
+    if metrics is not None and engine.metrics is None:
+        engine.metrics = metrics  # one metrics sink for the whole run
+    clock = scheduler.clock
+    while True:
+        now = clock()
+        while engine.free_slot_count > 0:
+            req = scheduler.pop_ready(now)
+            if req is None:
+                break
+            slot = engine.admit(req)
+            scheduler.bind(req, slot)
+        if engine.occupied == 0:
+            nxt = scheduler.next_arrival_time()
+            if nxt is None:
+                return results
+            scheduler.wait_until(nxt)
+            continue
+        if not scheduler.should_step(engine.occupied) \
+                and engine.free_slot_count > 0:
+            nxt = scheduler.next_arrival_time()
+            if nxt is not None and nxt > now:
+                scheduler.wait_until(nxt)
+                continue
+        if metrics is not None:
+            metrics.observe(scheduler.queue_depth,
+                            engine.occupied / engine.num_slots)
+        if max_dispatches is not None \
+                and engine.decode_dispatches >= max_dispatches:
+            raise RuntimeError(
+                f"serve_loop exceeded max_dispatches={max_dispatches} "
+                f"({len(results)} requests done, "
+                f"{scheduler.unfinished} unfinished)")
+        for slot, req, tokens, reason in engine.step():
+            scheduler.release(slot)
+            results[req.rid] = (tokens, reason)

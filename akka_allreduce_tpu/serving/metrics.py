@@ -1,0 +1,179 @@
+"""Serving observability: latency/occupancy histograms over the runtime
+tracing plane.
+
+What an operator watches on a serving box is not a single goodput number
+but distributions: TTFT (submit -> first token, the interactive-feel
+metric; queueing + prefill), TPOT (steady decode cadence per token),
+queue depth (backpressure headroom), and slot occupancy (batch
+efficiency — the fraction of decode-lane work that is real requests).
+This module keeps those as plain host-side histograms (p50/p90/p99 by
+nearest-rank, no deps) and wires them into the two existing
+observability planes instead of inventing a third:
+
+* every request lifecycle event can land in a
+  :class:`~akka_allreduce_tpu.runtime.tracing.Tracer` (``serve_submit``
+  / ``serve_admit`` / ``serve_first_token`` / ``serve_complete``
+  events; the engine adds ``serve_prefill`` / ``serve_step`` spans), so
+  ``--trace-file`` yields the same greppable JSONL the protocol plane
+  writes;
+* :meth:`ServingMetrics.host_sampler` hands back a
+  :class:`~akka_allreduce_tpu.runtime.metrics.HostResourceSampler`
+  wired to the same tracer, so a serve run's RSS/CPU story rides in the
+  summary next to its latency story.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+
+class Histogram:
+    """Append-only value log with nearest-rank percentiles. Serving
+    tiers care about tails; at serving-bench sample counts (10^2-10^5)
+    an exact sorted copy at summary time is cheaper than maintaining
+    approximate sketch state per record."""
+
+    def __init__(self):
+        self._vals: list[float] = []
+
+    def record(self, v: float) -> None:
+        self._vals.append(float(v))
+
+    @property
+    def count(self) -> int:
+        return len(self._vals)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return sum(self._vals) / len(self._vals) if self._vals else None
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Nearest-rank percentile, p in [0, 100]."""
+        if not self._vals:
+            return None
+        s = sorted(self._vals)
+        rank = max(1, math.ceil(p / 100.0 * len(s)))
+        return s[min(rank, len(s)) - 1]
+
+    def summary(self, scale: float = 1.0, digits: int = 3) -> dict:
+        if not self._vals:
+            return {"count": 0}
+        r = lambda v: round(v * scale, digits)  # noqa: E731
+        return {"count": len(self._vals), "mean": r(self.mean),
+                "p50": r(self.percentile(50)),
+                "p90": r(self.percentile(90)),
+                "p99": r(self.percentile(99)),
+                "max": r(max(self._vals))}
+
+
+class ServingMetrics:
+    """Request-lifecycle metrics for one serve run.
+
+    The engine/loop call the ``on_*`` hooks; ``summary()`` renders one
+    JSON-able dict (the serve CLI prints it as its single stdout line,
+    the same one-JSON-line contract as bench.py)."""
+
+    def __init__(self, clock=time.monotonic, tracer=None):
+        self.clock = clock
+        self.tracer = tracer
+        self.ttft_s = Histogram()
+        self.tpot_s = Histogram()
+        self.queue_depth = Histogram()
+        self.slot_occupancy = Histogram()
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_rejected = 0
+        self._first: dict[int, float] = {}  # rid -> first-token time
+        self._t0: Optional[float] = None
+        self._t_end: Optional[float] = None
+
+    # -- lifecycle hooks ----------------------------------------------
+
+    def _record(self, kind: str, **fields) -> None:
+        if self.tracer is not None:
+            self.tracer.record(kind, **fields)
+
+    def on_submit(self, rid: int) -> None:
+        self.requests_submitted += 1
+        if self._t0 is None:
+            self._t0 = self.clock()
+        self._record("serve_submit", rid=rid)
+
+    def on_reject(self, rid: int) -> None:
+        self.requests_rejected += 1
+        self._record("serve_reject", rid=rid)
+
+    def on_admit(self, rid: int, slot: int, prompt_len: int) -> None:
+        self.prefill_tokens += prompt_len
+        self._record("serve_admit", rid=rid, slot=slot,
+                     prompt_len=prompt_len)
+
+    def on_token(self, rid: int, submitted_at: float) -> None:
+        """Called per emitted token; the first emission banks TTFT."""
+        self.decode_tokens += 1
+        if rid not in self._first:
+            now = self.clock()
+            self._first[rid] = now
+            self.ttft_s.record(now - submitted_at)
+            self._record("serve_first_token", rid=rid,
+                         ttft_s=now - submitted_at)
+
+    def on_complete(self, rid: int, n_tokens: int, reason: str) -> None:
+        self.requests_completed += 1
+        now = self.clock()
+        self._t_end = now
+        first = self._first.pop(rid, None)
+        if first is not None and n_tokens > 1:
+            self.tpot_s.record((now - first) / (n_tokens - 1))
+        self._record("serve_complete", rid=rid, tokens=n_tokens,
+                     reason=reason)
+
+    def observe(self, queue_depth: int, occupancy: float) -> None:
+        """Sampled once per serve-loop iteration (the natural 'round')."""
+        self.queue_depth.record(queue_depth)
+        self.slot_occupancy.record(occupancy)
+
+    # -- host plane ----------------------------------------------------
+
+    def host_sampler(self, interval_s: float = 1.0):
+        """A runtime/metrics.py HostResourceSampler sharing this tracer
+        (use as a context manager around the serve loop; fold its
+        ``summary()`` into the report under ``host``)."""
+        from akka_allreduce_tpu.runtime.metrics import HostResourceSampler
+        return HostResourceSampler(interval_s=interval_s,
+                                   tracer=self.tracer)
+
+    # -- reporting -----------------------------------------------------
+
+    @property
+    def wall_s(self) -> Optional[float]:
+        if self._t0 is None or self._t_end is None:
+            return None
+        return self._t_end - self._t0
+
+    @property
+    def decode_tokens_per_s(self) -> Optional[float]:
+        w = self.wall_s
+        return self.decode_tokens / w if w and w > 0 else None
+
+    def summary(self) -> dict:
+        out = {
+            "requests": {"submitted": self.requests_submitted,
+                         "completed": self.requests_completed,
+                         "rejected": self.requests_rejected},
+            "tokens": {"prefill": self.prefill_tokens,
+                       "decode": self.decode_tokens},
+            "ttft_ms": self.ttft_s.summary(scale=1e3),
+            "tpot_ms": self.tpot_s.summary(scale=1e3),
+            "queue_depth": self.queue_depth.summary(digits=2),
+            "slot_occupancy": self.slot_occupancy.summary(digits=3),
+        }
+        if self.wall_s is not None:
+            out["wall_s"] = round(self.wall_s, 3)
+            out["decode_tokens_per_s"] = round(
+                self.decode_tokens_per_s or 0.0, 1)
+        return out

@@ -1,0 +1,232 @@
+"""Admission and scheduling for the serving engine (host plane).
+
+The scheduler is the serving twin of the protocol plane's master: it
+owns membership (which request sits in which slot), admission (what
+enters the batch next), and the threshold that decides when a round of
+work may proceed. The vocabulary maps one-to-one:
+
+* ``th_step`` is ``ThresholdConfig`` for decode: the fraction of slots
+  that must be occupied before a decode step fires. 0.0 (the default,
+  and the paper's point) means NEVER wait — step whatever is ready;
+  1.0 reconstructs the full-batch barrier as an A/B baseline.
+* ``max_queue_depth`` is backpressure, the bounded mailbox: a request
+  that ARRIVES to a full live queue is shed (:class:`QueueFull` for an
+  immediate submit, the ``on_reject`` callback for a future-dated one
+  draining in) so overload surfaces at the edge instead of as unbounded
+  latency inside. Depth is judged at arrival time, never against the
+  load generator's not-yet-due script.
+* slot bind/release is the master's member add/remove — strict
+  accounting (double-bind and double-release raise), pinned by
+  tests/test_serving_scheduler.py.
+
+Policies: ``fifo`` (arrival order) or ``deadline`` (earliest absolute
+deadline first, FIFO among equals — deadline-less requests sort last).
+Everything here is pure host Python: unit-testable with a fake clock,
+no device, no jax import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import math
+import time
+from typing import Optional
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the admission queue is at ``max_queue_depth``."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``prompt`` is the token-id sequence; ``max_new_tokens`` the decode
+    budget; ``eos_token``/``stop_tokens`` end the request early (the
+    EOS mirrors models/generate.py's ``eos_token``; ``stop_tokens`` is
+    the host-side generalization to a set). ``arrival`` is the earliest
+    time the scheduler may see the request (open-loop load generation);
+    ``deadline`` is an absolute completion target the deadline policy
+    sorts by. ``submitted_at`` is stamped by :meth:`RequestScheduler
+    .submit`.
+    """
+
+    rid: int
+    prompt: tuple
+    max_new_tokens: int
+    eos_token: Optional[int] = None
+    stop_tokens: tuple = ()
+    arrival: float = 0.0
+    deadline: Optional[float] = None
+    submitted_at: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    max_queue_depth: int = 256
+    policy: str = "fifo"  # "fifo" | "deadline"
+    th_step: float = 0.0  # occupancy fraction gating a decode step
+
+    def __post_init__(self):
+        if self.policy not in ("fifo", "deadline"):
+            raise ValueError(f"unknown policy {self.policy!r}")
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}")
+        if not 0.0 <= self.th_step <= 1.0:
+            raise ValueError(
+                f"th_step must be in [0, 1], got {self.th_step}")
+
+
+class RequestScheduler:
+    """Queue + slot table. The engine is the physical slot owner; the
+    scheduler mirrors occupancy so admission decisions (and tests) never
+    need a device.
+
+    Two pools: the LIVE queue (arrived, waiting — what backpressure and
+    the ``queue_depth`` metric are about) and the FUTURE pool (submitted
+    with a later ``arrival``, i.e. the load generator's script). Depth
+    is enforced when a request ARRIVES, not when the generator hands it
+    over: a future-dated submit never rejects, and an arrival that finds
+    the live queue full is dropped through ``on_reject`` — exactly when
+    a real open-loop server would shed it."""
+
+    def __init__(self, cfg: SchedulerConfig, num_slots: int,
+                 clock=time.monotonic, sleep=time.sleep, on_reject=None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.clock = clock
+        self._sleep = sleep
+        self.on_reject = on_reject
+        self._seq = itertools.count()
+        self._arrived: list[tuple] = []  # heap of (sort_key, seq, req)
+        self._future: list[tuple] = []   # heap of (arrival, seq, req)
+        self._slots: dict[int, Request] = {}
+        # decode quorum: ceil(th * slots), floored at 1 so th > 0 never
+        # demands zero occupancy (same ceil convention as the protocol
+        # thresholds: required count = ceil(fraction * total))
+        self.step_quorum = max(1, math.ceil(cfg.th_step * num_slots))
+        self.rejected = 0
+
+    # -- admission -----------------------------------------------------
+
+    def _sort_key(self, req: Request) -> float:
+        if self.cfg.policy == "deadline":
+            return req.deadline if req.deadline is not None \
+                else float("inf")
+        return req.arrival
+
+    def _reject(self, req: Request) -> None:
+        self.rejected += 1
+        if self.on_reject is not None:
+            self.on_reject(req.rid)
+
+    def _push_arrived(self, req: Request) -> None:
+        heapq.heappush(self._arrived,
+                       (self._sort_key(req), next(self._seq), req))
+
+    def submit(self, req: Request) -> None:
+        """Enqueue. An already-arrived request that finds the live queue
+        at ``max_queue_depth`` raises :class:`QueueFull` (backpressure —
+        the caller sheds load at the edge); a future-dated request parks
+        in the arrival pool and faces the depth check when it arrives."""
+        if req.submitted_at is None:
+            req.submitted_at = self.clock()
+        if req.arrival > self.clock():
+            heapq.heappush(self._future,
+                           (req.arrival, next(self._seq), req))
+            return
+        if len(self._arrived) >= self.cfg.max_queue_depth:
+            self._reject(req)
+            raise QueueFull(
+                f"queue at max_queue_depth={self.cfg.max_queue_depth}")
+        self._push_arrived(req)
+
+    def _drain_arrivals(self, now: float) -> None:
+        """Move every request whose arrival has passed into the live
+        queue, shedding (via ``on_reject``) any that find it full."""
+        while self._future and self._future[0][0] <= now:
+            _, _, req = heapq.heappop(self._future)
+            if len(self._arrived) >= self.cfg.max_queue_depth:
+                self._reject(req)
+            else:
+                self._push_arrived(req)
+
+    def pop_ready(self, now: Optional[float] = None) -> Optional[Request]:
+        """Best live request as of ``now`` (None = nothing has arrived).
+        Under the deadline policy an urgent late arrival outranks a
+        patient early one; among equals, submit order decides."""
+        if now is None:
+            now = self.clock()
+        self._drain_arrivals(now)
+        if self._arrived:
+            return heapq.heappop(self._arrived)[2]
+        return None
+
+    def next_arrival_time(self) -> Optional[float]:
+        """Earliest pending arrival (open-loop idle wait target); the
+        current time when live work is already queued, None when nothing
+        is pending anywhere."""
+        if self._arrived:
+            return self.clock()
+        if not self._future:
+            return None
+        return self._future[0][0]
+
+    def wait_until(self, t: float) -> None:
+        """Sleep the (injectable) clock forward to ``t``."""
+        dt = t - self.clock()
+        if dt > 0:
+            self._sleep(dt)
+
+    # -- slot accounting ----------------------------------------------
+
+    def bind(self, req: Request, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.num_slots})")
+        if slot in self._slots:
+            raise RuntimeError(
+                f"slot {slot} already bound to request "
+                f"{self._slots[slot].rid}")
+        if any(r.rid == req.rid for r in self._slots.values()):
+            raise RuntimeError(f"request {req.rid} already bound")
+        self._slots[slot] = req
+
+    def release(self, slot: int) -> Request:
+        if slot not in self._slots:
+            raise RuntimeError(f"slot {slot} is not bound")
+        return self._slots.pop(slot)
+
+    # -- progress gate -------------------------------------------------
+
+    def should_step(self, occupied: int) -> bool:
+        """Threshold-gated progress: step once ``occupied`` meets the
+        quorum. The serve loop still steps a sub-quorum batch when no
+        more work can arrive — the liveness rule; the threshold only
+        ever waits for work that is actually coming."""
+        return occupied >= self.step_quorum
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        """LIVE queue only (arrived, waiting) — the backpressure and
+        metrics quantity; future-dated load-generator submissions are
+        not queue occupancy."""
+        return len(self._arrived)
+
+    @property
+    def unfinished(self) -> int:
+        return len(self._arrived) + len(self._future) + len(self._slots)
+
+    @property
+    def occupied(self) -> int:
+        return len(self._slots)
+
+    def bound_request(self, slot: int) -> Optional[Request]:
+        return self._slots.get(slot)

@@ -87,7 +87,8 @@ def main(only=None) -> int:
     if only:
         fns = {f.__name__: f for f in
                (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
-                ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines)}
+                ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
+                serving_throughput)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -169,10 +170,34 @@ def main(only=None) -> int:
 
     skip = set(os.environ.get("AATPU_SUITE_SKIP", "").split(","))
     for fn in (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
-               ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines):
+               ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
+               serving_throughput):
         if fn.__name__ not in skip:
             fn()
     return 0
+
+
+def serving_throughput():
+    """The serving-plane A/B: continuous-batching engine
+    (serving/engine.py) vs sequential per-request ``generate()`` at 2
+    and 4 decode slots — the measurement behind the `serve` subcommand's
+    existence. Sizes down off-TPU the same way the other sections do;
+    the speedup row is the claim (engine > 1x at >= 2 concurrent
+    requests), the tok/s rows are the evidence."""
+    import jax
+
+    from akka_allreduce_tpu.bench import measure_serving_throughput
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        rows = measure_serving_throughput(
+            d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+            n_requests=16, prompt_len=64, steps=128,
+            slot_counts=(2, 4, 8))
+    else:
+        rows = measure_serving_throughput()
+    for row in rows:
+        emit(row["metric"], row["value"], row["unit"], row["note"])
 
 
 def ab_overlap():

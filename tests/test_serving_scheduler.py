@@ -1,0 +1,236 @@
+"""Scheduler-plane tests: admission, backpressure, deadlines, slots.
+
+Pure host tests (no jax, fake clock) for serving/scheduler.py — the
+serving twin of the protocol-plane master tests: membership accounting
+must be strict, backpressure must surface at the edge, and the
+threshold gate must follow the protocol's ceil convention.
+"""
+
+import pytest
+
+from akka_allreduce_tpu.serving.scheduler import (
+    QueueFull,
+    Request,
+    RequestScheduler,
+    SchedulerConfig,
+)
+
+
+def req(rid, arrival=0.0, deadline=None, plen=4):
+    return Request(rid=rid, prompt=tuple(range(plen)), max_new_tokens=4,
+                   arrival=arrival, deadline=deadline)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def make(policy="fifo", depth=4, slots=2, th=0.0, clock=None):
+    clock = clock or FakeClock()
+    return RequestScheduler(
+        SchedulerConfig(max_queue_depth=depth, policy=policy, th_step=th),
+        num_slots=slots, clock=clock, sleep=clock.sleep), clock
+
+
+class TestBackpressure:
+    def test_submit_beyond_depth_raises_queue_full(self):
+        s, _ = make(depth=3)
+        for i in range(3):
+            s.submit(req(i))
+        with pytest.raises(QueueFull):
+            s.submit(req(3))
+        assert s.queue_depth == 3
+        assert s.rejected == 1
+
+    def test_pop_frees_depth(self):
+        s, _ = make(depth=2)
+        s.submit(req(0))
+        s.submit(req(1))
+        assert s.pop_ready(0.0).rid == 0
+        s.submit(req(2))  # depth freed by the pop
+        assert s.queue_depth == 2
+
+    def test_submit_stamps_submitted_at(self):
+        s, clock = make()
+        clock.t = 7.5
+        r = req(0)
+        s.submit(r)
+        assert r.submitted_at == 7.5
+
+    def test_depth_judged_at_arrival_not_submit(self):
+        """Open-loop semantics: future-dated submits are the load
+        generator's script, not live queue occupancy — handing the
+        scheduler more scripted requests than max_queue_depth must NOT
+        reject anything up front; the bound bites only when arrivals
+        actually find the live queue full."""
+        shed = []
+        clock = FakeClock()
+        s = RequestScheduler(
+            SchedulerConfig(max_queue_depth=2), num_slots=1,
+            clock=clock, sleep=clock.sleep, on_reject=shed.append)
+        for i in range(5):  # 5 scripted arrivals >> depth 2
+            s.submit(req(i, arrival=float(i + 1)))
+        assert s.rejected == 0 and s.queue_depth == 0
+        # all five arrive before anything is popped: 2 fill the live
+        # queue, 3 are shed at their arrival instant
+        clock.t = 10.0
+        first = s.pop_ready()
+        assert first.rid == 0
+        assert s.rejected == 3
+        assert shed == [2, 3, 4]  # rids shed in arrival order
+        assert s.queue_depth == 1  # rid 1 still live
+
+    def test_arrivals_admitted_when_queue_drains(self):
+        """A later arrival is admitted if earlier pops freed depth —
+        shedding depends on occupancy AT the arrival, not on totals."""
+        clock = FakeClock()
+        s = RequestScheduler(
+            SchedulerConfig(max_queue_depth=1), num_slots=1,
+            clock=clock, sleep=clock.sleep)
+        s.submit(req(0, arrival=1.0))
+        s.submit(req(1, arrival=2.0))
+        clock.t = 1.5
+        assert s.pop_ready().rid == 0  # queue drains before rid 1 lands
+        clock.t = 2.5
+        assert s.pop_ready().rid == 1  # admitted: queue was empty at 2.0
+        assert s.rejected == 0
+
+
+class TestOrdering:
+    def test_fifo_is_arrival_order(self):
+        s, _ = make()
+        for i in (0, 1, 2):
+            s.submit(req(i))
+        assert [s.pop_ready(0.0).rid for _ in range(3)] == [0, 1, 2]
+
+    def test_deadline_policy_is_edf_among_arrived(self):
+        s, _ = make(policy="deadline", depth=8)
+        s.submit(req(0, deadline=9.0))
+        s.submit(req(1, deadline=3.0))
+        s.submit(req(2, deadline=6.0))
+        s.submit(req(3))  # no deadline sorts last
+        order = [s.pop_ready(0.0).rid for _ in range(4)]
+        assert order == [1, 2, 0, 3]
+
+    def test_unarrived_requests_never_pop(self):
+        s, _ = make(policy="deadline", depth=8)
+        # the urgent deadline has not arrived yet: the patient one runs
+        s.submit(req(0, arrival=10.0, deadline=1.0))
+        s.submit(req(1, arrival=0.0, deadline=99.0))
+        assert s.pop_ready(5.0).rid == 1
+        assert s.pop_ready(5.0) is None  # rid 0 still in the future
+        assert s.queue_depth == 0  # live queue; rid 0 is future, not queued
+        assert s.unfinished == 1
+        assert s.pop_ready(10.0).rid == 0
+
+    def test_late_urgent_arrival_preempts_queue_order(self):
+        s, _ = make(policy="deadline", depth=8)
+        s.submit(req(0, deadline=50.0))
+        s.submit(req(1, deadline=2.0))  # submitted later, far more urgent
+        assert s.pop_ready(0.0).rid == 1
+
+    def test_next_arrival_time(self):
+        s, _ = make(depth=8)
+        assert s.next_arrival_time() is None
+        s.submit(req(0, arrival=4.0))
+        s.submit(req(1, arrival=2.0))
+        assert s.next_arrival_time() == 2.0
+
+    def test_wait_until_advances_injected_clock(self):
+        s, clock = make()
+        s.wait_until(3.0)
+        assert clock.t == 3.0
+        s.wait_until(1.0)  # never sleeps backwards
+        assert clock.t == 3.0
+
+
+class TestSlotAccounting:
+    def test_bind_release_lifecycle(self):
+        s, _ = make(slots=2)
+        r0, r1 = req(0), req(1)
+        s.bind(r0, 0)
+        s.bind(r1, 1)
+        assert s.occupied == 2
+        assert s.bound_request(0) is r0
+        assert s.release(0) is r0
+        assert s.occupied == 1
+        s.bind(req(2), 0)  # freed slot is reusable
+        assert s.occupied == 2
+
+    def test_double_bind_raises(self):
+        s, _ = make(slots=2)
+        s.bind(req(0), 0)
+        with pytest.raises(RuntimeError, match="already bound"):
+            s.bind(req(1), 0)
+
+    def test_same_request_two_slots_raises(self):
+        s, _ = make(slots=2)
+        r = req(0)
+        s.bind(r, 0)
+        with pytest.raises(RuntimeError, match="already bound"):
+            s.bind(r, 1)
+
+    def test_release_unbound_raises(self):
+        s, _ = make(slots=2)
+        with pytest.raises(RuntimeError, match="not bound"):
+            s.release(0)
+
+    def test_bind_out_of_range_raises(self):
+        s, _ = make(slots=2)
+        with pytest.raises(ValueError, match="out of range"):
+            s.bind(req(0), 2)
+
+    def test_unfinished_counts_queue_and_slots(self):
+        s, _ = make(slots=2, depth=8)
+        s.submit(req(0))
+        s.submit(req(1))
+        r = s.pop_ready(0.0)
+        s.bind(r, 0)
+        assert s.unfinished == 2  # one queued + one bound
+
+
+class TestThresholdGate:
+    """th_step is the protocol plane's threshold dial: required count =
+    ceil(fraction * total), floored at 1."""
+
+    def test_zero_threshold_steps_at_one(self):
+        s, _ = make(slots=4, th=0.0)
+        assert s.step_quorum == 1
+        assert s.should_step(1)
+
+    def test_full_threshold_is_the_batch_barrier(self):
+        s, _ = make(slots=4, th=1.0)
+        assert s.step_quorum == 4
+        assert not s.should_step(3)
+        assert s.should_step(4)
+
+    def test_fractional_threshold_ceils(self):
+        s, _ = make(slots=3, th=0.5)
+        assert s.step_quorum == 2  # ceil(1.5)
+        assert not s.should_step(1)
+        assert s.should_step(2)
+
+
+class TestConfigValidation:
+    def test_bad_policy(self):
+        with pytest.raises(ValueError, match="policy"):
+            SchedulerConfig(policy="lifo")
+
+    def test_bad_depth(self):
+        with pytest.raises(ValueError, match="max_queue_depth"):
+            SchedulerConfig(max_queue_depth=0)
+
+    def test_bad_threshold(self):
+        with pytest.raises(ValueError, match="th_step"):
+            SchedulerConfig(th_step=1.5)
+
+    def test_bad_slots(self):
+        with pytest.raises(ValueError, match="num_slots"):
+            RequestScheduler(SchedulerConfig(), num_slots=0)

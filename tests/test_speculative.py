@@ -125,6 +125,27 @@ class TestGreedyEquivalence:
                                       cfg_t, cfg_d, steps, k=3)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
+    def test_eos_early_termination_matches_generate(self):
+        """ISSUE 2 satellite: with an EOS token the emitted tokens (and
+        post-EOS padding) still equal generate(eos_token=...) bitwise,
+        the reported length matches, and the loop actually STOPPED early
+        — fewer target passes than the no-EOS run (batch-1 while_loop:
+        a real wall-clock saving, not just bookkeeping)."""
+        target = init_transformer(jax.random.key(0), TCFG)
+        steps, k = 12, 4
+        base = np.asarray(generate(target, prompt(), TCFG, steps))[0]
+        eos = int(base[2])  # the 3rd greedy token -> length 3
+        ref, ref_len = generate(target, prompt(), TCFG, steps,
+                                eos_token=eos)
+        got, stats = speculative_generate(target, target, prompt(),
+                                          TCFG, TCFG, steps, k=k,
+                                          eos_token=eos)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        assert int(stats["length"]) == int(ref_len[0]) == 3
+        _, no_eos_stats = speculative_generate(target, target, prompt(),
+                                               TCFG, TCFG, steps, k=k)
+        assert int(stats["rounds"]) < int(no_eos_stats["rounds"])
+
 
 class TestSpeculativeSampling:
     def test_accept_resample_identity_is_exact(self):
