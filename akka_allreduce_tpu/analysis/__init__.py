@@ -1,0 +1,62 @@
+"""graftlint — the static-analysis plane: jaxpr/HLO invariants machine-checked.
+
+The repo's load-bearing claims are *program properties*: the windowed
+schedule is bitwise-exact because every element crosses exactly one
+reduce-scatter and one all-gather (ops/collectives.py); the serving
+engine never recompiles after warmup because slot churn is data, not
+shape (serving/engine.py); the int8 wire stays honest because counts
+ride an exact int32 psum (parallel/dp.py). Example-based tests witness
+these on specific inputs; this subsystem checks them on the *compiled
+artifact* — the jaxpr and the lowered StableHLO — with no device
+execution (CPU-only, tier-1-safe), the same move the reference protocol
+made when it turned distributed behavior into explicit thresholds and
+completion counts.
+
+Layout:
+
+* ``core``         — Finding/LintPolicy/LintContext, the pass registry,
+                     and the recursive jaxpr walk every pass shares.
+* ``passes``       — the pass catalog: collective-axis consistency,
+                     donation/aliasing audit, dtype-promotion lint,
+                     host-sync hazards.
+* ``recompile``    — the runtime half: a compile-counting guard that
+                     turns "never recompiles after warmup" into an
+                     asserted property.
+* ``entrypoints``  — builds LintContexts for the stack's jitted entry
+                     points (train step, generate, engine step/prefill,
+                     both two-phase collectives).
+* ``report``       — findings -> text / JSON, severity gating, exit
+                     codes (the ``lint`` CLI surface).
+* ``selfcheck``    — deliberately-broken fixtures each pass must catch
+                     (``lint --selfcheck``; the linter's own tier-1).
+"""
+
+from akka_allreduce_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    LintPolicy,
+    iter_eqns,
+    lint_pass,
+    run_passes,
+    trace_entry,
+)
+from akka_allreduce_tpu.analysis.recompile import (
+    CompileLog,
+    RecompileError,
+    assert_max_compiles,
+    no_recompiles,
+)
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "LintPolicy",
+    "iter_eqns",
+    "lint_pass",
+    "run_passes",
+    "trace_entry",
+    "CompileLog",
+    "RecompileError",
+    "assert_max_compiles",
+    "no_recompiles",
+]

@@ -1,0 +1,253 @@
+"""Lint core: findings, policies, the pass registry, and the jaxpr walk.
+
+A *pass* is a function ``(LintContext) -> list[Finding]`` registered
+under a stable name. A *context* is one traced entry point — its closed
+jaxpr, its flat input record (names, avals, declared donation), the
+lowered StableHLO text when the entry was lowered, and the
+:class:`LintPolicy` describing which invariants apply there. Policies
+exist because the same eqn is correct in one program and a bug in
+another: a float psum over ``tp`` is the Megatron activation reduction
+inside a train step and a quantization escape inside the int8 collective
+— only the policy knows which program it is looking at.
+
+Everything here is trace-time only: no device execution, no compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+# Collective primitives and where each keeps its axis names. psum-family
+# primitives bind ``axes``; the tiled collectives bind ``axis_name``
+# (which may itself be a name or a tuple of names).
+_AXES_PARAM = {
+    "psum": "axes", "pmax": "axes", "pmin": "axes",
+    "reduce_scatter": "axis_name", "all_gather": "axis_name",
+    "all_to_all": "axis_name", "ppermute": "axis_name",
+    "pbroadcast": "axes", "axis_index": "axis_name",
+}
+# The subset that moves payload bytes (axis_index is bookkeeping).
+COLLECTIVE_PRIMS = frozenset(_AXES_PARAM) - {"axis_index"}
+# Phase-1 primitives of a two-phase schedule (reduce side) vs phase 2
+# (broadcast side): the windowed schedules must keep them paired.
+REDUCE_PHASE_PRIMS = frozenset({"reduce_scatter", "all_to_all"})
+GATHER_PHASE_PRIMS = frozenset({"all_gather"})
+# Primitives that round-trip through the host: reachable from a hot loop
+# they serialize the device against Python.
+HOST_SYNC_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback_call", "infeed", "outfeed",
+})
+# Control-flow primitives whose body re-runs per trip — an eqn inside
+# them is "in a hot loop" for the host-sync pass.
+LOOP_PRIMS = frozenset({"scan", "while", "fori_loop"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint result. ``severity``: "error" (exit-code gating),
+    "warning" (reported, non-gating by default), or "info"."""
+
+    pass_name: str
+    severity: str
+    entrypoint: str
+    message: str
+    where: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class LintPolicy:
+    """Which invariants apply to an entry point.
+
+    ``known_axes``: the enclosing mesh's axis names; any collective
+    naming an axis outside this set is an error (empty = meshless entry:
+    every named-axis collective is an error).
+    ``reduce_axes``: when set, *float-payload* reductions (psum /
+    reduce_scatter) must stay on these axes — the grad-sync discipline
+    for standalone collective entries. None = don't check (full train
+    steps legitimately psum activations over model axes).
+    ``expect_two_phase``: reduce-phase and gather-phase collective
+    counts must pair per axis (the windowed-schedule invariant: every
+    window's reduce-scatter has its all-gather).
+    ``wire``: "bf16"/"int8" turn on the wire-dtype discipline (no f32
+    payload escapes the compressed wire).
+    ``exact_counts``: count/bookkeeping psums must be integer-dtyped
+    (the honesty contract: lossy rounds tolerate no rounded counts).
+    ``expect_donation``: the entry declares donated args and the
+    lowering must actually alias them (the HBM-residency contract).
+    ``hot``: the entry runs per step/token — host callbacks anywhere in
+    it are findings, not just inside scan/while bodies.
+    ``compute_dtype``: "bf16" turns on the upcast lint.
+    """
+
+    known_axes: frozenset = frozenset()
+    reduce_axes: Optional[frozenset] = None
+    expect_two_phase: bool = False
+    wire: Optional[str] = None
+    exact_counts: bool = False
+    expect_donation: bool = False
+    hot: bool = False
+    compute_dtype: str = "f32"
+
+
+@dataclasses.dataclass
+class LintContext:
+    """One traced entry point, ready for the passes."""
+
+    name: str
+    jaxpr: Any  # ClosedJaxpr
+    policy: LintPolicy
+    # flat input record (post pytree-flatten, same order as lowering):
+    arg_names: tuple = ()
+    in_avals: tuple = ()
+    donated: tuple = ()  # declared donation per flat arg
+    stablehlo: Optional[str] = None  # lowered module text, when lowered
+
+
+# -- jaxpr traversal ----------------------------------------------------
+
+def _sub_jaxprs(params: dict) -> Iterator[Any]:
+    """Yield every Jaxpr nested in an eqn's params (closed or open,
+    single or in a branches tuple) — duck-typed so it survives the
+    jax.core reshuffles across versions."""
+    for v in params.values():
+        items = v if isinstance(v, (list, tuple)) else (v,)
+        for item in items:
+            if hasattr(item, "eqns"):  # open Jaxpr
+                yield item
+            elif hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                yield item.jaxpr  # ClosedJaxpr
+
+def iter_eqns(closed_jaxpr, _jaxpr=None, _in_loop=False
+              ) -> Iterator[tuple]:
+    """Depth-first ``(eqn, in_loop)`` over a closed jaxpr and every
+    nested jaxpr (pjit/shard_map/scan/while/cond bodies). ``in_loop`` is
+    True for eqns whose enclosing control flow re-runs them per trip."""
+    jaxpr = closed_jaxpr.jaxpr if _jaxpr is None else _jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn, _in_loop
+        inner_loop = _in_loop or eqn.primitive.name in LOOP_PRIMS
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(closed_jaxpr, _jaxpr=sub,
+                                 _in_loop=inner_loop)
+
+
+def eqn_axes(eqn) -> tuple:
+    """The axis names a collective eqn binds, flattened to a tuple of
+    strings (handles both the ``axes`` and ``axis_name`` spellings and
+    the name-or-tuple convention)."""
+    param = _AXES_PARAM.get(eqn.primitive.name)
+    if param is None:
+        return ()
+    v = eqn.params.get(param)
+    if v is None:
+        return ()
+    names = v if isinstance(v, (list, tuple)) else (v,)
+    return tuple(str(n) for n in names)
+
+
+def out_elems(eqn) -> int:
+    """Total output elements of an eqn (payload-size proxy)."""
+    total = 0
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", ())
+        total += int(np.prod(shape)) if shape else 1
+    return total
+
+
+def out_dtype(eqn):
+    """Dtype of the eqn's first output (collectives are homogeneous)."""
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        if getattr(aval, "dtype", None) is not None:
+            return aval.dtype
+    return None
+
+
+# -- pass registry ------------------------------------------------------
+
+PASSES: "dict[str, Callable[[LintContext], list]]" = {}
+
+
+def lint_pass(name: str):
+    """Register a pass under ``name`` (the catalog key the CLI, the
+    report, and DESIGN.md §9 all use)."""
+
+    def register(fn):
+        PASSES[name] = fn
+        return fn
+
+    return register
+
+
+def run_passes(ctx: LintContext,
+               only: Optional[list] = None) -> "list[Finding]":
+    """Run the registered passes (or the ``only`` subset) over one
+    context, findings concatenated in catalog order."""
+    import akka_allreduce_tpu.analysis.passes  # noqa: F401  (registers)
+    findings = []
+    for name, fn in PASSES.items():
+        if only is not None and name not in only:
+            continue
+        findings.extend(fn(ctx))
+    return findings
+
+
+# -- entry tracing ------------------------------------------------------
+
+def _flat_args(tree_args: tuple, donate_argnums: tuple,
+               static_argnums: tuple) -> tuple:
+    """Flatten example args to (names, avals, donated) records, arg-major
+    — the same order jit lowers them in. Static args carry no buffers
+    and are skipped."""
+    names, avals, donated = [], [], []
+    for i, arg in enumerate(tree_args):
+        if i in static_argnums:
+            continue
+        for path, leaf in jax.tree.flatten_with_path(arg)[0]:
+            names.append(f"arg{i}" + "".join(str(p) for p in path))
+            avals.append(jax.api_util.shaped_abstractify(leaf))
+            donated.append(i in donate_argnums)
+    return tuple(names), tuple(avals), tuple(donated)
+
+
+def trace_entry(name: str, fn, args: tuple, policy: LintPolicy,
+                donate_argnums: tuple = (), static_argnums: tuple = (),
+                lower: bool = True) -> LintContext:
+    """Trace ``fn(*args)`` to a LintContext: jaxpr always; StableHLO
+    text when ``lower`` (the donation pass needs it — aliasing is a
+    lowering artifact, not a jaxpr one). ``fn`` may already be a jit
+    wrapper (the production entry points are; linting THEIR wrapper
+    keeps the declared donations in the artifact) — then
+    ``donate_argnums``/``static_argnums`` only label the flat record.
+    Accepts concrete arrays or ShapeDtypeStructs; never executes or
+    compiles."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(
+        fn, donate_argnums=donate_argnums,
+        static_argnums=static_argnums or None)
+    # one trace covers both artifacts when the AOT Traced stage exists
+    # (0.4.29+); otherwise pay a second trace for the lowering
+    text = None
+    try:
+        traced = jitted.trace(*args)
+        closed = traced.jaxpr
+        if lower:
+            text = traced.lower().as_text()
+    except AttributeError:
+        if lower:
+            text = jitted.lower(*args).as_text()
+        closed = jax.make_jaxpr(
+            fn, static_argnums=static_argnums)(*args)
+    names, avals, donated = _flat_args(args, tuple(donate_argnums),
+                                       tuple(static_argnums))
+    return LintContext(name=name, jaxpr=closed, policy=policy,
+                       arg_names=names, in_avals=avals, donated=donated,
+                       stablehlo=text)

@@ -1,0 +1,300 @@
+"""The stack's jitted entry points, traced for the lint passes.
+
+Each builder constructs a miniature-but-structurally-faithful instance
+of one production entry point — same factory, same jit wrapper, same
+donation declarations, tiny shapes — and traces it to a
+:class:`~akka_allreduce_tpu.analysis.core.LintContext` with the policy
+that entry's contract implies. CPU-only and execution-free: meshes are
+virtual host devices, tracing never touches a chip, and nothing
+compiles (tier-1-safe by construction).
+
+The catalog (``lint --all`` order):
+
+==================  =================================================
+train_step          make_train_step, fused f32 wire, donate=True,
+                    dp x tp mesh — donation + axis existence + hot-loop
+                    hygiene on the flagship step
+train_step_windowed windowed schedule — adds the rs/ag pairing check
+train_step_int8     int8 wire — adds the wire-dtype discipline
+train_step_bf16     bf16 compute — upcast census (info)
+generate            models/generate.py greedy decode (prefill + scan)
+engine_step         serving/engine.py _engine_step — state donation is
+                    the engine's HBM contract
+engine_prefill      serving/engine.py _engine_prefill — ditto
+collective_fused    two_phase_allreduce under shard_map — reduction-
+                    axis discipline + pairing
+collective_windowed pipelined_two_phase_allreduce (W=2) — pairing
+                    across windows
+collective_int8     quantized_two_phase_allreduce, lossy (masked) via
+                    allreduce_gradients — wire dtype + exact int32
+                    counts
+collective_bf16     bf16-wire lossy allreduce_gradients — wire dtype +
+                    exact counts
+==================  =================================================
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from akka_allreduce_tpu.analysis.core import (
+    LintContext,
+    LintPolicy,
+    trace_entry,
+)
+
+# Small enough that tracing the whole catalog stays in seconds; real
+# enough that every structural feature (GQA off, MoE off, 2 layers,
+# >= 2 buckets) exists in the jaxpr.
+_D_MODEL, _LAYERS, _HEADS, _DFF, _VOCAB, _SEQ = 32, 2, 4, 64, 61, 16
+_BUCKET_ELEMS = 256
+
+
+def _require_devices(n: int) -> None:
+    import jax
+    have = len(jax.devices())
+    if have < n:
+        raise RuntimeError(
+            f"lint needs {n} (virtual) devices for its mesh entries but "
+            f"the backend has {have} — run with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8 set before jax "
+            f"initializes (the lint CLI and tests/conftest.py both do)")
+
+
+def _model_cfg():
+    from akka_allreduce_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(
+        vocab_size=_VOCAB, d_model=_D_MODEL, n_heads=_HEADS,
+        n_layers=_LAYERS, d_ff=_DFF, max_seq=_SEQ)
+
+
+def _mesh(dp: int, tp: int = 1):
+    import jax
+    from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                  make_device_mesh)
+    _require_devices(dp * tp)
+    return make_device_mesh(MeshSpec(dp=dp, tp=tp),
+                            devices=jax.devices()[:dp * tp])
+
+
+def _mesh_axes(mesh) -> frozenset:
+    return frozenset(str(a) for a in mesh.axis_names)
+
+
+def _tokens(batch: int, seq: int = _SEQ):
+    rng = np.random.default_rng(0)
+    return rng.integers(0, _VOCAB, size=(batch, seq), dtype=np.int32)
+
+
+# -- train steps --------------------------------------------------------
+
+def _train_entry(name: str, dp: int, tp: int, policy_kw: dict,
+                 **cfg_kw) -> LintContext:
+    import jax
+    from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                 make_train_state,
+                                                 make_train_step)
+    mesh = _mesh(dp, tp)
+    cfg = TrainConfig(model=_model_cfg(), bucket_elems=_BUCKET_ELEMS,
+                      **cfg_kw)
+    params, opt_state, opt = make_train_state(jax.random.key(0), cfg,
+                                              mesh)
+    step = make_train_step(cfg, mesh, opt, donate=True)
+    policy = LintPolicy(known_axes=_mesh_axes(mesh),
+                        expect_donation=True, hot=True,
+                        compute_dtype=cfg.compute_dtype, **policy_kw)
+    return trace_entry(name, step, (params, opt_state, _tokens(2 * dp)),
+                       policy, donate_argnums=(0, 1))
+
+
+def build_train_step() -> LintContext:
+    return _train_entry("train_step", dp=2, tp=2, policy_kw={})
+
+
+def build_train_step_windowed() -> LintContext:
+    return _train_entry("train_step_windowed", dp=2, tp=1,
+                        policy_kw={"expect_two_phase": True},
+                        transport_schedule="windowed", num_windows=2)
+
+
+def build_train_step_int8() -> LintContext:
+    return _train_entry("train_step_int8", dp=2, tp=1,
+                        policy_kw={"wire": "int8",
+                                   "expect_two_phase": True},
+                        grad_transport="int8")
+
+
+def build_train_step_bf16() -> LintContext:
+    return _train_entry("train_step_bf16", dp=2, tp=1, policy_kw={},
+                        compute_dtype="bf16")
+
+
+# -- decode / serving ---------------------------------------------------
+
+def build_generate() -> LintContext:
+    import jax
+    from akka_allreduce_tpu.models.generate import generate
+    from akka_allreduce_tpu.models.transformer import init_transformer
+    cfg = _model_cfg()
+    params = init_transformer(jax.random.key(0), cfg)
+    prompt = _tokens(1, 4)
+    policy = LintPolicy(hot=True)
+    # no donated args -> the donation pass never reads the StableHLO;
+    # skip the lowering (the expensive half of the trace)
+    return trace_entry("generate", generate,
+                       (params, prompt, cfg, 4), policy,
+                       static_argnums=(2, 3), lower=False)
+
+
+def _engine_pieces():
+    import jax
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.models.generate import init_kv_cache
+    from akka_allreduce_tpu.models.transformer import init_transformer
+    cfg = _model_cfg()
+    params = init_transformer(jax.random.key(0), cfg)
+    slots = 2
+    base = init_kv_cache(cfg, slots)
+    del base["pos"]
+    state = {**base,
+             "logits": jnp.zeros((slots, cfg.vocab_size), cfg.dtype)}
+    return cfg, params, state, slots
+
+
+def build_engine_step() -> LintContext:
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.serving.engine import _engine_step
+    cfg, params, state, slots = _engine_pieces()
+    pos = jnp.zeros((slots,), jnp.int32)
+    policy = LintPolicy(expect_donation=True, hot=True)
+    return trace_entry("engine_step", _engine_step,
+                       (params, state, pos, cfg), policy,
+                       donate_argnums=(1,), static_argnums=(3,))
+
+
+def build_engine_prefill() -> LintContext:
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.serving.engine import _engine_prefill
+    cfg, params, state, _slots = _engine_pieces()
+    prompt = _tokens(1, 4)
+    policy = LintPolicy(expect_donation=True, hot=True)
+    return trace_entry(
+        "engine_prefill", _engine_prefill,
+        (params, state, prompt, jnp.asarray(4, jnp.int32),
+         jnp.asarray(0, jnp.int32), cfg, False),
+        policy, donate_argnums=(1,), static_argnums=(5, 6))
+
+
+# -- standalone collectives ---------------------------------------------
+
+def _collective_policy(mesh, **kw) -> LintPolicy:
+    return LintPolicy(known_axes=_mesh_axes(mesh),
+                      reduce_axes=frozenset({"dp"}),
+                      expect_two_phase=True, **kw)
+
+
+def build_collective_fused() -> LintContext:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.ops.collectives import two_phase_allreduce
+    mesh = _mesh(dp=2)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        return two_phase_allreduce(stacked[0], "dp")[None]
+
+    x = jnp.zeros((2, 4, _BUCKET_ELEMS), jnp.float32)
+    return trace_entry("collective_fused", entry, (x,),
+                       _collective_policy(mesh), lower=False)
+
+
+def build_collective_windowed() -> LintContext:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.ops.collectives import (
+        pipelined_two_phase_allreduce)
+    mesh = _mesh(dp=2)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        return pipelined_two_phase_allreduce(
+            stacked[0], "dp", num_windows=2)[None]
+
+    x = jnp.zeros((2, 4, _BUCKET_ELEMS), jnp.float32)
+    return trace_entry("collective_windowed", entry, (x,),
+                       _collective_policy(mesh), lower=False)
+
+
+def _lossy_sync_entry(name: str, transport: str,
+                      policy_kw: dict) -> LintContext:
+    """allreduce_gradients on a compressed wire with a straggler mask —
+    the full lossy sync: compressed payload + exact int32 counts."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.ops.bucketing import bucketize
+    from akka_allreduce_tpu.parallel.dp import (GradSyncConfig,
+                                                allreduce_gradients)
+    mesh = _mesh(dp=2)
+    grads = {"w": jnp.zeros((_D_MODEL, _D_MODEL), jnp.float32),
+             "b": jnp.zeros((_D_MODEL,), jnp.float32)}
+    sync = GradSyncConfig(bucket_elems=_BUCKET_ELEMS, axis_name="dp",
+                          transport=transport,
+                          return_elem_counts=False)
+    _, spec = bucketize(grads, sync.bucket_elems)
+    valid = jnp.ones((spec.num_buckets,), jnp.float32)
+    key = jax.random.key(0)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+             out_specs=(P(), P()), check_vma=False)
+    def entry(tree, valid, key):
+        out = allreduce_gradients(tree, sync, valid=valid,
+                                  quant_key=key)
+        return out.grads, out.bucket_counts
+
+    policy = LintPolicy(known_axes=_mesh_axes(mesh),
+                        reduce_axes=frozenset({"dp"}),
+                        exact_counts=True, wire=transport, **policy_kw)
+    # undonated collective entries skip lowering too (see generate)
+    return trace_entry(name, entry, (grads, valid, key), policy,
+                       lower=False)
+
+
+def build_collective_int8() -> LintContext:
+    return _lossy_sync_entry("collective_int8", "int8",
+                             {"expect_two_phase": True})
+
+
+def build_collective_bf16() -> LintContext:
+    return _lossy_sync_entry("collective_bf16", "bf16", {})
+
+
+ENTRYPOINTS = {
+    "train_step": build_train_step,
+    "train_step_windowed": build_train_step_windowed,
+    "train_step_int8": build_train_step_int8,
+    "train_step_bf16": build_train_step_bf16,
+    "generate": build_generate,
+    "engine_step": build_engine_step,
+    "engine_prefill": build_engine_prefill,
+    "collective_fused": build_collective_fused,
+    "collective_windowed": build_collective_windowed,
+    "collective_int8": build_collective_int8,
+    "collective_bf16": build_collective_bf16,
+}
+
+
+def build_entrypoints(names: Optional[list] = None) -> "list[LintContext]":
+    """Build (trace) the named entry points — all of them by default."""
+    unknown = set(names or ()) - set(ENTRYPOINTS)
+    if unknown:
+        raise ValueError(f"unknown lint target(s) {sorted(unknown)}; "
+                         f"have {sorted(ENTRYPOINTS)}")
+    return [ENTRYPOINTS[n]() for n in (names or ENTRYPOINTS)]

@@ -1,0 +1,294 @@
+"""The pass catalog. Each pass is policy-gated (core.LintPolicy): the
+same eqn pattern is legitimate in one program and a bug in another, and
+the policy — not the pass — knows which program it is looking at.
+
+Catalog (the names the CLI/report/DESIGN.md §9 use):
+
+* ``collective-axis`` — every collective names axes the mesh has;
+  float-payload reductions stay on the declared data axes; windowed
+  schedules keep their reduce/gather phases paired.
+* ``donation``        — declared ``donate_argnums`` actually alias in
+  the lowered module; entries whose loop contract depends on in-place
+  update actually declare donation; large buffers outliving donated
+  peers are surfaced.
+* ``dtype``           — compressed wires (bf16/int8) move no f32
+  payload; count psums stay integer; weak-type entry inputs (the
+  compile-cache splitters) are flagged; bf16 compute paths report their
+  f32 upcasts.
+* ``host-sync``       — callbacks / host round-trips reachable from hot
+  loops (and, for per-step entries, anywhere at all).
+
+Adding a pass: write ``(LintContext) -> list[Finding]``, decorate with
+``@lint_pass("name")``, give it at least one deliberately-broken fixture
+in selfcheck.py proving it fires and one clean entry proving it stays
+quiet (docs/DESIGN.md §9 has the recipe).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from akka_allreduce_tpu.analysis.core import (
+    COLLECTIVE_PRIMS,
+    GATHER_PHASE_PRIMS,
+    HOST_SYNC_PRIMS,
+    REDUCE_PHASE_PRIMS,
+    Finding,
+    LintContext,
+    eqn_axes,
+    iter_eqns,
+    lint_pass,
+    out_dtype,
+    out_elems,
+)
+
+
+def _is_float(dtype) -> bool:
+    return dtype is not None and jnp.issubdtype(dtype, jnp.floating)
+
+
+@lint_pass("collective-axis")
+def collective_axis_pass(ctx: LintContext) -> list:
+    """Axis existence, reduction-axis discipline, two-phase pairing."""
+    findings = []
+    pol = ctx.policy
+    # per-axis phase tallies for the pairing check
+    reduce_count: dict = {}
+    gather_count: dict = {}
+    for eqn, _in_loop in iter_eqns(ctx.jaxpr):
+        prim = eqn.primitive.name
+        if prim not in COLLECTIVE_PRIMS:
+            continue
+        axes = eqn_axes(eqn)
+        where = f"{prim}[{','.join(axes)}]"
+        for ax in axes:
+            if ax not in pol.known_axes:
+                findings.append(Finding(
+                    "collective-axis", "error", ctx.name,
+                    f"{prim} names axis {ax!r} which the enclosing mesh "
+                    f"does not define (axes: "
+                    f"{sorted(pol.known_axes) or 'none'}) — an SPMD "
+                    f"program binding a phantom axis reduces over the "
+                    f"wrong ranks or fails only at scale", where))
+        dtype = out_dtype(eqn)
+        if (pol.reduce_axes is not None and _is_float(dtype)
+                and prim in ("psum", "reduce_scatter")):
+            stray = [a for a in axes if a not in pol.reduce_axes]
+            if stray:
+                findings.append(Finding(
+                    "collective-axis", "error", ctx.name,
+                    f"float-payload {prim} reduces over {stray} but this "
+                    f"entry's data reduction is declared over "
+                    f"{sorted(pol.reduce_axes)} — gradients summed over "
+                    f"a model axis are silently wrong (portable-"
+                    f"collectives failure mode: axis/mesh mismatch)",
+                    where))
+        if prim in REDUCE_PHASE_PRIMS:
+            for ax in axes:
+                reduce_count[ax] = reduce_count.get(ax, 0) + 1
+        if prim in GATHER_PHASE_PRIMS:
+            for ax in axes:
+                gather_count[ax] = gather_count.get(ax, 0) + 1
+    if pol.expect_two_phase:
+        for ax in sorted(set(reduce_count) | set(gather_count)):
+            r, g = reduce_count.get(ax, 0), gather_count.get(ax, 0)
+            if r != g:
+                findings.append(Finding(
+                    "collective-axis", "error", ctx.name,
+                    f"two-phase windows unpaired over axis {ax!r}: "
+                    f"{r} reduce-phase collective(s) "
+                    f"(reduce_scatter/all_to_all) vs {g} all_gather(s) "
+                    f"— a window whose gather (or scatter) was dropped "
+                    f"leaves some ranks holding partial sums",
+                    f"axis {ax}"))
+    return findings
+
+
+# the lowered markers jit emits for a donated input that survived
+# lowering: ``tf.aliasing_output`` pins the input to a specific output
+# at lowering time (simple un-sharded programs); ``jax.buffer_donor``
+# hands the buffer to XLA to alias during compilation (the sharded /
+# mesh path, where output layout is XLA's call). A donation that was
+# UNUSABLE (dtype/shape matched no output) gets neither marker — JAX
+# warns once at lowering and silently copies forever after, which is
+# exactly the state this pass hardens into a gated finding.
+_ALIAS_ATTRS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+@lint_pass("donation")
+def donation_pass(ctx: LintContext) -> list:
+    """Declared donations must survive lowering; expected donations must
+    be declared; buffers dwarfing the donated set are surfaced."""
+    findings = []
+    pol = ctx.policy
+    declared = sum(bool(d) for d in ctx.donated)
+    if pol.expect_donation and declared == 0:
+        findings.append(Finding(
+            "donation", "error", ctx.name,
+            "entry is expected to update its state in place "
+            "(donate_argnums) but declares no donated args — every step "
+            "doubles the state's HBM residency"))
+    if ctx.stablehlo is None or declared == 0:
+        return findings
+    aliased = sum(len(re.findall(re.escape(attr), ctx.stablehlo))
+                  for attr in _ALIAS_ATTRS)
+    if aliased < declared:
+        dropped = declared - aliased
+        findings.append(Finding(
+            "donation", "error", ctx.name,
+            f"{dropped} of {declared} donated buffer(s) did not "
+            f"survive lowering (no {' / '.join(_ALIAS_ATTRS)} "
+            f"attribute) — XLA will silently copy instead of reusing "
+            f"them; the usual causes are a dtype/shape mismatch between "
+            f"the donated input and every output, or an output that "
+            f"was already claimed by another donor"))
+    if pol.expect_donation:
+        # the bar is the TOTAL donated set, not the largest single leaf:
+        # a quantized state legitimately donates many small buffers, and
+        # a read-only weights leaf out-sizing one of them is fine — a
+        # single non-donated buffer dwarfing the whole donated state is
+        # the "forgot the new state arg in donate_argnums" signature
+        total_donated = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a, d in zip(ctx.in_avals, ctx.donated) if d)
+        for name, aval, d in zip(ctx.arg_names, ctx.in_avals,
+                                 ctx.donated):
+            if d:
+                continue
+            nbytes = int(np.prod(aval.shape)) * aval.dtype.itemsize
+            if nbytes > total_donated:
+                findings.append(Finding(
+                    "donation", "warning", ctx.name,
+                    f"non-donated input {name} ({aval.dtype}"
+                    f"{list(aval.shape)}, {nbytes} B) outweighs the "
+                    f"entire donated set ({total_donated} B) — if the "
+                    f"caller rebinds it per step it is a donation "
+                    f"candidate", name))
+    return findings
+
+
+# f32 scale vectors legitimately ride beside int8 payloads (one scale
+# per row); anything bigger than payload/8 is not a scale vector.
+_SCALE_RATIO = 8
+
+
+@lint_pass("dtype")
+def dtype_pass(ctx: LintContext) -> list:
+    """Wire-dtype discipline, exact counts, weak-type inputs, upcasts."""
+    findings = []
+    pol = ctx.policy
+    # weak-type entry inputs: each Python-scalar-typed argument splits
+    # jit's cache in two (weak vs strong) and recompiles on first mix
+    for name, aval in zip(ctx.arg_names, ctx.in_avals):
+        if getattr(aval, "weak_type", False):
+            findings.append(Finding(
+                "dtype", "warning", ctx.name,
+                f"input {name} is weak-typed ({aval.dtype}, weak) — a "
+                f"Python scalar reached the jit boundary; passing it as "
+                f"jnp.asarray(x, {aval.dtype}) keeps one compile-cache "
+                f"entry instead of two", name))
+    upcasts = 0
+    int8_wire_elems = 0
+    bf16_wire_elems = 0
+    f32_wire: list = []
+    float_psums: list = []
+    for eqn, _in_loop in iter_eqns(ctx.jaxpr):
+        prim = eqn.primitive.name
+        dtype = out_dtype(eqn)
+        if prim in COLLECTIVE_PRIMS:
+            if dtype == jnp.int8:
+                int8_wire_elems = max(int8_wire_elems, out_elems(eqn))
+            elif dtype == jnp.bfloat16:
+                bf16_wire_elems = max(bf16_wire_elems, out_elems(eqn))
+            if _is_float(dtype):
+                f32_wire.append((eqn, dtype))
+                if prim == "psum":
+                    float_psums.append((eqn, dtype))
+        if (prim == "convert_element_type"
+                and pol.compute_dtype == "bf16"
+                and dtype == jnp.float32):
+            in_aval = getattr(eqn.invars[0], "aval", None)
+            if getattr(in_aval, "dtype", None) == jnp.bfloat16:
+                upcasts += 1
+    if pol.exact_counts:
+        # the only float psum a compressed-wire lossy entry may carry is
+        # the PAYLOAD in the wire's own dtype (bf16 wire psums bf16; the
+        # int8 wire moves payload on all_to_all/all_gather, never psum).
+        # Any other float psum is a count that lost its int32 exactness
+        # — including a count CAST to the wire dtype, which dtype alone
+        # cannot distinguish from payload: counts are count-shaped, so a
+        # wire-dtyped psum far smaller than the wire payload is a count
+        # (bf16 integer counts round above 256 contributors — exactly
+        # the corruption the honesty contract exists to prevent)
+        wire_psum_dtype = (jnp.bfloat16 if pol.wire == "bf16" else None)
+        count_floor = max(1, bf16_wire_elems // _SCALE_RATIO)
+        for eqn, dtype in float_psums:
+            payload_like = (dtype == wire_psum_dtype
+                            and out_elems(eqn) > count_floor)
+            if not payload_like:
+                findings.append(Finding(
+                    "dtype", "error", ctx.name,
+                    f"psum with {dtype} payload "
+                    f"({out_elems(eqn)} elems) in an exact-counts "
+                    f"entry — lossy-round completion counts must ride "
+                    f"an exact int32 psum (the honesty contract "
+                    f"tolerates no rounding)",
+                    f"psum[{','.join(eqn_axes(eqn))}]"))
+    if pol.wire == "bf16":
+        for eqn, dtype in f32_wire:
+            if dtype == jnp.float32:
+                findings.append(Finding(
+                    "dtype", "error", ctx.name,
+                    f"{eqn.primitive.name} moves float32 payload "
+                    f"({out_elems(eqn)} elems) on a bf16 wire — the "
+                    f"cast was dropped and the collective ships double "
+                    f"the bytes the schedule was sized for",
+                    f"{eqn.primitive.name}[{','.join(eqn_axes(eqn))}]"))
+    elif pol.wire == "int8":
+        floor = max(1, int8_wire_elems // _SCALE_RATIO)
+        for eqn, dtype in f32_wire:
+            if out_elems(eqn) > floor:
+                findings.append(Finding(
+                    "dtype", "error", ctx.name,
+                    f"{eqn.primitive.name} moves {dtype} payload "
+                    f"({out_elems(eqn)} elems) on an int8 wire — "
+                    f"larger than any scale vector (largest int8 "
+                    f"payload {int8_wire_elems} elems / {_SCALE_RATIO})"
+                    f", so un-quantized data escaped to the wire "
+                    f"(EQuARX failure mode: dtype/scale plumbing)",
+                    f"{eqn.primitive.name}[{','.join(eqn_axes(eqn))}]"))
+    if upcasts:
+        findings.append(Finding(
+            "dtype", "info", ctx.name,
+            f"{upcasts} bf16->f32 upcast(s) inside a bf16 compute path "
+            f"(loss/softmax/norm statistics are f32 by design; audit "
+            f"if this count grows across a refactor)"))
+    return findings
+
+
+@lint_pass("host-sync")
+def host_sync_pass(ctx: LintContext) -> list:
+    """Host round-trips reachable from hot code."""
+    findings = []
+    for eqn, in_loop in iter_eqns(ctx.jaxpr):
+        prim = eqn.primitive.name
+        if prim not in HOST_SYNC_PRIMS and "callback" not in prim:
+            continue
+        if in_loop:
+            findings.append(Finding(
+                "host-sync", "error", ctx.name,
+                f"{prim} inside a scan/while body — the device "
+                f"serializes against the host every trip (a debug "
+                f"print left in a decode loop turns tokens/s into "
+                f"round-trips/s)", prim))
+        elif ctx.policy.hot:
+            findings.append(Finding(
+                "host-sync", "warning", ctx.name,
+                f"{prim} in a per-step entry — one host round-trip "
+                f"per dispatch; keep callbacks out of the steady "
+                f"state (runtime/tracing.py samples host-side "
+                f"instead)", prim))
+    return findings

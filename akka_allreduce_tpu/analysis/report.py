@@ -1,0 +1,67 @@
+"""Lint report rendering + exit-code gating (the ``lint`` CLI surface).
+
+Text mode prints one line per finding, grouped by entry point, worst
+severity first; JSON mode emits a machine-checkable document (the CI
+contract — tier1.yml parses nothing, it just gates on the exit code,
+but the artifact keeps the triage story reviewable). Exit codes:
+
+* 0 — no findings at or above the gate severity
+* 1 — at least one gating finding (CI fails)
+* 2 — usage / build error (bad target, missing devices)
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from akka_allreduce_tpu.analysis.core import Finding
+
+_ORDER = {"error": 0, "warning": 1, "info": 2}
+
+
+def sort_findings(findings: Iterable[Finding]) -> "list[Finding]":
+    return sorted(findings,
+                  key=lambda f: (_ORDER.get(f.severity, 3),
+                                 f.entrypoint, f.pass_name))
+
+
+def render_text(entry_names: "list[str]",
+                findings: "list[Finding]") -> str:
+    lines = []
+    fs = sort_findings(findings)
+    counts = {}
+    for f in fs:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    for f in fs:
+        where = f" @ {f.where}" if f.where else ""
+        lines.append(f"{f.severity.upper():7s} [{f.pass_name}] "
+                     f"{f.entrypoint}{where}: {f.message}")
+    clean = [n for n in entry_names
+             if not any(f.entrypoint == n for f in fs)]
+    if clean:
+        lines.append(f"clean: {', '.join(clean)}")
+    summary = ", ".join(f"{v} {k}" for k, v in sorted(
+        counts.items(), key=lambda kv: _ORDER.get(kv[0], 3))) or "clean"
+    lines.append(f"lint: {len(entry_names)} entry point(s), {summary}")
+    return "\n".join(lines)
+
+
+def render_json(entry_names: "list[str]",
+                findings: "list[Finding]") -> dict:
+    fs = sort_findings(findings)
+    return {
+        "entrypoints": entry_names,
+        "findings": [f.to_json() for f in fs],
+        "summary": {
+            "errors": sum(f.severity == "error" for f in fs),
+            "warnings": sum(f.severity == "warning" for f in fs),
+            "info": sum(f.severity == "info" for f in fs),
+        },
+    }
+
+
+def exit_code(findings: Iterable[Finding], strict: bool = False) -> int:
+    """1 when any finding gates (errors always; warnings under
+    ``strict``), else 0."""
+    gate = {"error", "warning"} if strict else {"error"}
+    return 1 if any(f.severity in gate for f in findings) else 0

@@ -1,0 +1,300 @@
+"""Deliberately-broken fixtures the linter must catch — its own tier-1.
+
+A linter that silently stops firing is worse than no linter: CI keeps
+passing while the invariant it guarded rots. ``lint --selfcheck`` (and
+tests/test_analysis.py) builds one small program per bug class the pass
+catalog claims to catch — wrong collective axis, unpaired window,
+dropped donation, f32 leak on a compressed wire, float-dtyped counts,
+callback in a hot loop, weak-type input, post-warmup recompile — and
+fails unless every pass fires on its fixture.
+
+Fixtures are *realistic miniatures*: each one is the smallest program
+that makes the production mistake, not a synthetic eqn soup, so a pass
+that bit-rots against real jaxpr shapes fails here first.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from akka_allreduce_tpu.analysis.core import (
+    Finding,
+    LintPolicy,
+    run_passes,
+    trace_entry,
+)
+
+
+def _mesh2():
+    import jax
+    from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                  make_device_mesh)
+    return make_device_mesh(MeshSpec(dp=2, tp=2),
+                            devices=jax.devices()[:4])
+
+
+def _axes(mesh) -> frozenset:
+    return frozenset(str(a) for a in mesh.axis_names)
+
+
+def fixture_bad_axis():
+    """Gradient-style reduction issued over the MODEL axis — the
+    portable-collectives silent killer (compiles fine, sums the wrong
+    ranks)."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh2()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        return jax.lax.psum(stacked[0], "tp")[None]  # meant "dp"
+
+    x = jnp.zeros((2, 8), jnp.float32)
+    policy = LintPolicy(known_axes=_axes(mesh),
+                        reduce_axes=frozenset({"dp"}))
+    return trace_entry("fixture_bad_axis", entry, (x,), policy,
+                       lower=False)
+
+
+def fixture_unpaired_window():
+    """A windowed schedule that drops one window's all-gather: those
+    ranks keep scattered partial sums."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh2()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        x = stacked[0]
+        w0, w1 = x[:2], x[2:]
+        s0 = lax.psum_scatter(w0, "dp", scatter_dimension=1, tiled=True)
+        s1 = lax.psum_scatter(w1, "dp", scatter_dimension=1, tiled=True)
+        g0 = lax.all_gather(s0, "dp", axis=1, tiled=True)
+        # BUG: window 1's gather forgotten; s1 returned scattered
+        return jnp.concatenate(
+            [g0, jnp.tile(s1, (1, 2))], axis=0)[None]
+
+    x = jnp.zeros((2, 4, 8), jnp.float32)
+    policy = LintPolicy(known_axes=_axes(mesh),
+                        reduce_axes=frozenset({"dp"}),
+                        expect_two_phase=True)
+    return trace_entry("fixture_unpaired_window", entry, (x,), policy,
+                       lower=False)
+
+
+def fixture_dropped_donation():
+    """donate_argnums declared, but no output matches the donated
+    buffer's dtype — XLA copies silently; the HBM saving never happens."""
+    import jax
+    import jax.numpy as jnp
+
+    def entry(state, x):
+        # the "updated state" comes back bf16: the f32 donor can't alias
+        return (state + x).astype(jnp.bfloat16)
+
+    args = (jnp.zeros((64, 64), jnp.float32),
+            jnp.ones((64, 64), jnp.float32))
+    policy = LintPolicy(expect_donation=True)
+    with warnings.catch_warnings():
+        # jit warns about the unusable donation at lowering — that
+        # warning is exactly what this fixture exists to harden into a
+        # gated finding
+        warnings.simplefilter("ignore")
+        return trace_entry("fixture_dropped_donation", entry, args,
+                           policy, donate_argnums=(0,))
+
+
+def fixture_missing_donation():
+    """A state-updating step that never declares donation: every call
+    holds live input AND output state (double HBM residency)."""
+    import jax.numpy as jnp
+
+    def entry(state, x):
+        return state + x
+
+    args = (jnp.zeros((64, 64), jnp.float32),
+            jnp.ones((64, 64), jnp.float32))
+    policy = LintPolicy(expect_donation=True)
+    return trace_entry("fixture_missing_donation", entry, args, policy)
+
+
+def fixture_f32_leak():
+    """bf16 wire with the cast dropped: the collective ships 2x the
+    bytes the schedule was sized for."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh2()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        buckets = stacked[0]
+        # BUG: psum the f32 buckets directly; .astype(bf16) forgotten
+        return jax.lax.psum(buckets, "dp")[None]
+
+    x = jnp.zeros((2, 4, 64), jnp.float32)
+    policy = LintPolicy(known_axes=_axes(mesh),
+                        reduce_axes=frozenset({"dp"}), wire="bf16")
+    return trace_entry("fixture_f32_leak", entry, (x,), policy,
+                       lower=False)
+
+
+def fixture_float_count():
+    """Lossy-round completion counts psummed in f32 — the honesty
+    contract (exact integer counts) silently rounded."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh2()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
+             out_specs=(P("dp"), P("dp")), check_vma=False)
+    def entry(stacked, valid):
+        contrib = (stacked[0] * valid[0][:, None]).astype(jnp.bfloat16)
+        summed = jax.lax.psum(contrib, "dp").astype(jnp.float32)
+        # BUG: counts ride a float psum instead of int32
+        counts = jax.lax.psum(valid[0], "dp")
+        return summed[None], counts[None]
+
+    x = jnp.zeros((2, 4, 64), jnp.float32)
+    valid = jnp.ones((2, 4), jnp.float32)
+    policy = LintPolicy(known_axes=_axes(mesh), wire="bf16",
+                        exact_counts=True)
+    return trace_entry("fixture_float_count", entry, (x, valid), policy,
+                       lower=False)
+
+
+def fixture_bf16_count():
+    """Completion counts cast to the WIRE dtype before the psum: same
+    dtype as legitimate payload, but count-shaped — bf16 integer counts
+    round above 256 contributors, silently corrupting the per-bucket
+    rescale."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh2()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
+             out_specs=(P("dp"), P("dp")), check_vma=False)
+    def entry(stacked, valid):
+        contrib = (stacked[0] * valid[0][:, None]).astype(jnp.bfloat16)
+        summed = jax.lax.psum(contrib, "dp").astype(jnp.float32)
+        # BUG: counts ride the wire dtype instead of int32
+        counts = jax.lax.psum(valid[0].astype(jnp.bfloat16), "dp")
+        return summed[None], counts[None]
+
+    x = jnp.zeros((2, 4, 64), jnp.float32)
+    valid = jnp.ones((2, 4), jnp.float32)
+    policy = LintPolicy(known_axes=_axes(mesh), wire="bf16",
+                        exact_counts=True)
+    return trace_entry("fixture_bf16_count", entry, (x, valid), policy,
+                       lower=False)
+
+
+def fixture_hidden_callback():
+    """A debug print left inside the decode scan: one host round-trip
+    per token."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def entry(x):
+        def body(carry, _):
+            jax.debug.print("carry={c}", c=carry)  # BUG: left in
+            return carry * 1.01, carry
+        return lax.scan(body, x, None, length=4)
+
+    policy = LintPolicy(hot=True)
+    return trace_entry("fixture_hidden_callback", entry,
+                       (jnp.float32(1.0),), policy, lower=False)
+
+
+def fixture_weak_input():
+    """A Python scalar reaching the jit boundary: the compile cache
+    splits on weak-vs-strong and the step recompiles on first mix."""
+    import jax.numpy as jnp
+
+    def entry(x, lr):
+        return x * lr
+
+    policy = LintPolicy(hot=True)
+    return trace_entry("fixture_weak_input", entry,
+                       (jnp.zeros((4,), jnp.float32), 0.1), policy,
+                       lower=False)
+
+
+# (fixture name, pass that must fire, severity it must fire at)
+FIXTURES = [
+    ("bad_axis", fixture_bad_axis, "collective-axis", "error"),
+    ("unpaired_window", fixture_unpaired_window, "collective-axis",
+     "error"),
+    ("dropped_donation", fixture_dropped_donation, "donation", "error"),
+    ("missing_donation", fixture_missing_donation, "donation", "error"),
+    ("f32_leak", fixture_f32_leak, "dtype", "error"),
+    ("float_count", fixture_float_count, "dtype", "error"),
+    ("bf16_count", fixture_bf16_count, "dtype", "error"),
+    ("hidden_callback", fixture_hidden_callback, "host-sync", "error"),
+    ("weak_input", fixture_weak_input, "dtype", "warning"),
+]
+
+
+def _check_recompile_guard() -> "tuple[bool, str]":
+    """The runtime fixture: a warmed function recompiles (shape change)
+    inside the guard — RecompileError must fire, and a quiet repeat at
+    the warmed shape must not."""
+    import jax
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.analysis.recompile import (RecompileError,
+                                                       no_recompiles)
+
+    @jax.jit
+    def step(x):
+        return x * 2.0
+
+    step(jnp.zeros((4,)))  # warmup
+    try:
+        with no_recompiles("selfcheck warmed step"):
+            step(jnp.zeros((4,)))  # cache hit: quiet
+    except RecompileError as e:
+        return False, f"guard fired on a warmed shape: {e}"
+    try:
+        with no_recompiles("selfcheck shape drift"):
+            step(jnp.zeros((5,)))  # BUG-shaped: new program
+    except RecompileError:
+        return True, "recompile guard: caught the shape drift"
+    return False, "recompile guard NEVER fired on a shape change"
+
+
+def run_selfcheck() -> "tuple[bool, list[str]]":
+    """Build every fixture, run the pass catalog, verify each expected
+    (pass, severity) fires. Returns (all_caught, report lines)."""
+    ok, lines = True, []
+    for name, build, expect_pass, expect_sev in FIXTURES:
+        ctx = build()
+        findings = run_passes(ctx)
+        hit = [f for f in findings
+               if f.pass_name == expect_pass
+               and f.severity == expect_sev]
+        if hit:
+            lines.append(f"caught  {name}: [{expect_pass}] "
+                         f"{hit[0].message[:70]}...")
+        else:
+            ok = False
+            got = [(f.pass_name, f.severity) for f in findings]
+            lines.append(f"MISSED  {name}: expected [{expect_pass}] at "
+                         f"{expect_sev}, got {got or 'nothing'}")
+    guard_ok, guard_line = _check_recompile_guard()
+    ok = ok and guard_ok
+    lines.append(("caught  " if guard_ok else "MISSED  ") + guard_line)
+    return ok, lines

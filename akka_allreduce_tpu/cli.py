@@ -20,6 +20,10 @@ the CLI surface maps as:
   (serving/) under a synthetic closed/open-loop load generator, with a
   ``--selfcheck`` parity smoke for CI.
 * ``bench`` — the device-plane goodput benchmark (bench.py).
+* ``lint`` — the static-analysis plane (analysis/): trace the stack's
+  jitted entry points to jaxprs on a virtual CPU mesh and machine-check
+  collective-axis / donation / dtype / host-sync invariants; exit-code
+  gated for CI, ``--selfcheck`` proves every pass still fires.
 * ``info`` — topology summary: the master's membership view, hardware
   edition.
 
@@ -564,6 +568,18 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "deadline pacer and the multi-host hybrid")
     p.add_argument("--log-every", type=int, default=10,
                    help="print a progress line every N steps")
+    p.add_argument("--guard-recompiles", action="store_true",
+                   help="fail the run (exit 1) if the warmed step "
+                        "function compiles again after step 1 — the "
+                        "compile-cache-stability contract as a runtime "
+                        "assertion (analysis/recompile.py; the lint "
+                        "plane's dtype pass catches the usual cause, a "
+                        "weak-type scalar at the jit boundary, "
+                        "statically). Per-step paths only (no "
+                        "--steps-per-dispatch chunking, whose tail "
+                        "legitimately compiles the per-step program; "
+                        "no --coordinator hybrid, whose catch-up/"
+                        "rejoin paths legitimately compile)")
     p.add_argument("--grad-accum", type=int, default=1, metavar="K",
                    help="gradient accumulation: scan K microbatches "
                         "accumulating LOCAL grads, sync once — "
@@ -1162,6 +1178,13 @@ def _cmd_train(args: argparse.Namespace) -> int:
     # plausible "never log" spelling) must not divide-by-zero — treat it
     # as log-every-step, the least surprising reading
     args.log_every = max(1, args.log_every)
+    if args.guard_recompiles and (bool(args.coordinator)
+                                  or args.steps_per_dispatch > 1):
+        print("error: --guard-recompiles needs the per-step loop "
+              "(--steps-per-dispatch 1, no --coordinator): the chunked "
+              "tail and the hybrid's catch-up/rejoin paths compile "
+              "programs after warmup by design", file=sys.stderr)
+        return 2
     if args.steps_per_dispatch > 1 and (args.deadline_ms > 0
                                         or jax.process_count() > 1):
         # deadline masking and the hybrid interact with the host every
@@ -1333,6 +1356,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
     steps_in_window = 0
     xprof = _XprofWindow(args.xprof_dir, start_step=start + 1,
                          n_steps=args.xprof_steps)
+    # --guard-recompiles: opened after the run's FIRST step (which owns
+    # the one legitimate compile), closed in the finally so the logging
+    # state is restored even on preemption; verdict read after the loop
+    guard = None
     try:
         if hybrid:
             # round-driven loop: a process that caught up after a stall
@@ -1626,6 +1653,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     params, opt_state, tokens)
             else:
                 params, opt_state, metrics = step(params, opt_state, tokens)
+            if args.guard_recompiles and guard is None:
+                from akka_allreduce_tpu.analysis.recompile import \
+                    CompileLog
+                guard = CompileLog()
+                guard.__enter__()
             if mgr is not None:
                 mgr.maybe_save(i, params, opt_state, {"data_step": i},
                                ema=ema_of(opt_state))
@@ -1661,6 +1693,8 @@ def _cmd_train(args: argparse.Namespace) -> int:
                          {"data_step": final}, force=True,
                          ema=ema_of(opt_state))
     finally:
+        if guard is not None:
+            guard.__exit__(None, None, None)
         # Preemption/SIGINT is this feature's target scenario: always let
         # an in-flight async save land (and any open device trace flush)
         # before the process dies. The trace flush must not be able to
@@ -1675,6 +1709,29 @@ def _cmd_train(args: argparse.Namespace) -> int:
         if mgr is not None:
             mgr.wait_until_finished()
             mgr.close()
+    if guard is not None:
+        # the contract is about the STEP program; auxiliary first-use
+        # programs (checkpoint helpers, metric readbacks) are reported
+        # but don't gate — they compile once, not per step. The hot name
+        # comes from the jitted wrapper itself (functools.wraps), so a
+        # rename in models/train.py cannot silently un-gate the guard
+        hot_name = getattr(step, "__name__", "step")
+        hot = [n for n in guard.compiled if n == hot_name]
+        if hot:
+            print(f"error: --guard-recompiles: the warmed step function "
+                  f"recompiled {len(hot)} time(s) after step 1 "
+                  f"(shape/dtype/static-arg drift — a weak-type scalar "
+                  f"at the jit boundary is the usual cause; `lint` "
+                  f"flags it statically)", file=sys.stderr)
+            return 1
+        if guard.compiled and chatty:
+            print(f"guard-recompiles: step stable; {len(guard.compiled)}"
+                  f" auxiliary first-use program(s) compiled post-"
+                  f"warmup: {', '.join(sorted(set(guard.compiled)))}",
+                  file=sys.stderr)
+        elif chatty:
+            print(f"guard-recompiles: clean ({args.steps - start - 1} "
+                  f"guarded step(s), 0 compiles)", file=sys.stderr)
     return 0
 
 
@@ -1844,11 +1901,30 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     tput = metrics.decode_tokens_per_s or 0.0
     if tput <= 0.0:
         failures.append(f"throughput not positive: {tput}")
+    # the no-recompile contract (analysis/recompile.py): a SECOND run
+    # over the same request shapes — fresh engine state, full slot
+    # churn — must compile nothing; the first run above was the warmup
+    from akka_allreduce_tpu.analysis.recompile import (RecompileError,
+                                                       no_recompiles)
+    engine2 = ServingEngine(params, cfg, EngineConfig(num_slots=3))
+    sched2 = RequestScheduler(SchedulerConfig(), num_slots=3)
+    for r in reqs:
+        sched2.submit(r)
+    try:
+        with no_recompiles("selfcheck churn (warmed shapes)"):
+            results2 = serve_loop(engine2, sched2, max_dispatches=200)
+    except RecompileError as exc:
+        failures.append(str(exc))
+        results2 = {}
+    for rid, out in results2.items():
+        if list(out[0]) != list(results[rid][0]):
+            failures.append(f"rid={rid}: churn run diverged")
     print(json.dumps({
         "selfcheck": "ok" if not failures else "FAIL",
         "requests": len(reqs),
         "decode_tokens_per_s": round(tput, 1),
         "decode_dispatches": engine.decode_dispatches,
+        "churn_recompiles": 0 if results2 else None,
         "failures": failures,
     }))
     return 0 if not failures else 1
@@ -1972,7 +2048,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 sched.submit(r)
             except QueueFull:
                 pass  # counted via on_reject
-        with metrics.host_sampler() as sampler:
+        from akka_allreduce_tpu.analysis.recompile import CompileLog
+        with metrics.host_sampler() as sampler, CompileLog() as compiles:
             results = serve_loop(engine, sched, metrics=metrics)
     report = {
         "config": {"slots": args.slots, "requests": args.requests,
@@ -1986,6 +2063,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             for reason in {r for _, r in results.values()}},
         "prefill_dispatches": engine.prefill_dispatches,
         "prefill_programs": len(engine.prefill_shapes),
+        # total programs XLA built during the run (analysis/recompile.py
+        # guard plane): steady-state serving should pin this at the
+        # warmup set — 1 step + prefill_programs (+ first-use helpers);
+        # a count growing with request traffic is the recompile smell
+        # prefill_buckets exists to kill
+        "compiled_programs": compiles.count,
         "kv_cache_mb": round(engine.kv_cache_bytes() / 1e6, 2),
         "host": sampler.summary(),
         **metrics.summary(),
@@ -1995,6 +2078,93 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     print(json.dumps(report))
     return 0
 
+
+
+def _add_lint(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "lint", help="static-analysis plane (analysis/): trace the "
+        "stack's jitted entry points to jaxprs on a virtual CPU mesh "
+        "and machine-check collective-axis / donation / dtype / "
+        "host-sync invariants — no device execution, no compiles")
+    p.add_argument("--all", action="store_true",
+                   help="lint every entry point in the catalog "
+                        "(analysis/entrypoints.py)")
+    p.add_argument("--target", default=None,
+                   help="comma list of catalog entry points to lint "
+                        "(see --list)")
+    p.add_argument("--list", action="store_true",
+                   help="print the entry-point catalog and exit")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings gate the exit code too (default: "
+                        "errors only)")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="run the deliberately-broken fixtures instead: "
+                        "every pass must catch its fixture (the "
+                        "linter's own tier-1; analysis/selfcheck.py)")
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    # the lint plane is CPU-only BY DESIGN (tier-1-safe: runs with no
+    # chip, in CI, mid-incident): force the virtual 8-device host
+    # platform before any backend initializes, same dance as
+    # tests/conftest.py — this box's site customization overrides
+    # JAX_PLATFORMS at interpreter start, so the config update is the
+    # authoritative half
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from akka_allreduce_tpu.analysis.entrypoints import (ENTRYPOINTS,
+                                                         build_entrypoints)
+    from akka_allreduce_tpu.analysis.report import (exit_code,
+                                                    render_json,
+                                                    render_text)
+
+    if args.list:
+        for name in ENTRYPOINTS:
+            print(name)
+        return 0
+    if args.selfcheck:
+        from akka_allreduce_tpu.analysis.selfcheck import run_selfcheck
+        ok, lines = run_selfcheck()
+        for line in lines:
+            print(line)
+        print("selfcheck: every pass caught its fixture" if ok
+              else "selfcheck: FAILED — a pass went blind (see MISSED "
+                   "lines)")
+        return 0 if ok else 1
+    if args.all == (args.target is not None):
+        print("error: pass exactly one of --all / --target (or "
+              "--selfcheck / --list)", file=sys.stderr)
+        return 2
+    targets = None if args.all else \
+        [t for t in args.target.split(",") if t]
+    if targets == []:
+        # `--target ""` (an empty shell variable) must not silently
+        # become --all: the caller asked for specific targets and named
+        # none
+        print("error: --target got no entry-point names (empty value); "
+              "use --all to lint the whole catalog", file=sys.stderr)
+        return 2
+    try:
+        from akka_allreduce_tpu.analysis.core import run_passes
+        contexts = build_entrypoints(targets)
+    except (ValueError, RuntimeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    findings = []
+    for ctx in contexts:
+        findings.extend(run_passes(ctx))
+    names = [c.name for c in contexts]
+    if args.format == "json":
+        print(json.dumps(render_json(names, findings), indent=1))
+    else:
+        print(render_text(names, findings))
+    return exit_code(findings, strict=args.strict)
 
 
 def _add_eval(sub: argparse._SubParsersAction) -> None:
@@ -2095,6 +2265,7 @@ def main(argv: list[str] | None = None) -> int:
     _add_generate(sub)
     _add_serve(sub)
     _add_eval(sub)
+    _add_lint(sub)
     p_info = sub.add_parser("info", help="topology summary; --scaling "
                             "prints the analytic ICI scaling curve")
     p_info.add_argument("--scaling", action="store_true",
@@ -2117,7 +2288,7 @@ def main(argv: list[str] | None = None) -> int:
     return {"emulate": _cmd_emulate, "master": _cmd_master,
             "worker": _cmd_worker, "train": _cmd_train,
             "generate": _cmd_generate, "serve": _cmd_serve,
-            "eval": _cmd_eval,
+            "eval": _cmd_eval, "lint": _cmd_lint,
             "info": _cmd_info, "bench": _cmd_bench}[args.cmd](args)
 
 

@@ -1058,7 +1058,12 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
     HBM residency — the lever that lets chip-filling configs fit). Only
     for callers that rebind both from the step's return and never touch
     the old arrays again (the training-loop pattern; cli.py train and the
-    MFU bench use it)."""
+    MFU bench use it). That the donations actually SURVIVE lowering
+    (jax.buffer_donor markers — a dtype-mismatched donor is dropped
+    with one easily-missed warning) is machine-checked by the
+    ``donation`` lint pass over the traced step (``lint --target
+    train_step``), and the step's compile-cache stability is asserted
+    by tests/test_train.py::TestCompileStability."""
     grad_step = make_grad_step(cfg, mesh, valid_buckets,
                                dynamic_valid=dynamic_valid)
     donate_args = (0, 1) if donate else ()

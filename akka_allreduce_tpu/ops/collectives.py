@@ -110,6 +110,13 @@ def pipelined_two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp",
     ``num_windows`` must divide the bucket count — callers that cannot
     guarantee that pad the bucket axis with zero rows and slice them
     back off (parallel/dp.py does; zero rows sum harmlessly).
+
+    The schedule's structural invariant — every window's reduce-scatter
+    has its all-gather over the same axis — is machine-checked on the
+    traced jaxpr by the ``collective-axis`` lint pass
+    (analysis/passes.py; ``lint --target collective_windowed``), so a
+    refactor that drops one phase on one branch fails CI before it can
+    leave some ranks holding partial sums.
     """
     if x.ndim != 2:
         raise ValueError(

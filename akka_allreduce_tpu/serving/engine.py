@@ -44,6 +44,14 @@ The host loop costs one dispatch + one (slots,) readback per token —
 the continuous-batching shape; amortizing dispatches by scanning
 multiple steps between admission checks is a latency/occupancy trade
 the bench can explore later.
+
+The no-recompile contract is ASSERTED, not just designed for: slot
+churn/refill runs under the zero-compile guard
+(tests/test_serving_engine.py::TestNoRecompileContract, `serve
+--selfcheck`'s churn phase — analysis/recompile.py), and the state
+donation that keeps cache updates in place is machine-checked on the
+lowered step by the ``donation`` lint pass (``lint --target
+engine_step``).
 """
 
 from __future__ import annotations

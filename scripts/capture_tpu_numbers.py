@@ -50,7 +50,17 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "ab_overlap"], check=False)
 """),
-    # 1. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
+    # 1. the serving-plane A/B (ROADMAP open item): engine vs
+    # sequential decode, banked on CPU only so far (perf_capture/
+    # serving.json: 1.46x/1.93x at 2/4 slots) — the on-chip row rides
+    # the same healthy window as ab_overlap, sized up by bench_suite's
+    # on-TPU defaults
+    ("serving_throughput", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "serving_throughput"], check=False)
+"""),
+    # 2. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time
     ("scan_mfu_bf16", "mfu", 1500, """
@@ -60,14 +70,14 @@ r = measure_train_mfu(compute_dtype="bf16")
 print(json.dumps({"metric": "mfu_train_bf16", "scan_steps": True, **r}),
       flush=True)
 """),
-    # 2. the reworked windowed-SP A/B (round-4 verdict weak #4: zero
+    # 3. the reworked windowed-SP A/B (round-4 verdict weak #4: zero
     # on-chip rows; the old 29.7 TFLOP/s quote is from a flawed harness)
     ("windowed_sp", "suite", 900, """
 import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "ab_windowed_sp"], check=False)
 """),
-    # 3. headline goodput as median-of-5 two-point deltas with spread
+    # 4. headline goodput as median-of-5 two-point deltas with spread
     # (round-4 verdict weak #3: three single-shot captures spread
     # 305-341 GB/s with no methodology)
     ("headline_median", "headline", 700, """
@@ -77,7 +87,7 @@ env = {**os.environ, "AATPU_BENCH_PLATFORM": "default",
 subprocess.run([sys.executable, "-m", "akka_allreduce_tpu.bench"],
                env=env, check=False)
 """),
-    # 4. f32 MFU companion row
+    # 5. f32 MFU companion row
     ("scan_mfu_f32", "mfu", 1200, """
 import json
 from akka_allreduce_tpu.bench import measure_train_mfu
@@ -85,23 +95,24 @@ r = measure_train_mfu(compute_dtype="f32")
 print(json.dumps({"metric": "mfu_train_f32", "scan_steps": True, **r}),
       flush=True)
 """),
-    # 5. decode bench
+    # 6. decode bench
     ("decode", "decode", 600, """
 import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_decode.py"],
                check=False)
 """),
-    # 6. the rest of the suite (MFU, windowed-SP, and overlap skipped —
-    # steps 1/4, 2, and 0 own those rows; a re-run here would bank
-    # duplicates, and ab_overlap needs its own fresh process anyway)
+    # 7. the rest of the suite (MFU, windowed-SP, overlap, and serving
+    # skipped — steps 2/5, 3, 0, and 1 own those rows; a re-run here
+    # would bank duplicates, and ab_overlap needs its own fresh process
+    # anyway)
     ("suite", "suite", 1800, """
 import os, subprocess, sys
 env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1",
-       "AATPU_SUITE_SKIP": "ab_windowed_sp,ab_overlap"}
+       "AATPU_SUITE_SKIP": "ab_windowed_sp,ab_overlap,serving_throughput"}
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env,
                check=False)
 """),
-    # 7. speculative-decoding mechanics (new in round 5; last — never
+    # 8. speculative-decoding mechanics (round 5; last — never
     # ahead of the open claims)
     ("speculative", "decode", 900, """
 import subprocess, sys

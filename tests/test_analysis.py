@@ -1,0 +1,205 @@
+"""Static-analysis plane tests (analysis/): every pass fires on its
+broken fixture AND stays quiet on clean code.
+
+Two-sided by design (ISSUE 3 acceptance): a lint pass that never fires
+is dead weight, and one that fires on clean code trains people to
+ignore it. The negative side runs the deliberately-broken selfcheck
+fixtures (analysis/selfcheck.py — also `lint --selfcheck` in CI); the
+positive side lints real catalog entry points and asserts zero
+errors/warnings — the "lint-clean assertion" that turns the repo's
+current hygiene (donations declared and surviving lowering, collectives
+on the right axes, no scalars at jit boundaries) into a regression
+gate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.analysis.core import (
+    LintPolicy,
+    iter_eqns,
+    run_passes,
+    trace_entry,
+)
+from akka_allreduce_tpu.analysis.recompile import (
+    CompileLog,
+    RecompileError,
+    assert_max_compiles,
+    no_recompiles,
+)
+from akka_allreduce_tpu.analysis.report import (
+    exit_code,
+    render_json,
+    render_text,
+)
+from akka_allreduce_tpu.analysis.selfcheck import FIXTURES
+
+
+class TestPassesFireOnBrokenFixtures:
+    """Negative side: each catalog pass catches its bug class."""
+
+    @pytest.mark.parametrize(
+        "name,build,expect_pass,expect_sev",
+        FIXTURES, ids=[f[0] for f in FIXTURES])
+    def test_fixture_caught(self, name, build, expect_pass, expect_sev):
+        findings = run_passes(build())
+        hits = [f for f in findings if f.pass_name == expect_pass
+                and f.severity == expect_sev]
+        assert hits, (
+            f"{name}: expected [{expect_pass}] at {expect_sev}, got "
+            f"{[(f.pass_name, f.severity) for f in findings]}")
+
+
+class TestCleanEntrypointsStayClean:
+    """Positive side: the repo's own entry points lint clean. These are
+    the pins for ISSUE 3's fix-and-pin satellite — a regression that
+    drops a donation, moves a collective to the wrong axis, or leaks a
+    scalar to a jit boundary fails HERE, not on a chip."""
+
+    @pytest.mark.parametrize("target", [
+        "generate", "engine_step", "engine_prefill",
+        "collective_fused", "collective_windowed",
+        "collective_int8", "collective_bf16",
+    ])
+    def test_fast_entrypoints_lint_clean(self, target):
+        from akka_allreduce_tpu.analysis.entrypoints import ENTRYPOINTS
+        findings = run_passes(ENTRYPOINTS[target]())
+        gating = [f for f in findings if f.severity in ("error",
+                                                        "warning")]
+        assert not gating, [f"[{f.pass_name}] {f.message}"
+                            for f in gating]
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("target", [
+        "train_step", "train_step_windowed", "train_step_int8",
+        "train_step_bf16",
+    ])
+    def test_train_entrypoints_lint_clean(self, target):
+        from akka_allreduce_tpu.analysis.entrypoints import ENTRYPOINTS
+        findings = run_passes(ENTRYPOINTS[target]())
+        gating = [f for f in findings if f.severity in ("error",
+                                                        "warning")]
+        assert not gating, [f"[{f.pass_name}] {f.message}"
+                            for f in gating]
+
+    def test_train_step_donates_and_pairs(self):
+        """The flagship claims, asserted structurally (not just "no
+        findings"): the windowed train step's donations survive
+        lowering (buffer-donor/aliasing markers >= declared) and its
+        reduce-scatter/all-gather windows pair up."""
+        from akka_allreduce_tpu.analysis.entrypoints import (
+            build_train_step_windowed)
+        ctx = build_train_step_windowed()
+        declared = sum(ctx.donated)
+        assert declared > 0
+        markers = (ctx.stablehlo.count("jax.buffer_donor")
+                   + ctx.stablehlo.count("tf.aliasing_output"))
+        assert markers >= declared, (declared, markers)
+        rs = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
+                 if eqn.primitive.name == "reduce_scatter")
+        ag = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
+                 if eqn.primitive.name == "all_gather")
+        assert rs == ag and rs >= 2, (rs, ag)  # >= num_windows
+
+
+class TestReport:
+    def test_render_and_gate(self):
+        from akka_allreduce_tpu.analysis.core import Finding
+        fs = [Finding("dtype", "warning", "e1", "w"),
+              Finding("donation", "error", "e2", "boom", "argX")]
+        txt = render_text(["e1", "e2", "e3"], fs)
+        assert "ERROR" in txt and "@ argX" in txt and "clean: e3" in txt
+        doc = render_json(["e1", "e2"], fs)
+        assert doc["summary"] == {"errors": 1, "warnings": 1, "info": 0}
+        # errors gate; warnings only under strict
+        assert exit_code(fs) == 1
+        assert exit_code([fs[0]]) == 0
+        assert exit_code([fs[0]], strict=True) == 1
+        assert exit_code([]) == 0
+
+
+class TestRecompileGuard:
+    """The runtime half: compile counting + the post-warmup contract."""
+
+    def test_counts_and_names_compiles(self):
+        @jax.jit
+        def unique_fn_for_count(x):
+            return x * 3 + 1
+
+        with CompileLog() as log:
+            unique_fn_for_count(jnp.zeros((7,)))
+            unique_fn_for_count(jnp.zeros((7,)))  # cache hit
+            unique_fn_for_count(jnp.zeros((9,)))  # new shape
+        assert log.compiled.count("unique_fn_for_count") == 2, \
+            log.compiled
+
+    def test_guard_quiet_on_warmed_shape(self):
+        @jax.jit
+        def warmed(x):
+            return x + 2
+
+        warmed(jnp.zeros((3,)))
+        with no_recompiles("warmed fn"):
+            warmed(jnp.zeros((3,)))
+
+    def test_guard_raises_on_shape_drift(self):
+        @jax.jit
+        def drifting(x):
+            return x - 1
+
+        drifting(jnp.zeros((3,)))
+        with pytest.raises(RecompileError, match="drifting"):
+            with no_recompiles("drifting fn"):
+                drifting(jnp.zeros((4,)))
+
+    def test_bounded_warmup_budget(self):
+        @jax.jit
+        def budgeted(x):
+            return x * 5
+
+        # arrays built OUTSIDE the window: eager zeros are themselves
+        # tiny compiles, and the guard counts every program
+        xs = [jnp.zeros((n,)) for n in (2, 3, 4, 5)]
+        with assert_max_compiles(2, what="two shapes") as log:
+            budgeted(xs[0])
+            budgeted(xs[1])
+        assert log.count == 2
+        with pytest.raises(RecompileError):
+            with assert_max_compiles(1, what="three shapes"):
+                budgeted(xs[2])
+                budgeted(xs[3])
+
+    def test_guard_restores_log_compiles_flag(self):
+        before = jax.config.jax_log_compiles
+        with CompileLog():
+            pass
+        assert jax.config.jax_log_compiles == before
+
+
+class TestWeakTypeDetection:
+    """The compile-cache splitter the dtype pass warns about is real:
+    demonstrate a weak scalar costs a second compile, pinning the
+    pass's story to actual dispatch behavior."""
+
+    def test_weak_then_strong_recompiles(self):
+        @jax.jit
+        def scale(x, s):
+            return x * s
+
+        x = jnp.zeros((4,), jnp.float32)
+        with CompileLog() as log:
+            scale(x, 0.5)                             # weak f32 scalar
+            scale(x, jnp.asarray(0.5, jnp.float32))   # strong: new entry
+        assert log.compiled.count("scale") == 2, log.compiled
+
+    def test_trace_entry_flags_it(self):
+        def entry(x, s):
+            return x * s
+
+        ctx = trace_entry("weak_demo", entry,
+                          (jnp.zeros((4,), jnp.float32), 0.5),
+                          LintPolicy(), lower=False)
+        findings = run_passes(ctx, only=["dtype"])
+        assert any("weak-typed" in f.message for f in findings)

@@ -266,3 +266,79 @@ class TestEngineBookkeeping:
         results = serve_loop(engine, sched, max_dispatches=2000)
         assert len(results) == 3  # the odd tail request still finished
         assert_parity(results, params, DENSE, reqs)
+
+
+class TestNoRecompileContract:
+    """ISSUE 3 satellite: the engine's "slot churn and refill never
+    recompile" claim, asserted with the compile-counting guard
+    (analysis/recompile.py) instead of inferred from dispatch counts.
+
+    Uses a config with shapes unique to this test so the module-level
+    ``_engine_step``/``_engine_prefill`` jit caches are cold regardless
+    of which tests ran earlier in the process."""
+
+    # d_model/vocab chosen to collide with no other config in the suite
+    COLD = TransformerConfig(vocab_size=89, d_model=48, n_heads=4,
+                             n_layers=2, d_ff=96, max_seq=32)
+
+    def _run(self, params, n_requests):
+        reqs = make_requests(self.COLD, n_requests, steps=5, seed=7)
+        return run_engine(params, self.COLD, reqs, slots=2)
+
+    def test_warmup_compiles_exactly_then_churn_compiles_nothing(self):
+        from akka_allreduce_tpu.analysis.recompile import (CompileLog,
+                                                           no_recompiles)
+        params = init_transformer(jax.random.key(5), self.COLD)
+        with CompileLog() as warm:
+            results, engine = self._run(params, 4)
+        assert len(results) == 4
+        # exactly one decode program and one prefill program per
+        # distinct prompt length (make_requests uses plens=(3, 5)) —
+        # the compiled-program budget the engine's docstring promises
+        engine_programs = [n for n in warm.compiled if "engine" in n]
+        assert sorted(engine_programs) == [
+            "_engine_prefill", "_engine_prefill", "_engine_step"], \
+            warm.compiled
+        assert engine.prefill_shapes == {(3, False), (5, False)}
+        # churn + refill at warmed shapes: a FRESH engine (new slot
+        # state, same shapes) over more requests than slots — zero new
+        # programs, by contract
+        with no_recompiles("engine churn/refill"):
+            results, engine = self._run(params, 8)
+        assert len(results) == 8
+        assert engine.prefill_dispatches == 8  # churn actually happened
+
+    def test_bucketed_prefill_bounds_programs_under_guard(self):
+        """prefill_buckets: requests at 4 distinct lengths but ONE
+        bucket — warmup compiles one prefill program, then every other
+        length rides it (zero compiles), the program-count bound the
+        knob exists to buy."""
+        from akka_allreduce_tpu.analysis.recompile import (CompileLog,
+                                                           no_recompiles)
+        # its OWN unique config: sharing COLD would warm the module-
+        # level _engine_step cache for the other test and make the
+        # pair order-dependent
+        cfg = TransformerConfig(vocab_size=83, d_model=48, n_heads=4,
+                                n_layers=2, d_ff=96, max_seq=32)
+        params = init_transformer(jax.random.key(6), cfg)
+        engine = ServingEngine(params, cfg,
+                               EngineConfig(num_slots=2,
+                                            prefill_buckets=(8,)))
+        sched = RequestScheduler(SchedulerConfig(max_queue_depth=16),
+                                 num_slots=2)
+        reqs = make_requests(cfg, 2, steps=4, seed=9, plens=(4,))
+        for r in reqs:
+            sched.submit(r)
+        with CompileLog() as warm:
+            serve_loop(engine, sched, max_dispatches=500)
+        assert warm.compiled.count("_engine_prefill") == 1, warm.compiled
+        sched2 = RequestScheduler(SchedulerConfig(max_queue_depth=16),
+                                  num_slots=2)
+        more = make_requests(cfg, 6, steps=4, seed=10,
+                             plens=(2, 3, 5, 6))
+        for r in more:
+            sched2.submit(r)
+        with no_recompiles("bucketed prefill at new lengths"):
+            results = serve_loop(engine, sched2, max_dispatches=500)
+        assert len(results) == 6
+        assert engine.prefill_shapes == {(8, True)}

@@ -130,3 +130,60 @@ class TestTraining:
         flat = jax.tree.leaves(grads)[0]  # 'embed' (sorted first... dict)
         # embed is under key 'embed': leaves sorted -> embed first
         assert float(jnp.abs(flat[:4]).max()) == 0.0
+
+
+class TestCompileStability:
+    """ISSUE 3 satellite: the train step's compile-cache stability,
+    asserted with the compile-counting guard (analysis/recompile.py).
+    One program per shape is the contract that makes --compile-cache
+    warm restarts and long runs possible; a step that silently
+    recompiles per step would still pass the loss tests."""
+
+    def test_multi_step_run_compiles_once(self):
+        """30-step runs already exist above (loss test); here the same
+        loop shape is pinned to EXACTLY one compile: the first step
+        builds `step`, every later step is a cache hit."""
+        from akka_allreduce_tpu.analysis.recompile import (
+            CompileLog, no_recompiles)
+        spec = MeshSpec(dp=8)
+        mesh = make_device_mesh(spec)
+        cfg = TrainConfig(model=MCFG, bucket_elems=256)
+        params, opt_state, opt = make_train_state(jax.random.key(4),
+                                                  cfg, mesh)
+        step = make_train_step(cfg, mesh, opt)
+        tokens = make_tokens(8, 32, seed=5)
+        with CompileLog() as warm:
+            params, opt_state, _ = step(params, opt_state, tokens)
+        # exactly one step program (first-use dispatch helpers like
+        # _multi_slice may ride along in the warmup window)
+        assert warm.compiled.count("step") == 1, warm.compiled
+        with no_recompiles("warmed train step x4"):
+            for _ in range(4):
+                params, opt_state, metrics = step(params, opt_state,
+                                                  tokens)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_chunked_multi_step_compiles_once_per_chunk_length(self):
+        """make_multi_step (the --steps-per-dispatch path): one compile
+        serves every chunk of the same length — dispatch 2 runs under
+        the zero-compile guard."""
+        from akka_allreduce_tpu.analysis.recompile import (
+            CompileLog, no_recompiles)
+        from akka_allreduce_tpu.models.train import make_multi_step
+        spec = MeshSpec(dp=8)
+        mesh = make_device_mesh(spec)
+        cfg = TrainConfig(model=MCFG, bucket_elems=256)
+        params, opt_state, opt = make_train_state(jax.random.key(5),
+                                                  cfg, mesh)
+        run_chunk = make_multi_step(cfg, mesh, opt)
+        stacked = jnp.stack([make_tokens(8, 32, seed=s)
+                             for s in (0, 1)])
+        with CompileLog() as warm:
+            params, opt_state, _ = run_chunk(params, opt_state, stacked)
+        assert warm.compiled.count("run_chunk") == 1, warm.compiled
+        stacked2 = jnp.stack([make_tokens(8, 32, seed=s)
+                              for s in (2, 3)])
+        with no_recompiles("warmed chunked dispatch"):
+            params, opt_state, metrics = run_chunk(params, opt_state,
+                                                   stacked2)
+        assert metrics["loss"].shape == (2,)
