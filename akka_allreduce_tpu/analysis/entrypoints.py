@@ -17,9 +17,19 @@ train_step          make_train_step, fused f32 wire, donate=True,
 train_step_windowed windowed schedule — adds the rs/ag pairing check
 train_step_int8     int8 wire — adds the wire-dtype discipline
 train_step_bf16     bf16 compute — upcast census (info)
+train_step_pp       pipelined step (pp=2 mesh, parallel/pp.py
+                    gpipe_apply: ppermute-in-scan) — axis existence +
+                    donation on the pipeline path
+train_step_moe      MoE step (ep=2 mesh, parallel/ep.py moe_ffn:
+                    all_to_all dispatch) — axis existence + donation
+                    on the expert path
 generate            models/generate.py greedy decode (prefill + scan)
 engine_step         serving/engine.py _engine_step — state donation is
                     the engine's HBM contract
+engine_multi_step   serving/engine.py _engine_multi_step (S=4 block:
+                    multi_step_decode scan with on-device done-mask
+                    latching) — donation + host-sync on the fused
+                    decode loop; one program per distinct S
 engine_prefill      serving/engine.py _engine_prefill — ditto
 collective_fused    two_phase_allreduce under shard_map — reduction-
                     axis discipline + pairing
@@ -35,6 +45,7 @@ collective_bf16     bf16-wire lossy allreduce_gradients — wire dtype +
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Optional
 
@@ -71,13 +82,14 @@ def _model_cfg():
         n_layers=_LAYERS, d_ff=_DFF, max_seq=_SEQ)
 
 
-def _mesh(dp: int, tp: int = 1):
+def _mesh(dp: int, tp: int = 1, ep: int = 1, pp: int = 1):
     import jax
     from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
                                                   make_device_mesh)
-    _require_devices(dp * tp)
-    return make_device_mesh(MeshSpec(dp=dp, tp=tp),
-                            devices=jax.devices()[:dp * tp])
+    n = dp * tp * ep * pp
+    _require_devices(n)
+    return make_device_mesh(MeshSpec(dp=dp, tp=tp, ep=ep, pp=pp),
+                            devices=jax.devices()[:n])
 
 
 def _mesh_axes(mesh) -> frozenset:
@@ -92,13 +104,16 @@ def _tokens(batch: int, seq: int = _SEQ):
 # -- train steps --------------------------------------------------------
 
 def _train_entry(name: str, dp: int, tp: int, policy_kw: dict,
-                 **cfg_kw) -> LintContext:
+                 ep: int = 1, pp: int = 1, model_kw: "dict | None" = None,
+                 batch: "int | None" = None, **cfg_kw) -> LintContext:
     import jax
     from akka_allreduce_tpu.models.train import (TrainConfig,
                                                  make_train_state,
                                                  make_train_step)
-    mesh = _mesh(dp, tp)
-    cfg = TrainConfig(model=_model_cfg(), bucket_elems=_BUCKET_ELEMS,
+    mesh = _mesh(dp, tp, ep=ep, pp=pp)
+    model = _model_cfg() if not model_kw else dataclasses.replace(
+        _model_cfg(), **model_kw)
+    cfg = TrainConfig(model=model, bucket_elems=_BUCKET_ELEMS,
                       **cfg_kw)
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg,
                                               mesh)
@@ -106,7 +121,9 @@ def _train_entry(name: str, dp: int, tp: int, policy_kw: dict,
     policy = LintPolicy(known_axes=_mesh_axes(mesh),
                         expect_donation=True, hot=True,
                         compute_dtype=cfg.compute_dtype, **policy_kw)
-    return trace_entry(name, step, (params, opt_state, _tokens(2 * dp)),
+    return trace_entry(name, step,
+                       (params, opt_state,
+                        _tokens(batch if batch is not None else 2 * dp)),
                        policy, donate_argnums=(0, 1))
 
 
@@ -130,6 +147,29 @@ def build_train_step_int8() -> LintContext:
 def build_train_step_bf16() -> LintContext:
     return _train_entry("train_step_bf16", dp=2, tp=1, policy_kw={},
                         compute_dtype="bf16")
+
+
+def build_train_step_pp() -> LintContext:
+    """The pipeline path: pp=2 mesh, stacked layers, gpipe microbatch
+    scan (parallel/pp.py gpipe_apply — ppermute-per-tick inside
+    lax.scan). The collective-axis pass sees the pp ppermutes and the
+    pp-side metric/grad psums; donation covers the stacked state."""
+    return _train_entry("train_step_pp", dp=1, tp=1, pp=2,
+                        policy_kw={}, batch=2, microbatches=2,
+                        grad_axes=("dp",))
+
+
+def build_train_step_moe() -> LintContext:
+    """The expert path: ep=2 mesh, every layer a routed MoE FF
+    (parallel/ep.py moe_ffn — all_to_all dispatch each way over ep).
+    The collective-axis pass sees the ep all_to_alls; exact capacity
+    bookkeeping stays f32 by design (counters, not wire payloads)."""
+    from akka_allreduce_tpu.parallel.ep import MoEConfig
+    return _train_entry(
+        "train_step_moe", dp=1, tp=1, ep=2, policy_kw={}, batch=2,
+        model_kw={"moe": MoEConfig(n_experts=4, d_ff=_DFF,
+                                   capacity_factor=2.0)},
+        grad_axes=("dp",))
 
 
 # -- decode / serving ---------------------------------------------------
@@ -173,6 +213,27 @@ def build_engine_step() -> LintContext:
     return trace_entry("engine_step", _engine_step,
                        (params, state, pos, cfg), policy,
                        donate_argnums=(1,), static_argnums=(3,))
+
+
+def build_engine_multi_step() -> LintContext:
+    """The fused block-decode program (EngineConfig.decode_steps > 1):
+    multi_step_decode's scan over the slot step with per-slot finish
+    vectors. Donation is the same HBM contract as engine_step; the
+    host-sync pass walking the scan body is the point — a callback
+    smuggled into the fused loop would serialize S tokens, not one."""
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.serving.engine import _engine_multi_step
+    cfg, params, state, slots = _engine_pieces()
+    pos = jnp.zeros((slots,), jnp.int32)
+    done = jnp.zeros((slots,), bool)
+    remaining = jnp.full((slots,), 8, jnp.int32)
+    eos_ids = jnp.full((slots,), -1, jnp.int32)
+    stop_ids = jnp.full((slots, 4), -1, jnp.int32)
+    policy = LintPolicy(expect_donation=True, hot=True)
+    return trace_entry(
+        "engine_multi_step", _engine_multi_step,
+        (params, state, pos, done, remaining, eos_ids, stop_ids, cfg, 4),
+        policy, donate_argnums=(1,), static_argnums=(7, 8))
 
 
 def build_engine_prefill() -> LintContext:
@@ -281,8 +342,11 @@ ENTRYPOINTS = {
     "train_step_windowed": build_train_step_windowed,
     "train_step_int8": build_train_step_int8,
     "train_step_bf16": build_train_step_bf16,
+    "train_step_pp": build_train_step_pp,
+    "train_step_moe": build_train_step_moe,
     "generate": build_generate,
     "engine_step": build_engine_step,
+    "engine_multi_step": build_engine_multi_step,
     "engine_prefill": build_engine_prefill,
     "collective_fused": build_collective_fused,
     "collective_windowed": build_collective_windowed,

@@ -372,7 +372,8 @@ def measure_train_mfu(compute_dtype: str = "bf16",
                       d_ff: int = 8192, vocab: int = 32768,
                       batch: Optional[int] = None, seq: int = 2048,
                       steps_hi: int = 12, steps_lo: int = 4,
-                      scan_steps: bool = True) -> dict:
+                      scan_steps: bool = True,
+                      guard_recompiles: bool = False) -> dict:
     """Single-chip train-step MFU on the flagship transformer.
 
     Useful FLOPs (models/flops.py: fwd matmuls + causal-half attention,
@@ -387,6 +388,14 @@ def measure_train_mfu(compute_dtype: str = "bf16",
     round-3 profiling measured it ~85 ms/step slower at identical device
     work, i.e. it reports tunnel latency as if the chip were idle. Real
     deployments run many steps per dispatch exactly like the scan.
+
+    ``guard_recompiles=True`` wraps every TIMED run in the zero-compile
+    guard (analysis/recompile.py, `train --guard-recompiles`' contract):
+    a warmed step that recompiles mid-measurement would bank compile
+    time as if the chip were doing useful FLOPs — the guard raises
+    RecompileError instead of letting that number land. Each scan length
+    is warmed (compiled) before its guarded timing; the capture scripts'
+    MFU steps run with this on, so a bogus row can never be banked.
     """
     from akka_allreduce_tpu.models.flops import (chip_peak_flops,
                                                  transformer_step_flops)
@@ -469,6 +478,12 @@ def measure_train_mfu(compute_dtype: str = "bf16",
             state[0], state[1] = p, o
             return time.perf_counter() - t0
 
+    from akka_allreduce_tpu.analysis.recompile import maybe_no_recompiles
+
+    def timed_guard(what):
+        return maybe_no_recompiles(guard_recompiles,
+                                   f"mfu timed run ({what})")
+
     _log("mfu: compiling + warmup ...")
     if scan_steps:
         # each scan length is its own compiled program: warm BOTH before
@@ -477,8 +492,10 @@ def measure_train_mfu(compute_dtype: str = "bf16",
         run(steps_hi)
     else:
         run(2)  # warmup/compile
-    t_lo = run(steps_lo)
-    t_hi = run(steps_hi)
+    with timed_guard(f"{steps_lo} steps"):
+        t_lo = run(steps_lo)
+    with timed_guard(f"{steps_hi} steps"):
+        t_hi = run(steps_hi)
     per_step = (t_hi - t_lo) / (steps_hi - steps_lo)
     if per_step <= 0:
         # noise swamped the delta (tiny configs / loaded host): widen the
@@ -486,8 +503,9 @@ def measure_train_mfu(compute_dtype: str = "bf16",
         wide = 4 * steps_hi
         _log(f"non-positive per-step delta; retrying with {wide} steps")
         if scan_steps:
-            run(wide)
-        t_hi = run(wide)
+            run(wide)  # warm the new scan length OUTSIDE the guard
+        with timed_guard(f"{wide} steps"):
+            t_hi = run(wide)
         per_step = (t_hi - t_lo) / (wide - steps_lo)
     if per_step <= 0:
         raise RuntimeError(
@@ -508,6 +526,9 @@ def measure_train_mfu(compute_dtype: str = "bf16",
         "tokens_per_s": batch * seq / per_step,
         "device_kind": devices[0].device_kind,
         "compute_dtype": compute_dtype,
+        # True = every timed run held under the zero-compile guard, so
+        # the banked number cannot contain compile stalls
+        "guarded_recompiles": guard_recompiles,
     }
 
 
@@ -601,6 +622,108 @@ def measure_serving_throughput(d_model: int = 512, n_layers: int = 4,
                      "unit": "x",
                      "note": f"engine@{slots} slots vs sequential "
                              f"generate() ({plat})"})
+    return rows
+
+
+def measure_multi_step_decode(d_model: int = 512, n_layers: int = 4,
+                              d_ff: int = 2048, vocab: int = 2048,
+                              n_requests: int = 8, prompt_len: int = 16,
+                              steps: int = 32, slots: int = 4,
+                              step_counts: "tuple[int, ...]" = (1, 2, 4, 8),
+                              reps: int = 3, seed: int = 0) -> list:
+    """Fused block decode (EngineConfig.decode_steps=S) vs the S=1
+    engine at a fixed slot count — the measurement behind `serve
+    --decode-steps`.
+
+    Same engine, same requests, same greedy tokens (bitwise — the
+    parity suite's guarantee); the only variable is how many decode
+    steps one dispatch fuses, i.e. how often the host loop pays a
+    dispatch + readback. Budgets are RAGGED (cycled offsets around
+    ``steps``) so lanes finish mid-block and the wasted-token cost of
+    each S is part of its honest tokens/s — tokens/s counts CONSUMED
+    tokens only, so tail waste shows up as lost throughput exactly as
+    it would in production, and the per-S wasted rate rides in the
+    note. Timed runs follow one warm run per program shape (compile
+    excluded); best-of-``reps``. Rows: ``multi_step_decode_s{S}_tok_s``
+    per S, ``multi_step_decode_speedup_s{S}`` vs S=1, and a best-S
+    summary row."""
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.serving import (EngineConfig, Request,
+                                            RequestScheduler,
+                                            SchedulerConfig,
+                                            ServingEngine, serve_loop)
+
+    plat = jax.devices()[0].platform
+    offsets = (-6, 0, 6, -3)
+    budgets = [max(1, steps + offsets[i % len(offsets)])
+               for i in range(n_requests)]
+    mcfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model,
+        n_heads=max(1, d_model // 64), n_layers=n_layers, d_ff=d_ff,
+        max_seq=prompt_len + max(budgets))
+    params = init_transformer(jax.random.key(seed), mcfg)
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, vocab, size=(n_requests, prompt_len),
+                           dtype=np.int32)
+    total_tokens = sum(budgets)
+
+    def build(s_steps):
+        engine = ServingEngine(
+            params, mcfg,
+            EngineConfig(num_slots=slots, decode_steps=s_steps))
+        sched = RequestScheduler(SchedulerConfig(), num_slots=slots)
+        for rid, p in enumerate(prompts):
+            sched.submit(Request(rid=rid,
+                                 prompt=tuple(int(x) for x in p),
+                                 max_new_tokens=budgets[rid],
+                                 submitted_at=0.0))
+        return engine, sched
+
+    def run(pair):
+        serve_loop(*pair,
+                   max_dispatches=total_tokens + n_requests + 16)
+
+    rows = []
+    base_tok_s = None
+    results = {}
+    for s_steps in step_counts:
+        _log(f"multi_step_decode: S={s_steps} at {slots} slots")
+        warm_engine, warm_sched = build(s_steps)
+        run((warm_engine, warm_sched))  # compile + warm the S program
+        t_best = float("inf")
+        engine = warm_engine
+        for _ in range(reps):
+            engine, sched = build(s_steps)
+            t_best = min(t_best, _timed(lambda: run((engine, sched))))
+        tok_s = total_tokens / t_best
+        waste_rate = engine.wasted_tokens / (total_tokens
+                                             + engine.wasted_tokens)
+        results[s_steps] = tok_s
+        if s_steps == 1:
+            base_tok_s = tok_s
+        rows.append({
+            "metric": f"multi_step_decode_s{s_steps}_tok_s_{plat}",
+            "value": round(tok_s, 1), "unit": "tok/s",
+            "note": f"{slots} slots, {n_requests} ragged requests "
+                    f"(~{steps} tokens each), {engine.decode_dispatches}"
+                    f" dispatches, wasted-token rate "
+                    f"{waste_rate:.3f}"})
+        if s_steps != 1 and base_tok_s:
+            rows.append({
+                "metric": f"multi_step_decode_speedup_s{s_steps}",
+                "value": round(tok_s / base_tok_s, 3), "unit": "x",
+                "note": f"decode_steps={s_steps} vs 1 at {slots} slots "
+                        f"({plat}); consumed tokens only — waste "
+                        f"already charged"})
+    if base_tok_s and len(results) > 1:
+        best_s = max(results, key=results.get)
+        rows.append({
+            "metric": "multi_step_decode_best",
+            "value": round(results[best_s] / base_tok_s, 3), "unit": "x",
+            "note": f"best S={best_s}: {results[best_s]:.1f} tok/s vs "
+                    f"S=1 {base_tok_s:.1f} tok/s at {slots} slots "
+                    f"({plat})"})
     return rows
 
 

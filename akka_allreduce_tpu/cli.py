@@ -1792,6 +1792,18 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="per-slot KV cache format: model dtype, or "
                         "int8 (4x less cache HBM per slot at a bounded "
                         "logit error; models/generate.py quantize_kv)")
+    p.add_argument("--decode-steps", type=int, default=1, metavar="S",
+                   help="decode steps fused per dispatch: 1 = one "
+                        "token per host round-trip (the parity "
+                        "baseline); S > 1 scans S steps in one "
+                        "compiled program and reads back an (S, slots) "
+                        "token block — amortizes the per-token "
+                        "dispatch+readback at the cost of wasted tail "
+                        "tokens (lanes finishing mid-block) and block-"
+                        "granular admission/TTFT. Greedy tokens are "
+                        "bitwise identical across S. One program per "
+                        "distinct S; tune against the summary's "
+                        "wasted_token_rate")
     p.add_argument("--prefill-buckets", default="",
                    help="comma list of prompt-length buckets (prompts "
                         "pad up to the next bucket, bounding compiled-"
@@ -1848,7 +1860,9 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     """The tier-1 CI smoke: engine-vs-generate parity on a tiny model
     under slot churn, plus liveness of the metrics plane. Deliberately
     ignores the model-shape flags — the check must stay cheap and
-    deterministic no matter how the command is invoked."""
+    deterministic no matter how the command is invoked. ``--decode-steps
+    S`` runs the fused block engine and ALSO cross-checks it against the
+    S=1 engine (three-way parity: block == per-token == generate)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1875,7 +1889,9 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
                                                       size=plen)),
             max_new_tokens=int(rng.integers(4, 9)),
             eos_token=eos if rid % 2 else None))
-    engine = ServingEngine(params, cfg, EngineConfig(num_slots=3))
+    s_steps = args.decode_steps  # >= 1, validated by _cmd_serve
+    ecfg = EngineConfig(num_slots=3, decode_steps=s_steps)
+    engine = ServingEngine(params, cfg, ecfg)
     sched = RequestScheduler(SchedulerConfig(), num_slots=3)
     metrics = ServingMetrics()
     for r in reqs:
@@ -1884,6 +1900,21 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     results = serve_loop(engine, sched, metrics=metrics,
                          max_dispatches=200)
     failures = []
+    if s_steps > 1:
+        # three-way parity: the block engine's tokens must equal the
+        # S=1 engine's (which the loop below pins against generate())
+        engine1 = ServingEngine(params, cfg, EngineConfig(num_slots=3))
+        sched1 = RequestScheduler(SchedulerConfig(), num_slots=3)
+        for r in reqs:
+            sched1.submit(r)
+        results1 = serve_loop(engine1, sched1, max_dispatches=200)
+        for r in reqs:
+            if list(results[r.rid][0]) != list(results1[r.rid][0]) \
+                    or results[r.rid][1] != results1[r.rid][1]:
+                failures.append(
+                    f"rid={r.rid}: S={s_steps} block "
+                    f"{list(results[r.rid][0])} != S=1 "
+                    f"{list(results1[r.rid][0])}")
     for r in reqs:
         prompt = jnp.asarray(r.prompt, jnp.int32)[None]
         if r.eos_token is None:
@@ -1906,7 +1937,7 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     # churn — must compile nothing; the first run above was the warmup
     from akka_allreduce_tpu.analysis.recompile import (RecompileError,
                                                        no_recompiles)
-    engine2 = ServingEngine(params, cfg, EngineConfig(num_slots=3))
+    engine2 = ServingEngine(params, cfg, ecfg)
     sched2 = RequestScheduler(SchedulerConfig(), num_slots=3)
     for r in reqs:
         sched2.submit(r)
@@ -1922,8 +1953,10 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     print(json.dumps({
         "selfcheck": "ok" if not failures else "FAIL",
         "requests": len(reqs),
+        "decode_steps": s_steps,
         "decode_tokens_per_s": round(tput, 1),
         "decode_dispatches": engine.decode_dispatches,
+        "wasted_tokens": engine.wasted_tokens,
         "churn_recompiles": 0 if results2 else None,
         "failures": failures,
     }))
@@ -1932,6 +1965,12 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
 
 def _cmd_serve(args: argparse.Namespace) -> int:
     _apply_backend_flags(args)
+    # validated BEFORE the selfcheck dispatch: a typo'd S must exit 2,
+    # not silently clamp and self-certify a parity mode it never ran
+    if args.decode_steps < 1:
+        print(f"error: --decode-steps must be >= 1, got "
+              f"{args.decode_steps}", file=sys.stderr)
+        return 2
     if args.selfcheck:
         return _serve_selfcheck(args)
     import jax
@@ -2026,7 +2065,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 EngineConfig(
                     num_slots=args.slots, prefill_buckets=buckets,
                     kv_dtype="int8" if args.kv_cache == "int8"
-                    else None),
+                    else None,
+                    decode_steps=args.decode_steps),
                 tracer=tracer)
             sched = RequestScheduler(
                 SchedulerConfig(max_queue_depth=args.queue_depth,
@@ -2056,6 +2096,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                    "load": args.load, "policy": args.policy,
                    "th_step": args.th_step, "kv_cache": args.kv_cache,
                    "prefill_buckets": list(buckets),
+                   "decode_steps": args.decode_steps,
                    "max_new_tokens": args.max_new_tokens},
         "completed_reasons": {
             reason: sum(1 for toks, r in results.values()

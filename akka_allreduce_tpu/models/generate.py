@@ -290,6 +290,67 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
     return new_cache, logits[:, 0, :]
 
 
+def multi_step_decode(params: dict, kv: dict, logits: jnp.ndarray,
+                      pos: jnp.ndarray, done: jnp.ndarray,
+                      remaining: jnp.ndarray, eos_ids: jnp.ndarray,
+                      stop_ids: jnp.ndarray, steps: int, decode_fn):
+    """Fuse ``steps`` greedy decode steps into one ``lax.scan`` with
+    per-lane finish handling ON DEVICE — the masked multi-step core the
+    serving engine dispatches (serving/engine.py ``_engine_multi_step``).
+
+    The single-step engine pays one Python dispatch and one device->host
+    readback per emitted token; this core amortizes both across a block
+    of ``steps`` tokens (the paper's spend-bandwidth-not-round-trips
+    move, pointed at the decode loop). The price is that a lane can
+    finish MID-block: its done-mask latches on device and the trailing
+    block steps compute garbage for it ("wasted tokens" — the quantity
+    the engine's metrics report so operators can tune ``steps``).
+
+    Per scan step, for each lane:
+
+    1. emit ``tok = argmax(logits)`` (greedy — the parity mode; sampled
+       multi-step serving would thread a key through the carry);
+    2. latch ``done`` if the lane was active and ``tok`` is its EOS, one
+       of its stop ids, or its last budgeted token (``remaining <= 1``);
+    3. run ``decode_fn`` for every lane (static shapes), but a lane that
+       is frozen — done before this step, or latched by its just-emitted
+       token — neither writes KV (``write_mask``) nor advances ``pos``.
+       The S=1 engine runs the finishing token's cache write and then
+       discards the lane wholesale on refill, so masking it is
+       unobservable; active lanes see bitwise the same per-row math
+       either way, which is what keeps block decode bitwise equal to
+       the single-step engine and to :func:`generate`.
+
+    ``eos_ids`` (lanes,) and ``stop_ids`` (lanes, K) use -1 for "none"
+    (argmax tokens are >= 0, so -1 never matches); ``remaining`` (lanes,)
+    counts budgeted tokens left; ``done`` marks lanes (e.g. free engine
+    slots) that must not decode at all. ``decode_fn(params, kv, tok,
+    pos, write_mask)`` is one masked decode step returning ``(kv,
+    logits)`` — the engine passes its per-slot-position step.
+
+    Returns ``((kv, logits, pos, done, remaining), tokens)`` with
+    ``tokens`` of shape ``(steps, lanes)``; entries after a lane's latch
+    are garbage the caller must not consume.
+    """
+
+    def one(carry, _):
+        kv, logits, pos, done, remaining = carry
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        active = ~done
+        finished = active & ((tok == eos_ids)
+                             | (stop_ids == tok[:, None]).any(axis=1)
+                             | (remaining <= 1))
+        live = active & ~finished
+        remaining = jnp.where(active, remaining - 1, remaining)
+        done = done | finished
+        kv, logits = decode_fn(params, kv, tok, pos, live)
+        pos = jnp.where(live, pos + 1, pos)
+        return (kv, logits, pos, done, remaining), tok
+
+    return lax.scan(one, (kv, logits, pos, done, remaining), None,
+                    length=steps)
+
+
 def _filter_top_k(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
     """Keep the ``top_k`` largest logits per row, NEG_INF the rest (ties
     at the threshold are kept — harmless, matches common practice)."""

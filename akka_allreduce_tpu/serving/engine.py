@@ -40,10 +40,18 @@ request's tokens therefore do not depend on which slot it landed in or
 who shares the batch (same caveat as generate.py: MoE capacity binds
 per-batch — run serving MoE with generous ``capacity_factor``).
 
-The host loop costs one dispatch + one (slots,) readback per token —
-the continuous-batching shape; amortizing dispatches by scanning
-multiple steps between admission checks is a latency/occupancy trade
-the bench can explore later.
+The host loop costs one dispatch + one readback per BLOCK:
+``decode_steps=1`` (the parity baseline) pays it per token;
+``decode_steps=S`` scans S slot steps inside one compiled program
+(models/generate.py ``multi_step_decode`` over ``_slot_decode_step``)
+and reads back an ``(S, slots)`` token block plus the post-block
+positions as one array. Finish handling latches on device (per-slot
+EOS/stop/budget vectors; frozen lanes stop advancing ``pos`` and
+writing KV), the host replays the same conditions to unpack the block,
+and greedy output stays bitwise identical across S and vs
+``generate()`` (tests/test_multi_step_decode.py). The trade is tail
+waste (``wasted_tokens``) and block-granular admission — the
+``multi_step_decode`` bench row is the A/B.
 
 The no-recompile contract is ASSERTED, not just designed for: slot
 churn/refill runs under the zero-compile guard
@@ -69,6 +77,7 @@ from jax import lax
 from akka_allreduce_tpu.models.generate import (
     dequantize_kv,
     init_kv_cache,
+    multi_step_decode,
     prefill,
     quantize_kv,
 )
@@ -95,16 +104,41 @@ class EngineConfig:
     ``kv_dtype="int8"``: quantized per-slot KV cache
     (models/generate.py ``init_kv_cache``), 4x (bf16: 2x) less cache
     HBM per slot — i.e. 4x the slots per chip at a bounded logit error.
+
+    ``decode_steps=S``: fuse S decode steps into ONE compiled program
+    (a ``lax.scan`` over the slot step — models/generate.py
+    ``multi_step_decode``), so a dispatch emits an ``(S, slots)`` token
+    block and the host pays one readback per S tokens instead of per
+    token. Finish handling moves on-device: each lane's done-mask
+    latches on its EOS / stop token / budget, frozen lanes stop
+    advancing ``pos`` and writing KV, and the host unpacks the block
+    through the existing completion logic — greedy output stays BITWISE
+    identical to S=1 and to ``generate()``. The trade is tail waste
+    (block steps computed for a lane after it latched — surfaced as
+    ``wasted_tokens``) and block-granular admission/TTFT.
+
+    ``max_stop_tokens``: static width of the per-slot stop-token matrix
+    the S>1 program carries (padded with -1); a request with more stop
+    tokens than this is rejected at admit when ``decode_steps > 1``
+    (the S=1 path checks stops host-side and has no such bound).
     """
 
     num_slots: int = 4
     prefill_buckets: tuple = ()
     kv_dtype: Optional[str] = None
+    decode_steps: int = 1
+    max_stop_tokens: int = 4
 
     def __post_init__(self):
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, "
                              f"got {self.num_slots}")
+        if self.decode_steps < 1:
+            raise ValueError(f"decode_steps must be >= 1, "
+                             f"got {self.decode_steps}")
+        if self.max_stop_tokens < 1:
+            raise ValueError(f"max_stop_tokens must be >= 1, "
+                             f"got {self.max_stop_tokens}")
         if list(self.prefill_buckets) != sorted(set(
                 self.prefill_buckets)) or any(
                 b < 1 for b in self.prefill_buckets):
@@ -170,22 +204,32 @@ def _slot_cached_attention(q: jnp.ndarray, k_all: jnp.ndarray,
 
 
 def _write_slot_rows(cache: jnp.ndarray, layer: int, vals: jnp.ndarray,
-                     pos: jnp.ndarray) -> jnp.ndarray:
+                     pos: jnp.ndarray,
+                     mask: "jnp.ndarray | None" = None) -> jnp.ndarray:
     """Write ``vals[s]`` at ``cache[layer, s, pos[s]]`` for every slot.
     An unrolled loop of ``dynamic_update_slice`` (slots is small and
     static) rather than one ``.at[layer, rows, pos].set`` scatter: with
     the engine state donated, DUS updates the buffer in place, and the
     XLA:CPU scatter lowering measured ~5x slower per write. Placement
-    only — the written values are identical either way."""
+    only — the written values are identical either way.
+
+    ``mask`` (slots,) bool: a False lane keeps its old cache value at
+    ``pos[s]`` (the multi-step block's frozen lanes — the write becomes
+    a read-select-write of one tiny row, still a DUS the donation keeps
+    in place)."""
     for s in range(vals.shape[0]):
-        cache = lax.dynamic_update_slice(
-            cache, vals[s][None, None, None],
-            (layer, s, pos[s]) + (0,) * (vals.ndim - 1))
+        val = vals[s][None, None, None]
+        idx = (layer, s, pos[s]) + (0,) * (vals.ndim - 1)
+        if mask is not None:
+            old = lax.dynamic_slice(cache, idx, val.shape)
+            val = jnp.where(mask[s], val, old)
+        cache = lax.dynamic_update_slice(cache, val, idx)
     return cache
 
 
 def _slot_decode_step(params: dict, kv: dict, token: jnp.ndarray,
-                      pos: jnp.ndarray, cfg: TransformerConfig):
+                      pos: jnp.ndarray, cfg: TransformerConfig,
+                      write_mask: "jnp.ndarray | None" = None):
     """models/generate.py ``decode_step`` with the batch-wide position
     scalar generalized to a per-slot vector — the engine's one compiled
     decode program. Mirrors the block math op-for-op (same projections,
@@ -193,7 +237,9 @@ def _slot_decode_step(params: dict, kv: dict, token: jnp.ndarray,
     (per-slot positions instead of one shared slice) and the mask
     source differ, neither of which touches a row's arithmetic. kv: k/v
     (layers, slots, max_seq, kv_heads, head_dim) [+ scales]; token/pos
-    (slots,). Returns (new kv, logits (slots, vocab))."""
+    (slots,). ``write_mask`` (slots,) freezes a lane's cache writes
+    (multi-step blocks; never changes an unmasked row's math). Returns
+    (new kv, logits (slots, vocab))."""
     s = token.shape[0]
     quantized = "k_scale" in kv
     x = params["embed"][token][:, None, :]
@@ -213,17 +259,23 @@ def _slot_decode_step(params: dict, kv: dict, token: jnp.ndarray,
         if quantized:
             kq, ks = quantize_kv(k)
             vq, vs = quantize_kv(v)
-            k_cache = _write_slot_rows(k_cache, i, kq[:, 0], pos)
-            v_cache = _write_slot_rows(v_cache, i, vq[:, 0], pos)
-            k_scales = _write_slot_rows(k_scales, i, ks[:, 0], pos)
-            v_scales = _write_slot_rows(v_scales, i, vs[:, 0], pos)
+            k_cache = _write_slot_rows(k_cache, i, kq[:, 0], pos,
+                                       write_mask)
+            v_cache = _write_slot_rows(v_cache, i, vq[:, 0], pos,
+                                       write_mask)
+            k_scales = _write_slot_rows(k_scales, i, ks[:, 0], pos,
+                                        write_mask)
+            v_scales = _write_slot_rows(v_scales, i, vs[:, 0], pos,
+                                        write_mask)
             k_all = dequantize_kv(k_cache[i], k_scales[i], cfg.dtype)
             v_all = dequantize_kv(v_cache[i], v_scales[i], cfg.dtype)
         else:
             k_cache = _write_slot_rows(
-                k_cache, i, k[:, 0].astype(k_cache.dtype), pos)
+                k_cache, i, k[:, 0].astype(k_cache.dtype), pos,
+                write_mask)
             v_cache = _write_slot_rows(
-                v_cache, i, v[:, 0].astype(v_cache.dtype), pos)
+                v_cache, i, v[:, 0].astype(v_cache.dtype), pos,
+                write_mask)
             k_all, v_all = k_cache[i], v_cache[i]
         attn = _slot_cached_attention(q, k_all, v_all, pos,
                                       window=cfg.attn_window)
@@ -263,6 +315,47 @@ def _engine_step(params: dict, state: dict, pos: jnp.ndarray,
     kv = {n: state[n] for n in state if n != "logits"}
     new_kv, logits = _slot_decode_step(params, kv, tok, pos, cfg)
     return {**new_kv, "logits": logits}, tok
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"), donate_argnums=(1,))
+def _engine_multi_step(params: dict, state: dict, pos: jnp.ndarray,
+                       done: jnp.ndarray, remaining: jnp.ndarray,
+                       eos_ids: jnp.ndarray, stop_ids: jnp.ndarray,
+                       cfg: TransformerConfig, steps: int):
+    """``steps`` decode steps for every slot in ONE compiled program:
+    ``multi_step_decode`` (models/generate.py) scanning
+    ``_slot_decode_step``, with per-slot finish vectors so done-masks
+    latch on device. One program per distinct ``steps`` (static); slot
+    churn between blocks is data, compiling nothing — the S>1 extension
+    of the engine's no-recompile contract.
+
+    ``done`` marks free lanes up front (they neither write KV nor
+    advance ``pos`` — tighter than the S=1 step's park-at-0 garbage
+    writes, and equally unobservable); ``remaining``/``eos_ids``/
+    ``stop_ids`` are the per-slot budgets and finish ids (-1 = none).
+
+    Returns (new state, packed (steps+1, slots) int32, pos, done,
+    remaining): ``packed`` rows [0, steps) are the token block, row
+    ``steps`` the post-block positions — ONE array so the host pays a
+    single readback per block; the trailing device vectors let the host
+    carry slot state across quiet blocks without host->device uploads.
+    The state is donated, same as ``_engine_step``."""
+
+    def decode_fn(p, kv, tok, p_pos, write_mask):
+        return _slot_decode_step(p, kv, tok, p_pos, cfg,
+                                 write_mask=write_mask)
+
+    kv = {n: state[n] for n in state if n != "logits"}
+    (kv, logits, pos, done, remaining), toks = multi_step_decode(
+        params, kv, state["logits"], pos, done, remaining,
+        eos_ids, stop_ids, steps, decode_fn)
+    packed = jnp.concatenate([toks, pos[None]], axis=0)
+    # pos/done/remaining come back as DEVICE arrays so the host can
+    # feed the next block without re-uploading them: between blocks
+    # with no admit/free, the device's post-block vectors ARE the
+    # host's (a ~0.2 ms/array transfer saved per dispatch — at small
+    # step times that is the overhead the block fusion exists to kill)
+    return {**kv, "logits": logits}, packed, pos, done, remaining
 
 
 @partial(jax.jit, static_argnames=("cfg", "gather"), donate_argnums=(1,))
@@ -323,8 +416,27 @@ class ServingEngine:
             (ecfg.num_slots, cfg.vocab_size), cfg.dtype)}
         self._pos = np.zeros((ecfg.num_slots,), np.int32)
         self._slots: list[Optional[_SlotState]] = [None] * ecfg.num_slots
+        # per-slot finish vectors for the fused block program (S>1):
+        # device copies of each occupant's EOS id, stop-id row (padded
+        # -1), and remaining-token budget — the done-mask latch inputs
+        self._eos = np.full((ecfg.num_slots,), -1, np.int32)
+        self._stops = np.full((ecfg.num_slots, ecfg.max_stop_tokens),
+                              -1, np.int32)
+        self._remaining = np.zeros((ecfg.num_slots,), np.int32)
+        # device copies of the block program's slot vectors, carried
+        # across blocks: a block with no admit/free in between reuses
+        # the PREVIOUS block's device outputs verbatim (they equal the
+        # host replay by the parity contract), so steady-state decode
+        # pays zero host->device vector uploads per dispatch.
+        # admit()/_free_slot() set the dirty flag to force re-upload.
+        self._dev_vectors: Optional[dict] = None
+        self._vectors_dirty = True
         self.decode_dispatches = 0
         self.prefill_dispatches = 0
+        # block steps computed for a lane AFTER its done-mask latched
+        # (S>1 tail waste — the quantity an operator tunes decode_steps
+        # against; always 0 at S=1)
+        self.wasted_tokens = 0
         # distinct (padded length, gather) pairs = compiled prefill
         # programs — the quantity prefill_buckets exists to bound
         self.prefill_shapes: set = set()
@@ -377,6 +489,13 @@ class ServingEngine:
             if not 0 <= t < self.cfg.vocab_size:
                 raise ValueError(f"request {req.rid}: stop/eos token {t} "
                                  f"out of vocab [0, {self.cfg.vocab_size})")
+        stops = tuple(req.stop_tokens or ())
+        if self.ecfg.decode_steps > 1 \
+                and len(stops) > self.ecfg.max_stop_tokens:
+            raise ValueError(
+                f"request {req.rid}: {len(stops)} stop tokens exceed the "
+                f"block program's static width max_stop_tokens="
+                f"{self.ecfg.max_stop_tokens} (raise it in EngineConfig)")
         try:
             slot = self._slots.index(None)
         except ValueError:
@@ -396,6 +515,12 @@ class ServingEngine:
         self.prefill_dispatches += 1
         self.prefill_shapes.add((length, length != n))
         self._pos[slot] = n
+        self._eos[slot] = -1 if req.eos_token is None else req.eos_token
+        self._stops[slot, :] = -1
+        for j, t in enumerate(stops[:self.ecfg.max_stop_tokens]):
+            self._stops[slot, j] = t
+        self._remaining[slot] = req.max_new_tokens
+        self._vectors_dirty = True
         self._slots[slot] = _SlotState(req=req, emitted=[])
         if self.metrics is not None:
             self.metrics.on_admit(req.rid, slot, n)
@@ -403,12 +528,35 @@ class ServingEngine:
 
     # -- decode ---------------------------------------------------------
 
+    def _finish_reason(self, req: Request, t: int,
+                       emitted: int) -> Optional[str]:
+        """Host finish predicate — the S=1 check, and the replay that
+        mirrors the device latch (multi_step_decode) token for token."""
+        if req.eos_token is not None and t == req.eos_token:
+            return "eos"
+        if t in (req.stop_tokens or ()):
+            return "stop"
+        if emitted >= req.max_new_tokens:
+            return "max_tokens"
+        return None
+
+    def _free_slot(self, i: int) -> None:
+        self._slots[i] = None
+        self._pos[i] = 0  # park the free lane at position 0
+        self._eos[i] = -1
+        self._stops[i, :] = -1
+        self._remaining[i] = 0
+        self._vectors_dirty = True
+
     def step(self) -> list[tuple[int, Request, list, str]]:
-        """Advance every occupied slot one token. Returns completions as
-        ``(slot, request, tokens, reason)`` with reason one of
-        ``eos`` / ``stop`` / ``max_tokens``; completed slots are freed
-        before returning (the same dispatch that emitted the finishing
-        token — a slot never idles occupied)."""
+        """Advance every occupied slot by ``decode_steps`` tokens (its
+        done-mask latching earlier on device when S > 1). Returns
+        completions as ``(slot, request, tokens, reason)`` with reason
+        one of ``eos`` / ``stop`` / ``max_tokens``; completed slots are
+        freed before returning (the same dispatch that emitted the
+        finishing token — a slot never idles occupied)."""
+        if self.ecfg.decode_steps > 1:
+            return self._step_block()
         span = (self.tracer.span("serve_step", occupied=self.occupied)
                 if self.tracer is not None else _null_span())
         with span:
@@ -424,23 +572,92 @@ class ServingEngine:
             t = int(toks[i])
             slot.emitted.append(t)
             self._pos[i] += 1
+            self._remaining[i] -= 1
             req = slot.req
             if self.metrics is not None:
                 self.metrics.on_token(req.rid, req.submitted_at)
-            reason = None
-            if req.eos_token is not None and t == req.eos_token:
-                reason = "eos"
-            elif t in (req.stop_tokens or ()):
-                reason = "stop"
-            elif len(slot.emitted) >= req.max_new_tokens:
-                reason = "max_tokens"
+            reason = self._finish_reason(req, t, len(slot.emitted))
             if reason is not None:
                 finished.append((i, req, slot.emitted, reason))
-                self._slots[i] = None
-                self._pos[i] = 0  # park the free lane at position 0
+                self._free_slot(i)
                 if self.metrics is not None:
                     self.metrics.on_complete(req.rid, len(slot.emitted),
                                              reason)
+        return finished
+
+    def _step_block(self) -> list[tuple[int, Request, list, str]]:
+        """The S>1 dispatch: one fused ``_engine_multi_step`` program,
+        one ``(S+1, slots)`` readback, then the host unpacks the token
+        block through the SAME completion logic the S=1 path runs —
+        consuming each lane's tokens until its finish condition fires
+        (mirroring the device latch) and counting the trailing block
+        steps as wasted."""
+        s_steps = self.ecfg.decode_steps
+        if self._vectors_dirty:
+            self._dev_vectors = {
+                "pos": jnp.asarray(self._pos),
+                "done": jnp.asarray(
+                    np.array([s is None for s in self._slots])),
+                "remaining": jnp.asarray(self._remaining),
+                "eos": jnp.asarray(self._eos),
+                "stops": jnp.asarray(self._stops),
+            }
+            self._vectors_dirty = False
+        d = self._dev_vectors
+        span = (self.tracer.span("serve_step", occupied=self.occupied,
+                                 decode_steps=s_steps)
+                if self.tracer is not None else _null_span())
+        with span:
+            self._state, packed, pos_d, done_d, rem_d = \
+                _engine_multi_step(
+                    self.params, self._state, d["pos"], d["done"],
+                    d["remaining"], d["eos"], d["stops"],
+                    self.cfg, s_steps)
+            block = np.asarray(packed)  # ONE readback per S tokens
+        # carry the post-block device vectors; a dirty event below
+        # (admit/free) re-uploads from host truth instead
+        self._dev_vectors = {**d, "pos": pos_d, "done": done_d,
+                             "remaining": rem_d}
+        self.decode_dispatches += 1
+        toks, dev_pos = block[:s_steps], block[s_steps]
+        finished = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            req = slot.req
+            reason = None
+            consumed = 0
+            for s in range(s_steps):
+                t = int(toks[s, i])
+                slot.emitted.append(t)
+                consumed += 1
+                self._pos[i] += 1
+                self._remaining[i] -= 1
+                reason = self._finish_reason(req, t, len(slot.emitted))
+                if reason is not None:
+                    break
+            if self.metrics is not None:
+                self.metrics.on_block_tokens(req.rid, req.submitted_at,
+                                             consumed)
+            if reason is not None:
+                wasted = s_steps - consumed
+                self.wasted_tokens += wasted
+                if self.metrics is not None:
+                    self.metrics.on_wasted(req.rid, wasted)
+                    self.metrics.on_complete(req.rid, len(slot.emitted),
+                                             reason)
+                finished.append((i, req, slot.emitted, reason))
+                self._free_slot(i)
+            elif int(dev_pos[i]) != int(self._pos[i]):
+                # the host replay above mirrors the device latch; a
+                # surviving lane whose device position disagrees means
+                # the two finish logics drifted — corrupt state, not a
+                # recoverable condition
+                raise RuntimeError(
+                    f"slot {i} (rid {req.rid}): device pos "
+                    f"{int(dev_pos[i])} != host replay {self._pos[i]} "
+                    f"after a {s_steps}-step block — on-device finish "
+                    f"latch and host completion logic diverged")
         return finished
 
 

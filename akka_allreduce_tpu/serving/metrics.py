@@ -4,8 +4,13 @@ tracing plane.
 What an operator watches on a serving box is not a single goodput number
 but distributions: TTFT (submit -> first token, the interactive-feel
 metric; queueing + prefill), TPOT (steady decode cadence per token),
-queue depth (backpressure headroom), and slot occupancy (batch
-efficiency — the fraction of decode-lane work that is real requests).
+queue depth (backpressure headroom), slot occupancy (batch efficiency —
+the fraction of decode-lane work that is real requests), and — under
+multi-step block decode (``decode_steps > 1``) — wasted tokens (block
+steps computed after a lane's done-mask latched). Block emission is
+understood, not averaged away: TTFT is the block-end delivery time, and
+TPOT counts only tokens that arrived after the first delivery instant
+(a request that fits in one block has no cadence sample).
 This module keeps those as plain host-side histograms (p50/p90/p99 by
 nearest-rank, no deps) and wires them into the two existing
 observability planes instead of inventing a third:
@@ -82,12 +87,22 @@ class ServingMetrics:
         self.tpot_s = Histogram()
         self.queue_depth = Histogram()
         self.slot_occupancy = Histogram()
+        # multi-step blocks (engine decode_steps > 1): per-completion
+        # count of block steps computed after the lane's done-mask
+        # latched — the tail waste an operator tunes decode_steps
+        # against (always 0 at decode_steps=1)
+        self.wasted_per_completion = Histogram()
         self.prefill_tokens = 0
         self.decode_tokens = 0
+        self.wasted_tokens = 0
         self.requests_submitted = 0
         self.requests_completed = 0
         self.requests_rejected = 0
         self._first: dict[int, float] = {}  # rid -> first-token time
+        # rid -> tokens delivered AT the first-token instant (the whole
+        # first block lands at once under block emission; TPOT must not
+        # count those as if they took time)
+        self._first_count: dict[int, int] = {}
         self._t0: Optional[float] = None
         self._t_end: Optional[float] = None
 
@@ -114,21 +129,46 @@ class ServingMetrics:
 
     def on_token(self, rid: int, submitted_at: float) -> None:
         """Called per emitted token; the first emission banks TTFT."""
-        self.decode_tokens += 1
+        self.on_block_tokens(rid, submitted_at, 1)
+
+    def on_block_tokens(self, rid: int, submitted_at: float,
+                        n: int) -> None:
+        """``n`` tokens delivered to ``rid`` at THIS instant — per-token
+        emission is the n=1 case; a multi-step engine delivers a lane's
+        whole block share at once. The first delivery banks TTFT and
+        remembers its size so TPOT (on_complete) measures cadence only
+        over tokens that arrived after that instant."""
+        if n < 1:
+            return
+        self.decode_tokens += n
         if rid not in self._first:
             now = self.clock()
             self._first[rid] = now
+            self._first_count[rid] = n
             self.ttft_s.record(now - submitted_at)
             self._record("serve_first_token", rid=rid,
-                         ttft_s=now - submitted_at)
+                         ttft_s=now - submitted_at, tokens=n)
+
+    def on_wasted(self, rid: int, n: int) -> None:
+        """Block steps the device computed for ``rid``'s lane after its
+        done-mask latched (multi-step tail waste); called once per
+        completion by the S>1 engine, n=0 included so the histogram is a
+        per-completion distribution, not a nonzero-only one."""
+        self.wasted_tokens += n
+        self.wasted_per_completion.record(n)
+        self._record("serve_wasted", rid=rid, tokens=n)
 
     def on_complete(self, rid: int, n_tokens: int, reason: str) -> None:
         self.requests_completed += 1
         now = self.clock()
         self._t_end = now
         first = self._first.pop(rid, None)
-        if first is not None and n_tokens > 1:
-            self.tpot_s.record((now - first) / (n_tokens - 1))
+        # cadence over the tokens delivered after the first-token
+        # instant; a request that fit entirely in its first block has no
+        # measurable cadence (no sample beats a fabricated 0)
+        later = n_tokens - self._first_count.pop(rid, 1)
+        if first is not None and later > 0:
+            self.tpot_s.record((now - first) / later)
         self._record("serve_complete", rid=rid, tokens=n_tokens,
                      reason=reason)
 
@@ -161,12 +201,20 @@ class ServingMetrics:
         return self.decode_tokens / w if w and w > 0 else None
 
     def summary(self) -> dict:
+        computed = self.decode_tokens + self.wasted_tokens
         out = {
             "requests": {"submitted": self.requests_submitted,
                          "completed": self.requests_completed,
                          "rejected": self.requests_rejected},
             "tokens": {"prefill": self.prefill_tokens,
-                       "decode": self.decode_tokens},
+                       "decode": self.decode_tokens,
+                       "wasted": self.wasted_tokens},
+            # fraction of occupied-lane decode work thrown away to block
+            # tail waste — the decode_steps tuning signal
+            "wasted_token_rate": round(
+                self.wasted_tokens / computed, 4) if computed else 0.0,
+            "wasted_per_completion": self.wasted_per_completion.summary(
+                digits=2),
             "ttft_ms": self.ttft_s.summary(scale=1e3),
             "tpot_ms": self.tpot_s.summary(scale=1e3),
             "queue_depth": self.queue_depth.summary(digits=2),

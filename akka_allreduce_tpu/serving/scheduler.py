@@ -23,6 +23,15 @@ Policies: ``fifo`` (arrival order) or ``deadline`` (earliest absolute
 deadline first, FIFO among equals — deadline-less requests sort last).
 Everything here is pure host Python: unit-testable with a fake clock,
 no device, no jax import.
+
+One granularity note: a "round" is whatever the engine's dispatch is.
+With multi-step block decode (``EngineConfig.decode_steps = S``) the
+serve loop admits only BETWEEN blocks, so a slot freed mid-block stays
+empty for the block's remainder (counted as the engine's wasted
+tokens, not as queue time) and an arrival waits at most one block for
+admission — the latency/occupancy trade S buys its dispatch
+amortization with. The scheduler itself is unchanged: ``th_step``
+gates dispatches, whatever their token width.
 """
 
 from __future__ import annotations

@@ -88,7 +88,7 @@ def main(only=None) -> int:
         fns = {f.__name__: f for f in
                (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                 ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
-                serving_throughput)}
+                serving_throughput, multi_step_decode)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -171,7 +171,7 @@ def main(only=None) -> int:
     skip = set(os.environ.get("AATPU_SUITE_SKIP", "").split(","))
     for fn in (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
-               serving_throughput):
+               serving_throughput, multi_step_decode):
         if fn.__name__ not in skip:
             fn()
     return 0
@@ -196,6 +196,37 @@ def serving_throughput():
             slot_counts=(2, 4, 8))
     else:
         rows = measure_serving_throughput()
+    for row in rows:
+        emit(row["metric"], row["value"], row["unit"], row["note"])
+
+
+def multi_step_decode():
+    """The fused block-decode A/B (serving/engine.py decode_steps):
+    S in {1, 2, 4, 8} decode steps per dispatch at 4 slots, ragged
+    budgets so tail waste is charged — the measurement behind `serve
+    --decode-steps` (akka_allreduce_tpu.bench
+    measure_multi_step_decode). Sized up on TPU like the other
+    sections; the speedup rows are the claim, the wasted-token rate in
+    each note is the cost S pays for it."""
+    import jax
+
+    from akka_allreduce_tpu.bench import measure_multi_step_decode
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        rows = measure_multi_step_decode(
+            d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+            n_requests=16, prompt_len=64, steps=128, slots=4)
+    else:
+        # CPU sizes the model DOWN so the per-step device time sits at
+        # ~1 ms — the step-time : dispatch-overhead ratio a TPU decode
+        # step actually has (a CPU-sized 512-d model takes ~15 ms/step,
+        # burying the round-trip the A/B exists to measure under
+        # compute no chip would spend); more requests + reps because
+        # this box's run-to-run noise needs ~1 s runs to average out
+        rows = measure_multi_step_decode(
+            d_model=256, n_layers=2, d_ff=1024, vocab=1024,
+            n_requests=24, reps=4)
     for row in rows:
         emit(row["metric"], row["value"], row["unit"], row["note"])
 

@@ -60,24 +60,34 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "serving_throughput"], check=False)
 """),
-    # 2. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
+    # 2. the multi-step decode A/B (this PR's open claim): the fused
+    # block-decode engine vs S=1 at 4 slots, S in {1,2,4,8} — CPU rows
+    # banked in perf_capture/multi_step.json; this is the on-chip row
+    ("multi_step_decode", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "multi_step_decode"], check=False)
+"""),
+    # 3. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
-    # got chip time
+    # got chip time. guard_recompiles: every timed run holds under the
+    # zero-compile guard (analysis/recompile.py) so a recompiling warmed
+    # step raises instead of banking compile stalls as MFU
     ("scan_mfu_bf16", "mfu", 1500, """
 import json
 from akka_allreduce_tpu.bench import measure_train_mfu
-r = measure_train_mfu(compute_dtype="bf16")
+r = measure_train_mfu(compute_dtype="bf16", guard_recompiles=True)
 print(json.dumps({"metric": "mfu_train_bf16", "scan_steps": True, **r}),
       flush=True)
 """),
-    # 3. the reworked windowed-SP A/B (round-4 verdict weak #4: zero
+    # 4. the reworked windowed-SP A/B (round-4 verdict weak #4: zero
     # on-chip rows; the old 29.7 TFLOP/s quote is from a flawed harness)
     ("windowed_sp", "suite", 900, """
 import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "ab_windowed_sp"], check=False)
 """),
-    # 4. headline goodput as median-of-5 two-point deltas with spread
+    # 5. headline goodput as median-of-5 two-point deltas with spread
     # (round-4 verdict weak #3: three single-shot captures spread
     # 305-341 GB/s with no methodology)
     ("headline_median", "headline", 700, """
@@ -87,32 +97,34 @@ env = {**os.environ, "AATPU_BENCH_PLATFORM": "default",
 subprocess.run([sys.executable, "-m", "akka_allreduce_tpu.bench"],
                env=env, check=False)
 """),
-    # 5. f32 MFU companion row
+    # 6. f32 MFU companion row (guarded like the bf16 one)
     ("scan_mfu_f32", "mfu", 1200, """
 import json
 from akka_allreduce_tpu.bench import measure_train_mfu
-r = measure_train_mfu(compute_dtype="f32")
+r = measure_train_mfu(compute_dtype="f32", guard_recompiles=True)
 print(json.dumps({"metric": "mfu_train_f32", "scan_steps": True, **r}),
       flush=True)
 """),
-    # 6. decode bench
+    # 7. decode bench
     ("decode", "decode", 600, """
 import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_decode.py"],
                check=False)
 """),
-    # 7. the rest of the suite (MFU, windowed-SP, overlap, and serving
-    # skipped — steps 2/5, 3, 0, and 1 own those rows; a re-run here
-    # would bank duplicates, and ab_overlap needs its own fresh process
-    # anyway)
+    # 8. the rest of the suite (MFU, windowed-SP, overlap, serving, and
+    # multi-step decode skipped — the dedicated steps above own those
+    # rows; a re-run here would bank duplicates, and ab_overlap needs
+    # its own fresh process anyway)
     ("suite", "suite", 1800, """
 import os, subprocess, sys
 env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1",
-       "AATPU_SUITE_SKIP": "ab_windowed_sp,ab_overlap,serving_throughput"}
+       "AATPU_SUITE_SKIP":
+           "ab_windowed_sp,ab_overlap,serving_throughput,"
+           "multi_step_decode"}
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env,
                check=False)
 """),
-    # 8. speculative-decoding mechanics (round 5; last — never
+    # 9. speculative-decoding mechanics (round 5; last — never
     # ahead of the open claims)
     ("speculative", "decode", 900, """
 import subprocess, sys
@@ -272,7 +284,9 @@ def aggregate():
         "| metric | value | unit | captured | note |",
         "|--------|-------|------|----------|------|",
     ]
-    for sec in ("mfu", "headline", "decode", "suite", "canonical"):
+    order = ["mfu", "headline", "decode", "suite", "canonical"]
+    order += sorted(s for s in merged if s not in order)
+    for sec in order:
         v = merged.get(sec)
         if not v:
             continue

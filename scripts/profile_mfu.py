@@ -17,8 +17,15 @@ chip, at the exact MFU-bench configuration:
 Timing: chained two-point with device->host readback (bench.py's
 methodology — block_until_ready through this relay can return early).
 Emits one JSON row per component plus an attribution summary.
+
+Every timed region runs under the zero-compile guard
+(analysis/recompile.py) by default: a component that recompiles
+mid-measurement would attribute compile stalls to the chip, so the
+profile fails loudly instead of banking it (``--no-guard-recompiles``
+opts out, e.g. when deliberately profiling a cold cache).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -51,9 +58,24 @@ def emit(metric, value, unit, note):
                       "unit": unit, "note": note}), flush=True)
 
 
-def timed(fn, args, k_hi=12, k_lo=4, chain=None):
+# set by main() from --no-guard-recompiles; module-level so every timed
+# stage shares one switch
+_GUARD_TIMED = True
+
+
+def _timed_guard(what: str):
+    """Zero-compile guard around a timed region (analysis/recompile.py):
+    a warmed component that recompiles mid-measurement raises instead of
+    banking compile time as device time."""
+    from akka_allreduce_tpu.analysis.recompile import maybe_no_recompiles
+    return maybe_no_recompiles(_GUARD_TIMED,
+                               f"profile timed region ({what})")
+
+
+def timed(fn, args, k_hi=12, k_lo=4, chain=None, what="stage"):
     """Two-point timing of k chained calls; `chain` picks the carried
-    output (defaults to the first return). Returns seconds per call."""
+    output (defaults to the first return). Returns seconds per call.
+    The timed runs (never the warmup) hold under the recompile guard."""
     def run(k):
         a = args
         out = None
@@ -67,8 +89,9 @@ def timed(fn, args, k_hi=12, k_lo=4, chain=None):
         return time.perf_counter() - t0
 
     run(2)  # compile + warm
-    t_lo = run(k_lo)
-    t_hi = run(k_hi)
+    with _timed_guard(what):
+        t_lo = run(k_lo)
+        t_hi = run(k_hi)
     return (t_hi - t_lo) / (k_hi - k_lo)
 
 
@@ -81,10 +104,19 @@ def measure_dispatch_latency() -> float:
     kernel (attention x n_layers was exactly that trap)."""
     x = jnp.ones((8, 128), jnp.float32)
     noop = jax.jit(lambda x: x + 1.0)
-    return timed(noop, (x,), k_hi=24, k_lo=8)
+    return timed(noop, (x,), k_hi=24, k_lo=8, what="dispatch noop")
 
 
 def main() -> int:
+    global _GUARD_TIMED
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-guard-recompiles", action="store_true",
+                    help="drop the zero-compile guard around timed "
+                         "regions (default: a mid-measurement recompile "
+                         "fails the profile instead of banking compile "
+                         "stalls as device time)")
+    args = ap.parse_args()
+    _GUARD_TIMED = not args.no_guard_recompiles
     dev = jax.devices()[0]
     print(f"[profile] device: {dev.device_kind}", file=sys.stderr)
     mesh = make_device_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
@@ -132,17 +164,19 @@ def main() -> int:
     # loss output chains nothing, so rely on the readback per k-block;
     # each call is independent but the single device stream serializes)
     fwd_fn = jax.jit(loss_fn)
-    t_fwd = timed(fwd_fn, (params, tokens)) - t_disp
+    t_fwd = timed(fwd_fn, (params, tokens), what="fwd") - t_disp
     emit("profile_fwd_ms", t_fwd * 1e3, "ms",
          "forward loss only (dispatch-corrected)")
 
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))
-    t_grad = timed(grad_fn, (params, tokens)) - t_disp
+    t_grad = timed(grad_fn, (params, tokens),
+                   what="fwd+bwd") - t_disp
     emit("profile_fwd_bwd_ms", t_grad * 1e3, "ms",
          f"value_and_grad; bwd alone = {1e3 * (t_grad - t_fwd):.1f} ms")
 
     gstep = jax.jit(make_grad_step(cfg, mesh))
-    t_gstep = timed(gstep, (params, tokens, jnp.uint32(0))) - t_disp
+    t_gstep = timed(gstep, (params, tokens, jnp.uint32(0)),
+                    what="grad step") - t_disp
     emit("profile_grad_step_ms", t_gstep * 1e3, "ms",
          f"grad + bucketed sync; sync alone = "
          f"{1e3 * (t_gstep - t_grad):.1f} ms (dp=1: pure bucketize/"
@@ -166,8 +200,9 @@ def main() -> int:
         return time.perf_counter() - t0
 
     run_full(2)
-    t_lo_f = run_full(4)
-    t_hi_f = run_full(12)
+    with _timed_guard("full donated step"):
+        t_lo_f = run_full(4)
+        t_hi_f = run_full(12)
     t_full = (t_hi_f - t_lo_f) / 8 - t_disp
     emit("profile_full_step_ms", t_full * 1e3, "ms",
          f"full donated train step (dispatch-corrected); optimizer "
@@ -187,7 +222,8 @@ def main() -> int:
 
     # dispatch-corrected BEFORE the layer multiply: n_layers x the
     # relay constant would otherwise masquerade as kernel time
-    t_attn = timed(jax.jit(attn_fwd_bwd), (q, q, q)) - t_disp
+    t_attn = timed(jax.jit(attn_fwd_bwd), (q, q, q),
+                   what="attention kernel") - t_disp
     attn_total = max(t_attn, 0.0) * N_LAYERS
     emit("profile_attn_kernel_ms", attn_total * 1e3, "ms",
          f"flash fwd+bwd at (b={BATCH}, t={SEQ}, h={h}, d={hd}) x "

@@ -59,7 +59,8 @@ class TestCleanEntrypointsStayClean:
     scalar to a jit boundary fails HERE, not on a chip."""
 
     @pytest.mark.parametrize("target", [
-        "generate", "engine_step", "engine_prefill",
+        "generate", "engine_step", "engine_multi_step",
+        "engine_prefill",
         "collective_fused", "collective_windowed",
         "collective_int8", "collective_bf16",
     ])
@@ -74,7 +75,7 @@ class TestCleanEntrypointsStayClean:
     @pytest.mark.slow
     @pytest.mark.parametrize("target", [
         "train_step", "train_step_windowed", "train_step_int8",
-        "train_step_bf16",
+        "train_step_bf16", "train_step_pp", "train_step_moe",
     ])
     def test_train_entrypoints_lint_clean(self, target):
         from akka_allreduce_tpu.analysis.entrypoints import ENTRYPOINTS
@@ -83,6 +84,23 @@ class TestCleanEntrypointsStayClean:
                                                         "warning")]
         assert not gating, [f"[{f.pass_name}] {f.message}"
                             for f in gating]
+
+    def test_engine_multi_step_donates_and_scans(self):
+        """The fused block-decode program's structural claims: the
+        donated engine state survives lowering (in-place caches across
+        the whole block) and the S steps really are ONE scan in ONE
+        program, not S dispatches."""
+        from akka_allreduce_tpu.analysis.entrypoints import (
+            build_engine_multi_step)
+        ctx = build_engine_multi_step()
+        declared = sum(ctx.donated)
+        assert declared >= 3  # k, v, logits at minimum
+        markers = (ctx.stablehlo.count("jax.buffer_donor")
+                   + ctx.stablehlo.count("tf.aliasing_output"))
+        assert markers >= declared, (declared, markers)
+        scans = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
+                    if eqn.primitive.name == "scan")
+        assert scans >= 1
 
     def test_train_step_donates_and_pairs(self):
         """The flagship claims, asserted structurally (not just "no

@@ -1,0 +1,299 @@
+"""Multi-step block decode (ISSUE 4 tentpole): fusing S decode steps
+into one dispatch must be invisible in the tokens.
+
+THE acceptance property: for greedy decode, the block engine
+(``EngineConfig.decode_steps = S``) emits BITWISE the tokens of the S=1
+engine and of standalone ``generate()`` — across slot churn/refill,
+mixed finish reasons (eos / stop / max_tokens) landing mid-block, GQA/
+rope/swiglu model families, and the int8 KV cache. Everything S buys
+(one dispatch + one readback per S tokens, on-device done-mask
+latching) and everything it costs (wasted trailing tokens) must be
+unobservable in the output and EXACTLY accounted in the metrics.
+
+Configs deliberately mirror tests/test_serving_engine.py's DENSE/LLAMA
+so the parity halves share compiled programs; the no-recompile tests
+use their own unique shapes (cold module-level jit caches regardless of
+test order, same discipline as TestNoRecompileContract there).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.generate import generate
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from akka_allreduce_tpu.serving import (
+    EngineConfig,
+    Request,
+    RequestScheduler,
+    SchedulerConfig,
+    ServingEngine,
+    ServingMetrics,
+    serve_loop,
+)
+
+DENSE = TransformerConfig(vocab_size=97, d_model=64, n_heads=4,
+                          n_layers=2, d_ff=128, max_seq=32)
+LLAMA = TransformerConfig(vocab_size=61, d_model=64, n_heads=4,
+                          n_kv_heads=2, n_layers=2, d_ff=128, max_seq=32,
+                          rope=True, ffn="swiglu")
+
+
+def make_requests(cfg, n, steps, seed, plens=(3, 5), eos_every=0,
+                  budgets=None):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for rid in range(n):
+        plen = plens[rid % len(plens)]
+        reqs.append(Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in rng.integers(
+                0, cfg.vocab_size, size=plen)),
+            max_new_tokens=(budgets[rid % len(budgets)] if budgets
+                            else steps),
+            eos_token=(3 if eos_every and rid % eos_every == 0
+                       else None),
+            submitted_at=0.0))
+    return reqs
+
+
+def run_engine(params, cfg, reqs, slots, decode_steps=1, metrics=None,
+               **ecfg_kw):
+    engine = ServingEngine(params, cfg,
+                           EngineConfig(num_slots=slots,
+                                        decode_steps=decode_steps,
+                                        **ecfg_kw))
+    sched = RequestScheduler(SchedulerConfig(max_queue_depth=len(reqs)),
+                             num_slots=slots)
+    for r in reqs:
+        sched.submit(r)
+    return (serve_loop(engine, sched, metrics=metrics,
+                       max_dispatches=2000), engine)
+
+
+def reference(params, cfg, req, kv_dtype=None):
+    prompt = jnp.asarray(req.prompt, jnp.int32)[None]
+    if req.eos_token is None:
+        return np.asarray(generate(params, prompt, cfg,
+                                   steps=req.max_new_tokens,
+                                   kv_dtype=kv_dtype))[0]
+    toks, lengths = generate(params, prompt, cfg,
+                             steps=req.max_new_tokens,
+                             eos_token=req.eos_token, kv_dtype=kv_dtype)
+    return np.asarray(toks)[0][:int(lengths[0])]
+
+
+class TestBlockParity:
+    """Block tokens == single-step tokens == generate() tokens."""
+
+    @pytest.mark.parametrize("s_steps", [2, 4])
+    def test_dense_churn_eos_across_s(self, s_steps):
+        """More requests than slots + staggered EOS finishes: lanes
+        churn through several occupants, finishes land mid-block, and
+        every request's stream is bitwise generate()'s."""
+        params = init_transformer(jax.random.key(0), DENSE)
+        reqs = make_requests(DENSE, 9, steps=7, seed=23, eos_every=2)
+        single, _ = run_engine(params, DENSE, reqs, slots=4)
+        block, engine = run_engine(params, DENSE, reqs, slots=4,
+                                   decode_steps=s_steps)
+        for req in reqs:
+            want = reference(params, DENSE, req)
+            np.testing.assert_array_equal(
+                np.asarray(block[req.rid][0], np.int32), want,
+                err_msg=f"rid={req.rid} vs generate()")
+            assert list(block[req.rid][0]) == list(single[req.rid][0])
+            assert block[req.rid][1] == single[req.rid][1]
+        assert engine.prefill_dispatches == 9  # churn actually happened
+        # the block engine paid fewer dispatches for the same tokens
+        assert engine.decode_dispatches < sum(
+            len(t) for t, _ in block.values())
+
+    def test_mixed_finish_reasons_mid_block(self):
+        """eos / stop / max_tokens all landing mid-block (budgets and
+        stop positions chosen off the block grid) report the same
+        reason and tokens as the S=1 engine."""
+        params = init_transformer(jax.random.key(0), DENSE)
+        base_reqs = make_requests(DENSE, 4, steps=6, seed=11)
+        base, _ = run_engine(params, DENSE, base_reqs, slots=2)
+        # stop each request on its own second greedy token (mid-block
+        # for S=4), plus an eos request and ragged max_tokens budgets
+        reqs = [
+            Request(rid=r.rid, prompt=r.prompt, max_new_tokens=6,
+                    stop_tokens=(int(np.asarray(base[r.rid][0])[1]),),
+                    submitted_at=0.0)
+            for r in base_reqs[:2]
+        ] + [
+            Request(rid=2, prompt=base_reqs[2].prompt, max_new_tokens=5,
+                    submitted_at=0.0),
+            Request(rid=3, prompt=base_reqs[3].prompt, max_new_tokens=7,
+                    eos_token=3, submitted_at=0.0),
+        ]
+        single, _ = run_engine(params, DENSE, reqs, slots=2)
+        block, engine = run_engine(params, DENSE, reqs, slots=2,
+                                   decode_steps=4)
+        for r in reqs:
+            assert list(block[r.rid][0]) == list(single[r.rid][0]), r.rid
+            assert block[r.rid][1] == single[r.rid][1], r.rid
+        assert {reason for _, reason in block.values()} >= {"stop",
+                                                            "max_tokens"}
+        assert engine.wasted_tokens > 0  # something really died mid-block
+
+    def test_llama_family_block_decode(self):
+        """GQA + rope + swiglu exercise every decode-math branch the
+        masked multi-step core mirrors."""
+        params = init_transformer(jax.random.key(2), LLAMA)
+        reqs = make_requests(LLAMA, 6, steps=6, seed=37)
+        results, _ = run_engine(params, LLAMA, reqs, slots=3,
+                                decode_steps=4)
+        for req in reqs:
+            np.testing.assert_array_equal(
+                np.asarray(results[req.rid][0], np.int32),
+                reference(params, LLAMA, req))
+
+    def test_int8_kv_block_matches_int8_generate(self):
+        """The quantized cache's masked write path: block int8 tokens
+        equal generate(kv_dtype='int8') bitwise."""
+        params = init_transformer(jax.random.key(0), DENSE)
+        reqs = make_requests(DENSE, 4, steps=6, seed=51)
+        results, engine = run_engine(params, DENSE, reqs, slots=2,
+                                     decode_steps=2, kv_dtype="int8")
+        for req in reqs:
+            np.testing.assert_array_equal(
+                np.asarray(results[req.rid][0], np.int32),
+                reference(params, DENSE, req, kv_dtype="int8"))
+        assert engine._state["k"].dtype == jnp.int8
+
+    def test_stop_token_width_validation(self):
+        params = init_transformer(jax.random.key(0), DENSE)
+        engine = ServingEngine(
+            params, DENSE, EngineConfig(num_slots=1, decode_steps=2,
+                                        max_stop_tokens=2))
+        with pytest.raises(ValueError, match="max_stop_tokens"):
+            engine.admit(Request(rid=0, prompt=(1, 2),
+                                 max_new_tokens=4,
+                                 stop_tokens=(1, 2, 3),
+                                 submitted_at=0.0))
+
+
+class TestWastedAccounting:
+    """wasted = block steps computed after the lane's done-mask
+    latched; exact, not approximate."""
+
+    def test_exact_wasted_counts(self):
+        """No churn (slots == requests), budgets straddling the block
+        grid: a lane with budget b admitted at a block boundary wastes
+        S-1 - (b-1) % S steps in its final block."""
+        params = init_transformer(jax.random.key(0), DENSE)
+        s_steps = 4
+        budgets = (5, 6, 7, 8)
+        reqs = make_requests(DENSE, 4, steps=0, seed=11,
+                             budgets=budgets)
+        metrics = ServingMetrics()
+        results, engine = run_engine(params, DENSE, reqs, slots=4,
+                                     decode_steps=s_steps,
+                                     metrics=metrics)
+        want = sum(s_steps - 1 - (b - 1) % s_steps for b in budgets)
+        assert engine.wasted_tokens == want == 6
+        assert metrics.wasted_tokens == want
+        assert metrics.wasted_per_completion.count == 4
+        assert metrics.decode_tokens == sum(budgets)
+        summary = metrics.summary()
+        assert summary["tokens"]["wasted"] == want
+        assert summary["wasted_token_rate"] == pytest.approx(
+            want / (want + sum(budgets)), abs=1e-4)
+        for r in reqs:
+            assert len(results[r.rid][0]) == r.max_new_tokens
+
+    def test_single_step_never_wastes(self):
+        params = init_transformer(jax.random.key(0), DENSE)
+        reqs = make_requests(DENSE, 4, steps=6, seed=11)
+        metrics = ServingMetrics()
+        _, engine = run_engine(params, DENSE, reqs, slots=2,
+                               metrics=metrics)
+        assert engine.wasted_tokens == 0
+        assert metrics.wasted_tokens == 0
+        assert metrics.summary()["wasted_token_rate"] == 0.0
+
+
+class TestBlockMetrics:
+    """TTFT/TPOT under block emission: TTFT is the first block's
+    delivery time; TPOT only measures tokens that arrived after it."""
+
+    def test_tpot_excludes_first_block(self):
+        params = init_transformer(jax.random.key(0), DENSE)
+        s_steps = 4
+        # one request fits entirely in its first block (no cadence
+        # sample possible), one spans three blocks
+        reqs = make_requests(DENSE, 2, steps=0, seed=11,
+                             budgets=(3, 9))
+        metrics = ServingMetrics()
+        results, _ = run_engine(params, DENSE, reqs, slots=2,
+                                decode_steps=s_steps, metrics=metrics)
+        assert metrics.ttft_s.count == 2
+        assert metrics.tpot_s.count == 1  # only the 9-token request
+        assert len(results[0][0]) == 3 and len(results[1][0]) == 9
+
+    def test_s1_metrics_unchanged(self):
+        """The n=1 delegation keeps the S=1 engine's metrics exactly as
+        before the block path existed."""
+        params = init_transformer(jax.random.key(0), DENSE)
+        reqs = make_requests(DENSE, 3, steps=6, seed=11)
+        metrics = ServingMetrics()
+        results, engine = run_engine(params, DENSE, reqs, slots=2,
+                                     metrics=metrics)
+        assert metrics.ttft_s.count == 3
+        assert metrics.tpot_s.count == 3  # steps > 1 for every request
+        assert metrics.decode_tokens == sum(
+            len(t) for t, _ in results.values())
+
+
+class TestMultiStepNoRecompile:
+    """The no-recompile contract at S > 1: warmup compiles exactly ONE
+    block program per distinct S (plus the per-length prefills), and
+    churn/refill at warmed shapes compiles NOTHING.
+
+    Unique model shapes so the module-level jit caches are cold
+    regardless of which tests ran earlier in the process."""
+
+    COLD = TransformerConfig(vocab_size=101, d_model=48, n_heads=4,
+                             n_layers=2, d_ff=96, max_seq=32)
+
+    def _run(self, params, n_requests, s_steps):
+        reqs = make_requests(self.COLD, n_requests, steps=5, seed=7)
+        return run_engine(params, self.COLD, reqs, slots=2,
+                          decode_steps=s_steps)
+
+    def test_one_program_per_s_and_churn_compiles_nothing(self):
+        from akka_allreduce_tpu.analysis.recompile import (CompileLog,
+                                                           no_recompiles)
+        params = init_transformer(jax.random.key(5), self.COLD)
+        with CompileLog() as warm:
+            results, engine = self._run(params, 4, s_steps=4)
+        assert len(results) == 4
+        engine_programs = [n for n in warm.compiled if "engine" in n]
+        # one block program + one prefill per distinct prompt length
+        # (make_requests plens=(3, 5)); the S=1 _engine_step is never
+        # built — the block engine does not touch it
+        assert sorted(engine_programs) == [
+            "_engine_multi_step", "_engine_prefill", "_engine_prefill"], \
+            warm.compiled
+        # churn + refill at warmed shapes: a FRESH engine over more
+        # requests than slots — zero new programs, by contract
+        with no_recompiles("S=4 churn/refill"):
+            results, engine = self._run(params, 8, s_steps=4)
+        assert len(results) == 8
+        assert engine.prefill_dispatches == 8
+        # a DIFFERENT S is a different static arg: exactly one new
+        # block program, then ITS churn also compiles nothing
+        with CompileLog() as warm2:
+            results, _ = self._run(params, 4, s_steps=2)
+        assert warm2.compiled.count("_engine_multi_step") == 1, \
+            warm2.compiled
+        assert warm2.compiled.count("_engine_prefill") == 0
+        with no_recompiles("S=2 churn at warmed shapes"):
+            results, _ = self._run(params, 8, s_steps=2)
+        assert len(results) == 8
