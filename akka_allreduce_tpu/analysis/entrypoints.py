@@ -31,6 +31,12 @@ engine_multi_step   serving/engine.py _engine_multi_step (S=4 block:
                     latching) — donation + host-sync on the fused
                     decode loop; one program per distinct S
 engine_prefill      serving/engine.py _engine_prefill — ditto
+engine_recovery     the watchdog-recovery dispatch: _engine_step over a
+                    REBUILT engine state (ServingEngine._fresh_state)
+                    — donation must survive on the fresh buffers, no
+                    host sync sneaks into the recovery path, and the
+                    rebuilt avals are asserted identical to warmup's
+                    (the no-recompile half of the recovery contract)
 collective_fused    two_phase_allreduce under shard_map — reduction-
                     axis discipline + pairing
 collective_windowed pipelined_two_phase_allreduce (W=2) — pairing
@@ -249,6 +255,52 @@ def build_engine_prefill() -> LintContext:
         policy, donate_argnums=(1,), static_argnums=(5, 6))
 
 
+def build_engine_recovery() -> LintContext:
+    """The watchdog-recovery dispatch (ISSUE 5): after a hung or failed
+    dispatch the engine rebuilds its device state
+    (``ServingEngine._fresh_state``) and re-dispatches the SAME step.
+    Built from a real engine so the rebuilt buffers are the production
+    ones, with the no-recompile half of the contract asserted right
+    here at trace time: every rebuilt aval must equal the warmup aval
+    (same shape, same dtype), or the 'warmed programs reused' recovery
+    story is a recompile stall in disguise. The donation and host-sync
+    passes then run over the recovery dispatch like any hot entry."""
+    import jax
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.models.transformer import init_transformer
+    from akka_allreduce_tpu.serving.engine import (EngineConfig,
+                                                   ServingEngine,
+                                                   _engine_step)
+    cfg = _model_cfg()
+    params = init_transformer(jax.random.key(0), cfg)
+    engine = ServingEngine(params, cfg, EngineConfig(num_slots=2))
+    rebuilt = engine._fresh_state()
+    pos = jnp.zeros((2,), jnp.int32)
+    # the real no-recompile claim: the state a DISPATCH hands back (the
+    # steady-state avals every later dispatch consumes) must equal the
+    # rebuilt state's avals — eval_shape reads the output structure
+    # without executing, so a future _engine_step that adds/renames a
+    # state leaf or shifts a dtype fails HERE, not as a production
+    # recompile stall after the first watchdog trip
+    steady, _packed = jax.eval_shape(
+        lambda p, s, q: _engine_step(p, s, q, cfg),
+        params, rebuilt, pos)
+    mismatch = [
+        n for n in set(steady) | set(rebuilt)
+        if (n not in steady or n not in rebuilt
+            or steady[n].shape != rebuilt[n].shape
+            or steady[n].dtype != rebuilt[n].dtype)]
+    if mismatch:
+        raise RuntimeError(
+            f"engine_recovery: rebuilt state avals diverge from the "
+            f"dispatch output's at {sorted(mismatch)} — recovery would "
+            f"recompile")
+    policy = LintPolicy(expect_donation=True, hot=True)
+    return trace_entry("engine_recovery", _engine_step,
+                       (params, rebuilt, pos, cfg), policy,
+                       donate_argnums=(1,), static_argnums=(3,))
+
+
 # -- standalone collectives ---------------------------------------------
 
 def _collective_policy(mesh, **kw) -> LintPolicy:
@@ -348,6 +400,7 @@ ENTRYPOINTS = {
     "engine_step": build_engine_step,
     "engine_multi_step": build_engine_multi_step,
     "engine_prefill": build_engine_prefill,
+    "engine_recovery": build_engine_recovery,
     "collective_fused": build_collective_fused,
     "collective_windowed": build_collective_windowed,
     "collective_int8": build_collective_int8,

@@ -33,8 +33,11 @@ Run as ``python -m akka_allreduce_tpu.cli <subcommand> [flags]``.
 from __future__ import annotations
 
 import argparse
+import contextlib
+import dataclasses
 import json
 import os
+import signal
 import sys
 import time
 
@@ -1825,6 +1828,43 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "1.0 reconstructs the full-batch barrier "
                         "(A/B baseline). The gate only ever waits for "
                         "work that is actually due")
+    # -- fault tolerance
+    p.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   metavar="S",
+                   help="bound the blocking decode readback: a dispatch "
+                        "not back in S seconds trips the watchdog — "
+                        "in-flight requests fail into the retry budget "
+                        "and the engine rebuilds its state on warmed "
+                        "programs instead of wedging. 0 (default) = "
+                        "dispatch inline, no watchdog")
+    p.add_argument("--max-attempts", type=int, default=3,
+                   help="total attempt budget per request: an engine-"
+                        "failed request (watchdog/fault/NaN) retries "
+                        "with exponential backoff until this many "
+                        "attempts have failed, then dead-letters with "
+                        "a terminal status")
+    p.add_argument("--retry-base-delay", type=float, default=0.05,
+                   help="backoff base: the k-th failure requeues after "
+                        "base * 2^(k-1) (+ jitter) seconds")
+    p.add_argument("--retry-jitter", type=float, default=0.0,
+                   help="uniform [0, J) seconds added to each backoff "
+                        "(seeded — deterministic per --seed)")
+    p.add_argument("--tpot-estimate", type=float, default=0.0,
+                   help="with --policy deadline: seconds-per-token "
+                        "estimate arming admission-time feasibility "
+                        "shedding — a request whose deadline cannot fit "
+                        "one more token is rejected_infeasible instead "
+                        "of admitted into a guaranteed eviction. 0 = "
+                        "disabled")
+    p.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                   help="with --selfcheck: run the fault-matrix smoke — "
+                        "a seeded FaultPlan injects a hang, a dispatch "
+                        "exception, a NaN-poisoned lane, and a "
+                        "preemption into one serve run; asserts clean "
+                        "survival, bitwise token parity vs the fault-"
+                        "free run, exact retry accounting, drain/"
+                        "restore parity, and zero post-recovery "
+                        "compiles")
     # -- synthetic load
     p.add_argument("--requests", type=int, default=32)
     p.add_argument("--load", choices=("closed", "open"), default="closed",
@@ -1963,6 +2003,146 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     return 0 if not failures else 1
 
 
+def _serve_chaos_selfcheck(args: argparse.Namespace) -> int:
+    """`serve --selfcheck --chaos SEED`: the ISSUE 5 acceptance run.
+    One seeded FaultPlan injects a dispatch hang, a dispatch exception,
+    a NaN-poisoned lane, and a preemption into a single serve run over
+    a tiny model. Asserted, not hoped: the process exits cleanly, every
+    request's tokens land bitwise identical to the fault-free run
+    (faulted ones via retry or drain/restore), the retry ledger
+    reconciles exactly, the injected/survived fault pair balances, and
+    a post-recovery churn run compiles ZERO programs."""
+    import jax
+    import numpy as np
+
+    from akka_allreduce_tpu.analysis.recompile import (CompileLog,
+                                                       RecompileError,
+                                                       no_recompiles)
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.runtime.faults import FaultPlan
+    from akka_allreduce_tpu.serving import (EngineConfig, Request,
+                                            RequestScheduler, RetryPolicy,
+                                            SchedulerConfig, ServingEngine,
+                                            ServingMetrics, serve_loop)
+
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=48)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(11)
+    eos = 5
+    slots = 3
+
+    def make_requests():
+        # fresh objects each run: requests are mutated in flight
+        # (attempts, arrival) and runs must not share that state
+        r = np.random.default_rng(11)
+        return [Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in r.integers(
+                0, cfg.vocab_size, size=int(r.integers(2, 6)))),
+            max_new_tokens=8,
+            eos_token=eos if rid % 2 else None,
+            submitted_at=0.0) for rid in range(10)]
+
+    del rng
+    s_steps = args.decode_steps
+    # the fault-free baseline warms every program WITHOUT the watchdog:
+    # first-dispatch XLA compiles dwarf any sane readback bound, and a
+    # watchdog that trips on warmup would be testing compile latency,
+    # not fault recovery (the production rule rides in OPERATIONS.md:
+    # warm before you arm)
+    ecfg_warm = EngineConfig(num_slots=slots, decode_steps=s_steps)
+    ecfg = dataclasses.replace(ecfg_warm, watchdog_timeout_s=0.15)
+    scfg = SchedulerConfig(
+        policy=args.policy,
+        retry=RetryPolicy(max_attempts=4, base_delay=0.0))
+
+    def run(metrics=None, plan=None, engine_cfg=None):
+        engine = ServingEngine(params, cfg, engine_cfg or ecfg)
+        sched = RequestScheduler(scfg, num_slots=slots)
+        for r in make_requests():
+            sched.submit(r)
+        ctx = (plan.armed() if plan is not None
+               else contextlib.nullcontext())
+        with ctx:
+            results = serve_loop(engine, sched, metrics=metrics,
+                                 max_dispatches=1000)
+            # a preemption drains the loop; restore the snapshots into
+            # a FRESH engine (the drained one's device state is dead
+            # with the "preempted" process) and finish the queue
+            while engine.drained or sched.unfinished:
+                fresh = ServingEngine(params, cfg,
+                                      engine_cfg or ecfg)
+                for rr in engine.drained:
+                    sched.bind(rr.req, fresh.restore(rr))
+                results.update(serve_loop(fresh, sched, metrics=metrics,
+                                          max_dispatches=1000))
+                engine = fresh
+        return results, engine
+
+    # fault-free: the parity truth + program warmup (no watchdog)
+    baseline, _ = run(engine_cfg=ecfg_warm)
+    plan = FaultPlan.chaos(args.chaos, slots=slots)
+    metrics = ServingMetrics()
+    for r in make_requests():
+        metrics.on_submit(r.rid)
+    chaos_results, _ = run(metrics=metrics, plan=plan)
+    metrics.on_fault_injected(len(plan.fired))
+
+    failures = []
+    kinds = {k for _site, k, _hit in plan.fired}
+    if not {"hang", "raise", "nan", "preempt"} <= kinds:
+        failures.append(f"not every fault fired: {sorted(plan.fired)}")
+    for rid, (toks, reason) in baseline.items():
+        got = chaos_results.get(rid)
+        if got is None:
+            failures.append(f"rid={rid} missing from chaos run")
+        elif list(got[0]) != list(toks) or got[1] != reason:
+            failures.append(
+                f"rid={rid}: chaos ({got[1]}) {list(got[0])} != "
+                f"fault-free ({reason}) {list(toks)}")
+    if metrics.watchdog_trips_total != 1:
+        failures.append(f"watchdog_trips_total="
+                        f"{metrics.watchdog_trips_total}, want 1")
+    # ledger: every failed attempt was either requeued or dead-lettered
+    if metrics.retries_total + metrics.dead_letter_total \
+            != metrics.requests_failed:
+        failures.append(
+            f"retry ledger off: {metrics.retries_total} retries + "
+            f"{metrics.dead_letter_total} dead letters != "
+            f"{metrics.requests_failed} failed attempts")
+    if metrics.fault_survived != metrics.fault_injected:
+        failures.append(
+            f"fault pair off: injected {metrics.fault_injected} != "
+            f"survived {metrics.fault_survived}")
+    # post-recovery churn (same shapes, fresh engines) compiles NOTHING
+    churn_ok = True
+    try:
+        with no_recompiles("post-chaos churn (warmed shapes)"):
+            again, _ = run()
+    except RecompileError as exc:
+        failures.append(str(exc))
+        churn_ok, again = False, {}
+    for rid, out in again.items():
+        if list(out[0]) != list(baseline[rid][0]):
+            failures.append(f"rid={rid}: post-chaos churn diverged")
+    print(json.dumps({
+        "selfcheck": "ok" if not failures else "FAIL",
+        "chaos_seed": args.chaos,
+        "decode_steps": s_steps,
+        "policy": args.policy,
+        "faults_fired": [list(f) for f in plan.fired],
+        "watchdog_trips": metrics.watchdog_trips_total,
+        "retries": metrics.retries_total,
+        "dead_letters": metrics.dead_letter_total,
+        "discarded_to_wasted": metrics.wasted_tokens,
+        "churn_recompiles": 0 if churn_ok else None,
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     _apply_backend_flags(args)
     # validated BEFORE the selfcheck dispatch: a typo'd S must exit 2,
@@ -1971,7 +2151,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print(f"error: --decode-steps must be >= 1, got "
               f"{args.decode_steps}", file=sys.stderr)
         return 2
+    if args.watchdog_timeout < 0:
+        print(f"error: --watchdog-timeout must be >= 0 (0 disables), "
+              f"got {args.watchdog_timeout}", file=sys.stderr)
+        return 2
+    if args.chaos is not None and not args.selfcheck:
+        print("error: --chaos requires --selfcheck (the fault-matrix "
+              "smoke)", file=sys.stderr)
+        return 2
     if args.selfcheck:
+        if args.chaos is not None:
+            return _serve_chaos_selfcheck(args)
         return _serve_selfcheck(args)
     import jax
     import numpy as np
@@ -1979,7 +2169,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     from akka_allreduce_tpu.runtime.tracing import tracer_to_file
     from akka_allreduce_tpu.serving import (EngineConfig, QueueFull,
                                             Request, RequestScheduler,
-                                            SchedulerConfig, ServingEngine,
+                                            RetryPolicy, SchedulerConfig,
+                                            ServingEngine,
                                             ServingMetrics, serve_loop)
 
     try:
@@ -2066,12 +2257,19 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     num_slots=args.slots, prefill_buckets=buckets,
                     kv_dtype="int8" if args.kv_cache == "int8"
                     else None,
-                    decode_steps=args.decode_steps),
+                    decode_steps=args.decode_steps,
+                    watchdog_timeout_s=args.watchdog_timeout or None),
                 tracer=tracer)
             sched = RequestScheduler(
                 SchedulerConfig(max_queue_depth=args.queue_depth,
                                 policy=args.policy,
-                                th_step=args.th_step),
+                                th_step=args.th_step,
+                                retry=RetryPolicy(
+                                    max_attempts=args.max_attempts,
+                                    base_delay=args.retry_base_delay,
+                                    jitter=args.retry_jitter),
+                                tpot_estimate=args.tpot_estimate,
+                                seed=args.seed),
                 num_slots=args.slots,
                 # open-loop overload: a request ARRIVING to a full
                 # queue is shed at the edge — the rejection count is
@@ -2088,9 +2286,20 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 sched.submit(r)
             except QueueFull:
                 pass  # counted via on_reject
+        # a real preemption (SIGTERM) drains instead of killing the
+        # in-flight requests: admission stops, snapshots land on
+        # engine.drained, and the report says how many wait for a
+        # restore — the operator runbook is OPERATIONS.md "Preemption
+        # drain"
+        prev_term = signal.signal(
+            signal.SIGTERM, lambda *_: engine.request_drain())
         from akka_allreduce_tpu.analysis.recompile import CompileLog
-        with metrics.host_sampler() as sampler, CompileLog() as compiles:
-            results = serve_loop(engine, sched, metrics=metrics)
+        try:
+            with metrics.host_sampler() as sampler, \
+                    CompileLog() as compiles:
+                results = serve_loop(engine, sched, metrics=metrics)
+        finally:
+            signal.signal(signal.SIGTERM, prev_term)
     report = {
         "config": {"slots": args.slots, "requests": args.requests,
                    "load": args.load, "policy": args.policy,
@@ -2102,6 +2311,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             reason: sum(1 for toks, r in results.values()
                         if r == reason)
             for reason in {r for _, r in results.values()}},
+        # in-flight snapshots left by a SIGTERM drain (tokens already
+        # generated ride along; a fresh engine restores them with
+        # bitwise parity) + the terminal dead-letter triage list
+        "drained": len(engine.drained),
+        "dead_letter": [
+            {"rid": req.rid, "attempts": req.attempts, "reason": rsn}
+            for req, rsn in sched.dead_letter],
+        "watchdog_trips": engine.watchdog_trips,
+        "evictions": engine.evictions,
         "prefill_dispatches": engine.prefill_dispatches,
         "prefill_programs": len(engine.prefill_shapes),
         # total programs XLA built during the run (analysis/recompile.py

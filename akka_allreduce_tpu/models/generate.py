@@ -328,13 +328,26 @@ def multi_step_decode(params: dict, kv: dict, logits: jnp.ndarray,
     pos, write_mask)`` is one masked decode step returning ``(kv,
     logits)`` — the engine passes its per-slot-position step.
 
-    Returns ``((kv, logits, pos, done, remaining), tokens)`` with
+    The finite-output guard rides the same scan: before each step's
+    argmax, a lane whose carried logits contain a non-finite value
+    (NaN-poisoned decode, an overflowed matmul) latches ``bad`` AND
+    ``done`` — the poisoned lane freezes exactly like a finished one
+    (no KV writes, no pos advance, so the poison is contained to its
+    own row) and the flag folds into the caller's packed readback with
+    no extra host round-trip. Healthy lanes see one ``isfinite``
+    reduction per step and bitwise-unchanged tokens.
+
+    Returns ``((kv, logits, pos, done, remaining, bad), tokens)`` with
     ``tokens`` of shape ``(steps, lanes)``; entries after a lane's latch
-    are garbage the caller must not consume.
+    are garbage the caller must not consume, and a ``bad`` lane's whole
+    block is garbage (the poison may predate any token in it).
     """
 
     def one(carry, _):
-        kv, logits, pos, done, remaining = carry
+        kv, logits, pos, done, remaining, bad = carry
+        poisoned = ~done & ~jnp.isfinite(logits).all(axis=-1)
+        bad = bad | poisoned
+        done = done | poisoned
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         active = ~done
         finished = active & ((tok == eos_ids)
@@ -345,9 +358,10 @@ def multi_step_decode(params: dict, kv: dict, logits: jnp.ndarray,
         done = done | finished
         kv, logits = decode_fn(params, kv, tok, pos, live)
         pos = jnp.where(live, pos + 1, pos)
-        return (kv, logits, pos, done, remaining), tok
+        return (kv, logits, pos, done, remaining, bad), tok
 
-    return lax.scan(one, (kv, logits, pos, done, remaining), None,
+    bad0 = jnp.zeros_like(done)
+    return lax.scan(one, (kv, logits, pos, done, remaining, bad0), None,
                     length=steps)
 
 

@@ -22,12 +22,26 @@ Modules:
 * ``metrics.py`` — TTFT/TPOT/queue-depth/occupancy histograms, wired
   into runtime/tracing.py spans and runtime/metrics.py host sampling.
 
+Failure domains (ISSUE 5 — the paper's "complete the round without the
+missing contribution", pointed at serving): a hung dispatch trips the
+engine watchdog (per-request failures + rebuilt state, never a stuck
+process), a NaN-poisoned decode fails its request through the on-device
+finite guard, an expired deadline evicts mid-flight, failed requests
+retry under the scheduler's budgeted backoff or dead-letter, and a
+preemption drains to :class:`~akka_allreduce_tpu.serving.engine
+.ResumableRequest` snapshots a fresh engine restores with bitwise
+parity. All of it is driven — not hoped for — by the fault-injection
+plane (runtime/faults.py) in tests/test_serving_faults.py and
+``serve --selfcheck --chaos``.
+
 Entry point: ``python -m akka_allreduce_tpu.cli serve`` (cli.py).
 """
 
 from akka_allreduce_tpu.serving.engine import (
     EngineConfig,
+    ResumableRequest,
     ServingEngine,
+    WatchdogTimeout,
     serve_loop,
 )
 from akka_allreduce_tpu.serving.metrics import Histogram, ServingMetrics
@@ -35,17 +49,21 @@ from akka_allreduce_tpu.serving.scheduler import (
     QueueFull,
     Request,
     RequestScheduler,
+    RetryPolicy,
     SchedulerConfig,
 )
 
 __all__ = [
     "EngineConfig",
+    "ResumableRequest",
     "ServingEngine",
+    "WatchdogTimeout",
     "serve_loop",
     "Histogram",
     "ServingMetrics",
     "QueueFull",
     "Request",
     "RequestScheduler",
+    "RetryPolicy",
     "SchedulerConfig",
 ]

@@ -60,12 +60,44 @@ churn/refill runs under the zero-compile guard
 donation that keeps cache updates in place is machine-checked on the
 lowered step by the ``donation`` lint pass (``lint --target
 engine_step``).
+
+Failure story (the paper's "complete the round without the missing
+contribution", pointed at serving — runtime/faults.py is the harness
+that proves each path):
+
+* a dispatch that HANGS no longer wedges the process: with
+  ``watchdog_timeout_s`` set, the blocking readback runs on a guard
+  thread and a trip converts every in-flight request into a per-request
+  failure (the serve loop retries or dead-letters them) plus a REBUILT
+  engine state — fresh KV/slot arrays at the warmup avals, so the
+  already-compiled step/prefill programs are reused and recovery
+  compiles nothing (pinned: tests/test_serving_faults.py, the
+  ``engine_recovery`` lint entry);
+* a dispatch that RAISES (injected or real) takes the same
+  recovery path — the donated inputs of a failed dispatch are garbage
+  either way, and rebuilding is cheaper than reasoning about which;
+* a NaN-poisoned decode fails the poisoned REQUEST, not the engine:
+  both step programs fold a per-lane finite-logits flag into the one
+  packed readback (no extra host round-trip), and the multi-step scan
+  latches a poisoned lane's done-mask on device so the poison never
+  writes KV (models/generate.py ``multi_step_decode``);
+* a request whose ``deadline`` passes mid-flight is EVICTED between
+  dispatches — partial decode charged to wasted tokens, slot refilled
+  the same loop iteration — instead of burning its whole budget;
+* a preemption (synthetic fault or real SIGTERM) DRAINS: admission
+  stops, in-flight requests snapshot as :class:`ResumableRequest`
+  (prompt + generated-so-far), and a fresh engine restores them through
+  prefill with bitwise greedy parity — the cached-decode == full-forward
+  contract (tests/test_generate.py) is exactly what makes the replay
+  exact.
 """
 
 from __future__ import annotations
 
 import bisect
+import concurrent.futures
 import dataclasses
+import time
 from functools import partial
 from typing import Optional
 
@@ -88,7 +120,12 @@ from akka_allreduce_tpu.models.transformer import (
 )
 from akka_allreduce_tpu.parallel.ep import moe_ffn
 from akka_allreduce_tpu.parallel.ring_attention import NEG_INF
+from akka_allreduce_tpu.runtime.faults import InjectedFault, maybe_fail
 from akka_allreduce_tpu.serving.scheduler import Request, RequestScheduler
+
+
+class WatchdogTimeout(RuntimeError):
+    """The blocking device readback exceeded ``watchdog_timeout_s``."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,6 +158,14 @@ class EngineConfig:
     the S>1 program carries (padded with -1); a request with more stop
     tokens than this is rejected at admit when ``decode_steps > 1``
     (the S=1 path checks stops host-side and has no such bound).
+
+    ``watchdog_timeout_s``: bound on the blocking device readback. None
+    (default) dispatches inline — zero overhead; set, every decode
+    dispatch runs on a guard thread and a result not back in time
+    raises :class:`WatchdogTimeout`, which the engine converts into
+    per-request failures plus a rebuilt state instead of a stuck
+    process. Size it at several times the worst healthy step (a block
+    dispatch computes ``decode_steps`` tokens before the readback).
     """
 
     num_slots: int = 4
@@ -128,11 +173,16 @@ class EngineConfig:
     kv_dtype: Optional[str] = None
     decode_steps: int = 1
     max_stop_tokens: int = 4
+    watchdog_timeout_s: Optional[float] = None
 
     def __post_init__(self):
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, "
                              f"got {self.num_slots}")
+        if self.watchdog_timeout_s is not None \
+                and self.watchdog_timeout_s <= 0:
+            raise ValueError(f"watchdog_timeout_s must be > 0, "
+                             f"got {self.watchdog_timeout_s}")
         if self.decode_steps < 1:
             raise ValueError(f"decode_steps must be >= 1, "
                              f"got {self.decode_steps}")
@@ -308,13 +358,21 @@ def _engine_step(params: dict, state: dict, pos: jnp.ndarray,
     slot (free lanes park at 0; their writes land in a region the next
     prefill overwrites wholesale).
 
-    Returns (new state, emitted tokens (slots,)). The state is donated:
-    the caches update in place instead of doubling slot HBM per step.
+    Returns (new state, packed (2, slots) int32): row 0 the emitted
+    tokens, row 1 the finite-output guard — 1 iff the logits the token
+    was picked from were all finite. The flag rides the SAME readback
+    array (a NaN-poisoned lane costs no extra host round-trip to
+    detect; the host fails that request, not the engine). The state is
+    donated: the caches update in place instead of doubling slot HBM
+    per step.
     """
-    tok = jnp.argmax(state["logits"], axis=-1).astype(jnp.int32)
+    logits_in = state["logits"]
+    tok = jnp.argmax(logits_in, axis=-1).astype(jnp.int32)
+    finite = jnp.isfinite(logits_in).all(axis=-1)
     kv = {n: state[n] for n in state if n != "logits"}
     new_kv, logits = _slot_decode_step(params, kv, tok, pos, cfg)
-    return {**new_kv, "logits": logits}, tok
+    packed = jnp.stack([tok, finite.astype(jnp.int32)])
+    return {**new_kv, "logits": logits}, packed
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps"), donate_argnums=(1,))
@@ -334,22 +392,26 @@ def _engine_multi_step(params: dict, state: dict, pos: jnp.ndarray,
     writes, and equally unobservable); ``remaining``/``eos_ids``/
     ``stop_ids`` are the per-slot budgets and finish ids (-1 = none).
 
-    Returns (new state, packed (steps+1, slots) int32, pos, done,
+    Returns (new state, packed (steps+2, slots) int32, pos, done,
     remaining): ``packed`` rows [0, steps) are the token block, row
-    ``steps`` the post-block positions — ONE array so the host pays a
-    single readback per block; the trailing device vectors let the host
-    carry slot state across quiet blocks without host->device uploads.
-    The state is donated, same as ``_engine_step``."""
+    ``steps`` the post-block positions, row ``steps+1`` the per-lane
+    ``bad`` flag (the finite-output guard — a lane whose logits went
+    non-finite during the block; its done-mask latched on device, so
+    the poison wrote no KV) — ONE array so the host pays a single
+    readback per block; the trailing device vectors let the host carry
+    slot state across quiet blocks without host->device uploads. The
+    state is donated, same as ``_engine_step``."""
 
     def decode_fn(p, kv, tok, p_pos, write_mask):
         return _slot_decode_step(p, kv, tok, p_pos, cfg,
                                  write_mask=write_mask)
 
     kv = {n: state[n] for n in state if n != "logits"}
-    (kv, logits, pos, done, remaining), toks = multi_step_decode(
+    (kv, logits, pos, done, remaining, bad), toks = multi_step_decode(
         params, kv, state["logits"], pos, done, remaining,
         eos_ids, stop_ids, steps, decode_fn)
-    packed = jnp.concatenate([toks, pos[None]], axis=0)
+    packed = jnp.concatenate(
+        [toks, pos[None], bad.astype(jnp.int32)[None]], axis=0)
     # pos/done/remaining come back as DEVICE arrays so the host can
     # feed the next block without re-uploading them: between blocks
     # with no admit/free, the device's post-block vectors ARE the
@@ -394,26 +456,40 @@ class _SlotState:
     emitted: list
 
 
+@dataclasses.dataclass(frozen=True)
+class ResumableRequest:
+    """A drained in-flight request: everything a fresh engine needs to
+    continue it with bitwise greedy parity. ``generated`` is the tokens
+    emitted so far; :meth:`ServingEngine.restore` replays
+    ``req.prompt + generated`` through prefill (the cached-decode ==
+    full-forward parity contract makes the replayed logits bitwise the
+    ones the drained engine held) and decodes the remaining budget.
+    ``slot`` is the slot the request held at drain time — the serve
+    loop uses it to release the scheduler's mirror binding."""
+
+    req: Request
+    generated: tuple
+    slot: int
+
+
 class ServingEngine:
     """Slot owner + device-state holder. The scheduler decides WHAT runs
     (serving/scheduler.py); the engine runs it."""
 
     def __init__(self, params: dict, cfg: TransformerConfig,
                  ecfg: EngineConfig = EngineConfig(),
-                 metrics=None, tracer=None):
+                 metrics=None, tracer=None, clock=time.monotonic):
         self.params = params
         self.cfg = cfg
         self.ecfg = ecfg
         self.metrics = metrics
         self.tracer = tracer
+        self.clock = clock
         if ecfg.prefill_buckets and ecfg.prefill_buckets[-1] > cfg.max_seq:
             raise ValueError(
                 f"largest prefill bucket {ecfg.prefill_buckets[-1]} "
                 f"exceeds max_seq {cfg.max_seq}")
-        base = init_kv_cache(cfg, ecfg.num_slots, kv_dtype=ecfg.kv_dtype)
-        del base["pos"]  # per-slot positions live host-side
-        self._state = {**base, "logits": jnp.zeros(
-            (ecfg.num_slots, cfg.vocab_size), cfg.dtype)}
+        self._state = self._fresh_state()
         self._pos = np.zeros((ecfg.num_slots,), np.int32)
         self._slots: list[Optional[_SlotState]] = [None] * ecfg.num_slots
         # per-slot finish vectors for the fused block program (S>1):
@@ -440,6 +516,31 @@ class ServingEngine:
         # distinct (padded length, gather) pairs = compiled prefill
         # programs — the quantity prefill_buckets exists to bound
         self.prefill_shapes: set = set()
+        # -- fault-tolerance bookkeeping --------------------------------
+        self.watchdog_trips = 0
+        self.evictions = 0
+        # tokens decoded for requests later failed/evicted (their whole
+        # partial output is discarded — the retry replays from scratch)
+        self.discarded_tokens = 0
+        self._draining = False
+        self.drained: list[ResumableRequest] = []
+        # guard thread for watchdog'd dispatches, created lazily; a
+        # tripped (still-wedged) worker is abandoned and replaced
+        self._executor: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+
+    def _fresh_state(self) -> dict:
+        """The device state at its warmup avals — used at construction
+        AND after a watchdog/dispatch failure. Same shapes and dtypes
+        both times, so rebuilding re-dispatches into the already-
+        compiled programs (the recovery half of the no-recompile
+        contract; pinned by the ``engine_recovery`` lint entry and
+        tests/test_serving_faults.py)."""
+        base = init_kv_cache(self.cfg, self.ecfg.num_slots,
+                             kv_dtype=self.ecfg.kv_dtype)
+        del base["pos"]  # per-slot positions live host-side
+        return {**base, "logits": jnp.zeros(
+            (self.ecfg.num_slots, self.cfg.vocab_size), self.cfg.dtype)}
 
     # -- slot introspection -------------------------------------------
 
@@ -472,8 +573,16 @@ class ServingEngine:
                 f"{buckets[-1]}")
         return buckets[i]
 
-    def admit(self, req: Request) -> int:
-        """Prefill ``req`` into a free slot; returns the slot index."""
+    def admit(self, req: Request, emitted: tuple = ()) -> int:
+        """Prefill ``req`` into a free slot; returns the slot index.
+
+        ``emitted`` is the drain/restore hook (:meth:`restore`): tokens
+        the request already generated in a previous engine, replayed
+        through prefill as part of the prompt — the cached-decode ==
+        full-forward parity contract makes the replayed logits bitwise
+        the drained engine's, so the continued stream is exact. The
+        decode budget shrinks by ``len(emitted)``; the total sequence
+        footprint (and the max_seq validation) is unchanged."""
         n = len(req.prompt)
         if n < 1:
             raise ValueError(f"request {req.rid}: empty prompt")
@@ -496,34 +605,41 @@ class ServingEngine:
                 f"request {req.rid}: {len(stops)} stop tokens exceed the "
                 f"block program's static width max_stop_tokens="
                 f"{self.ecfg.max_stop_tokens} (raise it in EngineConfig)")
+        if len(emitted) >= req.max_new_tokens:
+            raise ValueError(
+                f"request {req.rid}: restore carries {len(emitted)} "
+                f"generated tokens, >= its budget {req.max_new_tokens}")
         try:
             slot = self._slots.index(None)
         except ValueError:
             raise RuntimeError("no free slot (admit gated on "
                                "free_slot_count)") from None
-        length = self._bucket_len(n)
+        full = tuple(req.prompt) + tuple(emitted)
+        n_full = len(full)
+        length = self._bucket_len(n_full)
         padded = np.zeros((1, length), np.int32)
-        padded[0, :n] = req.prompt
+        padded[0, :n_full] = full
         span = (self.tracer.span("serve_prefill", rid=req.rid, slot=slot,
-                                 prompt_len=n, bucket=length)
+                                 prompt_len=n_full, bucket=length)
                 if self.tracer is not None else _null_span())
         with span:
             self._state = _engine_prefill(
                 self.params, self._state, jnp.asarray(padded),
-                jnp.asarray(n, jnp.int32), jnp.asarray(slot, jnp.int32),
-                self.cfg, gather=length != n)
+                jnp.asarray(n_full, jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                self.cfg, gather=length != n_full)
         self.prefill_dispatches += 1
-        self.prefill_shapes.add((length, length != n))
-        self._pos[slot] = n
+        self.prefill_shapes.add((length, length != n_full))
+        self._pos[slot] = n_full
         self._eos[slot] = -1 if req.eos_token is None else req.eos_token
         self._stops[slot, :] = -1
         for j, t in enumerate(stops[:self.ecfg.max_stop_tokens]):
             self._stops[slot, j] = t
-        self._remaining[slot] = req.max_new_tokens
+        self._remaining[slot] = req.max_new_tokens - len(emitted)
         self._vectors_dirty = True
-        self._slots[slot] = _SlotState(req=req, emitted=[])
+        self._slots[slot] = _SlotState(req=req, emitted=list(emitted))
         if self.metrics is not None:
-            self.metrics.on_admit(req.rid, slot, n)
+            self.metrics.on_admit(req.rid, slot, n_full)
         return slot
 
     # -- decode ---------------------------------------------------------
@@ -548,26 +664,190 @@ class ServingEngine:
         self._remaining[i] = 0
         self._vectors_dirty = True
 
+    # -- failure handling ----------------------------------------------
+
+    def _fail_lane(self, i: int, reason: str) -> tuple:
+        """Fail slot ``i``'s request: its partial decode is discarded
+        (charged to wasted work — a retry replays from scratch) and the
+        slot freed. Returns the ``(slot, req, [], reason)`` completion
+        tuple the serve loop routes to retry/dead-letter."""
+        slot = self._slots[i]
+        n = len(slot.emitted)
+        self.discarded_tokens += n
+        if self.metrics is not None:
+            self.metrics.on_discard(slot.req.rid, n)
+            self.metrics.on_failure(slot.req.rid, reason)
+        self._free_slot(i)
+        return (i, slot.req, [], reason)
+
+    def _recover(self, reason: str) -> list[tuple]:
+        """A dispatch hung past the watchdog or raised: the donated
+        in-flight state is garbage either way. Fail every occupied
+        slot's request (the serve loop retries or dead-letters them)
+        and rebuild the device state at its warmup avals — the warmed
+        step/prefill programs are reused, so recovery compiles nothing
+        and the next loop iteration refills the fresh slots."""
+        failures = [self._fail_lane(i, reason)
+                    for i, s in enumerate(self._slots) if s is not None]
+        self._state = self._fresh_state()
+        self._dev_vectors = None
+        self._vectors_dirty = True
+        if self.metrics is not None:
+            self.metrics.on_fault_survived(reason)
+        if self.tracer is not None:
+            self.tracer.record("serve_recover", reason=reason,
+                               failed=len(failures))
+        return failures
+
+    def _guarded_dispatch(self, fn):
+        """Run one dispatch+readback, under the watchdog when armed.
+        The fault site ``engine.dispatch`` lives INSIDE the guarded
+        callable so an injected hang stalls exactly what a wedged
+        readback would stall. A tripped worker is abandoned (its late
+        result — and the stale buffers the dispatch donated — are
+        dropped on the floor; the rebuild owns fresh arrays) and the
+        executor replaced so the next dispatch gets a live thread."""
+        wd = self.ecfg.watchdog_timeout_s
+        if wd is None:
+            maybe_fail("engine.dispatch")
+            return fn()
+
+        def guarded():
+            maybe_fail("engine.dispatch")
+            return fn()
+
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="engine-dispatch")
+        fut = self._executor.submit(guarded)
+        try:
+            return fut.result(timeout=wd)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            self._executor.shutdown(wait=False)
+            self._executor = None
+            raise WatchdogTimeout(
+                f"decode dispatch exceeded watchdog_timeout_s={wd}"
+            ) from None
+
+    def _maybe_poison(self) -> None:
+        """The ``nan`` fault hook: overwrite the scheduled lane's
+        carried logits with NaN before the dispatch — the injected
+        version of a numerically-poisoned decode, which the on-device
+        finite guard must catch and contain."""
+        pt = maybe_fail("engine.logits")
+        if pt is None or pt.kind != "nan":
+            return
+        logits = self._state["logits"]
+        if pt.slot is None:
+            poisoned = jnp.full_like(logits, jnp.nan)
+        else:
+            poisoned = logits.at[pt.slot].set(jnp.nan)
+        self._state = {**self._state, "logits": poisoned}
+
+    def _evict_expired(self, finished: list) -> None:
+        """Mid-flight deadline enforcement: between dispatches, a still-
+        running request whose absolute ``deadline`` has passed is
+        evicted — partial decode charged to wasted work, slot freed for
+        the same-iteration refill — instead of burning the rest of its
+        token budget on an answer nobody is waiting for."""
+        now = self.clock()
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            req = slot.req
+            if req.deadline is not None and now > req.deadline:
+                self.evictions += 1
+                n = len(slot.emitted)
+                self.discarded_tokens += n
+                if self.metrics is not None:
+                    self.metrics.on_discard(req.rid, n)
+                    self.metrics.on_evict(req.rid, n)
+                finished.append((i, req, [], "evicted"))
+                self._free_slot(i)
+
+    # -- drain / restore (preemption) ----------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def request_drain(self) -> None:
+        """Preemption signal (synthetic fault or SIGTERM handler): the
+        serve loop stops admitting and calls :meth:`drain`."""
+        self._draining = True
+
+    def drain(self) -> list[ResumableRequest]:
+        """Snapshot every in-flight request as a
+        :class:`ResumableRequest` (prompt + generated-so-far) and free
+        its slot. Pure host bookkeeping — the device state is abandoned
+        with the process. The snapshots are also kept on
+        ``self.drained`` for the caller that owns the handoff."""
+        out = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            out.append(ResumableRequest(
+                req=slot.req, generated=tuple(slot.emitted), slot=i))
+            self._free_slot(i)
+        self.drained = out
+        if self.tracer is not None:
+            self.tracer.record("serve_drain", in_flight=len(out))
+        return out
+
+    def restore(self, rr: ResumableRequest) -> int:
+        """Continue a drained request in THIS engine: replay prompt +
+        generated-so-far through prefill (bitwise greedy parity — see
+        :meth:`admit`) and decode the remaining budget. Returns the
+        slot; the caller re-binds it in its scheduler."""
+        return self.admit(rr.req, emitted=rr.generated)
+
+    # -- the dispatch paths --------------------------------------------
+
     def step(self) -> list[tuple[int, Request, list, str]]:
         """Advance every occupied slot by ``decode_steps`` tokens (its
         done-mask latching earlier on device when S > 1). Returns
-        completions as ``(slot, request, tokens, reason)`` with reason
-        one of ``eos`` / ``stop`` / ``max_tokens``; completed slots are
-        freed before returning (the same dispatch that emitted the
-        finishing token — a slot never idles occupied)."""
+        completions as ``(slot, request, tokens, reason)`` — reason one
+        of ``eos`` / ``stop`` / ``max_tokens`` for successes, or a
+        failure the serve loop routes: ``nan`` (poisoned decode, this
+        request only), ``watchdog`` / ``fault`` (hung / raised dispatch
+        — every in-flight request fails and the state is rebuilt), or
+        ``evicted`` (deadline passed mid-flight; terminal). Completed
+        and failed slots are freed before returning (the same dispatch
+        that emitted the finishing token — a slot never idles
+        occupied)."""
         if self.ecfg.decode_steps > 1:
             return self._step_block()
+        self._maybe_poison()
         span = (self.tracer.span("serve_step", occupied=self.occupied)
                 if self.tracer is not None else _null_span())
-        with span:
-            self._state, tok = _engine_step(
-                self.params, self._state, jnp.asarray(self._pos),
-                self.cfg)
-            toks = np.asarray(tok)  # the one host readback per token
+        # snapshot the dispatch inputs NOW: a hung watchdog worker may
+        # wake after recovery has already rebuilt self._state, and it
+        # must donate the abandoned buffers it was given, never the
+        # live rebuilt ones
+        state_in, pos_in = self._state, jnp.asarray(self._pos)
+        try:
+            with span:
+                state, packed = self._guarded_dispatch(
+                    lambda: self._dispatch_single(state_in, pos_in))
+        except WatchdogTimeout:
+            self.watchdog_trips += 1
+            if self.metrics is not None:
+                self.metrics.on_watchdog_trip()
+            return self._recover("watchdog")
+        except InjectedFault:
+            return self._recover("fault")
+        self._state = state
         self.decode_dispatches += 1
+        toks, finite = packed[0], packed[1]
         finished = []
         for i, slot in enumerate(self._slots):
             if slot is None:
+                continue
+            if not finite[i]:
+                finished.append(self._fail_lane(i, "nan"))
+                if self.metrics is not None:
+                    self.metrics.on_fault_survived("nan")
                 continue
             t = int(toks[i])
             slot.emitted.append(t)
@@ -583,7 +863,13 @@ class ServingEngine:
                 if self.metrics is not None:
                     self.metrics.on_complete(req.rid, len(slot.emitted),
                                              reason)
+        self._evict_expired(finished)
         return finished
+
+    def _dispatch_single(self, state_in: dict, pos_in):
+        state, packed = _engine_step(
+            self.params, state_in, pos_in, self.cfg)
+        return state, np.asarray(packed)  # the one host readback
 
     def _step_block(self) -> list[tuple[int, Request, list, str]]:
         """The S>1 dispatch: one fused ``_engine_multi_step`` program,
@@ -593,6 +879,7 @@ class ServingEngine:
         (mirroring the device latch) and counting the trailing block
         steps as wasted."""
         s_steps = self.ecfg.decode_steps
+        self._maybe_poison()
         if self._vectors_dirty:
             self._dev_vectors = {
                 "pos": jnp.asarray(self._pos),
@@ -607,22 +894,44 @@ class ServingEngine:
         span = (self.tracer.span("serve_step", occupied=self.occupied,
                                  decode_steps=s_steps)
                 if self.tracer is not None else _null_span())
-        with span:
-            self._state, packed, pos_d, done_d, rem_d = \
-                _engine_multi_step(
-                    self.params, self._state, d["pos"], d["done"],
-                    d["remaining"], d["eos"], d["stops"],
-                    self.cfg, s_steps)
-            block = np.asarray(packed)  # ONE readback per S tokens
+        # snapshot the state reference (see step(): a woken watchdog
+        # worker must donate the abandoned buffers, not the rebuilt
+        # live state)
+        state_in = self._state
+        try:
+            with span:
+                state, block, pos_d, done_d, rem_d = \
+                    self._guarded_dispatch(
+                        lambda: self._dispatch_block(state_in, d,
+                                                     s_steps))
+        except WatchdogTimeout:
+            self.watchdog_trips += 1
+            if self.metrics is not None:
+                self.metrics.on_watchdog_trip()
+            return self._recover("watchdog")
+        except InjectedFault:
+            return self._recover("fault")
+        self._state = state
         # carry the post-block device vectors; a dirty event below
         # (admit/free) re-uploads from host truth instead
         self._dev_vectors = {**d, "pos": pos_d, "done": done_d,
                              "remaining": rem_d}
         self.decode_dispatches += 1
-        toks, dev_pos = block[:s_steps], block[s_steps]
+        toks, dev_pos, bad = \
+            block[:s_steps], block[s_steps], block[s_steps + 1]
         finished = []
         for i, slot in enumerate(self._slots):
             if slot is None:
+                continue
+            if bad[i]:
+                # the lane's logits went non-finite during the block;
+                # its device done-mask latched (no KV written) and the
+                # whole block is garbage — fail the request, not the
+                # engine (_free_slot marks the vectors dirty, so the
+                # next block re-uploads host truth for the fresh lane)
+                finished.append(self._fail_lane(i, "nan"))
+                if self.metrics is not None:
+                    self.metrics.on_fault_survived("nan")
                 continue
             req = slot.req
             reason = None
@@ -658,7 +967,15 @@ class ServingEngine:
                     f"{int(dev_pos[i])} != host replay {self._pos[i]} "
                     f"after a {s_steps}-step block — on-device finish "
                     f"latch and host completion logic diverged")
+        self._evict_expired(finished)
         return finished
+
+    def _dispatch_block(self, state_in: dict, d: dict, s_steps: int):
+        state, packed, pos_d, done_d, rem_d = _engine_multi_step(
+            self.params, state_in, d["pos"], d["done"],
+            d["remaining"], d["eos"], d["stops"], self.cfg, s_steps)
+        return (state, np.asarray(packed),  # ONE readback per S tokens
+                pos_d, done_d, rem_d)
 
 
 class _null_span:
@@ -669,11 +986,18 @@ class _null_span:
         return None
 
 
+# failure reasons the serve loop hands back to the scheduler's retry
+# budget (everything else in a completion tuple is terminal)
+RETRYABLE_REASONS = frozenset({"watchdog", "fault", "nan"})
+
+
 def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
                metrics=None, max_dispatches: Optional[int] = None
                ) -> dict:
     """Drive engine + scheduler until both drain. Returns
-    ``{rid: (tokens, reason)}``.
+    ``{rid: (tokens, reason)}`` — successes carry their tokens; a
+    terminal failure carries ``[]`` and its status (``evicted``,
+    ``dead_letter``, ``rejected_infeasible``).
 
     Loop shape per iteration: admit every ARRIVED request into free
     slots, then step — unless occupancy is below the scheduler's
@@ -682,13 +1006,42 @@ def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
     rule: the threshold only ever waits for work that is coming;
     a drained queue always steps).
 
+    Failure routing: a retryable engine failure (``watchdog`` /
+    ``fault`` / ``nan``) goes back through
+    :meth:`RequestScheduler.requeue_failed` — exponential backoff
+    within the attempt budget, dead-letter past it; scheduler-side
+    drops (dead letters, infeasible-deadline sheds) surface here as
+    terminal results, so every submitted request ends the run with
+    exactly one status. A preemption (injected ``preempt`` fault or
+    :meth:`ServingEngine.request_drain` from a SIGTERM handler) stops
+    admission and returns after :meth:`ServingEngine.drain` — the
+    snapshots wait on ``engine.drained`` for a fresh engine's
+    :meth:`ServingEngine.restore`.
+
     ``max_dispatches`` bounds total decode dispatches (tests / selfcheck
     watchdog) — exceeding it raises instead of hanging."""
     results: dict = {}
     if metrics is not None and engine.metrics is None:
         engine.metrics = metrics  # one metrics sink for the whole run
     clock = scheduler.clock
+
+    def drain_drops() -> None:
+        for req, reason in scheduler.drain_dropped():
+            results[req.rid] = ([], reason)
+            if metrics is not None:
+                metrics.on_drop(req.rid, reason)
+
     while True:
+        pt = maybe_fail("serve.loop")
+        if pt is not None and pt.kind == "preempt":
+            engine.request_drain()
+            if metrics is not None:
+                metrics.on_fault_survived("preempt")
+        if engine.draining:
+            for rr in engine.drain():
+                scheduler.release(rr.slot)
+            drain_drops()
+            return results
         now = clock()
         while engine.free_slot_count > 0:
             req = scheduler.pop_ready(now)
@@ -696,6 +1049,7 @@ def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
                 break
             slot = engine.admit(req)
             scheduler.bind(req, slot)
+        drain_drops()
         if engine.occupied == 0:
             nxt = scheduler.next_arrival_time()
             if nxt is None:
@@ -719,4 +1073,9 @@ def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
                 f"{scheduler.unfinished} unfinished)")
         for slot, req, tokens, reason in engine.step():
             scheduler.release(slot)
-            results[req.rid] = (tokens, reason)
+            if reason in RETRYABLE_REASONS:
+                if scheduler.requeue_failed(req, reason) \
+                        and metrics is not None:
+                    metrics.on_retry(req.rid)
+            else:
+                results[req.rid] = (tokens, reason)

@@ -98,6 +98,22 @@ class ServingMetrics:
         self.requests_submitted = 0
         self.requests_completed = 0
         self.requests_rejected = 0
+        # -- fault-tolerance counters (ISSUE 5): the robustness story in
+        # numbers, surfaced in summary() next to wasted_token_rate
+        self.retries_total = 0          # requeues within the budget
+        self.evictions_total = 0        # mid-flight deadline evictions
+        self.deadline_misses_total = 0  # evictions + infeasible sheds
+        self.watchdog_trips_total = 0   # hung dispatches recovered
+        self.dead_letter_total = 0      # retry budget exhausted
+        self.requests_failed = 0        # failure EVENTS (per attempt)
+        # the reconciliation pair: faults the plan fired vs failure
+        # events the plane absorbed and kept serving through. Injected
+        # is stamped from FaultPlan.fired by the harness (the engine
+        # cannot attribute a watchdog trip to an injection — that
+        # ignorance is the point); survived ticks in recovery handlers,
+        # so injected == survived is the chaos run's pass condition.
+        self.fault_injected = 0
+        self.fault_survived = 0
         self._first: dict[int, float] = {}  # rid -> first-token time
         # rid -> tokens delivered AT the first-token instant (the whole
         # first block lands at once under block emission; TPOT must not
@@ -148,6 +164,63 @@ class ServingMetrics:
             self.ttft_s.record(now - submitted_at)
             self._record("serve_first_token", rid=rid,
                          ttft_s=now - submitted_at, tokens=n)
+
+    # -- fault-tolerance hooks ----------------------------------------
+
+    def on_failure(self, rid: int, reason: str) -> None:
+        """One failed ATTEMPT (watchdog / fault / nan) — not terminal;
+        the scheduler's retry budget decides that. Clears the request's
+        first-token bookkeeping so a retried attempt banks its own TTFT
+        sample (the histogram keeps one sample per delivering attempt)
+        and TPOT never spans a failure."""
+        self.requests_failed += 1
+        self._first.pop(rid, None)
+        self._first_count.pop(rid, None)
+        self._record("serve_failure", rid=rid, reason=reason)
+
+    def on_discard(self, rid: int, n: int) -> None:
+        """``n`` partial-decode tokens thrown away by a failure or
+        eviction: computed but never delivered, so they move from the
+        decode count to the wasted count (total computed is unchanged —
+        the wasted_token_rate denominator stays honest)."""
+        if n:
+            self.decode_tokens -= n
+            self.wasted_tokens += n
+        self._record("serve_discard", rid=rid, tokens=n)
+
+    def on_retry(self, rid: int) -> None:
+        self.retries_total += 1
+        self._record("serve_retry", rid=rid)
+
+    def on_evict(self, rid: int, n_tokens: int) -> None:
+        """Mid-flight deadline eviction — terminal, and by definition a
+        deadline miss."""
+        self.evictions_total += 1
+        self.deadline_misses_total += 1
+        self._record("serve_evict", rid=rid, tokens=n_tokens)
+
+    def on_watchdog_trip(self) -> None:
+        self.watchdog_trips_total += 1
+        self._record("serve_watchdog_trip")
+
+    def on_drop(self, rid: int, reason: str) -> None:
+        """A scheduler-side terminal drop reported through the serve
+        loop: ``dead_letter`` (retry budget spent) or
+        ``rejected_infeasible`` (deadline unmeetable at admission —
+        counted as a deadline miss with its own status)."""
+        if reason == "dead_letter":
+            self.dead_letter_total += 1
+        elif reason == "rejected_infeasible":
+            self.deadline_misses_total += 1
+        self._record("serve_drop", rid=rid, reason=reason)
+
+    def on_fault_injected(self, n: int = 1) -> None:
+        """Stamped by the chaos harness from ``FaultPlan.fired``."""
+        self.fault_injected += n
+
+    def on_fault_survived(self, kind: str) -> None:
+        self.fault_survived += 1
+        self._record("serve_fault_survived", fault=kind)
 
     def on_wasted(self, rid: int, n: int) -> None:
         """Block steps the device computed for ``rid``'s lane after its
@@ -205,14 +278,28 @@ class ServingMetrics:
         out = {
             "requests": {"submitted": self.requests_submitted,
                          "completed": self.requests_completed,
-                         "rejected": self.requests_rejected},
+                         "rejected": self.requests_rejected,
+                         "failed_attempts": self.requests_failed},
             "tokens": {"prefill": self.prefill_tokens,
                        "decode": self.decode_tokens,
                        "wasted": self.wasted_tokens},
-            # fraction of occupied-lane decode work thrown away to block
-            # tail waste — the decode_steps tuning signal
+            # fraction of occupied-lane decode work thrown away (block
+            # tail waste + failure/eviction discards) — the
+            # decode_steps AND fault-exposure tuning signal
             "wasted_token_rate": round(
                 self.wasted_tokens / computed, 4) if computed else 0.0,
+            # the robustness story next to the waste it causes: retries
+            # and trips that stayed invisible to callers vs requests
+            # that ended in a terminal failure status
+            "faults": {
+                "retries_total": self.retries_total,
+                "evictions_total": self.evictions_total,
+                "deadline_misses_total": self.deadline_misses_total,
+                "watchdog_trips_total": self.watchdog_trips_total,
+                "dead_letter_total": self.dead_letter_total,
+                "fault_injected": self.fault_injected,
+                "fault_survived": self.fault_survived,
+            },
             "wasted_per_completion": self.wasted_per_completion.summary(
                 digits=2),
             "ttft_ms": self.ttft_s.summary(scale=1e3),

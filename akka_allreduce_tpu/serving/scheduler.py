@@ -24,6 +24,16 @@ deadline first, FIFO among equals — deadline-less requests sort last).
 Everything here is pure host Python: unit-testable with a fake clock,
 no device, no jax import.
 
+The scheduler also owns the serving plane's RETRY budget
+(:class:`RetryPolicy` — the serving twin of the protocol plane's
+bounded rejoin/backoff): an engine-failed request (watchdog trip,
+dispatch fault, NaN-poisoned decode) requeues with exponential backoff
+and attempt accounting, and lands in the ``dead_letter`` list with a
+terminal status once the budget is spent. Under the ``deadline``
+policy, admission sheds requests whose deadline is already infeasible
+(``tpot_estimate``) — the same "don't dispatch work that cannot land
+in time" judgment the training plane's straggler deadlines make.
+
 One granularity note: a "round" is whatever the engine's dispatch is.
 With multi-step block decode (``EngineConfig.decode_steps = S``) the
 serve loop admits only BETWEEN blocks, so a slot freed mid-block stays
@@ -40,6 +50,7 @@ import dataclasses
 import heapq
 import itertools
 import math
+import random
 import time
 from typing import Optional
 
@@ -70,13 +81,62 @@ class Request:
     arrival: float = 0.0
     deadline: Optional[float] = None
     submitted_at: Optional[float] = None
+    # failed-attempt count, stamped by requeue_failed — the retry
+    # budget's ledger (a request enters the system with 0)
+    attempts: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Budgeted retry with exponential backoff for engine-failed
+    requests (watchdog trips, dispatch faults, NaN-poisoned decodes).
+
+    ``max_attempts`` is the TOTAL attempt budget: a request whose
+    ``max_attempts``-th attempt fails is dead-lettered with a terminal
+    status instead of requeued. The k-th failure backs off
+    ``base_delay * 2**(k-1)`` plus a uniform draw in ``[0, jitter)``
+    from the scheduler's seeded RNG (deterministic per seed — the
+    fault-plan tests pin exact requeue times)."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.05
+    jitter: float = 0.0
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay < 0 or self.jitter < 0:
+            raise ValueError(
+                f"base_delay/jitter must be >= 0, got "
+                f"{self.base_delay}/{self.jitter}")
+
+    def delay(self, failures: int, rng: random.Random) -> float:
+        d = self.base_delay * (2.0 ** (failures - 1))
+        if self.jitter:
+            d += rng.uniform(0.0, self.jitter)
+        return d
 
 
 @dataclasses.dataclass(frozen=True)
 class SchedulerConfig:
+    """``retry`` budgets engine-failed requests (see
+    :class:`RetryPolicy`); ``seed`` drives its jitter.
+
+    ``tpot_estimate`` (seconds per token, 0 = disabled) arms admission-
+    time feasibility shedding under the ``deadline`` policy: a popped
+    request whose deadline cannot fit even ``min_feasible_tokens`` more
+    tokens (``deadline < now + min_feasible_tokens * tpot_estimate``)
+    is shed with the ``rejected_infeasible`` status instead of admitted
+    into work that is guaranteed to be evicted mid-flight."""
+
     max_queue_depth: int = 256
     policy: str = "fifo"  # "fifo" | "deadline"
     th_step: float = 0.0  # occupancy fraction gating a decode step
+    retry: RetryPolicy = RetryPolicy()
+    tpot_estimate: float = 0.0
+    min_feasible_tokens: int = 1
+    seed: int = 0
 
     def __post_init__(self):
         if self.policy not in ("fifo", "deadline"):
@@ -87,6 +147,12 @@ class SchedulerConfig:
         if not 0.0 <= self.th_step <= 1.0:
             raise ValueError(
                 f"th_step must be in [0, 1], got {self.th_step}")
+        if self.tpot_estimate < 0:
+            raise ValueError(f"tpot_estimate must be >= 0, "
+                             f"got {self.tpot_estimate}")
+        if self.min_feasible_tokens < 1:
+            raise ValueError(f"min_feasible_tokens must be >= 1, "
+                             f"got {self.min_feasible_tokens}")
 
 
 class RequestScheduler:
@@ -120,6 +186,17 @@ class RequestScheduler:
         # thresholds: required count = ceil(fraction * total))
         self.step_quorum = max(1, math.ceil(cfg.th_step * num_slots))
         self.rejected = 0
+        # -- failure plumbing (serving fault tolerance) -----------------
+        self._rng = random.Random(cfg.seed)  # retry jitter
+        self.retries = 0            # successful requeues
+        self.shed_infeasible = 0    # deadline-infeasible admission sheds
+        # terminal record of budget-exhausted requests: (req, the
+        # failure reason of the LAST attempt) — the operator's triage
+        # list (OPERATIONS.md "Dead-letter triage")
+        self.dead_letter: list[tuple] = []
+        # terminal drops not yet reported to the serve loop; drained
+        # (and turned into results/metrics) once per loop iteration
+        self._dropped: list[tuple] = []
 
     # -- admission -----------------------------------------------------
 
@@ -157,24 +234,79 @@ class RequestScheduler:
 
     def _drain_arrivals(self, now: float) -> None:
         """Move every request whose arrival has passed into the live
-        queue, shedding (via ``on_reject``) any that find it full."""
+        queue, shedding (via ``on_reject``) any FRESH request that
+        finds it full. A retried request (``attempts > 0``) is exempt:
+        it already paid for (and held) its admission, and shedding it
+        here would lose it with no terminal status — backpressure is
+        an edge policy, and a retry is not at the edge."""
         while self._future and self._future[0][0] <= now:
             _, _, req = heapq.heappop(self._future)
-            if len(self._arrived) >= self.cfg.max_queue_depth:
+            if req.attempts == 0 \
+                    and len(self._arrived) >= self.cfg.max_queue_depth:
                 self._reject(req)
             else:
                 self._push_arrived(req)
 
+    def _infeasible(self, req: Request, now: float) -> bool:
+        """Deadline already unmeetable at admission time: even the
+        minimum useful decode would outlive it. Admitting such a
+        request only manufactures a guaranteed mid-flight eviction —
+        shed it at the edge instead (the same judgment the protocol
+        plane's deadline pacer makes about a straggler's chunks: work
+        that cannot land in time is work not worth dispatching)."""
+        return (self.cfg.policy == "deadline"
+                and self.cfg.tpot_estimate > 0
+                and req.deadline is not None
+                and req.deadline < now + (self.cfg.min_feasible_tokens
+                                          * self.cfg.tpot_estimate))
+
     def pop_ready(self, now: Optional[float] = None) -> Optional[Request]:
         """Best live request as of ``now`` (None = nothing has arrived).
         Under the deadline policy an urgent late arrival outranks a
-        patient early one; among equals, submit order decides."""
+        patient early one; among equals, submit order decides —
+        and already-infeasible requests are shed (``rejected_
+        infeasible``), never admitted."""
         if now is None:
             now = self.clock()
         self._drain_arrivals(now)
-        if self._arrived:
-            return heapq.heappop(self._arrived)[2]
+        while self._arrived:
+            req = heapq.heappop(self._arrived)[2]
+            if self._infeasible(req, now):
+                self.shed_infeasible += 1
+                self._dropped.append((req, "rejected_infeasible"))
+                continue
+            return req
         return None
+
+    # -- failure handling ----------------------------------------------
+
+    def requeue_failed(self, req: Request, reason: str = "fault") -> bool:
+        """Route an engine-failed request through the retry budget:
+        within ``retry.max_attempts``, requeue it with exponential
+        backoff (it re-enters through the future pool, so the deadline/
+        FIFO policy re-sorts it on arrival); past the budget, dead-
+        letter it with a terminal status. Returns True iff requeued.
+        Retries bypass the queue-depth check — the request already held
+        (and paid for) its admission."""
+        req.attempts += 1
+        pol = self.cfg.retry
+        if req.attempts >= pol.max_attempts:
+            self.dead_letter.append((req, reason))
+            self._dropped.append((req, "dead_letter"))
+            return False
+        self.retries += 1
+        req.arrival = self.clock() + pol.delay(req.attempts, self._rng)
+        heapq.heappush(self._future, (req.arrival, next(self._seq), req))
+        return True
+
+    def drain_dropped(self) -> "list[tuple]":
+        """Hand back (and clear) the terminal drops accumulated since
+        the last call: ``(request, status)`` with status
+        ``dead_letter`` or ``rejected_infeasible``. The serve loop
+        folds these into its results so every request ends with
+        exactly one terminal record."""
+        out, self._dropped = self._dropped, []
+        return out
 
     def next_arrival_time(self) -> Optional[float]:
         """Earliest pending arrival (open-loop idle wait target); the

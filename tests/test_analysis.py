@@ -60,7 +60,7 @@ class TestCleanEntrypointsStayClean:
 
     @pytest.mark.parametrize("target", [
         "generate", "engine_step", "engine_multi_step",
-        "engine_prefill",
+        "engine_prefill", "engine_recovery",
         "collective_fused", "collective_windowed",
         "collective_int8", "collective_bf16",
     ])
@@ -101,6 +101,26 @@ class TestCleanEntrypointsStayClean:
         scans = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
                     if eqn.primitive.name == "scan")
         assert scans >= 1
+
+    def test_engine_recovery_rebuild_is_warmup_shaped(self):
+        """ISSUE 5 satellite: the watchdog-recovery contract, pinned
+        structurally. The rebuilt engine state must dispatch into the
+        warmed step program (builder raises if any rebuilt aval drifts
+        from warmup's — the no-recompile half), the donation that keeps
+        recovery cache updates in place must survive lowering, and no
+        host callback may ride the recovery dispatch."""
+        from akka_allreduce_tpu.analysis.entrypoints import (
+            build_engine_recovery)
+        ctx = build_engine_recovery()
+        declared = sum(ctx.donated)
+        assert declared >= 3  # k, v, logits at minimum
+        markers = (ctx.stablehlo.count("jax.buffer_donor")
+                   + ctx.stablehlo.count("tf.aliasing_output"))
+        assert markers >= declared, (declared, markers)
+        gating = [f for f in run_passes(ctx)
+                  if f.severity in ("error", "warning")]
+        assert not gating, [f"[{f.pass_name}] {f.message}"
+                            for f in gating]
 
     def test_train_step_donates_and_pairs(self):
         """The flagship claims, asserted structurally (not just "no

@@ -1,0 +1,560 @@
+"""Serving-plane fault tolerance (ISSUE 5): every failure path driven
+by a scheduled fault, never by hoping.
+
+THE acceptance property, per fault class: the engine completes the
+round without the missing contribution. A hung dispatch trips the
+watchdog and fails only the in-flight requests (rebuilt state, warmed
+programs, zero recompiles); a raising dispatch takes the same path; a
+NaN-poisoned decode fails the poisoned request through the on-device
+finite guard; a preemption drains to resumable snapshots a fresh engine
+restores with bitwise parity. In EVERY case each submitted request ends
+with exactly one terminal record, and every request that completes at
+all completes with tokens bitwise identical to the fault-free run —
+retries and restores are invisible in the output, visible only in the
+ledger (retries/evictions/dead-letter/watchdog counters, which this
+file pins exactly).
+
+Model shapes mirror the chaos selfcheck (tiny, unique to this file);
+the module-scope baselines double as program warmup so watchdog'd runs
+never time a cold XLA compile (the warm-before-you-arm rule,
+OPERATIONS.md "Watchdog trips")."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from akka_allreduce_tpu.runtime.faults import (
+    FaultPlan,
+    FaultPoint,
+    InjectedFault,
+    maybe_fail,
+)
+from akka_allreduce_tpu.serving import (
+    EngineConfig,
+    Request,
+    RequestScheduler,
+    RetryPolicy,
+    SchedulerConfig,
+    ServingEngine,
+    ServingMetrics,
+    serve_loop,
+)
+
+CFG = TransformerConfig(vocab_size=67, d_model=32, n_heads=2,
+                        n_layers=2, d_ff=64, max_seq=48)
+SLOTS = 3
+WATCHDOG_S = 0.15  # dispatch bound; injected hangs sleep 4x this
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(jax.random.key(0), CFG)
+
+
+def make_requests(n=6, budget=6, seed=3, eos_every=2, deadline=None):
+    """Fresh Request objects every call: requests are mutated in flight
+    (attempts, backoff arrival) and runs must not share that state."""
+    rng = np.random.default_rng(seed)
+    return [Request(
+        rid=rid,
+        prompt=tuple(int(x) for x in rng.integers(
+            0, CFG.vocab_size, size=(3, 5)[rid % 2])),
+        max_new_tokens=budget,
+        eos_token=3 if eos_every and rid % eos_every == 0 else None,
+        deadline=deadline,
+        submitted_at=0.0) for rid in range(n)]
+
+
+def build(params, s=1, watchdog=WATCHDOG_S, max_attempts=3,
+          base_delay=0.0, policy="fifo", clock=None, sleep=None,
+          metrics=None, **scfg_kw):
+    ecfg = EngineConfig(num_slots=SLOTS, decode_steps=s,
+                        watchdog_timeout_s=watchdog)
+    engine = ServingEngine(
+        params, CFG, ecfg, metrics=metrics,
+        **({"clock": clock} if clock is not None else {}))
+    kw = {}
+    if clock is not None:
+        kw["clock"] = clock
+    if sleep is not None:
+        kw["sleep"] = sleep
+    sched = RequestScheduler(
+        SchedulerConfig(policy=policy,
+                        retry=RetryPolicy(max_attempts=max_attempts,
+                                          base_delay=base_delay),
+                        **scfg_kw),
+        num_slots=SLOTS, **kw)
+    return engine, sched
+
+
+def run_to_completion(params, engine, sched, reqs, metrics=None,
+                      plan=None):
+    """serve_loop plus the preemption handoff: a drained run restores
+    its snapshots into a fresh engine (same config) and finishes the
+    queue — the production restart choreography the drain tests pin."""
+    for r in reqs:
+        sched.submit(r)
+    import contextlib
+    ctx = plan.armed() if plan is not None else contextlib.nullcontext()
+    with ctx:
+        results = serve_loop(engine, sched, metrics=metrics,
+                             max_dispatches=2000)
+    while engine.drained or sched.unfinished:
+        fresh = ServingEngine(params, CFG, engine.ecfg,
+                              metrics=metrics)
+        for rr in engine.drained:
+            sched.bind(rr.req, fresh.restore(rr))
+        results.update(serve_loop(fresh, sched, metrics=metrics,
+                                  max_dispatches=2000))
+        engine = fresh
+    return results, engine
+
+
+@pytest.fixture(scope="module")
+def baselines(params):
+    """Fault-free truth per decode_steps — and the program warmup that
+    keeps watchdog'd runs from timing cold compiles."""
+    out = {}
+    for s in (1, 4):
+        engine, sched = build(params, s=s, watchdog=None)
+        out[s], _ = run_to_completion(params, engine, sched,
+                                      make_requests())
+    return out
+
+
+def point_for(kind, s):
+    if kind == "hang":
+        return FaultPoint("engine.dispatch", "hang", hit=2,
+                          duration_s=4 * WATCHDOG_S)
+    if kind == "raise":
+        return FaultPoint("engine.dispatch", "raise", hit=2)
+    if kind == "nan":
+        return FaultPoint("engine.logits", "nan", hit=2, slot=1)
+    # preempt while work is genuinely in flight: at S=1 the third loop
+    # tick has every first-wave lane mid-decode; at S=4 the second tick
+    # lands between blocks with 4 of 6 budgeted tokens emitted
+    return FaultPoint("serve.loop", "preempt", hit=4 if s == 1 else 2)
+
+
+class TestFaultPlanUnit:
+    """The harness itself: deterministic, scoped, ledgered."""
+
+    def test_unarmed_is_noop(self):
+        assert maybe_fail("engine.dispatch") is None
+
+    def test_hit_window_and_times(self):
+        naps = []
+        plan = FaultPlan([FaultPoint("site", "hang", hit=2, times=2,
+                                     duration_s=0.5)],
+                         sleep=naps.append)
+        with plan.armed():
+            assert maybe_fail("site") is None          # hit 1
+            assert maybe_fail("site").kind == "hang"   # hit 2 fires
+            assert maybe_fail("site").kind == "hang"   # hit 3 fires
+            assert maybe_fail("site") is None          # window closed
+        assert naps == [0.5, 0.5]
+        assert plan.fired == [("site", "hang", 2), ("site", "hang", 3)]
+
+    def test_raise_kind_raises(self):
+        plan = FaultPlan([FaultPoint("s", "raise")])
+        with plan.armed():
+            with pytest.raises(InjectedFault, match="'s'"):
+                maybe_fail("s")
+        assert plan.fired == [("s", "raise", 1)]
+
+    def test_plans_do_not_nest_and_disarm(self):
+        plan = FaultPlan([FaultPoint("s", "preempt")])
+        with plan.armed():
+            with pytest.raises(RuntimeError, match="already armed"):
+                with FaultPlan([]).armed():
+                    pass
+        assert maybe_fail("s") is None  # disarmed on exit
+
+    def test_wrap_clock_skew(self):
+        plan = FaultPlan([FaultPoint("scheduler.clock", "skew", hit=3,
+                                     duration_s=100.0)])
+        t = [0.0]
+        clock = plan.wrap_clock(lambda: t[0])
+        with plan.armed():
+            assert clock() == 0.0
+            assert clock() == 0.0
+            assert clock() == 100.0  # third read fires the jump
+            assert clock() == 100.0  # and it sticks
+        assert ("scheduler.clock", "skew", 3) in plan.fired
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            FaultPoint("s", "explode")
+        with pytest.raises(ValueError, match="hit"):
+            FaultPoint("s", "hang", hit=0)
+
+
+class TestFaultMatrix:
+    """The ISSUE 5 matrix: (hang, raise, nan, preempt) x (fifo,
+    deadline) x decode_steps in {1, 4}. Every request's final tokens
+    and reason are bitwise the fault-free run's, and the failure ledger
+    reconciles exactly."""
+
+    @pytest.mark.parametrize("kind", ["hang", "raise", "nan", "preempt"])
+    @pytest.mark.parametrize("policy", ["fifo", "deadline"])
+    @pytest.mark.parametrize("s", [1, 4])
+    def test_matrix(self, params, baselines, kind, policy, s):
+        reqs = make_requests()
+        plan = FaultPlan([point_for(kind, s)])
+        metrics = ServingMetrics()
+        engine, sched = build(params, s=s, policy=policy,
+                              metrics=metrics)
+        results, engine = run_to_completion(params, engine, sched, reqs,
+                                            metrics=metrics, plan=plan)
+        assert len(plan.fired) == 1, plan.fired
+        # parity: faults are invisible in every request's output
+        assert set(results) == set(baselines[s])
+        for rid, (toks, reason) in baselines[s].items():
+            assert list(results[rid][0]) == list(toks), f"rid={rid}"
+            assert results[rid][1] == reason, f"rid={rid}"
+        # the ledger, exactly
+        assert metrics.fault_survived == 1
+        assert metrics.dead_letter_total == 0
+        if kind == "hang":
+            assert engine.watchdog_trips == 1
+            assert metrics.watchdog_trips_total == 1
+            assert metrics.retries_total == SLOTS  # all in-flight
+            assert metrics.requests_failed == SLOTS
+        elif kind == "raise":
+            assert metrics.watchdog_trips_total == 0
+            assert metrics.retries_total == SLOTS
+        elif kind == "nan":
+            assert metrics.retries_total == 1  # the poisoned lane only
+            assert metrics.requests_failed == 1
+        else:  # preempt
+            assert metrics.retries_total == 0
+            assert metrics.requests_failed == 0
+
+
+class TestWatchdogRecovery:
+    def test_recovery_compiles_nothing(self, params, baselines):
+        """The rebuilt-state dispatch contract at runtime (the lint
+        half is the engine_recovery catalog entry): with programs
+        warmed, the ENTIRE faulted run — trip, rebuild, retries, churn
+        — compiles zero programs."""
+        from akka_allreduce_tpu.analysis.recompile import no_recompiles
+        plan = FaultPlan([point_for("hang", 1)])
+        engine, sched = build(params, s=1, metrics=None)
+        with no_recompiles("watchdog recovery at warmed shapes"):
+            results, engine = run_to_completion(
+                params, engine, sched, make_requests(), plan=plan)
+        assert engine.watchdog_trips == 1
+        for rid, (toks, reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks)
+
+    def test_discarded_partials_charged_to_waste(self, params,
+                                                 baselines):
+        """A failed attempt's partial decode is wasted work: moved from
+        the decode count to the wasted count, token for token."""
+        plan = FaultPlan([point_for("hang", 1)])  # trip at dispatch 2
+        metrics = ServingMetrics()
+        engine, sched = build(params, s=1, metrics=metrics)
+        results, engine = run_to_completion(params, engine, sched,
+                                            make_requests(),
+                                            metrics=metrics, plan=plan)
+        # 3 lanes had emitted exactly 1 token each when dispatch 2 hung
+        assert engine.discarded_tokens == SLOTS
+        assert metrics.wasted_tokens == SLOTS
+        # delivered tokens stay exact despite the discard accounting
+        assert metrics.decode_tokens == sum(
+            len(t) for t, _ in results.values())
+
+    def test_dead_letter_after_budget(self, params, baselines):
+        """Retry exhaustion: a dispatch that fails EVERY time pushes
+        each request through max_attempts failures into the dead-letter
+        list with a terminal status — and the run still terminates."""
+        plan = FaultPlan([FaultPoint("engine.dispatch", "raise",
+                                     hit=2, times=10_000)])
+        metrics = ServingMetrics()
+        engine, sched = build(params, s=1, max_attempts=2,
+                              metrics=metrics)
+        results, engine = run_to_completion(params, engine, sched,
+                                            make_requests(),
+                                            metrics=metrics, plan=plan)
+        # dispatch 1 succeeded, then nothing ever again: every request
+        # burns its 2 attempts and dead-letters
+        assert all(r == ([], "dead_letter") for r in results.values())
+        assert metrics.dead_letter_total == 6
+        assert len(sched.dead_letter) == 6
+        assert all(req.attempts == 2 for req, _ in sched.dead_letter)
+        # ledger identity: every failed attempt was requeued or
+        # dead-lettered, nothing lost, nothing double-counted
+        assert metrics.retries_total + metrics.dead_letter_total \
+            == metrics.requests_failed == 12
+
+
+class TestNaNGuard:
+    def test_poison_all_lanes_fails_all_retries_all(self, params,
+                                                    baselines):
+        """slot=None poisons the whole logits batch: every in-flight
+        request fails through the finite guard, retries, and still
+        lands bitwise on the baseline."""
+        plan = FaultPlan([FaultPoint("engine.logits", "nan", hit=2,
+                                     slot=None)])
+        metrics = ServingMetrics()
+        engine, sched = build(params, s=1, metrics=metrics)
+        results, _ = run_to_completion(params, engine, sched,
+                                       make_requests(),
+                                       metrics=metrics, plan=plan)
+        assert metrics.requests_failed == SLOTS
+        assert metrics.fault_survived == SLOTS  # one per poisoned lane
+        for rid, (toks, reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks)
+            assert results[rid][1] == reason
+
+
+class _TickClock:
+    """A clock that advances a fixed dt per READ — deterministic decode
+    'wall time' for deadline tests without real sleeping."""
+
+    def __init__(self, dt=0.05):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+class TestDeadlineEnforcement:
+    def test_expired_request_evicted_mid_flight(self, params):
+        """The deadline field is enforced BETWEEN dispatches: an
+        expired request is evicted with its partial decode charged to
+        waste, and its slot refills the same iteration."""
+        clock = _TickClock(dt=0.05)
+        metrics = ServingMetrics(clock=clock)
+        engine, sched = build(params, s=1, watchdog=None,
+                              policy="deadline", clock=clock,
+                              sleep=clock.sleep, metrics=metrics)
+        reqs = make_requests(n=4, budget=30, eos_every=0)
+        reqs[0] = dataclasses.replace(reqs[0], deadline=1.0)
+        for r in reqs[1:]:
+            r.deadline = 1e9
+        results, engine = run_to_completion(params, engine, sched, reqs,
+                                            metrics=metrics)
+        assert results[0] == ([], "evicted")
+        assert engine.evictions == 1
+        assert metrics.evictions_total == 1
+        assert metrics.deadline_misses_total == 1
+        assert metrics.wasted_tokens > 0  # rid 0's partial decode
+        # the freed slot was refilled: everyone else ran to budget
+        for rid in (1, 2, 3):
+            toks, reason = results[rid]
+            assert reason == "max_tokens" and len(toks) == 30
+
+    def test_infeasible_deadline_shed_at_admission(self, params):
+        """ISSUE 5 satellite: under the deadline policy with a tpot
+        estimate, a request whose deadline cannot fit min_feasible_
+        tokens is shed as rejected_infeasible instead of admitted into
+        a guaranteed eviction."""
+        clock = _TickClock(dt=0.05)
+        metrics = ServingMetrics(clock=clock)
+        engine, sched = build(params, s=1, watchdog=None,
+                              policy="deadline", clock=clock,
+                              sleep=clock.sleep, metrics=metrics,
+                              tpot_estimate=0.1, min_feasible_tokens=5)
+        reqs = make_requests(n=3, budget=6, eos_every=0)
+        reqs[0].deadline = 0.2   # < now + 5 * 0.1: unmeetable
+        reqs[1].deadline = 1e9
+        reqs[2].deadline = 1e9
+        results, _ = run_to_completion(params, engine, sched, reqs,
+                                       metrics=metrics)
+        assert results[0] == ([], "rejected_infeasible")
+        assert sched.shed_infeasible == 1
+        assert metrics.deadline_misses_total == 1
+        assert metrics.evictions_total == 0  # shed, never admitted
+        assert len(results[1][0]) == 6 and len(results[2][0]) == 6
+
+    def test_scheduler_infeasible_unit(self):
+        t = [100.0]
+        sched = RequestScheduler(
+            SchedulerConfig(policy="deadline", tpot_estimate=0.1,
+                            min_feasible_tokens=5),
+            num_slots=2, clock=lambda: t[0])
+        bad = Request(rid=0, prompt=(1,), max_new_tokens=8,
+                      deadline=100.3)
+        ok = Request(rid=1, prompt=(1,), max_new_tokens=8,
+                     deadline=101.0)
+        sched.submit(bad)
+        sched.submit(ok)
+        got = sched.pop_ready(100.0)
+        assert got is not None and got.rid == 1
+        assert sched.drain_dropped() == [(bad, "rejected_infeasible")]
+        assert sched.drain_dropped() == []  # drained exactly once
+
+
+class TestRetryBackoffExact:
+    """The satellite's 'retry/backoff accounting is exact' pin, at the
+    scheduler unit level with a fake clock."""
+
+    def test_exponential_backoff_and_dead_letter(self):
+        t = [1000.0]
+        sched = RequestScheduler(
+            SchedulerConfig(retry=RetryPolicy(max_attempts=3,
+                                              base_delay=0.2)),
+            num_slots=1, clock=lambda: t[0])
+        req = Request(rid=7, prompt=(1,), max_new_tokens=4)
+        assert sched.requeue_failed(req, "watchdog") is True
+        assert req.attempts == 1
+        assert req.arrival == pytest.approx(1000.0 + 0.2)   # 0.2 * 2^0
+        assert sched.requeue_failed(req, "fault") is True
+        assert req.attempts == 2
+        assert req.arrival == pytest.approx(1000.0 + 0.4)   # 0.2 * 2^1
+        assert sched.requeue_failed(req, "nan") is False    # budget out
+        assert req.attempts == 3
+        assert sched.retries == 2
+        assert sched.dead_letter == [(req, "nan")]
+        assert sched.drain_dropped() == [(req, "dead_letter")]
+
+    def test_retry_survives_full_queue(self):
+        """A retried request re-entering through the future pool must
+        NOT be shed by the arrival-time depth check: it already paid
+        for its admission, and shedding it would lose it with no
+        terminal status (backpressure is an edge policy; a retry is
+        not at the edge)."""
+        t = [0.0]
+        rejected = []
+        sched = RequestScheduler(
+            SchedulerConfig(max_queue_depth=2,
+                            retry=RetryPolicy(max_attempts=3,
+                                              base_delay=0.0)),
+            num_slots=1, clock=lambda: t[0],
+            on_reject=rejected.append)
+        for rid in range(2):  # fill the live queue to its depth bound
+            sched.submit(Request(rid=rid, prompt=(1,),
+                                 max_new_tokens=2))
+        failed = Request(rid=9, prompt=(1,), max_new_tokens=2)
+        assert sched.requeue_failed(failed, "watchdog") is True
+        got = sched.pop_ready(0.0)  # drains arrivals at a FULL queue
+        assert rejected == []       # the retry was not shed
+        popped = {got.rid, sched.pop_ready(0.0).rid,
+                  sched.pop_ready(0.0).rid}
+        assert popped == {0, 1, 9}  # everyone eventually pops
+        assert sched.drain_dropped() == []
+
+    def test_jitter_is_seeded_and_bounded(self):
+        def mk():
+            return RequestScheduler(
+                SchedulerConfig(retry=RetryPolicy(max_attempts=9,
+                                                  base_delay=0.1,
+                                                  jitter=0.05),
+                                seed=5),
+                num_slots=1, clock=lambda: 0.0)
+
+        def delays(s):
+            out = []
+            for k in range(4):
+                r = Request(rid=k, prompt=(1,), max_new_tokens=2)
+                s.requeue_failed(r)
+                out.append(r.arrival)
+            return out
+
+        a, b = delays(mk()), delays(mk())
+        assert a == b  # deterministic per seed
+        for k, d in enumerate(a):
+            base = 0.1 * (2 ** 0)  # every request on its 1st failure
+            assert base <= d < base + 0.05, (k, d)
+
+
+class TestDrainRestore:
+    def test_restore_validation(self, params):
+        from akka_allreduce_tpu.serving import ResumableRequest
+        engine = ServingEngine(params, CFG, EngineConfig(num_slots=1))
+        req = Request(rid=0, prompt=(1, 2), max_new_tokens=3,
+                      submitted_at=0.0)
+        rr = ResumableRequest(req=req, generated=(4, 5, 6), slot=0)
+        with pytest.raises(ValueError, match="restore"):
+            engine.restore(rr)
+
+    def test_drain_snapshots_and_restore_parity(self, params,
+                                                baselines):
+        """Drain mid-decode, restore into a fresh engine, and the
+        continued streams are bitwise the uninterrupted ones — plus the
+        snapshots really carry the partial progress."""
+        plan = FaultPlan([point_for("preempt", 1)])
+        engine, sched = build(params, s=1)
+        reqs = make_requests()
+        for r in reqs:
+            sched.submit(r)
+        with plan.armed():
+            early = serve_loop(engine, sched, max_dispatches=2000)
+        assert engine.draining
+        assert len(engine.drained) == SLOTS  # first wave mid-decode
+        assert all(len(rr.generated) >= 1 for rr in engine.drained)
+        fresh = ServingEngine(params, CFG, engine.ecfg)
+        for rr in engine.drained:
+            sched.bind(rr.req, fresh.restore(rr))
+        results = dict(early)
+        results.update(serve_loop(fresh, sched, max_dispatches=2000))
+        for rid, (toks, reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks), f"rid={rid}"
+            assert results[rid][1] == reason
+
+
+class TestClockSkew:
+    def test_skewed_clock_sheds_instead_of_wedging(self, params):
+        """Scheduler-clock skew under the deadline policy: a forward
+        jump expires everything, and the plane answers with evictions
+        and infeasible sheds — terminal statuses for every request,
+        never a stall."""
+        plan = FaultPlan([FaultPoint("scheduler.clock", "skew",
+                                     hit=40, duration_s=1e6)])
+        clock = plan.wrap_clock(_TickClock(dt=0.01))
+        metrics = ServingMetrics(clock=clock)
+        engine, sched = build(params, s=1, watchdog=None,
+                              policy="deadline", clock=clock,
+                              sleep=lambda dt: None, metrics=metrics,
+                              tpot_estimate=0.05)
+        reqs = make_requests(n=6, budget=12, eos_every=0)
+        for r in reqs:
+            r.deadline = 50.0  # generous until the skew lands
+        with plan.armed():
+            results, _ = run_to_completion(params, engine, sched, reqs,
+                                           metrics=metrics)
+        assert ("scheduler.clock", "skew", 40) in plan.fired
+        assert set(results) == {r.rid for r in reqs}
+        statuses = {reason for _, reason in results.values()}
+        assert statuses <= {"evicted", "rejected_infeasible",
+                            "max_tokens", "eos"}
+        # the jump really bit: someone was evicted or shed
+        assert metrics.deadline_misses_total >= 1
+
+
+class TestFaultMetricsSurface:
+    def test_summary_carries_the_fault_counters(self):
+        m = ServingMetrics()
+        m.on_retry(1)
+        m.on_evict(2, 3)
+        m.on_watchdog_trip()
+        m.on_drop(3, "dead_letter")
+        m.on_drop(4, "rejected_infeasible")
+        m.on_fault_injected(2)
+        m.on_fault_survived("watchdog")
+        f = m.summary()["faults"]
+        assert f == {"retries_total": 1, "evictions_total": 1,
+                     "deadline_misses_total": 2,
+                     "watchdog_trips_total": 1, "dead_letter_total": 1,
+                     "fault_injected": 2, "fault_survived": 1}
+
+    def test_discard_moves_decode_to_wasted(self):
+        m = ServingMetrics()
+        m.on_block_tokens(1, 0.0, 4)
+        assert m.decode_tokens == 4
+        m.on_discard(1, 4)
+        assert m.decode_tokens == 0 and m.wasted_tokens == 4
+        # rate denominator (computed work) is unchanged by the move
+        assert m.summary()["wasted_token_rate"] == 1.0
