@@ -37,6 +37,15 @@ engine_recovery     the watchdog-recovery dispatch: _engine_step over a
                     host sync sneaks into the recovery path, and the
                     rebuilt avals are asserted identical to warmup's
                     (the no-recompile half of the recovery contract)
+engine_step_telemetry  the SAME engine step traced through an engine
+                    with the full telemetry plane armed (tracer,
+                    registry-backed metrics, device-span timer) — the
+                    host-sync pass walking it pins that telemetry adds
+                    ZERO host callbacks inside jitted code, and the
+                    traced jaxpr is asserted structurally identical to
+                    the bare engine_step's (telemetry cannot perturb
+                    the compiled program, the no-recompile guarantee's
+                    static half)
 collective_fused    two_phase_allreduce under shard_map — reduction-
                     axis discipline + pairing
 collective_windowed pipelined_two_phase_allreduce (W=2) — pairing
@@ -255,6 +264,52 @@ def build_engine_prefill() -> LintContext:
         policy, donate_argnums=(1,), static_argnums=(5, 6))
 
 
+def build_engine_step_telemetry() -> LintContext:
+    """ISSUE 6's zero-callback pin: construct a ServingEngine with the
+    ENTIRE telemetry plane armed — Tracer, registry-backed
+    ServingMetrics, and the device-span timer created — and trace the
+    decode step it would dispatch. Telemetry is host-side by design
+    (spans bracket dispatches, they never enter them); this entry makes
+    that design machine-checked: the host-sync pass walks the jaxpr for
+    smuggled callbacks, and the jaxpr is asserted structurally equal to
+    the bare ``engine_step`` entry's — same program, so telemetry can
+    neither sync nor recompile the hot path."""
+    import jax
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.models.transformer import init_transformer
+    from akka_allreduce_tpu.runtime.tracing import Tracer
+    from akka_allreduce_tpu.serving.engine import (EngineConfig,
+                                                   ServingEngine,
+                                                   _engine_step)
+    from akka_allreduce_tpu.serving.metrics import ServingMetrics
+    cfg = _model_cfg()
+    params = init_transformer(jax.random.key(0), cfg)
+    tracer = Tracer()
+    metrics = ServingMetrics(tracer=tracer)
+    engine = ServingEngine(params, cfg, EngineConfig(num_slots=2),
+                           metrics=metrics, tracer=tracer)
+    engine._device_timer()  # the timer a real dispatch would create
+    pos = jnp.zeros((2,), jnp.int32)
+    policy = LintPolicy(expect_donation=True, hot=True)
+    ctx = trace_entry("engine_step_telemetry", _engine_step,
+                      (params, engine._state, pos, cfg), policy,
+                      donate_argnums=(1,), static_argnums=(3,))
+    # structural identity with the bare engine_step: telemetry armed
+    # must trace to the SAME program (eqn sequence), or a span helper
+    # has leaked into the jitted function — a compile/sync hazard the
+    # diff below catches at lint time, not as a production stall
+    bare = build_engine_step()
+    armed_eqns = [str(e.primitive) for e in ctx.jaxpr.jaxpr.eqns]
+    bare_eqns = [str(e.primitive) for e in bare.jaxpr.jaxpr.eqns]
+    if armed_eqns != bare_eqns:
+        raise RuntimeError(
+            "engine_step_telemetry: the telemetry-armed engine's step "
+            "jaxpr diverged from the bare engine_step's "
+            f"({len(armed_eqns)} vs {len(bare_eqns)} eqns) — telemetry "
+            "code has entered the jitted program")
+    return ctx
+
+
 def build_engine_recovery() -> LintContext:
     """The watchdog-recovery dispatch (ISSUE 5): after a hung or failed
     dispatch the engine rebuilds its device state
@@ -401,6 +456,7 @@ ENTRYPOINTS = {
     "engine_multi_step": build_engine_multi_step,
     "engine_prefill": build_engine_prefill,
     "engine_recovery": build_engine_recovery,
+    "engine_step_telemetry": build_engine_step_telemetry,
     "collective_fused": build_collective_fused,
     "collective_windowed": build_collective_windowed,
     "collective_int8": build_collective_int8,

@@ -24,6 +24,9 @@ the CLI surface maps as:
   jitted entry points to jaxprs on a virtual CPU mesh and machine-check
   collective-axis / donation / dtype / host-sync invariants; exit-code
   gated for CI, ``--selfcheck`` proves every pass still fires.
+* ``perfgate`` — the perf-regression gate (telemetry/regression.py):
+  re-measure the A/B benchmark sections and fail (exit 1) any claim
+  row below the banked ``perf_capture/`` median minus tolerance.
 * ``info`` — topology summary: the master's membership view, hardware
   edition.
 
@@ -605,6 +608,28 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "(ema = d*ema + (1-d)*params per step), saved "
                         "as the checkpoint's own 'ema' item — decode "
                         "or eval them with --use-ema. 0 disables")
+    p.add_argument("--metrics-file", default=None, metavar="PATH",
+                   help="write the telemetry-registry snapshot "
+                        "(Prometheus text: train_steps_total / "
+                        "train_tokens_total / train_loss plus the "
+                        "train_step host/device/dispatch-gap "
+                        "histograms) every --metrics-interval and once "
+                        "at exit. Enables per-step device-time "
+                        "attribution on the single-process paths: each "
+                        "step blocks on its loss readback so the "
+                        "block-until-ready wall delta is the device "
+                        "time — a small pipelining cost, the "
+                        "attribution price (use --xprof-dir for the "
+                        "zero-perturbation device view). The hybrid "
+                        "DCN loop exports counters/loss and round "
+                        "spans only — a DCN round is not one dispatch")
+    p.add_argument("--metrics-interval", type=float, default=5.0,
+                   help="seconds between --metrics-file snapshots")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="expose the registry over stdlib HTTP "
+                        "(GET /metrics, /metrics.json on "
+                        "127.0.0.1:PORT; 0 = ephemeral, printed)")
     p.add_argument("--xprof-dir", default=None, metavar="DIR",
                    help="write a jax.profiler device trace "
                         "(TensorBoard/XProf-viewable: per-op device "
@@ -786,6 +811,77 @@ class _XprofWindow:
                   f"lower --steps-per-dispatch or raise --steps",
                   file=sys.stderr)
             self._state = 2
+
+
+class _TrainTelemetry:
+    """`train --metrics-file/--metrics-port` wiring (telemetry plane,
+    ISSUE 6): a MetricsRegistry with train_steps_total /
+    train_tokens_total / train_loss series plus a DeviceTimer
+    bracketing every step dispatch — host-vs-device split via the
+    blocked loss readback, ``train_step_dispatch_gap_ms`` as the
+    host-bubble series. Disabled (every method a no-op except the
+    optional tracer round span) when neither flag is set, so the
+    default train loop pays nothing."""
+
+    def __init__(self, args):
+        self.enabled = bool(getattr(args, "metrics_file", None)) \
+            or getattr(args, "metrics_port", None) is not None
+        self._stack = contextlib.ExitStack()
+        self.registry = None
+        self.timer = None
+        if not self.enabled:
+            return
+        from akka_allreduce_tpu.telemetry import MetricsRegistry
+        from akka_allreduce_tpu.telemetry.device import DeviceTimer
+        self.registry = MetricsRegistry()
+        self.timer = DeviceTimer("train_step", registry=self.registry)
+        self._steps = self.registry.counter(
+            "train_steps_total", help="optimizer steps applied")
+        self._tokens = self.registry.counter(
+            "train_tokens_total", help="tokens consumed")
+        self._loss = self.registry.gauge(
+            "train_loss", help="latest step loss")
+        if args.metrics_port is not None:
+            server = self._stack.enter_context(
+                self.registry.serve_http(port=args.metrics_port))
+            print(f"metrics -> http://127.0.0.1:{server.port}/metrics",
+                  file=sys.stderr)
+        if args.metrics_file:
+            self._stack.enter_context(self.registry.start_snapshotter(
+                args.metrics_file, args.metrics_interval))
+
+    @contextlib.contextmanager
+    def step_span(self, tracer=None, device=True, **fields):
+        """Bracket one dispatch. Yields the DeviceSpan (or None when
+        disabled) — callers mark_dispatched() after the async dispatch
+        call returns and block inside the span so the tail is the
+        device's. Also opens a ``train_round`` tracer span when the
+        (hybrid) run carries a tracer, making the DCN trainer's
+        round_complete / mask_published events its children.
+
+        ``device=False`` (the hybrid round loop) skips the DeviceTimer:
+        a DCN round is publish + wait + apply, not one device dispatch
+        — an unmarked span would export the whole round as host time
+        and a fabricated device_ms of 0, which misreads worse than no
+        sample (the hybrid run still exports counters/loss and the
+        round spans)."""
+        with contextlib.ExitStack() as s:
+            if tracer is not None:
+                s.enter_context(tracer.span("train_round", **fields))
+            ds = (s.enter_context(self.timer.span(**fields))
+                  if device and self.timer is not None else None)
+            yield ds
+
+    def on_step(self, n_tokens: float, loss=None, steps: int = 1) -> None:
+        if not self.enabled:
+            return
+        self._steps.inc(steps)
+        self._tokens.inc(n_tokens)
+        if loss is not None:
+            self._loss.set(float(loss))
+
+    def close(self) -> None:
+        self._stack.close()  # final snapshot write + server shutdown
 
 
 def _add_model_args(p: argparse.ArgumentParser) -> None:
@@ -1359,6 +1455,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
     steps_in_window = 0
     xprof = _XprofWindow(args.xprof_dir, start_step=start + 1,
                          n_steps=args.xprof_steps)
+    telem = _TrainTelemetry(args)
     # --guard-recompiles: opened after the run's FIRST step (which owns
     # the one legitimate compile), closed in the finally so the logging
     # state is restored even on preemption; verdict read after the loop
@@ -1481,8 +1578,14 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     if step_rng.random(nprocs)[rank] < args.straggle_prob:
                         time.sleep(1.5 * dcn.deadline_s)
                 try:
-                    params, opt_state, rep = dcn.run_round(
-                        params, opt_state, tokens)
+                    # nested round span (hybrid tracer): the DCN
+                    # trainer's round_complete / mask_published events
+                    # record as this span's children. device=False —
+                    # a DCN round is not one dispatch (see step_span)
+                    with telem.step_span(tracer, device=False,
+                                         round=i):
+                        params, opt_state, rep = dcn.run_round(
+                            params, opt_state, tokens)
                 except StalledBeyondRetention as exc:
                     # a stall can strike INSIDE run_round (waiting for a
                     # mask the master has since garbage-collected)
@@ -1493,6 +1596,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 # checkpoint and narration follow the APPLIED frontier
                 if rep is None:
                     continue
+                telem.on_step(b * t, loss=rep.loss)
                 serve_snapshot_requests(rep)
                 if chatty and rep.downed != last_downed:
                     # membership changes always narrate (not log-every
@@ -1590,16 +1694,32 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 if n == spd:
                     chunk_np = np.stack(
                         [build_batch(j)[1] for j in range(i, i + n)])
-                    params, opt_state, ms = multi(
-                        params, opt_state, jnp.asarray(chunk_np))
+                    with telem.step_span(chunk_steps=n) as ds:
+                        params, opt_state, ms = multi(
+                            params, opt_state, jnp.asarray(chunk_np))
+                        if ds is not None:
+                            ds.mark_dispatched()
+                            # block inside the span: the tail of the
+                            # span is the chunk's device time
+                            np.asarray(ms["loss"])
                 else:
                     # tail shorter than the compiled scan length: the
                     # per-step program instead of a second scan compile
                     for j in range(i, i + n):
-                        params, opt_state, m1 = step(
-                            params, opt_state,
-                            jnp.asarray(build_batch(j)[1]))
+                        with telem.step_span(step=j) as ds:
+                            params, opt_state, m1 = step(
+                                params, opt_state,
+                                jnp.asarray(build_batch(j)[1]))
+                            if ds is not None:
+                                ds.mark_dispatched()
+                                # scalar readback, not block_until_ready
+                                # (the relay backend resolves the latter
+                                # early — bench.py's rule)
+                                np.asarray(m1["loss"])
                     ms = jax.tree.map(lambda x: x[None], m1)
+                telem.on_step(n * b * t, steps=n,
+                              loss=(float(np.asarray(ms["loss"])[-1])
+                                    if telem.enabled else None))
                 last = i + n - 1
                 # --ckpt-every 0 means save-every-step on the per-step
                 # path (orbax's steps-since-last >= 0); the chunk
@@ -1642,20 +1762,32 @@ def _cmd_train(args: argparse.Namespace) -> int:
                                             P(batch_axes, "sp"))
             else:
                 tokens = jnp.asarray(batch_np)
-            if trainer is not None:
-                r = trainer.open_round()
-                # arrival simulation: each data rank lands on time or
-                # misses the deadline with --straggle-prob (a deployment
-                # reports real DCN arrival timestamps here instead)
-                for peer in range(trainer.clock.num_peers):
-                    late = step_rng.random() < args.straggle_prob
-                    trainer.clock.report_offset(
-                        r, peer,
-                        (2.0 if late else 0.0) * trainer.clock.deadline_s)
-                params, opt_state, metrics = trainer.run_round(
-                    params, opt_state, tokens)
-            else:
-                params, opt_state, metrics = step(params, opt_state, tokens)
+            with telem.step_span(step=i) as ds:
+                if trainer is not None:
+                    r = trainer.open_round()
+                    # arrival simulation: each data rank lands on time
+                    # or misses the deadline with --straggle-prob (a
+                    # deployment reports real DCN arrival timestamps
+                    # here instead)
+                    for peer in range(trainer.clock.num_peers):
+                        late = step_rng.random() < args.straggle_prob
+                        trainer.clock.report_offset(
+                            r, peer, (2.0 if late else 0.0)
+                            * trainer.clock.deadline_s)
+                    params, opt_state, metrics = trainer.run_round(
+                        params, opt_state, tokens)
+                else:
+                    params, opt_state, metrics = step(params, opt_state,
+                                                      tokens)
+                loss_now = None
+                if ds is not None:
+                    ds.mark_dispatched()
+                    # blocked scalar readback INSIDE the span: the tail
+                    # is the step's device time (the attribution price
+                    # --metrics-file documents; --xprof-dir is the
+                    # zero-perturbation alternative)
+                    loss_now = float(np.asarray(metrics["loss"]))
+            telem.on_step(b * t, loss=loss_now)
             if args.guard_recompiles and guard is None:
                 from akka_allreduce_tpu.analysis.recompile import \
                     CompileLog
@@ -1698,6 +1830,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
     finally:
         if guard is not None:
             guard.__exit__(None, None, None)
+        try:
+            telem.close()  # final metrics snapshot + server shutdown
+        except Exception as exc:
+            print(f"WARNING: metrics snapshot flush failed: {exc}",
+                  file=sys.stderr)
         # Preemption/SIGINT is this feature's target scenario: always let
         # an in-flight async save land (and any open device trace flush)
         # before the process dies. The trace flush must not be able to
@@ -1887,6 +2024,34 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--trace-file", default=None,
                    help="write serve_* lifecycle events + prefill/step "
                         "spans (JSONL, runtime/tracing.py) here on exit")
+    # -- telemetry plane (ISSUE 6)
+    p.add_argument("--perfetto-file", default=None, metavar="PATH",
+                   help="write the SAME event stream as Perfetto-"
+                        "loadable Chrome-trace JSON (nested per-request "
+                        "spans, engine dispatch brackets with host/"
+                        "device split; telemetry/chrome_trace.py) — "
+                        "load it at https://ui.perfetto.dev")
+    p.add_argument("--metrics-file", default=None, metavar="PATH",
+                   help="write the metrics-registry snapshot "
+                        "(Prometheus text: serve_* counters, latency "
+                        "summaries, engine dispatch/gap histograms, "
+                        "host gauges) every --metrics-interval plus "
+                        "once at exit")
+    p.add_argument("--metrics-interval", type=float, default=5.0,
+                   help="seconds between --metrics-file snapshots")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="expose the registry over stdlib HTTP for the "
+                        "run's duration: GET /metrics (Prometheus "
+                        "text) and /metrics.json on 127.0.0.1:PORT "
+                        "(0 = ephemeral, printed to stderr)")
+    p.add_argument("--drain-dir", default=None, metavar="DIR",
+                   help="persist a SIGTERM drain's in-flight request "
+                        "snapshots here (runtime/checkpoint.py JSON "
+                        "sidecar) and RESTORE any snapshots found at "
+                        "startup — a preemption drain survives the "
+                        "process boundary with bitwise-parity "
+                        "continuation")
     p.add_argument("--selfcheck", action="store_true",
                    help="CI smoke: tiny fixed model, 8 synthetic "
                         "requests (half with an EOS), asserts every "
@@ -1902,7 +2067,14 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     ignores the model-shape flags — the check must stay cheap and
     deterministic no matter how the command is invoked. ``--decode-steps
     S`` runs the fused block engine and ALSO cross-checks it against the
-    S=1 engine (three-way parity: block == per-token == generate)."""
+    S=1 engine (three-way parity: block == per-token == generate).
+
+    The telemetry plane rides the same run (ISSUE 6 acceptance): the
+    Prometheus snapshot must agree EXACTLY with the summary dict
+    (serve_completed_total, TTFT quantiles), the Perfetto export must
+    be valid JSON with one nested request span per request, and the
+    churn phase runs with telemetry ATTACHED under the zero-compile
+    guard — telemetry may never cost a program."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1910,6 +2082,7 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     from akka_allreduce_tpu.models.generate import generate
     from akka_allreduce_tpu.models.transformer import (TransformerConfig,
                                                        init_transformer)
+    from akka_allreduce_tpu.runtime.tracing import Tracer
     from akka_allreduce_tpu.serving import (EngineConfig, Request,
                                             RequestScheduler,
                                             SchedulerConfig, ServingEngine,
@@ -1931,9 +2104,10 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
             eos_token=eos if rid % 2 else None))
     s_steps = args.decode_steps  # >= 1, validated by _cmd_serve
     ecfg = EngineConfig(num_slots=3, decode_steps=s_steps)
-    engine = ServingEngine(params, cfg, ecfg)
+    tracer = Tracer()
+    engine = ServingEngine(params, cfg, ecfg, tracer=tracer)
     sched = RequestScheduler(SchedulerConfig(), num_slots=3)
-    metrics = ServingMetrics()
+    metrics = ServingMetrics(tracer=tracer)
     for r in reqs:
         metrics.on_submit(r.rid)
         sched.submit(r)
@@ -1972,24 +2146,90 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     tput = metrics.decode_tokens_per_s or 0.0
     if tput <= 0.0:
         failures.append(f"throughput not positive: {tput}")
+    # -- telemetry plane (ISSUE 6 acceptance) -------------------------
+    # The Prometheus snapshot and the summary dict read the SAME cells
+    # (serving/metrics.py registers pull collectors) — assert the two
+    # surfaces agree exactly, through the text format round-trip
+    from akka_allreduce_tpu.telemetry import parse_prometheus_text
+    summ = metrics.summary()
+    prom = parse_prometheus_text(metrics.registry.to_prometheus_text())
+    if prom.get(("serve_completed_total", ())) \
+            != summ["requests"]["completed"]:
+        failures.append(
+            f"prometheus serve_completed_total "
+            f"{prom.get(('serve_completed_total', ()))} != summary "
+            f"{summ['requests']['completed']}")
+    for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+        got = prom.get(("serve_ttft_seconds", (("quantile", q),)))
+        want = summ["ttft_ms"][key]
+        if got is None or round(got * 1e3, 3) != want:
+            failures.append(f"prometheus ttft quantile {q} "
+                            f"{got} (s) != summary {key} {want} (ms)")
+    # the Perfetto export must be loadable JSON whose synthesized
+    # request spans nest their queued/decode children (per-request
+    # correlation view, telemetry/chrome_trace.py)
+    trace = tracer.to_chrome_trace()
+    try:
+        json.loads(json.dumps(trace))
+    except (TypeError, ValueError) as exc:
+        failures.append(f"chrome trace not JSON-serializable: {exc}")
+        trace = {"traceEvents": []}
+    req_spans = {e["tid"]: e for e in trace["traceEvents"]
+                 if e.get("name") == "request"}
+    if len(req_spans) != len(reqs):
+        failures.append(f"{len(req_spans)} request spans in the "
+                        f"chrome trace, want {len(reqs)}")
+    for e in trace["traceEvents"]:
+        if e.get("name") not in ("queued", "decode"):
+            continue
+        parent = req_spans.get(e["tid"])
+        if parent is None or e["ts"] < parent["ts"] - 1e-6 \
+                or e["ts"] + e["dur"] > parent["ts"] + parent["dur"] + 1e-6:
+            failures.append(
+                f"{e['name']} slice on tid {e['tid']} not nested "
+                f"inside its request span")
+            break
+    dispatch_count = sum(1 for e in trace["traceEvents"]
+                         if e.get("name") == "engine_dispatch")
+    if dispatch_count != engine.decode_dispatches:
+        failures.append(f"{dispatch_count} engine_dispatch spans != "
+                        f"{engine.decode_dispatches} dispatches")
     # the no-recompile contract (analysis/recompile.py): a SECOND run
     # over the same request shapes — fresh engine state, full slot
-    # churn — must compile nothing; the first run above was the warmup
+    # churn, telemetry ATTACHED — must compile nothing; the first run
+    # above was the warmup, and telemetry being host-side only is
+    # exactly what this guard pins
     from akka_allreduce_tpu.analysis.recompile import (RecompileError,
                                                        no_recompiles)
-    engine2 = ServingEngine(params, cfg, ecfg)
+    tracer2 = Tracer()
+    engine2 = ServingEngine(params, cfg, ecfg, tracer=tracer2)
     sched2 = RequestScheduler(SchedulerConfig(), num_slots=3)
+    metrics2 = ServingMetrics(tracer=tracer2)
     for r in reqs:
         sched2.submit(r)
     try:
-        with no_recompiles("selfcheck churn (warmed shapes)"):
-            results2 = serve_loop(engine2, sched2, max_dispatches=200)
+        with no_recompiles("selfcheck churn (warmed shapes, "
+                           "telemetry on)"):
+            results2 = serve_loop(engine2, sched2, metrics=metrics2,
+                                  max_dispatches=200)
     except RecompileError as exc:
         failures.append(str(exc))
         results2 = {}
     for rid, out in results2.items():
         if list(out[0]) != list(results[rid][0]):
             failures.append(f"rid={rid}: churn run diverged")
+    # artifacts on request (CI uploads these)
+    if args.metrics_file:
+        metrics.registry.write_snapshot(args.metrics_file)
+        print(f"metrics snapshot -> {args.metrics_file}",
+              file=sys.stderr)
+    if args.perfetto_file:
+        tracer.write_chrome_trace(args.perfetto_file)
+        print(f"perfetto trace -> {args.perfetto_file}",
+              file=sys.stderr)
+    if args.trace_file:
+        tracer.write_jsonl(args.trace_file)
+        print(f"trace -> {args.trace_file}", file=sys.stderr)
     print(json.dumps({
         "selfcheck": "ok" if not failures else "FAIL",
         "requests": len(reqs),
@@ -1998,6 +2238,14 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
         "decode_dispatches": engine.decode_dispatches,
         "wasted_tokens": engine.wasted_tokens,
         "churn_recompiles": 0 if results2 else None,
+        "telemetry": {
+            "prometheus_series": len(prom),
+            "trace_events": len(trace["traceEvents"]),
+            "request_spans": len(req_spans),
+            "dispatch_gap_ms_p50":
+                engine.device_time_summary()
+                ["dispatch_gap_ms"].get("p50"),
+        },
         "failures": failures,
     }))
     return 0 if not failures else 1
@@ -2227,6 +2475,49 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         from akka_allreduce_tpu.models.transformer import init_transformer
         params = init_transformer(jax.random.key(args.seed), mcfg)
 
+    # a previous process's drain state loads BEFORE the synthetic rids
+    # are assigned: restored requests keep their original rids, so the
+    # fresh load must start past them — a collision would double-bind
+    # in the scheduler (strict accounting raises) or silently merge two
+    # requests' results
+    resumed = []
+    if args.drain_dir:
+        from akka_allreduce_tpu.serving import load_drained
+        try:
+            resumed = load_drained(args.drain_dir)
+        except (ValueError, KeyError, TypeError, OSError) as exc:
+            # a corrupt / hand-edited / future-version sidecar is an
+            # operator problem deserving an operator message, not a
+            # traceback (the same courtesy the bucket check below pays)
+            print(f"error: --drain-dir {args.drain_dir} holds an "
+                  f"unreadable drained-requests state ({exc}); move "
+                  f"it aside to start fresh, or restore it from the "
+                  f"preempted run's copy", file=sys.stderr)
+            return 2
+        if buckets:
+            # a restore replays prompt + generated-so-far through
+            # prefill: that REPLAY length must fit the bucket set, or
+            # engine.restore would die mid-startup and the promised
+            # drain continuation never happen. The snapshots are on
+            # disk, so validate the actual lengths, with the exact
+            # bucket the operator needs in the message
+            too_long = [(rr.req.rid,
+                         len(rr.req.prompt) + len(rr.generated))
+                        for rr in resumed
+                        if len(rr.req.prompt) + len(rr.generated)
+                        > max(buckets)]
+            if too_long:
+                rid, n = max(too_long, key=lambda t: t[1])
+                print(f"error: --drain-dir holds {len(too_long)} "
+                      f"drained request(s) whose replay (prompt + "
+                      f"generated) exceeds the largest prefill bucket "
+                      f"{max(buckets)} (worst: rid {rid} needs {n}); "
+                      f"add a bucket >= {n} to --prefill-buckets or "
+                      f"drop the flag for exact-length prefill",
+                      file=sys.stderr)
+                return 2
+    rid_base = 1 + max((rr.req.rid for rr in resumed), default=-1)
+
     rng = np.random.default_rng(args.seed)
     arrivals = np.zeros(args.requests)
     if args.load == "open":
@@ -2234,9 +2525,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                                              size=args.requests))
     t0 = time.monotonic()
     reqs = []
-    for rid in range(args.requests):
+    for i in range(args.requests):
+        rid = rid_base + i
         plen = int(rng.integers(p_lo, p_hi + 1))
-        arrival = t0 + float(arrivals[rid])
+        arrival = t0 + float(arrivals[i])
         reqs.append(Request(
             rid=rid,
             prompt=tuple(int(x) for x in rng.integers(
@@ -2248,8 +2540,23 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                       if args.deadline_slack_s > 0 else None),
             submitted_at=arrival))
 
-    with tracer_to_file(args.trace_file) as tracer:
+    from akka_allreduce_tpu.runtime.tracing import Tracer
+
+    with contextlib.ExitStack() as stack:
+        tracer = stack.enter_context(tracer_to_file(args.trace_file))
+        if tracer is None and args.perfetto_file:
+            # Perfetto export wants the event stream even when no JSONL
+            # was asked for — same tracer, second renderer
+            tracer = Tracer()
         metrics = ServingMetrics(tracer=tracer)
+        if args.metrics_port is not None:
+            server = stack.enter_context(
+                metrics.registry.serve_http(port=args.metrics_port))
+            print(f"metrics -> http://127.0.0.1:{server.port}/metrics",
+                  file=sys.stderr)
+        if args.metrics_file:
+            stack.enter_context(metrics.registry.start_snapshotter(
+                args.metrics_file, args.metrics_interval))
         try:
             engine = ServingEngine(
                 params, mcfg,
@@ -2280,6 +2587,16 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         except ValueError as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 2
+        # a previous process's preemption drain (loaded above, before
+        # rid assignment), restored across the boundary (--drain-dir;
+        # OPERATIONS.md "Preemption drain"): snapshots re-enter through
+        # serve_loop's resume hook AHEAD of the fresh load and continue
+        # with bitwise parity
+        for rr in resumed:
+            metrics.on_submit(rr.req.rid)
+        if resumed:
+            print(f"restoring {len(resumed)} drained request(s) "
+                  f"from {args.drain_dir}", file=sys.stderr)
         for r in reqs:
             metrics.on_submit(r.rid)
             try:
@@ -2297,9 +2614,29 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         try:
             with metrics.host_sampler() as sampler, \
                     CompileLog() as compiles:
-                results = serve_loop(engine, sched, metrics=metrics)
+                results = serve_loop(engine, sched, metrics=metrics,
+                                     resume=resumed)
         finally:
             signal.signal(signal.SIGTERM, prev_term)
+        drain_path = None
+        if args.drain_dir:
+            from akka_allreduce_tpu.serving import (clear_drained,
+                                                    persist_drained)
+            if engine.drained:
+                drain_path = persist_drained(args.drain_dir,
+                                             engine.drained,
+                                             metrics=metrics)
+                print(f"persisted {len(engine.drained)} drained "
+                      f"request(s) -> {drain_path} (restore with "
+                      f"--drain-dir on the next run)", file=sys.stderr)
+            else:
+                # the restored requests finished: a stale drain file
+                # must not be replayed into a third run
+                clear_drained(args.drain_dir)
+        if args.perfetto_file and tracer is not None:
+            n = tracer.write_chrome_trace(args.perfetto_file)
+            print(f"perfetto trace ({n} events) -> "
+                  f"{args.perfetto_file}", file=sys.stderr)
     report = {
         "config": {"slots": args.slots, "requests": args.requests,
                    "load": args.load, "policy": args.policy,
@@ -2330,6 +2667,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         "compiled_programs": compiles.count,
         "kv_cache_mb": round(engine.kv_cache_bytes() / 1e6, 2),
         "host": sampler.summary(),
+        # host-vs-device attribution per decode dispatch plus the
+        # dispatch_gap_ms host bubble (telemetry/device.py) — the
+        # overlap-is-actually-overlapping numbers
+        "device_time": engine.device_time_summary(),
+        "resumed": len(resumed),
+        "drain_persisted": (len(engine.drained) if drain_path else 0),
         **metrics.summary(),
     }
     if args.trace_file:
@@ -2424,6 +2767,143 @@ def _cmd_lint(args: argparse.Namespace) -> int:
     else:
         print(render_text(names, findings))
     return exit_code(findings, strict=args.strict)
+
+
+def _add_perfgate(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "perfgate", help="perf-regression gate (telemetry/regression"
+        ".py): re-measure the A/B benchmark sections and compare "
+        "against the banked perf_capture/ medians within per-section "
+        "tolerances — exit 1 on any regressed claim row (ROADMAP item "
+        "5's closing half; runs as a tier-1 CI job)")
+    p.add_argument("--capture-dir",
+                   default=os.path.join(os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))), "perf_capture"),
+                   help="banked captures directory (default: the "
+                        "repo's perf_capture/)")
+    p.add_argument("--sections",
+                   default="serving_throughput,multi_step_decode",
+                   help="comma list of sections to gate (known: "
+                        "serving_throughput, multi_step_decode, "
+                        "ab_overlap). Sections with no banked rows "
+                        "skip with a note — the gate guards banked "
+                        "claims, it does not invent them")
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="relative tolerance override for every "
+                        "section (default: per-section values derived "
+                        "from each capture's recorded run-to-run "
+                        "spread — see telemetry/regression.py)")
+    p.add_argument("--gate-all", action="store_true",
+                   help="gate every numeric row, not just the "
+                        "speedup/best claim rows (for quiet pinned "
+                        "boxes; raw tok/s rows are machine-dependent)")
+    p.add_argument("--fresh-file", default=None, metavar="PATH",
+                   help="compare these rows instead of re-measuring: "
+                        "a JSON object {section: [rows...]} or, with "
+                        "a single --sections entry, a JSON array / "
+                        "JSONL stream of {metric, value} rows (offline "
+                        "capture triage)")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="also write the JSON verdict here (CI "
+                        "artifact)")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text")
+    _add_backend_args(p)
+
+
+def _cmd_perfgate(args: argparse.Namespace) -> int:
+    from akka_allreduce_tpu.telemetry.regression import (SECTIONS,
+                                                         run_gate)
+
+    sections = [s.strip() for s in args.sections.split(",")
+                if s.strip()]
+    if not sections:
+        print("error: --sections named no sections", file=sys.stderr)
+        return 2
+    unknown = [s for s in sections if s not in SECTIONS]
+    if unknown:
+        print(f"error: unknown section(s) {unknown}; have "
+              f"{list(SECTIONS)}", file=sys.stderr)
+        return 2
+    if args.tolerance is not None \
+            and not 0.0 <= args.tolerance < 0.5:
+        print(f"error: --tolerance must be in [0, 0.5) — at 0.5 a "
+              f"2x regression would pass the gate — got "
+              f"{args.tolerance}", file=sys.stderr)
+        return 2
+    fresh_by_section = None
+    if args.fresh_file:
+        try:
+            with open(args.fresh_file) as f:
+                text = f.read()
+            try:
+                doc = json.loads(text)
+            except ValueError:
+                # JSONL stream of row objects (the bench harness's
+                # native output format)
+                doc = [json.loads(line) for line in text.splitlines()
+                       if line.strip()]
+        except (OSError, ValueError) as exc:
+            print(f"error: cannot read --fresh-file: {exc}",
+                  file=sys.stderr)
+            return 2
+        if isinstance(doc, list):
+            if len(sections) != 1:
+                print("error: a row-array --fresh-file needs exactly "
+                      "one --sections entry to attribute the rows to",
+                      file=sys.stderr)
+                return 2
+            fresh_by_section = {sections[0]: doc}
+        else:
+            fresh_by_section = doc
+    uncovered = [s for s in sections
+                 if fresh_by_section is None
+                 or s not in fresh_by_section]
+    if uncovered:
+        # these sections will be measured LIVE (device programs
+        # dispatch) — honor the backend flags the way every measuring
+        # subcommand does, and say so when the user gave a rows file
+        # that only partially covers the request
+        if args.fresh_file:
+            print(f"note: --fresh-file covers "
+                  f"{sorted(fresh_by_section or {})} only; measuring "
+                  f"{uncovered} live", file=sys.stderr)
+        _apply_backend_flags(args)
+    report = run_gate(args.capture_dir, sections=sections,
+                      fresh_by_section=fresh_by_section,
+                      tolerance=args.tolerance, gate_all=args.gate_all)
+    verdict = report.as_dict()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=1)
+    if args.format == "json":
+        print(json.dumps(verdict, indent=1))
+    else:
+        for section, results in report.sections.items():
+            for r in results:
+                if r.ok is None:
+                    tag = "  .."
+                else:
+                    tag = "PASS" if r.ok else "FAIL"
+                line = f"{tag} {section}/{r.metric}"
+                if r.fresh_value is not None:
+                    line += f": fresh {r.fresh_value:g}"
+                if r.banked_median is not None:
+                    line += f" vs banked median {r.banked_median:g}"
+                if r.threshold is not None:
+                    line += f" (floor {r.threshold:g})"
+                if r.note:
+                    line += f" — {r.note}"
+                print(line)
+        for section, reason in report.skipped.items():
+            print(f"SKIP {section}: {reason}")
+        n_fail = len(report.failed)
+        vacuous = ("" if report.gated or report.skipped else
+                   " (nothing gated: no claim rows banked for these "
+                   "sections — check --capture-dir / --sections)")
+        print(f"perfgate: {len(report.gated)} gated rows, {n_fail} "
+              f"regressed -> {'FAIL' if n_fail else 'PASS'}{vacuous}")
+    return 0 if report.ok else 1
 
 
 def _add_eval(sub: argparse._SubParsersAction) -> None:
@@ -2525,6 +3005,7 @@ def main(argv: list[str] | None = None) -> int:
     _add_serve(sub)
     _add_eval(sub)
     _add_lint(sub)
+    _add_perfgate(sub)
     p_info = sub.add_parser("info", help="topology summary; --scaling "
                             "prints the analytic ICI scaling curve")
     p_info.add_argument("--scaling", action="store_true",
@@ -2548,6 +3029,7 @@ def main(argv: list[str] | None = None) -> int:
             "worker": _cmd_worker, "train": _cmd_train,
             "generate": _cmd_generate, "serve": _cmd_serve,
             "eval": _cmd_eval, "lint": _cmd_lint,
+            "perfgate": _cmd_perfgate,
             "info": _cmd_info, "bench": _cmd_bench}[args.cmd](args)
 
 

@@ -19,11 +19,54 @@ every ``save_interval_steps``.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 from typing import Any, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+
+# -- JSON sidecar state (serving drain persistence, ISSUE 6) ------------
+#
+# Small host-plane state that must survive a process boundary but is
+# not a sharded-array checkpoint: drained ResumableRequest snapshots
+# (serving/engine.py persist_drained). Same atomicity rule as orbax's
+# step directories — write-then-rename, so a preemption mid-save never
+# corrupts the last complete state — without dragging the array
+# machinery into a list of token ids.
+
+def save_state_json(directory: str, name: str, payload: dict) -> str:
+    """Atomically write ``payload`` as ``<directory>/<name>.json``
+    (telemetry/registry.py ``atomic_write_text``: write + fsync +
+    rename — a crash mid-write leaves the previous complete file,
+    never a torn one). Returns the path."""
+    from akka_allreduce_tpu.telemetry.registry import atomic_write_text
+    os.makedirs(directory, exist_ok=True)
+    return atomic_write_text(os.path.join(directory, f"{name}.json"),
+                             json.dumps(payload))
+
+
+def load_state_json(directory: str, name: str) -> Optional[dict]:
+    """Read a :func:`save_state_json` file; None when absent."""
+    path = os.path.join(directory, f"{name}.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def delete_state_json(directory: str, name: str) -> bool:
+    """Remove a sidecar state file (a consumed drain must not be
+    restored twice); returns whether a file existed."""
+    path = os.path.join(directory, f"{name}.json")
+    try:
+        os.remove(path)
+        return True
+    except FileNotFoundError:
+        return False
 
 
 def _place_like(like: Any, raw: Any) -> Any:

@@ -75,10 +75,23 @@ class HostResourceSampler:
     """
 
     def __init__(self, pids: Optional[Sequence[int]] = None,
-                 interval_s: float = 1.0, tracer=None):
+                 interval_s: float = 1.0, tracer=None, registry=None):
         self.pids = list(pids) if pids else [os.getpid()]
         self.interval_s = interval_s
         self.tracer = tracer
+        # telemetry plane (ISSUE 6): samples also land as registry
+        # gauges so a --metrics-port scrape sees the host story live
+        # (host_peak_rss_mb is a gauge, not a counter: it is a
+        # point-in-time maximum, monotone only within one run)
+        self._g_rss = self._g_cpu = self._g_peak = None
+        if registry is not None:
+            self._g_rss = registry.gauge(
+                "host_rss_mb", help="summed RSS across sampled pids")
+            self._g_cpu = registry.gauge(
+                "host_cpu_pct",
+                help="summed CPU utilisation, percent of one core")
+            self._g_peak = registry.gauge(
+                "host_peak_rss_mb", help="run peak of host_rss_mb")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._peak_rss_kb = 0
@@ -96,6 +109,11 @@ class HostResourceSampler:
             self._cpu_pcts.append(cpu_pct)
         self._peak_rss_kb = max(self._peak_rss_kb, rss)
         self._samples += 1
+        if self._g_rss is not None:
+            self._g_rss.set(round(rss / 1024, 1))
+            self._g_peak.set(round(self._peak_rss_kb / 1024, 1))
+            if cpu_pct is not None:
+                self._g_cpu.set(round(cpu_pct, 1))
         if self.tracer is not None:
             fields = {"rss_mb": round(rss / 1024, 1),
                       "pids": len(self.pids)}

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -37,17 +38,28 @@ from typing import Any, Optional
 @dataclasses.dataclass(frozen=True)
 class TraceEvent:
     """One structured event: monotonic timestamp, kind, free-form fields.
-    ``duration_s`` is present only for span-produced events."""
+    ``duration_s`` is present only for span-produced events.
+    ``span_id`` / ``parent_id`` carry the nested-span parentage: every
+    span gets a tracer-unique id, and any event recorded while a span
+    is open (child spans AND point events) names the enclosing span as
+    its parent — the structure the Perfetto export renders as nested
+    slices and tests assert on directly."""
 
     ts: float
     kind: str
     fields: dict[str, Any]
     duration_s: Optional[float] = None
+    span_id: Optional[int] = None
+    parent_id: Optional[int] = None
 
     def as_dict(self) -> dict[str, Any]:
         d = {"ts": self.ts, "kind": self.kind, **self.fields}
         if self.duration_s is not None:
             d["duration_s"] = self.duration_s
+        if self.span_id is not None:
+            d["span_id"] = self.span_id
+        if self.parent_id is not None:
+            d["parent_id"] = self.parent_id
         return d
 
 
@@ -56,7 +68,8 @@ class Tracer:
 
     Not thread-safe by design: each host process traces its own protocol
     engine (one mailbox, one thread — the same safety argument as the
-    reference's actor model, SURVEY.md §5.2).
+    reference's actor model, SURVEY.md §5.2). The open-span stack rides
+    that same rule: spans nest lexically in the tracing thread.
     """
 
     def __init__(self, clock=time.perf_counter, max_events: int = 1_000_000):
@@ -64,22 +77,65 @@ class Tracer:
         self._max_events = max_events
         self.events: list[TraceEvent] = []
         self.counters: dict[str, int] = defaultdict(int)
+        self._next_span_id = 1
+        # the open-span stack is PER THREAD: background recorders (the
+        # host sampler, a watchdog worker) must not have their events
+        # parented to whatever span the main thread happens to have
+        # open — cross-thread "nesting" would be a lie about structure
+        self._tls = threading.local()
+
+    @property
+    def _span_stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    @property
+    def current_span_id(self) -> Optional[int]:
+        """The innermost span open ON THIS THREAD (None outside any)."""
+        stack = self._span_stack
+        return stack[-1] if stack else None
 
     def record(self, kind: str, **fields: Any) -> TraceEvent:
-        ev = TraceEvent(ts=self._clock(), kind=kind, fields=fields)
+        ev = TraceEvent(ts=self._clock(), kind=kind, fields=fields,
+                        parent_id=self.current_span_id)
         self._append(ev)
         return ev
 
     @contextmanager
     def span(self, kind: str, **fields: Any):
-        """Time a block; records one event with ``duration_s`` on exit."""
+        """Time a block; records one event with ``duration_s`` on exit.
+        Spans opened (and point events recorded) inside the block carry
+        this span's id as their ``parent_id`` — nesting is structural,
+        not inferred from timestamps. Yields the span id (useful as a
+        correlation handle)."""
+        sid = self._next_span_id
+        self._next_span_id += 1
+        parent = self.current_span_id
+        self._span_stack.append(sid)
         t0 = self._clock()
         try:
-            yield
+            yield sid
         finally:
             t1 = self._clock()
+            self._span_stack.pop()
             self._append(TraceEvent(ts=t0, kind=kind, fields=fields,
-                                    duration_s=t1 - t0))
+                                    duration_s=t1 - t0, span_id=sid,
+                                    parent_id=parent))
+
+    def record_span(self, kind: str, ts: float, duration_s: float,
+                    **fields: Any) -> TraceEvent:
+        """Append an already-timed span (the device-span helper measures
+        host/device splits itself and reports afterwards). Parented to
+        the currently open span like any other event."""
+        sid = self._next_span_id
+        self._next_span_id += 1
+        ev = TraceEvent(ts=ts, kind=kind, fields=fields,
+                        duration_s=duration_s, span_id=sid,
+                        parent_id=self.current_span_id)
+        self._append(ev)
+        return ev
 
     def _append(self, ev: TraceEvent) -> None:
         self.counters[ev.kind] += 1
@@ -133,6 +189,24 @@ class Tracer:
             for ev in self.events:
                 f.write(json.dumps(ev.as_dict()) + "\n")
         return len(self.events)
+
+    def to_chrome_trace(self) -> dict:
+        """The SAME event stream as Perfetto-loadable Chrome-trace JSON
+        (telemetry/chrome_trace.py): spans become nested slices via
+        their span/parent ids, rid-carrying events land on per-request
+        tracks, and per-request lifecycle slices (submit -> queued ->
+        decode -> finish) are synthesized from the instant events the
+        metrics plane records."""
+        from akka_allreduce_tpu.telemetry.chrome_trace import chrome_trace
+        return chrome_trace(self.events)
+
+    def write_chrome_trace(self, path: str) -> int:
+        """Write :meth:`to_chrome_trace` JSON; returns trace events
+        written (load the file in https://ui.perfetto.dev or
+        chrome://tracing)."""
+        from akka_allreduce_tpu.telemetry.chrome_trace import (
+            write_chrome_trace)
+        return write_chrome_trace(self.events, path)
 
     @staticmethod
     def read_jsonl(path: str) -> list[dict[str, Any]]:

@@ -42,6 +42,9 @@ from akka_allreduce_tpu.serving.engine import (
     ResumableRequest,
     ServingEngine,
     WatchdogTimeout,
+    clear_drained,
+    load_drained,
+    persist_drained,
     serve_loop,
 )
 from akka_allreduce_tpu.serving.metrics import Histogram, ServingMetrics
@@ -58,6 +61,9 @@ __all__ = [
     "ResumableRequest",
     "ServingEngine",
     "WatchdogTimeout",
+    "clear_drained",
+    "load_drained",
+    "persist_drained",
     "serve_loop",
     "Histogram",
     "ServingMetrics",

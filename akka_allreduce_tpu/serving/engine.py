@@ -528,6 +528,32 @@ class ServingEngine:
         # tripped (still-wedged) worker is abandoned and replaced
         self._executor: Optional[
             concurrent.futures.ThreadPoolExecutor] = None
+        # device-time attribution (telemetry/device.py), created lazily
+        # at the first dispatch so it lands on the metrics registry the
+        # serve loop attaches AFTER construction
+        self._dtimer = None
+
+    def _device_timer(self):
+        if self._dtimer is None:
+            from akka_allreduce_tpu.telemetry.device import DeviceTimer
+            # annotate_site="dispatch": profiler annotations are
+            # thread-local, and with the watchdog armed the dispatch
+            # runs on the executor thread — the annotation must open
+            # inside the dispatched callable (see _dispatch_single)
+            self._dtimer = DeviceTimer(
+                "engine",
+                registry=(self.metrics.registry
+                          if self.metrics is not None else None),
+                tracer=self.tracer, annotate_site="dispatch")
+        return self._dtimer
+
+    def device_time_summary(self) -> dict:
+        """host/device/dispatch-gap histograms across this engine's
+        decode dispatches (telemetry/device.py): ``dispatch_gap_ms`` is
+        the host-side bubble between consecutive dispatches — the
+        number that says whether the loop is feeding the device or the
+        device is waiting on the loop."""
+        return self._device_timer().summary()
 
     def _fresh_state(self) -> dict:
         """The device state at its warmup avals — used at construction
@@ -692,6 +718,10 @@ class ServingEngine:
         self._state = self._fresh_state()
         self._dev_vectors = None
         self._vectors_dirty = True
+        if self._dtimer is not None:
+            # the wedge/rebuild interval is recovery, not a scheduling
+            # bubble — it must not pollute the dispatch_gap_ms series
+            self._dtimer.reset_gap()
         if self.metrics is not None:
             self.metrics.on_fault_survived(reason)
         if self.tracer is not None:
@@ -827,9 +857,11 @@ class ServingEngine:
         # live rebuilt ones
         state_in, pos_in = self._state, jnp.asarray(self._pos)
         try:
-            with span:
+            with span, self._device_timer().span(
+                    occupied=self.occupied) as dspan:
                 state, packed = self._guarded_dispatch(
-                    lambda: self._dispatch_single(state_in, pos_in))
+                    lambda: self._dispatch_single(state_in, pos_in,
+                                                  dspan))
         except WatchdogTimeout:
             self.watchdog_trips += 1
             if self.metrics is not None:
@@ -866,10 +898,18 @@ class ServingEngine:
         self._evict_expired(finished)
         return finished
 
-    def _dispatch_single(self, state_in: dict, pos_in):
-        state, packed = _engine_step(
-            self.params, state_in, pos_in, self.cfg)
-        return state, np.asarray(packed)  # the one host readback
+    def _dispatch_single(self, state_in: dict, pos_in, dspan=None):
+        with (dspan.annotation() if dspan is not None
+              else _null_span()):
+            state, packed = _engine_step(
+                self.params, state_in, pos_in, self.cfg)
+            if dspan is not None:
+                # dispatch returned, readback not yet forced:
+                # everything after this mark is the block-until-ready
+                # wall delta — the device-time attribution
+                # (telemetry/device.py)
+                dspan.mark_dispatched()
+            return state, np.asarray(packed)  # the one host readback
 
     def _step_block(self) -> list[tuple[int, Request, list, str]]:
         """The S>1 dispatch: one fused ``_engine_multi_step`` program,
@@ -899,11 +939,13 @@ class ServingEngine:
         # live state)
         state_in = self._state
         try:
-            with span:
+            with span, self._device_timer().span(
+                    occupied=self.occupied,
+                    decode_steps=s_steps) as dspan:
                 state, block, pos_d, done_d, rem_d = \
                     self._guarded_dispatch(
                         lambda: self._dispatch_block(state_in, d,
-                                                     s_steps))
+                                                     s_steps, dspan))
         except WatchdogTimeout:
             self.watchdog_trips += 1
             if self.metrics is not None:
@@ -970,12 +1012,18 @@ class ServingEngine:
         self._evict_expired(finished)
         return finished
 
-    def _dispatch_block(self, state_in: dict, d: dict, s_steps: int):
-        state, packed, pos_d, done_d, rem_d = _engine_multi_step(
-            self.params, state_in, d["pos"], d["done"],
-            d["remaining"], d["eos"], d["stops"], self.cfg, s_steps)
-        return (state, np.asarray(packed),  # ONE readback per S tokens
-                pos_d, done_d, rem_d)
+    def _dispatch_block(self, state_in: dict, d: dict, s_steps: int,
+                        dspan=None):
+        with (dspan.annotation() if dspan is not None
+              else _null_span()):
+            state, packed, pos_d, done_d, rem_d = _engine_multi_step(
+                self.params, state_in, d["pos"], d["done"],
+                d["remaining"], d["eos"], d["stops"], self.cfg,
+                s_steps)
+            if dspan is not None:
+                dspan.mark_dispatched()  # see _dispatch_single
+            return (state, np.asarray(packed),  # ONE readback per S
+                    pos_d, done_d, rem_d)
 
 
 class _null_span:
@@ -986,14 +1034,87 @@ class _null_span:
         return None
 
 
+# -- drain persistence (ISSUE 6 / PR 5 loose end) -----------------------
+#
+# A SIGTERM drain snapshots in-flight requests as ResumableRequests,
+# but until now the snapshots lived only in the dying process — a real
+# preemption (the thing drain exists for) lost them. These helpers
+# round-trip the snapshots through runtime/checkpoint.py's atomic JSON
+# sidecar, so the NEXT process restores them (`serve --drain-dir`)
+# with the same bitwise-parity replay an in-process restore gets.
+
+DRAIN_STATE_NAME = "drained_requests"
+
+
+def _req_to_json(req: Request) -> dict:
+    return {"rid": req.rid, "prompt": list(req.prompt),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_token": req.eos_token,
+            "stop_tokens": list(req.stop_tokens or ()),
+            "attempts": req.attempts}
+
+
+def _req_from_json(d: dict) -> Request:
+    # arrival/deadline/submitted_at are NOT persisted: they are
+    # monotonic-clock instants from the dead process's clock domain,
+    # meaningless (possibly far-future) in the restorer's. A restored
+    # request is due immediately and keeps its remaining token budget;
+    # its wall deadline died with the process that promised it.
+    return Request(rid=d["rid"], prompt=tuple(d["prompt"]),
+                   max_new_tokens=d["max_new_tokens"],
+                   eos_token=d["eos_token"],
+                   stop_tokens=tuple(d["stop_tokens"]),
+                   arrival=0.0, submitted_at=None,
+                   attempts=d["attempts"])
+
+
+def persist_drained(directory: str, drained, metrics=None) -> str:
+    """Write ``drained`` (:class:`ResumableRequest` list) under
+    ``directory`` atomically; returns the path. Ticks the registry's
+    ``serve_drain_persisted_total`` when ``metrics`` is given."""
+    from akka_allreduce_tpu.runtime.checkpoint import save_state_json
+    payload = {"version": 1, "requests": [
+        {"req": _req_to_json(rr.req), "generated": list(rr.generated),
+         "slot": rr.slot} for rr in drained]}
+    path = save_state_json(directory, DRAIN_STATE_NAME, payload)
+    if metrics is not None:
+        metrics.on_drain_persisted(len(drained))
+    return path
+
+
+def load_drained(directory: str) -> "list[ResumableRequest]":
+    """Read a :func:`persist_drained` file back into restorable
+    snapshots (empty list when none exists). The caller decides when
+    to delete (:func:`clear_drained`) — after the restored requests
+    actually finished, so a second preemption mid-restore still finds
+    the state."""
+    from akka_allreduce_tpu.runtime.checkpoint import load_state_json
+    payload = load_state_json(directory, DRAIN_STATE_NAME)
+    if payload is None:
+        return []
+    if payload.get("version") != 1:
+        raise ValueError(
+            f"drained-requests state version "
+            f"{payload.get('version')!r} not supported (have 1)")
+    return [ResumableRequest(req=_req_from_json(e["req"]),
+                             generated=tuple(e["generated"]),
+                             slot=e["slot"])
+            for e in payload["requests"]]
+
+
+def clear_drained(directory: str) -> bool:
+    from akka_allreduce_tpu.runtime.checkpoint import delete_state_json
+    return delete_state_json(directory, DRAIN_STATE_NAME)
+
+
 # failure reasons the serve loop hands back to the scheduler's retry
 # budget (everything else in a completion tuple is terminal)
 RETRYABLE_REASONS = frozenset({"watchdog", "fault", "nan"})
 
 
 def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
-               metrics=None, max_dispatches: Optional[int] = None
-               ) -> dict:
+               metrics=None, max_dispatches: Optional[int] = None,
+               resume=()) -> dict:
     """Drive engine + scheduler until both drain. Returns
     ``{rid: (tokens, reason)}`` — successes carry their tokens; a
     terminal failure carries ``[]`` and its status (``evicted``,
@@ -1019,8 +1140,15 @@ def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
     :meth:`ServingEngine.restore`.
 
     ``max_dispatches`` bounds total decode dispatches (tests / selfcheck
-    watchdog) — exceeding it raises instead of hanging."""
+    watchdog) — exceeding it raises instead of hanging.
+
+    ``resume`` is the drain handoff: :class:`ResumableRequest`
+    snapshots (from a previous engine's drain, or ``load_drained``
+    across a process boundary) restored into free slots AHEAD of queue
+    admission — they already held a slot once and resume mid-stream
+    with bitwise parity."""
     results: dict = {}
+    pending_resume = list(resume)
     if metrics is not None and engine.metrics is None:
         engine.metrics = metrics  # one metrics sink for the whole run
     clock = scheduler.clock
@@ -1040,9 +1168,21 @@ def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
         if engine.draining:
             for rr in engine.drain():
                 scheduler.release(rr.slot)
+            # resumables not yet re-admitted stay resumable: a second
+            # preemption mid-restore must not silently drop them
+            engine.drained.extend(pending_resume)
+            pending_resume = []
             drain_drops()
             return results
         now = clock()
+        while engine.free_slot_count > 0 and pending_resume:
+            rr = pending_resume.pop(0)
+            if rr.req.submitted_at is None:
+                # restored across a process boundary: the original
+                # submit instant died with the old clock domain — TTFT
+                # for a restored request measures from its restore
+                rr.req.submitted_at = now
+            scheduler.bind(rr.req, engine.restore(rr))
         while engine.free_slot_count > 0:
             req = scheduler.pop_ready(now)
             if req is None:

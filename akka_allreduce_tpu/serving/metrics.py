@@ -12,8 +12,8 @@ understood, not averaged away: TTFT is the block-end delivery time, and
 TPOT counts only tokens that arrived after the first delivery instant
 (a request that fits in one block has no cadence sample).
 This module keeps those as plain host-side histograms (p50/p90/p99 by
-nearest-rank, no deps) and wires them into the two existing
-observability planes instead of inventing a third:
+nearest-rank, no deps) and wires them into the repo's observability
+planes instead of keeping private ones:
 
 * every request lifecycle event can land in a
   :class:`~akka_allreduce_tpu.runtime.tracing.Tracer` (``serve_submit``
@@ -24,53 +24,29 @@ observability planes instead of inventing a third:
 * :meth:`ServingMetrics.host_sampler` hands back a
   :class:`~akka_allreduce_tpu.runtime.metrics.HostResourceSampler`
   wired to the same tracer, so a serve run's RSS/CPU story rides in the
-  summary next to its latency story.
+  summary next to its latency story;
+* every series re-registers onto a :class:`~akka_allreduce_tpu
+  .telemetry.registry.MetricsRegistry` (``self.registry`` — pass a
+  shared one or let the constructor own one) as pull collectors, so
+  the Prometheus-text / JSON snapshot ``serve --metrics-file`` /
+  ``--metrics-port`` expose reads the SAME cells ``summary()`` renders:
+  the two surfaces agree exactly, asserted by ``serve --selfcheck``.
+
+The :class:`Histogram` implementation lives in telemetry/registry.py
+(sorted-cache percentiles + ``merge()`` for per-replica aggregation);
+it is re-exported here because serving code and tests have always
+imported it from this module.
 """
 
 from __future__ import annotations
 
-import math
 import time
 from typing import Optional
 
-
-class Histogram:
-    """Append-only value log with nearest-rank percentiles. Serving
-    tiers care about tails; at serving-bench sample counts (10^2-10^5)
-    an exact sorted copy at summary time is cheaper than maintaining
-    approximate sketch state per record."""
-
-    def __init__(self):
-        self._vals: list[float] = []
-
-    def record(self, v: float) -> None:
-        self._vals.append(float(v))
-
-    @property
-    def count(self) -> int:
-        return len(self._vals)
-
-    @property
-    def mean(self) -> Optional[float]:
-        return sum(self._vals) / len(self._vals) if self._vals else None
-
-    def percentile(self, p: float) -> Optional[float]:
-        """Nearest-rank percentile, p in [0, 100]."""
-        if not self._vals:
-            return None
-        s = sorted(self._vals)
-        rank = max(1, math.ceil(p / 100.0 * len(s)))
-        return s[min(rank, len(s)) - 1]
-
-    def summary(self, scale: float = 1.0, digits: int = 3) -> dict:
-        if not self._vals:
-            return {"count": 0}
-        r = lambda v: round(v * scale, digits)  # noqa: E731
-        return {"count": len(self._vals), "mean": r(self.mean),
-                "p50": r(self.percentile(50)),
-                "p90": r(self.percentile(90)),
-                "p99": r(self.percentile(99)),
-                "max": r(max(self._vals))}
+from akka_allreduce_tpu.telemetry.registry import (  # noqa: F401
+    Histogram,
+    MetricsRegistry,
+)
 
 
 class ServingMetrics:
@@ -80,9 +56,11 @@ class ServingMetrics:
     JSON-able dict (the serve CLI prints it as its single stdout line,
     the same one-JSON-line contract as bench.py)."""
 
-    def __init__(self, clock=time.monotonic, tracer=None):
+    def __init__(self, clock=time.monotonic, tracer=None, registry=None):
         self.clock = clock
         self.tracer = tracer
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
         self.ttft_s = Histogram()
         self.tpot_s = Histogram()
         self.queue_depth = Histogram()
@@ -121,6 +99,73 @@ class ServingMetrics:
         self._first_count: dict[int, int] = {}
         self._t0: Optional[float] = None
         self._t_end: Optional[float] = None
+        # -- telemetry plane (ISSUE 6): drained-snapshot persistence
+        # (the registry-owned counter the drain runbook watches)
+        self._drain_persisted = self.registry.counter(
+            "serve_drain_persisted_total",
+            help="drained ResumableRequests persisted across a process "
+                 "boundary (runtime/checkpoint.py save_drained)")
+        self._register(self.registry)
+
+    def _register(self, r) -> None:
+        """Re-register every series onto the registry as pull
+        collectors: the export surface reads the same cells summary()
+        renders, so the Prometheus snapshot can never drift from the
+        summary dict (the two are asserted equal in `serve
+        --selfcheck`). Counter names follow prometheus convention
+        (snake_case, ``_total`` suffix, base units in the name)."""
+        counters = (
+            ("serve_submitted_total", lambda: self.requests_submitted,
+             "requests submitted"),
+            ("serve_completed_total", lambda: self.requests_completed,
+             "requests completed with tokens"),
+            ("serve_rejected_total", lambda: self.requests_rejected,
+             "requests shed at the admission edge (backpressure)"),
+            ("serve_failed_attempts_total", lambda: self.requests_failed,
+             "failed attempts (watchdog/fault/nan) — per attempt, "
+             "not per request"),
+            ("serve_retries_total", lambda: self.retries_total,
+             "failed attempts requeued within the retry budget"),
+            ("serve_evictions_total", lambda: self.evictions_total,
+             "mid-flight deadline evictions"),
+            ("serve_deadline_misses_total",
+             lambda: self.deadline_misses_total,
+             "evictions + infeasible-deadline sheds"),
+            ("serve_watchdog_trips_total",
+             lambda: self.watchdog_trips_total,
+             "hung dispatches recovered by the watchdog"),
+            ("serve_dead_letter_total", lambda: self.dead_letter_total,
+             "requests terminal after the retry budget"),
+            ("serve_fault_injected_total", lambda: self.fault_injected,
+             "faults the armed plan fired (chaos harness stamp)"),
+            ("serve_fault_survived_total", lambda: self.fault_survived,
+             "failure events absorbed by a recovery handler"),
+            ("serve_prefill_tokens_total", lambda: self.prefill_tokens,
+             "prompt tokens prefilled"),
+            ("serve_decode_tokens_total", lambda: self.decode_tokens,
+             "decode tokens delivered"),
+            ("serve_wasted_tokens_total", lambda: self.wasted_tokens,
+             "block tail waste + failure/eviction discards"),
+        )
+        for name, pull, help_text in counters:
+            r.register_callback(name, pull, kind="counter",
+                                help=help_text)
+        histograms = (
+            ("serve_ttft_seconds", lambda: self.ttft_s,
+             "submit -> first token delivery"),
+            ("serve_tpot_seconds", lambda: self.tpot_s,
+             "steady decode cadence per token (post-first-delivery)"),
+            ("serve_queue_depth", lambda: self.queue_depth,
+             "live admission-queue depth per loop iteration"),
+            ("serve_slot_occupancy", lambda: self.slot_occupancy,
+             "occupied-slot fraction per loop iteration"),
+            ("serve_wasted_per_completion",
+             lambda: self.wasted_per_completion,
+             "block steps computed after the lane's done-mask latched, "
+             "per completion"),
+        )
+        for name, pull, help_text in histograms:
+            r.register_histogram(name, pull, help=help_text)
 
     # -- lifecycle hooks ----------------------------------------------
 
@@ -222,6 +267,13 @@ class ServingMetrics:
         self.fault_survived += 1
         self._record("serve_fault_survived", fault=kind)
 
+    def on_drain_persisted(self, n: int) -> None:
+        """``n`` drained ResumableRequests written through
+        runtime/checkpoint.py — the preemption survived a process
+        boundary, not just a loop exit."""
+        self._drain_persisted.inc(n)
+        self._record("serve_drain_persisted", count=n)
+
     def on_wasted(self, rid: int, n: int) -> None:
         """Block steps the device computed for ``rid``'s lane after its
         done-mask latched (multi-step tail waste); called once per
@@ -254,11 +306,14 @@ class ServingMetrics:
 
     def host_sampler(self, interval_s: float = 1.0):
         """A runtime/metrics.py HostResourceSampler sharing this tracer
-        (use as a context manager around the serve loop; fold its
-        ``summary()`` into the report under ``host``)."""
+        AND this registry (host_rss_mb / host_cpu_pct gauges land next
+        to the serving series; use as a context manager around the
+        serve loop and fold its ``summary()`` into the report under
+        ``host``)."""
         from akka_allreduce_tpu.runtime.metrics import HostResourceSampler
         return HostResourceSampler(interval_s=interval_s,
-                                   tracer=self.tracer)
+                                   tracer=self.tracer,
+                                   registry=self.registry)
 
     # -- reporting -----------------------------------------------------
 

@@ -1,0 +1,60 @@
+"""Unified telemetry plane: the one observability surface the rest of
+the repo plugs into.
+
+The paper's value proposition — partial completion under thresholds and
+``maxLag`` — makes the interesting production questions distributional:
+which contributions missed, how late, how often, at what waste. Before
+this package the repo answered them through three disconnected planes
+(JSONL tracer, host sampler, serving summary dicts) with no exporter,
+no device-time attribution, and no guard on the banked perf trajectory.
+The telemetry plane supplies all four, each host-side only (nothing
+here ever enters jitted code — pinned by the ``engine_step_telemetry``
+lint entry):
+
+* ``registry`` — :class:`MetricsRegistry`: named counters / gauges /
+  histograms with labels, Prometheus-text + JSON exporters, periodic
+  snapshot writer, stdlib HTTP exposer. ``serving/metrics.py`` and the
+  train loop register their series here; ``serve``/``train`` expose it
+  via ``--metrics-file`` / ``--metrics-port``.
+* ``chrome_trace`` — render a :class:`~akka_allreduce_tpu.runtime
+  .tracing.Tracer` event stream (now carrying nested span ids and
+  per-request correlation) as Perfetto-loadable Chrome-trace JSON.
+* ``device`` — :class:`DeviceTimer` / ``device_span``: bracket every
+  engine dispatch and train step with ``jax.profiler``
+  StepTraceAnnotation when available plus block-until-ready wall
+  deltas, yielding host-vs-device time and the ``dispatch_gap_ms``
+  host-bubble series.
+* ``regression`` — the perf-regression gate behind ``cli.py perfgate``:
+  fresh A/B rows vs the banked ``perf_capture/`` medians within
+  per-section tolerances, exit-nonzero on regression (ROADMAP item 5's
+  closing half), wired as a tier-1 CI job.
+"""
+
+from akka_allreduce_tpu.telemetry.chrome_trace import (
+    chrome_trace,
+    write_chrome_trace,
+)
+from akka_allreduce_tpu.telemetry.device import DeviceSpan, DeviceTimer
+from akka_allreduce_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    MetricsServer,
+    SnapshotWriter,
+    parse_prometheus_text,
+)
+
+__all__ = [
+    "chrome_trace",
+    "write_chrome_trace",
+    "DeviceSpan",
+    "DeviceTimer",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsServer",
+    "SnapshotWriter",
+    "parse_prometheus_text",
+]

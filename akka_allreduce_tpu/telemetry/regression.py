@@ -1,0 +1,318 @@
+"""Perf-regression gate: fresh A/B rows vs the banked perf_capture/.
+
+ROADMAP item 5's second half: the repo banks its performance trajectory
+as JSON captures (``perf_capture/*.json`` — one ``{"section", "rows"}``
+document per A/B family), but until now nothing COMPARED a fresh
+measurement against them, so a regression in any A/B (overlap, serving
+throughput, multi-step decode) could erode silently while tier-1 stayed
+green. This module closes the loop: load every banked capture, take the
+per-metric median across captures (re-captures of a section accumulate;
+the median is the noise-robust center), re-measure the section fresh
+(or accept a rows file from an offline run), and fail — exit-code fail,
+CI-red fail — any gated metric that lands below
+``median * (1 - tolerance)``.
+
+What gates, and why tolerances differ per section
+-------------------------------------------------
+By default only the CLAIM rows gate: the ``*_speedup_*`` / ``*_best``
+ratio metrics. Raw tok/s and GB/s rows are machine-dependent (a faster
+CI runner would "improve" them meaninglessly; a loaded one would flake
+the gate) while the ratios are the actual banked claims ("engine beats
+sequential", "S=8 beats S=1") and are computed from two measurements
+sharing the run's noise. Tolerances come from the banked captures' own
+recorded spread plus probes of the capture box's run-to-run noise: the
+serving capture notes a repeat run at 1.10x/1.63x vs banked
+1.46x/1.93x, and direct probes measured up to 3x wall-time swings on
+identical work on the shared 1-core box; the multi-step capture notes
+an observed 1.36x-2.3x range. Both sections sit at 0.45 — and every
+tolerance is capped STRICTLY below 0.5, so a 2x regression (the
+injected-failure acceptance case, fresh = median/2) fails at every
+section's boundary: 0.5 < 1 - tolerance always holds. ``--gate-all`` (or ``gate_all=True``)
+widens the gate to every numeric row for operators on a quiet pinned
+box.
+
+Sections without banked rows (ab_overlap until the TPU capture window)
+SKIP with a note instead of failing: the gate guards banked claims, it
+does not invent them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Optional
+
+# sections the gate knows how to re-measure, in bank order
+SECTIONS = ("serving_throughput", "multi_step_decode", "ab_overlap")
+
+# per-section relative tolerance, derived from the banked captures' own
+# recorded run-to-run spread (module docstring); _DEFAULT for unknowns
+# every tolerance stays strictly below 0.5 so the acceptance case — a
+# 2x regression, fresh = median/2 — fails at every section's boundary
+# (0.5 < 1 - tol); gate_section enforces the bound
+SECTION_TOLERANCE = {
+    "serving_throughput": 0.45,
+    "multi_step_decode": 0.45,
+    "ab_overlap": 0.35,
+}
+_DEFAULT_TOLERANCE = 0.35
+
+_GATED = re.compile(r"(_speedup(_|$))|(_best$)")
+
+
+def default_gated(metric: str) -> bool:
+    """The claim rows: ratio metrics (speedups and best-of summaries)."""
+    return bool(_GATED.search(metric))
+
+
+@dataclasses.dataclass(frozen=True)
+class GateResult:
+    """One gated metric's verdict. ``ok=None`` means informational
+    (ungated or unmatched) — reported, never failing."""
+
+    metric: str
+    banked_median: Optional[float]
+    fresh_value: Optional[float]
+    threshold: Optional[float]
+    ok: Optional[bool]
+    note: str = ""
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def load_banked(capture_dir: str) -> dict:
+    """``perf_capture/`` -> ``{section: {metric: [values...]}}``. Every
+    ``*.json`` document with a ``rows`` list contributes; error rows
+    (value 0 with an ``error`` key) are excluded — a failed capture is
+    not a performance claim."""
+    out: dict = {}
+    if not os.path.isdir(capture_dir):
+        return out
+    for fn in sorted(os.listdir(capture_dir)):
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(capture_dir, fn)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rows = doc.get("rows")
+        section = doc.get("section")
+        if not isinstance(rows, list) or not section:
+            continue
+        sec = out.setdefault(section, {})
+        for row in rows:
+            if not isinstance(row, dict) or "metric" not in row:
+                continue
+            if row.get("error"):
+                continue
+            try:
+                v = float(row["value"])
+            except (TypeError, ValueError):
+                continue
+            sec.setdefault(row["metric"], []).append(v)
+    return out
+
+
+def gate_section(section: str, banked: dict, fresh_rows: list,
+                 tolerance: Optional[float] = None,
+                 gate_all: bool = False) -> list:
+    """Compare one section's fresh rows against its banked metric lists.
+
+    Returns a list of :class:`GateResult` — gated metrics carry a bool
+    ``ok``; metrics present on only one side, or ungated by policy,
+    come back informational. A banked GATED metric with no fresh row
+    (the measurement errored or vanished) FAILS: a gate that passes
+    when the measurement stops running is not a gate."""
+    tol = (SECTION_TOLERANCE.get(section, _DEFAULT_TOLERANCE)
+           if tolerance is None else tolerance)
+    if not 0.0 <= tol < 0.5:
+        # the hard cap keeps the acceptance property: an exact 2x
+        # regression (fresh = median/2) must fail every gated row —
+        # at tol >= 0.5 it would pass the >= threshold comparison
+        raise ValueError(f"tolerance must be in [0, 0.5) so a 2x "
+                         f"regression always fails, got {tol}")
+    fresh: dict = {}
+    errors: dict = {}
+    for row in fresh_rows:
+        m = row.get("metric")
+        if not m:
+            continue
+        if row.get("error"):
+            errors[m] = row["error"]
+            continue
+        try:
+            fresh[m] = float(row["value"])
+        except (TypeError, ValueError):
+            errors[m] = f"non-numeric value {row.get('value')!r}"
+    results: list = []
+    for metric in sorted(set(banked) | set(fresh)):
+        gated = gate_all or default_gated(metric)
+        med = _median(banked[metric]) if metric in banked else None
+        val = fresh.get(metric)
+        if med is None:
+            results.append(GateResult(metric, None, val, None, None,
+                                      note="no banked row"))
+            continue
+        if val is None:
+            err = errors.get(metric, "no fresh row")
+            results.append(GateResult(
+                metric, med, None, med * (1 - tol),
+                ok=False if gated else None,
+                note=f"fresh measurement missing: {err}"))
+            continue
+        thresh = med * (1 - tol)
+        if not gated:
+            results.append(GateResult(metric, med, val, None, None,
+                                      note="informational (ungated)"))
+            continue
+        ok = val >= thresh
+        results.append(GateResult(
+            metric, med, val, thresh, ok,
+            note="" if ok else
+            f"regressed: {val:g} < {thresh:g} "
+            f"(banked median {med:g}, tolerance {tol:g})"))
+    return results
+
+
+def fresh_rows(section: str) -> list:
+    """Re-measure one section's A/B rows NOW, at the same shapes the
+    capture harness banked (sizes mirror scripts/bench_suite.py per
+    platform — comparability is the whole point; drifting these sizes
+    invalidates the banked medians and needs a re-bank)."""
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if section == "serving_throughput":
+        from akka_allreduce_tpu.bench import measure_serving_throughput
+        if on_tpu:
+            return measure_serving_throughput(
+                d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+                n_requests=16, prompt_len=64, steps=128,
+                slot_counts=(2, 4, 8))
+        return measure_serving_throughput()
+    if section == "multi_step_decode":
+        from akka_allreduce_tpu.bench import measure_multi_step_decode
+        if on_tpu:
+            return measure_multi_step_decode(
+                d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+                n_requests=16, prompt_len=64, steps=128, slots=4)
+        return measure_multi_step_decode(
+            d_model=256, n_layers=2, d_ff=1024, vocab=1024,
+            n_requests=24, reps=4)
+    if section == "ab_overlap":
+        from akka_allreduce_tpu.bench import measure_ab_overlap
+        return list(measure_ab_overlap())
+    raise ValueError(f"unknown section {section!r}; have {SECTIONS}")
+
+
+@dataclasses.dataclass
+class GateReport:
+    """The perfgate verdict across sections, JSON-able for CI."""
+
+    sections: dict        # section -> list[GateResult]
+    skipped: dict         # section -> reason
+    tolerance: Optional[float]  # the override, None = per-section
+
+    @property
+    def failed(self) -> list:
+        return [r for results in self.sections.values()
+                for r in results if r.ok is False]
+
+    @property
+    def gated(self) -> list:
+        return [r for results in self.sections.values()
+                for r in results if r.ok is not None]
+
+    @property
+    def ok(self) -> bool:
+        """No gated row regressed. A run that gated NOTHING (sections
+        skipped for lack of banked rows, or banked rows carrying no
+        claim metrics) is a pass with notes, not a failure — the
+        text/JSON verdict says how many rows actually gated, and the
+        CLI flags a zero so a vacuous green is visible, not silent."""
+        return not self.failed
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "gated": len(self.gated),
+            "failed": [r.as_dict() for r in self.failed],
+            "skipped": self.skipped,
+            "sections": {s: [r.as_dict() for r in results]
+                         for s, results in self.sections.items()},
+        }
+
+
+def _merge_best(rows_a: list, rows_b: list) -> list:
+    """Per-metric max across two measurement attempts. Load noise on a
+    shared box only ever SLOWS a measurement (the same argument as
+    bench.py's min-of-reps timing), so the faster attempt is the one
+    closer to the machine's truth; keeping the max per row never
+    manufactures a speedup the machine cannot produce."""
+    best: dict = {}
+    order: list = []
+    for rows in (rows_a, rows_b):
+        for row in rows:
+            m = row.get("metric")
+            if m is None or row.get("error"):
+                continue
+            try:
+                v = float(row["value"])
+            except (TypeError, ValueError):
+                continue
+            if m not in best:
+                order.append(m)
+                best[m] = row
+            elif v > float(best[m]["value"]):
+                best[m] = row
+    return [best[m] for m in order]
+
+
+def run_gate(capture_dir: str, sections=None,
+             fresh_by_section: Optional[dict] = None,
+             tolerance: Optional[float] = None,
+             gate_all: bool = False, retries: int = 2) -> GateReport:
+    """The perfgate driver: load the bank, obtain fresh rows per section
+    (``fresh_by_section`` when the caller measured offline — the
+    ``--fresh-file`` path — else re-measure here), compare. Sections
+    with no banked rows skip with a note.
+
+    ``retries``: a LIVE-measured section that fails is re-measured up
+    to this many times, keeping each metric's best value across
+    attempts, before the failure stands — one transient load spike on
+    a shared runner must not redden the gate (offline ``fresh_by_
+    section`` rows are taken as-is: they are evidence, not a probe)."""
+    banked = load_banked(capture_dir)
+    report = GateReport(sections={}, skipped={}, tolerance=tolerance)
+    for section in (sections or SECTIONS):
+        if section not in banked:
+            report.skipped[section] = (
+                f"no banked rows under {capture_dir} (capture not run "
+                f"on this platform yet) — nothing to gate")
+            continue
+        offline = (fresh_by_section is not None
+                   and section in fresh_by_section)
+        rows = (fresh_by_section[section] if offline
+                else fresh_rows(section))
+        results = gate_section(section, banked[section], rows,
+                               tolerance=tolerance, gate_all=gate_all)
+        attempts = 0
+        while not offline and attempts < retries \
+                and any(r.ok is False for r in results):
+            attempts += 1
+            rows = _merge_best(rows, fresh_rows(section))
+            results = gate_section(section, banked[section], rows,
+                                   tolerance=tolerance,
+                                   gate_all=gate_all)
+        report.sections[section] = results
+    return report

@@ -61,6 +61,9 @@ class TestCleanEntrypointsStayClean:
     @pytest.mark.parametrize("target", [
         "generate", "engine_step", "engine_multi_step",
         "engine_prefill", "engine_recovery",
+        # ISSUE 6: telemetry armed must lint clean AND trace to the
+        # bare engine_step's exact program (asserted in the builder)
+        "engine_step_telemetry",
         "collective_fused", "collective_windowed",
         "collective_int8", "collective_bf16",
     ])
