@@ -37,6 +37,13 @@ engine_recovery     the watchdog-recovery dispatch: _engine_step over a
                     host sync sneaks into the recovery path, and the
                     rebuilt avals are asserted identical to warmup's
                     (the no-recompile half of the recovery contract)
+engine_paged_step   serving/engine.py _engine_paged_step — the paged
+                    engine's decode dispatch (ISSUE 7): KV-pool state
+                    donated (in-place page writes), the page TABLE a
+                    plain int32 operand — non-donated, non-static —
+                    so churn/sharing/COW rewrite table data while the
+                    program is reused (the paged no-recompile
+                    contract); host-sync clean like every hot entry
 engine_step_telemetry  the SAME engine step traced through an engine
                     with the full telemetry plane armed (tracer,
                     registry-backed metrics, device-span timer) — the
@@ -264,6 +271,68 @@ def build_engine_prefill() -> LintContext:
         policy, donate_argnums=(1,), static_argnums=(5, 6))
 
 
+def build_engine_paged_step() -> LintContext:
+    """The paged decode dispatch (ISSUE 7): ``_engine_paged_step`` over
+    a real ``PagedServingEngine``'s pool state. Three structural claims
+    asserted at build time, before the passes even run:
+
+    * the page TABLE operand is int32 and NOT donated — it is host
+      truth re-uploaded on change; donating it would hand the engine's
+      address map to XLA as scratch;
+    * the KV pool (+ logits) IS donated — the in-place page-write HBM
+      contract, same as the slot engine's;
+    * the dispatch's output state avals equal the fresh-state avals —
+      the paged extension of the no-recompile contract (a drifting
+      leaf would recompile on the first recovery).
+    The host-sync and donation passes then walk it like any hot entry.
+    """
+    import jax
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.models.transformer import init_transformer
+    from akka_allreduce_tpu.serving.engine import (PagedEngineConfig,
+                                                   PagedServingEngine,
+                                                   _engine_paged_step)
+    cfg = _model_cfg()
+    params = init_transformer(jax.random.key(0), cfg)
+    engine = PagedServingEngine(
+        params, cfg, PagedEngineConfig(num_slots=2, page_size=4))
+    pos = jnp.zeros((2,), jnp.int32)
+    pt = jnp.zeros((2, engine._pages_per_seq), jnp.int32)
+    steady, _packed = jax.eval_shape(
+        lambda p, s, q, t: _engine_paged_step(p, s, q, t, cfg,
+                                              "gather"),
+        params, engine._state, pos, pt)
+    mismatch = [
+        n for n in set(steady) | set(engine._state)
+        if (n not in steady or n not in engine._state
+            or steady[n].shape != engine._state[n].shape
+            or steady[n].dtype != engine._state[n].dtype)]
+    if mismatch:
+        raise RuntimeError(
+            f"engine_paged_step: dispatch output avals diverge from "
+            f"the fresh pool state's at {sorted(mismatch)} — paged "
+            f"recovery would recompile")
+    policy = LintPolicy(expect_donation=True, hot=True)
+    ctx = trace_entry(
+        "engine_paged_step", _engine_paged_step,
+        (params, engine._state, pos, pt, cfg, "gather"), policy,
+        donate_argnums=(1,), static_argnums=(4, 5))
+    # the page-table operand contract: exactly one 2-D int32 input
+    # (lanes, pages_per_seq), and it must NOT be donated
+    tables = [(aval, don) for aval, don in zip(ctx.in_avals, ctx.donated)
+              if aval.dtype == jnp.int32 and aval.ndim == 2]
+    if len(tables) != 1:
+        raise RuntimeError(
+            f"engine_paged_step: expected exactly one 2-D int32 input "
+            f"(the page table), found {len(tables)}")
+    if tables[0][1]:
+        raise RuntimeError(
+            "engine_paged_step: the page table is DONATED — table "
+            "contents are host truth, donation would let XLA scribble "
+            "over the engine's address map")
+    return ctx
+
+
 def build_engine_step_telemetry() -> LintContext:
     """ISSUE 6's zero-callback pin: construct a ServingEngine with the
     ENTIRE telemetry plane armed — Tracer, registry-backed
@@ -454,6 +523,7 @@ ENTRYPOINTS = {
     "generate": build_generate,
     "engine_step": build_engine_step,
     "engine_multi_step": build_engine_multi_step,
+    "engine_paged_step": build_engine_paged_step,
     "engine_prefill": build_engine_prefill,
     "engine_recovery": build_engine_recovery,
     "engine_step_telemetry": build_engine_step_telemetry,

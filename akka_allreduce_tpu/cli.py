@@ -1950,6 +1950,42 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "program count); empty = one exact-length "
                         "program per distinct prompt length (the "
                         "bitwise-parity mode)")
+    # -- paged KV (ISSUE 7)
+    p.add_argument("--paged", action="store_true",
+                   help="paged KV engine (serving/paging.py + "
+                        "PagedServingEngine): KV lives in a flat page "
+                        "pool addressed through per-request page "
+                        "tables; --slots becomes the decode-LANE count "
+                        "(compute width, not an HBM reservation), "
+                        "admission is gated on free PAGES, common "
+                        "prompt prefixes share pages (COW on first "
+                        "divergent write), and greedy tokens stay "
+                        "bitwise generate()'s")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="with --paged: KV positions per page (small = "
+                        "less tail waste, wider tables; see DESIGN.md "
+                        "§12 'Choosing page size')")
+    p.add_argument("--num-pages", type=int, default=0,
+                   help="with --paged: pool capacity in pages; 0 = "
+                        "auto (slots * ceil(max_seq/page_size) — the "
+                        "slot engine's equivalent HBM, for honest "
+                        "A/Bs)")
+    p.add_argument("--paged-attention", choices=("gather", "pallas"),
+                   default="gather",
+                   help="with --paged: the pool read path — gather "
+                        "(bitwise parity, CPU-green) or the fused "
+                        "Pallas paged-attention kernel "
+                        "(ops/pallas_kernels/attention.py; TPU "
+                        "throughput, allclose-not-bitwise)")
+    # -- preemption notice (ISSUE 7 satellite / PR 5 loose end)
+    p.add_argument("--preempt-poll", default=None, metavar="URL",
+                   help="poll this GCE-style metadata URL for a "
+                        "preemption notice (runtime/preempt.py; 'gce' "
+                        "= the real instance/preempted endpoint) and "
+                        "drain on TRUE — same path as SIGTERM, "
+                        "composes with --drain-dir persistence")
+    p.add_argument("--preempt-interval", type=float, default=1.0,
+                   help="seconds between --preempt-poll reads")
     # -- scheduler
     p.add_argument("--queue-depth", type=int, default=256,
                    help="admission-queue bound: submits beyond it are "
@@ -2251,6 +2287,181 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
     return 0 if not failures else 1
 
 
+def _serve_paged_selfcheck(args: argparse.Namespace) -> int:
+    """`serve --selfcheck --paged`: the ISSUE 7 acceptance run.
+
+    A shared-system-prompt load (the production norm the prefix
+    registry exists for) over a tiny model: 16 requests share a
+    24-token system prompt with unique 2-token suffixes, plus 4
+    requests with IDENTICAL 26-token prompts (the shared-tail / COW
+    regime), ragged budgets so lanes churn. Asserted, not hoped:
+
+    * THREE-WAY PARITY — every request's tokens from the paged engine
+      equal the slot engine's equal the standalone ``generate()``'s,
+      bitwise (``--decode-steps S`` runs the paged block engine too);
+    * the paged no-recompile contract — a second paged run over the
+      same shapes (fresh engine, fresh pool, full churn, COW splits
+      firing again) compiles ZERO programs;
+    * the prefix-reuse claim — hit rate >= 0.9 and measured cache-HBM
+      saving >= 2x under this load, with COW splits > 0 (the
+      divergent-write path actually exercised);
+    * scrape == summary for the new serve_page_* series (the PR 6
+      contract extended to the paging plane).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.analysis.recompile import (RecompileError,
+                                                       no_recompiles)
+    from akka_allreduce_tpu.models.generate import generate
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.serving import (EngineConfig,
+                                            PagedEngineConfig,
+                                            PagedServingEngine, Request,
+                                            RequestScheduler,
+                                            SchedulerConfig,
+                                            ServingEngine,
+                                            ServingMetrics, serve_loop)
+    from akka_allreduce_tpu.telemetry import parse_prometheus_text
+
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=48)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(13)
+    eos = 5
+    system = tuple(int(x) for x in rng.integers(0, cfg.vocab_size,
+                                                size=24))
+    twin = system + tuple(int(x) for x in rng.integers(
+        0, cfg.vocab_size, size=2))
+
+    def make_requests():
+        r = np.random.default_rng(13)
+        r.integers(0, cfg.vocab_size, size=26)  # burn the draws above
+        reqs = []
+        for rid in range(16):
+            suffix = tuple(int(x) for x in r.integers(
+                0, cfg.vocab_size, size=2))
+            reqs.append(Request(
+                rid=rid, prompt=system + suffix,
+                max_new_tokens=4 + rid % 5,
+                eos_token=eos if rid % 3 == 0 else None,
+                submitted_at=0.0))
+        for j in range(4):  # identical prompts: shared tail -> COW
+            reqs.append(Request(rid=100 + j, prompt=twin,
+                                max_new_tokens=5 + j,
+                                submitted_at=0.0))
+        return reqs
+
+    s_steps = args.decode_steps
+    lanes, page = 4, 4
+    pcfg = PagedEngineConfig(num_slots=lanes, page_size=page,
+                             num_pages=48, decode_steps=s_steps)
+
+    def run_paged(metrics=None):
+        engine = PagedServingEngine(params, cfg, pcfg, metrics=metrics)
+        if metrics is not None:
+            metrics.attach_paging(engine.paging_summary)
+        sched = RequestScheduler(SchedulerConfig(), num_slots=lanes)
+        reqs = make_requests()
+        for r in reqs:
+            if metrics is not None:
+                metrics.on_submit(r.rid)
+            sched.submit(r)
+        results = serve_loop(engine, sched, metrics=metrics,
+                             max_dispatches=600)
+        engine.pool.check_invariants()
+        return results, engine, reqs
+
+    metrics = ServingMetrics()
+    results, engine, reqs = run_paged(metrics=metrics)
+    failures = []
+
+    # three-way parity: paged == slot engine == generate(), bitwise
+    slot_engine = ServingEngine(params, cfg,
+                                EngineConfig(num_slots=lanes,
+                                             decode_steps=s_steps))
+    slot_sched = RequestScheduler(SchedulerConfig(), num_slots=lanes)
+    for r in make_requests():
+        slot_sched.submit(r)
+    slot_results = serve_loop(slot_engine, slot_sched,
+                              max_dispatches=600)
+    for r in reqs:
+        prompt = jnp.asarray(r.prompt, jnp.int32)[None]
+        if r.eos_token is None:
+            want = np.asarray(generate(params, prompt, cfg,
+                                       steps=r.max_new_tokens))[0]
+        else:
+            toks, lengths = generate(params, prompt, cfg,
+                                     steps=r.max_new_tokens,
+                                     eos_token=r.eos_token)
+            want = np.asarray(toks)[0][:int(lengths[0])]
+        got = np.asarray(results[r.rid][0], np.int32)
+        if not np.array_equal(got, want):
+            failures.append(f"rid={r.rid}: paged {got.tolist()} != "
+                            f"generate {want.tolist()}")
+        if list(results[r.rid][0]) != list(slot_results[r.rid][0]):
+            failures.append(f"rid={r.rid}: paged != slot engine")
+
+    # the paging claims (ISSUE 7 acceptance): >= 90% prefix hit rate,
+    # >= 2x measured cache-HBM saving, COW actually fired
+    ps = engine.paging_summary()
+    if ps["prefix_hit_rate"] < 0.9:
+        failures.append(f"prefix hit rate {ps['prefix_hit_rate']} "
+                        f"< 0.9 under the shared-prompt load")
+    if ps["hbm_saving_x"] < 2.0:
+        failures.append(f"cache-HBM saving {ps['hbm_saving_x']}x < 2x "
+                        f"(peak unshared {ps['peak_pages_unshared']} / "
+                        f"in use {ps['peak_pages_in_use']})")
+    if ps["cow_splits_total"] < 1:
+        failures.append("no COW split fired — the shared-tail "
+                        "divergent-write path went unexercised")
+    if engine.peak_occupied != lanes:
+        failures.append(f"peak concurrency {engine.peak_occupied} "
+                        f"never filled the {lanes} lanes")
+
+    # scrape == summary for the serve_page_* series (the PR 6 contract)
+    prom = parse_prometheus_text(metrics.registry.to_prometheus_text())
+    live = engine.paging_summary()  # pool drained by now — re-read
+    for series, key in (("serve_page_pool_free", "pages_free"),
+                        ("serve_prefix_hit_rate", "prefix_hit_rate"),
+                        ("serve_cow_splits_total", "cow_splits_total")):
+        got = prom.get((series, ()))
+        if got is None or abs(got - live[key]) > 1e-9:
+            failures.append(f"prometheus {series} {got} != "
+                            f"paging_summary {live[key]}")
+
+    # the paged no-recompile contract: fresh engine + pool, same
+    # request shapes, churn + sharing + COW all over again -> zero
+    # compiles (run 1 warmed step/prefill programs AND the COW page
+    # copy)
+    try:
+        with no_recompiles("paged selfcheck churn (warmed shapes)"):
+            results2, _eng2, _ = run_paged()
+    except RecompileError as exc:
+        failures.append(str(exc))
+        results2 = {}
+    for rid, out in results2.items():
+        if list(out[0]) != list(results[rid][0]):
+            failures.append(f"rid={rid}: paged churn run diverged")
+
+    print(json.dumps({
+        "selfcheck": "ok" if not failures else "FAIL",
+        "requests": len(reqs),
+        "decode_steps": s_steps,
+        "lanes": lanes,
+        "page_size": page,
+        "prefix_hit_rate": ps["prefix_hit_rate"],
+        "hbm_saving_x": ps["hbm_saving_x"],
+        "cow_splits": ps["cow_splits_total"],
+        "peak_concurrency": engine.peak_occupied,
+        "churn_recompiles": 0 if results2 else None,
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
 def _serve_chaos_selfcheck(args: argparse.Namespace) -> int:
     """`serve --selfcheck --chaos SEED`: the ISSUE 5 acceptance run.
     One seeded FaultPlan injects a dispatch hang, a dispatch exception,
@@ -2407,9 +2618,25 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print("error: --chaos requires --selfcheck (the fault-matrix "
               "smoke)", file=sys.stderr)
         return 2
+    if args.page_size < 1:
+        print(f"error: --page-size must be >= 1, got {args.page_size}",
+              file=sys.stderr)
+        return 2
+    if args.num_pages < 0:
+        print(f"error: --num-pages must be >= 0 (0 = auto), got "
+              f"{args.num_pages}", file=sys.stderr)
+        return 2
+    if args.chaos is not None and args.paged:
+        print("error: --chaos runs the slot-engine fault matrix; the "
+              "paged selfcheck is `--selfcheck --paged` (paged fault "
+              "recovery is covered by tests/test_paged_engine.py)",
+              file=sys.stderr)
+        return 2
     if args.selfcheck:
         if args.chaos is not None:
             return _serve_chaos_selfcheck(args)
+        if args.paged:
+            return _serve_paged_selfcheck(args)
         return _serve_selfcheck(args)
     import jax
     import numpy as np
@@ -2558,15 +2785,34 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             stack.enter_context(metrics.registry.start_snapshotter(
                 args.metrics_file, args.metrics_interval))
         try:
-            engine = ServingEngine(
-                params, mcfg,
-                EngineConfig(
-                    num_slots=args.slots, prefill_buckets=buckets,
-                    kv_dtype="int8" if args.kv_cache == "int8"
-                    else None,
-                    decode_steps=args.decode_steps,
-                    watchdog_timeout_s=args.watchdog_timeout or None),
-                tracer=tracer)
+            if args.paged:
+                from akka_allreduce_tpu.serving import (
+                    PagedEngineConfig, PagedServingEngine)
+                engine = PagedServingEngine(
+                    params, mcfg,
+                    PagedEngineConfig(
+                        num_slots=args.slots, prefill_buckets=buckets,
+                        kv_dtype="int8" if args.kv_cache == "int8"
+                        else None,
+                        decode_steps=args.decode_steps,
+                        watchdog_timeout_s=args.watchdog_timeout
+                        or None,
+                        page_size=args.page_size,
+                        num_pages=args.num_pages,
+                        attention_impl=args.paged_attention),
+                    tracer=tracer)
+                metrics.attach_paging(engine.paging_summary)
+            else:
+                engine = ServingEngine(
+                    params, mcfg,
+                    EngineConfig(
+                        num_slots=args.slots, prefill_buckets=buckets,
+                        kv_dtype="int8" if args.kv_cache == "int8"
+                        else None,
+                        decode_steps=args.decode_steps,
+                        watchdog_timeout_s=args.watchdog_timeout
+                        or None),
+                    tracer=tracer)
             sched = RequestScheduler(
                 SchedulerConfig(max_queue_depth=args.queue_depth,
                                 policy=args.policy,
@@ -2608,6 +2854,20 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # engine.drained, and the report says how many wait for a
         # restore — the operator runbook is OPERATIONS.md "Preemption
         # drain"
+        # the real TPU-VM preemption notice (runtime/preempt.py):
+        # polls the metadata endpoint and converges on the SAME drain
+        # path as SIGTERM — with --drain-dir, a poll-detected
+        # preemption persists its snapshots across the process
+        # boundary like any other drain
+        watcher = None
+        if args.preempt_poll:
+            from akka_allreduce_tpu.runtime.preempt import (
+                GCE_PREEMPTED_URL, PreemptionWatcher)
+            url = (GCE_PREEMPTED_URL if args.preempt_poll == "gce"
+                   else args.preempt_poll)
+            watcher = stack.enter_context(PreemptionWatcher(
+                engine.request_drain, url=url,
+                interval_s=args.preempt_interval))
         prev_term = signal.signal(
             signal.SIGTERM, lambda *_: engine.request_drain())
         from akka_allreduce_tpu.analysis.recompile import CompileLog
@@ -2643,7 +2903,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                    "th_step": args.th_step, "kv_cache": args.kv_cache,
                    "prefill_buckets": list(buckets),
                    "decode_steps": args.decode_steps,
-                   "max_new_tokens": args.max_new_tokens},
+                   "max_new_tokens": args.max_new_tokens,
+                   "paged": args.paged,
+                   # capacity (scratch page excluded): agrees with the
+                   # user's --num-pages and the metrics plane's
+                   # serve_page_pool_pages / pages_total
+                   **({"page_size": args.page_size,
+                       "num_pages": engine.pool.capacity,
+                       "paged_attention": args.paged_attention}
+                      if args.paged else {})},
+        # admission polls where the head request waited on pool MEMORY
+        # with a lane free — the page-pressure signal (always 0 for the
+        # slot engine: a slot is its own reservation)
+        "blocked_on_memory": sched.blocked_on_memory,
+        **({"preempt_notice": watcher.fired,
+            "preempt_polls": watcher.polls} if watcher else {}),
         "completed_reasons": {
             reason: sum(1 for toks, r in results.values()
                         if r == reason)

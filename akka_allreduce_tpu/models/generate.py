@@ -82,6 +82,41 @@ def init_kv_cache(cfg: TransformerConfig, batch: int,
     }
 
 
+def init_kv_pool(cfg: TransformerConfig, num_pages: int, page_size: int,
+                 kv_dtype: "str | None" = None) -> dict:
+    """The PAGED twin of :func:`init_kv_cache`: one flat
+    ``(layers, num_pages, page_size, kv_heads, head_dim)`` K and V pool
+    shared by every request, addressed through per-request page tables
+    (serving/paging.py owns which page belongs to whom). Where the slot
+    cache's HBM is ``slots * max_seq`` positions whether or not they
+    are used, the pool's is exactly ``num_pages * page_size`` —
+    capacity becomes a budget the admission plane spends page by page
+    instead of a per-slot reservation.
+
+    Same dtype/format contract as the slot cache: model compute dtype
+    by default, ``kv_dtype="int8"`` for the quantized format with
+    per-(position, head) f32 scales riding in ``k_scale``/``v_scale``
+    (shape ``(layers, num_pages, page_size, kv_heads)``), and the
+    pytree structure IS the format switch for every consumer. No
+    ``pos`` entry — positions are per-request host state in the paged
+    engine."""
+    if num_pages < 1 or page_size < 1:
+        raise ValueError(f"num_pages/page_size must be >= 1, got "
+                         f"{num_pages}/{page_size}")
+    shape = (cfg.n_layers, num_pages, page_size, cfg.kv_heads,
+             cfg.head_dim)
+    if kv_dtype is None:
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+    if str(kv_dtype) not in ("int8",):
+        raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                         f"(None = model dtype, or 'int8')")
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
+
+
 def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(..., head_dim) f32/bf16 -> (int8 values, f32 scales (...,)).
 

@@ -439,6 +439,196 @@ def default_flash_block(dtype) -> int:
     return 1024 if dtype == jnp.bfloat16 else 512
 
 
+# -- paged decode attention (the serving engine's KV-pool read path) ----
+#
+# The paged serving engine (serving/engine.py PagedServingEngine) keeps
+# K/V in a flat (num_pages, page_size, kv_heads, D) pool and addresses
+# it through an (active, pages_per_req) int32 page table. Two readers:
+#
+# * paged_gather_attention — pure JAX: gather each lane's pages into a
+#   contiguous logical-order buffer and run EXACTLY the slot engine's
+#   masked-softmax decode formula over it. This is the parity path (and
+#   the CPU/tier-1 path): per-lane math is op-for-op the slot engine's
+#   _slot_cached_attention, so paged greedy decode stays BITWISE equal
+#   to the slot engine and to generate(). The gather materializes
+#   O(lanes * padded_len) per layer — the cost the kernel below kills.
+# * paged_attention — the Pallas TPU kernel: the page table rides as a
+#   scalar-prefetch operand, each grid step DMAs ONE page (block index
+#   map reads the table), and an online softmax accumulates across the
+#   page axis — no gathered copy of the KV ever exists, HBM reads are
+#   exactly the pages the lane owns, and pages past the lane's position
+#   are skipped the way the causal flash grid skips future tiles.
+#   Online softmax reassociates the reduction, so this path is
+#   allclose- (not bitwise-) equal to the gather path — the engine
+#   defaults to gather and offers the kernel as the TPU throughput
+#   opt-in (PagedEngineConfig.attention_impl).
+
+
+def paged_gather_kv(pages: jnp.ndarray, page_table: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """(num_pages, P, h_kv, D) pool + (B, n_pt) int32 table ->
+    (B, n_pt * P, h_kv, D) per-lane logical-order KV. A pure gather:
+    row b's logical position p lives at
+    ``out[b, p] == pages[page_table[b, p // P], p % P]``."""
+    n_pt = page_table.shape[1]
+    g = pages[page_table]  # (B, n_pt, P, h_kv, D)
+    return g.reshape((g.shape[0], n_pt * pages.shape[1]) + g.shape[3:])
+
+
+def paged_gather_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray,
+                           page_table: jnp.ndarray, pos: jnp.ndarray,
+                           window: "int | None" = None) -> jnp.ndarray:
+    """Decode attention through a page table, gather-and-mask form.
+
+    q: (B, 1, H, D); k_pages/v_pages: (num_pages, P, h_kv, D);
+    page_table: (B, n_pt) int32; pos: (B,) int32 — row b attends its
+    logical positions <= pos[b]. Returns (B, 1, H, D).
+
+    The math after the gather is OP-FOR-OP the slot engine's
+    ``_slot_cached_attention`` (same grouped einsum, f32 score/softmax,
+    same cast points, ``NEG_INF`` mask) over the gathered buffer — kept
+    in lockstep deliberately: masked lanes contribute exactly 0.0 to
+    the softmax sums, so per-row outputs are bitwise the slot engine's
+    whenever the gathered content matches, even when the padded gather
+    length (n_pt * P) differs from max_seq. That identity is the paged
+    engine's parity contract (tests/test_paged_engine.py)."""
+    k_all = paged_gather_kv(k_pages, page_table)
+    v_all = paged_gather_kv(v_pages, page_table)
+    b, one, h, d = q.shape
+    h_kv = k_all.shape[2]
+    g = h // h_kv
+    qg = q.reshape(b, one, h_kv, g, d)
+    scale = d ** -0.5
+    k_idx = jnp.arange(k_all.shape[1])
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all,
+                        preferred_element_type=jnp.float32) * scale
+    valid = k_idx[None, :] <= pos[:, None]
+    if window is not None:
+        valid &= k_idx[None, :] > pos[:, None] - window
+    scores = jnp.where(valid[:, None, None, None, :], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_all.dtype), v_all,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, one, h, d).astype(q.dtype)
+
+
+def _paged_fwd_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *, page_size, scale):
+    """One (lane, kv-head, page) grid step: accumulate this page's
+    contribution to the lane's online softmax. The block index maps
+    already routed the DMA through the page table (scalar prefetch);
+    the kernel masks by position and skips pages entirely past the
+    lane's frontier."""
+    b, j = pl.program_id(0), pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[b]
+    # page j covers logical positions [j*P, (j+1)*P): dead once its
+    # first position is past the frontier (the paged analogue of the
+    # causal-future tile skip — a lane at position p reads exactly
+    # ceil((p+1)/P) pages, not its whole table)
+    live = j * page_size <= pos
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0]              # (g, D)
+        k = k_ref[0, 0]              # (P, D)
+        v = v_ref[0, 0]
+        k_pos = j * page_size + lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], page_size), 1)
+        mask = k_pos <= pos
+        m_new, l_new, acc_new = _softmax_tile(
+            q, k, v, m_scr[:, 0:1], l_scr[:, 0:1], acc_scr[:], mask,
+            scale)
+        acc_scr[:] = acc_new
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _emit():
+        # position 0 is always <= pos, so l > 0 for every lane (free
+        # engine lanes park at pos 0 and produce garbage the host
+        # ignores — garbage, not NaN)
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:, 0:1]).astype(o_ref.dtype)
+
+
+def paged_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                    v_pages: jnp.ndarray, page_table: jnp.ndarray,
+                    pos: jnp.ndarray, interpret: bool = False
+                    ) -> jnp.ndarray:
+    """Fused paged decode attention (one token per lane).
+
+    q: (B, 1, H, D); k_pages/v_pages: (num_pages, P, h_kv, D) —
+    the serving pool's per-layer slice (models/generate.py
+    ``init_kv_pool``), float dtypes only (the int8 pool dequantizes on
+    the gather path); page_table: (B, n_pt) int32; pos: (B,) int32.
+    Returns (B, 1, H, D).
+
+    Grid (B, h_kv, n_pt) with the page axis innermost: scratch carries
+    the online-softmax state across one lane-head's pages, the k/v
+    block index map reads ``page_table[b, j]`` from the scalar-prefetch
+    operand (the DMA for page j+1 can start before page j's math — the
+    standard TPU paged-attention shape), and pages past the lane's
+    position skip. GQA is native: q is blocked per KV head at the group
+    width, so the narrow pool is read once per group, never repeated.
+    ``interpret`` runs the Pallas interpreter (CPU-testable; the
+    correctness harness cross-checks against
+    :func:`paged_gather_attention`)."""
+    if q.dtype == jnp.int8 or k_pages.dtype == jnp.int8:
+        raise ValueError(
+            "paged_attention kernel reads float pools only; the int8 "
+            "pool decodes through the gather path (dequantize-on-read)")
+    b, one, h, d = q.shape
+    num_pages, page_size, h_kv, _d = k_pages.shape
+    g = h // h_kv
+    n_pt = page_table.shape[1]
+    scale = d ** -0.5
+    qk = q.reshape(b, h_kv, g, d)
+    # pool in kernel layout (num_pages, h_kv, P, D): legal (P, D) VMEM
+    # tiles, one relayout per layer per step — the production engine
+    # would store the pool in this layout outright; the wrapper keeps
+    # the engine's logical layout decoupled from Mosaic's tiling rules
+    kk = jnp.swapaxes(k_pages, 1, 2)
+    vk = jnp.swapaxes(v_pages, 1, 2)
+
+    def qspec():
+        return pl.BlockSpec((1, 1, g, d),
+                            lambda b_, hk, j, pt, ps: (b_, hk, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def kspec():
+        return pl.BlockSpec((1, 1, page_size, d),
+                            lambda b_, hk, j, pt, ps: (pt[b_, j], hk,
+                                                       0, 0),
+                            memory_space=pltpu.VMEM)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, n_pt),
+        in_specs=[qspec(), kspec(), kspec()],
+        out_specs=qspec(),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),  # running max
+            pltpu.VMEM((g, 128), jnp.float32),  # running sum
+            pltpu.VMEM((g, d), jnp.float32),    # output accumulator
+        ])
+    out = pl.pallas_call(
+        functools.partial(_paged_fwd_kernel, page_size=page_size,
+                          scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, g, d), q.dtype),
+        interpret=interpret,
+    )(page_table, pos, qk, kk, vk)
+    return out.reshape(b, one, h, d)
+
+
 def pick_flash_block(t: int, want: int) -> "int | None":
     """Largest legal flash block for sequence length ``t``, or None.
 

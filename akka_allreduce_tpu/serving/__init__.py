@@ -16,9 +16,17 @@ Modules:
 
 * ``engine.py`` — the device plane: fixed-slot batch, per-slot KV caches,
   one jitted step advancing every occupied slot (static shapes, compiles
-  once), slot-granular prefill refill.
+  once), slot-granular prefill refill; plus the PAGED engine
+  (:class:`~akka_allreduce_tpu.serving.engine.PagedServingEngine`,
+  ISSUE 7) whose KV lives in a flat page pool addressed through
+  per-request page tables — admission gated on free pages, shared
+  prompt prefixes stored once, bitwise parity kept.
+* ``paging.py`` — the page allocator: free-list, refcounts,
+  exact-content prefix registry, pre-paid copy-on-write splits. Pure
+  host Python, fuzz-pinned.
 * ``scheduler.py`` — the admission plane: FIFO / earliest-deadline queue,
-  max-depth backpressure, per-request budgets, slot accounting.
+  max-depth backpressure, per-request budgets, slot accounting, and the
+  engine memory gate (``pop_ready(can_admit=...)``).
 * ``metrics.py`` — TTFT/TPOT/queue-depth/occupancy histograms, wired
   into runtime/tracing.py spans and runtime/metrics.py host sampling.
 
@@ -39,6 +47,8 @@ Entry point: ``python -m akka_allreduce_tpu.cli serve`` (cli.py).
 
 from akka_allreduce_tpu.serving.engine import (
     EngineConfig,
+    PagedEngineConfig,
+    PagedServingEngine,
     ResumableRequest,
     ServingEngine,
     WatchdogTimeout,
@@ -48,6 +58,7 @@ from akka_allreduce_tpu.serving.engine import (
     serve_loop,
 )
 from akka_allreduce_tpu.serving.metrics import Histogram, ServingMetrics
+from akka_allreduce_tpu.serving.paging import AdmitPlan, PagePool, pages_for
 from akka_allreduce_tpu.serving.scheduler import (
     QueueFull,
     Request,
@@ -57,6 +68,11 @@ from akka_allreduce_tpu.serving.scheduler import (
 )
 
 __all__ = [
+    "AdmitPlan",
+    "PagePool",
+    "PagedEngineConfig",
+    "PagedServingEngine",
+    "pages_for",
     "EngineConfig",
     "ResumableRequest",
     "ServingEngine",

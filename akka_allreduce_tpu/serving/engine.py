@@ -109,6 +109,7 @@ from jax import lax
 from akka_allreduce_tpu.models.generate import (
     dequantize_kv,
     init_kv_cache,
+    init_kv_pool,
     multi_step_decode,
     prefill,
     quantize_kv,
@@ -118,6 +119,7 @@ from akka_allreduce_tpu.models.transformer import (
     lm_logits,
     rmsnorm,
 )
+from akka_allreduce_tpu.ops.pallas_kernels.attention import paged_gather_kv
 from akka_allreduce_tpu.parallel.ep import moe_ffn
 from akka_allreduce_tpu.parallel.ring_attention import NEG_INF
 from akka_allreduce_tpu.runtime.faults import InjectedFault, maybe_fail
@@ -195,6 +197,70 @@ class EngineConfig:
             raise ValueError(
                 f"prefill_buckets must be strictly increasing positive "
                 f"lengths, got {self.prefill_buckets}")
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedEngineConfig(EngineConfig):
+    """Shape knobs for the PAGED engine (:class:`PagedServingEngine`).
+
+    ``num_slots`` becomes the decode-LANE count — the compute batch
+    width of the one compiled step, no longer an HBM reservation: a
+    lane holds a page table, not a ``max_seq`` cache row. Memory is
+    ``num_pages`` x ``page_size`` KV positions in one flat pool
+    (models/generate.py ``init_kv_pool``; +1 scratch page for parked
+    lanes' garbage writes), and admission is gated on FREE PAGES
+    (serving/paging.py), so concurrency at a fixed HBM budget scales
+    with actual request lengths instead of worst-case ones.
+
+    ``page_size``: positions per page. Small pages waste less tail
+    (internal fragmentation ~ page_size/2 per request) but widen the
+    page table and the gather; 16-32 suits short-request serving,
+    128+ suits long contexts (DESIGN.md §12 "Choosing page size").
+
+    ``num_pages``: pool capacity; 0 (default) auto-sizes to the slot
+    engine's equivalent HBM (``num_slots * ceil(max_seq/page_size)``)
+    so A/B comparisons are equal-budget by construction.
+
+    ``attention_impl``: how decode reads K/V through the page table —
+    ``"gather"`` (default) materializes each lane's pages in logical
+    order and runs the slot engine's exact masked-softmax formula
+    (BITWISE parity with the slot engine and ``generate()``, CPU-
+    green); ``"pallas"`` runs the fused paged-attention kernel
+    (ops/pallas_kernels/attention.py ``paged_attention`` — no gathered
+    copy, online softmax, allclose-not-bitwise; float KV only,
+    interpreter mode off-TPU).
+
+    ``prefill_buckets`` is rejected: paged prefill is exact-length by
+    design (the parity mode), and page indirection already bounds what
+    bucketing exists to bound — program count grows with distinct
+    prompt LENGTHS, never with pool occupancy."""
+
+    page_size: int = 16
+    num_pages: int = 0
+    attention_impl: str = "gather"
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1, got {self.page_size}")
+        if self.num_pages < 0:
+            raise ValueError(
+                f"num_pages must be >= 0 (0 = auto), got "
+                f"{self.num_pages}")
+        if self.attention_impl not in ("gather", "pallas"):
+            raise ValueError(
+                f"attention_impl must be 'gather' or 'pallas', got "
+                f"{self.attention_impl!r}")
+        if self.prefill_buckets:
+            raise ValueError(
+                "prefill_buckets is a slot-engine knob; paged prefill "
+                "is exact-length (see PagedEngineConfig docstring)")
+        if self.kv_dtype is not None and self.attention_impl == "pallas":
+            raise ValueError(
+                "attention_impl='pallas' reads float pools only; the "
+                "int8 pool decodes through the gather path "
+                "(dequantize-on-read)")
 
 
 _KV_KEYS = ("k", "v", "k_scale", "v_scale")
@@ -448,6 +514,232 @@ def _engine_prefill(params: dict, state: dict, prompt: jnp.ndarray,
     return out
 
 
+# -- the paged device plane (ISSUE 7) -----------------------------------
+#
+# Same decode MATH as the slot programs above — the paged twins differ
+# only in where K/V bytes live: a flat (layers, num_pages, page_size,
+# kv_heads, head_dim) pool addressed through an (lanes, pages_per_seq)
+# int32 page table. The table is an OPERAND (data, never donated, never
+# a shape): request churn, prefix sharing and COW splits rewrite table
+# contents while every compiled program is reused verbatim — the paged
+# extension of the engine's no-recompile contract, pinned by the
+# ``engine_paged_step`` lint entry and tests/test_paged_engine.py.
+
+
+def _write_pool_rows(pool: jnp.ndarray, layer: int, vals: jnp.ndarray,
+                     pos: jnp.ndarray, page_table: jnp.ndarray,
+                     page_size: int,
+                     mask: "jnp.ndarray | None" = None) -> jnp.ndarray:
+    """The paged ``_write_slot_rows``: write ``vals[s]`` at lane s's
+    CURRENT page — ``pool[layer, page_table[s, pos[s] // P],
+    pos[s] % P]``. Same unrolled-DUS shape (donation keeps the pool
+    updating in place), with the row index routed through the table.
+    A parked lane (table row all zeros, pos 0) writes the reserved
+    scratch page 0 — the paged analogue of the slot engine's
+    park-at-position-0 garbage write."""
+    for s in range(vals.shape[0]):
+        page = page_table[s, pos[s] // page_size]
+        off = pos[s] % page_size
+        val = vals[s][None, None, None]
+        idx = (layer, page, off) + (0,) * (vals.ndim - 1)
+        if mask is not None:
+            old = lax.dynamic_slice(pool, idx, val.shape)
+            val = jnp.where(mask[s], val, old)
+        pool = lax.dynamic_update_slice(pool, val, idx)
+    return pool
+
+
+def _paged_decode_step(params: dict, kv: dict, token: jnp.ndarray,
+                       pos: jnp.ndarray, page_table: jnp.ndarray,
+                       cfg: TransformerConfig, impl: str,
+                       write_mask: "jnp.ndarray | None" = None):
+    """``_slot_decode_step`` with the per-slot cache rows replaced by
+    the page pool: identical projections, norms, rope, residual order
+    and cast points — only K/V placement (table-routed page writes) and
+    the attention read path differ, neither of which touches a lane's
+    arithmetic. ``impl="gather"`` gathers each lane's pages and runs
+    ``_slot_cached_attention`` — the SAME function object the slot
+    engine runs, over content bitwise equal at every valid position, so
+    paged greedy decode is bitwise the slot engine's (the masked tail
+    of the gathered buffer contributes exactly 0.0 to the softmax sums
+    even when the padded length differs from max_seq).
+    ``impl="pallas"`` dispatches the fused paged-attention kernel
+    instead (float pools only, allclose-not-bitwise)."""
+    s = token.shape[0]
+    quantized = "k_scale" in kv
+    P = kv["k"].shape[2]
+    x = params["embed"][token][:, None, :]
+    if not cfg.rope:
+        x = x + params["pos"][pos][:, None, :]
+    k_pool, v_pool = kv["k"], kv["v"]
+    if quantized:
+        k_scales, v_scales = kv["k_scale"], kv["v_scale"]
+    for i, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["ln1"])
+        q = (h @ layer["wq"]).reshape(s, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(s, 1, cfg.kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(s, 1, cfg.kv_heads, cfg.head_dim)
+        if cfg.rope:
+            q = _rope_slots(q, pos, cfg.rope_theta)
+            k = _rope_slots(k, pos, cfg.rope_theta)
+        if quantized:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_pool = _write_pool_rows(k_pool, i, kq[:, 0], pos,
+                                      page_table, P, write_mask)
+            v_pool = _write_pool_rows(v_pool, i, vq[:, 0], pos,
+                                      page_table, P, write_mask)
+            k_scales = _write_pool_rows(k_scales, i, ks[:, 0], pos,
+                                        page_table, P, write_mask)
+            v_scales = _write_pool_rows(v_scales, i, vs[:, 0], pos,
+                                        page_table, P, write_mask)
+            # dequantize-on-read after the gather: elementwise, so the
+            # values equal the slot engine's dequantized cache at every
+            # valid position (same int8 bytes, same scales)
+            k_all = dequantize_kv(paged_gather_kv(k_pool[i], page_table),
+                                  paged_gather_kv(k_scales[i], page_table),
+                                  cfg.dtype)
+            v_all = dequantize_kv(paged_gather_kv(v_pool[i], page_table),
+                                  paged_gather_kv(v_scales[i], page_table),
+                                  cfg.dtype)
+            attn = _slot_cached_attention(q, k_all, v_all, pos,
+                                          window=cfg.attn_window)
+        else:
+            k_pool = _write_pool_rows(
+                k_pool, i, k[:, 0].astype(k_pool.dtype), pos,
+                page_table, P, write_mask)
+            v_pool = _write_pool_rows(
+                v_pool, i, v[:, 0].astype(v_pool.dtype), pos,
+                page_table, P, write_mask)
+            if impl == "pallas":
+                from akka_allreduce_tpu.ops.pallas_kernels.attention \
+                    import paged_attention
+                attn = paged_attention(
+                    q, k_pool[i], v_pool[i], page_table, pos,
+                    interpret=jax.devices()[0].platform != "tpu")
+            else:
+                k_all = paged_gather_kv(k_pool[i], page_table)
+                v_all = paged_gather_kv(v_pool[i], page_table)
+                attn = _slot_cached_attention(q, k_all, v_all, pos,
+                                              window=cfg.attn_window)
+        x = x + attn.reshape(s, 1, -1) @ layer["wo"]
+
+        h = rmsnorm(x, layer["ln2"])
+        if "router" in layer:
+            y, _aux = moe_ffn(h, layer, cfg.moe, axis_name=None)
+            x = x + y
+        elif "w3" in layer:
+            x = x + (jax.nn.silu(h @ layer["w1"])
+                     * (h @ layer["w3"])) @ layer["w2"]
+        else:
+            x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    logits = lm_logits(params, rmsnorm(x, params["out_norm"]), cfg)
+    new_kv = {"k": k_pool, "v": v_pool}
+    if quantized:
+        new_kv["k_scale"], new_kv["v_scale"] = k_scales, v_scales
+    return new_kv, logits[:, 0, :]
+
+
+@partial(jax.jit, static_argnames=("cfg", "impl"), donate_argnums=(1,))
+def _engine_paged_step(params: dict, state: dict, pos: jnp.ndarray,
+                       page_table: jnp.ndarray, cfg: TransformerConfig,
+                       impl: str):
+    """The paged ``_engine_step``: same argmax-carry-advance contract
+    and (2, slots) packed readback, with the KV pool donated (in-place
+    page writes) and the page table a plain int32 OPERAND — table
+    rewrites between dispatches (churn, sharing, COW) are data, so this
+    program compiles exactly once per engine config."""
+    logits_in = state["logits"]
+    tok = jnp.argmax(logits_in, axis=-1).astype(jnp.int32)
+    finite = jnp.isfinite(logits_in).all(axis=-1)
+    kv = {n: state[n] for n in state if n != "logits"}
+    new_kv, logits = _paged_decode_step(params, kv, tok, pos,
+                                        page_table, cfg, impl)
+    packed = jnp.stack([tok, finite.astype(jnp.int32)])
+    return {**new_kv, "logits": logits}, packed
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "impl"),
+         donate_argnums=(1,))
+def _engine_paged_multi_step(params: dict, state: dict, pos: jnp.ndarray,
+                             done: jnp.ndarray, remaining: jnp.ndarray,
+                             eos_ids: jnp.ndarray, stop_ids: jnp.ndarray,
+                             page_table: jnp.ndarray,
+                             cfg: TransformerConfig, steps: int,
+                             impl: str):
+    """The paged ``_engine_multi_step``: ``multi_step_decode``'s masked
+    S-step scan over the paged decode step. The page table is loop-
+    invariant across the block (every page a lane can write during S
+    steps is resolved — COW-split if shared — by the host's pre-write
+    pass BEFORE the dispatch), so it rides the scan as a closed-over
+    operand, not a carry."""
+
+    def decode_fn(p, kv, tok, p_pos, write_mask):
+        return _paged_decode_step(p, kv, tok, p_pos, page_table, cfg,
+                                  impl, write_mask=write_mask)
+
+    kv = {n: state[n] for n in state if n != "logits"}
+    (kv, logits, pos, done, remaining, bad), toks = multi_step_decode(
+        params, kv, state["logits"], pos, done, remaining,
+        eos_ids, stop_ids, steps, decode_fn)
+    packed = jnp.concatenate(
+        [toks, pos[None], bad.astype(jnp.int32)[None]], axis=0)
+    return {**kv, "logits": logits}, packed, pos, done, remaining
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _engine_paged_prefill(params: dict, state: dict, prompt: jnp.ndarray,
+                          page_ids: jnp.ndarray, slot: jnp.ndarray,
+                          cfg: TransformerConfig):
+    """Prefill ``prompt`` (1, L) and scatter its K/V into the pool
+    pages ``page_ids`` (ceil(L/P) ids, static count — jit's shape cache
+    keys one program per prompt length, exactly like the slot path).
+    The prefill math runs the SAME exact-length program shape
+    ``generate()`` prefills with (bitwise parity); only the cache
+    destination differs: each page-sized chunk of the temp lane lands
+    at its table-assigned pool page. A shared page re-writes identical
+    bytes (content-keyed sharing, serving/paging.py) — the redundant
+    write is the price of one-program-per-length."""
+    quant = "k_scale" in state
+    one = init_kv_cache(cfg, 1, kv_dtype="int8" if quant else None)
+    cache, logits = prefill(params, one, prompt, cfg)
+    out = dict(state)
+    n_pages = page_ids.shape[0]
+    P = state["k"].shape[2]
+    for n in _KV_KEYS:
+        if n not in cache:
+            continue
+        pool = out[n]
+        for c in range(n_pages):
+            chunk = cache[n][:, 0, c * P:(c + 1) * P][:, None]
+            pool = lax.dynamic_update_slice(
+                pool, chunk, (0, page_ids[c], 0) + (0,) * (chunk.ndim - 3))
+        out[n] = pool
+    out["logits"] = lax.dynamic_update_slice(
+        state["logits"], logits.astype(state["logits"].dtype),
+        (slot, 0))
+    return out
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _copy_page(state: dict, src: jnp.ndarray, dst: jnp.ndarray) -> dict:
+    """The COW split's device half: copy one page's K/V (+ scales)
+    ``src`` -> ``dst`` across every layer, in place (donated state).
+    One compiled program for the engine's lifetime — src/dst are
+    traced scalars."""
+    out = dict(state)
+    for n in _KV_KEYS:
+        if n not in state:
+            continue
+        pool = state[n]
+        page = lax.dynamic_slice(
+            pool, (0, src, 0) + (0,) * (pool.ndim - 3),
+            (pool.shape[0], 1) + pool.shape[2:])
+        out[n] = lax.dynamic_update_slice(
+            pool, page, (0, dst, 0) + (0,) * (pool.ndim - 3))
+    return out
+
+
 @dataclasses.dataclass
 class _SlotState:
     """Host-side bookkeeping for one occupied slot."""
@@ -509,6 +801,10 @@ class ServingEngine:
         self._vectors_dirty = True
         self.decode_dispatches = 0
         self.prefill_dispatches = 0
+        # high-water mark of concurrently occupied slots/lanes — the
+        # paged A/B's sustained-concurrency evidence (bench.py
+        # measure_paged_serving)
+        self.peak_occupied = 0
         # block steps computed for a lane AFTER its done-mask latched
         # (S>1 tail waste — the quantity an operator tunes decode_steps
         # against; always 0 at S=1)
@@ -599,16 +895,9 @@ class ServingEngine:
                 f"{buckets[-1]}")
         return buckets[i]
 
-    def admit(self, req: Request, emitted: tuple = ()) -> int:
-        """Prefill ``req`` into a free slot; returns the slot index.
-
-        ``emitted`` is the drain/restore hook (:meth:`restore`): tokens
-        the request already generated in a previous engine, replayed
-        through prefill as part of the prompt — the cached-decode ==
-        full-forward parity contract makes the replayed logits bitwise
-        the drained engine's, so the continued stream is exact. The
-        decode budget shrinks by ``len(emitted)``; the total sequence
-        footprint (and the max_seq validation) is unchanged."""
+    def _validate_admit(self, req: Request, emitted: tuple) -> tuple:
+        """The admission contract checks shared by every engine kind;
+        returns the request's stop-token tuple."""
         n = len(req.prompt)
         if n < 1:
             raise ValueError(f"request {req.rid}: empty prompt")
@@ -635,12 +924,21 @@ class ServingEngine:
             raise ValueError(
                 f"request {req.rid}: restore carries {len(emitted)} "
                 f"generated tokens, >= its budget {req.max_new_tokens}")
-        try:
-            slot = self._slots.index(None)
-        except ValueError:
-            raise RuntimeError("no free slot (admit gated on "
-                               "free_slot_count)") from None
-        full = tuple(req.prompt) + tuple(emitted)
+        return stops
+
+    def can_admit(self, req: Request, emitted: tuple = ()) -> bool:
+        """Beyond a free slot, does the engine have the MEMORY for this
+        request right now? Always true for the slot engine (a slot IS
+        its reservation); the paged engine answers from its free-page
+        count — the admission signal the scheduler consumes
+        (serve_loop / RequestScheduler.pop_ready)."""
+        return True
+
+    def _prefill_into(self, slot: int, req: Request, full: tuple) -> None:
+        """Dispatch the prefill that fills ``slot``'s KV with ``full``
+        (prompt + any restore-replayed tokens) — the slot engine's
+        bucket-padded lane write; the paged engine overrides with page
+        allocation + pool scatter."""
         n_full = len(full)
         length = self._bucket_len(n_full)
         padded = np.zeros((1, length), np.int32)
@@ -656,6 +954,26 @@ class ServingEngine:
                 self.cfg, gather=length != n_full)
         self.prefill_dispatches += 1
         self.prefill_shapes.add((length, length != n_full))
+
+    def admit(self, req: Request, emitted: tuple = ()) -> int:
+        """Prefill ``req`` into a free slot; returns the slot index.
+
+        ``emitted`` is the drain/restore hook (:meth:`restore`): tokens
+        the request already generated in a previous engine, replayed
+        through prefill as part of the prompt — the cached-decode ==
+        full-forward parity contract makes the replayed logits bitwise
+        the drained engine's, so the continued stream is exact. The
+        decode budget shrinks by ``len(emitted)``; the total sequence
+        footprint (and the max_seq validation) is unchanged."""
+        stops = self._validate_admit(req, emitted)
+        try:
+            slot = self._slots.index(None)
+        except ValueError:
+            raise RuntimeError("no free slot (admit gated on "
+                               "free_slot_count)") from None
+        full = tuple(req.prompt) + tuple(emitted)
+        n_full = len(full)
+        self._prefill_into(slot, req, full)
         self._pos[slot] = n_full
         self._eos[slot] = -1 if req.eos_token is None else req.eos_token
         self._stops[slot, :] = -1
@@ -664,6 +982,7 @@ class ServingEngine:
         self._remaining[slot] = req.max_new_tokens - len(emitted)
         self._vectors_dirty = True
         self._slots[slot] = _SlotState(req=req, emitted=list(emitted))
+        self.peak_occupied = max(self.peak_occupied, self.occupied)
         if self.metrics is not None:
             self.metrics.on_admit(req.rid, slot, n_full)
         return slot
@@ -1026,6 +1345,252 @@ class ServingEngine:
                     pos_d, done_d, rem_d)
 
 
+class PagedServingEngine(ServingEngine):
+    """The paged-KV engine (ISSUE 7 tentpole): ``ServingEngine``'s host
+    loop, dispatch discipline and failure story, with the per-slot
+    ``max_seq`` cache monoliths replaced by a page pool + per-lane page
+    tables.
+
+    What changes and what doesn't:
+
+    * MEMORY — ``init_kv_pool`` (models/generate.py) owns the flat
+      pool; serving/paging.py ``PagePool`` owns which page backs whom
+      (free list, refcounts, shared prompt-prefix pages, COW tails).
+      Admission is gated on FREE PAGES (:meth:`can_admit`), so at a
+      fixed HBM budget the engine sustains as many concurrent requests
+      as their ACTUAL lengths allow — the capacity multiplier — and N
+      requests sharing a system prompt pay its KV once.
+    * COMPUTE — one jitted step per config, same as ever; the page
+      table rides as an int32 operand (data, not shape), so churn,
+      sharing and COW rewrite table contents while every program is
+      reused (the paged no-recompile contract). The host runs a
+      PRE-WRITE pass before each dispatch (:meth:`_prepare_writes`):
+      any shared/registered page the block will write is COW-split
+      (device page copy, one compiled program) or unregistered first,
+      so the dispatch itself never observes sharing.
+    * PARITY — with the default ``attention_impl="gather"`` the decode
+      math is op-for-op the slot engine's (same function objects), so
+      greedy tokens are BITWISE ``generate()``'s across S, fp and
+      int8, under churn and recovery (tests/test_paged_engine.py).
+    * FAILURE — watchdog/raise recovery, NaN containment, eviction and
+      drain/restore are inherited; every slot-free path releases the
+      lane's pages, so recovery leaves the pool empty and consistent.
+    """
+
+    def __init__(self, params: dict, cfg: TransformerConfig,
+                 ecfg: PagedEngineConfig = PagedEngineConfig(),
+                 metrics=None, tracer=None, clock=time.monotonic):
+        from akka_allreduce_tpu.serving.paging import PagePool, pages_for
+        if not isinstance(ecfg, PagedEngineConfig):
+            raise TypeError(
+                f"PagedServingEngine needs a PagedEngineConfig, got "
+                f"{type(ecfg).__name__}")
+        if ecfg.attention_impl == "pallas" and cfg.attn_window:
+            raise ValueError(
+                "attention_impl='pallas' does not implement sliding-"
+                "window decode; use the gather path with attn_window")
+        self._pages_per_seq = pages_for(cfg.max_seq, ecfg.page_size)
+        num_pages = ecfg.num_pages or (
+            ecfg.num_slots * self._pages_per_seq)
+        if num_pages < self._pages_per_seq:
+            raise ValueError(
+                f"num_pages={num_pages} cannot hold one maximal request "
+                f"({self._pages_per_seq} pages of {ecfg.page_size} for "
+                f"max_seq {cfg.max_seq})")
+        # +1: page 0 is the reserved scratch sink for parked lanes'
+        # garbage writes (their table rows are all zeros)
+        self.pool = PagePool(num_pages + 1, ecfg.page_size,
+                             scratch_pages=1)
+        self._lane_pages: "list[Optional[list]]" = [None] * ecfg.num_slots
+        self._lane_end: "list[int]" = [0] * ecfg.num_slots
+        self._pt = np.zeros((ecfg.num_slots, self._pages_per_seq),
+                            np.int32)
+        self._pt_dirty = True
+        self._dev_pt = None
+        self.cow_page_copies = 0  # device page copies (splits that ran)
+        # capacity-story peaks: what the pool actually held vs what the
+        # same live set would have cost with no sharing — the
+        # prefix-reuse HBM saving is their ratio
+        self._unshared_pages_now = 0
+        self.peak_pages_in_use = 0
+        self.peak_pages_unshared = 0
+        super().__init__(params, cfg, ecfg, metrics=metrics,
+                         tracer=tracer, clock=clock)
+
+    def _fresh_state(self) -> dict:
+        return {**init_kv_pool(self.cfg, self.pool.num_pages,
+                               self.ecfg.page_size,
+                               kv_dtype=self.ecfg.kv_dtype),
+                "logits": jnp.zeros(
+                    (self.ecfg.num_slots, self.cfg.vocab_size),
+                    self.cfg.dtype)}
+
+    # -- admission ------------------------------------------------------
+
+    def can_admit(self, req: Request, emitted: tuple = ()) -> bool:
+        full = tuple(req.prompt) + tuple(emitted)
+        budget = req.max_new_tokens - len(emitted)
+        return self.pool.can_admit(full, budget)
+
+    def _prefill_into(self, slot: int, req: Request, full: tuple) -> None:
+        from akka_allreduce_tpu.serving.paging import pages_for
+        n_full = len(full)
+        budget = req.max_new_tokens - (n_full - len(req.prompt))
+        pages, _writes = self.pool.admit(full, budget)
+        self._lane_pages[slot] = pages
+        self._lane_end[slot] = n_full + budget
+        self._pt[slot, :] = 0
+        self._pt[slot, :len(pages)] = pages
+        self._pt_dirty = True
+        self._unshared_pages_now += pages_for(n_full + budget,
+                                              self.ecfg.page_size)
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pool.pages_in_use)
+        self.peak_pages_unshared = max(self.peak_pages_unshared,
+                                       self._unshared_pages_now)
+        arr = np.asarray(full, np.int32)[None]
+        n_cov = pages_for(n_full, self.ecfg.page_size)
+        span = (self.tracer.span("serve_prefill", rid=req.rid, slot=slot,
+                                 prompt_len=n_full, pages=len(pages),
+                                 shared=sum(1 for w in _writes if not w))
+                if self.tracer is not None else _null_span())
+        with span:
+            self._state = _engine_paged_prefill(
+                self.params, self._state, jnp.asarray(arr),
+                jnp.asarray(pages[:n_cov], jnp.int32),
+                jnp.asarray(slot, jnp.int32), self.cfg)
+        self.prefill_dispatches += 1
+        self.prefill_shapes.add((n_full, False))
+
+    def _free_slot(self, i: int) -> None:
+        from akka_allreduce_tpu.serving.paging import pages_for
+        if self._lane_pages[i] is not None:
+            self.pool.release_all(self._lane_pages[i])
+            self._lane_pages[i] = None
+            self._unshared_pages_now -= pages_for(
+                self._lane_end[i], self.ecfg.page_size)
+            self._lane_end[i] = 0
+        self._pt[i, :] = 0
+        self._pt_dirty = True
+        super()._free_slot(i)
+
+    # -- the pre-write (COW) pass ---------------------------------------
+
+    def _prepare_writes(self) -> None:
+        """Resolve sharing for every page the NEXT dispatch may write:
+        a shared page COW-splits (pool spare + device ``_copy_page`` +
+        table rewrite), an exclusively-held registered page drops its
+        registry entry (its content is about to stop being the prompt
+        prefix the key promises). Runs host-side between dispatches, so
+        the jitted step never sees a shared page under its pen —
+        conservative over the block (a lane that latches early splits a
+        page it wouldn't have written; correctness is unaffected)."""
+        s_steps = self.ecfg.decode_steps
+        P = self.ecfg.page_size
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            pages = self._lane_pages[i]
+            p0 = int(self._pos[i])
+            n_write = max(1, min(s_steps, int(self._remaining[i])))
+            last = min(p0 + n_write - 1, self._lane_end[i] - 1)
+            for c in range(p0 // P, min(last // P + 1, len(pages))):
+                page = pages[c]
+                if not (self.pool.is_shared(page)
+                        or self.pool.is_registered(page)):
+                    continue
+                new = self.pool.split_for_write(page)
+                if new is not None:
+                    self._state = _copy_page(
+                        self._state, jnp.asarray(page, jnp.int32),
+                        jnp.asarray(new, jnp.int32))
+                    self.cow_page_copies += 1
+                    pages[c] = new
+                    self._pt[i, c] = new
+                    self._pt_dirty = True
+                    if self.tracer is not None:
+                        self.tracer.record("serve_cow_split", slot=i,
+                                           rid=slot.req.rid,
+                                           src=page, dst=new)
+
+    def step(self) -> list:
+        self._prepare_writes()
+        return super().step()
+
+    # -- the dispatch paths (page-table operand) ------------------------
+
+    def _page_table_device(self):
+        if self._pt_dirty or self._dev_pt is None:
+            self._dev_pt = jnp.asarray(self._pt)
+            self._pt_dirty = False
+        return self._dev_pt
+
+    def _dispatch_single(self, state_in: dict, pos_in, dspan=None):
+        pt = self._page_table_device()
+        with (dspan.annotation() if dspan is not None
+              else _null_span()):
+            state, packed = _engine_paged_step(
+                self.params, state_in, pos_in, pt, self.cfg,
+                self.ecfg.attention_impl)
+            if dspan is not None:
+                dspan.mark_dispatched()
+            return state, np.asarray(packed)
+
+    def _dispatch_block(self, state_in: dict, d: dict, s_steps: int,
+                        dspan=None):
+        pt = self._page_table_device()
+        with (dspan.annotation() if dspan is not None
+              else _null_span()):
+            state, packed, pos_d, done_d, rem_d = \
+                _engine_paged_multi_step(
+                    self.params, state_in, d["pos"], d["done"],
+                    d["remaining"], d["eos"], d["stops"], pt,
+                    self.cfg, s_steps, self.ecfg.attention_impl)
+            if dspan is not None:
+                dspan.mark_dispatched()
+            return (state, np.asarray(packed), pos_d, done_d, rem_d)
+
+    # -- introspection / metrics ----------------------------------------
+
+    def paging_summary(self) -> dict:
+        """The page-pool health numbers the metrics plane exports
+        (OPERATIONS.md "Page-pool sizing"): utilization (allocated /
+        capacity — the admission headroom), fragmentation (reserved-
+        but-unwritten fraction of allocated capacity; sharing can push
+        it to 0 because shared positions are stored once but counted
+        per holder), prefix hit rate, and the cumulative sharing/COW
+        counters. Peaks carry the capacity story: ``hbm_saving_x`` is
+        what the live set would have cost unshared over what it
+        actually held."""
+        pool = self.pool
+        live_tokens = sum(int(self._pos[i])
+                          for i, s in enumerate(self._slots)
+                          if s is not None)
+        in_use = pool.pages_in_use
+        cap = pool.capacity
+        return {
+            "page_size": self.ecfg.page_size,
+            "pages_total": cap,
+            "pages_free": pool.free_pages,
+            "pages_in_use": in_use,
+            "utilization": round(in_use / cap, 4) if cap else 0.0,
+            "fragmentation": round(
+                max(0.0, 1.0 - live_tokens
+                    / (in_use * self.ecfg.page_size)), 4)
+                if in_use else 0.0,
+            "prefix_hit_rate": round(pool.prefix_hit_rate, 4),
+            "prefix_hits": pool.prefix_hits,
+            "prefix_lookups": pool.prefix_lookups,
+            "pages_shared_total": pool.pages_shared_total,
+            "cow_splits_total": pool.cow_splits,
+            "peak_pages_in_use": self.peak_pages_in_use,
+            "peak_pages_unshared": self.peak_pages_unshared,
+            "hbm_saving_x": round(
+                self.peak_pages_unshared / self.peak_pages_in_use, 3)
+                if self.peak_pages_in_use else 1.0,
+        }
+
+
 class _null_span:
     def __enter__(self):
         return self
@@ -1175,16 +1740,33 @@ def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,
             drain_drops()
             return results
         now = clock()
+        resume_blocked = False
         while engine.free_slot_count > 0 and pending_resume:
-            rr = pending_resume.pop(0)
+            rr = pending_resume[0]
+            if not engine.can_admit(rr.req, rr.generated):
+                # paged: the replay waits for pages — and HOLDS its
+                # head-of-line priority: fresh queue admissions must
+                # not siphon off every page decode frees, or a large
+                # drained request starves behind later-submitted small
+                # ones (it was admitted first in its previous life).
+                # No deadlock: an empty engine implies an empty pool,
+                # where any valid request fits.
+                resume_blocked = True
+                break
+            pending_resume.pop(0)
             if rr.req.submitted_at is None:
                 # restored across a process boundary: the original
                 # submit instant died with the old clock domain — TTFT
                 # for a restored request measures from its restore
                 rr.req.submitted_at = now
             scheduler.bind(rr.req, engine.restore(rr))
-        while engine.free_slot_count > 0:
-            req = scheduler.pop_ready(now)
+        while not resume_blocked and engine.free_slot_count > 0:
+            # the memory gate rides admission: the slot engine always
+            # says yes (a slot IS its reservation); the paged engine
+            # answers from free pages, leaving a too-big head request
+            # queued until decode frees its bill (head-of-line order is
+            # preserved — admission never reorders around memory)
+            req = scheduler.pop_ready(now, can_admit=engine.can_admit)
             if req is None:
                 break
             slot = engine.admit(req)

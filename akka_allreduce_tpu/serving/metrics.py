@@ -99,6 +99,8 @@ class ServingMetrics:
         self._first_count: dict[int, int] = {}
         self._t0: Optional[float] = None
         self._t_end: Optional[float] = None
+        # paged-engine page-pool summary source (attach_paging)
+        self._paging = None
         # -- telemetry plane (ISSUE 6): drained-snapshot persistence
         # (the registry-owned counter the drain runbook watches)
         self._drain_persisted = self.registry.counter(
@@ -166,6 +168,48 @@ class ServingMetrics:
         )
         for name, pull, help_text in histograms:
             r.register_histogram(name, pull, help=help_text)
+
+    # -- paged engine (ISSUE 7) ----------------------------------------
+
+    def attach_paging(self, paging_summary) -> None:
+        """Register the paged engine's page-pool series as pull
+        collectors over ``paging_summary`` (a zero-arg callable —
+        normally ``PagedServingEngine.paging_summary``). Scrape and
+        summary() read the SAME dict by construction, keeping the
+        selfcheck's prom-snapshot == summary contract. No-op series for
+        slot-engine runs: nothing registers until a paged engine
+        attaches."""
+        if self._paging is not None:
+            raise RuntimeError("paging already attached")
+        self._paging = paging_summary
+        gauges = (
+            ("serve_page_pool_pages", "pages_total",
+             "page-pool capacity (scratch excluded)"),
+            ("serve_page_pool_free", "pages_free",
+             "free pages — the admission headroom"),
+            ("serve_page_pool_utilization", "utilization",
+             "allocated fraction of pool capacity"),
+            ("serve_page_fragmentation", "fragmentation",
+             "reserved-but-unwritten fraction of allocated capacity"),
+            ("serve_prefix_hit_rate", "prefix_hit_rate",
+             "full prompt pages served by sharing instead of "
+             "allocation"),
+        )
+        for name, key, help_text in gauges:
+            self.registry.register_callback(
+                name, (lambda k=key: self._paging()[k]), kind="gauge",
+                help=help_text)
+        counters = (
+            ("serve_prefix_pages_shared_total", "pages_shared_total",
+             "page acquisitions served by refcount++ (prefix reuse)"),
+            ("serve_cow_splits_total", "cow_splits_total",
+             "shared pages copy-on-write split at first divergent "
+             "write"),
+        )
+        for name, key, help_text in counters:
+            self.registry.register_callback(
+                name, (lambda k=key: self._paging()[k]), kind="counter",
+                help=help_text)
 
     # -- lifecycle hooks ----------------------------------------------
 
@@ -362,6 +406,10 @@ class ServingMetrics:
             "queue_depth": self.queue_depth.summary(digits=2),
             "slot_occupancy": self.slot_occupancy.summary(digits=3),
         }
+        if self._paging is not None:
+            # the page-pool story (paged engine only): the same dict
+            # the registry's serve_page_* collectors read
+            out["paging"] = self._paging()
         if self.wall_s is not None:
             out["wall_s"] = round(self.wall_s, 3)
             out["decode_tokens_per_s"] = round(

@@ -186,6 +186,11 @@ class RequestScheduler:
         # thresholds: required count = ceil(fraction * total))
         self.step_quorum = max(1, math.ceil(cfg.th_step * num_slots))
         self.rejected = 0
+        # admission polls where the head request waited on engine
+        # MEMORY (the paged engine's free-page gate) with its slot
+        # otherwise available — sustained growth means the page pool,
+        # not the lane count, is the bottleneck (OPERATIONS.md)
+        self.blocked_on_memory = 0
         # -- failure plumbing (serving fault tolerance) -----------------
         self._rng = random.Random(cfg.seed)  # retry jitter
         self.retries = 0            # successful requeues
@@ -260,21 +265,34 @@ class RequestScheduler:
                 and req.deadline < now + (self.cfg.min_feasible_tokens
                                           * self.cfg.tpot_estimate))
 
-    def pop_ready(self, now: Optional[float] = None) -> Optional[Request]:
+    def pop_ready(self, now: Optional[float] = None,
+                  can_admit=None) -> Optional[Request]:
         """Best live request as of ``now`` (None = nothing has arrived).
         Under the deadline policy an urgent late arrival outranks a
         patient early one; among equals, submit order decides —
         and already-infeasible requests are shed (``rejected_
-        infeasible``), never admitted."""
+        infeasible``), never admitted.
+
+        ``can_admit`` is the engine's MEMORY gate (paged serving: free
+        pages instead of free slots): when the best request fails it,
+        the request goes back at its position and None returns —
+        admission waits for memory in policy order rather than
+        reordering around it (counted in ``blocked_on_memory``, the
+        page-pressure signal next to ``queue_depth``)."""
         if now is None:
             now = self.clock()
         self._drain_arrivals(now)
         while self._arrived:
-            req = heapq.heappop(self._arrived)[2]
+            entry = heapq.heappop(self._arrived)
+            req = entry[2]
             if self._infeasible(req, now):
                 self.shed_infeasible += 1
                 self._dropped.append((req, "rejected_infeasible"))
                 continue
+            if can_admit is not None and not can_admit(req):
+                heapq.heappush(self._arrived, entry)
+                self.blocked_on_memory += 1
+                return None
             return req
         return None
 

@@ -45,7 +45,8 @@ import re
 from typing import Optional
 
 # sections the gate knows how to re-measure, in bank order
-SECTIONS = ("serving_throughput", "multi_step_decode", "ab_overlap")
+SECTIONS = ("serving_throughput", "multi_step_decode", "paged_serving",
+            "ab_overlap")
 
 # per-section relative tolerance, derived from the banked captures' own
 # recorded run-to-run spread (module docstring); _DEFAULT for unknowns
@@ -55,6 +56,10 @@ SECTIONS = ("serving_throughput", "multi_step_decode", "ab_overlap")
 SECTION_TOLERANCE = {
     "serving_throughput": 0.45,
     "multi_step_decode": 0.45,
+    # same shared-box serving noise regime as the two sections above
+    # (wall-clock ratios of ~1 s runs); still < 0.5 so a 2x regression
+    # in the paged-vs-slot claim fails at the boundary
+    "paged_serving": 0.45,
     "ab_overlap": 0.35,
 }
 _DEFAULT_TOLERANCE = 0.35
@@ -209,6 +214,14 @@ def fresh_rows(section: str) -> list:
         return measure_multi_step_decode(
             d_model=256, n_layers=2, d_ff=1024, vocab=1024,
             n_requests=24, reps=4)
+    if section == "paged_serving":
+        from akka_allreduce_tpu.bench import measure_paged_serving
+        if on_tpu:
+            return measure_paged_serving(
+                d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+                n_requests=32, prompt_len=64, steps=128, slots=4,
+                page_size=32, max_seq=1024)
+        return measure_paged_serving()
     if section == "ab_overlap":
         from akka_allreduce_tpu.bench import measure_ab_overlap
         return list(measure_ab_overlap())

@@ -88,7 +88,7 @@ def main(only=None) -> int:
         fns = {f.__name__: f for f in
                (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                 ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
-                serving_throughput, multi_step_decode)}
+                serving_throughput, multi_step_decode, paged_serving)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -171,7 +171,7 @@ def main(only=None) -> int:
     skip = set(os.environ.get("AATPU_SUITE_SKIP", "").split(","))
     for fn in (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
-               serving_throughput, multi_step_decode):
+               serving_throughput, multi_step_decode, paged_serving):
         if fn.__name__ not in skip:
             fn()
     return 0
@@ -227,6 +227,33 @@ def multi_step_decode():
         rows = measure_multi_step_decode(
             d_model=256, n_layers=2, d_ff=1024, vocab=1024,
             n_requests=24, reps=4)
+    for row in rows:
+        emit(row["metric"], row["value"], row["unit"], row["note"])
+
+
+def paged_serving():
+    """The paged-KV A/B (ISSUE 7, serving/paging.py +
+    PagedServingEngine): paged engine vs slot engine at EQUAL cache-HBM
+    budget — the paged arm runs more decode lanes than the slot arm has
+    slots because short requests stop reserving max_seq each — plus a
+    shared-prompt variant measuring the prefix-reuse HBM saving. The
+    speedup row is the claim; the concurrency and prefix-saving rows
+    are the mechanism (akka_allreduce_tpu.bench
+    measure_paged_serving). CPU sizes the model down the way
+    multi_step_decode does (step time ~1 ms, the TPU-like
+    overhead:compute ratio); TPU sizes up."""
+    import jax
+
+    from akka_allreduce_tpu.bench import measure_paged_serving
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        rows = measure_paged_serving(
+            d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+            n_requests=32, prompt_len=64, steps=128, slots=4,
+            page_size=32, max_seq=1024)
+    else:
+        rows = measure_paged_serving()
     for row in rows:
         emit(row["metric"], row["value"], row["unit"], row["note"])
 

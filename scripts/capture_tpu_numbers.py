@@ -68,6 +68,15 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "multi_step_decode"], check=False)
 """),
+    # 3. the paged-KV A/B (ISSUE 7's open claim): paged engine vs slot
+    # engine at equal cache-HBM budget + the shared-prompt prefix-reuse
+    # saving — CPU rows banked in perf_capture/paged.json; this is the
+    # on-chip row, sized up by bench_suite's on-TPU defaults
+    ("paged_serving", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "paged_serving"], check=False)
+"""),
     # 3. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time. guard_recompiles: every timed run holds under the
@@ -120,7 +129,7 @@ import os, subprocess, sys
 env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1",
        "AATPU_SUITE_SKIP":
            "ab_windowed_sp,ab_overlap,serving_throughput,"
-           "multi_step_decode"}
+           "multi_step_decode,paged_serving"}
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env,
                check=False)
 """),

@@ -60,6 +60,7 @@ class TestCleanEntrypointsStayClean:
 
     @pytest.mark.parametrize("target", [
         "generate", "engine_step", "engine_multi_step",
+        "engine_paged_step",
         "engine_prefill", "engine_recovery",
         # ISSUE 6: telemetry armed must lint clean AND trace to the
         # bare engine_step's exact program (asserted in the builder)
@@ -104,6 +105,37 @@ class TestCleanEntrypointsStayClean:
         scans = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
                     if eqn.primitive.name == "scan")
         assert scans >= 1
+
+    def test_engine_paged_step_table_operand_contract(self):
+        """ISSUE 7's structural pins: the paged decode dispatch donates
+        its KV pool (+ logits) with the markers surviving lowering, its
+        page TABLE rides as a non-donated int32 operand (the builder
+        raises on violation — re-asserted here over the flat record),
+        the catalog carries 17 entries, and the traced program is
+        host-sync clean."""
+        import jax.numpy as jnp
+
+        from akka_allreduce_tpu.analysis.entrypoints import (
+            ENTRYPOINTS,
+            build_engine_paged_step,
+        )
+        assert len(ENTRYPOINTS) == 17
+        ctx = build_engine_paged_step()
+        declared = sum(ctx.donated)
+        assert declared >= 3  # k, v, logits at minimum
+        markers = (ctx.stablehlo.count("jax.buffer_donor")
+                   + ctx.stablehlo.count("tf.aliasing_output"))
+        assert markers >= declared, (declared, markers)
+        tables = [(aval, don)
+                  for aval, don in zip(ctx.in_avals, ctx.donated)
+                  if aval.dtype == jnp.int32 and aval.ndim == 2]
+        assert len(tables) == 1, tables
+        assert tables[0][0].shape[0] == 2  # (lanes, pages_per_seq)
+        assert not tables[0][1], "page table must not be donated"
+        gating = [f for f in run_passes(ctx)
+                  if f.severity in ("error", "warning")]
+        assert not gating, [f"[{f.pass_name}] {f.message}"
+                            for f in gating]
 
     def test_engine_recovery_rebuild_is_warmup_shaped(self):
         """ISSUE 5 satellite: the watchdog-recovery contract, pinned

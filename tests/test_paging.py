@@ -1,0 +1,212 @@
+"""PagePool allocator correctness: unit semantics + seeded fuzz.
+
+The pool (serving/paging.py) is the host half of the paged KV plane —
+pure Python, no jax — so its invariants are cheap to state and fuzz:
+
+* refcount conservation: every page's refcount equals its live holder
+  count (tracked independently by the harness), scratch pages pinned;
+* no aliasing post-split: after a COW split, no page is writable by two
+  live requests (a request's WRITE page — the one holding its decode
+  frontier — is exclusively held once the split protocol runs);
+* full-drain recovery: releasing every request returns the free list
+  to exactly the pool's capacity, with the prefix registry and spare
+  piles empty;
+* spare accounting: a shared tail page with refcount r carries exactly
+  r - 1 pre-paid split targets (the OOM-proofing invariant), trimmed
+  when holders leave without writing.
+
+The fuzz drives random interleavings of admit / split / release with
+shared and unique prompts against ``check_invariants()`` (the pool's
+own oracle) plus the harness's independent holder ledger.
+"""
+
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.serving.paging import AdmitPlan, PagePool, pages_for
+
+
+class TestPagesFor:
+    def test_rounding(self):
+        assert pages_for(1, 4) == 1
+        assert pages_for(4, 4) == 1
+        assert pages_for(5, 4) == 2
+        assert pages_for(16, 4) == 4
+
+
+class TestPoolBasics:
+    def test_alloc_release_roundtrip(self):
+        pool = PagePool(8, 4)
+        pages, writes = pool.admit((1, 2, 3, 4, 5), 3)  # 5+3 -> 2 pages
+        assert len(pages) == 2
+        assert writes == [True, True]  # 1 full page + 1 tail
+        assert pool.pages_in_use == 2
+        pool.release_all(pages)
+        assert pool.free_pages == pool.capacity == 8
+        pool.check_invariants()
+
+    def test_exhaustion_raises_and_gate_predicts(self):
+        pool = PagePool(2, 4)
+        assert pool.can_admit((1, 2), 2)
+        pool.admit((1, 2), 2)  # 1 page
+        assert pool.can_admit((3, 4), 2)
+        pool.admit((3, 4), 2)
+        assert not pool.can_admit((5, 6), 2)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            pool.admit((5, 6), 2)
+
+    def test_plan_is_pure(self):
+        pool = PagePool(16, 4)
+        before = pool.pages_in_use
+        plan = pool.plan((1, 2, 3, 4, 5, 6), 6)
+        assert isinstance(plan, AdmitPlan)
+        assert plan.total_pages == pages_for(12, 4) == 3
+        assert pool.pages_in_use == before
+        assert pool.prefix_lookups == 0  # gate polls never count
+
+    def test_scratch_pages_pinned(self):
+        pool = PagePool(8, 4, scratch_pages=1)
+        assert pool.capacity == 7
+        pages, _ = pool.admit((1, 2, 3, 4), 4)
+        assert 0 not in pages  # scratch never handed out
+        with pytest.raises(RuntimeError, match="scratch"):
+            pool.release(0)
+        pool.check_invariants()
+
+
+class TestPrefixSharing:
+    def test_full_pages_shared_by_prefix(self):
+        pool = PagePool(32, 4)
+        sys_prompt = tuple(range(8))  # 2 full pages
+        a, wa = pool.admit(sys_prompt + (20, 21), 4)
+        b, wb = pool.admit(sys_prompt + (30, 31), 4)
+        assert a[0] == b[0] and a[1] == b[1]  # system pages shared
+        assert wb[:2] == [False, False]
+        assert a[2] != b[2]  # divergent tails are private
+        assert pool.refcount(a[0]) == 2
+        assert pool.prefix_hit_rate == 0.5  # 2 of 4 full-page lookups
+        pool.release_all(a)
+        pool.release_all(b)
+        assert pool.free_pages == pool.capacity
+
+    def test_registry_dies_with_last_holder(self):
+        pool = PagePool(16, 4)
+        a, _ = pool.admit((1, 2, 3, 4), 4)
+        pool.release_all(a)
+        b, wb = pool.admit((1, 2, 3, 4), 4)
+        assert wb[0] is True  # freed page unregistered: fresh alloc
+        pool.release_all(b)
+
+    def test_identical_prompts_share_tail_with_spare(self):
+        pool = PagePool(32, 4)
+        p = (1, 2, 3, 4, 5, 6)  # 1 full + tail of 2
+        a, _ = pool.admit(p, 4)
+        used_before = pool.pages_in_use
+        b, wb = pool.admit(p, 4)
+        # full + tail shared; the sharer's bill still covers the spare
+        assert a[0] == b[0] and a[1] == b[1]
+        assert wb == [False, False]
+        assert pool.refcount(a[1]) == 2
+        pool.check_invariants()  # spare pile == refcount - 1
+        # COW: first writer splits onto the pre-paid spare
+        new = pool.split_for_write(b[1])
+        assert new is not None and new != a[1]
+        assert pool.refcount(a[1]) == 1
+        assert pool.refcount(new) == 1
+        # last holder writes in place after unregistering
+        assert pool.split_for_write(a[1]) is None
+        assert not pool.is_registered(a[1])
+        pool.check_invariants()
+        pool.release_all(a)
+        pool.release_all([b[0], new] + b[2:])
+        assert pool.free_pages == pool.capacity
+
+    def test_abandoned_spare_returns_on_release(self):
+        pool = PagePool(16, 4)
+        p = (1, 2, 3, 4, 5)
+        a, _ = pool.admit(p, 3)       # 2 pages: 1 full + 1 tail
+        b, _ = pool.admit(p, 3)       # shares both; allocates 1 spare
+        assert b == a
+        assert pool.pages_in_use == 3
+        # b evicted before its first write: its tail ref AND the spare
+        # it paid for both come back; a's pages stay
+        pool.release_all(b)
+        assert pool.pages_in_use == 2
+        pool.check_invariants()
+        pool.release_all(a)
+        assert pool.free_pages == pool.capacity
+
+
+class TestAllocatorFuzz:
+    """Seeded alloc/free/COW-split fuzz (the ISSUE 7 satellite): random
+    interleavings against the pool's own oracle plus an independent
+    holder ledger."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_invariants(self, seed):
+        rng = np.random.default_rng(seed)
+        pool = PagePool(64, 4, scratch_pages=1)
+        # a few recurring prompts (sharing) + unique ones
+        shared_prompts = [
+            tuple(int(x) for x in rng.integers(0, 50, size=n))
+            for n in (8, 10, 13)]
+        live = []  # (pages list, write_frontier_page_index)
+
+        def holder_counts():
+            counts = {}
+            for pages, _f in live:
+                for p in set(pages):
+                    counts[p] = counts.get(p, 0) + 1
+                # duplicate ids inside ONE request would be aliasing
+                assert len(set(pages)) == len(pages)
+            return counts
+
+        for _op in range(400):
+            roll = rng.random()
+            if roll < 0.45:
+                if rng.random() < 0.5:
+                    prompt = shared_prompts[
+                        int(rng.integers(len(shared_prompts)))]
+                else:
+                    prompt = tuple(int(x) for x in rng.integers(
+                        0, 50, size=int(rng.integers(3, 14))))
+                budget = int(rng.integers(1, 9))
+                if pool.can_admit(prompt, budget):
+                    pages, _w = pool.admit(prompt, budget)
+                    live.append([pages, len(prompt) // 4])
+            elif roll < 0.75 and live:
+                # a decode write at the holder's frontier page: run the
+                # split protocol; afterwards the written page must be
+                # exclusively held (no aliasing post-split)
+                idx = int(rng.integers(len(live)))
+                pages, frontier = live[idx]
+                if frontier < len(pages):
+                    page = pages[frontier]
+                    new = pool.split_for_write(page)
+                    if new is not None:
+                        pages[frontier] = new
+                    written = pages[frontier]
+                    assert pool.refcount(written) == 1, \
+                        f"page {written} aliased at write time"
+                    assert not pool.is_registered(written)
+                    live[idx][1] += 1
+            elif live:
+                idx = int(rng.integers(len(live)))
+                pages, _f = live.pop(idx)
+                pool.release_all(pages)
+            pool.check_invariants()
+            # refcount conservation vs the independent ledger (spares
+            # and scratch are pool-internal holders)
+            counts = holder_counts()
+            spares = {s for pile in pool._spares.values() for s in pile}
+            for p in range(1, pool.num_pages):
+                want = counts.get(p, 0) + (1 if p in spares else 0)
+                assert pool.refcount(p) == want, (
+                    f"page {p}: refcount {pool.refcount(p)} != "
+                    f"{want} live holders")
+        # full drain: everything comes back
+        for pages, _f in live:
+            pool.release_all(pages)
+        assert pool.free_pages == pool.capacity
+        assert not pool._by_key and not pool._key_of and not pool._spares
+        pool.check_invariants()
