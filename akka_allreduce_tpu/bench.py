@@ -884,6 +884,163 @@ def measure_paged_serving(d_model: int = 256, n_layers: int = 2,
     return rows
 
 
+def measure_replicated_serving(d_model: int = 256, n_layers: int = 2,
+                               d_ff: int = 1024, vocab: int = 1024,
+                               n_requests: int = 24,
+                               prompt_len: int = 16, steps: int = 32,
+                               total_slots: int = 4,
+                               n_replicas: int = 2,
+                               reps: int = 3, seed: int = 0) -> list:
+    """One engine vs N router-fronted replicas at EQUAL TOTAL SLOTS —
+    the ISSUE 8 scale-out A/B — plus the hedged-dispatch tax.
+
+    Three arms, same model, same requests, same greedy tokens:
+
+    * SINGLE — one engine with ``total_slots`` decode slots driven by
+      serve_loop (the PR 2 baseline);
+    * FLEET — ``n_replicas`` engines with ``total_slots / n_replicas``
+      slots each behind the router (serving/router.py, th=1). The
+      gated ``replicated_serving_speedup`` row is fleet / single — a
+      REGRESSION gate on the structure's cost, not a parallelism
+      claim: one host loop steps the replicas sequentially, so the
+      fleet pays N dispatches per round at 1/N batch width plus the
+      routing itself (on separate hosts the dispatches overlap; here
+      they cannot). A drop in this ratio means the router/ledger path
+      got more expensive;
+    * HEDGED — the same fleet at th=2: every request decodes on two
+      replicas, first completion wins, losers are cancelled into the
+      wasted-token account. Its ratio row is informational — the tail-
+      latency insurance premium, paid in throughput, with the wasted
+      share in the note.
+
+    Timed runs follow one warm run per program shape (compile
+    excluded); best-of-``reps``."""
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.serving import (EngineConfig, FleetMetrics,
+                                            ReplicaRouter, Request,
+                                            RequestScheduler,
+                                            RouterConfig,
+                                            SchedulerConfig,
+                                            ServingEngine, serve_loop)
+
+    plat = jax.devices()[0].platform
+    if total_slots % n_replicas:
+        raise ValueError(f"total_slots {total_slots} must divide by "
+                         f"n_replicas {n_replicas} (equal-slot A/B)")
+    per_rep = total_slots // n_replicas
+    mcfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model,
+        n_heads=max(1, d_model // 64), n_layers=n_layers, d_ff=d_ff,
+        max_seq=prompt_len + steps)
+    params = init_transformer(jax.random.key(seed), mcfg)
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, vocab, size=(n_requests, prompt_len),
+                           dtype=np.int32)
+    total_tokens = n_requests * steps
+
+    def submit_all(sink, sched):
+        for rid, p in enumerate(prompts):
+            req = Request(rid=rid, prompt=tuple(int(x) for x in p),
+                          max_new_tokens=steps, submitted_at=0.0)
+            if sink is not None:
+                sink.on_submit(rid)
+            sched.submit(req)
+
+    def build_single():
+        engine = ServingEngine(params, mcfg,
+                               EngineConfig(num_slots=total_slots))
+        sched = RequestScheduler(SchedulerConfig(),
+                                 num_slots=total_slots)
+        submit_all(None, sched)
+        return engine, sched
+
+    def run_single(pair):
+        serve_loop(*pair,
+                   max_dispatches=total_tokens + n_requests + 16)
+
+    def build_fleet(th):
+        engines = [ServingEngine(params, mcfg,
+                                 EngineConfig(num_slots=per_rep))
+                   for _ in range(n_replicas)]
+        sched = RequestScheduler(SchedulerConfig(),
+                                 num_slots=total_slots)
+        fleet = FleetMetrics(n_replicas)
+        router = ReplicaRouter(engines, sched, RouterConfig(th=th),
+                               fleet=fleet)
+        submit_all(fleet, sched)
+        return router, fleet
+
+    def run_fleet(pair):
+        pair[0].run(max_rounds=(total_tokens + n_requests + 16)
+                    * max(1, pair[0].cfg.th))
+
+    rows = []
+    _log(f"replicated_serving: single engine ({total_slots} slots)")
+    run_single(build_single())  # compile + warm (slots=total_slots)
+    t_single = min(_timed(lambda p=build_single(): run_single(p))
+                   for _ in range(reps))
+    single_tok_s = total_tokens / t_single
+    rows.append({"metric": f"replicated_serving_single_tok_s_{plat}",
+                 "value": round(single_tok_s, 1), "unit": "tok/s",
+                 "note": f"one engine, {total_slots} slots, "
+                         f"{n_requests} requests x {steps} tokens, "
+                         f"d_model={d_model} L={n_layers}"})
+
+    _log(f"replicated_serving: fleet ({n_replicas} x {per_rep} slots, "
+         f"th=1)")
+    run_fleet(build_fleet(1))  # warm the per_rep-slot programs
+    t_fleet = min(_timed(lambda p=build_fleet(1): run_fleet(p))
+                  for _ in range(reps))
+    fleet_tok_s = total_tokens / t_fleet
+    rows.append({"metric": f"replicated_serving_fleet_tok_s_{plat}",
+                 "value": round(fleet_tok_s, 1), "unit": "tok/s",
+                 "note": f"{n_replicas} replicas x {per_rep} slots "
+                         f"behind the router (th=1), same requests"})
+    rows.append({"metric": "replicated_serving_speedup",
+                 "value": round(fleet_tok_s / single_tok_s, 3),
+                 "unit": "x",
+                 "note": f"fleet@{n_replicas}x{per_rep} vs single@"
+                         f"{total_slots} slots ({plat}), one host "
+                         f"loop: the fleet pays {n_replicas}x "
+                         f"dispatches at 1/{n_replicas} batch width "
+                         f"plus routing (sequential in-process; "
+                         f"separate hosts would overlap them) — a "
+                         f"regression gate on the structure's cost, "
+                         f"not a parallelism claim"})
+
+    if n_replicas >= 2:
+        _log("replicated_serving: hedged (th=2)")
+        run_fleet(build_fleet(2))  # warm
+        t_h, fleet_m = float("inf"), None
+        for _ in range(reps):
+            pair = build_fleet(2)
+            t = _timed(lambda: run_fleet(pair))
+            if t < t_h:
+                # keep the metrics of the BEST-timed rep so the note
+                # (losers cancelled, hedge waste) describes the same
+                # run the throughput value came from
+                t_h, fleet_m = t, pair[1]
+        hedged_tok_s = total_tokens / t_h
+        s = fleet_m.summary()
+        rows.append({
+            "metric": f"replicated_serving_hedged_tok_s_{plat}",
+            "value": round(hedged_tok_s, 1), "unit": "tok/s",
+            "note": f"same fleet at th=2 (every request decodes on 2 "
+                    f"replicas, first completion wins): "
+                    f"{s['hedge']['cancelled']} losers cancelled, "
+                    f"hedge waste {s['hedge']['wasted_tokens']} of "
+                    f"{s['tokens']['decode']} delivered tokens"})
+        rows.append({
+            "metric": "replicated_serving_hedge_ratio",
+            "value": round(hedged_tok_s / single_tok_s, 3),
+            "unit": "x",
+            "note": f"hedged (th=2) vs single ({plat}) — the tail-"
+                    f"latency insurance premium, paid in throughput; "
+                    f"wasted_token_rate {s['wasted_token_rate']}"})
+    return rows
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()

@@ -1969,7 +1969,9 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="with --paged: pool capacity in pages; 0 = "
                         "auto (slots * ceil(max_seq/page_size) — the "
                         "slot engine's equivalent HBM, for honest "
-                        "A/Bs)")
+                        "A/Bs). PER REPLICA with --replicas, like "
+                        "--slots: each replica owns its own pool "
+                        "(total cache HBM = replicas x this)")
     p.add_argument("--paged-attention", choices=("gather", "pallas"),
                    default="gather",
                    help="with --paged: the pool read path — gather "
@@ -1977,6 +1979,31 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "Pallas paged-attention kernel "
                         "(ops/pallas_kernels/attention.py; TPU "
                         "throughput, allclose-not-bitwise)")
+    # -- replicated serving (ISSUE 8)
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="run N engine replicas behind one router "
+                        "(serving/router.py): --slots becomes the "
+                        "PER-REPLICA slot count, requests route to the "
+                        "least-loaded healthy replica, a failed "
+                        "replica's in-flight requests fail over "
+                        "through the retry budget, and a preempted "
+                        "replica's drain snapshots migrate to "
+                        "survivors. 1 (default) = the single-engine "
+                        "serve loop")
+    p.add_argument("--th", type=int, default=1, metavar="K",
+                   help="with --replicas: the hedge width — dispatch "
+                        "each request to K of the N replicas and take "
+                        "the FIRST completion (the reference's "
+                        "threshold dial pointed at replicas); losers "
+                        "are cancelled and charged to wasted tokens. "
+                        "1 = single dispatch (throughput mode)")
+    p.add_argument("--max-lag", type=int, default=2, metavar="L",
+                   help="with --replicas: router rounds a replica may "
+                        "fall behind its last completed dispatch "
+                        "before it is DEGRADED — new admissions shed "
+                        "to healthy replicas until it completes a "
+                        "probe dispatch again (the reference's maxLag "
+                        "staleness bound at the fleet)")
     # -- preemption notice (ISSUE 7 satellite / PR 5 loose end)
     p.add_argument("--preempt-poll", default=None, metavar="URL",
                    help="poll this GCE-style metadata URL for a "
@@ -2602,6 +2629,209 @@ def _serve_chaos_selfcheck(args: argparse.Namespace) -> int:
     return 0 if not failures else 1
 
 
+def _serve_replicated_selfcheck(args: argparse.Namespace) -> int:
+    """`serve --selfcheck --replicas N`: the ISSUE 8 acceptance run.
+    N slot-engine replicas behind the router, one seeded fault script
+    aimed INTO the fleet — a hang, a dispatch exception and a
+    NaN-poisoned lane on replica 0, a preemption of replica 1 (its
+    in-flight requests MIGRATE to survivors). Asserted, not hoped:
+
+    * PARITY — every request's greedy tokens from the faulted fleet
+      are bitwise identical to a fault-free SINGLE-ENGINE run;
+    * LEDGER RECONCILIATION — injected == survived, failed attempts ==
+      retries + dead letters (+ hedge absorbs), exactly one watchdog
+      trip, exactly one retired replica, nothing parked on the router;
+    * SURVIVOR no-recompile — a second, HEDGED (th=2) fault-free fleet
+      run over the same shapes compiles ZERO programs, with
+      first-completion-wins accounting balancing exactly;
+    * scrape == summary with ``replica`` labels AND at the fleet level
+      (the merged ``serve_fleet_*`` quantiles are the same
+      ``Histogram.merge`` the summary renders).
+    """
+    import jax
+    import numpy as np
+
+    from akka_allreduce_tpu.analysis.recompile import (RecompileError,
+                                                       no_recompiles)
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.runtime.faults import FaultPlan, FaultPoint
+    from akka_allreduce_tpu.serving import (EngineConfig, FleetMetrics,
+                                            ReplicaRouter, Request,
+                                            RequestScheduler, RetryPolicy,
+                                            RouterConfig, SchedulerConfig,
+                                            ServingEngine, serve_loop)
+    from akka_allreduce_tpu.telemetry import parse_prometheus_text
+
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=48)
+    params = init_transformer(jax.random.key(0), cfg)
+    eos = 5
+    slots = 2  # per replica; the baseline engine matches, so every
+    n_rep = args.replicas   # jitted program is shared fleet-wide
+
+    def make_requests():
+        r = np.random.default_rng(17)
+        return [Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in r.integers(
+                0, cfg.vocab_size, size=int(r.integers(2, 6)))),
+            max_new_tokens=8,
+            eos_token=eos if rid % 2 else None,
+            submitted_at=0.0) for rid in range(10)]
+
+    # fault-free single-engine truth + program warmup (warm before
+    # you arm — OPERATIONS.md)
+    base_engine = ServingEngine(params, cfg,
+                                EngineConfig(num_slots=slots))
+    base_sched = RequestScheduler(SchedulerConfig(), num_slots=slots)
+    for r in make_requests():
+        base_sched.submit(r)
+    baseline = serve_loop(base_engine, base_sched, max_dispatches=1000)
+
+    def build_fleet(th, watchdog):
+        engines = [ServingEngine(
+            params, cfg, EngineConfig(num_slots=slots,
+                                      watchdog_timeout_s=watchdog))
+            for _ in range(n_rep)]
+        fleet = FleetMetrics(n_rep)
+        sched = RequestScheduler(
+            SchedulerConfig(policy=args.policy,
+                            retry=RetryPolicy(max_attempts=4,
+                                              base_delay=0.0)),
+            num_slots=n_rep * slots)
+        router = ReplicaRouter(engines, sched,
+                               RouterConfig(th=th,
+                                            max_lag=args.max_lag),
+                               fleet=fleet)
+        return router, sched, fleet
+
+    def run_fleet(router, sched, fleet, plan=None):
+        for r in make_requests():
+            fleet.on_submit(r.rid)
+            sched.submit(r)
+        ctx = (plan.armed() if plan is not None
+               else contextlib.nullcontext())
+        with ctx:
+            return router.run(max_rounds=4000)
+
+    # the fleet fault script: three failure domains on replica 0, then
+    # replica 1 preempted mid-load (migration, not loss)
+    plan = FaultPlan([
+        FaultPoint("replica0.dispatch", "hang", hit=2, duration_s=0.6),
+        FaultPoint("replica0.dispatch", "raise", hit=4),
+        FaultPoint("replica0.logits", "nan", hit=6, slot=1),
+        FaultPoint("replica1.loop", "preempt", hit=8),
+    ])
+    router, sched, fleet = build_fleet(th=args.th, watchdog=0.15)
+    results = run_fleet(router, sched, fleet, plan=plan)
+    fleet.on_fault_injected(len(plan.fired))
+
+    failures = []
+    kinds = {k for _site, k, _hit in plan.fired}
+    if not {"hang", "raise", "nan", "preempt"} <= kinds:
+        failures.append(f"not every fault fired: {sorted(plan.fired)}")
+    for rid, (toks, reason) in baseline.items():
+        got = results.get(rid)
+        if got is None:
+            failures.append(f"rid={rid} missing from fleet run")
+        elif list(got[0]) != list(toks) or got[1] != reason:
+            failures.append(
+                f"rid={rid}: fleet ({got[1]}) {list(got[0])} != "
+                f"single-engine ({reason}) {list(toks)}")
+    s = fleet.summary()
+    if s["faults"]["fault_injected"] != s["faults"]["fault_survived"]:
+        failures.append(
+            f"fault pair off: injected {s['faults']['fault_injected']} "
+            f"!= survived {s['faults']['fault_survived']}")
+    if s["faults"]["watchdog_trips_total"] != 1:
+        failures.append(f"watchdog_trips_total="
+                        f"{s['faults']['watchdog_trips_total']}, want 1")
+    if (s["faults"]["retries_total"] + s["faults"]["dead_letter_total"]
+            + s["hedge"]["absorbed_failures"]
+            != s["requests"]["failed_attempts"]):
+        failures.append(
+            f"retry ledger off: {s['faults']['retries_total']} retries "
+            f"+ {s['faults']['dead_letter_total']} dead letters + "
+            f"{s['hedge']['absorbed_failures']} hedge-absorbed != "
+            f"{s['requests']['failed_attempts']} failed attempts")
+    if s["lag"]["retired_total"] != 1:
+        failures.append(f"retired_total={s['lag']['retired_total']}, "
+                        f"want 1 (the preempted replica)")
+    if router.drained:
+        failures.append(f"{len(router.drained)} snapshots parked on "
+                        f"the router — migration must re-place them")
+
+    # scrape == summary: per-replica labels and the merged fleet series
+    prom = parse_prometheus_text(fleet.registry.to_prometheus_text())
+    for i, m in enumerate(fleet.replicas):
+        got = prom.get(("serve_completed_total",
+                        (("replica", str(i)),)))
+        want = m.summary()["requests"]["completed"]
+        if got != want:
+            failures.append(f"prometheus serve_completed_total"
+                            f"{{replica={i}}} {got} != summary {want}")
+    if prom.get(("serve_fleet_completed_total", ())) \
+            != s["requests"]["completed"]:
+        failures.append(
+            f"prometheus serve_fleet_completed_total "
+            f"{prom.get(('serve_fleet_completed_total', ()))} != "
+            f"summary {s['requests']['completed']}")
+    for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+        got = prom.get(("serve_fleet_ttft_seconds", (("quantile", q),)))
+        want = s["ttft_ms"][key]
+        if got is None or round(got * 1e3, 3) != want:
+            failures.append(f"fleet ttft quantile {q} {got} (s) != "
+                            f"summary {key} {want} (ms)")
+
+    # survivors compile nothing — and hedged dispatch balances: a
+    # SECOND fleet run (fresh engines, th=2, fault-free) over the same
+    # shapes under the zero-compile guard
+    hedge_th = min(2, n_rep)
+    router2, sched2, fleet2 = build_fleet(th=hedge_th, watchdog=0.15)
+    try:
+        with no_recompiles("replicated churn (warmed shapes, hedged)"):
+            results2 = run_fleet(router2, sched2, fleet2)
+    except RecompileError as exc:
+        failures.append(str(exc))
+        results2 = {}
+    for rid, out in results2.items():
+        if list(out[0]) != list(baseline[rid][0]):
+            failures.append(f"rid={rid}: hedged churn run diverged")
+    s2 = fleet2.summary()
+    if results2 and hedge_th > 1:
+        if s2["hedge"]["dispatched"] < 1:
+            failures.append("no hedge copies dispatched at th=2")
+        if (s2["hedge"]["cancelled"] + s2["hedge"]["duplicates"]
+                != s2["hedge"]["dispatched"]):
+            failures.append(
+                f"hedge accounting off: {s2['hedge']['cancelled']} "
+                f"cancelled + {s2['hedge']['duplicates']} duplicates "
+                f"!= {s2['hedge']['dispatched']} dispatched")
+
+    print(json.dumps({
+        "selfcheck": "ok" if not failures else "FAIL",
+        "replicas": n_rep,
+        "th": args.th,
+        "max_lag": args.max_lag,
+        "policy": args.policy,
+        "faults_fired": [list(f) for f in plan.fired],
+        "watchdog_trips": s["faults"]["watchdog_trips_total"],
+        "retries": s["faults"]["retries_total"],
+        "retired_replicas": s["lag"]["retired_total"],
+        "shed_admissions": s["lag"]["shed_admissions_total"],
+        "hedged_churn": {
+            "th": hedge_th,
+            "dispatched": s2["hedge"]["dispatched"],
+            "cancelled": s2["hedge"]["cancelled"],
+            "wasted_tokens": s2["hedge"]["wasted_tokens"],
+        },
+        "churn_recompiles": 0 if results2 else None,
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     _apply_backend_flags(args)
     # validated BEFORE the selfcheck dispatch: a typo'd S must exit 2,
@@ -2632,7 +2862,40 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "recovery is covered by tests/test_paged_engine.py)",
               file=sys.stderr)
         return 2
+    if args.replicas < 1:
+        print(f"error: --replicas must be >= 1, got {args.replicas}",
+              file=sys.stderr)
+        return 2
+    if not 1 <= args.th <= args.replicas:
+        print(f"error: --th must be in [1, --replicas={args.replicas}] "
+              f"(a hedge wider than the fleet is unsatisfiable), got "
+              f"{args.th}", file=sys.stderr)
+        return 2
+    if args.max_lag < 1:
+        print(f"error: --max-lag must be >= 1, got {args.max_lag}",
+              file=sys.stderr)
+        return 2
+    if args.replicas > 1 and args.th_step != 0.0:
+        print("error: --th-step gates the single-engine decode batch "
+              "(serve_loop); the router steps every occupied replica "
+              "each round — its threshold dial is --th (hedge width). "
+              "Drop --th-step or --replicas", file=sys.stderr)
+        return 2
+    if args.chaos is not None and args.replicas > 1:
+        print("error: --chaos is the single-engine fault matrix; the "
+              "replicated chaos rides `--selfcheck --replicas N` "
+              "(its fault script targets replica sites)",
+              file=sys.stderr)
+        return 2
+    if args.selfcheck and args.paged and args.replicas > 1:
+        print("error: the replicated selfcheck runs slot-engine "
+              "replicas; paged fleet recovery is covered by "
+              "tests/test_replica_router.py + test_paged_engine.py",
+              file=sys.stderr)
+        return 2
     if args.selfcheck:
+        if args.replicas > 1:
+            return _serve_replicated_selfcheck(args)
         if args.chaos is not None:
             return _serve_chaos_selfcheck(args)
         if args.paged:
@@ -2775,7 +3038,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             # Perfetto export wants the event stream even when no JSONL
             # was asked for — same tracer, second renderer
             tracer = Tracer()
-        metrics = ServingMetrics(tracer=tracer)
+        if args.replicas > 1:
+            # the replicated plane: one shared registry, per-replica
+            # labeled series + fleet aggregation (serving/metrics.py
+            # FleetMetrics) — every surface below (snapshot file, HTTP,
+            # host sampler) reads the same registry either way
+            from akka_allreduce_tpu.serving import FleetMetrics
+            metrics = FleetMetrics(args.replicas, tracer=tracer)
+        else:
+            metrics = ServingMetrics(tracer=tracer)
         if args.metrics_port is not None:
             server = stack.enter_context(
                 metrics.registry.serve_http(port=args.metrics_port))
@@ -2785,25 +3056,25 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             stack.enter_context(metrics.registry.start_snapshotter(
                 args.metrics_file, args.metrics_interval))
         try:
-            if args.paged:
-                from akka_allreduce_tpu.serving import (
-                    PagedEngineConfig, PagedServingEngine)
-                engine = PagedServingEngine(
-                    params, mcfg,
-                    PagedEngineConfig(
-                        num_slots=args.slots, prefill_buckets=buckets,
-                        kv_dtype="int8" if args.kv_cache == "int8"
-                        else None,
-                        decode_steps=args.decode_steps,
-                        watchdog_timeout_s=args.watchdog_timeout
-                        or None,
-                        page_size=args.page_size,
-                        num_pages=args.num_pages,
-                        attention_impl=args.paged_attention),
-                    tracer=tracer)
-                metrics.attach_paging(engine.paging_summary)
-            else:
-                engine = ServingEngine(
+            def build_engine():
+                if args.paged:
+                    from akka_allreduce_tpu.serving import (
+                        PagedEngineConfig, PagedServingEngine)
+                    return PagedServingEngine(
+                        params, mcfg,
+                        PagedEngineConfig(
+                            num_slots=args.slots,
+                            prefill_buckets=buckets,
+                            kv_dtype="int8" if args.kv_cache == "int8"
+                            else None,
+                            decode_steps=args.decode_steps,
+                            watchdog_timeout_s=args.watchdog_timeout
+                            or None,
+                            page_size=args.page_size,
+                            num_pages=args.num_pages,
+                            attention_impl=args.paged_attention),
+                        tracer=tracer)
+                return ServingEngine(
                     params, mcfg,
                     EngineConfig(
                         num_slots=args.slots, prefill_buckets=buckets,
@@ -2813,6 +3084,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                         watchdog_timeout_s=args.watchdog_timeout
                         or None),
                     tracer=tracer)
+
+            engines = [build_engine() for _ in range(args.replicas)]
+            engine = engines[0]
+            if args.paged:
+                if args.replicas > 1:
+                    # per-replica page-pool series, replica-labeled
+                    for i, eng in enumerate(engines):
+                        metrics.replicas[i].attach_paging(
+                            eng.paging_summary)
+                else:
+                    metrics.attach_paging(engine.paging_summary)
             sched = RequestScheduler(
                 SchedulerConfig(max_queue_depth=args.queue_depth,
                                 policy=args.policy,
@@ -2823,13 +3105,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                                     jitter=args.retry_jitter),
                                 tpot_estimate=args.tpot_estimate,
                                 seed=args.seed),
-                num_slots=args.slots,
+                num_slots=args.replicas * args.slots,
                 # open-loop overload: a request ARRIVING to a full
                 # queue is shed at the edge — the rejection count is
                 # the result, not an error (the scheduler applies the
                 # depth bound at arrival time, so future-dated submits
                 # below never reject here)
                 on_reject=metrics.on_reject)
+            router = None
+            if args.replicas > 1:
+                from akka_allreduce_tpu.serving import (ReplicaRouter,
+                                                        RouterConfig)
+                router = ReplicaRouter(
+                    engines, sched,
+                    RouterConfig(th=args.th, max_lag=args.max_lag),
+                    fleet=metrics, tracer=tracer)
         except ValueError as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 2
@@ -2859,6 +3149,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # path as SIGTERM — with --drain-dir, a poll-detected
         # preemption persists its snapshots across the process
         # boundary like any other drain
+        # a fleet drains THROUGH the router (every replica's snapshots
+        # collect on router.drained); a single engine drains itself
+        drain_target = router if router is not None else engine
         watcher = None
         if args.preempt_poll:
             from akka_allreduce_tpu.runtime.preempt import (
@@ -2866,27 +3159,31 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             url = (GCE_PREEMPTED_URL if args.preempt_poll == "gce"
                    else args.preempt_poll)
             watcher = stack.enter_context(PreemptionWatcher(
-                engine.request_drain, url=url,
+                drain_target.request_drain, url=url,
                 interval_s=args.preempt_interval))
         prev_term = signal.signal(
-            signal.SIGTERM, lambda *_: engine.request_drain())
+            signal.SIGTERM, lambda *_: drain_target.request_drain())
         from akka_allreduce_tpu.analysis.recompile import CompileLog
         try:
             with metrics.host_sampler() as sampler, \
                     CompileLog() as compiles:
-                results = serve_loop(engine, sched, metrics=metrics,
-                                     resume=resumed)
+                if router is not None:
+                    results = router.run(resume=resumed)
+                else:
+                    results = serve_loop(engine, sched, metrics=metrics,
+                                         resume=resumed)
         finally:
             signal.signal(signal.SIGTERM, prev_term)
+        drained = drain_target.drained
         drain_path = None
         if args.drain_dir:
             from akka_allreduce_tpu.serving import (clear_drained,
                                                     persist_drained)
-            if engine.drained:
+            if drained:
                 drain_path = persist_drained(args.drain_dir,
-                                             engine.drained,
+                                             drained,
                                              metrics=metrics)
-                print(f"persisted {len(engine.drained)} drained "
+                print(f"persisted {len(drained)} drained "
                       f"request(s) -> {drain_path} (restore with "
                       f"--drain-dir on the next run)", file=sys.stderr)
             else:
@@ -2897,7 +3194,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             n = tracer.write_chrome_trace(args.perfetto_file)
             print(f"perfetto trace ({n} events) -> "
                   f"{args.perfetto_file}", file=sys.stderr)
-    report = {
+    # everything both report shapes share — one builder, so a field
+    # added here lands in the single-engine AND fleet reports
+    common = {
         "config": {"slots": args.slots, "requests": args.requests,
                    "load": args.load, "policy": args.policy,
                    "th_step": args.th_step, "kv_cache": args.kv_cache,
@@ -2911,10 +3210,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                    **({"page_size": args.page_size,
                        "num_pages": engine.pool.capacity,
                        "paged_attention": args.paged_attention}
-                      if args.paged else {})},
-        # admission polls where the head request waited on pool MEMORY
-        # with a lane free — the page-pressure signal (always 0 for the
-        # slot engine: a slot is its own reservation)
+                      if args.paged else {}),
+                   **({"replicas": args.replicas, "th": args.th,
+                       "max_lag": args.max_lag}
+                      if router is not None else {})},
         "blocked_on_memory": sched.blocked_on_memory,
         **({"preempt_notice": watcher.fired,
             "preempt_polls": watcher.polls} if watcher else {}),
@@ -2922,31 +3221,57 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             reason: sum(1 for toks, r in results.values()
                         if r == reason)
             for reason in {r for _, r in results.values()}},
-        # in-flight snapshots left by a SIGTERM drain (tokens already
-        # generated ride along; a fresh engine restores them with
-        # bitwise parity) + the terminal dead-letter triage list
-        "drained": len(engine.drained),
+        "drained": len(drained),
         "dead_letter": [
             {"rid": req.rid, "attempts": req.attempts, "reason": rsn}
             for req, rsn in sched.dead_letter],
+        # triage records the bounded ring rolled off (the list above
+        # is a WINDOW once this is nonzero — SchedulerConfig
+        # .dead_letter_cap)
+        "dead_letter_dropped": sched.dead_letter_dropped,
+        "compiled_programs": compiles.count,
+        "host": sampler.summary(),
+        "resumed": len(resumed),
+        "drain_persisted": (len(drained) if drain_path else 0),
+    }
+    if router is not None:
+        # the FLEET report: router semantics (hedge/lag/retirement) +
+        # fleet-merged metrics; per-replica engine counters ride in a
+        # list instead of the single-engine scalars
+        report = {
+            **common,
+            "fleet": router.fleet_status(),
+            "per_replica": [
+                {"replica": i,
+                 "retired": rep.retired,
+                 "decode_dispatches": rep.engine.decode_dispatches,
+                 "watchdog_trips": rep.engine.watchdog_trips,
+                 "evictions": rep.engine.evictions,
+                 "prefill_programs": len(rep.engine.prefill_shapes),
+                 "kv_cache_mb": round(
+                     rep.engine.kv_cache_bytes() / 1e6, 2),
+                 # host-vs-device split + dispatch_gap_ms per replica
+                 # — the slow-replica triage numbers (OPERATIONS.md
+                 # "Degraded-replica triage")
+                 "device_time": rep.engine.device_time_summary()}
+                for i, rep in enumerate(router.replicas)],
+            **metrics.summary(),
+        }
+        if args.trace_file:
+            print(f"trace -> {args.trace_file}", file=sys.stderr)
+        print(json.dumps(report))
+        return 0
+    report = {
+        **common,
         "watchdog_trips": engine.watchdog_trips,
         "evictions": engine.evictions,
         "prefill_dispatches": engine.prefill_dispatches,
         "prefill_programs": len(engine.prefill_shapes),
-        # total programs XLA built during the run (analysis/recompile.py
-        # guard plane): steady-state serving should pin this at the
-        # warmup set — 1 step + prefill_programs (+ first-use helpers);
-        # a count growing with request traffic is the recompile smell
-        # prefill_buckets exists to kill
-        "compiled_programs": compiles.count,
         "kv_cache_mb": round(engine.kv_cache_bytes() / 1e6, 2),
-        "host": sampler.summary(),
         # host-vs-device attribution per decode dispatch plus the
         # dispatch_gap_ms host bubble (telemetry/device.py) — the
         # overlap-is-actually-overlapping numbers
         "device_time": engine.device_time_summary(),
-        "resumed": len(resumed),
-        "drain_persisted": (len(engine.drained) if drain_path else 0),
         **metrics.summary(),
     }
     if args.trace_file:

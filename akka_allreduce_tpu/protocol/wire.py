@@ -36,6 +36,8 @@ MSG_SCATTER = 3
 MSG_REDUCE = 4
 MSG_COMPLETE = 5
 MSG_PING = 6
+MSG_SUBMIT = 7
+MSG_COMPLETION = 8
 
 
 class Ping:
@@ -69,6 +71,105 @@ class Hello:
 
     def __repr__(self) -> str:
         return f"Hello({self.addr}, {self.role!r})"
+
+
+class SubmitFrame:
+    """One serving request on the wire (the replicated serving plane,
+    serving/router.py): a router dispatching to a SUBPROCESS replica
+    sends this over the same tcp.py transport the allreduce protocol
+    rides. Token ids travel as int32; optional fields (eos, deadline)
+    use sentinel encoding (-1 / NaN-free: ``has_*`` flag bytes) so the
+    frame stays fixed-layout and struct-parsable. ``attempts`` carries
+    the retry ledger across the boundary — a failover re-dispatch must
+    keep its budget, not reset it."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token",
+                 "stop_tokens", "deadline", "attempts")
+
+    def __init__(self, rid: int, prompt, max_new_tokens: int,
+                 eos_token: Optional[int] = None, stop_tokens=(),
+                 deadline: Optional[float] = None, attempts: int = 0):
+        self.rid = rid
+        self.prompt = tuple(int(t) for t in prompt)
+        self.max_new_tokens = max_new_tokens
+        self.eos_token = eos_token
+        self.stop_tokens = tuple(int(t) for t in stop_tokens)
+        if len(self.stop_tokens) > 255:
+            # the frame carries the stop count in one byte — far above
+            # any engine's max_stop_tokens, but fail at construction
+            # with a real message instead of struct.error at dispatch
+            raise ValueError(
+                f"SubmitFrame carries at most 255 stop tokens, got "
+                f"{len(self.stop_tokens)}")
+        self.deadline = deadline
+        self.attempts = attempts
+
+    def __repr__(self) -> str:
+        return (f"SubmitFrame(rid={self.rid}, "
+                f"prompt_len={len(self.prompt)}, "
+                f"max_new_tokens={self.max_new_tokens})")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SubmitFrame) and all(
+            getattr(self, f) == getattr(other, f)
+            for f in self.__slots__)
+
+
+class CompletionFrame:
+    """A replica's terminal answer for one dispatched request:
+    generated tokens plus the finish reason (``eos``/``stop``/
+    ``max_tokens``, or a failure status the router routes through its
+    retry budget). The inverse direction of :class:`SubmitFrame`."""
+
+    __slots__ = ("rid", "tokens", "reason")
+
+    def __init__(self, rid: int, tokens, reason: str):
+        self.rid = rid
+        self.tokens = tuple(int(t) for t in tokens)
+        if len(reason.encode()) > 255:
+            # one length byte on the wire; reasons are short enum-like
+            # strings — a longer one is a caller bug surfaced here,
+            # not a struct.error at dispatch
+            raise ValueError(
+                f"CompletionFrame reason exceeds 255 bytes: {reason[:40]!r}...")
+        self.reason = reason
+
+    def __repr__(self) -> str:
+        return (f"CompletionFrame(rid={self.rid}, "
+                f"tokens={len(self.tokens)}, reason={self.reason!r})")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, CompletionFrame) and all(
+            getattr(self, f) == getattr(other, f)
+            for f in self.__slots__)
+
+
+def request_to_frame(req) -> SubmitFrame:
+    """Map a serving :class:`~akka_allreduce_tpu.serving.scheduler
+    .Request` to its wire frame. Clock-domain fields (``arrival``,
+    ``submitted_at``) deliberately do not travel: they are monotonic
+    instants of the ROUTER's clock, meaningless to a replica process
+    (same rule as the drain sidecar, serving/engine.py
+    ``_req_from_json``). ``deadline`` does travel — the replica
+    enforces mid-flight eviction locally — converted by the caller to
+    a shared epoch if the hosts' clocks are not the same."""
+    return SubmitFrame(rid=req.rid, prompt=req.prompt,
+                       max_new_tokens=req.max_new_tokens,
+                       eos_token=req.eos_token,
+                       stop_tokens=req.stop_tokens or (),
+                       deadline=req.deadline, attempts=req.attempts)
+
+
+def frame_to_request(frame: SubmitFrame):
+    """The receiving replica's half of :func:`request_to_frame` —
+    imported lazily so the protocol plane stays importable without the
+    serving package."""
+    from akka_allreduce_tpu.serving.scheduler import Request
+    return Request(rid=frame.rid, prompt=frame.prompt,
+                   max_new_tokens=frame.max_new_tokens,
+                   eos_token=frame.eos_token,
+                   stop_tokens=frame.stop_tokens,
+                   deadline=frame.deadline, attempts=frame.attempts)
 
 
 def _pack_addr(addr: Addr) -> bytes:
@@ -122,6 +223,23 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
         return struct.pack("<Biq", MSG_COMPLETE, msg.src_id, msg.round)
     if isinstance(msg, Ping):
         return struct.pack("<Bd", MSG_PING, msg.interval)
+    if isinstance(msg, SubmitFrame):
+        prompt = np.asarray(msg.prompt, dtype=np.int32).tobytes()
+        stops = np.asarray(msg.stop_tokens, dtype=np.int32).tobytes()
+        return (struct.pack(
+            "<BqIiBiBdI", MSG_SUBMIT, msg.rid, msg.max_new_tokens,
+            msg.eos_token if msg.eos_token is not None else -1,
+            1 if msg.deadline is not None else 0,
+            msg.attempts,
+            len(msg.stop_tokens),
+            msg.deadline if msg.deadline is not None else 0.0,
+            len(msg.prompt)) + stops + prompt)
+    if isinstance(msg, CompletionFrame):
+        tokens = np.asarray(msg.tokens, dtype=np.int32).tobytes()
+        reason = msg.reason.encode()
+        return (struct.pack("<BqBI", MSG_COMPLETION, msg.rid,
+                            len(reason), len(msg.tokens))
+                + reason + tokens)
     raise TypeError(f"cannot encode {type(msg).__name__}")
 
 
@@ -184,4 +302,27 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
     if mtype == MSG_PING:
         (interval,) = struct.unpack_from("<d", buf, off)
         return Ping(interval)
+    if mtype == MSG_SUBMIT:
+        (rid, max_new, eos, has_deadline, attempts, n_stops, deadline,
+         n_prompt) = struct.unpack_from("<qIiBiBdI", buf, off)
+        off += struct.calcsize("<qIiBiBdI")
+        stops = np.frombuffer(buf, dtype=np.int32, count=n_stops,
+                              offset=off)
+        off += 4 * n_stops
+        prompt = np.frombuffer(buf, dtype=np.int32, count=n_prompt,
+                               offset=off)
+        return SubmitFrame(rid=rid, prompt=prompt,
+                           max_new_tokens=max_new,
+                           eos_token=None if eos < 0 else eos,
+                           stop_tokens=stops,
+                           deadline=deadline if has_deadline else None,
+                           attempts=attempts)
+    if mtype == MSG_COMPLETION:
+        rid, rlen, n_tokens = struct.unpack_from("<qBI", buf, off)
+        off += struct.calcsize("<qBI")
+        reason = buf[off:off + rlen].decode()
+        off += rlen
+        tokens = np.frombuffer(buf, dtype=np.int32, count=n_tokens,
+                               offset=off)
+        return CompletionFrame(rid=rid, tokens=tokens, reason=reason)
     raise ValueError(f"unknown message type {mtype}")

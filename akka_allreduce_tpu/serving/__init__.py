@@ -28,7 +28,18 @@ Modules:
   max-depth backpressure, per-request budgets, slot accounting, and the
   engine memory gate (``pop_ready(can_admit=...)``).
 * ``metrics.py`` — TTFT/TPOT/queue-depth/occupancy histograms, wired
-  into runtime/tracing.py spans and runtime/metrics.py host sampling.
+  into runtime/tracing.py spans and runtime/metrics.py host sampling;
+  :class:`~akka_allreduce_tpu.serving.metrics.FleetMetrics` adds the
+  replicated layer (per-replica labeled series on one registry +
+  merged fleet distributions).
+* ``replica.py`` / ``router.py`` — the MULTI-REPLICA plane (ISSUE 8):
+  N engines behind one router applying the paper's dials at the
+  request level — hedged dispatch to ``th`` of N replicas (first
+  completion wins, losers charged to wasted tokens), a ``max_lag``
+  staleness ledger shedding admissions away from degraded replicas,
+  and failover that requeues a failed replica's in-flight requests
+  (or migrates a preempted replica's drain snapshots) onto healthy
+  replicas with bitwise-parity continuation.
 
 Failure domains (ISSUE 5 — the paper's "complete the round without the
 missing contribution", pointed at serving): a hung dispatch trips the
@@ -57,8 +68,14 @@ from akka_allreduce_tpu.serving.engine import (
     persist_drained,
     serve_loop,
 )
-from akka_allreduce_tpu.serving.metrics import Histogram, ServingMetrics
+from akka_allreduce_tpu.serving.metrics import (
+    FleetMetrics,
+    Histogram,
+    ServingMetrics,
+)
 from akka_allreduce_tpu.serving.paging import AdmitPlan, PagePool, pages_for
+from akka_allreduce_tpu.serving.replica import LagLedger, ReplicaHandle
+from akka_allreduce_tpu.serving.router import ReplicaRouter, RouterConfig
 from akka_allreduce_tpu.serving.scheduler import (
     QueueFull,
     Request,
@@ -81,7 +98,12 @@ __all__ = [
     "load_drained",
     "persist_drained",
     "serve_loop",
+    "FleetMetrics",
     "Histogram",
+    "LagLedger",
+    "ReplicaHandle",
+    "ReplicaRouter",
+    "RouterConfig",
     "ServingMetrics",
     "QueueFull",
     "Request",

@@ -770,13 +770,20 @@ class ServingEngine:
 
     def __init__(self, params: dict, cfg: TransformerConfig,
                  ecfg: EngineConfig = EngineConfig(),
-                 metrics=None, tracer=None, clock=time.monotonic):
+                 metrics=None, tracer=None, clock=time.monotonic,
+                 site_prefix: str = "engine"):
         self.params = params
         self.cfg = cfg
         self.ecfg = ecfg
         self.metrics = metrics
         self.tracer = tracer
         self.clock = clock
+        # fault-site namespace (runtime/faults.py): a standalone engine
+        # keeps the historical "engine.*" sites; a replicated fleet
+        # gives each replica its own prefix ("replica0", ...) so a
+        # FaultPlan can script a fault INTO one replica — the
+        # per-replica failure domain the router's fault matrix drives
+        self.site_prefix = site_prefix
         if ecfg.prefill_buckets and ecfg.prefill_buckets[-1] > cfg.max_seq:
             raise ValueError(
                 f"largest prefill bucket {ecfg.prefill_buckets[-1]} "
@@ -1025,6 +1032,26 @@ class ServingEngine:
         self._free_slot(i)
         return (i, slot.req, [], reason)
 
+    def cancel(self, rid: int) -> Optional[int]:
+        """Free the lane holding ``rid`` WITHOUT a completion: the
+        hedged-dispatch loser (serving/router.py) — another replica
+        already delivered this request's tokens, so this copy's partial
+        decode is discarded and charged to wasted work (the hedging tax
+        the fleet summary surfaces). Not a failure: no retry, no
+        failure event, no terminal record. Returns the discarded token
+        count, or None when ``rid`` holds no lane here (it already
+        finished or was never admitted)."""
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.req.rid == rid:
+                n = len(slot.emitted)
+                self.discarded_tokens += n
+                if self.metrics is not None:
+                    self.metrics.on_discard(rid, n)
+                    self.metrics.on_cancel(rid)
+                self._free_slot(i)
+                return n
+        return None
+
     def _recover(self, reason: str) -> list[tuple]:
         """A dispatch hung past the watchdog or raised: the donated
         in-flight state is garbage either way. Fail every occupied
@@ -1057,12 +1084,13 @@ class ServingEngine:
         dropped on the floor; the rebuild owns fresh arrays) and the
         executor replaced so the next dispatch gets a live thread."""
         wd = self.ecfg.watchdog_timeout_s
+        site = f"{self.site_prefix}.dispatch"
         if wd is None:
-            maybe_fail("engine.dispatch")
+            maybe_fail(site)
             return fn()
 
         def guarded():
-            maybe_fail("engine.dispatch")
+            maybe_fail(site)
             return fn()
 
         if self._executor is None:
@@ -1084,7 +1112,7 @@ class ServingEngine:
         carried logits with NaN before the dispatch — the injected
         version of a numerically-poisoned decode, which the on-device
         finite guard must catch and contain."""
-        pt = maybe_fail("engine.logits")
+        pt = maybe_fail(f"{self.site_prefix}.logits")
         if pt is None or pt.kind != "nan":
             return
         logits = self._state["logits"]
@@ -1379,7 +1407,8 @@ class PagedServingEngine(ServingEngine):
 
     def __init__(self, params: dict, cfg: TransformerConfig,
                  ecfg: PagedEngineConfig = PagedEngineConfig(),
-                 metrics=None, tracer=None, clock=time.monotonic):
+                 metrics=None, tracer=None, clock=time.monotonic,
+                 site_prefix: str = "engine"):
         from akka_allreduce_tpu.serving.paging import PagePool, pages_for
         if not isinstance(ecfg, PagedEngineConfig):
             raise TypeError(
@@ -1415,7 +1444,8 @@ class PagedServingEngine(ServingEngine):
         self.peak_pages_in_use = 0
         self.peak_pages_unshared = 0
         super().__init__(params, cfg, ecfg, metrics=metrics,
-                         tracer=tracer, clock=clock)
+                         tracer=tracer, clock=clock,
+                         site_prefix=site_prefix)
 
     def _fresh_state(self) -> dict:
         return {**init_kv_pool(self.cfg, self.pool.num_pages,

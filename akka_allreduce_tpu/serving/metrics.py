@@ -56,11 +56,19 @@ class ServingMetrics:
     JSON-able dict (the serve CLI prints it as its single stdout line,
     the same one-JSON-line contract as bench.py)."""
 
-    def __init__(self, clock=time.monotonic, tracer=None, registry=None):
+    def __init__(self, clock=time.monotonic, tracer=None, registry=None,
+                 labels=None):
         self.clock = clock
         self.tracer = tracer
         self.registry = registry if registry is not None \
             else MetricsRegistry()
+        # series labels (e.g. {"replica": "0"}): a replicated fleet
+        # (serving/router.py) registers N ServingMetrics on ONE shared
+        # registry, each under its replica label — the scrape surface
+        # keys per-replica series exactly, and the fleet summary merges
+        # the same cells (FleetMetrics). Empty (default) = the
+        # historical unlabeled single-engine series.
+        self.labels = dict(labels or {})
         self.ttft_s = Histogram()
         self.tpot_s = Histogram()
         self.queue_depth = Histogram()
@@ -106,7 +114,8 @@ class ServingMetrics:
         self._drain_persisted = self.registry.counter(
             "serve_drain_persisted_total",
             help="drained ResumableRequests persisted across a process "
-                 "boundary (runtime/checkpoint.py save_drained)")
+                 "boundary (runtime/checkpoint.py save_drained)",
+            labels=self.labels)
         self._register(self.registry)
 
     def _register(self, r) -> None:
@@ -151,7 +160,7 @@ class ServingMetrics:
         )
         for name, pull, help_text in counters:
             r.register_callback(name, pull, kind="counter",
-                                help=help_text)
+                                help=help_text, labels=self.labels)
         histograms = (
             ("serve_ttft_seconds", lambda: self.ttft_s,
              "submit -> first token delivery"),
@@ -167,7 +176,8 @@ class ServingMetrics:
              "per completion"),
         )
         for name, pull, help_text in histograms:
-            r.register_histogram(name, pull, help=help_text)
+            r.register_histogram(name, pull, help=help_text,
+                                 labels=self.labels)
 
     # -- paged engine (ISSUE 7) ----------------------------------------
 
@@ -198,7 +208,7 @@ class ServingMetrics:
         for name, key, help_text in gauges:
             self.registry.register_callback(
                 name, (lambda k=key: self._paging()[k]), kind="gauge",
-                help=help_text)
+                help=help_text, labels=self.labels)
         counters = (
             ("serve_prefix_pages_shared_total", "pages_shared_total",
              "page acquisitions served by refcount++ (prefix reuse)"),
@@ -209,7 +219,7 @@ class ServingMetrics:
         for name, key, help_text in counters:
             self.registry.register_callback(
                 name, (lambda k=key: self._paging()[k]), kind="counter",
-                help=help_text)
+                help=help_text, labels=self.labels)
 
     # -- lifecycle hooks ----------------------------------------------
 
@@ -281,11 +291,27 @@ class ServingMetrics:
         self.retries_total += 1
         self._record("serve_retry", rid=rid)
 
+    def on_cancel(self, rid: int) -> None:
+        """A hedged-dispatch loser cancelled on THIS replica
+        (serving/router.py): not a failure, not a completion — but the
+        request's first-token bookkeeping must still clear, or a
+        long-lived hedged fleet leaks one dict entry per request (the
+        banked TTFT sample itself stays: the histogram log is
+        append-only, and under hedging each copy's delivery time is a
+        real sample of what the user could have seen)."""
+        self._first.pop(rid, None)
+        self._first_count.pop(rid, None)
+        self._record("serve_cancel", rid=rid)
+
     def on_evict(self, rid: int, n_tokens: int) -> None:
         """Mid-flight deadline eviction — terminal, and by definition a
-        deadline miss."""
+        deadline miss. Clears first-token bookkeeping: an evicted
+        request never reaches on_complete, which is where the entries
+        normally pop."""
         self.evictions_total += 1
         self.deadline_misses_total += 1
+        self._first.pop(rid, None)
+        self._first_count.pop(rid, None)
         self._record("serve_evict", rid=rid, tokens=n_tokens)
 
     def on_watchdog_trip(self) -> None:
@@ -414,4 +440,343 @@ class ServingMetrics:
             out["wall_s"] = round(self.wall_s, 3)
             out["decode_tokens_per_s"] = round(
                 self.decode_tokens_per_s or 0.0, 1)
+        return out
+
+
+class FleetMetrics:
+    """Fleet-wide metrics for a REPLICATED serve run
+    (serving/router.py): N per-replica :class:`ServingMetrics` on ONE
+    shared registry (each under a ``replica`` label), plus the router's
+    own fleet-scope series — hedging, lag-ledger transitions, the
+    fleet retry/dead-letter ledger — and merged fleet distributions.
+
+    The aggregation contract is the one ``Histogram.merge()`` was built
+    for (telemetry/registry.py): every fleet percentile series
+    (``serve_fleet_ttft_seconds`` etc.) is a PULL collector that merges
+    the per-replica histograms at scrape time, and :meth:`summary`
+    renders the same merge — scrape == summary holds by construction at
+    both the replica label and the fleet level, exactly as it does for
+    a single engine. (Queue depth is sampled once per router round on
+    every live replica's metrics, so the merged distribution repeats
+    each sample per replica — percentiles are invariant under that
+    duplication.)
+
+    Event routing: ENGINE-side hooks (admit/token/complete/discard/
+    failure/evict/watchdog) land on the owning replica's ServingMetrics
+    via ``engine.metrics``; FLEET-side events — submission, terminal
+    results, scheduler retries/dead-letters, hedge accounting, degrade/
+    readmit/shed transitions, router-level fault survival — land here.
+    """
+
+    def __init__(self, num_replicas: int, clock=time.monotonic,
+                 tracer=None, registry=None):
+        if num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {num_replicas}")
+        self.clock = clock
+        self.tracer = tracer
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.replicas = [
+            ServingMetrics(clock=clock, tracer=tracer,
+                           registry=self.registry,
+                           labels={"replica": str(i)})
+            for i in range(num_replicas)]
+        # -- fleet-scope state --------------------------------------------
+        self.requests_submitted = 0
+        self.requests_completed = 0   # unique successful terminals
+        self.results_failed = 0       # unique failed terminals
+        self.retries_total = 0        # scheduler requeues (fleet events)
+        self.dead_letter_total = 0
+        self.deadline_misses_total = 0  # fleet-level infeasible sheds
+        # hedged dispatch (th > 1): copies admitted beyond the primary,
+        # losers cancelled when the winner landed, copies that finished
+        # after the winner in the same round, failures a live sibling
+        # copy absorbed (no retry needed), and the decode tokens the
+        # losing copies computed (a subset of the summed wasted tokens,
+        # attributed to hedging specifically)
+        self.hedge_dispatched = 0
+        self.hedge_cancelled = 0
+        self.hedge_duplicates = 0
+        self.hedge_absorbed_failures = 0
+        self.hedge_wasted_tokens = 0
+        # lag-ledger transitions (serving/replica.py LagLedger)
+        self.replicas_degraded_total = 0
+        self.replicas_readmitted_total = 0
+        self.shed_admissions_total = 0
+        # replicas retired from the fleet (preemption drain)
+        self.replicas_retired_total = 0
+        # backpressure sheds at the fleet's admission edge
+        self.requests_rejected = 0
+        # the chaos reconciliation pair at fleet scope: injected is
+        # stamped from FaultPlan.fired; survived sums the replicas'
+        # recovery events plus router-level survivals (preempt drains)
+        self.fault_injected = 0
+        self._fault_survived_fleet = 0
+        self._t0: Optional[float] = None
+        self._t_end: Optional[float] = None
+        self._drain_persisted = self.registry.counter(
+            "serve_fleet_drain_persisted_total",
+            help="fleet-drained ResumableRequests persisted across a "
+                 "process boundary")
+        self._register()
+
+    # -- aggregation ---------------------------------------------------
+
+    def merged(self, attr: str) -> Histogram:
+        """One fleet distribution from every replica's ``attr``
+        histogram (``Histogram.merge`` — replicas unchanged)."""
+        h = Histogram()
+        for m in self.replicas:
+            h.merge(getattr(m, attr))
+        return h
+
+    def _sum(self, attr: str) -> float:
+        return sum(getattr(m, attr) for m in self.replicas)
+
+    @property
+    def fault_survived(self) -> int:
+        return int(self._fault_survived_fleet
+                   + self._sum("fault_survived"))
+
+    def _register(self) -> None:
+        r = self.registry
+        counters = (
+            ("serve_fleet_submitted_total",
+             lambda: self.requests_submitted,
+             "requests submitted to the fleet"),
+            ("serve_fleet_completed_total",
+             lambda: self.requests_completed,
+             "unique requests completed with tokens (hedge duplicates "
+             "excluded)"),
+            ("serve_fleet_retries_total", lambda: self.retries_total,
+             "failed attempts requeued by the fleet scheduler"),
+            ("serve_fleet_dead_letter_total",
+             lambda: self.dead_letter_total,
+             "requests terminal after the fleet retry budget"),
+            ("serve_fleet_hedge_dispatched_total",
+             lambda: self.hedge_dispatched,
+             "hedge copies admitted beyond the primary (th > 1)"),
+            ("serve_fleet_hedge_cancelled_total",
+             lambda: self.hedge_cancelled,
+             "hedge losers cancelled after the winner delivered"),
+            ("serve_fleet_hedge_duplicates_total",
+             lambda: self.hedge_duplicates,
+             "hedge copies that finished after the winner, same round"),
+            ("serve_fleet_hedge_absorbed_failures_total",
+             lambda: self.hedge_absorbed_failures,
+             "replica failures absorbed by a live sibling hedge copy "
+             "(no retry spent)"),
+            ("serve_fleet_hedge_wasted_tokens_total",
+             lambda: self.hedge_wasted_tokens,
+             "decode tokens computed by losing hedge copies"),
+            ("serve_fleet_replicas_degraded_total",
+             lambda: self.replicas_degraded_total,
+             "lag-ledger degrade transitions (> max_lag rounds "
+             "behind)"),
+            ("serve_fleet_replicas_readmitted_total",
+             lambda: self.replicas_readmitted_total,
+             "degraded replicas readmitted after proving progress"),
+            ("serve_fleet_shed_admissions_total",
+             lambda: self.shed_admissions_total,
+             "admissions steered away from degraded replicas"),
+            ("serve_fleet_replicas_retired_total",
+             lambda: self.replicas_retired_total,
+             "replicas retired from the fleet by a preemption drain"),
+            ("serve_fleet_fault_injected_total",
+             lambda: self.fault_injected,
+             "faults the armed plan fired (chaos harness stamp)"),
+            ("serve_fleet_fault_survived_total",
+             lambda: self.fault_survived,
+             "failure events absorbed fleet-wide (replica recoveries + "
+             "router drains)"),
+        )
+        for name, pull, help_text in counters:
+            r.register_callback(name, pull, kind="counter",
+                                help=help_text)
+        r.register_callback("serve_fleet_replicas",
+                            lambda: len(self.replicas), kind="gauge",
+                            help="replicas constructed into the fleet")
+        histograms = (
+            ("serve_fleet_ttft_seconds", "ttft_s",
+             "submit -> first token, merged across replicas"),
+            ("serve_fleet_tpot_seconds", "tpot_s",
+             "steady decode cadence, merged across replicas"),
+            ("serve_fleet_queue_depth", "queue_depth",
+             "fleet admission-queue depth per router round (each "
+             "sample repeated per live replica; percentiles "
+             "unaffected)"),
+            ("serve_fleet_slot_occupancy", "slot_occupancy",
+             "per-replica occupied-slot fraction per router round, "
+             "merged"),
+        )
+        for name, attr, help_text in histograms:
+            r.register_histogram(name, (lambda a=attr: self.merged(a)),
+                                 help=help_text)
+
+    # -- fleet event hooks ---------------------------------------------
+
+    def _record(self, kind: str, **fields) -> None:
+        if self.tracer is not None:
+            self.tracer.record(kind, **fields)
+
+    def on_submit(self, rid: int) -> None:
+        self.requests_submitted += 1
+        if self._t0 is None:
+            self._t0 = self.clock()
+        self._record("serve_submit", rid=rid)
+
+    def on_result(self, rid: int, reason: str) -> None:
+        """One TERMINAL record per request, whatever replica (or
+        scheduler path) produced it — the fleet's completion truth."""
+        self._t_end = self.clock()
+        if reason in ("eos", "stop", "max_tokens"):
+            self.requests_completed += 1
+        else:
+            self.results_failed += 1
+
+    def on_reject(self, rid: int) -> None:
+        self.requests_rejected += 1
+        self._record("serve_reject", rid=rid)
+
+    def on_drain_persisted(self, n: int) -> None:
+        self._drain_persisted.inc(n)
+        self._record("serve_drain_persisted", count=n)
+
+    def on_retry(self, rid: int) -> None:
+        self.retries_total += 1
+        self._record("serve_retry", rid=rid)
+
+    def on_drop(self, rid: int, reason: str) -> None:
+        if reason == "dead_letter":
+            self.dead_letter_total += 1
+        elif reason == "rejected_infeasible":
+            self.deadline_misses_total += 1
+        self._record("serve_drop", rid=rid, reason=reason)
+
+    def on_hedge_dispatched(self, rid: int, n: int) -> None:
+        self.hedge_dispatched += n
+        if n:
+            self._record("serve_hedge", rid=rid, copies=n)
+
+    def on_hedge_cancelled(self, rid: int, replica: int,
+                           tokens: int) -> None:
+        self.hedge_cancelled += 1
+        self.hedge_wasted_tokens += tokens
+        self._record("serve_hedge_cancel", rid=rid, replica=replica,
+                     tokens=tokens)
+
+    def on_hedge_duplicate(self, rid: int, replica: int,
+                           tokens: int) -> None:
+        self.hedge_duplicates += 1
+        self.hedge_wasted_tokens += tokens
+        self._record("serve_hedge_duplicate", rid=rid, replica=replica,
+                     tokens=tokens)
+
+    def on_hedge_absorbed(self, rid: int, replica: int,
+                          reason: str) -> None:
+        self.hedge_absorbed_failures += 1
+        self._record("serve_hedge_absorbed", rid=rid, replica=replica,
+                     reason=reason)
+
+    def on_degraded(self, replica: int, lag: int) -> None:
+        self.replicas_degraded_total += 1
+        self._record("serve_replica_degraded", replica=replica, lag=lag)
+
+    def on_readmitted(self, replica: int) -> None:
+        self.replicas_readmitted_total += 1
+        self._record("serve_replica_readmitted", replica=replica)
+
+    def on_shed(self, replica: int, rid: int) -> None:
+        self.shed_admissions_total += 1
+        self._record("serve_admission_shed", replica=replica, rid=rid)
+
+    def on_retired(self, replica: int, migrated: int) -> None:
+        self.replicas_retired_total += 1
+        self._record("serve_replica_retired", replica=replica,
+                     migrated=migrated)
+
+    def on_fault_injected(self, n: int = 1) -> None:
+        self.fault_injected += n
+
+    def on_fault_survived(self, kind: str) -> None:
+        """Router-level survival (a drained replica, a fleet preempt);
+        replica-level recoveries tick their own ServingMetrics and are
+        summed into :attr:`fault_survived`."""
+        self._fault_survived_fleet += 1
+        self._record("serve_fault_survived", fault=kind)
+
+    # -- host plane ----------------------------------------------------
+
+    def host_sampler(self, interval_s: float = 1.0):
+        """Same contract as :meth:`ServingMetrics.host_sampler`: one
+        RSS/CPU sampler on the fleet's shared tracer + registry."""
+        from akka_allreduce_tpu.runtime.metrics import HostResourceSampler
+        return HostResourceSampler(interval_s=interval_s,
+                                   tracer=self.tracer,
+                                   registry=self.registry)
+
+    # -- reporting -----------------------------------------------------
+
+    @property
+    def wall_s(self) -> Optional[float]:
+        if self._t0 is None or self._t_end is None:
+            return None
+        return self._t_end - self._t0
+
+    def summary(self) -> dict:
+        decode = int(self._sum("decode_tokens"))
+        wasted = int(self._sum("wasted_tokens"))
+        computed = decode + wasted
+        out = {
+            "replicas": len(self.replicas),
+            "requests": {
+                "submitted": self.requests_submitted,
+                "completed": self.requests_completed,
+                "failed_terminal": self.results_failed,
+                "rejected": int(self.requests_rejected
+                                + self._sum("requests_rejected")),
+                "failed_attempts": int(self._sum("requests_failed")),
+            },
+            "tokens": {"prefill": int(self._sum("prefill_tokens")),
+                       "decode": decode, "wasted": wasted},
+            "wasted_token_rate": round(
+                wasted / computed, 4) if computed else 0.0,
+            "faults": {
+                "retries_total": self.retries_total,
+                "evictions_total": int(self._sum("evictions_total")),
+                "deadline_misses_total": int(
+                    self.deadline_misses_total
+                    + self._sum("evictions_total")),
+                "watchdog_trips_total": int(
+                    self._sum("watchdog_trips_total")),
+                "dead_letter_total": self.dead_letter_total,
+                "fault_injected": self.fault_injected,
+                "fault_survived": self.fault_survived,
+            },
+            "hedge": {
+                "dispatched": self.hedge_dispatched,
+                "cancelled": self.hedge_cancelled,
+                "duplicates": self.hedge_duplicates,
+                "absorbed_failures": self.hedge_absorbed_failures,
+                "wasted_tokens": self.hedge_wasted_tokens,
+            },
+            "lag": {
+                "degraded_total": self.replicas_degraded_total,
+                "readmitted_total": self.replicas_readmitted_total,
+                "shed_admissions_total": self.shed_admissions_total,
+                "retired_total": self.replicas_retired_total,
+            },
+            # the merged fleet distributions — the SAME merge the
+            # serve_fleet_* pull collectors run at scrape time
+            "ttft_ms": self.merged("ttft_s").summary(scale=1e3),
+            "tpot_ms": self.merged("tpot_s").summary(scale=1e3),
+            "queue_depth": self.merged("queue_depth").summary(digits=2),
+            "slot_occupancy": self.merged("slot_occupancy").summary(
+                digits=3),
+        }
+        if self.wall_s is not None:
+            out["wall_s"] = round(self.wall_s, 3)
+            out["decode_tokens_per_s"] = round(
+                decode / self.wall_s, 1) if self.wall_s > 0 else 0.0
         return out

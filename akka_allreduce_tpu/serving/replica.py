@@ -1,0 +1,152 @@
+"""Replica identity and the per-replica lag ledger.
+
+The reference's master tallies worker completions per round and
+tolerates a straggler up to ``maxLag`` rounds behind before the round
+simply proceeds without it (PAPER.md L3/L4; the training plane's
+runtime/straggler.py + runtime/pacer.py reproduce it for gradient
+rounds). Pointed at a FLEET of serving-engine replicas, the same two
+dials become horizontal-scale machinery:
+
+* a router ROUND is one pass over the fleet — every replica with
+  occupied slots gets one dispatch opportunity per round, the serving
+  twin of the reference's allreduce round;
+* :class:`LagLedger` tracks, per replica, the last round it actually
+  COMPLETED a dispatch in. A replica more than ``max_lag`` rounds
+  behind (its dispatches hang past the watchdog, raise, or otherwise
+  never land) is DEGRADED: new admissions shed away from it while its
+  in-flight work keeps its chance to finish — the membership analogue
+  of a straggler whose chunks stop being waited for
+  (runtime/elastic.py ``QuorumTracker`` is the training-plane cousin;
+  here nothing re-forms, because slots are per-replica and a shed
+  replica keeps serving what it already holds).
+* Readmission is EARNED, not timed: a degraded replica rejoins when it
+  completes a dispatch again. Because shedding starves an idle
+  degraded replica of the very work it would prove itself on, the
+  router grants one PROBE admission per degraded replica per round
+  when no healthy replica can take the request — the liveness rule,
+  same shape as the deadline trainer's all-masked fallback
+  (runtime/straggler.py: the group can never wedge below quorum).
+
+Pure host bookkeeping — no device, no jax import; unit-tested with
+scripted rounds in tests/test_replica_router.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from akka_allreduce_tpu.serving.engine import ServingEngine
+
+
+class LagLedger:
+    """Round-based staleness accounting for ``num_replicas`` replicas.
+
+    ``round`` advances once per router pass (:meth:`begin_round`);
+    ``last[i]`` is the newest round replica ``i`` proved progress in —
+    by completing a decode dispatch (:meth:`on_progress`) or by being
+    idle while healthy (:meth:`mark_current`: a replica with nothing to
+    do is trivially keeping up, and must not degrade for lack of work).
+    ``lag(i) = round - last[i]``; crossing ``max_lag`` flips the
+    replica to degraded exactly once per excursion
+    (:meth:`check_degrade`), and the first completed dispatch after
+    that clears it (:meth:`on_progress` returns True — the readmission
+    event the router counts)."""
+
+    def __init__(self, num_replicas: int, max_lag: int):
+        if num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {num_replicas}")
+        if max_lag < 1:
+            raise ValueError(f"max_lag must be >= 1, got {max_lag}")
+        self.max_lag = max_lag
+        self.round = 0
+        self._last = [0] * num_replicas
+        self.degraded = [False] * num_replicas
+        # per-replica counters for the triage surface
+        # (OPERATIONS.md "Degraded-replica triage")
+        self.degrade_events = [0] * num_replicas
+        self.readmit_events = [0] * num_replicas
+        self.shed_events = [0] * num_replicas
+
+    def begin_round(self) -> int:
+        self.round += 1
+        return self.round
+
+    def lag(self, i: int) -> int:
+        return self.round - self._last[i]
+
+    def mark_current(self, i: int) -> None:
+        """An idle HEALTHY replica keeps up by definition. Deliberately
+        not offered to degraded replicas: they earn currency back by
+        completing a dispatch (a probe admission provides the work)."""
+        if not self.degraded[i]:
+            self._last[i] = self.round
+
+    def on_progress(self, i: int) -> bool:
+        """Replica ``i`` completed a dispatch this round. Returns True
+        iff this readmits a degraded replica (the catch-up event)."""
+        self._last[i] = self.round
+        if self.degraded[i]:
+            self.degraded[i] = False
+            self.readmit_events[i] += 1
+            return True
+        return False
+
+    def check_degrade(self, i: int) -> bool:
+        """Flip ``i`` to degraded if its lag just crossed ``max_lag``.
+        Returns True only on the transition (counted once)."""
+        if not self.degraded[i] and self.lag(i) > self.max_lag:
+            self.degraded[i] = True
+            self.degrade_events[i] += 1
+            return True
+        return False
+
+    def on_shed(self, i: int) -> None:
+        self.shed_events[i] += 1
+
+    def status(self) -> dict:
+        """The operator view: per-replica lag / state / transition
+        counts — what the fleet report and ``serve_fleet_*`` gauges
+        render."""
+        return {
+            "round": self.round,
+            "max_lag": self.max_lag,
+            "lag": [self.lag(i) for i in range(len(self._last))],
+            "degraded": list(self.degraded),
+            "degrade_events": list(self.degrade_events),
+            "readmit_events": list(self.readmit_events),
+            "shed_events": list(self.shed_events),
+        }
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """One fleet member: the engine, its per-replica metrics sink, and
+    the router-side state that is about the REPLICA rather than any
+    request. ``retired`` marks a replica permanently out of the fleet
+    (preemption drain — the in-process model of a host that went away);
+    ``probe_round`` is the last round this replica consumed its
+    one-degraded-probe admission."""
+
+    index: int
+    engine: ServingEngine
+    metrics: Optional[object] = None
+    retired: bool = False
+    probe_round: int = -1
+
+    @property
+    def name(self) -> str:
+        return f"replica{self.index}"
+
+    @property
+    def live(self) -> bool:
+        return not self.retired
+
+    @property
+    def free_slots(self) -> int:
+        return self.engine.free_slot_count
+
+    @property
+    def occupied(self) -> int:
+        return self.engine.occupied

@@ -46,6 +46,7 @@ gates dispatches, whatever their token width.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import heapq
 import itertools
@@ -137,6 +138,14 @@ class SchedulerConfig:
     tpot_estimate: float = 0.0
     min_feasible_tokens: int = 1
     seed: int = 0
+    # bound on the dead-letter TRIAGE list (a ring: the newest
+    # ``dead_letter_cap`` terminal records are kept, older ones dropped
+    # and counted in ``dead_letter_dropped``). A raise-storm — which
+    # replica failover makes one wedged replica able to produce — must
+    # not grow an unbounded list inside the scheduler; the terminal
+    # RESULT records (drain_dropped) are unaffected, only the operator's
+    # triage window is bounded.
+    dead_letter_cap: int = 256
 
     def __post_init__(self):
         if self.policy not in ("fifo", "deadline"):
@@ -144,6 +153,9 @@ class SchedulerConfig:
         if self.max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1, got {self.max_queue_depth}")
+        if self.dead_letter_cap < 1:
+            raise ValueError(
+                f"dead_letter_cap must be >= 1, got {self.dead_letter_cap}")
         if not 0.0 <= self.th_step <= 1.0:
             raise ValueError(
                 f"th_step must be in [0, 1], got {self.th_step}")
@@ -197,8 +209,13 @@ class RequestScheduler:
         self.shed_infeasible = 0    # deadline-infeasible admission sheds
         # terminal record of budget-exhausted requests: (req, the
         # failure reason of the LAST attempt) — the operator's triage
-        # list (OPERATIONS.md "Dead-letter triage")
-        self.dead_letter: list[tuple] = []
+        # list (OPERATIONS.md "Dead-letter triage"). A bounded RING:
+        # the newest ``cfg.dead_letter_cap`` records are kept; a
+        # raise-storm rolls older ones off into ``dead_letter_dropped``
+        # instead of growing without bound
+        self.dead_letter: collections.deque = collections.deque(
+            maxlen=cfg.dead_letter_cap)
+        self.dead_letter_dropped = 0
         # terminal drops not yet reported to the serve loop; drained
         # (and turned into results/metrics) once per loop iteration
         self._dropped: list[tuple] = []
@@ -309,6 +326,11 @@ class RequestScheduler:
         req.attempts += 1
         pol = self.cfg.retry
         if req.attempts >= pol.max_attempts:
+            if len(self.dead_letter) == self.cfg.dead_letter_cap:
+                # ring full: the OLDEST triage record rolls off (the
+                # deque's maxlen drops it on append) — counted, so the
+                # operator knows the window is a window
+                self.dead_letter_dropped += 1
             self.dead_letter.append((req, reason))
             self._dropped.append((req, "dead_letter"))
             return False

@@ -46,7 +46,7 @@ from typing import Optional
 
 # sections the gate knows how to re-measure, in bank order
 SECTIONS = ("serving_throughput", "multi_step_decode", "paged_serving",
-            "ab_overlap")
+            "replicated_serving", "ab_overlap")
 
 # per-section relative tolerance, derived from the banked captures' own
 # recorded run-to-run spread (module docstring); _DEFAULT for unknowns
@@ -60,6 +60,9 @@ SECTION_TOLERANCE = {
     # (wall-clock ratios of ~1 s runs); still < 0.5 so a 2x regression
     # in the paged-vs-slot claim fails at the boundary
     "paged_serving": 0.45,
+    # the gated row is a RATIO of two serve runs on the same box —
+    # same noise regime as the serving sections
+    "replicated_serving": 0.45,
     "ab_overlap": 0.35,
 }
 _DEFAULT_TOLERANCE = 0.35
@@ -222,6 +225,14 @@ def fresh_rows(section: str) -> list:
                 n_requests=32, prompt_len=64, steps=128, slots=4,
                 page_size=32, max_seq=1024)
         return measure_paged_serving()
+    if section == "replicated_serving":
+        from akka_allreduce_tpu.bench import measure_replicated_serving
+        if on_tpu:
+            return measure_replicated_serving(
+                d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+                n_requests=16, prompt_len=64, steps=128,
+                total_slots=8, n_replicas=2)
+        return measure_replicated_serving()
     if section == "ab_overlap":
         from akka_allreduce_tpu.bench import measure_ab_overlap
         return list(measure_ab_overlap())

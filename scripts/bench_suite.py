@@ -88,7 +88,8 @@ def main(only=None) -> int:
         fns = {f.__name__: f for f in
                (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                 ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
-                serving_throughput, multi_step_decode, paged_serving)}
+                serving_throughput, multi_step_decode, paged_serving,
+                replicated_serving)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -171,7 +172,8 @@ def main(only=None) -> int:
     skip = set(os.environ.get("AATPU_SUITE_SKIP", "").split(","))
     for fn in (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
-               serving_throughput, multi_step_decode, paged_serving):
+               serving_throughput, multi_step_decode, paged_serving,
+               replicated_serving):
         if fn.__name__ not in skip:
             fn()
     return 0
@@ -254,6 +256,32 @@ def paged_serving():
             page_size=32, max_seq=1024)
     else:
         rows = measure_paged_serving()
+    for row in rows:
+        emit(row["metric"], row["value"], row["unit"], row["note"])
+
+
+def replicated_serving():
+    """The replicated-serving A/B (ISSUE 8, serving/router.py): one
+    engine vs N router-fronted replicas at EQUAL total slots, plus the
+    hedged-dispatch (th=2) arm. The speedup row is the claim — fleet
+    throughput ~parity with the single engine, i.e. the survivability
+    structure (failover, lag shedding, migration) rides for ~free at
+    equal hardware — and the hedge-ratio row prices the tail-latency
+    insurance (akka_allreduce_tpu.bench measure_replicated_serving).
+    CPU sizes the model down the way multi_step_decode does; TPU sizes
+    up."""
+    import jax
+
+    from akka_allreduce_tpu.bench import measure_replicated_serving
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        rows = measure_replicated_serving(
+            d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+            n_requests=16, prompt_len=64, steps=128, total_slots=8,
+            n_replicas=2)
+    else:
+        rows = measure_replicated_serving()
     for row in rows:
         emit(row["metric"], row["value"], row["unit"], row["note"])
 

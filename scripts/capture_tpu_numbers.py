@@ -77,6 +77,16 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "paged_serving"], check=False)
 """),
+    # 4. the replicated-serving A/B (ISSUE 8's open claim): one engine
+    # vs 2 router-fronted replicas at equal total slots + the hedged
+    # (th=2) arm — CPU rows banked in perf_capture/replicated.json;
+    # this is the on-chip row, sized up by bench_suite's on-TPU
+    # defaults
+    ("replicated_serving", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "replicated_serving"], check=False)
+"""),
     # 3. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time. guard_recompiles: every timed run holds under the
@@ -129,7 +139,7 @@ import os, subprocess, sys
 env = {**os.environ, "AATPU_SUITE_SKIP_MFU": "1",
        "AATPU_SUITE_SKIP":
            "ab_windowed_sp,ab_overlap,serving_throughput,"
-           "multi_step_decode,paged_serving"}
+           "multi_step_decode,paged_serving,replicated_serving"}
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py"], env=env,
                check=False)
 """),

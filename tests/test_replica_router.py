@@ -1,0 +1,558 @@
+"""Multi-replica serving (ISSUE 8): the reference's threshold / maxLag
+dials at the request level, driven — not hoped — by scheduled faults.
+
+THE acceptance property: with one of N >= 2 replicas killed / hung /
+NaN-poisoned / preempted mid-load, the run completes with greedy
+tokens BITWISE identical to a fault-free SINGLE-ENGINE run, the fault
+ledger reconciles exactly (injected == survived; failed attempts ==
+retries + dead letters + hedge-absorbed), and the surviving replicas
+compile nothing after warmup. Plus the routing machinery itself: the
+lag ledger's degrade/shed/readmit protocol, hedged dispatch's
+first-completion-wins accounting, the bounded dead-letter ring, and
+the wire frames a subprocess replica would ride.
+
+Model shapes are tiny and unique to this file; the module-scope
+baselines double as program warmup (the warm-before-you-arm rule,
+OPERATIONS.md). Replica engines use the SAME num_slots as the baseline
+engine so every jitted program is shared — which is exactly why the
+survivors-compile-nothing assertion can hold across a whole fleet.
+"""
+
+import contextlib
+
+import jax
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from akka_allreduce_tpu.protocol.wire import (
+    CompletionFrame,
+    SubmitFrame,
+    decode,
+    encode,
+    frame_to_request,
+    request_to_frame,
+)
+from akka_allreduce_tpu.runtime.faults import FaultPlan, FaultPoint
+from akka_allreduce_tpu.serving import (
+    EngineConfig,
+    FleetMetrics,
+    Histogram,
+    LagLedger,
+    ReplicaRouter,
+    Request,
+    RequestScheduler,
+    RetryPolicy,
+    RouterConfig,
+    SchedulerConfig,
+    ServingEngine,
+    serve_loop,
+)
+
+CFG = TransformerConfig(vocab_size=71, d_model=32, n_heads=2,
+                        n_layers=2, d_ff=64, max_seq=48)
+SLOTS = 2         # per replica, and for the single-engine baseline
+REPLICAS = 2
+WATCHDOG_S = 0.15
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(jax.random.key(0), CFG)
+
+
+def make_requests(n=6, budget=6, seed=5):
+    """Fresh Request objects every call (mutated in flight)."""
+    rng = np.random.default_rng(seed)
+    return [Request(
+        rid=rid,
+        prompt=tuple(int(x) for x in rng.integers(
+            0, CFG.vocab_size, size=(3, 5)[rid % 2])),
+        max_new_tokens=budget,
+        eos_token=3 if rid % 2 == 0 else None,
+        submitted_at=0.0) for rid in range(n)]
+
+
+def build_fleet(params, s=1, th=1, max_lag=2, replicas=REPLICAS,
+                watchdog=WATCHDOG_S, max_attempts=3, policy="fifo",
+                **scfg_kw):
+    engines = [ServingEngine(
+        params, CFG, EngineConfig(num_slots=SLOTS, decode_steps=s,
+                                  watchdog_timeout_s=watchdog))
+        for _ in range(replicas)]
+    sched = RequestScheduler(
+        SchedulerConfig(policy=policy,
+                        retry=RetryPolicy(max_attempts=max_attempts,
+                                          base_delay=0.0),
+                        **scfg_kw),
+        num_slots=replicas * SLOTS)
+    fleet = FleetMetrics(replicas)
+    router = ReplicaRouter(engines, sched,
+                           RouterConfig(th=th, max_lag=max_lag),
+                           fleet=fleet)
+    return router, sched, fleet
+
+
+def run_fleet(router, sched, fleet, reqs, plan=None, max_rounds=3000):
+    for r in reqs:
+        fleet.on_submit(r.rid)
+        sched.submit(r)
+    ctx = plan.armed() if plan is not None else contextlib.nullcontext()
+    with ctx:
+        results = router.run(max_rounds=max_rounds)
+    return results
+
+
+@pytest.fixture(scope="module")
+def baselines(params):
+    """Fault-free SINGLE-ENGINE truth per decode_steps — the parity
+    target the ISSUE acceptance names — and the program warmup."""
+    out = {}
+    for s in (1, 4):
+        engine = ServingEngine(
+            params, CFG, EngineConfig(num_slots=SLOTS, decode_steps=s))
+        sched = RequestScheduler(SchedulerConfig(), num_slots=SLOTS)
+        for r in make_requests():
+            sched.submit(r)
+        out[s] = serve_loop(engine, sched, max_dispatches=2000)
+    return out
+
+
+# -- the lag ledger (pure host) -----------------------------------------
+
+
+class TestLagLedger:
+    def test_degrades_after_max_lag_and_readmits_on_progress(self):
+        led = LagLedger(2, max_lag=2)
+        for _ in range(2):
+            led.begin_round()
+            led.on_progress(0)          # replica 0 keeps completing
+            assert not led.check_degrade(1)  # lag 1, 2: inside the bound
+        led.begin_round()
+        led.on_progress(0)
+        assert led.lag(1) == 3
+        assert led.check_degrade(1)     # lag 3 > 2: the transition
+        assert not led.check_degrade(1)  # counted once
+        assert led.degraded == [False, True]
+        assert led.on_progress(1) is True   # catch-up readmits
+        assert led.degraded == [False, False]
+        assert led.degrade_events == [0, 1]
+        assert led.readmit_events == [0, 1]
+
+    def test_idle_healthy_replica_never_degrades(self):
+        led = LagLedger(1, max_lag=1)
+        for _ in range(10):
+            led.begin_round()
+            led.mark_current(0)         # idle, healthy: keeps up
+            assert not led.check_degrade(0)
+
+    def test_degraded_replica_cannot_mark_current(self):
+        led = LagLedger(1, max_lag=1)
+        led.begin_round()
+        led.begin_round()
+        led.begin_round()
+        assert led.check_degrade(0)
+        led.mark_current(0)             # must not launder staleness
+        assert led.degraded == [True]
+        assert led.lag(0) == 3
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_lag"):
+            LagLedger(2, max_lag=0)
+        with pytest.raises(ValueError, match="num_replicas"):
+            LagLedger(0, max_lag=1)
+
+
+# -- the bounded dead-letter ring ---------------------------------------
+
+
+class TestDeadLetterRing:
+    def _exhaust(self, sched, rid):
+        req = Request(rid=rid, prompt=(1,), max_new_tokens=1,
+                      submitted_at=0.0)
+        while sched.requeue_failed(req, "fault"):
+            pass
+
+    def test_ring_bounds_and_counts_drops(self):
+        sched = RequestScheduler(
+            SchedulerConfig(retry=RetryPolicy(max_attempts=1),
+                            dead_letter_cap=3), num_slots=1)
+        for rid in range(5):
+            self._exhaust(sched, rid)
+        assert len(sched.dead_letter) == 3
+        assert [req.rid for req, _ in sched.dead_letter] == [2, 3, 4]
+        assert sched.dead_letter_dropped == 2
+        # the terminal RESULT records are not bounded: every request
+        # still ends with exactly one dead_letter drop
+        drops = sched.drain_dropped()
+        assert [r.rid for r, _ in drops] == list(range(5))
+        assert all(status == "dead_letter" for _, status in drops)
+
+    def test_cap_validation(self):
+        with pytest.raises(ValueError, match="dead_letter_cap"):
+            SchedulerConfig(dead_letter_cap=0)
+
+
+# -- wire frames ---------------------------------------------------------
+
+
+class TestServingWireFrames:
+    def test_submit_round_trip(self):
+        req = Request(rid=9, prompt=(1, 2, 3), max_new_tokens=8,
+                      eos_token=4, stop_tokens=(6, 7), deadline=12.5,
+                      attempts=2)
+        frame = request_to_frame(req)
+        back = decode(encode(frame, None), None)
+        assert back == frame
+        req2 = frame_to_request(back)
+        assert (req2.rid, req2.prompt, req2.max_new_tokens,
+                req2.eos_token, req2.stop_tokens, req2.deadline,
+                req2.attempts) == (9, (1, 2, 3), 8, 4, (6, 7), 12.5, 2)
+
+    def test_optional_fields_absent(self):
+        frame = SubmitFrame(rid=0, prompt=(5,), max_new_tokens=1)
+        back = decode(encode(frame, None), None)
+        assert back == frame
+        assert back.eos_token is None and back.deadline is None
+        # clock-domain fields never travel (router-clock instants are
+        # meaningless to a replica process)
+        req = frame_to_request(back)
+        assert req.arrival == 0.0 and req.submitted_at is None
+
+    def test_completion_round_trip(self):
+        for comp in (CompletionFrame(3, (9, 8, 7), "eos"),
+                     CompletionFrame(4, (), "watchdog")):
+            assert decode(encode(comp, None), None) == comp
+
+    def test_one_byte_fields_validated_at_construction(self):
+        # the wire layout carries these lengths in one byte; the bound
+        # must surface as a ValueError at build time, never a
+        # struct.error at dispatch
+        with pytest.raises(ValueError, match="255 stop tokens"):
+            SubmitFrame(rid=0, prompt=(1,), max_new_tokens=1,
+                        stop_tokens=tuple(range(256)))
+        with pytest.raises(ValueError, match="reason exceeds"):
+            CompletionFrame(0, (), "x" * 256)
+
+
+# -- routing basics -------------------------------------------------------
+
+
+class TestRouterBasics:
+    def test_parity_and_balance(self, params, baselines):
+        router, sched, fleet = build_fleet(params, watchdog=None)
+        results = run_fleet(router, sched, fleet, make_requests())
+        for rid, (toks, reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks), f"rid={rid}"
+            assert results[rid][1] == reason
+        # both replicas actually served (least-loaded balance)
+        served = [rep.engine.decode_dispatches
+                  for rep in router.replicas]
+        assert all(d > 0 for d in served), served
+        s = fleet.summary()
+        assert s["requests"]["completed"] == len(results)
+        assert s["lag"] == {"degraded_total": 0, "readmitted_total": 0,
+                            "shed_admissions_total": 0,
+                            "retired_total": 0}
+
+    def test_th_wider_than_fleet_rejected(self, params):
+        with pytest.raises(ValueError, match="unsatisfiable"):
+            build_fleet(params, th=3, replicas=2)
+
+    def test_strict_binding(self, params):
+        router, _sched, _fleet = build_fleet(params, watchdog=None)
+        router._bind(1, 0)
+        with pytest.raises(RuntimeError, match="already dispatched"):
+            router._bind(1, 0)
+        router._unbind(1, 0)
+        with pytest.raises(RuntimeError, match="not bound"):
+            router._unbind(1, 0)
+
+
+class TestHedgedDispatch:
+    def test_first_completion_wins_losers_charged(self, params,
+                                                  baselines):
+        router, sched, fleet = build_fleet(params, th=2, watchdog=None)
+        results = run_fleet(router, sched, fleet, make_requests())
+        for rid, (toks, reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks), f"rid={rid}"
+            assert results[rid][1] == reason
+        s = fleet.summary()
+        # every request that got a hedge copy had exactly one loser
+        # cancelled (or the copy finished as a duplicate)
+        assert s["hedge"]["dispatched"] > 0
+        assert (s["hedge"]["cancelled"] + s["hedge"]["duplicates"]
+                == s["hedge"]["dispatched"])
+        # the hedging tax is visible: losers' partial decode is wasted
+        assert s["hedge"]["wasted_tokens"] > 0
+        assert s["tokens"]["wasted"] >= s["hedge"]["wasted_tokens"]
+        # completions are unique despite two copies per request
+        assert s["requests"]["completed"] == len(make_requests())
+
+    def test_hedge_absorbs_replica_failure_without_retry(self, params,
+                                                         baselines):
+        # replica 0's dispatch raises while every in-flight request
+        # also runs a hedge copy on replica 1: the hedge IS the retry —
+        # no budget spent, parity intact
+        router, sched, fleet = build_fleet(params, th=2, watchdog=None)
+        plan = FaultPlan([FaultPoint("replica0.dispatch", "raise",
+                                     hit=2)])
+        results = run_fleet(router, sched, fleet, make_requests(),
+                            plan=plan)
+        assert len(plan.fired) == 1
+        for rid, (toks, reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks), f"rid={rid}"
+        s = fleet.summary()
+        assert s["hedge"]["absorbed_failures"] > 0
+        # the reconciliation identity, hedged form
+        assert (s["faults"]["retries_total"]
+                + s["faults"]["dead_letter_total"]
+                + s["hedge"]["absorbed_failures"]
+                == s["requests"]["failed_attempts"])
+
+    def test_preempt_under_hedging_keeps_ledger_and_wastes_drops(
+            self, params, baselines):
+        """A preempted replica's hedge-covered snapshots are DROPPED
+        (the sibling copy continues) — that is a cancellation charged
+        to hedge waste, NOT an absorbed failure: no failure event
+        fired, and the ledger identity must stay exact under
+        preemption too."""
+        router, sched, fleet = build_fleet(params, th=2, watchdog=None)
+        plan = FaultPlan([FaultPoint("replica0.loop", "preempt",
+                                     hit=4)])
+        results = run_fleet(router, sched, fleet, make_requests(),
+                            plan=plan)
+        assert len(plan.fired) == 1
+        for rid, (toks, reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks), f"rid={rid}"
+            assert results[rid][1] == reason
+        s = fleet.summary()
+        # every in-flight copy on the preempted replica had a live
+        # sibling (th == replicas == 2), so nothing migrated, nothing
+        # was absorbed-as-failure, and the drops are hedge waste
+        assert s["requests"]["failed_attempts"] == 0
+        assert s["hedge"]["absorbed_failures"] == 0
+        assert (s["faults"]["retries_total"]
+                + s["faults"]["dead_letter_total"]
+                + s["hedge"]["absorbed_failures"]
+                == s["requests"]["failed_attempts"])
+        assert s["lag"]["retired_total"] == 1
+        assert s["hedge"]["cancelled"] >= 1
+        # the dropped copies' partial decode moved decode -> wasted
+        assert s["tokens"]["wasted"] >= s["hedge"]["wasted_tokens"] > 0
+
+
+# -- the replica fault matrix --------------------------------------------
+
+
+def point_for(kind, s):
+    """One fault into replica 0, timed to land while work is in
+    flight (hit numbering mirrors tests/test_serving_faults.py's
+    single-engine points, re-aimed at the replica0.* sites)."""
+    if kind == "hang":
+        return FaultPoint("replica0.dispatch", "hang", hit=2,
+                          duration_s=4 * WATCHDOG_S)
+    if kind == "raise":
+        return FaultPoint("replica0.dispatch", "raise", hit=2)
+    if kind == "nan":
+        return FaultPoint("replica0.logits", "nan", hit=2, slot=1)
+    # preempt replica 0 while it holds work: round 4 at S=1 is
+    # mid-decode; round 2 at S=4 lands between blocks
+    return FaultPoint("replica0.loop", "preempt", hit=4 if s == 1
+                      else 2)
+
+
+class TestReplicaFaultMatrix:
+    """The ISSUE 8 matrix: (kill=raise, hang, nan, preempt) on one of
+    N=2 replicas x (fifo, deadline) x S in {1, 4}. Tokens bitwise the
+    fault-free single-engine run's; ledgers exact."""
+
+    @pytest.mark.parametrize("kind", ["hang", "raise", "nan",
+                                      "preempt"])
+    @pytest.mark.parametrize("policy", ["fifo", "deadline"])
+    @pytest.mark.parametrize("s", [1, 4])
+    def test_matrix(self, params, baselines, kind, policy, s):
+        plan = FaultPlan([point_for(kind, s)])
+        router, sched, fleet = build_fleet(params, s=s, policy=policy)
+        results = run_fleet(router, sched, fleet, make_requests(),
+                            plan=plan)
+        assert len(plan.fired) == 1, plan.fired
+        fleet.on_fault_injected(len(plan.fired))
+        # parity: the fault is invisible in every request's output
+        assert set(results) == set(baselines[s])
+        for rid, (toks, reason) in baselines[s].items():
+            assert list(results[rid][0]) == list(toks), \
+                f"rid={rid} kind={kind}"
+            assert results[rid][1] == reason, f"rid={rid}"
+        s_ = fleet.summary()
+        # injected == survived, fleet-wide
+        assert s_["faults"]["fault_injected"] == 1
+        assert s_["faults"]["fault_survived"] == 1
+        # failed attempts == retries + dead letters (+ hedge absorbs,
+        # zero at th=1)
+        assert (s_["faults"]["retries_total"]
+                + s_["faults"]["dead_letter_total"]
+                == s_["requests"]["failed_attempts"])
+        assert s_["faults"]["dead_letter_total"] == 0
+        if kind == "hang":
+            assert s_["faults"]["watchdog_trips_total"] == 1
+            assert s_["faults"]["retries_total"] == SLOTS
+        elif kind == "raise":
+            assert s_["faults"]["watchdog_trips_total"] == 0
+            assert s_["faults"]["retries_total"] == SLOTS
+        elif kind == "nan":
+            assert s_["faults"]["retries_total"] == 1
+        else:  # preempt: migration, not retry — and the replica left
+            assert s_["faults"]["retries_total"] == 0
+            assert s_["requests"]["failed_attempts"] == 0
+            assert s_["lag"]["retired_total"] == 1
+            assert router.replicas[0].retired
+            assert router.replicas[1].engine.decode_dispatches > 0
+            assert router.drained == []  # migrated, never parked
+
+    def test_survivors_compile_nothing(self, params, baselines):
+        """Zero post-warmup recompiles on the survivors: with every
+        program warmed (baselines fixture — engines share jit caches
+        because every replica runs the same shapes), an entire faulted
+        fleet run — trip, rebuild, failover retries, churn — compiles
+        zero programs."""
+        from akka_allreduce_tpu.analysis.recompile import no_recompiles
+        plan = FaultPlan([point_for("hang", 1)])
+        router, sched, fleet = build_fleet(params)
+        with no_recompiles("replica failover at warmed shapes"):
+            results = run_fleet(router, sched, fleet, make_requests(),
+                                plan=plan)
+        assert router.replicas[0].engine.watchdog_trips == 1
+        for rid, (toks, _reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks)
+
+
+# -- straggler shedding ---------------------------------------------------
+
+
+class TestStragglerShedding:
+    def test_degrade_shed_readmit(self, params, baselines):
+        """Replica 0's dispatches raise for a stretch: it falls more
+        than max_lag rounds behind, degrades (admissions shed to
+        replica 1), then earns readmission by completing a probe — and
+        every request still finishes with fault-free tokens."""
+        router, sched, fleet = build_fleet(
+            params, max_lag=1, watchdog=None, max_attempts=10)
+        plan = FaultPlan([FaultPoint("replica0.dispatch", "raise",
+                                     hit=2, times=6)])
+        results = run_fleet(router, sched, fleet,
+                            make_requests(n=10, budget=3), plan=plan)
+        assert len(plan.fired) == 6
+        engine = ServingEngine(params, CFG,
+                               EngineConfig(num_slots=SLOTS))
+        sched1 = RequestScheduler(SchedulerConfig(), num_slots=SLOTS)
+        for r in make_requests(n=10, budget=3):
+            sched1.submit(r)
+        base = serve_loop(engine, sched1, max_dispatches=2000)
+        for rid, (toks, reason) in base.items():
+            assert list(results[rid][0]) == list(toks), f"rid={rid}"
+            assert results[rid][1] == reason
+        s = fleet.summary()
+        assert s["lag"]["degraded_total"] >= 1
+        assert s["lag"]["shed_admissions_total"] >= 1
+        assert s["lag"]["readmitted_total"] >= 1
+        status = router.fleet_status()
+        assert status["degraded"] == [False, False]  # recovered
+        assert status["shed_events"][0] >= 1
+        assert status["shed_events"][1] == 0
+
+    def test_probe_keeps_degraded_replica_reachable(self, params):
+        """All-degraded fleet liveness: a single degraded replica still
+        takes one probe admission per round, so work cannot wedge."""
+        router, sched, fleet = build_fleet(
+            params, replicas=1, th=1, max_lag=1, watchdog=None,
+            max_attempts=8)
+        plan = FaultPlan([FaultPoint("replica0.dispatch", "raise",
+                                     hit=1, times=3)])
+        results = run_fleet(router, sched, fleet, make_requests(n=2),
+                            plan=plan)
+        assert len(results) == 2
+        assert all(reason in ("eos", "stop", "max_tokens")
+                   for _, reason in results.values())
+        assert fleet.summary()["lag"]["degraded_total"] >= 1
+
+
+# -- fleet drain / migration ---------------------------------------------
+
+
+class TestFleetDrain:
+    def test_fleet_preempt_drains_everything(self, params, baselines):
+        """A router-level preemption (SIGTERM's injected twin) drains
+        EVERY replica; restoring the snapshots into a fresh fleet
+        finishes the queue with bitwise parity — the restart
+        choreography at fleet scope."""
+        router, sched, fleet = build_fleet(params, watchdog=None)
+        plan = FaultPlan([FaultPoint("router.loop", "preempt", hit=4)])
+        results = run_fleet(router, sched, fleet, make_requests(),
+                            plan=plan)
+        assert router.draining
+        assert len(router.drained) > 0
+        # fresh fleet, same scheduler (unfinished queue rides along)
+        engines = [ServingEngine(
+            params, CFG, EngineConfig(num_slots=SLOTS))
+            for _ in range(REPLICAS)]
+        router2 = ReplicaRouter(engines, sched, RouterConfig(),
+                                fleet=None)
+        results.update(router2.run(resume=router.drained,
+                                   max_rounds=3000))
+        for rid, (toks, reason) in baselines[1].items():
+            assert list(results[rid][0]) == list(toks), f"rid={rid}"
+            assert results[rid][1] == reason
+
+
+# -- fleet metrics --------------------------------------------------------
+
+
+class TestFleetMetricsSurface:
+    def test_scrape_equals_summary_with_replica_labels(self, params,
+                                                       baselines):
+        from akka_allreduce_tpu.telemetry import parse_prometheus_text
+        router, sched, fleet = build_fleet(params, th=2, watchdog=None)
+        run_fleet(router, sched, fleet, make_requests())
+        prom = parse_prometheus_text(
+            fleet.registry.to_prometheus_text())
+        # per-replica labeled series == per-replica summary
+        for i, m in enumerate(fleet.replicas):
+            got = prom.get(("serve_completed_total",
+                            (("replica", str(i)),)))
+            assert got == m.summary()["requests"]["completed"], i
+        # fleet counters == fleet summary
+        s = fleet.summary()
+        assert prom.get(("serve_fleet_completed_total", ())) \
+            == s["requests"]["completed"]
+        assert prom.get(("serve_fleet_hedge_cancelled_total", ())) \
+            == s["hedge"]["cancelled"]
+        # the merged fleet quantiles (Histogram.merge as a pull
+        # collector) == the summary's merged quantiles, exactly
+        for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            got = prom.get(("serve_fleet_ttft_seconds",
+                            (("quantile", q),)))
+            want = s["ttft_ms"][key]
+            assert got is not None and round(got * 1e3, 3) == want, \
+                (q, got, want)
+
+    def test_merge_is_the_aggregation(self, params, baselines):
+        """The fleet TTFT distribution is literally the per-replica
+        histograms merged — pinning the Histogram.merge() call path
+        PR 6 built for this."""
+        router, sched, fleet = build_fleet(params, watchdog=None)
+        run_fleet(router, sched, fleet, make_requests())
+        manual = Histogram()
+        for m in fleet.replicas:
+            manual.merge(m.ttft_s)
+        assert manual.count == sum(m.ttft_s.count
+                                   for m in fleet.replicas)
+        assert manual.count > 0
+        assert fleet.merged("ttft_s").summary() == manual.summary()
+
+    def test_fleet_metrics_validation(self):
+        with pytest.raises(ValueError, match="num_replicas"):
+            FleetMetrics(0)

@@ -417,7 +417,7 @@ class TestRetryBackoffExact:
         assert sched.requeue_failed(req, "nan") is False    # budget out
         assert req.attempts == 3
         assert sched.retries == 2
-        assert sched.dead_letter == [(req, "nan")]
+        assert list(sched.dead_letter) == [(req, "nan")]
         assert sched.drain_dropped() == [(req, "dead_letter")]
 
     def test_retry_survives_full_queue(self):
