@@ -76,6 +76,11 @@ class LintPolicy:
     ``expect_two_phase``: reduce-phase and gather-phase collective
     counts must pair per axis (the windowed-schedule invariant: every
     window's reduce-scatter has its all-gather).
+    ``expect_swing``: the swing short-cut schedule's invariant — the
+    entry must carry exactly this many float-payload ppermute exchange
+    steps per reduce axis (log2 of the group size; a dropped exchange
+    leaves every rank holding a partial sum, the swing analog of an
+    unpaired window). None = not a swing entry, ppermutes unchecked.
     ``wire``: "bf16"/"int8" turn on the wire-dtype discipline (no f32
     payload escapes the compressed wire).
     ``exact_counts``: count/bookkeeping psums must be integer-dtyped
@@ -90,6 +95,7 @@ class LintPolicy:
     known_axes: frozenset = frozenset()
     reduce_axes: Optional[frozenset] = None
     expect_two_phase: bool = False
+    expect_swing: Optional[int] = None
     wire: Optional[str] = None
     exact_counts: bool = False
     expect_donation: bool = False

@@ -62,6 +62,13 @@ collective_int8     quantized_two_phase_allreduce, lossy (masked) via
                     counts
 collective_bf16     bf16-wire lossy allreduce_gradients — wire dtype +
                     exact counts
+collectives_swing   swing_allreduce under shard_map (ISSUE 9) — the
+                    ±2^t exchange schedule: exactly log2(group) float
+                    ppermute hops per reduce axis (expect_swing)
+collectives_ef8     ef8 (block-quantized + error-feedback) lossy
+                    allreduce_gradients with the residual threaded —
+                    int8 wire discipline + exact counts + rs/ag
+                    pairing on the two-phase structure
 ==================  =================================================
 """
 
@@ -513,6 +520,71 @@ def build_collective_bf16() -> LintContext:
     return _lossy_sync_entry("collective_bf16", "bf16", {})
 
 
+def build_collectives_swing() -> LintContext:
+    """The swing short-cut schedule (ISSUE 9): ``swing_allreduce``
+    under a dp=2 shard_map. The collective-axis pass checks the swing
+    invariant — exactly log2(group) float-payload ppermute exchange
+    steps over the reduce axis (``expect_swing``); a refactor dropping
+    one exchange fails here before it can leave every rank holding a
+    partial sum (the swing analog of the unpaired-window lint)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.ops.collectives import swing_allreduce
+    mesh = _mesh(dp=2)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        return swing_allreduce(stacked[0], "dp")[None]
+
+    x = jnp.zeros((2, 4, _BUCKET_ELEMS), jnp.float32)
+    policy = LintPolicy(known_axes=_mesh_axes(mesh),
+                        reduce_axes=frozenset({"dp"}),
+                        expect_swing=1)  # log2(2)
+    return trace_entry("collectives_swing", entry, (x,), policy,
+                       lower=False)
+
+
+def build_collectives_ef8() -> LintContext:
+    """The error-feedback wire (ISSUE 9): lossy ``allreduce_gradients``
+    on the ef8 transport with the residual state threaded through —
+    int8 wire discipline (block scales are small f32 side-cars, not
+    payload escapes), exact int32 counts, and rs/ag pairing on the
+    two-phase structure, like collective_int8 plus the residual."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.ops.bucketing import bucketize
+    from akka_allreduce_tpu.parallel.dp import (GradSyncConfig,
+                                                allreduce_gradients)
+    mesh = _mesh(dp=2)
+    grads = {"w": jnp.zeros((_D_MODEL, _D_MODEL), jnp.float32),
+             "b": jnp.zeros((_D_MODEL,), jnp.float32)}
+    sync = GradSyncConfig(bucket_elems=_BUCKET_ELEMS, axis_name="dp",
+                          transport="ef8",
+                          return_elem_counts=False)
+    buckets, spec = bucketize(grads, sync.bucket_elems)
+    valid = jnp.ones((spec.num_buckets,), jnp.float32)
+    residual = jnp.zeros(buckets.shape, jnp.float32)
+    key = jax.random.key(0)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P(), P()),
+             out_specs=(P(), P(), P()), check_vma=False)
+    def entry(tree, valid, key, residual):
+        out = allreduce_gradients(tree, sync, valid=valid,
+                                  quant_key=key, residual=residual)
+        return out.grads, out.bucket_counts, out.residual
+
+    policy = LintPolicy(known_axes=_mesh_axes(mesh),
+                        reduce_axes=frozenset({"dp"}),
+                        exact_counts=True, wire="int8",
+                        expect_two_phase=True)
+    return trace_entry("collectives_ef8", entry,
+                       (grads, valid, key, residual), policy,
+                       lower=False)
+
+
 ENTRYPOINTS = {
     "train_step": build_train_step,
     "train_step_windowed": build_train_step_windowed,
@@ -531,6 +603,8 @@ ENTRYPOINTS = {
     "collective_windowed": build_collective_windowed,
     "collective_int8": build_collective_int8,
     "collective_bf16": build_collective_bf16,
+    "collectives_swing": build_collectives_swing,
+    "collectives_ef8": build_collectives_ef8,
 }
 
 

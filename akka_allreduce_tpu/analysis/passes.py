@@ -52,12 +52,15 @@ def _is_float(dtype) -> bool:
 
 @lint_pass("collective-axis")
 def collective_axis_pass(ctx: LintContext) -> list:
-    """Axis existence, reduction-axis discipline, two-phase pairing."""
+    """Axis existence, reduction-axis discipline, two-phase pairing,
+    swing exchange-count (ISSUE 9)."""
     findings = []
     pol = ctx.policy
     # per-axis phase tallies for the pairing check
     reduce_count: dict = {}
     gather_count: dict = {}
+    # per-axis float-payload ppermute tally for the swing check
+    exchange_count: dict = {}
     for eqn, _in_loop in iter_eqns(ctx.jaxpr):
         prim = eqn.primitive.name
         if prim not in COLLECTIVE_PRIMS:
@@ -92,6 +95,29 @@ def collective_axis_pass(ctx: LintContext) -> list:
         if prim in GATHER_PHASE_PRIMS:
             for ax in axes:
                 gather_count[ax] = gather_count.get(ax, 0) + 1
+        if prim == "ppermute" and _is_float(dtype):
+            # swing exchanges ride ppermute with a FLOAT payload (f32/
+            # bf16 wires; the int8 wire's values travel int8 but its
+            # scales are f32 — one float ppermute per exchange either
+            # way), so the tally counts exactly the schedule's hops
+            for ax in axes:
+                exchange_count[ax] = exchange_count.get(ax, 0) + 1
+    if pol.expect_swing is not None:
+        # the swing invariant: every reduce axis carries exactly
+        # log2(group) exchange steps — one missing leaves every rank a
+        # partial sum (the swing analog of an unpaired window), one
+        # extra double-counts a subgroup
+        for ax in sorted(pol.reduce_axes or exchange_count):
+            got = exchange_count.get(ax, 0)
+            if got != pol.expect_swing:
+                findings.append(Finding(
+                    "collective-axis", "error", ctx.name,
+                    f"swing schedule over axis {ax!r} carries {got} "
+                    f"float-payload exchange step(s), expected "
+                    f"{pol.expect_swing} (log2 of the group size): a "
+                    f"dropped ±2^t exchange leaves every rank holding "
+                    f"a partial sum; an extra one double-counts a "
+                    f"subgroup", f"axis {ax}"))
     if pol.expect_two_phase:
         for ax in sorted(set(reduce_count) | set(gather_count)):
             r, g = reduce_count.get(ax, 0), gather_count.get(ax, 0)

@@ -89,6 +89,37 @@ def fixture_unpaired_window():
                        lower=False)
 
 
+def fixture_swing_dropped_exchange():
+    """A swing schedule missing one ±2^t exchange step: the dp axis has
+    4 ranks (log2 = 2 exchanges required) but only the distance-1 hop
+    runs — every rank ends holding a HALF-group sum that looks complete
+    (right shape, plausible values), the swing analog of the unpaired
+    window."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                  make_device_mesh)
+    mesh = make_device_mesh(MeshSpec(dp=4), devices=jax.devices()[:4])
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        x = stacked[0]
+        # BUG: only the t=0 exchange; the t=1 (distance-2) hop forgotten
+        x = x + lax.ppermute(x, "dp", [(j, j ^ 1) for j in range(4)])
+        return x[None]
+
+    x = jnp.zeros((4, 8), jnp.float32)
+    policy = LintPolicy(known_axes=_axes(mesh),
+                        reduce_axes=frozenset({"dp"}),
+                        expect_swing=2)  # log2(4)
+    return trace_entry("fixture_swing_dropped_exchange", entry, (x,),
+                       policy, lower=False)
+
+
 def fixture_dropped_donation():
     """donate_argnums declared, but no output matches the donated
     buffer's dtype — XLA copies silently; the HBM saving never happens."""
@@ -239,6 +270,8 @@ FIXTURES = [
     ("bad_axis", fixture_bad_axis, "collective-axis", "error"),
     ("unpaired_window", fixture_unpaired_window, "collective-axis",
      "error"),
+    ("swing_dropped_exchange", fixture_swing_dropped_exchange,
+     "collective-axis", "error"),
     ("dropped_donation", fixture_dropped_donation, "donation", "error"),
     ("missing_donation", fixture_missing_donation, "donation", "error"),
     ("f32_leak", fixture_f32_leak, "dtype", "error"),

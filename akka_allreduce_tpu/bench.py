@@ -367,6 +367,177 @@ def measure_ab_overlap(windows=AB_OVERLAP_WINDOWS,
                         + flags_note}
 
 
+# canonical quantized/topology A/B payloads (ISSUE 9): the same small
+# (2.5M float) and ResNet-50-sized (25M float) rows the overlap A/B
+# uses, lane-aligned buckets
+QUANTIZED_AB_PAYLOADS = ((2_500_000, 327_680),
+                         (25_000_000, BUCKET_ELEMS_ALIGNED))
+
+
+def measure_quantized_collectives(payloads=QUANTIZED_AB_PAYLOADS,
+                                  r_hi: Optional[int] = None,
+                                  r_lo: Optional[int] = None,
+                                  reps: Optional[int] = None):
+    """The ISSUE 9 gradient-sync transport A/B: the fused f32 psum
+    baseline vs (a) the Swing short-cut schedule (f32 payload, ±2^t
+    exchange steps — log2(n) latency-bound hops instead of the
+    two-phase's O(n)) and (b) the ef8 wire (EQuARX-style block-quantized
+    int8 with error feedback — ~4x fewer wire bytes, the residual
+    carried through the round chain exactly as training carries it
+    through the scan). YIELDS one JSON-able row per (payload, arm) plus
+    the gated ``quantized_collectives_{swing,ef8}_speedup_*`` claim
+    rows, generator-style like measure_ab_overlap (a watchdog SIGKILL
+    loses only the in-flight measurement).
+
+    Methodology matches the goodput bench: all rounds inside one jitted
+    lax.scan, CHAINED through the carry (round r+1 consumes round r's
+    reduced mean through an abs() — no cross-round collapse, magnitude
+    stable because the sync averages), two-point delta timing,
+    best-of-reps. The ef8 arm threads the residual through the scan
+    carry and draws a fresh fold_in key per round — the production
+    shape, so its quantize/dequantize cost is charged honestly.
+
+    On one device every arm is the identity sync (size-1 bypass); rows
+    still bank with the degradation named in the note. Swing needs a
+    power-of-two group: other sizes bank an error row for the swing
+    arm and keep the rest."""
+    from akka_allreduce_tpu.ops.bucketing import tree_bucket_spec
+
+    _log("quantized_collectives: initializing backend ...")
+    devices = jax.devices()
+    n = len(devices)
+    plat = devices[0].platform
+    label = "chip" if plat == "tpu" else plat
+    on_tpu = plat == "tpu"
+    if r_hi is None:
+        r_hi = 60 if on_tpu else 6
+    if r_lo is None:
+        r_lo = max(1, r_hi // 4)
+    if reps is None:
+        reps = 3 if on_tpu else 2
+    mesh = single_axis_mesh("dp", devices=devices)
+    pow2 = n & (n - 1) == 0
+    ident = ("; 1-device: schedule identity — every arm IS the fused "
+             "path, deltas are jitter" if n == 1 else "")
+
+    def make(arm, elems, bucket, rounds):
+        nb = tree_bucket_spec(
+            {"g": jax.ShapeDtypeStruct((elems,), jnp.float32)},
+            bucket).num_buckets
+        ef = arm == "ef8"
+        cfg = GradSyncConfig(
+            bucket_elems=bucket, average=True, rescale_target=1.0,
+            return_elem_counts=False,
+            transport="ef8" if ef else "f32",
+            transport_schedule="swing" if arm == "swing" else "fused")
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P("dp"), P("dp")), out_specs=P("dp"),
+                 check_vma=False)
+        def run(x0, resid0):
+            base_key = jax.random.key(11)
+
+            def one(carry, i):
+                x, r = carry
+                # chained non-linear consumption: round i+1's input is
+                # round i's reduced MEAN through abs() — XLA cannot
+                # collapse the chain, and averaging keeps |x| stable
+                # over any round count
+                g = {"g": jnp.abs(x) + 1e-12}
+                res = allreduce_gradients(
+                    g, cfg,
+                    quant_key=(jax.random.fold_in(base_key, i)
+                               if ef else None),
+                    residual=(r if ef else None))
+                return (res.grads["g"],
+                        res.residual if ef else r), None
+
+            (xf, _), _ = lax.scan(
+                one, (x0[0], resid0[0]),
+                jnp.arange(rounds, dtype=jnp.uint32))
+            return xf[None]
+
+        x0 = jnp.zeros((n, elems), jnp.float32)
+        # only the ef8 arm reads the residual: the other arms carry a
+        # scalar-sized dummy so a payload-sized dead buffer never rides
+        # (or doubles the HBM of) the fused/swing measurements
+        resid0 = (jnp.zeros((n, nb, bucket), jnp.float32) if ef
+                  else jnp.zeros((n, 1, 1), jnp.float32))
+        return jax.jit(run), x0, resid0
+
+    def arm_goodput(arm, elems, bucket):
+        def measure(rounds):
+            f, x0, resid0 = make(arm, elems, bucket, rounds)
+            np.asarray(f(x0, resid0).addressable_shards[0]
+                       .data[0, :4])  # compile + warm
+            ts = []
+            for i in range(reps):
+                t0 = time.perf_counter()
+                out = f(x0 + float(i) * 1e-3, resid0)
+                np.asarray(out.addressable_shards[0].data[0, :4])
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        per_round = (measure(r_hi) - measure(r_lo)) / (r_hi - r_lo)
+        if per_round <= 0:
+            wide = 4 * r_hi
+            _log(f"quantized_collectives: non-positive delta for "
+                 f"{arm}; widening span to {wide}")
+            per_round = (measure(wide) - measure(r_lo)) / (wide - r_lo)
+        if per_round <= 0:
+            raise RuntimeError(
+                f"two-point timing failed twice for {arm}: relay too "
+                f"noisy for this workload size")
+        return elems * 4 / per_round / 1e9
+
+    arm_notes = {
+        "fused": "fused f32 psum (the baseline)",
+        "swing": "swing ±2^t exchange schedule, f32 payload, "
+                 "log2(n) hops",
+        "ef8": "block-quantized int8 + error feedback (residual through "
+               "the scan carry, fresh key per round), fused two-phase",
+    }
+    for elems, bucket in payloads:
+        mega = f"{elems / 1_000_000:g}"
+        base = None
+        for arm in ("fused", "swing", "ef8"):
+            if arm == "swing" and not pow2:
+                yield {"metric":
+                       f"quantized_collectives_swing_{mega}M_{n}{label}",
+                       "value": 0.0, "unit": "GB/s",
+                       "error": f"swing needs a power-of-two group, "
+                                f"got {n} devices"}
+                continue
+            _log(f"quantized_collectives: {arm} @ {mega}M on "
+                 f"{n} {label}(s)")
+            try:
+                g = arm_goodput(arm, elems, bucket)
+            except Exception as e:  # noqa: BLE001 — bank, move on
+                yield {"metric":
+                       f"quantized_collectives_{arm}_{mega}M_{n}{label}",
+                       "value": 0.0, "unit": "GB/s",
+                       "error": f"{type(e).__name__}: {e}"}
+                continue
+            yield {"metric":
+                   f"quantized_collectives_{arm}_{mega}M_{n}{label}",
+                   "value": round(g, 3), "unit": "GB/s",
+                   "note": f"{arm_notes[arm]}, buckets of {bucket}"
+                           + ident}
+            if arm == "fused":
+                base = g
+            elif base:
+                # the gated claim rows: transport goodput as a fraction
+                # of the fused psum on the same box in the same run —
+                # a REGRESSION gate on the transports' cost (on CPU and
+                # single chips the schedules cannot win; what the gate
+                # holds is that they do not silently get MORE expensive)
+                yield {"metric":
+                       f"quantized_collectives_{arm}_speedup_{mega}M",
+                       "value": round(g / base, 3), "unit": "x",
+                       "note": f"{arm} vs fused psum at {mega}M floats "
+                               f"({n}{label}){ident}"}
+
+
 def measure_train_mfu(compute_dtype: str = "bf16",
                       d_model: int = 2048, n_layers: int = 8,
                       d_ff: int = 8192, vocab: int = 32768,

@@ -526,18 +526,42 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "quantizer state, works over any axis "
                         "combination (int8 needs a single data axis); "
                         "masters/optimizer stay f32")
-    p.add_argument("--grad-schedule", choices=("fused", "windowed"),
+    p.add_argument("--grad-quant",
+                   choices=("none", "bf16", "int8", "ef8"), default=None,
+                   help="gradient-wire quantization, the one flag for "
+                        "every wire format (supersedes --int8-grads/"
+                        "--bf16-grads, which remain as aliases): none "
+                        "= f32; bf16 / int8 as the legacy flags; ef8 = "
+                        "EQuARX-style block-quantized int8 WITH error "
+                        "feedback (ISSUE 9) — block-wise scales confine "
+                        "outliers to one 512-column block, and the "
+                        "quantization error is carried in a persistent "
+                        "residual added back before the next round's "
+                        "quantize, so compression error is compensated "
+                        "across steps. The residual is training state: "
+                        "checkpointed as its own 'sync' item, restored "
+                        "on resume (bitwise), carried through "
+                        "--grad-accum/--accum-schedule overlap and "
+                        "--steps-per-dispatch scan carries. Single >1 "
+                        "data axis; dense models only (no --moe-experts)")
+    p.add_argument("--grad-schedule",
+                   choices=("fused", "windowed", "swing"),
                    default="fused",
                    help="gradient-collective schedule: fused (one "
-                        "monolithic collective per sync) or windowed "
+                        "monolithic collective per sync); windowed "
                         "(bucket axis split into --grad-windows windows "
                         "issued on the software-pipelined schedule of "
                         "ops/collectives.pipelined_two_phase_allreduce "
                         "so one window's all-gather overlaps the next's "
                         "reduce-scatter; pair with --xla-overlap on "
-                        "TPU). Needs a single >1 data axis; f32/bf16 "
-                        "wires need --bucket-elems divisible by its "
-                        "size")
+                        "TPU); or swing (ISSUE 9: the ±2^t short-cut "
+                        "exchange schedule — log2(n) latency-bound "
+                        "steps instead of the two-phase's O(n), the "
+                        "mid-size-payload winner; composes with every "
+                        "--grad-quant wire). Needs a single >1 data "
+                        "axis (swing: power-of-two size); ragged "
+                        "bucket geometry pads internally on every "
+                        "schedule (ops/collectives.py pad-and-trim)")
     p.add_argument("--grad-windows", type=int, default=4, metavar="W",
                    help="window count for --grad-schedule windowed "
                         "(the bucket axis pads to a multiple of W)")
@@ -1145,23 +1169,23 @@ def _cmd_generate(args: argparse.Namespace) -> int:
 
 
 def _two_phase_geometry_error(feature: str, data_axes: dict,
-                              bucket_elems: int, remedy: str,
-                              check_divisibility: bool = True,
-                              wire: str = "") -> "str | None":
-    """Validate the two-phase (reduce-scatter + all-gather) collective
-    geometry a train flag demands: exactly one >1 data axis and, when
-    ``check_divisibility`` (the wire scatters bucket rows directly), a
-    bucket length that axis's size divides. Returns the error message to
-    print, or None when the geometry holds."""
+                              remedy: str, wire: str = "",
+                              power_of_two: bool = False) -> "str | None":
+    """Validate the collective geometry a train flag demands: exactly
+    one >1 data axis (two-phase and swing schedules alike), and for the
+    swing schedule a power-of-two axis size (the ±2^t pairing). Bucket
+    divisibility is no longer a constraint — every schedule pads and
+    trims internally (ops/collectives.py, ISSUE 9 satellite). Returns
+    the error message to print, or None when the geometry holds."""
     wide = [f"{k}={v}" for k, v in data_axes.items() if v > 1]
     if len(wide) > 1:
         return (f"{feature} needs a single >1 data axis, got "
                 f"{' '.join(wide)}; {remedy}")
     axis_size = max(data_axes.values())
-    if check_divisibility and axis_size > 1 and bucket_elems % axis_size:
-        return (f"{feature}{f' with a {wire} wire' if wire else ''} needs "
-                f"--bucket-elems divisible by the data-axis size "
-                f"{axis_size}, got {bucket_elems}")
+    if power_of_two and axis_size & (axis_size - 1):
+        return (f"{feature}{f' with a {wire} wire' if wire else ''} "
+                f"needs a power-of-two data-axis size (the ±2^t "
+                f"exchange pairing), got {axis_size}; {remedy}")
     return None
 
 
@@ -1226,34 +1250,56 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print("error: pick ONE gradient wire: --int8-grads or "
               "--bf16-grads", file=sys.stderr)
         return 2
-    grad_wire = ("int8" if args.int8_grads
-                 else "bf16" if args.bf16_grads else "f32")
+    legacy_wire = ("int8" if args.int8_grads
+                   else "bf16" if args.bf16_grads else None)
+    if args.grad_quant is not None:
+        grad_wire = "f32" if args.grad_quant == "none" else args.grad_quant
+        if legacy_wire is not None and legacy_wire != grad_wire:
+            print(f"error: --grad-quant {args.grad_quant} contradicts "
+                  f"--{legacy_wire}-grads — drop the legacy flag "
+                  f"(--grad-quant is the one spelling)", file=sys.stderr)
+            return 2
+    else:
+        grad_wire = legacy_wire or "f32"
     # fail at the flag layer with the mesh math spelled out, not deep
-    # inside shard_map tracing: both the int8 transport and the windowed
-    # schedule run the two-phase (reduce-scatter + all-gather) geometry —
-    # exactly one >1 data axis and, when the wire scatters bucket rows,
-    # a bucket length its size divides (parallel/dp.py, ops/collectives.py)
+    # inside shard_map tracing: the quantized transports and the
+    # windowed/swing schedules all need exactly one >1 data axis (and
+    # swing a power-of-two one); bucket geometry pads internally on
+    # every schedule (parallel/dp.py, ops/collectives.py)
     data_axes = {"dp": dp, "sp": args.sp, "ep": args.ep}
-    if args.int8_grads:
+    if grad_wire in ("int8", "ef8"):
         err = _two_phase_geometry_error(
-            "--int8-grads", data_axes, args.bucket_elems,
-            remedy="use f32 transport or fold the parallelism into dp")
+            f"--grad-quant {grad_wire}", data_axes,
+            remedy="use f32/bf16 transport or fold the parallelism "
+                   "into dp")
         if err:
             print(f"error: {err}", file=sys.stderr)
+            return 2
+    if grad_wire == "ef8":
+        if args.moe_experts:
+            print("error: --grad-quant ef8 does not yet compose with "
+                  "--moe-experts (the ep-owned expert sync would need "
+                  "its own residual plane) — use int8 for MoE models",
+                  file=sys.stderr)
+            return 2
+        if args.coordinator or args.deadline_ms > 0:
+            print("error: --grad-quant ef8 does not yet compose with "
+                  "the deadline/hybrid paths (--deadline-ms / "
+                  "--coordinator): their trainers do not thread the "
+                  "residual state — use int8 there, or run ef8 on the "
+                  "exact single-process path", file=sys.stderr)
             return 2
     if args.grad_windows < 1:
         print(f"error: --grad-windows must be >= 1, got "
               f"{args.grad_windows}", file=sys.stderr)
         return 2
-    if args.grad_schedule == "windowed":
+    if args.grad_schedule in ("windowed", "swing"):
         err = _two_phase_geometry_error(
-            "--grad-schedule windowed", data_axes, args.bucket_elems,
+            f"--grad-schedule {args.grad_schedule}", data_axes,
             remedy="fold the parallelism into dp or use "
                    "--grad-schedule fused",
-            # the int8 wire pads its own rows; only f32/bf16 scatter
-            # bucket rows directly and need the divisibility
-            check_divisibility=grad_wire != "int8",
-            wire=grad_wire)
+            wire=grad_wire,
+            power_of_two=args.grad_schedule == "swing")
         if err:
             print(f"error: {err}", file=sys.stderr)
             return 2
@@ -1371,6 +1417,11 @@ def _cmd_train(args: argparse.Namespace) -> int:
               f"1f1b {st['1f1b']['bubble_fraction']:.1%} (resident "
               f"{st['1f1b']['resident_microbatches']})")
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
+    # ef8 error-feedback residual: explicit training state next to
+    # params/opt_state (None for every other wire) — the step consumes
+    # and returns it, the checkpoint stores it as the 'sync' item
+    from akka_allreduce_tpu.models.train import init_ef_state
+    ef_state = init_ef_state(cfg, mesh, params)
     if args.ema_decay > 0:
         from akka_allreduce_tpu.models.train import get_ema_params
         ema_of = get_ema_params  # extraction only — no copy
@@ -1430,6 +1481,29 @@ def _cmd_train(args: argparse.Namespace) -> int:
         if start and chatty:
             print(f"resumed from step {start - 1} "
                   f"(data position {extra.get('data_step', '?')})")
+        if start and ef_state is not None:
+            # the ef8 residual's own item: restoring it makes the
+            # resumed run bitwise the uninterrupted one; a checkpoint
+            # without it (pre-ef8, or saved under another wire)
+            # restarts the accumulator at zero — safe, narrated
+            try:
+                _, out, _ = mgr.restore_params(
+                    {"residual": ef_state}, step=start - 1, item="sync")
+                ef_state = out["residual"]
+                if chatty:
+                    print("restored ef8 error-feedback residual "
+                          "('sync' item)")
+            except (KeyError, ValueError, FileNotFoundError) as exc:
+                # a genuinely ABSENT item (pre-ef8 checkpoint, or one
+                # saved under another wire) restarts the accumulator at
+                # zero — safe, narrated. Anything else (corrupt item,
+                # I/O error) PROPAGATES: silently zeroing the residual
+                # there would hand the operator a non-bitwise resume
+                # while the runbook promises a bitwise one
+                if chatty:
+                    print(f"note: no restorable 'sync' item at step "
+                          f"{start - 1} ({type(exc).__name__}); ef8 "
+                          f"residual restarts at zero")
         if hybrid and not chatty:
             # hybrid params are replicated per process: every process
             # restores, only process 0 writes (one writer per directory)
@@ -1695,8 +1769,13 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     chunk_np = np.stack(
                         [build_batch(j)[1] for j in range(i, i + n)])
                     with telem.step_span(chunk_steps=n) as ds:
-                        params, opt_state, ms = multi(
-                            params, opt_state, jnp.asarray(chunk_np))
+                        if ef_state is None:
+                            params, opt_state, ms = multi(
+                                params, opt_state, jnp.asarray(chunk_np))
+                        else:
+                            params, opt_state, ms, ef_state = multi(
+                                params, opt_state, jnp.asarray(chunk_np),
+                                ef_state)
                         if ds is not None:
                             ds.mark_dispatched()
                             # block inside the span: the tail of the
@@ -1707,9 +1786,15 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     # per-step program instead of a second scan compile
                     for j in range(i, i + n):
                         with telem.step_span(step=j) as ds:
-                            params, opt_state, m1 = step(
-                                params, opt_state,
-                                jnp.asarray(build_batch(j)[1]))
+                            if ef_state is None:
+                                params, opt_state, m1 = step(
+                                    params, opt_state,
+                                    jnp.asarray(build_batch(j)[1]))
+                            else:
+                                params, opt_state, m1, ef_state = step(
+                                    params, opt_state,
+                                    jnp.asarray(build_batch(j)[1]),
+                                    ef_state)
                             if ds is not None:
                                 ds.mark_dispatched()
                                 # scalar readback, not block_until_ready
@@ -1735,7 +1820,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     # stays paired with the params actually holding it
                     mgr.save(last, params, opt_state,
                              {"data_step": last}, force=True,
-                             ema=ema_of(opt_state))
+                             ema=ema_of(opt_state),
+                             sync=None if ef_state is None else
+                             {"residual": ef_state})
                 steps_in_window += n
                 if i == start or (i // args.log_every
                                   != (last + 1) // args.log_every):
@@ -1776,6 +1863,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                             * trainer.clock.deadline_s)
                     params, opt_state, metrics = trainer.run_round(
                         params, opt_state, tokens)
+                elif ef_state is not None:
+                    params, opt_state, metrics, ef_state = step(
+                        params, opt_state, tokens, ef_state)
                 else:
                     params, opt_state, metrics = step(params, opt_state,
                                                       tokens)
@@ -1795,7 +1885,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 guard.__enter__()
             if mgr is not None:
                 mgr.maybe_save(i, params, opt_state, {"data_step": i},
-                               ema=ema_of(opt_state))
+                               ema=ema_of(opt_state),
+                               sync=None if ef_state is None else
+                               {"residual": ef_state})
             steps_in_window += 1
             if i == start or (i + 1) % args.log_every == 0:
                 loss = float(jax.block_until_ready(metrics["loss"]))
@@ -1826,7 +1918,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
             if args.steps > start and mgr.latest_step() != final:
                 mgr.save(final, params, opt_state,
                          {"data_step": final}, force=True,
-                         ema=ema_of(opt_state))
+                         ema=ema_of(opt_state),
+                         sync=None if ef_state is None else
+                         {"residual": ef_state})
     finally:
         if guard is not None:
             guard.__exit__(None, None, None)
@@ -3384,7 +3478,11 @@ def _add_perfgate(sub: argparse._SubParsersAction) -> None:
                    default="serving_throughput,multi_step_decode",
                    help="comma list of sections to gate (known: "
                         "serving_throughput, multi_step_decode, "
-                        "ab_overlap). Sections with no banked rows "
+                        "paged_serving, replicated_serving, "
+                        "ab_overlap, quantized_collectives — the last "
+                        "wants XLA_FLAGS=--xla_force_host_platform_"
+                        "device_count=8 on CPU or every arm is the "
+                        "identity sync). Sections with no banked rows "
                         "skip with a note — the gate guards banked "
                         "claims, it does not invent them")
     p.add_argument("--tolerance", type=float, default=None,

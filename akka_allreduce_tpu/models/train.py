@@ -88,8 +88,17 @@ class TrainConfig:
     # — see parallel/pp.py pp_schedule_stats for the economics)
     pp_schedule: str = "gpipe"
     # gradient-sync wire format: "f32"; "bf16" (half the collective
-    # bytes, plain rounding, any axis combination); or "int8" (quantized
-    # two-phase allreduce — needs exactly one data axis of size > 1)
+    # bytes, plain rounding, any axis combination); "int8" (quantized
+    # two-phase allreduce — needs exactly one data axis of size > 1);
+    # or "ef8" (ISSUE 9: block-scale int8 WITH error feedback — the
+    # quantization error is captured in a persistent residual, added
+    # back before the next round's quantize, so compression error is
+    # compensated across steps. The residual is explicit training
+    # state: init_ef_state() builds it, the train step takes and
+    # returns it — including through the accum_schedule="overlap" scan
+    # carry — and the checkpoint stores it as its own 'sync' item.
+    # Dense models only for now: the ep-owned expert sync would need a
+    # second residual plane)
     grad_transport: str = "f32"
     # Collective schedule for the gradient sync (GradSyncConfig.
     # transport_schedule): "fused" issues one monolithic collective per
@@ -97,8 +106,11 @@ class TrainConfig:
     # and software-pipelines them (ops/collectives.
     # pipelined_two_phase_allreduce) so one window's all-gather overlaps
     # the next's reduce-scatter under XLA's latency-hiding scheduler
-    # (runtime/xla_flags.py). Windowed needs a single (>1) data axis and,
-    # for f32/bf16 wires, bucket_elems divisible by its size.
+    # (runtime/xla_flags.py); "swing" (ISSUE 9) runs the ±2^t short-cut
+    # exchange schedule — log2(n) latency-bound steps instead of the
+    # two-phase's O(n), the mid-size-payload winner (DESIGN.md §14).
+    # Windowed/swing need a single (>1) data axis (swing: power-of-two
+    # size); bucket geometry pads internally on every schedule.
     transport_schedule: str = "fused"
     num_windows: int = 4
     # "bf16" runs the model compute (matmuls, activations) in bfloat16 on
@@ -629,7 +641,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     # ep doubles as a data axis (batch sharded over dp x ep): dense params
     # are replicated over it and their grads reduce over it; expert weights
     # are ep-OWNED and reduce over the plain data axes only.
-    dense_axes = cfg.grad_axes + (("ep",) if has_ep else ())
+    dense_axes = _data_axes(cfg, mesh)
     n_dense_ranks = math.prod(mesh.shape.get(a, 1) for a in dense_axes)
     n_expert_ranks = math.prod(mesh.shape.get(a, 1) for a in cfg.grad_axes)
     gcfg = GradSyncConfig(bucket_elems=cfg.bucket_elems,
@@ -646,6 +658,13 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                                  transport=cfg.grad_transport,
                                  transport_schedule=cfg.transport_schedule,
                                  num_windows=cfg.num_windows)
+    use_ef = cfg.grad_transport == "ef8"
+    if use_ef and has_moe:
+        raise ValueError(
+            "grad_transport='ef8' does not yet compose with MoE: the "
+            "ep-owned expert sync would need its own residual plane "
+            "(a second (ranks, buckets, elems) state over different "
+            "axes) — use 'int8' for MoE models, or file the follow-up")
 
     def targets_and_weights(tokens):
         """Per-token next-token targets and loss weights; under sp the
@@ -702,11 +721,11 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         data-dependent may enter the key. Each sync call folds in its own
         tag (sync_and_metrics) so the dense and expert collectives draw
         uncorrelated noise in the same round."""
-        if cfg.grad_transport != "int8":
-            return None  # only the int8 wire rounds stochastically
+        if cfg.grad_transport not in ("int8", "ef8"):
+            return None  # only the quantized wires round stochastically
         return jax.random.fold_in(jax.random.key(17), quant_seed)
 
-    def sync_grads(grads, quant_key, valid=None):
+    def sync_grads(grads, quant_key, valid=None, ef=None):
         # Gradient sync over the data axes: the framework's bucketed,
         # counted collective — THE allreduce the reference exists for.
         # Gradients for tp shards need no sync (tp_grad_boundary completed
@@ -742,10 +761,10 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                                     res_e.bucket_counts.min())
         else:
             res = allreduce_gradients(grads, gcfg, valid=valid,
-                                      quant_key=k_dense)
+                                      quant_key=k_dense, residual=ef)
             grads_out = res.grads
             min_count = res.bucket_counts.min()
-        return grads_out, min_count
+        return grads_out, min_count, res.residual
 
     def make_metrics(loss, aux, total_count, min_count):
         return {
@@ -759,9 +778,13 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         }
 
     def sync_and_metrics(loss, aux, grads, total_count, quant_key,
-                         valid=None):
-        grads_out, min_count = sync_grads(grads, quant_key, valid=valid)
-        return grads_out, make_metrics(loss, aux, total_count, min_count)
+                         valid=None, ef=None):
+        grads_out, min_count, new_ef = sync_grads(grads, quant_key,
+                                                  valid=valid, ef=ef)
+        metrics = make_metrics(loss, aux, total_count, min_count)
+        if use_ef:
+            return grads_out, metrics, new_ef
+        return grads_out, metrics
 
     accum = cfg.grad_accum
     if accum < 1:
@@ -776,7 +799,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             f"(one sync after the microbatch scan) or 'overlap' "
             f"(per-microbatch syncs double-buffered through the carry)")
 
-    def grad_local(params, tokens, quant_seed, valid=None):
+    def grad_local(params, tokens, quant_seed, valid=None, ef=None):
         targets, weights, positions = targets_and_weights(tokens)
         total_count = psum_all(weights.sum(), dense_axes)
 
@@ -838,28 +861,38 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                 zero_l, zero_aux, zero_g = zeros
 
                 def body(carry, xs):
-                    la, auxa, acc, fly, mc = carry
+                    la, auxa, acc, fly, mc, ef_c = carry
                     tok, tgt, w, i = xs
                     (l, aux), g = mb_value_and_grad(tok, tgt, w)
-                    # per-microbatch rounding keys: K int8 syncs in one
-                    # round must draw uncorrelated noise
+                    # per-microbatch rounding keys: K int8/ef8 syncs in
+                    # one round must draw uncorrelated noise
                     kq = None if quant_key is None else \
                         jax.random.fold_in(quant_key, i)
-                    synced, min_c = sync_grads(g, kq, valid=valid)
+                    # the ef8 residual rides the carry: microbatch k's
+                    # sync compensates what microbatch k-1's quantize
+                    # dropped — EF telescopes WITHIN the step exactly
+                    # as it does across steps (ef_c is None on every
+                    # other transport, an empty carry slot)
+                    synced, min_c, ef_c = sync_grads(g, kq, valid=valid,
+                                                     ef=ef_c)
                     # fold the PREVIOUS tick's in-flight result only now
                     acc = jax.tree.map(jnp.add, acc, fly)
                     return (la + l, jax.tree.map(jnp.add, auxa, aux),
-                            acc, synced, jnp.minimum(mc, min_c)), None
+                            acc, synced, jnp.minimum(mc, min_c),
+                            ef_c), None
 
                 init = (zero_l, zero_aux, zero_g, zero_g,
-                        jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32))
-                (loss, aux, acc, fly, min_count), _ = lax.scan(
+                        jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32),
+                        ef)
+                (loss, aux, acc, fly, min_count, ef_out), _ = lax.scan(
                     body, init, (tok_m, tgt_m, w_m,
                                  jnp.arange(accum, dtype=jnp.uint32)))
                 synced_grads = jax.tree.map(jnp.add, acc, fly)
                 aux = jax.tree.map(lambda x: x / accum, aux)
-                return synced_grads, make_metrics(loss, aux, total_count,
-                                                  min_count)
+                metrics = make_metrics(loss, aux, total_count, min_count)
+                if use_ef:
+                    return synced_grads, metrics, ef_out
+                return synced_grads, metrics
 
             def body(carry, xs):
                 la, auxa, ga = carry
@@ -873,9 +906,9 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             aux = jax.tree.map(lambda x: x / accum, aux)
         return sync_and_metrics(loss, aux, grads, total_count,
                                 derive_quant_key(quant_seed),
-                                valid=valid)
+                                valid=valid, ef=ef)
 
-    def grad_local_pp(params, tokens, quant_seed, valid=None):
+    def grad_local_pp(params, tokens, quant_seed, valid=None, ef=None):
         targets, weights, positions = targets_and_weights(tokens)
         total_count = psum_all(weights.sum(), dense_axes)
         m = cfg.microbatches
@@ -923,9 +956,9 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             params)
         return sync_and_metrics(loss, aux, grads, total_count,
                                 derive_quant_key(quant_seed),
-                                valid=valid)
+                                valid=valid, ef=ef)
 
-    def grad_local_1f1b(params, tokens, quant_seed, valid=None):
+    def grad_local_1f1b(params, tokens, quant_seed, valid=None, ef=None):
         """The pp path under the fused 1F1B schedule (parallel/pp.py
         one_f_one_b): same loss and gradients as grad_local_pp, but the
         backward interleaves with the forward tick-by-tick, bounding
@@ -985,7 +1018,7 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                "dispatch_fraction": jnp.ones((), jnp.float32)}
         return sync_and_metrics(loss_sum, aux, grads, total_count,
                                 derive_quant_key(quant_seed),
-                                valid=valid)
+                                valid=valid, ef=ef)
 
     # check_vma=False: varying-axis tracking would auto-insert psums over
     # the data axes in the backward pass (pvary transpose), taking gradient
@@ -1003,7 +1036,35 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         local_fn = grad_local_1f1b
     else:
         local_fn = grad_local_pp if has_pp else grad_local
-    if dynamic_valid:
+    # the ef8 residual is explicit rank-varying state: one
+    # (num_buckets, bucket_elems) f32 plane per rank, stacked on a
+    # leading axis sharded over EVERY axis whose ranks hold different
+    # gradients — data axes AND tp/pp (init_ef_state builds it with the
+    # same _ef_state_axes tuple). Unlike the dynamic valid mask (which
+    # tp/pp ranks genuinely share), the residual VARIES across tp/pp:
+    # each model-parallel rank quantizes its own parameter shard's
+    # gradients — an out_spec claiming tp replication here would
+    # silently keep one rank's residual and corrupt the others' error
+    # feedback every step
+    ef_spec = P(_ef_state_axes(cfg, mesh), None, None)
+
+    def _relead_ef(out):
+        # the rank-local residual is (num_buckets, bucket_elems); the
+        # stacked state regains its leading rank axis for the out_spec
+        g, m, e = out
+        return g, m, e[None]
+
+    if dynamic_valid and use_ef:
+        mapped = jax.shard_map(
+            lambda p, t, s, e, v: _relead_ef(
+                local_fn(p, t, s, valid=v[0], ef=e[0])),
+            mesh=mesh,
+            in_specs=(specs, P(batch_axes, "sp"), P(), ef_spec,
+                      P(dense_axes, None)),
+            out_specs=(specs, P(), ef_spec),
+            check_vma=False,
+        )
+    elif dynamic_valid:
         # the (n_data_ranks, num_buckets) mask shards one row per data
         # rank; tp/pp ranks within a data rank see the same row
         mapped = jax.shard_map(
@@ -1014,6 +1075,14 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             out_specs=(specs, P()),
             check_vma=False,
         )
+    elif use_ef:
+        mapped = jax.shard_map(
+            lambda p, t, s, e: _relead_ef(local_fn(p, t, s, ef=e[0])),
+            mesh=mesh,
+            in_specs=(specs, P(batch_axes, "sp"), P(), ef_spec),
+            out_specs=(specs, P(), ef_spec),
+            check_vma=False,
+        )
     else:
         mapped = jax.shard_map(
             local_fn, mesh=mesh,
@@ -1022,24 +1091,88 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             check_vma=False,
         )
 
-    def grad_step(params, tokens, quant_seed=None, valid=None):
-        if quant_seed is None and cfg.grad_transport == "int8":
+    def grad_step(params, tokens, quant_seed=None, valid=None,
+                  ef_state=None):
+        if quant_seed is None and cfg.grad_transport in ("int8", "ef8"):
             # a defaulted seed would reuse one rounding key every round,
             # making the quantization error systematic instead of
             # zero-mean (make_train_step passes the optimizer step count)
             raise ValueError(
-                "int8 grad transport needs a per-round quant_seed")
+                f"{cfg.grad_transport} grad transport needs a per-round "
+                f"quant_seed")
         seed = jnp.asarray(0 if quant_seed is None else quant_seed,
                            jnp.uint32)
+        if use_ef and ef_state is None:
+            raise ValueError(
+                "ef8 grad transport needs the error-feedback state: "
+                "build it with init_ef_state(cfg, mesh, params) and "
+                "thread the returned state into the next step — "
+                "dropping it silently degrades ef8 to plain block-int8")
         if dynamic_valid:
             if valid is None:
                 raise ValueError("dynamic_valid step needs a per-round "
                                  "valid mask (n_data_ranks, num_buckets)")
+            if use_ef:
+                return mapped(params, tokens, seed, ef_state,
+                              jnp.asarray(valid, jnp.float32))
             return mapped(params, tokens, seed,
                           jnp.asarray(valid, jnp.float32))
+        if use_ef:
+            return mapped(params, tokens, seed, ef_state)
         return mapped(params, tokens, seed)
 
     return grad_step
+
+
+def _data_axes(cfg: TrainConfig, mesh: Mesh) -> tuple:
+    """The axes the DENSE gradient sync reduces over: cfg.grad_axes
+    plus ep when the mesh has experts (ep doubles as a data axis for
+    dense params). The one definition serving make_grad_step,
+    data_rank_count, and the ef-state stacking — copies of this
+    expression drifting apart is how mask rows and residual planes
+    stop lining up with the collective."""
+    return cfg.grad_axes + (("ep",)
+                            if mesh.shape.get("ep", 1) > 1 else ())
+
+
+def _ef_state_axes(cfg: TrainConfig, mesh: Mesh) -> tuple:
+    """The mesh axes the ef8 residual is STACKED over: every axis along
+    which ranks hold different gradients — the data axes (dp/sp, + ep
+    when present) AND the model axes (tp/pp): a tp rank quantizes its
+    own parameter-shard's gradients, so its quantization error (and
+    hence its residual) differs from its tp siblings'. One shared
+    tuple for init_ef_state and make_grad_step's shard_map specs —
+    the two drifting apart is exactly the silent-replication bug this
+    helper exists to prevent."""
+    return _data_axes(cfg, mesh) + tuple(
+        a for a in ("tp", "pp") if mesh.shape.get(a, 1) > 1)
+
+
+def init_ef_state(cfg: TrainConfig, mesh: Mesh,
+                  params: Any) -> Optional[jax.Array]:
+    """The ef8 transport's error-feedback state: a zero
+    ``(n_ranks, num_buckets, bucket_elems)`` f32 array, leading axis
+    sharded over every mesh axis whose ranks hold different gradients
+    (data axes AND tp/pp — each such rank owns its own residual plane,
+    because quantization error is rank-local; see
+    :func:`_ef_state_axes`). None for every other transport, so
+    callers can thread it unconditionally.
+
+    This is TRAINING STATE on par with opt_state: the step consumes and
+    returns it, cli.py train rebinds it every step and checkpoints it
+    as the ``sync`` item — a resume that drops it restarts the error
+    accumulator at zero, which is safe (EF re-converges) but loses one
+    residual's worth of compensation; restoring it is what makes the
+    resumed run bitwise the uninterrupted one
+    (tests/test_ef8_grad_sync.py pins that)."""
+    if cfg.grad_transport != "ef8":
+        return None
+    axes = _ef_state_axes(cfg, mesh)
+    n_ranks = math.prod(mesh.shape.get(a, 1) for a in axes)
+    n_buckets = dense_bucket_count(cfg, mesh, params)
+    zeros = jnp.zeros((n_ranks, n_buckets, cfg.bucket_elems),
+                      jnp.float32)
+    return jax.device_put(zeros, NamedSharding(mesh, P(axes, None, None)))
 
 
 def make_train_step(cfg: TrainConfig, mesh: Mesh,
@@ -1066,7 +1199,12 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
     by tests/test_train.py::TestCompileStability."""
     grad_step = make_grad_step(cfg, mesh, valid_buckets,
                                dynamic_valid=dynamic_valid)
+    use_ef = cfg.grad_transport == "ef8"
     donate_args = (0, 1) if donate else ()
+    # the ef8 residual is rebound every step exactly like params/
+    # opt_state, so it joins the donation set (it is params-plane-sized
+    # HBM — leaving both generations live would double it)
+    donate_args_ef = (0, 1, 3) if donate else ()
 
     def step_count(opt_state):
         """The chain's guaranteed step counter (make_optimizer pins a
@@ -1101,6 +1239,32 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh,
         params = optax.apply_updates(params, updates)
         return params, opt_state, metrics
 
+    # ef8 steps: the error-feedback residual is a fourth state item the
+    # step consumes and returns (init_ef_state builds it; cli.py train
+    # rebinds + checkpoints it like opt_state)
+    @partial(jax.jit, donate_argnums=donate_args_ef)
+    def step_ef(params, opt_state, tokens, ef_state):
+        count = step_count(opt_state)
+        grads, metrics, ef_state = grad_step(params, tokens,
+                                             quant_seed=count,
+                                             ef_state=ef_state)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics, ef_state
+
+    @partial(jax.jit, donate_argnums=donate_args_ef)
+    def step_ef_dynamic(params, opt_state, tokens, ef_state, valid):
+        count = step_count(opt_state)
+        grads, metrics, ef_state = grad_step(params, tokens,
+                                             quant_seed=count,
+                                             valid=valid,
+                                             ef_state=ef_state)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics, ef_state
+
+    if use_ef:
+        return step_ef_dynamic if dynamic_valid else step_ef
     return step_dynamic if dynamic_valid else step
 
 
@@ -1132,6 +1296,23 @@ def make_multi_step(cfg: TrainConfig, mesh: Mesh,
     """
     step_inner = make_train_step(cfg, mesh, opt, donate=False)
 
+    if cfg.grad_transport == "ef8":
+        # the residual rides the chunk's scan carry alongside params/
+        # opt_state — a chunk of n steps telescopes its error feedback
+        # exactly like n dispatched steps
+        @partial(jax.jit, donate_argnums=(0, 1, 3))
+        def run_chunk_ef(params, opt_state, tokens_stacked, ef_state):
+            def one(carry, tokens):
+                p, o, e = carry
+                p, o, metrics, e = step_inner(p, o, tokens, e)
+                return (p, o, e), metrics
+
+            (params, opt_state, ef_state), metrics = lax.scan(
+                one, (params, opt_state, ef_state), tokens_stacked)
+            return params, opt_state, metrics, ef_state
+
+        return run_chunk_ef
+
     @partial(jax.jit, donate_argnums=(0, 1))
     def run_chunk(params, opt_state, tokens_stacked):
         def one(carry, tokens):
@@ -1150,8 +1331,8 @@ def data_rank_count(cfg: TrainConfig, mesh: Mesh) -> int:
     """How many data ranks contribute to the dense gradient sync — the row
     count of a dynamic ``valid`` mask (dp x sp, x ep when the mesh has
     experts; rows dp-major)."""
-    axes = cfg.grad_axes + (("ep",) if mesh.shape.get("ep", 1) > 1 else ())
-    return math.prod(mesh.shape.get(a, 1) for a in axes)
+    return math.prod(mesh.shape.get(a, 1)
+                     for a in _data_axes(cfg, mesh))
 
 
 def dense_bucket_count(cfg: TrainConfig, mesh: Mesh, params: Any) -> int:

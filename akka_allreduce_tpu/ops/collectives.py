@@ -25,6 +25,23 @@ SURVEY.md §5.8). On TPU both phases lower to single XLA collectives over ICI:
   ICI/DCN while accumulation stays f32. Per-chunk scales confine outlier
   damage, matching the framework's chunk granularity; stochastic rounding
   keeps the round-over-round gradient sum unbiased.
+* :func:`ef8_two_phase_allreduce` — the EQuARX scheme completed
+  (ISSUE 9): BLOCK-wise scales (one per ``block_elems`` columns, not per
+  row) plus a persistent error-feedback residual. Each round quantizes
+  ``grads + residual`` with deterministic round-to-nearest and carries
+  ``(grads + residual) - dequant(sent)`` forward, so compression error
+  is not just bounded but *compensated* — the sum over T rounds of what
+  the wire delivered telescopes to the sum of the true gradients plus
+  one terminal residual, independent of T.
+* :func:`swing_allreduce` / :func:`quantized_swing_allreduce` — the
+  Swing-style short-cut schedule (arxiv 2401.09356, PAPERS.md): step *t*
+  exchanges the full running sum with the peer at signed distance
+  ``±2^t`` (rendered as the XOR partner on a power-of-two group), so an
+  allreduce completes in ``log2(n)`` exchange steps instead of the
+  ring's ``2(n-1)`` — the latency-bound regime's win for mid-size
+  payloads. The quantized form re-quantizes the running sum each hop
+  (int8 per-row scales, or ef8 block scales + error feedback on the
+  first hop — the hop that carries this rank's own contribution).
 
 All are *rank-local* functions meant for use inside ``shard_map`` /
 ``pjit``-traced train steps; the ``exact_allreduce`` driver wraps one for
@@ -44,10 +61,22 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from akka_allreduce_tpu.ops.pallas_kernels.dispatch import use_pallas
 from akka_allreduce_tpu.ops.pallas_kernels.quantized import (
+    _pad_cols_to,
+    block_scales,
     dequantize_int8,
+    dequantize_int8_block,
     quantize_int8,
+    quantize_int8_block,
+    quantize_int8_block_rtn,
     quantize_int8_prng,
 )
+
+# ef8 scale-block width: one f32 scale per this many int8 columns.
+# 512 keeps the scale overhead at 1/128 of the payload while shrinking
+# an outlier's blast radius 1/(bucket_elems/512) vs the per-row form;
+# a multiple of 128 lanes so the Pallas kernels can make the scale
+# block their VMEM column tile.
+DEFAULT_EF_BLOCK = 512
 
 
 def psum_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
@@ -56,31 +85,40 @@ def psum_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
     return lax.psum(x, axis_name)
 
 
-def _check_scatter_geometry(x: jnp.ndarray, axis_name: str) -> None:
-    """The two-phase geometry precondition, shared by the fused and
-    windowed forms so the error reads identically however the caller
-    routed here: psum_scatter tiles the last axis across the group."""
+def _pad_scatter_geometry(x: jnp.ndarray, axis_name: str
+                          ) -> tuple[jnp.ndarray, int]:
+    """The two-phase geometry, satisfied by construction (ISSUE 9
+    satellite — this used to be a hard assert): psum_scatter tiles the
+    last axis across the group, so a payload whose last axis the group
+    size does not divide is zero-padded up to the next multiple (zeros
+    sum harmlessly and land at the END of the axis, so the kept
+    elements keep their positions — and their reduction trees, so
+    results on the kept region are bitwise what the unpadded op would
+    produce). Returns ``(padded, original_len)``; callers slice
+    ``[..., :original_len]`` after the gather."""
     n = lax.axis_size(axis_name)
-    if x.shape[-1] % n != 0:
-        raise ValueError(
-            f"last axis {x.shape[-1]} not divisible by group size {n} "
-            f"(= lax.axis_size({axis_name!r}), the mesh extent of the "
-            f"{axis_name!r} axis this collective reduces over); choose "
-            f"bucket_elems as a multiple of that axis size, or pad the "
-            f"last axis with zeros (they sum harmlessly)")
+    e = x.shape[-1]
+    pad = (-e) % n
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros(x.shape[:-1] + (pad,), x.dtype)], axis=-1)
+    return x, e
 
 
 def two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
     """Reduce-scatter + all-gather along the *last* axis. Rank-local.
 
-    Requires the last-axis length to be divisible by the axis size — use
-    bucket_elems that are a multiple of the group size (pad otherwise;
-    ops/bucketing pads with zeros which sum harmlessly).
+    Any last-axis length is accepted: lengths the group size does not
+    divide are zero-padded to the next multiple and trimmed back after
+    the gather (``_pad_scatter_geometry``) — aligned bucket_elems remain
+    the PERFORMANCE recommendation (ops/bucketing.py), the pad is a
+    correctness guarantee, not a license to pick ragged sizes.
     """
-    _check_scatter_geometry(x, axis_name)
-    scattered = lax.psum_scatter(x, axis_name, scatter_dimension=x.ndim - 1,
-                                 tiled=True)
-    return lax.all_gather(scattered, axis_name, axis=x.ndim - 1, tiled=True)
+    xp, e = _pad_scatter_geometry(x, axis_name)
+    scattered = lax.psum_scatter(xp, axis_name,
+                                 scatter_dimension=xp.ndim - 1, tiled=True)
+    out = lax.all_gather(scattered, axis_name, axis=xp.ndim - 1, tiled=True)
+    return out[..., :e]
 
 
 def pipelined_two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp",
@@ -131,9 +169,9 @@ def pipelined_two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp",
             f"num_windows (they sum harmlessly and slice back off — "
             f"parallel/dp.py's windowed path does this), or pick "
             f"num_windows from the divisors of {b}")
-    _check_scatter_geometry(x, axis_name)
     if num_windows == 1:
         return two_phase_allreduce(x, axis_name)
+    x, e = _pad_scatter_geometry(x, axis_name)
     wb = b // num_windows
     windows = [x[i * wb:(i + 1) * wb] for i in range(num_windows)]
 
@@ -153,7 +191,7 @@ def pipelined_two_phase_allreduce(x: jnp.ndarray, axis_name: str = "dp",
         out[i - 1] = gather(scattered)
         scattered = next_scattered
     out[num_windows - 1] = gather(scattered)
-    return jnp.concatenate(out, axis=0)
+    return jnp.concatenate(out, axis=0)[..., :e]
 
 
 def _quantize_rows(x2d: jnp.ndarray, key: jax.Array
@@ -300,6 +338,328 @@ def quantized_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
         reduced = next_reduced
     out[num_windows - 1] = phase2(reduced, keys[num_windows - 1][1])
     return jnp.concatenate(out, axis=0)[:b]
+
+
+def _quantize_blocks(x2d: jnp.ndarray, block: int,
+                     key: Optional[jax.Array] = None
+                     ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(rows, e) f32 -> (int8 values (rows, e), f32 scales
+    (rows, ceil(e/block))), block-wise symmetric scales.
+
+    ``key=None`` selects deterministic round-to-nearest — the error-
+    feedback rule: bias is compensated by the residual, and determinism
+    is what lets the residual restore bitwise through a checkpoint.
+    A key selects the stochastic floor+Bernoulli rule (the same wire
+    rule as the per-row quantizer) for hops whose error is NOT fed
+    back. TPU routes through the Pallas block kernels when the measured
+    dispatch says so (ops/pallas_kernels/dispatch.py 'int8_block')."""
+    if use_pallas("int8_block") and block % 128 == 0:
+        if key is None:
+            return quantize_int8_block_rtn(x2d, block)
+        bits = jax.random.bits(key, x2d.shape, dtype=jnp.uint32)
+        return quantize_int8_block(x2d, bits, block)
+    rows, e = x2d.shape
+    scales = block_scales(x2d, block)
+    # ONE padding rule (trailing zeros to a block multiple) shared with
+    # the kernels and block_scales — diverging pads would desync the
+    # scale grid from the value grid
+    xp = _pad_cols_to(x2d, block)
+    scaled = xp / jnp.repeat(scales, block, axis=1)
+    if key is None:
+        q = jnp.clip(jnp.round(scaled), -127.0, 127.0)
+    else:
+        low = jnp.floor(scaled)
+        u = jax.random.uniform(key, scaled.shape, jnp.float32)
+        q = jnp.clip(low + (scaled - low > u), -127.0, 127.0)
+    return q.astype(jnp.int8)[:, :e], scales
+
+
+def _dequantize_blocks(values: jnp.ndarray, scales: jnp.ndarray,
+                       block: int) -> jnp.ndarray:
+    """Inverse of :func:`_quantize_blocks`; accepts leading batch dims
+    (the all_to_all / all_gather results carry a group axis)."""
+    if use_pallas("int8_block") and block % 128 == 0 and values.ndim == 2:
+        return dequantize_int8_block(values, scales, block)
+    e = values.shape[-1]
+    return (values.astype(jnp.float32)
+            * jnp.repeat(scales, block, axis=-1)[..., :e])
+
+
+def ef8_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
+                            axis_name: str = "dp",
+                            residual: Optional[jnp.ndarray] = None,
+                            valid: Optional[jnp.ndarray] = None,
+                            num_windows: int = 1,
+                            block_elems: int = DEFAULT_EF_BLOCK
+                            ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """EQuARX-style block-quantized allreduce WITH error feedback.
+
+    Same two-phase structure as :func:`quantized_two_phase_allreduce`
+    (scatter+reduce via all_to_all, broadcast via all_gather, int8 on
+    the wire, f32 accumulation, row padding and window carving
+    identical) with two changes:
+
+    * **Block scales**: one f32 scale per ``block_elems`` columns, so
+      an outlier poisons one block's precision, not its whole bucket
+      row — the scale overhead is ``4/block_elems`` of the int8 payload
+      (1/128 at the default 512).
+    * **Error feedback on phase 1** (the hop carrying this rank's own
+      contribution): the round quantizes ``comp = buckets + residual``
+      with DETERMINISTIC round-to-nearest and returns
+      ``new_residual = comp - dequant(sent)``. What the wire delivered
+      over rounds 1..T then telescopes to the true gradient sum plus
+      one terminal residual — compression error is *compensated*
+      across steps, not merely bounded. Phase 2 (the broadcast of the
+      already-reduced rows) keeps stochastic rounding: its error is
+      zero-mean by construction and feeding it back would need a
+      second owner-rows-shaped state for ~no quality gain (DESIGN.md
+      §14 quantifies).
+
+    ``residual`` is this rank's carried state, ``buckets``-shaped f32
+    (None = zeros, the fresh-start state); callers thread the returned
+    residual into the next round (models/train.py rides it through the
+    scan carry and the checkpoint's ``sync`` item). ``valid`` masks
+    lossy rounds: a masked bucket row contributes exact zeros on the
+    wire and its residual carries over UNCHANGED — a protocol drop is
+    not a compression error, so it is not fed back.
+
+    Returns ``(summed, new_residual)``.
+    """
+    if buckets.ndim != 2:
+        raise ValueError(
+            f"expected (num_buckets, bucket_elems), got {buckets.shape}")
+    if num_windows < 1:
+        raise ValueError(f"num_windows must be >= 1, got {num_windows}")
+    if block_elems < 1:
+        raise ValueError(f"block_elems must be >= 1, got {block_elems}")
+    if residual is None:
+        residual = jnp.zeros_like(buckets)
+    if residual.shape != buckets.shape:
+        raise ValueError(
+            f"residual shape {residual.shape} != buckets shape "
+            f"{buckets.shape} — the error-feedback state is one f32 "
+            f"residual per bucket element (re-init it when the model "
+            f"or bucket_elems changes)")
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        # identity sync: nothing is compressed, so no error to feed
+        # back — but a masked bucket still contributes nothing
+        if valid is not None:
+            return buckets * valid.astype(buckets.dtype)[:, None], \
+                residual
+        return buckets, residual
+    comp = buckets + residual
+    if valid is not None:
+        comp = comp * valid.astype(comp.dtype)[:, None]
+    b, e = buckets.shape
+    pad_rows = (-b) % n
+    comp_p = comp if not pad_rows else jnp.concatenate(
+        [comp, jnp.zeros((pad_rows, e), comp.dtype)], axis=0)
+    bp = b + pad_rows
+    key = jax.random.fold_in(key, lax.axis_index(axis_name))
+
+    def phase1(win):
+        # deterministic RTN quantize of the compensated contribution;
+        # returns (owner-reduced rows, this window's dequantized send)
+        # — the local dequant is what the residual subtracts
+        rows_per_rank = win.shape[0] // n
+        values, scales = _quantize_blocks(win, block_elems)
+        deq_local = _dequantize_blocks(values, scales, block_elems)
+        nb = scales.shape[1]
+        recv_v = lax.all_to_all(values.reshape(n, rows_per_rank, e),
+                                axis_name, split_axis=0, concat_axis=0)
+        recv_s = lax.all_to_all(scales.reshape(n, rows_per_rank, nb),
+                                axis_name, split_axis=0, concat_axis=0)
+        reduced = jnp.sum(
+            _dequantize_blocks(recv_v, recv_s, block_elems), axis=0)
+        return reduced, deq_local
+
+    def phase2(reduced, k2):
+        out_v, out_s = _quantize_blocks(reduced, block_elems, key=k2)
+        all_v = lax.all_gather(out_v, axis_name, axis=0, tiled=True)
+        all_s = lax.all_gather(out_s, axis_name, axis=0, tiled=True)
+        return _dequantize_blocks(all_v, all_s, block_elems)
+
+    # window carve: identical to the int8 path — whole owner row-groups,
+    # never more rows than the fused form pads
+    num_windows = min(num_windows, bp // n)
+    if num_windows == 1:
+        reduced, deq_local = phase1(comp_p)
+        out = phase2(reduced, key)[:b]
+        deq = deq_local[:b]
+    else:
+        m = bp // n
+        sizes = [(m // num_windows + (i < m % num_windows)) * n
+                 for i in range(num_windows)]
+        offs = [0]
+        for s in sizes:
+            offs.append(offs[-1] + s)
+        wins = [comp_p[offs[i]:offs[i + 1]] for i in range(num_windows)]
+        keys = [jax.random.fold_in(key, i) for i in range(num_windows)]
+        out_w = [None] * num_windows
+        deq_w = [None] * num_windows
+        reduced, deq_w[0] = phase1(wins[0])
+        for i in range(1, num_windows):
+            next_reduced, deq_w[i] = phase1(wins[i])
+            out_w[i - 1] = phase2(reduced, keys[i - 1])
+            reduced = next_reduced
+        out_w[num_windows - 1] = phase2(reduced, keys[num_windows - 1])
+        out = jnp.concatenate(out_w, axis=0)[:b]
+        deq = jnp.concatenate(deq_w, axis=0)[:b]
+    new_residual = comp[:b] - deq
+    if valid is not None:
+        # masked rows sent exact zeros (comp==deq==0 there): keep their
+        # residual as-is — the drop is the protocol's, not the wire's
+        new_residual = jnp.where(valid.astype(bool)[:, None],
+                                 new_residual, residual)
+    return out, new_residual
+
+
+def _swing_partner_perm(n: int, t: int) -> list:
+    """Step-``t`` exchange permutation of the swing schedule: rank *j*
+    pairs with ``j XOR 2^t`` — the power-of-two rendering of Swing's
+    ±2^t signed peer distance (even ranks step +2^t, odd ranks -2^t at
+    t=0, then the pairs themselves swing), a valid permutation because
+    XOR with a constant is an involution."""
+    d = 1 << t
+    return [(j, j ^ d) for j in range(n)]
+
+
+def swing_allreduce(x: jnp.ndarray, axis_name: str = "dp") -> jnp.ndarray:
+    """Swing short-cut allreduce: ``log2(n)`` exchange-and-add steps,
+    each moving the FULL running sum to the peer at distance ``2^t``.
+    Rank-local (inside shard_map); any operand shape/dtype.
+
+    Latency-optimal (log n serialized hops vs the ring's 2(n-1)) at
+    bandwidth cost (every hop moves the whole payload vs the ring's
+    1/n blocks): the crossover favors swing for latency-bound mid-size
+    payloads — DESIGN.md §14 carries the table.
+
+    Determinism: every rank folds the SAME balanced pairwise tree
+    (f32 addition is commutative per IEEE-754, so the two sides of
+    each exchange compute bitwise-identical sums), hence the result is
+    bitwise identical across ranks AND across runs — pinned by
+    tests/test_swing_schedule.py against a host-computed tree.
+
+    Requires a power-of-two group (the XOR pairing); other sizes raise
+    with the fused/windowed remedies. Group size 1 is the identity.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if n & (n - 1):
+        raise ValueError(
+            f"swing schedule needs a power-of-two group, got {n} "
+            f"(= lax.axis_size({axis_name!r})): the ±2^t exchange "
+            f"pairing only closes on powers of two — use the fused or "
+            f"windowed schedule for this mesh")
+    out = x
+    for t in range(n.bit_length() - 1):
+        out = out + lax.ppermute(out, axis_name,
+                                 _swing_partner_perm(n, t))
+    return out
+
+
+def quantized_swing_allreduce(buckets: jnp.ndarray, key: jax.Array,
+                              axis_name: str = "dp",
+                              residual: Optional[jnp.ndarray] = None,
+                              valid: Optional[jnp.ndarray] = None,
+                              block_elems: Optional[int] = None
+                              ) -> tuple[jnp.ndarray,
+                                         Optional[jnp.ndarray]]:
+    """Swing exchange with int8 wire payloads — the schedule x wire
+    composition (ISSUE 9): each of the ``log2(n)`` hops quantizes the
+    running sum (values + scales ride the ppermute), dequantizes the
+    peer's, and accumulates in f32.
+
+    ``block_elems=None`` = per-row scales, stochastic rounding every
+    hop (the int8 wire on the swing schedule). An int selects block
+    scales, and when ``residual`` is given the FIRST hop — the one
+    carrying this rank's own contribution — quantizes
+    ``buckets + residual`` with deterministic round-to-nearest and
+    feeds its error back exactly like :func:`ef8_two_phase_allreduce`
+    (later hops carry partial sums of many ranks; their error stays
+    stochastic/zero-mean, priced in DESIGN.md §14: log2(n) hops vs the
+    two-phase's 2).
+
+    ``valid`` masks lossy rounds at hop 0 (masked rows contribute
+    exact zeros; their residual carries over unchanged). Returns
+    ``(summed, new_residual)`` — residual is None when none was given.
+    """
+    if buckets.ndim != 2:
+        raise ValueError(
+            f"expected (num_buckets, bucket_elems), got {buckets.shape}")
+    if residual is not None and residual.shape != buckets.shape:
+        # same contract as ef8_two_phase_allreduce: a mis-shaped
+        # residual would silently BROADCAST into the sum and write a
+        # wrong-shaped state back
+        raise ValueError(
+            f"residual shape {residual.shape} != buckets shape "
+            f"{buckets.shape} — the error-feedback state is one f32 "
+            f"residual per bucket element (re-init it when the model "
+            f"or bucket_elems changes)")
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        # identity sync; the mask still zeroes masked buckets
+        if valid is not None:
+            return buckets * valid.astype(buckets.dtype)[:, None], \
+                residual
+        return buckets, residual
+    if n & (n - 1):
+        raise ValueError(
+            f"swing schedule needs a power-of-two group, got {n} "
+            f"(= lax.axis_size({axis_name!r})): use the fused or "
+            f"windowed schedule for this mesh")
+    # Rounding-noise keys are per-SUBGROUP, not per-rank: after step t
+    # every rank in the subgroup ``rank >> t`` holds a bitwise-identical
+    # partial sum, and keying its quantize identically is what keeps the
+    # ranks identical THROUGH the quantize — rank-local noise here would
+    # make an "allreduce" whose ranks drift apart (params diverge one
+    # ulp per hop). Across subgroups and rounds the keys differ, which
+    # is all unbiasedness needs (noise independent of the VALUES).
+    me = lax.axis_index(axis_name)
+
+    def quant(mat, k):
+        if block_elems is None:
+            return _quantize_rows(mat, k) if k is not None else (
+                # RTN per-row (unused today: EF implies block scales,
+                # but keep the rule total)
+                _quantize_blocks(mat, mat.shape[1]))
+        return _quantize_blocks(mat, block_elems, key=k)
+
+    def deq(v, s):
+        if block_elems is None:
+            return _dequantize_rows(v, s)
+        return _dequantize_blocks(v, s, block_elems)
+
+    new_residual = residual
+    acc = buckets
+    for t in range(n.bit_length() - 1):
+        kt = jax.random.fold_in(jax.random.fold_in(key, t),
+                                (me >> t).astype(jnp.uint32))
+        if t == 0:
+            comp = acc if residual is None else acc + residual
+            if valid is not None:
+                comp = comp * valid.astype(comp.dtype)[:, None]
+            # EF hop: deterministic; plain hops: stochastic
+            v, s = quant(comp, None if residual is not None else kt)
+            d = deq(v, s)
+            if residual is not None:
+                nr = comp - d
+                new_residual = nr if valid is None else jnp.where(
+                    valid.astype(bool)[:, None], nr, residual)
+            # the accumulator adopts its own dequant too: both sides of
+            # every exchange then fold identical (wire-visible) values,
+            # keeping the cross-rank bitwise-consistency property
+            acc = d
+        else:
+            v, s = quant(acc, kt)
+            acc = deq(v, s)
+        perm = _swing_partner_perm(n, t)
+        rv = lax.ppermute(v, axis_name, perm)
+        rs = lax.ppermute(s, axis_name, perm)
+        acc = acc + deq(rv, rs)
+    return acc, new_residual
 
 
 def exact_allreduce(stacked: jnp.ndarray, mesh: Mesh, axis_name: str = "dp",

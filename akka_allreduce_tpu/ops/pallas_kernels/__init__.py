@@ -6,11 +6,14 @@ the table (SURVEY.md §7 build order step 6).
   (reference: ScatteredDataBuffer.scala:20-32) fused with its count
   bookkeeping and the sink's divide-by-count compensation.
 * `quantized.py` — int8 stochastic-rounding quantize/dequantize with
-  per-chunk scales: the wire-compression direction of PAPERS.md (EQuARX).
+  per-chunk scales: the wire-compression direction of PAPERS.md
+  (EQuARX); plus the ISSUE 9 block-scale variants (one scale per column
+  tile, stochastic and deterministic-RTN — the error-feedback wire).
 * `ring.py` — ICI ring reduce-scatter + all-gather via remote DMA: the
   reference's scatter/broadcast phases as a hand-scheduled chip-to-chip
   pipeline, for when XLA's built-in collective schedule loses to a custom
-  chunk schedule.
+  chunk schedule; plus the ISSUE 9 swing short-cut schedule (±2^t
+  exchange partners, log2(n) hops).
 
 The ring collective falls back to ``lax.psum`` for group size 1; the local
 kernels accept ``interpret=True`` to run on non-TPU backends (CPU tests use
@@ -20,17 +23,29 @@ this), and compile natively on TPU.
 from akka_allreduce_tpu.ops.pallas_kernels.dispatch import use_pallas
 from akka_allreduce_tpu.ops.pallas_kernels.reduce import fused_masked_reduce
 from akka_allreduce_tpu.ops.pallas_kernels.quantized import (
+    block_scales,
     dequantize_int8,
+    dequantize_int8_block,
     quantize_int8,
+    quantize_int8_block,
+    quantize_int8_block_rtn,
     quantize_int8_stochastic,
 )
-from akka_allreduce_tpu.ops.pallas_kernels.ring import pallas_ring_allreduce
+from akka_allreduce_tpu.ops.pallas_kernels.ring import (
+    pallas_ring_allreduce,
+    pallas_swing_allreduce,
+)
 
 __all__ = [
     "use_pallas",
     "fused_masked_reduce",
+    "block_scales",
     "quantize_int8",
+    "quantize_int8_block",
+    "quantize_int8_block_rtn",
     "quantize_int8_stochastic",
     "dequantize_int8",
+    "dequantize_int8_block",
     "pallas_ring_allreduce",
+    "pallas_swing_allreduce",
 ]

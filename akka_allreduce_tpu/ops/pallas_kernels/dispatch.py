@@ -39,6 +39,13 @@ import jax
 _TPU_DEFAULTS = {
     "masked_reduce": True,
     "int8": False,
+    # block-scale quantize (the ef8 error-feedback wire): same
+    # scale/round/clip/cast chain as "int8" with one scale per column
+    # tile instead of per row — the same XLA-fuses-it-better economics
+    # apply until a chip A/B says otherwise, so the jnp form is the
+    # default here too (kernels stay exercised in interpret mode by
+    # tests/test_pallas_kernels.py)
+    "int8_block": False,
     # in-kernel PRNG quantize: wins END TO END (bits generation included;
     # see module docstring) — the production int8 quantize on TPU
     "int8_prng": True,

@@ -41,6 +41,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
 
+# jax 0.4.x spells the compiler-params dataclass TPUCompilerParams;
+# 0.7+ renamed it CompilerParams. One alias so both ring and swing
+# kernels build on either.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
 
 def _ring_kernel(my_ref, x_ref, out_ref, carry_ref, comm_ref, send_sem,
                  recv_sem, free_sem, *, n: int, interpret: bool):
@@ -145,9 +151,133 @@ def _ring_call(blocks: jnp.ndarray, my: jnp.ndarray, n: int, rows: int,
             pltpu.SemaphoreType.DMA((2,)),               # recv sems
             pltpu.SemaphoreType.REGULAR((2,)),           # slot-free grants
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=0),
+        compiler_params=_CompilerParams(collective_id=0),
         interpret=interpret,
     )(jnp.asarray([my], jnp.int32), blocks)
+
+
+def _swing_kernel(my_ref, x_ref, out_ref, comm_ref, send_sem, recv_sem,
+                  free_sem, *, n: int, interpret: bool):
+    """Swing short-cut schedule (ISSUE 9): step ``t`` exchanges the FULL
+    running sum with the peer at signed distance ±2^t — rendered as the
+    XOR partner ``my ^ 2^t`` on a power-of-two group — so the allreduce
+    completes in ``log2(n)`` exchange steps instead of the ring's
+    ``2(n-1)``. Latency-optimal at bandwidth cost (every hop moves the
+    whole payload); the crossover economics live in DESIGN.md §14.
+
+    Flow control: the same slot-free handshake as the ring, re-indexed
+    for CHANGING partners. ``rdma.wait()`` only synchronizes a rank
+    with its CURRENT partner, but step t+1's partner is a different
+    rank whose progress is tied to ITS OWN previous partner — it can be
+    a full step ahead, and its step-(t+1) write targets my
+    ``comm[(t+2)%2] = comm[t%2]``, exactly the slot my step-t send is
+    reading. So after step t's send completes, this rank grants its
+    STEP-(t+1) partner the write into that slot (``my ^ 2^(t+1)`` —
+    which, from the partner's side, is precisely who it waits on:
+    ``(my ^ 2^(t+1)) ^ 2^(t+1) == my``), and before each remote write
+    from step 1 on it waits for the matching grant from its current
+    partner (step 0 is covered by the startup barrier). The final step
+    grants nothing — no write follows, and a stale credit would let a
+    future invocation race (the ring kernel's reasoning). Interpret
+    mode executes ranks sequentially and elides handshake + barrier.
+    """
+    my = my_ref[0]
+    steps = n.bit_length() - 1
+    if not interpret:
+        barrier = pltpu.get_barrier_semaphore()
+        for t in range(steps):
+            partner = jnp.bitwise_xor(my, 1 << t)
+            pltpu.semaphore_signal(barrier, inc=1, device_id=partner,
+                                   device_id_type=pltpu.DeviceIdType.
+                                   LOGICAL)
+        pltpu.semaphore_wait(barrier, steps)
+    out_ref[:] = x_ref[:]
+    for t in range(steps):
+        partner = jnp.bitwise_xor(my, 1 << t)
+        slot, recv_slot = t % 2, (t + 1) % 2
+        comm_ref[slot] = out_ref[:]
+        if not interpret and t >= 1:
+            # wait for the current partner's grant: its step-(t-1) send
+            # from the slot we are about to overwrite remotely (its
+            # comm[(t-1)%2] == comm[recv_slot]) has completed
+            pltpu.semaphore_wait(free_sem.at[recv_slot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[slot],
+            dst_ref=comm_ref.at[recv_slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=partner,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        if not interpret and t < steps - 1:
+            # our send from `slot` is done: grant the NEXT step's
+            # partner — the rank whose step-(t+1) write targets this
+            # very slot of ours — its remote write
+            next_partner = jnp.bitwise_xor(my, 1 << (t + 1))
+            pltpu.semaphore_signal(free_sem.at[slot], inc=1,
+                                   device_id=next_partner,
+                                   device_id_type=pltpu.DeviceIdType.
+                                   LOGICAL)
+        out_ref[:] = out_ref[:] + comm_ref[recv_slot]
+
+
+def _swing_call(blocks: jnp.ndarray, my: jnp.ndarray, n: int, rows: int,
+                interpret: bool) -> jnp.ndarray:
+    kernel = functools.partial(_swing_kernel, n=n, interpret=interpret)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, LANE), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, LANE), jnp.float32),   # comm slots
+            pltpu.SemaphoreType.DMA((2,)),               # send sems
+            pltpu.SemaphoreType.DMA((2,)),               # recv sems
+            pltpu.SemaphoreType.REGULAR((2,)),           # slot-free grants
+        ],
+        # distinct collective_id from the ring kernel: the barrier
+        # semaphore is per-id, and a program composing both schedules
+        # must not cross their barriers
+        compiler_params=_CompilerParams(collective_id=1),
+        interpret=interpret,
+    )(jnp.asarray([my], jnp.int32), blocks)
+
+
+def pallas_swing_allreduce(x: jnp.ndarray, axis_name: str = "dp",
+                           interpret: bool = False) -> jnp.ndarray:
+    """Rank-local allreduce of a flat f32 vector on the hand-scheduled
+    swing schedule: ``log2(n)`` remote-DMA exchanges at distances
+    1, 2, 4, ... instead of the ring's 2(n-1) neighbor hops. Requires a
+    power-of-two group and ``x.size % 128 == 0`` (whole lanes); group
+    size 1 falls back to the identity psum.
+
+    EXPERIMENTAL on real multi-chip ICI exactly like the ring kernel
+    (module docstring): interpreter mode validates the schedule and the
+    sum, not the concurrent semaphore protocol. Production gradient
+    sync uses the XLA swing schedule (ops/collectives.swing_allreduce);
+    route through this kernel only on hardware where you can A/B it."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return lax.psum(x, axis_name)
+    if n & (n - 1):
+        raise ValueError(
+            f"swing schedule needs a power-of-two group, got {n}: the "
+            f"±2^t exchange pairing only closes on powers of two")
+    elems = x.shape[-1]
+    if elems % LANE != 0:
+        raise ValueError(
+            f"vector of {elems} elements must be whole {LANE}-lanes; "
+            f"pad to a multiple of {LANE}")
+    rows = elems // LANE
+    blocks = x.reshape(rows, LANE)
+    my = lax.axis_index(axis_name)
+    out = _swing_call(blocks, my, n, rows, interpret)
+    return out.reshape(elems)
 
 
 def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str = "dp",

@@ -30,8 +30,14 @@ from jax import lax
 
 from akka_allreduce_tpu.ops.bucketing import BucketSpec, bucketize, \
     debucketize, vector_to_tree
-from akka_allreduce_tpu.ops.collectives import \
-    pipelined_two_phase_allreduce, quantized_two_phase_allreduce
+from akka_allreduce_tpu.ops.collectives import (
+    DEFAULT_EF_BLOCK,
+    ef8_two_phase_allreduce,
+    pipelined_two_phase_allreduce,
+    quantized_swing_allreduce,
+    quantized_two_phase_allreduce,
+    swing_allreduce,
+)
 from akka_allreduce_tpu.ops.masked import expand_bucket_counts, \
     masked_allreduce
 from akka_allreduce_tpu.utils.vma import _axis_tuple, psum_all
@@ -59,29 +65,42 @@ class GradSyncConfig:
     return_elem_counts: bool = True
     # Wire format of the collective: "f32" (stock psum); "bf16" (the
     # operand dtype IS the wire — half the ICI/DCN bytes with plain
-    # rounding, any axis combination, size-1 axes bypass the cast); or
-    # "int8" (quantized two-phase allreduce, ops/collectives.py — 4x less
-    # traffic, one stochastic-rounding error per hop; requires a single
-    # data axis and bucket_elems divisible by its size). Lossy (masked)
-    # rounds keep the compressed wire: masked contributions round to
-    # exact zeros and the per-bucket counts ride a separate exact int32
-    # psum.
+    # rounding, any axis combination, size-1 axes bypass the cast);
+    # "int8" (quantized two-phase allreduce, ops/collectives.py — 4x
+    # less traffic, one stochastic-rounding error per hop; requires a
+    # single data axis); or "ef8" (ISSUE 9: int8 payload with BLOCK-wise
+    # scales and a persistent error-feedback residual — the residual is
+    # added back before each round's quantize and re-captures what the
+    # wire dropped, so compression error is compensated across steps,
+    # not just bounded. Needs a single data axis, a per-round
+    # quant_key, and the ``residual`` state threaded through
+    # allreduce_gradients — models/train.py rides it through the scan
+    # carry and the checkpoint's ``sync`` item). Lossy (masked) rounds
+    # keep the compressed wire: masked contributions round to exact
+    # zeros (their ef8 residual carries over unchanged) and the
+    # per-bucket counts ride a separate exact int32 psum.
     transport: str = "f32"
     # Collective schedule: "fused" issues one monolithic collective per
-    # sync (psum, or the single two-phase pair for int8); "windowed"
-    # splits the bucket axis into num_windows windows and issues them on
-    # the software-pipelined schedule of
+    # sync (psum, or the single two-phase pair for int8/ef8);
+    # "windowed" splits the bucket axis into num_windows windows and
+    # issues them on the software-pipelined schedule of
     # ops/collectives.pipelined_two_phase_allreduce, so window i's
-    # all-gather can overlap window i+1's reduce-scatter (and, for int8,
-    # window i+1's quantization) under XLA's latency-hiding scheduler
-    # (runtime/xla_flags.py). Exactness-preserving for f32 (bitwise the
-    # fused two-phase result); bf16/int8 stay inside their wire's error
-    # envelope. Needs a single (>1) data axis whose size divides
-    # bucket_elems (the two-phase geometry); the bucket axis pads with
-    # zero rows to a multiple of the window count (sliced back off,
-    # degrading the count when padding would exceed one window's rows),
-    # and lossy rounds keep their per-bucket counts on ONE exact int32
-    # psum — never per-window.
+    # all-gather can overlap window i+1's reduce-scatter (and, for
+    # int8/ef8, window i+1's quantization) under XLA's latency-hiding
+    # scheduler (runtime/xla_flags.py); "swing" (ISSUE 9) issues the
+    # Swing short-cut exchange schedule — step t trades the full
+    # running sum with the peer at distance 2^t, finishing in log2(n)
+    # latency-bound steps instead of the two-phase's O(n) — the
+    # mid-size-payload winner (DESIGN.md §14 crossover table).
+    # Exactness: windowed f32 is bitwise the fused two-phase result;
+    # swing f32 is bitwise-deterministic (identical across ranks and
+    # runs — the balanced pairwise tree) and equals the psum within
+    # f32 summation order; bf16/int8/ef8 stay inside their wire's
+    # error envelope (swing re-quantizes per hop: log2(n) hops vs the
+    # two-phase's 2). Windowed/swing need a single (>1) data axis —
+    # swing additionally a power-of-two one; bucket geometry is
+    # satisfied by construction (pads slice back off), and lossy
+    # rounds keep their per-bucket counts on ONE exact int32 psum.
     transport_schedule: str = "fused"
     num_windows: int = 4
 
@@ -93,18 +112,23 @@ class GradSyncResult:
     out), and the raw per-bucket counts for observability.
 
     ``transport`` is the wire format that ran (both exact and lossy
-    rounds honor ``config.transport``)."""
+    rounds honor ``config.transport``). ``residual`` is the updated
+    error-feedback state of the ef8 transport — buckets-shaped f32,
+    thread it into the next round's ``allreduce_gradients`` call (None
+    for every other transport)."""
 
     grads: Any
     counts: Any
     bucket_counts: jnp.ndarray
     spec: BucketSpec
     transport: str = "f32"
+    residual: Any = None
 
 
 def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
                         valid: Optional[jnp.ndarray] = None,
-                        quant_key: Optional[jax.Array] = None
+                        quant_key: Optional[jax.Array] = None,
+                        residual: Optional[jnp.ndarray] = None
                         ) -> GradSyncResult:
     """Synchronise a gradient pytree across the data axis (rank-local).
 
@@ -113,8 +137,12 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     supplies zeros for contributions that missed their deadline
     (runtime/pacer.py). Counts in the result reflect how many ranks actually
     contributed each element. ``quant_key`` drives the stochastic rounding
-    of the int8 transport (vary it per round or the rounding error stops
-    being unbiased across rounds).
+    of the int8/ef8 transports (vary it per round or the rounding error
+    stops being unbiased across rounds). ``residual`` is the ef8
+    transport's carried error-feedback state — buckets-shaped f32, None
+    initialises to zeros; the updated state comes back as
+    ``GradSyncResult.residual`` and MUST be threaded into the next round
+    (dropping it silently degrades ef8 to plain block-int8).
     """
     buckets, spec = bucketize(grads, config.bucket_elems)
     # axes that actually move bytes: size-1 axes reduce to identity and
@@ -123,32 +151,24 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     live_axes = [a for a in _axis_tuple(config.axis_name)
                  if lax.axis_size(a) > 1]
     use_bf16 = config.transport == "bf16" and bool(live_axes)
-    if config.transport_schedule not in ("fused", "windowed"):
+    if config.transport_schedule not in ("fused", "windowed", "swing"):
         raise ValueError(
             f"unknown transport_schedule {config.transport_schedule!r}: "
-            f"'fused' (one monolithic collective) or 'windowed' (the "
-            f"software-pipelined schedule)")
+            f"'fused' (one monolithic collective), 'windowed' (the "
+            f"software-pipelined schedule), or 'swing' (the ±2^t "
+            f"short-cut exchange schedule)")
     windowed = config.transport_schedule == "windowed" and bool(live_axes)
-    if windowed:
-        if config.num_windows < 1:
+    swing = config.transport_schedule == "swing" and bool(live_axes)
+    if windowed or swing:
+        if windowed and config.num_windows < 1:
             raise ValueError(
                 f"num_windows must be >= 1, got {config.num_windows}")
         if len(live_axes) > 1:
             raise ValueError(
-                f"transport_schedule='windowed' runs the two-phase "
-                f"(reduce-scatter + all-gather) geometry, which needs a "
-                f"single (>1) data axis; got {live_axes} — fold the "
+                f"transport_schedule={config.transport_schedule!r} needs "
+                f"a single (>1) data axis; got {live_axes} — fold the "
                 f"parallelism into one axis or use the fused schedule")
         win_axis = live_axes[0]
-        if config.transport != "int8" \
-                and config.bucket_elems % lax.axis_size(win_axis):
-            raise ValueError(
-                f"transport_schedule='windowed' with a {config.transport} "
-                f"wire scatters each bucket row across the "
-                f"{win_axis!r} axis (size "
-                f"{lax.axis_size(win_axis)} = lax.axis_size"
-                f"({win_axis!r})); choose bucket_elems as a multiple of "
-                f"that size (got {config.bucket_elems})")
 
     def windowed_sum(mat: jnp.ndarray) -> jnp.ndarray:
         """Pipelined two-phase sum of a bucket matrix, padding the bucket
@@ -171,45 +191,88 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         out = pipelined_two_phase_allreduce(mat, win_axis, w)
         return out[:rows]
 
-    if config.transport == "int8":
-        # shared int8 preconditions (exact and masked paths)
+    quantized = config.transport in ("int8", "ef8")
+    if quantized:
+        # shared int8/ef8 preconditions (exact and masked paths)
         int8_axes = live_axes
         if len(int8_axes) > 1:
             raise ValueError(
-                f"int8 transport needs a single (>1) data axis, "
-                f"got {int8_axes}")
+                f"{config.transport} transport needs a single (>1) data "
+                f"axis, got {int8_axes}")
         if quant_key is None:
             raise ValueError(
-                "int8 transport needs quant_key, varied per round — a "
-                "fixed key makes the stochastic-rounding error systematic "
-                "instead of zero-mean across rounds")
+                f"{config.transport} transport needs quant_key, varied "
+                f"per round — a fixed key makes the stochastic-rounding "
+                f"error systematic instead of zero-mean across rounds")
+        if config.transport == "ef8" and residual is None:
+            # fresh-start state; callers that want compensation ACROSS
+            # rounds must thread the returned residual back in
+            residual = jnp.zeros_like(buckets)
     elif config.transport not in ("f32", "bf16"):
         raise ValueError(f"unknown transport {config.transport!r}")
+    # captured AFTER the fresh-start default so the size-1 identity
+    # path still honors the residual contract (ef8 always returns the
+    # buckets-shaped state, never the caller's None back)
+    new_residual = residual if config.transport == "ef8" else None
+
+    def quantized_sum(mat, vmask):
+        """The compressed-wire sum on whichever schedule is selected;
+        updates ``new_residual`` for ef8 (the closure is the one place
+        the schedule x wire matrix is spelled out)."""
+        nonlocal new_residual
+        if not int8_axes:
+            # size-1 identity: nothing moves, nothing rounds — but the
+            # mask still applies (a masked bucket contributes nothing
+            # even to a group of one; count 0 with a live payload would
+            # break the average=False honesty contract)
+            return mat if vmask is None else \
+                mat * vmask.astype(mat.dtype)[:, None]
+        ax = int8_axes[0]
+        if config.transport == "ef8":
+            if swing:
+                out, new_residual = quantized_swing_allreduce(
+                    mat, quant_key, ax, residual=residual, valid=vmask,
+                    block_elems=DEFAULT_EF_BLOCK)
+            else:
+                out, new_residual = ef8_two_phase_allreduce(
+                    mat, quant_key, ax, residual=residual, valid=vmask,
+                    num_windows=config.num_windows if windowed else 1,
+                    block_elems=DEFAULT_EF_BLOCK)
+            return out
+        if swing:
+            out, _ = quantized_swing_allreduce(mat, quant_key, ax,
+                                               valid=vmask)
+            return out
+        contrib = mat if vmask is None else \
+            mat * vmask.astype(mat.dtype)[:, None]
+        return quantized_two_phase_allreduce(
+            contrib, quant_key, ax,
+            num_windows=config.num_windows if windowed else 1)
+
     if valid is None:
         # Exact path (thresholds = 1.0): every rank contributes every
         # bucket, so the masking multiply and the count psum are pure
         # overhead — counts are the static group size. This keeps the
         # whole round at ~2 HBM passes (the reference's fast-path
         # degenerate case: the entire protocol is one sum).
-        if config.transport == "int8":
-            # size-1 axes reduce to identity and don't need a wire format
-            summed = buckets if not int8_axes else \
-                quantized_two_phase_allreduce(
-                    buckets, quant_key, int8_axes[0],
-                    num_windows=config.num_windows if windowed else 1)
+        if quantized:
+            summed = quantized_sum(buckets, None)
         elif use_bf16:
             # the collective's payload dtype IS its wire format: casting
             # the operand halves the bytes every hop moves; the f32
             # master grads/optimizer never see bf16 (cast back before
             # rescale). The fused form works over ANY axis set — no
             # reduce_scatter geometry to satisfy, unlike int8's
-            # two-phase; the windowed form trades that freedom for the
-            # pipelined schedule (single axis, validated above)
+            # two-phase; the windowed/swing forms trade that freedom for
+            # their schedules (single axis, validated above)
             wire = buckets.astype(jnp.bfloat16)
             summed = (windowed_sum(wire) if windowed else
+                      swing_allreduce(wire, win_axis) if swing else
                       psum_all(wire, config.axis_name)).astype(jnp.float32)
         elif windowed:
             summed = windowed_sum(buckets)
+        elif swing:
+            summed = swing_allreduce(buckets, win_axis)
         else:
             summed = psum_all(buckets, config.axis_name)
         group = 1
@@ -219,19 +282,17 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         if config.average:
             summed = summed * (config.rescale_target / group)
     else:
-        if config.transport == "int8":
-            # Lossy rounds keep the int8 wire: a masked rank's zeroed
-            # contribution quantizes to exact zeros (scale of an all-zero
-            # row is the epsilon floor, values round to 0), so masking
-            # commutes with quantization; the per-bucket counts ride a
+        if quantized:
+            # Lossy rounds keep the compressed wire: a masked rank's
+            # zeroed contribution quantizes to exact zeros (scale of an
+            # all-zero row is the epsilon floor, values round to 0), so
+            # masking commutes with quantization — and an ef8 masked
+            # row's residual carries over UNCHANGED (a protocol drop is
+            # not a compression error). The per-bucket counts ride a
             # separate exact int32 psum — tiny next to the payload, and
             # the honesty contract (reference: ReduceBlock.count,
             # AllreduceMessage.scala:20) tolerates no rounding.
-            contrib = buckets * valid.astype(buckets.dtype)[:, None]
-            summed = contrib if not int8_axes else \
-                quantized_two_phase_allreduce(
-                    contrib, quant_key, int8_axes[0],
-                    num_windows=config.num_windows if windowed else 1)
+            summed = quantized_sum(buckets, valid)
             bucket_counts = psum_all(valid.astype(jnp.int32),
                                      config.axis_name)
         elif use_bf16:
@@ -241,19 +302,21 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
             contrib = (buckets * valid.astype(buckets.dtype)[:, None]
                        ).astype(jnp.bfloat16)
             summed = (windowed_sum(contrib) if windowed else
+                      swing_allreduce(contrib, win_axis) if swing else
                       psum_all(contrib,
                                config.axis_name)).astype(jnp.float32)
             bucket_counts = psum_all(valid.astype(jnp.int32),
                                      config.axis_name)
-        elif windowed:
-            # lossy + windowed: the masked payload rides the pipelined
-            # schedule, but the per-bucket counts stay on ONE exact
-            # int32 psum over the full bucket axis — windowing the
-            # honesty contract would buy nothing (counts are tiny) and
-            # fragment the one collective whose exactness is the
+        elif windowed or swing:
+            # lossy + windowed/swing: the masked payload rides the
+            # selected schedule, but the per-bucket counts stay on ONE
+            # exact int32 psum over the full bucket axis — scheduling
+            # the honesty contract would buy nothing (counts are tiny)
+            # and fragment the one collective whose exactness is the
             # contract
-            summed = windowed_sum(
-                buckets * valid.astype(buckets.dtype)[:, None])
+            contrib = buckets * valid.astype(buckets.dtype)[:, None]
+            summed = (windowed_sum(contrib) if windowed else
+                      swing_allreduce(contrib, win_axis))
             bucket_counts = psum_all(valid.astype(jnp.int32),
                                      config.axis_name)
         else:
@@ -281,4 +344,5 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         counts_tree = vector_to_tree(per_elem, counts_spec)
     return GradSyncResult(grads=out_tree, counts=counts_tree,
                           bucket_counts=bucket_counts, spec=spec,
-                          transport=config.transport)
+                          transport=config.transport,
+                          residual=new_residual)

@@ -244,7 +244,7 @@ class CheckpointManager:
 
     def save(self, step: int, params: Any, opt_state: Any,
              extra: Optional[dict] = None, force: bool = False,
-             ema: Any = None) -> bool:
+             ema: Any = None, sync: Any = None) -> bool:
         """Save unconditionally (``force``) or per the interval policy.
         Returns whether a save actually happened.
 
@@ -257,10 +257,21 @@ class CheckpointManager:
         in ``opt_state`` (what resume needs, structure intact) and once
         as the ``ema`` item (what template-free consumers read); the
         ``ema`` item is authoritative for consumers, and the cost is one
-        params-sized tree per retained checkpoint."""
+        params-sized tree per retained checkpoint.
+
+        ``sync`` is the gradient-transport state (the ef8 error-
+        feedback residual, ISSUE 9) — its own item so resumes of
+        non-ef8 runs never pay for it and weights-only consumers never
+        see it; restore it with ``restore_params(template,
+        item="sync")``. A resumed ef8 run that skips it restarts the
+        residual at zero (safe, loses one residual of compensation);
+        restoring it is what makes the resume bitwise
+        (tests/test_ef8_grad_sync.py)."""
         items = {"params": params, "opt_state": opt_state}
         if ema is not None:
             items["ema"] = ema
+        if sync is not None:
+            items["sync"] = sync
         if self.config.single_process:
             # orbax refuses process-LOCAL device arrays in a multi-
             # process job ("host local jax.Array"); the island's arrays
@@ -278,10 +289,11 @@ class CheckpointManager:
         return bool(saved)
 
     def maybe_save(self, step: int, params: Any, opt_state: Any,
-                   extra: Optional[dict] = None, ema: Any = None) -> bool:
+                   extra: Optional[dict] = None, ema: Any = None,
+                   sync: Any = None) -> bool:
         """Interval-gated save — safe to call every round."""
         return self.save(step, params, opt_state, extra, force=False,
-                         ema=ema)
+                         ema=ema, sync=sync)
 
     # -- restore -------------------------------------------------------------
 

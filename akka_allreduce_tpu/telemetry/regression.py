@@ -46,7 +46,7 @@ from typing import Optional
 
 # sections the gate knows how to re-measure, in bank order
 SECTIONS = ("serving_throughput", "multi_step_decode", "paged_serving",
-            "replicated_serving", "ab_overlap")
+            "replicated_serving", "ab_overlap", "quantized_collectives")
 
 # per-section relative tolerance, derived from the banked captures' own
 # recorded run-to-run spread (module docstring); _DEFAULT for unknowns
@@ -64,6 +64,11 @@ SECTION_TOLERANCE = {
     # same noise regime as the serving sections
     "replicated_serving": 0.45,
     "ab_overlap": 0.35,
+    # ISSUE 9: swing/ef8 goodput as a fraction of the fused psum,
+    # measured back-to-back in one run — two-point deltas on a shared
+    # box swing like the serving ratios, so the same 0.45 (< 0.5 keeps
+    # the 2x-regression acceptance property)
+    "quantized_collectives": 0.45,
 }
 _DEFAULT_TOLERANCE = 0.35
 
@@ -236,6 +241,15 @@ def fresh_rows(section: str) -> list:
     if section == "ab_overlap":
         from akka_allreduce_tpu.bench import measure_ab_overlap
         return list(measure_ab_overlap())
+    if section == "quantized_collectives":
+        from akka_allreduce_tpu.bench import (
+            measure_quantized_collectives)
+        # same shapes as the banked capture on every platform (the
+        # per-platform round defaults live in the measure function);
+        # CPU needs the virtual-device mesh or the arms collapse to
+        # the identity sync (the tier1 perfgate step sets XLA_FLAGS=
+        # --xla_force_host_platform_device_count=8 for exactly this)
+        return list(measure_quantized_collectives())
     raise ValueError(f"unknown section {section!r}; have {SECTIONS}")
 
 

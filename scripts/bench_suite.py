@@ -89,7 +89,7 @@ def main(only=None) -> int:
                (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                 ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
                 serving_throughput, multi_step_decode, paged_serving,
-                replicated_serving)}
+                replicated_serving, quantized_collectives)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -173,7 +173,7 @@ def main(only=None) -> int:
     for fn in (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
                serving_throughput, multi_step_decode, paged_serving,
-               replicated_serving):
+               replicated_serving, quantized_collectives):
         if fn.__name__ not in skip:
             fn()
     return 0
@@ -284,6 +284,23 @@ def replicated_serving():
         rows = measure_replicated_serving()
     for row in rows:
         emit(row["metric"], row["value"], row["unit"], row["note"])
+
+
+def quantized_collectives():
+    """The ISSUE 9 transport A/B (akka_allreduce_tpu.bench
+    measure_quantized_collectives): fused f32 psum vs the Swing ±2^t
+    short-cut schedule and the ef8 (block-quantized + error-feedback)
+    wire on the canonical 2.5M/25M payloads. The
+    ``*_speedup_*`` rows are the gated claims — on CPU (and one chip)
+    they gate the transports' COST, not a win; the multi-chip win needs
+    the TPU capture window (capture_tpu_numbers.py step 5). CPU wants
+    >= 2 virtual devices (XLA_FLAGS=--xla_force_host_platform_device_
+    count=8, the tier-1 perfgate invocation's setting) or the arms
+    collapse to the identity sync."""
+    from akka_allreduce_tpu.bench import measure_quantized_collectives
+
+    for row in measure_quantized_collectives():
+        print(json.dumps(row), flush=True)
 
 
 def ab_overlap():

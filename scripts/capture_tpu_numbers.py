@@ -87,6 +87,18 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "replicated_serving"], check=False)
 """),
+    # 5. the quantized/topology-aware collectives A/B (ISSUE 9's open
+    # claim): fused f32 psum vs the Swing ±2^t short-cut schedule and
+    # the ef8 block-quantized + error-feedback wire at 2.5M/25M floats
+    # — CPU rows banked in perf_capture/quantized_collectives.json
+    # (8 virtual devices, cost gate only); this is the on-chip row
+    # where the schedules can actually WIN. Fresh subprocess so the
+    # latency-hiding flags land before backend init, like ab_overlap.
+    ("quantized_collectives", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "quantized_collectives"], check=False)
+"""),
     # 3. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time. guard_recompiles: every timed run holds under the

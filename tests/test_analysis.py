@@ -67,6 +67,10 @@ class TestCleanEntrypointsStayClean:
         "engine_step_telemetry",
         "collective_fused", "collective_windowed",
         "collective_int8", "collective_bf16",
+        # ISSUE 9: the swing short-cut schedule (exchange-count lint)
+        # and the error-feedback wire (residual threaded, int8
+        # discipline + exact counts) pinned lint-clean
+        "collectives_swing", "collectives_ef8",
     ])
     def test_fast_entrypoints_lint_clean(self, target):
         from akka_allreduce_tpu.analysis.entrypoints import ENTRYPOINTS
@@ -111,7 +115,8 @@ class TestCleanEntrypointsStayClean:
         its KV pool (+ logits) with the markers surviving lowering, its
         page TABLE rides as a non-donated int32 operand (the builder
         raises on violation — re-asserted here over the flat record),
-        the catalog carries 17 entries, and the traced program is
+        the catalog carries 19 entries (ISSUE 9 added
+        collectives_swing + collectives_ef8), and the traced program is
         host-sync clean."""
         import jax.numpy as jnp
 
@@ -119,7 +124,7 @@ class TestCleanEntrypointsStayClean:
             ENTRYPOINTS,
             build_engine_paged_step,
         )
-        assert len(ENTRYPOINTS) == 17
+        assert len(ENTRYPOINTS) == 19
         ctx = build_engine_paged_step()
         declared = sum(ctx.donated)
         assert declared >= 3  # k, v, logits at minimum
@@ -156,6 +161,29 @@ class TestCleanEntrypointsStayClean:
                   if f.severity in ("error", "warning")]
         assert not gating, [f"[{f.pass_name}] {f.message}"
                             for f in gating]
+
+    def test_collectives_swing_exchange_count(self):
+        """ISSUE 9 structural pin: the swing entry's jaxpr carries
+        exactly log2(group) ppermute exchanges (dp=2 -> 1), and the
+        quantized ef8 entry keeps its reduce/gather phases paired (the
+        pass would flag both; this pins the raw counts so a pass
+        refactor cannot silently stop looking)."""
+        from akka_allreduce_tpu.analysis.entrypoints import (
+            build_collectives_ef8,
+            build_collectives_swing,
+        )
+        ctx = build_collectives_swing()
+        pp = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
+                 if eqn.primitive.name == "ppermute")
+        assert pp == 1, pp  # log2(2) exchanges
+        ctx8 = build_collectives_ef8()
+        a2a = sum(1 for eqn, _ in iter_eqns(ctx8.jaxpr)
+                  if eqn.primitive.name == "all_to_all")
+        ag = sum(1 for eqn, _ in iter_eqns(ctx8.jaxpr)
+                 if eqn.primitive.name == "all_gather")
+        # values + scales ride separate collectives: 2 all_to_alls in
+        # phase 1, 2 all_gathers in phase 2 — paired
+        assert a2a == ag == 2, (a2a, ag)
 
     def test_train_step_donates_and_pairs(self):
         """The flagship claims, asserted structurally (not just "no

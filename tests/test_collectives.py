@@ -52,10 +52,17 @@ class TestExactAllreduce:
         np.testing.assert_allclose(np.asarray(fused), np.asarray(phased),
                                    rtol=1e-5)
 
-    def test_two_phase_rejects_indivisible_buckets(self, mesh):
-        stacked = jnp.ones((N, 10), dtype=jnp.float32)
-        with pytest.raises(ValueError, match="not divisible"):
-            exact_allreduce(stacked, mesh, two_phase=True)
+    def test_two_phase_accepts_indivisible_buckets(self, mesh):
+        """ISSUE 9 satellite: payload lengths the group does not divide
+        used to hard-error; the two-phase geometry now zero-pads and
+        trims, and the kept region equals the psum bitwise."""
+        rng = np.random.default_rng(9)
+        stacked = jnp.asarray(rng.normal(size=(N, 10)).astype(np.float32))
+        fused = exact_allreduce(stacked, mesh, two_phase=False)
+        phased = exact_allreduce(stacked, mesh, two_phase=True)
+        assert phased.shape == (N, 10)
+        np.testing.assert_array_equal(np.asarray(fused),
+                                      np.asarray(phased))
 
     def test_readme_demo_config_on_two_ranks(self):
         """README CPU baseline: 2 workers, dataSize=10

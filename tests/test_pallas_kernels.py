@@ -16,9 +16,13 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from akka_allreduce_tpu.ops.pallas_kernels import (
+    block_scales,
     dequantize_int8,
+    dequantize_int8_block,
     fused_masked_reduce,
     pallas_ring_allreduce,
+    quantize_int8_block,
+    quantize_int8_block_rtn,
     quantize_int8_stochastic,
 )
 from akka_allreduce_tpu.parallel.mesh import single_axis_mesh
@@ -86,6 +90,55 @@ class TestQuantized:
         mean_err = abs(acc / n - 0.37).mean()
         step = float(np.asarray(s).ravel()[0])
         assert mean_err < 0.2 * step, (mean_err, step)
+
+
+class TestBlockQuantized:
+    """The ISSUE 9 block-scale kernels: one scale per 128-lane column
+    tile instead of per row, stochastic (wire) and deterministic-RTN
+    (error-feedback) rounding — interpreter-mode exact against the jnp
+    oracle in ops/collectives._quantize_blocks."""
+
+    def test_rtn_round_trip_within_half_step(self):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(4, 300)).astype(np.float32))
+        v, s = quantize_int8_block_rtn(x, 128, interpret=True)
+        assert v.shape == (4, 300) and s.shape == (4, 3)
+        back = dequantize_int8_block(v, s, 128, interpret=True)
+        step = np.asarray(s).repeat(128, axis=1)[:, :300]
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        assert (err <= 0.5 * step + 1e-7).all()
+
+    def test_block_scales_isolate_outliers_within_a_row(self):
+        x = jnp.ones((1, 256), jnp.float32)
+        x = x.at[0, 0].set(1000.0)  # outlier in block 0 only
+        s = np.asarray(block_scales(x, 128)).ravel()
+        assert s[0] == pytest.approx(1000.0 / 127.0)
+        assert s[1] == pytest.approx(1.0 / 127.0)  # block 1 unharmed
+
+    def test_stochastic_block_kernel_matches_rule(self):
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        bits = jax.random.bits(jax.random.key(0), x.shape,
+                               dtype=jnp.uint32)
+        v, s = quantize_int8_block(x, bits, 128, interpret=True)
+        back = dequantize_int8_block(v, s, 128, interpret=True)
+        step = np.asarray(s).repeat(128, axis=1)
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        assert (err <= step * 1.001).all()
+
+    def test_kernel_matches_jnp_oracle_bitwise(self):
+        from akka_allreduce_tpu.ops.collectives import _quantize_blocks
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(3, 260)).astype(np.float32))
+        vk, sk = quantize_int8_block_rtn(x, 128, interpret=True)
+        vj, sj = _quantize_blocks(x, 128)  # jnp form (CPU default)
+        np.testing.assert_array_equal(np.asarray(vk), np.asarray(vj))
+        np.testing.assert_array_equal(np.asarray(sk), np.asarray(sj))
+
+    def test_non_lane_multiple_block_rejected(self):
+        x = jnp.ones((2, 256), jnp.float32)
+        with pytest.raises(ValueError, match="128"):
+            quantize_int8_block_rtn(x, 100, interpret=True)
 
 
 @pytest.mark.slow  # EXPERIMENTAL kernel (ring.py): pending real

@@ -102,29 +102,42 @@ class TestPipelinedValidation:
         with pytest.raises(ValueError, match="num_windows"):
             run(jnp.ones((N, 4, 16), jnp.float32))
 
-    def test_divisibility_error_names_axis_size_source(self):
-        """Satellite: the error must say WHERE the group size came from
-        (lax.axis_size of the named mesh axis), not just the number."""
+    def test_indivisible_last_axis_pads_and_trims(self):
+        """ISSUE 9 satellite: geometry the group size does not divide is
+        satisfied by construction (zero-pad at the END of the axis,
+        trim after the gather) instead of the old hard assert — and the
+        kept region is BITWISE the psum, because trailing zeros change
+        no kept element's reduction tree."""
         mesh = single_axis_mesh("dp")
+        rng = np.random.default_rng(23)
+        stacked = jnp.asarray(
+            rng.normal(size=(N, 4, 10)).astype(np.float32))  # 10 % 8 != 0
 
         @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
-                 out_specs=P("dp"), check_vma=False)
+                 out_specs=(P("dp"), P("dp")), check_vma=False)
         def run(b):
-            return two_phase_allreduce(b[0], "dp")[None]
+            return (two_phase_allreduce(b[0], "dp")[None],
+                    lax.psum(b[0], "dp")[None])
 
-        with pytest.raises(ValueError, match=r"lax\.axis_size\('dp'\)"):
-            run(jnp.ones((N, 4, 10), jnp.float32))
+        t, p = run(stacked)
+        assert t.shape == p.shape == (N, 4, 10)
+        np.testing.assert_array_equal(np.asarray(t), np.asarray(p))
 
-    def test_windowed_divisibility_error_names_axis_size_source(self):
+    def test_windowed_indivisible_last_axis_pads_and_trims(self):
         mesh = single_axis_mesh("dp")
+        rng = np.random.default_rng(29)
+        stacked = jnp.asarray(
+            rng.normal(size=(N, 4, 10)).astype(np.float32))
 
         @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
-                 out_specs=P("dp"), check_vma=False)
+                 out_specs=(P("dp"), P("dp")), check_vma=False)
         def run(b):
-            return pipelined_two_phase_allreduce(b[0], "dp", 2)[None]
+            return (pipelined_two_phase_allreduce(b[0], "dp", 2)[None],
+                    lax.psum(b[0], "dp")[None])
 
-        with pytest.raises(ValueError, match=r"lax\.axis_size\('dp'\)"):
-            run(jnp.ones((N, 4, 10), jnp.float32))
+        w, p = run(stacked)
+        assert w.shape == p.shape == (N, 4, 10)
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(p))
 
 
 def _sync(grads, cfg, valid=None, key=None, n=N):
@@ -293,20 +306,31 @@ class TestGradSyncWindowed:
         with pytest.raises(ValueError, match="transport_schedule"):
             run({"w": jnp.ones((8,), jnp.float32)})
 
-    def test_indivisible_bucket_elems_rejected(self):
+    def test_indivisible_bucket_elems_accepted(self):
+        """ISSUE 9 satellite: bucket_elems the axis size does not divide
+        used to hard-error on the windowed schedule; the pad-and-trim
+        geometry now accepts any bucket size, and the result stays
+        bitwise the fused sum."""
         mesh = single_axis_mesh("dp")
-        cfg = GradSyncConfig(bucket_elems=60, axis_name="dp",
-                             average=True, rescale_target=float(N),
-                             return_elem_counts=False,
-                             transport_schedule="windowed")
+        rng = np.random.default_rng(31)
+        g = {"w": jnp.asarray(rng.normal(size=(120,)).astype(np.float32))}
+        fused = GradSyncConfig(bucket_elems=60, axis_name="dp",
+                               average=True, rescale_target=float(N),
+                               return_elem_counts=False)
+        windowed = GradSyncConfig(bucket_elems=60, axis_name="dp",
+                                  average=True, rescale_target=float(N),
+                                  return_elem_counts=False,
+                                  transport_schedule="windowed",
+                                  num_windows=2)
 
         @partial(jax.shard_map, mesh=mesh, in_specs=P(),
-                 out_specs=P(), check_vma=False)
+                 out_specs=(P(), P()), check_vma=False)
         def run(g):
-            return allreduce_gradients(g, cfg).grads["w"]
+            return (allreduce_gradients(g, fused).grads["w"],
+                    allreduce_gradients(g, windowed).grads["w"])
 
-        with pytest.raises(ValueError, match="bucket_elems"):
-            run({"w": jnp.ones((120,), jnp.float32)})
+        gf, gw = run(g)
+        np.testing.assert_array_equal(np.asarray(gf), np.asarray(gw))
 
     def test_size_one_axis_bypasses_schedule(self):
         """live_axes empty => the schedule reduces to identity exactly
