@@ -44,6 +44,14 @@ engine_paged_step   serving/engine.py _engine_paged_step — the paged
                     so churn/sharing/COW rewrite table data while the
                     program is reused (the paged no-recompile
                     contract); host-sync clean like every hot entry
+engine_speculative_step  serving/engine.py _engine_speculative_step —
+                    the draft-verify block (ISSUE 10): draft decode
+                    steps + one (k+1)-position verify extend + the
+                    accept/reject + emit latch, ONE donated program
+                    (target AND draft caches in one state pytree); no
+                    host sync may ride the accept/reject path, and the
+                    dispatch's output avals must equal the fresh-state
+                    avals (speculative recovery compiles nothing)
 engine_step_telemetry  the SAME engine step traced through an engine
                     with the full telemetry plane armed (tracer,
                     registry-backed metrics, device-span timer) — the
@@ -340,6 +348,72 @@ def build_engine_paged_step() -> LintContext:
     return ctx
 
 
+def build_engine_speculative_step() -> LintContext:
+    """The speculative block dispatch (ISSUE 10): draft proposals +
+    one (k+1)-position verify extend + per-slot accept/reject and the
+    on-device emit latch, traced over a real
+    :class:`~akka_allreduce_tpu.serving.engine.SpeculativeEngine`'s
+    state (target AND draft caches in the one donated pytree).
+    Structural claims asserted at build time:
+
+    * the state (both models' caches + carried logits) is donated —
+      speculation must not double either cache's HBM per block;
+    * the dispatch's output state avals equal the fresh-state avals —
+      the speculative extension of the recovery no-recompile contract
+      (a drifting leaf would recompile on the first watchdog trip);
+    * ≥ 2 scans/loops worth of structure ride ONE program (the draft
+      steps and the emit latch — re-asserted in test_analysis.py).
+    The host-sync pass then walks it like any hot entry: a callback
+    smuggled into the accept/reject path would serialize the block.
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    from akka_allreduce_tpu.models.transformer import init_transformer
+    from akka_allreduce_tpu.serving.engine import (
+        EngineConfig,
+        SpeculativeEngine,
+        _engine_speculative_step,
+    )
+    cfg = _model_cfg()
+    params = init_transformer(jax.random.key(0), cfg)
+    draft_cfg = _dc.replace(cfg, n_layers=1)
+    draft_params = {**params, "layers": params["layers"][:1]}
+    k = 2
+    engine = SpeculativeEngine(
+        params, cfg, draft_params, draft_cfg,
+        EngineConfig(num_slots=2, draft_steps=k))
+    pos = jnp.zeros((2,), jnp.int32)
+    done = jnp.zeros((2,), bool)
+    remaining = jnp.full((2,), 8, jnp.int32)
+    eos_ids = jnp.full((2,), -1, jnp.int32)
+    stop_ids = jnp.full((2, 4), -1, jnp.int32)
+    step_idx = jnp.zeros((2,), jnp.int32)
+    steady = jax.eval_shape(
+        lambda p, dp, s, q, d, r, e, st, si: _engine_speculative_step(
+            p, dp, s, q, d, r, e, st, si, None, cfg, draft_cfg, k,
+            None),
+        params, draft_params, engine._state, pos, done, remaining,
+        eos_ids, stop_ids, step_idx)[0]
+    mismatch = [
+        n for n in set(steady) | set(engine._state)
+        if (n not in steady or n not in engine._state
+            or steady[n].shape != engine._state[n].shape
+            or steady[n].dtype != engine._state[n].dtype)]
+    if mismatch:
+        raise RuntimeError(
+            f"engine_speculative_step: dispatch output avals diverge "
+            f"from the fresh state's at {sorted(mismatch)} — "
+            f"speculative recovery would recompile")
+    policy = LintPolicy(expect_donation=True, hot=True)
+    return trace_entry(
+        "engine_speculative_step", _engine_speculative_step,
+        (params, draft_params, engine._state, pos, done, remaining,
+         eos_ids, stop_ids, step_idx, None, cfg, draft_cfg, k, None),
+        policy, donate_argnums=(2,), static_argnums=(10, 11, 12, 13))
+
+
 def build_engine_step_telemetry() -> LintContext:
     """ISSUE 6's zero-callback pin: construct a ServingEngine with the
     ENTIRE telemetry plane armed — Tracer, registry-backed
@@ -596,6 +670,7 @@ ENTRYPOINTS = {
     "engine_step": build_engine_step,
     "engine_multi_step": build_engine_multi_step,
     "engine_paged_step": build_engine_paged_step,
+    "engine_speculative_step": build_engine_speculative_step,
     "engine_prefill": build_engine_prefill,
     "engine_recovery": build_engine_recovery,
     "engine_step_telemetry": build_engine_step_telemetry,

@@ -898,6 +898,181 @@ def measure_multi_step_decode(d_model: int = 512, n_layers: int = 4,
     return rows
 
 
+def measure_speculative_serving(d_model: int = 64, n_layers: int = 2,
+                                d_ff: int = 256, vocab: int = 512,
+                                n_requests: int = 4,
+                                prompt_len: int = 16, steps: int = 32,
+                                slots: int = 1, k: int = 6,
+                                temperature: float = 0.7,
+                                top_k: int = 32, reps: int = 3,
+                                seed: int = 0) -> list:
+    """Speculative decode vs the sampled non-speculative engine at
+    equal slots — the ISSUE 10 A/B behind `serve --speculative`.
+
+    Default slots=1: speculation is the LATENCY tool (it trades extra
+    verify FLOPs for sequential depth — models/speculate.py's batch-1
+    rule holds for the engine too), so the canonical operating point
+    is the per-stream regime where each emitted token otherwise costs
+    one full dispatch; wide-batch throughput serving keeps the plain
+    (or fused-block) engine.
+
+    Speculation wins when the draft is CHEAP and predicts the target
+    WELL — a property of trained/distilled weight pairs this harness
+    cannot train. To measure the serving mechanics at a realistic
+    operating point anyway, the bench target's back-half layers have
+    their residual output projections attenuated (x1e-3), so its
+    first-half truncation — the serve CLI's own draft construction —
+    is a stand-in for a well-distilled draft: ~half the per-token
+    FLOPs, acceptance near 1. Every arm serves this SAME target, so
+    the A/B stays apples-to-apples:
+
+    * BASE — the per-token sampled engine (decode_steps=1): one
+      dispatch + readback per token, the cost speculation amortizes;
+    * BLOCK — the fused sampled S=k+1 engine: the NON-speculative way
+      to buy the same dispatch amortization (context row; speculation
+      must beat it exactly where the draft is cheaper than the
+      target);
+    * SPEC — the speculative engine with the half-layer draft: the
+      gated ``speculative_serving_speedup`` claim (vs BASE), its
+      measured acceptance banked alongside;
+    * SELF — the draft = the target itself: acceptance ~1 at FULL
+      draft cost, isolating the draft-verify structure's price
+      (informational).
+
+    Tokens/s counts CONSUMED tokens only — rejected drafts are waste,
+    charged exactly as production would."""
+    import dataclasses as _dc
+
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.serving import (EngineConfig, Request,
+                                            RequestScheduler,
+                                            SchedulerConfig,
+                                            ServingEngine,
+                                            SpeculativeEngine,
+                                            serve_loop)
+
+    plat = jax.devices()[0].platform
+    mcfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model,
+        n_heads=max(1, d_model // 64), n_layers=n_layers, d_ff=d_ff,
+        max_seq=prompt_len + steps + k + 1)
+    params = init_transformer(jax.random.key(seed), mcfg)
+    half = max(1, n_layers // 2)
+    # attenuate the back half's residual contributions: the truncated
+    # draft then PREDICTS this target (the distilled-pair stand-in);
+    # the target still pays its full per-token compute
+    atten = []
+    for i, layer in enumerate(params["layers"]):
+        if i < half:
+            atten.append(layer)
+        else:
+            atten.append({nm: (w * 1e-3 if nm in ("wo", "w2") else w)
+                          for nm, w in layer.items()})
+    params = {**params, "layers": atten}
+    drafts = {
+        "self": (params, mcfg),
+        "spec": ({**params, "layers": params["layers"][:half]},
+                 _dc.replace(mcfg, n_layers=half)),
+    }
+    rng = np.random.default_rng(seed)
+    prompts = rng.integers(0, vocab, size=(n_requests, prompt_len),
+                           dtype=np.int32)
+    total_tokens = n_requests * steps
+    sample_kw = dict(temperature=temperature, top_k=top_k)
+
+    def make_requests():
+        return [Request(rid=rid, prompt=tuple(int(x) for x in p),
+                        max_new_tokens=steps, seed=1000 + rid,
+                        submitted_at=0.0)
+                for rid, p in enumerate(prompts)]
+
+    def build(kind):
+        if kind == "base":
+            engine = ServingEngine(
+                params, mcfg, EngineConfig(num_slots=slots,
+                                           **sample_kw))
+        elif kind == "block":
+            engine = ServingEngine(
+                params, mcfg, EngineConfig(num_slots=slots,
+                                           decode_steps=k + 1,
+                                           **sample_kw))
+        else:
+            dp, dc = drafts[kind]
+            engine = SpeculativeEngine(
+                params, mcfg, dp, dc,
+                EngineConfig(num_slots=slots, draft_steps=k,
+                             **sample_kw))
+        sched = RequestScheduler(SchedulerConfig(), num_slots=slots)
+        for r in make_requests():
+            sched.submit(r)
+        return engine, sched
+
+    def run(pair):
+        serve_loop(*pair, max_dispatches=total_tokens + n_requests + 16)
+
+    rows = []
+    results = {}
+    for kind in ("base", "block", "spec", "self"):
+        _log(f"speculative_serving: arm={kind} at {slots} slots, "
+             f"k={k}")
+        warm = build(kind)
+        run(warm)
+        t_best = float("inf")
+        engine = warm[0]
+        for _ in range(reps):
+            engine, sched = build(kind)
+            t_best = min(t_best, _timed(lambda: run((engine, sched))))
+        tok_s = total_tokens / t_best
+        results[kind] = tok_s
+        acc = (engine.acceptance_rate
+               if isinstance(engine, SpeculativeEngine) else None)
+        note = (f"{slots} slots, {n_requests} requests x {steps} "
+                f"tokens, temperature={temperature}/top_k={top_k}, "
+                f"{engine.decode_dispatches} dispatches")
+        if kind == "block":
+            note += (f"; fused S={k + 1} sampled blocks — the "
+                     f"non-speculative dispatch-amortization row "
+                     f"speculation must beat where the draft is "
+                     f"cheaper than the target")
+        if acc is not None:
+            note += (f"; k={k}, acceptance {acc:.3f}, rejected "
+                     f"drafts charged to waste")
+        if kind == "spec":
+            note += ("; half-layer draft over the back-half-"
+                     "attenuated target — the distilled-pair "
+                     "stand-in (draft ~half per-token FLOPs)")
+        if kind == "self":
+            note += ("; draft = the target itself: acceptance~1 at "
+                     "FULL draft cost — prices the draft-verify "
+                     "structure alone (informational)")
+        rows.append({
+            "metric": f"speculative_serving_{kind}_tok_s_{plat}",
+            "value": round(tok_s, 1), "unit": "tok/s", "note": note})
+        if kind == "spec":
+            rows.append({
+                "metric": "speculative_serving_acceptance",
+                "value": round(acc, 3), "unit": "rate",
+                "note": f"half-layer distilled-stand-in draft "
+                        f"acceptance at k={k}, {steps}-token budgets"})
+    rows.append({
+        "metric": "speculative_serving_speedup",
+        "value": round(results["spec"] / results["base"], 3),
+        "unit": "x",
+        "note": f"speculative (half-layer distilled-stand-in draft, "
+                f"k={k}) vs sampled S=1 engine at {slots} slots "
+                f"({plat}); consumed tokens only — rejected-draft "
+                f"waste already charged"})
+    rows.append({
+        "metric": "speculative_serving_self_ratio",
+        "value": round(results["self"] / results["base"], 3),
+        "unit": "x",
+        "note": "full-cost self-draft vs sampled S=1 — the structure "
+                "price with zero draft-compute advantage "
+                "(informational, not gated)"})
+    return rows
+
+
 def measure_paged_serving(d_model: int = 256, n_layers: int = 2,
                           d_ff: int = 1024, vocab: int = 1024,
                           n_requests: int = 24, prompt_len: int = 16,

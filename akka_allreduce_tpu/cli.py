@@ -2044,6 +2044,50 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "program count); empty = one exact-length "
                         "program per distinct prompt length (the "
                         "bitwise-parity mode)")
+    # -- sampling + speculative decode (ISSUE 10)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="sampling temperature for every decode pick: "
+                        "0 (default) = greedy (the bitwise-parity "
+                        "mode); > 0 samples per slot with a seeded "
+                        "per-REQUEST key stream — tokens are bitwise "
+                        "reproducible and invariant to slot placement, "
+                        "churn and restore, and (plain engines) match "
+                        "generate(key=key(seed), temperature=...) "
+                        "exactly. Combined with --speculative the "
+                        "stream keeps the same DISTRIBUTION and "
+                        "seed-determinism but uses the speculative "
+                        "key schedule, so it is not token-for-token "
+                        "generate()'s")
+    p.add_argument("--top-k", type=int, default=None,
+                   help="with --temperature > 0: keep only the k "
+                        "most-likely tokens before sampling")
+    p.add_argument("--top-p", type=float, default=None,
+                   help="with --temperature > 0: nucleus filter — keep "
+                        "the smallest set of tokens reaching this "
+                        "probability mass")
+    p.add_argument("--speculative", action="store_true",
+                   help="draft-verify speculative decode "
+                        "(SpeculativeEngine): a small draft model "
+                        "(--draft-layers of the target) proposes "
+                        "--draft-steps tokens per slot and ONE target "
+                        "verify dispatch scores all of them — up to "
+                        "draft_steps+1 tokens per host round-trip. "
+                        "Greedy output (temperature 0) stays bitwise "
+                        "generate()'s; acceptance-rate and rejected-"
+                        "draft waste ride the summary. Composes with "
+                        "--paged (the draft KV gets its own small page "
+                        "pool); not with --decode-steps, "
+                        "--prefill-buckets or --replicas")
+    p.add_argument("--draft-steps", type=int, default=4, metavar="K",
+                   help="with --speculative: draft tokens proposed per "
+                        "slot per block (one verify scores K+1 "
+                        "positions). Tune against the summary's "
+                        "acceptance_rate (OPERATIONS.md)")
+    p.add_argument("--draft-layers", type=int, default=0, metavar="N",
+                   help="with --speculative: the draft model = the "
+                        "target's first N layers (embed/unembed "
+                        "shared). 0 (default) = half the target's "
+                        "layers, minimum 1")
     # -- paged KV (ISSUE 7)
     p.add_argument("--paged", action="store_true",
                    help="paged KV engine (serving/paging.py + "
@@ -2403,6 +2447,216 @@ def _serve_selfcheck(args: argparse.Namespace) -> int:
                 engine.device_time_summary()
                 ["dispatch_gap_ms"].get("p50"),
         },
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
+def _serve_speculative_selfcheck(args: argparse.Namespace) -> int:
+    """`serve --selfcheck --speculative`: the ISSUE 10 acceptance run.
+
+    A tiny target + its half-layer draft over churned requests.
+    Asserted, not hoped:
+
+    * THREE-WAY PARITY — the speculative engine at temperature 0 emits
+      every request's tokens bitwise equal to the plain greedy
+      engine's and to standalone ``generate()``'s (add ``--paged`` to
+      run the paged speculative engine through the same gauntlet);
+    * the speculative no-recompile contract — a second run over the
+      same shapes (fresh engines, churn, per-slot acceptance varying
+      block to block) compiles ZERO programs;
+    * the draft ledger reconciles exactly — proposed == accepted +
+      rejected, the engine's counters equal the metrics plane's, and
+      rejected drafts landed in wasted_tokens;
+    * scrape == summary for the new serve_draft_* series (the PR 6
+      contract extended to the speculation plane);
+    * seeded SAMPLED speculation is deterministic: two runs at
+      temperature > 0 with per-request seeds emit identical streams.
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_allreduce_tpu.analysis.recompile import (RecompileError,
+                                                       no_recompiles)
+    from akka_allreduce_tpu.models.generate import generate
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.serving import (EngineConfig,
+                                            PagedEngineConfig,
+                                            PagedSpeculativeEngine,
+                                            Request, RequestScheduler,
+                                            SchedulerConfig,
+                                            ServingEngine,
+                                            ServingMetrics,
+                                            SpeculativeEngine,
+                                            serve_loop)
+    from akka_allreduce_tpu.telemetry import parse_prometheus_text
+
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=48)
+    params = init_transformer(jax.random.key(0), cfg)
+    draft_params, draft_cfg = _make_draft_model(params, cfg, 0)
+    eos = 5
+    slots = 3
+    # honor the operator's k up to the tiny model's headroom; say so
+    # when clamping — a green selfcheck must never claim to have
+    # exercised a k it silently replaced
+    k = min(args.draft_steps, 8)
+    if k != args.draft_steps:
+        print(f"selfcheck: --draft-steps {args.draft_steps} clamped "
+              f"to {k} (the smoke model's max_seq headroom)",
+              file=sys.stderr)
+
+    def make_requests():
+        r = np.random.default_rng(17)
+        return [Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in r.integers(
+                0, cfg.vocab_size, size=int(r.integers(2, 8)))),
+            max_new_tokens=int(r.integers(5, 12)),
+            eos_token=eos if rid % 2 else None,
+            seed=300 + rid,
+            submitted_at=0.0) for rid in range(10)]
+
+    def build_spec(sample_kw=None, metrics=None):
+        ecfg_kw = dict(num_slots=slots, draft_steps=k,
+                       **(sample_kw or {}))
+        if args.paged:
+            engine = PagedSpeculativeEngine(
+                params, cfg, draft_params, draft_cfg,
+                PagedEngineConfig(page_size=4, **ecfg_kw),
+                metrics=metrics)
+        else:
+            engine = SpeculativeEngine(params, cfg, draft_params,
+                                       draft_cfg,
+                                       EngineConfig(**ecfg_kw),
+                                       metrics=metrics)
+        sched = RequestScheduler(SchedulerConfig(), num_slots=slots)
+        return engine, sched
+
+    def run(engine, sched, metrics=None):
+        for r in make_requests():
+            if metrics is not None:
+                metrics.on_submit(r.rid)
+            sched.submit(r)
+        return serve_loop(engine, sched, metrics=metrics,
+                          max_dispatches=600)
+
+    failures = []
+    metrics = ServingMetrics()
+    spec_engine, spec_sched = build_spec(metrics=metrics)
+    results = run(spec_engine, spec_sched, metrics=metrics)
+
+    # three-way parity at temperature 0
+    greedy = ServingEngine(params, cfg, EngineConfig(num_slots=slots))
+    gsched = RequestScheduler(SchedulerConfig(), num_slots=slots)
+    greedy_results = run(greedy, gsched)
+    for r in make_requests():
+        prompt = jnp.asarray(r.prompt, jnp.int32)[None]
+        if r.eos_token is None:
+            want = np.asarray(generate(params, prompt, cfg,
+                                       steps=r.max_new_tokens))[0]
+        else:
+            toks, lengths = generate(params, prompt, cfg,
+                                     steps=r.max_new_tokens,
+                                     eos_token=r.eos_token)
+            want = np.asarray(toks)[0][:int(lengths[0])]
+        got = np.asarray(results[r.rid][0], np.int32)
+        if not np.array_equal(got, want):
+            failures.append(f"rid={r.rid}: speculative {got.tolist()} "
+                            f"!= generate {want.tolist()}")
+        if list(results[r.rid][0]) != list(greedy_results[r.rid][0]):
+            failures.append(f"rid={r.rid}: speculative != greedy "
+                            f"engine")
+
+    # the draft ledger (ISSUE 10 satellite): identity + engine ==
+    # metrics + rejected feeds wasted
+    eng = spec_engine
+    if eng.draft_proposed != eng.draft_accepted + eng.draft_rejected:
+        failures.append(
+            f"ledger identity off: proposed {eng.draft_proposed} != "
+            f"accepted {eng.draft_accepted} + rejected "
+            f"{eng.draft_rejected}")
+    if (metrics.draft_proposed, metrics.draft_accepted,
+            metrics.draft_rejected) != (eng.draft_proposed,
+                                        eng.draft_accepted,
+                                        eng.draft_rejected):
+        failures.append("engine vs metrics draft ledgers disagree")
+    if metrics.wasted_tokens < eng.draft_rejected:
+        failures.append(
+            f"rejected drafts not charged to waste: wasted "
+            f"{metrics.wasted_tokens} < rejected {eng.draft_rejected}")
+    if eng.draft_proposed < 1:
+        failures.append("no draft tokens proposed — speculation "
+                        "never ran")
+
+    # scrape == summary for the serve_draft_* series (guarded: a run
+    # that proposed nothing already failed above, and summary() only
+    # emits the speculative block when speculation ran — the selfcheck
+    # must report that failure, not die on a KeyError)
+    prom = parse_prometheus_text(metrics.registry.to_prometheus_text())
+    summ = metrics.summary()
+    for series, key in (("serve_draft_proposed_total",
+                         "draft_proposed"),
+                        ("serve_draft_accepted_total",
+                         "draft_accepted"),
+                        ("serve_draft_rejected_total",
+                         "draft_rejected")):
+        got = prom.get((series, ()))
+        want = summ.get("speculative", {}).get(key)
+        if got != want:
+            failures.append(f"prometheus {series} {got} != summary "
+                            f"{want}")
+
+    # the speculative no-recompile contract: fresh engines, same
+    # request shapes, acceptance varying per block — zero compiles
+    try:
+        with no_recompiles("speculative selfcheck churn (warmed "
+                           "shapes)"):
+            eng2, sched2 = build_spec()
+            results2 = run(eng2, sched2)
+    except RecompileError as exc:
+        failures.append(str(exc))
+        results2 = {}
+    for rid, out in results2.items():
+        if list(out[0]) != list(results[rid][0]):
+            failures.append(f"rid={rid}: speculative churn run "
+                            f"diverged")
+
+    # seeded sampled speculation: two runs, identical streams
+    sample_kw = dict(temperature=1.3, top_k=16)
+    sa, ssa = build_spec(sample_kw=sample_kw)
+    ra = run(sa, ssa)
+    sb, ssb = build_spec(sample_kw=sample_kw)
+    rb = run(sb, ssb)
+    for rid in ra:
+        if list(ra[rid][0]) != list(rb[rid][0]):
+            failures.append(f"rid={rid}: sampled speculative runs "
+                            f"diverged (seeded determinism broken)")
+
+    if args.paged:
+        spec_engine.pool.check_invariants()
+        spec_engine.draft_pool.check_invariants()
+        if spec_engine.pool.pages_in_use \
+                or spec_engine.draft_pool.pages_in_use:
+            failures.append("speculative page pools not drained")
+
+    print(json.dumps({
+        "selfcheck": "ok" if not failures else "FAIL",
+        "speculative": True,
+        "paged": args.paged,
+        "draft_steps": k,
+        "requests": len(make_requests()),
+        "acceptance_rate": round(eng.acceptance_rate, 4),
+        "draft_proposed": eng.draft_proposed,
+        "draft_accepted": eng.draft_accepted,
+        "draft_rejected": eng.draft_rejected,
+        "decode_dispatches": eng.decode_dispatches,
+        "greedy_dispatches": greedy.decode_dispatches,
+        "churn_recompiles": 0 if results2 else None,
         "failures": failures,
     }))
     return 0 if not failures else 1
@@ -2926,6 +3180,22 @@ def _serve_replicated_selfcheck(args: argparse.Namespace) -> int:
     return 0 if not failures else 1
 
 
+def _make_draft_model(params: dict, mcfg, draft_layers: int):
+    """The serve CLI's draft model: the target's first N layers with
+    the embed / positional / output-norm / unembed weights SHARED —
+    zero extra parameters, a guaranteed-shared vocabulary, and logits
+    that correlate with the target's (the residual stream keeps the
+    shallow prefix's contribution). 0 = half the target's layers
+    (minimum 1). Checkpoint-backed draft models ride the offline
+    ``generate --draft-ckpt-dir`` path; the serving engine takes any
+    (params, cfg) pair whose vocab matches."""
+    import dataclasses as _dc
+    n = draft_layers or max(1, mcfg.n_layers // 2)
+    draft_cfg = _dc.replace(mcfg, n_layers=n)
+    draft_params = {**params, "layers": params["layers"][:n]}
+    return draft_params, draft_cfg
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     _apply_backend_flags(args)
     # validated BEFORE the selfcheck dispatch: a typo'd S must exit 2,
@@ -2987,7 +3257,67 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "tests/test_replica_router.py + test_paged_engine.py",
               file=sys.stderr)
         return 2
+    # -- sampling / speculative validation (ISSUE 10) ------------------
+    if args.temperature < 0.0:
+        print(f"error: --temperature must be >= 0 (0 = greedy), got "
+              f"{args.temperature}", file=sys.stderr)
+        return 2
+    if args.top_k is not None and args.top_k < 1:
+        print(f"error: --top-k must be >= 1, got {args.top_k}",
+              file=sys.stderr)
+        return 2
+    if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
+        print(f"error: --top-p must be in (0, 1], got {args.top_p}",
+              file=sys.stderr)
+        return 2
+    if (args.top_k is not None or args.top_p is not None) \
+            and args.temperature == 0.0:
+        # the programmatic API mirrors generate() (filters are inert
+        # at temperature 0); the CLI refuses rather than silently
+        # serving greedy under flags that promise sampling
+        print("error: --top-k/--top-p require --temperature > 0 "
+              "(temperature 0 is greedy; the filters would be "
+              "silently ignored)", file=sys.stderr)
+        return 2
+    if args.speculative:
+        if args.draft_steps < 1:
+            print(f"error: --draft-steps must be >= 1, got "
+                  f"{args.draft_steps}", file=sys.stderr)
+            return 2
+        if args.decode_steps > 1:
+            print("error: --speculative and --decode-steps are both "
+                  "block modes (a speculative block already verifies "
+                  "draft-steps+1 tokens per dispatch); pick one",
+                  file=sys.stderr)
+            return 2
+        if args.prefill_buckets.strip():
+            print("error: --speculative prefill is exact-length (the "
+                  "parity mode); drop --prefill-buckets",
+                  file=sys.stderr)
+            return 2
+        if args.replicas > 1:
+            print("error: --speculative is a single-engine mode for "
+                  "now; replicated speculation is an open follow-up "
+                  "(ROADMAP.md)", file=sys.stderr)
+            return 2
+        if args.chaos is not None:
+            print("error: --chaos runs the plain-engine fault matrix; "
+                  "speculative fault recovery is covered by "
+                  "tests/test_speculative_engine.py", file=sys.stderr)
+            return 2
+        if args.paged and args.paged_attention == "pallas":
+            print("error: the speculative verify is a block extend; "
+                  "run --speculative --paged on the gather path",
+                  file=sys.stderr)
+            return 2
+        if args.draft_layers < 0 or args.draft_layers > args.n_layers:
+            print(f"error: --draft-layers must be in [0, --n-layers="
+                  f"{args.n_layers}], got {args.draft_layers}",
+                  file=sys.stderr)
+            return 2
     if args.selfcheck:
+        if args.speculative:
+            return _serve_speculative_selfcheck(args)
         if args.replicas > 1:
             return _serve_replicated_selfcheck(args)
         if args.chaos is not None:
@@ -3150,34 +3480,59 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             stack.enter_context(metrics.registry.start_snapshotter(
                 args.metrics_file, args.metrics_interval))
         try:
+            sample_kw = dict(temperature=args.temperature,
+                             top_k=args.top_k, top_p=args.top_p)
+            draft = None
+            if args.speculative:
+                draft = _make_draft_model(params, mcfg,
+                                          args.draft_layers)
+                print(f"speculative: draft = first "
+                      f"{draft[1].n_layers}/{mcfg.n_layers} target "
+                      f"layers, draft_steps={args.draft_steps}",
+                      file=sys.stderr)
+
             def build_engine():
                 if args.paged:
                     from akka_allreduce_tpu.serving import (
-                        PagedEngineConfig, PagedServingEngine)
-                    return PagedServingEngine(
-                        params, mcfg,
-                        PagedEngineConfig(
-                            num_slots=args.slots,
-                            prefill_buckets=buckets,
-                            kv_dtype="int8" if args.kv_cache == "int8"
-                            else None,
-                            decode_steps=args.decode_steps,
-                            watchdog_timeout_s=args.watchdog_timeout
-                            or None,
-                            page_size=args.page_size,
-                            num_pages=args.num_pages,
-                            attention_impl=args.paged_attention),
-                        tracer=tracer)
-                return ServingEngine(
-                    params, mcfg,
-                    EngineConfig(
-                        num_slots=args.slots, prefill_buckets=buckets,
+                        PagedEngineConfig, PagedServingEngine,
+                        PagedSpeculativeEngine)
+                    pcfg = PagedEngineConfig(
+                        num_slots=args.slots,
+                        prefill_buckets=buckets,
                         kv_dtype="int8" if args.kv_cache == "int8"
                         else None,
                         decode_steps=args.decode_steps,
                         watchdog_timeout_s=args.watchdog_timeout
-                        or None),
-                    tracer=tracer)
+                        or None,
+                        page_size=args.page_size,
+                        num_pages=args.num_pages,
+                        attention_impl=args.paged_attention,
+                        draft_steps=(args.draft_steps
+                                     if args.speculative else 0),
+                        **sample_kw)
+                    if args.speculative:
+                        return PagedSpeculativeEngine(
+                            params, mcfg, draft[0], draft[1], pcfg,
+                            tracer=tracer)
+                    return PagedServingEngine(params, mcfg, pcfg,
+                                              tracer=tracer)
+                from akka_allreduce_tpu.serving import SpeculativeEngine
+                ecfg = EngineConfig(
+                    num_slots=args.slots, prefill_buckets=buckets,
+                    kv_dtype="int8" if args.kv_cache == "int8"
+                    else None,
+                    decode_steps=args.decode_steps,
+                    watchdog_timeout_s=args.watchdog_timeout
+                    or None,
+                    draft_steps=(args.draft_steps
+                                 if args.speculative else 0),
+                    **sample_kw)
+                if args.speculative:
+                    return SpeculativeEngine(params, mcfg, draft[0],
+                                             draft[1], ecfg,
+                                             tracer=tracer)
+                return ServingEngine(params, mcfg, ecfg,
+                                     tracer=tracer)
 
             engines = [build_engine() for _ in range(args.replicas)]
             engine = engines[0]
@@ -3298,6 +3653,13 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                    "decode_steps": args.decode_steps,
                    "max_new_tokens": args.max_new_tokens,
                    "paged": args.paged,
+                   "temperature": args.temperature,
+                   **({"top_k": args.top_k, "top_p": args.top_p}
+                      if args.temperature > 0 else {}),
+                   **({"speculative": True,
+                       "draft_steps": args.draft_steps,
+                       "draft_layers": draft[1].n_layers}
+                      if args.speculative else {}),
                    # capacity (scratch page excluded): agrees with the
                    # user's --num-pages and the metrics plane's
                    # serve_page_pool_pages / pages_total
@@ -3357,6 +3719,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         return 0
     report = {
         **common,
+        **({"speculative": engine.speculative_summary()}
+           if args.speculative else {}),
         "watchdog_trips": engine.watchdog_trips,
         "evictions": engine.evictions,
         "prefill_dispatches": engine.prefill_dispatches,

@@ -328,7 +328,10 @@ def prefill(params: dict, cache: dict, prompt: jnp.ndarray,
 def multi_step_decode(params: dict, kv: dict, logits: jnp.ndarray,
                       pos: jnp.ndarray, done: jnp.ndarray,
                       remaining: jnp.ndarray, eos_ids: jnp.ndarray,
-                      stop_ids: jnp.ndarray, steps: int, decode_fn):
+                      stop_ids: jnp.ndarray, steps: int, decode_fn,
+                      sample: Optional[tuple] = None,
+                      key_data: Optional[jnp.ndarray] = None,
+                      step_idx: Optional[jnp.ndarray] = None):
     """Fuse ``steps`` greedy decode steps into one ``lax.scan`` with
     per-lane finish handling ON DEVICE — the masked multi-step core the
     serving engine dispatches (serving/engine.py ``_engine_multi_step``).
@@ -343,8 +346,11 @@ def multi_step_decode(params: dict, kv: dict, logits: jnp.ndarray,
 
     Per scan step, for each lane:
 
-    1. emit ``tok = argmax(logits)`` (greedy — the parity mode; sampled
-       multi-step serving would thread a key through the carry);
+    1. emit ``tok = argmax(logits)`` (greedy — the parity mode), or —
+       with ``sample`` set — the seeded per-lane pick
+       (:func:`sample_token_rows` over the carried ``step_idx``: the
+       per-slot PRNG key threaded through the scan carry, the open
+       question flagged since the block-decode PR);
     2. latch ``done`` if the lane was active and ``tok`` is its EOS, one
        of its stop ids, or its last budgeted token (``remaining <= 1``);
     3. run ``decode_fn`` for every lane (static shapes), but a lane that
@@ -376,7 +382,42 @@ def multi_step_decode(params: dict, kv: dict, logits: jnp.ndarray,
     ``tokens`` of shape ``(steps, lanes)``; entries after a lane's latch
     are garbage the caller must not consume, and a ``bad`` lane's whole
     block is garbage (the poison may predate any token in it).
-    """
+
+    SAMPLED blocks (ISSUE 10): ``sample`` = the static ``(temperature,
+    top_k, top_p)`` triple switches step 1's pick from argmax to
+    :func:`sample_token_rows` over per-lane keys — ``key_data``
+    (lanes, key_width) raw key bytes (request-seed-derived, so streams
+    are churn/slot invariant) and ``step_idx`` (lanes,) the per-lane
+    emitted-token index join the scan carry, with ``step_idx``
+    advancing exactly where a lane was active (mirroring the host's
+    consumed-token replay, restore included). The carry and return
+    grow a trailing ``step_idx`` leaf in this mode ONLY — the greedy
+    path's program is byte-for-byte what it was (the parity pin)."""
+
+    if sample is not None:
+        def one_sampled(carry, _):
+            kv, logits, pos, done, remaining, bad, idx = carry
+            poisoned = ~done & ~jnp.isfinite(logits).all(axis=-1)
+            bad = bad | poisoned
+            done = done | poisoned
+            tok = sample_token_rows(key_data, logits, idx, sample)
+            active = ~done
+            finished = active & ((tok == eos_ids)
+                                 | (stop_ids == tok[:, None]).any(axis=1)
+                                 | (remaining <= 1))
+            live = active & ~finished
+            remaining = jnp.where(active, remaining - 1, remaining)
+            idx = jnp.where(active, idx + 1, idx)
+            done = done | finished
+            kv, logits = decode_fn(params, kv, tok, pos, live)
+            pos = jnp.where(live, pos + 1, pos)
+            return (kv, logits, pos, done, remaining, bad, idx), tok
+
+        bad0 = jnp.zeros_like(done)
+        return lax.scan(
+            one_sampled,
+            (kv, logits, pos, done, remaining, bad0, step_idx), None,
+            length=steps)
 
     def one(carry, _):
         kv, logits, pos, done, remaining, bad = carry
@@ -405,6 +446,64 @@ def _filter_top_k(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
     at the threshold are kept — harmless, matches common practice)."""
     vals = lax.top_k(logits, top_k)[0]
     return jnp.where(logits < vals[..., -1:], NEG_INF, logits)
+
+
+def apply_sample_filters(logits: jnp.ndarray, temperature: float,
+                         top_k: Optional[int],
+                         top_p: Optional[float]) -> jnp.ndarray:
+    """The sampling pipeline shared by every sampled decode path
+    (``generate``, the engine's per-slot sampling, the speculative
+    verify): temperature scaling then optional top-k / top-p (nucleus)
+    filtering, row-wise over ``(..., vocab)``. Every filter is a
+    per-row operation (top_k / sort / softmax reduce only over the
+    vocab axis), so a row's filtered logits are bitwise identical
+    whether it rides in a batch of 1 or of ``slots`` — the property
+    the engine's sampled-parity contract leans on."""
+    x = logits / temperature
+    if top_k is not None and top_k < x.shape[-1]:
+        x = _filter_top_k(x, top_k)
+    if top_p is not None and top_p < 1.0:
+        x = _filter_top_p(x, top_p)
+    return x
+
+
+def sample_step_key(key: jax.Array, idx) -> jax.Array:
+    """The canonical per-token sampling key: ``fold_in(base, idx)``
+    where ``idx`` is the 0-based index of the token being emitted
+    (counting from the first generated token, prompt excluded).
+
+    fold_in — not ``split(key, steps)[idx]`` — because the schedule
+    must be STEP-COUNT-FREE: the serving engine decodes a request in
+    blocks of unknowable size across churn, refill and drain/restore,
+    and its per-slot streams can only match ``generate(key=...)``
+    bitwise if token ``idx``'s key depends on nothing but (base key,
+    idx). Both ``generate`` and the engine derive their keys through
+    this one function."""
+    return jax.random.fold_in(key, idx)
+
+
+def sample_token_rows(key_data: jnp.ndarray, logits: jnp.ndarray,
+                      idx: jnp.ndarray, sample: tuple) -> jnp.ndarray:
+    """Per-lane sampled pick for the serving engine: row ``s`` of
+    ``logits`` (lanes, vocab) samples with ``sample_step_key(key_s,
+    idx[s])`` where ``key_s`` wraps ``key_data[s]`` (the raw key bytes
+    the host uploads per slot — derived from the REQUEST's seed, never
+    the slot index, so a surviving lane's stream is invariant to
+    admission order and churn). ``sample`` is the static
+    ``(temperature, top_k, top_p)`` triple.
+
+    Each lane's categorical runs over a ``(1, vocab)`` row — the exact
+    shape ``generate``'s batch-1 pick samples over — so an engine
+    lane's tokens are bitwise ``generate(key=key_s, temperature=...)``
+    's (pinned by tests/test_sampled_serving.py)."""
+    temperature, top_k, top_p = sample
+    filtered = apply_sample_filters(logits, temperature, top_k, top_p)
+
+    def one(kd, row, i):
+        k = sample_step_key(jax.random.wrap_key_data(kd), i)
+        return jax.random.categorical(k, row[None], axis=-1)[0]
+
+    return jax.vmap(one)(key_data, filtered, idx).astype(jnp.int32)
 
 
 def _filter_top_p(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
@@ -470,16 +569,16 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: TransformerConfig,
     def pick(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        if top_k is not None and top_k < logits.shape[-1]:
-            logits = _filter_top_k(logits, top_k)
-        if top_p is not None and top_p < 1.0:
-            logits = _filter_top_p(logits, top_p)
+        logits = apply_sample_filters(logits, temperature, top_k, top_p)
         return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
-    def one(carry, k):
+    def one(carry, j):
         cache, logits, done = carry
-        tok = pick(logits, k)
+        # the canonical step-count-free key schedule (sample_step_key):
+        # token j's key is fold_in(base, j), which is what lets the
+        # serving engine reproduce this exact stream from any block
+        # partition of the decode
+        tok = pick(logits, sample_step_key(key, j))
         if eos_token is not None:
             # an already-done row keeps emitting EOS (stable padding);
             # rows finishing THIS step keep their freshly-picked EOS
@@ -488,9 +587,9 @@ def generate(params: dict, prompt: jnp.ndarray, cfg: TransformerConfig,
         cache, logits = decode_step(params, cache, tok, cfg)
         return (cache, logits, done), tok
 
-    keys = jax.random.split(key, steps)
     done0 = jnp.zeros((b,), bool)
-    _, tokens = lax.scan(one, (cache, logits, done0), keys)
+    _, tokens = lax.scan(one, (cache, logits, done0),
+                         jnp.arange(steps))
     tokens = tokens.T  # (b, steps)
     if eos_token is None:
         return tokens

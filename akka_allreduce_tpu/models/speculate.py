@@ -38,8 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from akka_allreduce_tpu.models.generate import (
-    _filter_top_k,
-    _filter_top_p,
+    apply_sample_filters,
     decode_step,
     dequantize_kv,
     init_kv_cache,
@@ -319,14 +318,12 @@ def _filtered_probs(logits: jnp.ndarray, temperature: float,
                     top_k: Optional[int],
                     top_p: Optional[float]) -> jnp.ndarray:
     """logits (vocab,) -> the filtered sampling distribution — the SAME
-    pipeline generate() samples from, so speculative sampling preserves
-    exactly the distribution plain sampling uses."""
-    x = logits[None] / temperature
-    if top_k is not None and top_k < x.shape[-1]:
-        x = _filter_top_k(x, top_k)
-    if top_p is not None and top_p < 1.0:
-        x = _filter_top_p(x, top_p)
-    return jax.nn.softmax(x, axis=-1)[0]
+    pipeline generate() (and the serving engine's per-slot sampler)
+    samples from, so speculative sampling preserves exactly the
+    distribution plain sampling uses."""
+    return jax.nn.softmax(
+        apply_sample_filters(logits[None], temperature, top_k, top_p),
+        axis=-1)[0]
 
 
 @partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps",

@@ -81,14 +81,19 @@ class SubmitFrame:
     use sentinel encoding (-1 / NaN-free: ``has_*`` flag bytes) so the
     frame stays fixed-layout and struct-parsable. ``attempts`` carries
     the retry ledger across the boundary — a failover re-dispatch must
-    keep its budget, not reset it."""
+    keep its budget, not reset it; ``seed`` carries the sampled
+    stream's identity (ISSUE 10) — a replica must reproduce the same
+    per-request key schedule the router promised, so an explicit seed
+    survives the wire (None stays None: the rid-derived default is
+    already carried by rid)."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token",
-                 "stop_tokens", "deadline", "attempts")
+                 "stop_tokens", "deadline", "attempts", "seed")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  eos_token: Optional[int] = None, stop_tokens=(),
-                 deadline: Optional[float] = None, attempts: int = 0):
+                 deadline: Optional[float] = None, attempts: int = 0,
+                 seed: Optional[int] = None):
         self.rid = rid
         self.prompt = tuple(int(t) for t in prompt)
         self.max_new_tokens = max_new_tokens
@@ -103,6 +108,7 @@ class SubmitFrame:
                 f"{len(self.stop_tokens)}")
         self.deadline = deadline
         self.attempts = attempts
+        self.seed = seed
 
     def __repr__(self) -> str:
         return (f"SubmitFrame(rid={self.rid}, "
@@ -157,7 +163,8 @@ def request_to_frame(req) -> SubmitFrame:
                        max_new_tokens=req.max_new_tokens,
                        eos_token=req.eos_token,
                        stop_tokens=req.stop_tokens or (),
-                       deadline=req.deadline, attempts=req.attempts)
+                       deadline=req.deadline, attempts=req.attempts,
+                       seed=req.seed)
 
 
 def frame_to_request(frame: SubmitFrame):
@@ -169,7 +176,8 @@ def frame_to_request(frame: SubmitFrame):
                    max_new_tokens=frame.max_new_tokens,
                    eos_token=frame.eos_token,
                    stop_tokens=frame.stop_tokens,
-                   deadline=frame.deadline, attempts=frame.attempts)
+                   deadline=frame.deadline, attempts=frame.attempts,
+                   seed=frame.seed)
 
 
 def _pack_addr(addr: Addr) -> bytes:
@@ -227,13 +235,15 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
         prompt = np.asarray(msg.prompt, dtype=np.int32).tobytes()
         stops = np.asarray(msg.stop_tokens, dtype=np.int32).tobytes()
         return (struct.pack(
-            "<BqIiBiBdI", MSG_SUBMIT, msg.rid, msg.max_new_tokens,
+            "<BqIiBiBdIBq", MSG_SUBMIT, msg.rid, msg.max_new_tokens,
             msg.eos_token if msg.eos_token is not None else -1,
             1 if msg.deadline is not None else 0,
             msg.attempts,
             len(msg.stop_tokens),
             msg.deadline if msg.deadline is not None else 0.0,
-            len(msg.prompt)) + stops + prompt)
+            len(msg.prompt),
+            1 if msg.seed is not None else 0,
+            msg.seed if msg.seed is not None else 0) + stops + prompt)
     if isinstance(msg, CompletionFrame):
         tokens = np.asarray(msg.tokens, dtype=np.int32).tobytes()
         reason = msg.reason.encode()
@@ -304,8 +314,9 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
         return Ping(interval)
     if mtype == MSG_SUBMIT:
         (rid, max_new, eos, has_deadline, attempts, n_stops, deadline,
-         n_prompt) = struct.unpack_from("<qIiBiBdI", buf, off)
-        off += struct.calcsize("<qIiBiBdI")
+         n_prompt, has_seed, seed) = struct.unpack_from("<qIiBiBdIBq",
+                                                        buf, off)
+        off += struct.calcsize("<qIiBiBdIBq")
         stops = np.frombuffer(buf, dtype=np.int32, count=n_stops,
                               offset=off)
         off += 4 * n_stops
@@ -316,7 +327,8 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
                            eos_token=None if eos < 0 else eos,
                            stop_tokens=stops,
                            deadline=deadline if has_deadline else None,
-                           attempts=attempts)
+                           attempts=attempts,
+                           seed=seed if has_seed else None)
     if mtype == MSG_COMPLETION:
         rid, rlen, n_tokens = struct.unpack_from("<qBI", buf, off)
         off += struct.calcsize("<qBI")

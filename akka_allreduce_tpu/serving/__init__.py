@@ -21,6 +21,15 @@ Modules:
   ISSUE 7) whose KV lives in a flat page pool addressed through
   per-request page tables — admission gated on free pages, shared
   prompt prefixes stored once, bitwise parity kept.
+  The SPECULATIVE engines (ISSUE 10:
+  :class:`~akka_allreduce_tpu.serving.engine.SpeculativeEngine` /
+  :class:`~akka_allreduce_tpu.serving.engine.PagedSpeculativeEngine`)
+  replace the per-token dispatch with a draft-verify block — a small
+  draft model proposes k tokens per slot, one target extend verifies
+  k+1 positions, per-slot acceptance emits 1..k+1 tokens — and every
+  engine can SAMPLE (``EngineConfig.temperature``/``top_k``/``top_p``)
+  with seeded per-request key streams that are bitwise
+  ``generate(key=...)``'s and survive churn, blocks and restore.
 * ``paging.py`` — the page allocator: free-list, refcounts,
   exact-content prefix registry, pre-paid copy-on-write splits. Pure
   host Python, fuzz-pinned.
@@ -60,8 +69,10 @@ from akka_allreduce_tpu.serving.engine import (
     EngineConfig,
     PagedEngineConfig,
     PagedServingEngine,
+    PagedSpeculativeEngine,
     ResumableRequest,
     ServingEngine,
+    SpeculativeEngine,
     WatchdogTimeout,
     clear_drained,
     load_drained,
@@ -89,10 +100,12 @@ __all__ = [
     "PagePool",
     "PagedEngineConfig",
     "PagedServingEngine",
+    "PagedSpeculativeEngine",
     "pages_for",
     "EngineConfig",
     "ResumableRequest",
     "ServingEngine",
+    "SpeculativeEngine",
     "WatchdogTimeout",
     "clear_drained",
     "load_drained",

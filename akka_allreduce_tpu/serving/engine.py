@@ -107,12 +107,15 @@ import numpy as np
 from jax import lax
 
 from akka_allreduce_tpu.models.generate import (
+    apply_sample_filters,
     dequantize_kv,
     init_kv_cache,
     init_kv_pool,
     multi_step_decode,
     prefill,
     quantize_kv,
+    sample_step_key,
+    sample_token_rows,
 )
 from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
@@ -168,6 +171,28 @@ class EngineConfig:
     per-request failures plus a rebuilt state instead of a stuck
     process. Size it at several times the worst healthy step (a block
     dispatch computes ``decode_steps`` tokens before the readback).
+
+    ``temperature`` / ``top_k`` / ``top_p`` (ISSUE 10): the engine's
+    SAMPLING mode — temperature > 0 switches every decode pick from
+    argmax to seeded per-slot sampling (models/generate.py
+    ``sample_token_rows``): each request's stream is keyed by ITS seed
+    (``Request.seed``, rid-derived when unset) and its emitted-token
+    index, so tokens are bitwise reproducible and invariant to slot
+    placement, churn and restore, and bitwise equal to
+    ``generate(key=jax.random.key(seed), temperature=...)``.
+    temperature == 0.0 (default) is the historical greedy engine —
+    same program, byte for byte. Sampling is engine-wide and STATIC
+    (one compiled program per config); per-request temperatures would
+    be a shape-stable extension but are not offered yet.
+
+    ``draft_steps`` (ISSUE 10): > 0 arms SPECULATIVE decode — a
+    :class:`SpeculativeEngine` proposes ``draft_steps`` tokens per
+    slot from a small draft model and verifies all of them (plus the
+    block's anchor token) in ONE target dispatch. Mutually exclusive
+    with ``decode_steps > 1`` (both are block modes; speculation IS
+    the multi-token dispatch) and with ``prefill_buckets``
+    (speculative prefill is exact-length, the parity mode). 0 on the
+    plain engines.
     """
 
     num_slots: int = 4
@@ -176,6 +201,19 @@ class EngineConfig:
     decode_steps: int = 1
     max_stop_tokens: int = 4
     watchdog_timeout_s: Optional[float] = None
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    draft_steps: int = 0
+
+    @property
+    def sample(self) -> Optional[tuple]:
+        """The static sampling triple the device programs key on —
+        None (greedy; the bitwise-parity mode, and exactly the
+        pre-sampling program) when temperature == 0."""
+        if self.temperature == 0.0:
+            return None
+        return (self.temperature, self.top_k, self.top_p)
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -197,6 +235,26 @@ class EngineConfig:
             raise ValueError(
                 f"prefill_buckets must be strictly increasing positive "
                 f"lengths, got {self.prefill_buckets}")
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0 (0 = greedy), "
+                             f"got {self.temperature}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], "
+                             f"got {self.top_p}")
+        if self.draft_steps < 0:
+            raise ValueError(f"draft_steps must be >= 0 (0 = not "
+                             f"speculative), got {self.draft_steps}")
+        if self.draft_steps > 0 and self.decode_steps > 1:
+            raise ValueError(
+                "draft_steps and decode_steps > 1 are both block "
+                "modes — a speculative block already verifies "
+                "draft_steps + 1 tokens per dispatch; pick one")
+        if self.draft_steps > 0 and self.prefill_buckets:
+            raise ValueError(
+                "prefill_buckets is a plain-engine knob; speculative "
+                "prefill is exact-length (the parity mode)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -261,6 +319,11 @@ class PagedEngineConfig(EngineConfig):
                 "attention_impl='pallas' reads float pools only; the "
                 "int8 pool decodes through the gather path "
                 "(dequantize-on-read)")
+        if self.draft_steps > 0 and self.attention_impl == "pallas":
+            raise ValueError(
+                "attention_impl='pallas' is a single-query decode "
+                "kernel; the speculative verify is a BLOCK extend — "
+                "run speculation on the gather path")
 
 
 _KV_KEYS = ("k", "v", "k_scale", "v_scale")
@@ -413,9 +476,11 @@ def _slot_decode_step(params: dict, kv: dict, token: jnp.ndarray,
     return new_kv, logits[:, 0, :]
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "sample"), donate_argnums=(1,))
 def _engine_step(params: dict, state: dict, pos: jnp.ndarray,
-                 cfg: TransformerConfig):
+                 cfg: TransformerConfig, sample: Optional[tuple] = None,
+                 key_data: Optional[jnp.ndarray] = None,
+                 step_idx: Optional[jnp.ndarray] = None):
     """One decode step for every slot: pick each slot's next token from
     the carried logits (greedy — the parity mode), then advance every
     slot's cache at its own position in one batched program. ``state``:
@@ -431,9 +496,18 @@ def _engine_step(params: dict, state: dict, pos: jnp.ndarray,
     detect; the host fails that request, not the engine). The state is
     donated: the caches update in place instead of doubling slot HBM
     per step.
+
+    ``sample`` (static; ``EngineConfig.sample``) switches the pick to
+    seeded per-slot sampling over ``key_data``/``step_idx`` operands
+    (models/generate.py ``sample_token_rows``); None keeps the greedy
+    program untouched — the existing parity pins never see a changed
+    jaxpr.
     """
     logits_in = state["logits"]
-    tok = jnp.argmax(logits_in, axis=-1).astype(jnp.int32)
+    if sample is None:
+        tok = jnp.argmax(logits_in, axis=-1).astype(jnp.int32)
+    else:
+        tok = sample_token_rows(key_data, logits_in, step_idx, sample)
     finite = jnp.isfinite(logits_in).all(axis=-1)
     kv = {n: state[n] for n in state if n != "logits"}
     new_kv, logits = _slot_decode_step(params, kv, tok, pos, cfg)
@@ -441,11 +515,15 @@ def _engine_step(params: dict, state: dict, pos: jnp.ndarray,
     return {**new_kv, "logits": logits}, packed
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "steps", "sample"),
+         donate_argnums=(1,))
 def _engine_multi_step(params: dict, state: dict, pos: jnp.ndarray,
                        done: jnp.ndarray, remaining: jnp.ndarray,
                        eos_ids: jnp.ndarray, stop_ids: jnp.ndarray,
-                       cfg: TransformerConfig, steps: int):
+                       cfg: TransformerConfig, steps: int,
+                       sample: Optional[tuple] = None,
+                       key_data: Optional[jnp.ndarray] = None,
+                       step_idx: Optional[jnp.ndarray] = None):
     """``steps`` decode steps for every slot in ONE compiled program:
     ``multi_step_decode`` (models/generate.py) scanning
     ``_slot_decode_step``, with per-slot finish vectors so done-masks
@@ -473,6 +551,19 @@ def _engine_multi_step(params: dict, state: dict, pos: jnp.ndarray,
                                  write_mask=write_mask)
 
     kv = {n: state[n] for n in state if n != "logits"}
+    if sample is not None:
+        # the sampled block: per-lane keys + emitted-token indices ride
+        # the scan carry (models/generate.py); the extra step_idx
+        # vector joins the carried device vectors below
+        (kv, logits, pos, done, remaining, bad, idx), toks = \
+            multi_step_decode(
+                params, kv, state["logits"], pos, done, remaining,
+                eos_ids, stop_ids, steps, decode_fn, sample=sample,
+                key_data=key_data, step_idx=step_idx)
+        packed = jnp.concatenate(
+            [toks, pos[None], bad.astype(jnp.int32)[None]], axis=0)
+        return ({**kv, "logits": logits}, packed, pos, done, remaining,
+                idx)
     (kv, logits, pos, done, remaining, bad), toks = multi_step_decode(
         params, kv, state["logits"], pos, done, remaining,
         eos_ids, stop_ids, steps, decode_fn)
@@ -640,17 +731,25 @@ def _paged_decode_step(params: dict, kv: dict, token: jnp.ndarray,
     return new_kv, logits[:, 0, :]
 
 
-@partial(jax.jit, static_argnames=("cfg", "impl"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "impl", "sample"),
+         donate_argnums=(1,))
 def _engine_paged_step(params: dict, state: dict, pos: jnp.ndarray,
                        page_table: jnp.ndarray, cfg: TransformerConfig,
-                       impl: str):
+                       impl: str, sample: Optional[tuple] = None,
+                       key_data: Optional[jnp.ndarray] = None,
+                       step_idx: Optional[jnp.ndarray] = None):
     """The paged ``_engine_step``: same argmax-carry-advance contract
     and (2, slots) packed readback, with the KV pool donated (in-place
     page writes) and the page table a plain int32 OPERAND — table
     rewrites between dispatches (churn, sharing, COW) are data, so this
-    program compiles exactly once per engine config."""
+    program compiles exactly once per engine config. ``sample``
+    switches the pick to seeded per-lane sampling exactly as in
+    ``_engine_step``."""
     logits_in = state["logits"]
-    tok = jnp.argmax(logits_in, axis=-1).astype(jnp.int32)
+    if sample is None:
+        tok = jnp.argmax(logits_in, axis=-1).astype(jnp.int32)
+    else:
+        tok = sample_token_rows(key_data, logits_in, step_idx, sample)
     finite = jnp.isfinite(logits_in).all(axis=-1)
     kv = {n: state[n] for n in state if n != "logits"}
     new_kv, logits = _paged_decode_step(params, kv, tok, pos,
@@ -659,26 +758,39 @@ def _engine_paged_step(params: dict, state: dict, pos: jnp.ndarray,
     return {**new_kv, "logits": logits}, packed
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "impl"),
+@partial(jax.jit, static_argnames=("cfg", "steps", "impl", "sample"),
          donate_argnums=(1,))
 def _engine_paged_multi_step(params: dict, state: dict, pos: jnp.ndarray,
                              done: jnp.ndarray, remaining: jnp.ndarray,
                              eos_ids: jnp.ndarray, stop_ids: jnp.ndarray,
                              page_table: jnp.ndarray,
                              cfg: TransformerConfig, steps: int,
-                             impl: str):
+                             impl: str, sample: Optional[tuple] = None,
+                             key_data: Optional[jnp.ndarray] = None,
+                             step_idx: Optional[jnp.ndarray] = None):
     """The paged ``_engine_multi_step``: ``multi_step_decode``'s masked
     S-step scan over the paged decode step. The page table is loop-
     invariant across the block (every page a lane can write during S
     steps is resolved — COW-split if shared — by the host's pre-write
     pass BEFORE the dispatch), so it rides the scan as a closed-over
-    operand, not a carry."""
+    operand, not a carry. ``sample`` switches the pick to seeded
+    per-lane sampling exactly as in ``_engine_multi_step``."""
 
     def decode_fn(p, kv, tok, p_pos, write_mask):
         return _paged_decode_step(p, kv, tok, p_pos, page_table, cfg,
                                   impl, write_mask=write_mask)
 
     kv = {n: state[n] for n in state if n != "logits"}
+    if sample is not None:
+        (kv, logits, pos, done, remaining, bad, idx), toks = \
+            multi_step_decode(
+                params, kv, state["logits"], pos, done, remaining,
+                eos_ids, stop_ids, steps, decode_fn, sample=sample,
+                key_data=key_data, step_idx=step_idx)
+        packed = jnp.concatenate(
+            [toks, pos[None], bad.astype(jnp.int32)[None]], axis=0)
+        return ({**kv, "logits": logits}, packed, pos, done, remaining,
+                idx)
     (kv, logits, pos, done, remaining, bad), toks = multi_step_decode(
         params, kv, state["logits"], pos, done, remaining,
         eos_ids, stop_ids, steps, decode_fn)
@@ -740,6 +852,601 @@ def _copy_page(state: dict, src: jnp.ndarray, dst: jnp.ndarray) -> dict:
     return out
 
 
+# -- the speculative device plane (ISSUE 10) ----------------------------
+#
+# Draft-verify block decode for the serving engine: a small DRAFT model
+# proposes k tokens per slot (k+1 cheap per-slot decode steps inside the
+# same program), the TARGET model scores the anchor + all k proposals in
+# ONE block extend (`_slot_extend` / `_paged_extend` — the engine twins
+# of models/speculate.py `extend` with the position scalar generalized
+# to a per-slot vector), and per-slot acceptance emits the longest
+# agreeing prefix. Rejection "rollback" is the position vector: entries
+# written past a lane's accepted frontier are masked by the position
+# check and overwritten by the next block's writes — exactly the
+# offline speculative cache-rewind trick, per slot. One dispatch, one
+# packed readback (tokens + per-slot accepted counts + positions + the
+# finite guard), fixed program count however acceptance varies.
+
+
+def _rope_slots_block(x: jnp.ndarray, pos: jnp.ndarray,
+                      theta: float) -> jnp.ndarray:
+    """``_rope_slots`` generalized to a block: x (slots, t, heads, d)
+    holds block positions ``pos[s] + j``. Same formula, f32 phases,
+    half-split pairing and cast points — the angle for (slot s, block
+    offset j) is bitwise the angle ``_rope_slots`` computes at scalar
+    position pos[s] + j, which is what keeps the verify extend bitwise
+    equal to the sequential slot steps it replaces."""
+    s, t, _h, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    positions = (pos[:, None] + jnp.arange(t)).astype(jnp.float32)
+    angles = positions[:, :, None] * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]  # (slots, t, 1, D/2)
+    sin = jnp.sin(angles)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
+
+
+def _slot_block_attention(q: jnp.ndarray, k_all: jnp.ndarray,
+                          v_all: jnp.ndarray, pos: jnp.ndarray,
+                          window: "int | None" = None) -> jnp.ndarray:
+    """``_slot_cached_attention`` with a block of queries: q
+    (slots, t, h, d) at positions ``pos[s] + j``; k_all/v_all
+    (slots, L, h_kv, d) with the block's K/V already written (L =
+    max_seq, or the gathered page span on the paged path — the masked
+    tail contributes exactly 0.0 either way). Query j of slot s masks
+    by ``k_idx <= pos[s] + j`` (prefix + causal-within-block). Same
+    einsum structure, f32 score/softmax and cast points as the
+    single-query form — each (slot, j) row's arithmetic is the
+    batched-over-q version of one ``_slot_cached_attention`` call,
+    which is what the bitwise verify-parity contract rests on (the
+    offline ``extend`` pins the same property against
+    ``decode_step``)."""
+    b, t, h, d = q.shape
+    h_kv = k_all.shape[2]
+    g = h // h_kv
+    qg = q.reshape(b, t, h_kv, g, d)
+    scale = d ** -0.5
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all,
+                        preferred_element_type=jnp.float32) * scale
+    k_idx = jnp.arange(k_all.shape[1])
+    q_pos = pos[:, None] + jnp.arange(t)[None, :]        # (slots, t)
+    valid = k_idx[None, None, :] <= q_pos[:, :, None]    # (s, t, L)
+    if window is not None:
+        valid &= k_idx[None, None, :] > q_pos[:, :, None] - window
+    scores = jnp.where(valid[:, None, None, :, :], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_all.dtype), v_all,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def _slot_extend(params: dict, kv: dict, tokens: jnp.ndarray,
+                 pos: jnp.ndarray, cfg: TransformerConfig,
+                 write_mask: "jnp.ndarray | None" = None):
+    """models/speculate.py ``extend`` with the batch-wide position
+    scalar generalized to a per-slot vector — the speculative verify
+    program's core. Consume ``tokens`` (slots, t) starting at each
+    slot's ``pos``; return (new kv, logits (slots, t, vocab)) where
+    ``logits[s, j]`` is the next-token distribution after slot s
+    consumed ``tokens[s, :j+1]``. Same projections, norms, rope,
+    residual order and cast points as ``_slot_decode_step``; K/V
+    placement is t unrolled per-slot row writes per layer
+    (``_write_slot_rows`` at pos+j — the donation keeps them in
+    place). ``write_mask`` freezes a lane's writes wholesale (done /
+    free lanes)."""
+    s, t = tokens.shape
+    quantized = "k_scale" in kv
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos"][pos[:, None] + jnp.arange(t)[None, :]]
+    k_cache, v_cache = kv["k"], kv["v"]
+    if quantized:
+        k_scales, v_scales = kv["k_scale"], kv["v_scale"]
+    for i, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["ln1"])
+        q = (h @ layer["wq"]).reshape(s, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(s, t, cfg.kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(s, t, cfg.kv_heads, cfg.head_dim)
+        if cfg.rope:
+            q = _rope_slots_block(q, pos, cfg.rope_theta)
+            k = _rope_slots_block(k, pos, cfg.rope_theta)
+        if quantized:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            for j in range(t):
+                k_cache = _write_slot_rows(k_cache, i, kq[:, j],
+                                           pos + j, write_mask)
+                v_cache = _write_slot_rows(v_cache, i, vq[:, j],
+                                           pos + j, write_mask)
+                k_scales = _write_slot_rows(k_scales, i, ks[:, j],
+                                            pos + j, write_mask)
+                v_scales = _write_slot_rows(v_scales, i, vs[:, j],
+                                            pos + j, write_mask)
+            k_all = dequantize_kv(k_cache[i], k_scales[i], cfg.dtype)
+            v_all = dequantize_kv(v_cache[i], v_scales[i], cfg.dtype)
+        else:
+            for j in range(t):
+                k_cache = _write_slot_rows(
+                    k_cache, i, k[:, j].astype(k_cache.dtype), pos + j,
+                    write_mask)
+                v_cache = _write_slot_rows(
+                    v_cache, i, v[:, j].astype(v_cache.dtype), pos + j,
+                    write_mask)
+            k_all, v_all = k_cache[i], v_cache[i]
+        attn = _slot_block_attention(q, k_all, v_all, pos,
+                                     window=cfg.attn_window)
+        x = x + attn.reshape(s, t, -1) @ layer["wo"]
+
+        h = rmsnorm(x, layer["ln2"])
+        if "router" in layer:
+            y, _aux = moe_ffn(h, layer, cfg.moe, axis_name=None)
+            x = x + y
+        elif "w3" in layer:
+            x = x + (jax.nn.silu(h @ layer["w1"])
+                     * (h @ layer["w3"])) @ layer["w2"]
+        else:
+            x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    logits = lm_logits(params, rmsnorm(x, params["out_norm"]), cfg)
+    new_kv = {"k": k_cache, "v": v_cache}
+    if quantized:
+        new_kv["k_scale"], new_kv["v_scale"] = k_scales, v_scales
+    return new_kv, logits
+
+
+def _paged_extend(params: dict, kv: dict, tokens: jnp.ndarray,
+                  pos: jnp.ndarray, page_table: jnp.ndarray,
+                  cfg: TransformerConfig,
+                  write_mask: "jnp.ndarray | None" = None):
+    """``_slot_extend`` over the page pool: identical math, with K/V
+    block writes routed through the page table (``_write_pool_rows``
+    at pos+j — the host's pre-write pass resolved every page the block
+    can touch) and attention reading each lane's pages in logical
+    order through the gather path (the bitwise-parity read)."""
+    s, t = tokens.shape
+    quantized = "k_scale" in kv
+    P = kv["k"].shape[2]
+    x = params["embed"][tokens]
+    if not cfg.rope:
+        x = x + params["pos"][pos[:, None] + jnp.arange(t)[None, :]]
+    k_pool, v_pool = kv["k"], kv["v"]
+    if quantized:
+        k_scales, v_scales = kv["k_scale"], kv["v_scale"]
+    for i, layer in enumerate(params["layers"]):
+        h = rmsnorm(x, layer["ln1"])
+        q = (h @ layer["wq"]).reshape(s, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(s, t, cfg.kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(s, t, cfg.kv_heads, cfg.head_dim)
+        if cfg.rope:
+            q = _rope_slots_block(q, pos, cfg.rope_theta)
+            k = _rope_slots_block(k, pos, cfg.rope_theta)
+        if quantized:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            for j in range(t):
+                k_pool = _write_pool_rows(k_pool, i, kq[:, j], pos + j,
+                                          page_table, P, write_mask)
+                v_pool = _write_pool_rows(v_pool, i, vq[:, j], pos + j,
+                                          page_table, P, write_mask)
+                k_scales = _write_pool_rows(k_scales, i, ks[:, j],
+                                            pos + j, page_table, P,
+                                            write_mask)
+                v_scales = _write_pool_rows(v_scales, i, vs[:, j],
+                                            pos + j, page_table, P,
+                                            write_mask)
+            k_all = dequantize_kv(paged_gather_kv(k_pool[i], page_table),
+                                  paged_gather_kv(k_scales[i],
+                                                  page_table),
+                                  cfg.dtype)
+            v_all = dequantize_kv(paged_gather_kv(v_pool[i], page_table),
+                                  paged_gather_kv(v_scales[i],
+                                                  page_table),
+                                  cfg.dtype)
+        else:
+            for j in range(t):
+                k_pool = _write_pool_rows(
+                    k_pool, i, k[:, j].astype(k_pool.dtype), pos + j,
+                    page_table, P, write_mask)
+                v_pool = _write_pool_rows(
+                    v_pool, i, v[:, j].astype(v_pool.dtype), pos + j,
+                    page_table, P, write_mask)
+            k_all = paged_gather_kv(k_pool[i], page_table)
+            v_all = paged_gather_kv(v_pool[i], page_table)
+        attn = _slot_block_attention(q, k_all, v_all, pos,
+                                     window=cfg.attn_window)
+        x = x + attn.reshape(s, t, -1) @ layer["wo"]
+
+        h = rmsnorm(x, layer["ln2"])
+        if "router" in layer:
+            y, _aux = moe_ffn(h, layer, cfg.moe, axis_name=None)
+            x = x + y
+        elif "w3" in layer:
+            x = x + (jax.nn.silu(h @ layer["w1"])
+                     * (h @ layer["w3"])) @ layer["w2"]
+        else:
+            x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    logits = lm_logits(params, rmsnorm(x, params["out_norm"]), cfg)
+    new_kv = {"k": k_pool, "v": v_pool}
+    if quantized:
+        new_kv["k_scale"], new_kv["v_scale"] = k_scales, v_scales
+    return new_kv, logits
+
+
+_DRAFT_PREFIX = "draft_"
+
+
+def _split_spec_state(state: dict) -> "tuple[dict, dict]":
+    """One donated state pytree -> (target kv, draft kv) views. The
+    draft model's cache rides the same state dict under ``draft_*``
+    keys so one donation covers both caches (and recovery rebuilds
+    both at warmup avals in one `_fresh_state`)."""
+    t_kv = {n: state[n] for n in _KV_KEYS if n in state}
+    d_kv = {n[len(_DRAFT_PREFIX):]: state[n] for n in state
+            if n.startswith(_DRAFT_PREFIX)}
+    return t_kv, d_kv
+
+
+def _spec_probs_rows(logits: jnp.ndarray, sample: tuple) -> jnp.ndarray:
+    """Rows (..., vocab) of logits -> the filtered sampling
+    distribution — the same pipeline ``generate``/the sampled engine
+    pick from, so speculative sampling preserves exactly the
+    distribution plain sampling uses (the offline
+    ``_filtered_probs`` contract, batched)."""
+    temperature, top_k, top_p = sample
+    return jax.nn.softmax(
+        apply_sample_filters(logits, temperature, top_k, top_p),
+        axis=-1)
+
+
+def _spec_categorical_rows(key_data: jnp.ndarray, probs: jnp.ndarray,
+                           idx: jnp.ndarray, tag: int) -> jnp.ndarray:
+    """Per-lane categorical over probability rows with the speculative
+    key schedule: lane s's key is ``fold_in(fold_in(base_s, idx[s]),
+    tag)`` — the block's per-lane key (request seed + emitted index)
+    fanned out by a static ``tag`` so the anchor pick, each draft
+    proposal and the accept draws consume DISJOINT streams."""
+
+    def one(kd, row, i):
+        k = jax.random.fold_in(
+            sample_step_key(jax.random.wrap_key_data(kd), i), tag)
+        return jax.random.categorical(
+            k, jnp.log(jnp.maximum(row, 1e-30))[None], axis=-1)[0]
+
+    return jax.vmap(one)(key_data, probs, idx).astype(jnp.int32)
+
+
+def _spec_uniform_rows(key_data: jnp.ndarray, idx: jnp.ndarray,
+                       tag: int, n: int) -> jnp.ndarray:
+    """(lanes, n) uniform draws on the speculative key schedule — the
+    per-proposal accept tests."""
+
+    def one(kd, i):
+        k = jax.random.fold_in(
+            sample_step_key(jax.random.wrap_key_data(kd), i), tag)
+        return jax.random.uniform(k, (n,))
+
+    return jax.vmap(one)(key_data, idx)
+
+
+def _spec_core(params: dict, draft_params: dict, state: dict,
+               pos: jnp.ndarray, done: jnp.ndarray,
+               remaining: jnp.ndarray, eos_ids: jnp.ndarray,
+               stop_ids: jnp.ndarray, step_idx: jnp.ndarray,
+               key_data: Optional[jnp.ndarray], k: int,
+               sample: Optional[tuple], t_extend, d_step):
+    """One speculative block for every slot — the shared body of
+    ``_engine_speculative_step`` (slot) and
+    ``_engine_paged_speculative_step`` (paged); ``t_extend`` /
+    ``d_step`` close over each engine kind's placement.
+
+    Per block, for each active lane:
+
+    1. pick the ANCHOR token from the carried logits (greedy argmax,
+       or — sampled — the residual-aware pick: after a rejection the
+       carried ``q_res`` row makes the anchor draw come from
+       ``norm(max(p - q, 0))``, the modified-rejection resample that
+       keeps the emitted stream distributed exactly as target-only
+       sampling; after a full acceptance q_res is zero and the pick
+       degenerates to plain sampling from p);
+    2. run k+1 draft decode steps — k proposals d_1..d_k plus one
+       cache-fill step consuming d_k, so the draft cache never holds a
+       hole at the frontier after a full acceptance;
+    3. verify [anchor, d_1..d_k] in ONE (k+1)-position target extend;
+       accept the longest prefix (greedy: d_j == argmax V_{j-1};
+       sampled: u * q_j(d_j) < p_j(d_j)), yielding per-slot ``n_acc``;
+    4. latch EOS / stop / budget over the emitted prefix ON DEVICE
+       (the multi_step_decode discipline: frozen lanes stop advancing
+       ``pos``); carry ``logits = V[n_acc]`` — the distribution after
+       the last emitted token, which is bitwise what the sequential
+       engine would carry (the parity argument).
+
+    KV rollback is the position vector: the verify wrote k+1 positions
+    per lane, the lane's ``pos`` advanced only to its emitted
+    frontier, and everything past it is masked garbage the next
+    block's writes overwrite (the offline cache-rewind trick).
+
+    Returns ``(state, packed (k+4, slots) int32, pos, done, remaining,
+    step_idx)``: packed rows [0, k] the emit-candidate tokens (row 0
+    the anchor, rows 1..k the proposals), row k+1 the per-slot
+    accepted counts (the acceptance ledger rides the ONE readback),
+    row k+2 the post-block positions, row k+3 the finite-guard bad
+    flag."""
+    logits_in = state["logits"]
+    poisoned = ~done & ~jnp.isfinite(logits_in).all(axis=-1)
+    bad = poisoned
+    done = done | poisoned
+    active = ~done
+
+    # 1. the anchor pick
+    if sample is None:
+        tok0 = jnp.argmax(logits_in, axis=-1).astype(jnp.int32)
+    else:
+        p0 = _spec_probs_rows(logits_in, sample)
+        res = jnp.maximum(p0 - state["q_res"], 0.0)
+        tot = res.sum(axis=-1, keepdims=True)
+        anchor_probs = jnp.where(tot > 0.0,
+                                 res / jnp.maximum(tot, 1e-30), p0)
+        tok0 = _spec_categorical_rows(key_data, anchor_probs, step_idx,
+                                      tag=0)
+
+    # 2. the draft: k proposals + one cache-fill step (no frontier
+    # hole after a full acceptance). Key tags must be STATIC per draft
+    # step, so the small k+1 loop unrolls instead of scanning — each
+    # proposal's key tag is a Python int.
+    t_kv, d_kv = _split_spec_state(state)
+    props = []
+    qs = []
+    cur, dpos = tok0, pos
+    for j in range(k + 1):
+        d_kv, dl = d_step(draft_params, d_kv, cur, dpos, active)
+        if j < k:
+            if sample is None:
+                nxt = jnp.argmax(dl, axis=-1).astype(jnp.int32)
+            else:
+                qj = _spec_probs_rows(dl, sample)
+                qs.append(qj)
+                nxt = _spec_categorical_rows(key_data, qj, step_idx,
+                                             tag=1 + j)
+            props.append(nxt)
+            cur = nxt
+        dpos = jnp.where(active, dpos + 1, dpos)
+    props_m = jnp.stack(props, axis=1)                   # (s, k)
+
+    # 3. the verify: one (k+1)-position target extend
+    block = jnp.concatenate([tok0[:, None], props_m], axis=1)  # (s,k+1)
+    t_kv, v_logits = t_extend(params, t_kv, block, pos, active)
+    finite_v = jnp.isfinite(v_logits).all(axis=(-2, -1))
+    bad_v = active & ~finite_v
+    bad = bad | bad_v
+    done = done | bad_v
+    active = ~done
+
+    if sample is None:
+        t_arg = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+        match = props_m == t_arg[:, :k]                  # (s, k)
+        n_acc = jnp.argmin(jnp.concatenate(
+            [match, jnp.zeros((match.shape[0], 1), bool)],
+            axis=1).astype(jnp.int32), axis=1)           # (s,)
+        idx1 = n_acc[:, None, None]
+        logits_next = jnp.take_along_axis(
+            v_logits, idx1, axis=1)[:, 0]                # (s, vocab)
+        new_extra = {}
+    else:
+        ps = _spec_probs_rows(v_logits, sample)          # (s, k+1, v)
+        qs_m = jnp.stack(qs, axis=1)                     # (s, k, v)
+        props_e = props_m[:, :, None]
+        p_at = jnp.take_along_axis(ps[:, :k], props_e, axis=2)[..., 0]
+        q_at = jnp.take_along_axis(qs_m, props_e, axis=2)[..., 0]
+        u = _spec_uniform_rows(key_data, step_idx, tag=k + 1, n=k)
+        ok = u * q_at < p_at                             # (s, k)
+        n_acc = jnp.argmin(jnp.concatenate(
+            [ok, jnp.zeros((ok.shape[0], 1), bool)],
+            axis=1).astype(jnp.int32), axis=1)
+        idx1 = n_acc[:, None, None]
+        logits_next = jnp.take_along_axis(
+            v_logits, idx1, axis=1)[:, 0]
+        # the residual carry: a rejection at proposal n_acc leaves the
+        # NEXT anchor to be drawn from norm(max(p - q_{n_acc}, 0));
+        # full acceptance carries zeros (plain sampling from p)
+        q_rej = jnp.take_along_axis(
+            qs_m, jnp.minimum(n_acc, k - 1)[:, None, None],
+            axis=1)[:, 0]                                # (s, v)
+        new_extra = {"q_res": jnp.where((n_acc < k)[:, None], q_rej,
+                                        jnp.zeros_like(q_rej))}
+
+    # 4. the on-device emit latch: consume [anchor, d_1..d_n_acc] per
+    # lane, stopping at EOS / stop / budget exactly as
+    # multi_step_decode latches
+    def latch(carry, xs):
+        done, remaining, pos2, idx2 = carry
+        tok, j = xs
+        a = ~done & (j <= n_acc)
+        finished = a & ((tok == eos_ids)
+                        | (stop_ids == tok[:, None]).any(axis=1)
+                        | (remaining <= 1))
+        remaining = jnp.where(a, remaining - 1, remaining)
+        idx2 = jnp.where(a, idx2 + 1, idx2)
+        live = a & ~finished
+        done = done | finished
+        pos2 = jnp.where(live, pos2 + 1, pos2)
+        return (done, remaining, pos2, idx2), None
+
+    (done, remaining, pos, step_idx), _ = lax.scan(
+        latch, (done, remaining, pos, step_idx),
+        (block.T, jnp.arange(k + 1)))
+
+    packed = jnp.concatenate(
+        [block.T.astype(jnp.int32), n_acc.astype(jnp.int32)[None],
+         pos[None], bad.astype(jnp.int32)[None]], axis=0)
+    out_state = {**{n: t_kv[n] for n in t_kv},
+                 **{_DRAFT_PREFIX + n: d_kv[n] for n in d_kv},
+                 "logits": logits_next.astype(logits_in.dtype),
+                 **new_extra}
+    return out_state, packed, pos, done, remaining, step_idx
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "draft_cfg", "k", "sample"),
+         donate_argnums=(2,))
+def _engine_speculative_step(params: dict, draft_params: dict,
+                             state: dict, pos: jnp.ndarray,
+                             done: jnp.ndarray, remaining: jnp.ndarray,
+                             eos_ids: jnp.ndarray,
+                             stop_ids: jnp.ndarray,
+                             step_idx: jnp.ndarray,
+                             key_data: Optional[jnp.ndarray],
+                             cfg: TransformerConfig,
+                             draft_cfg: TransformerConfig, k: int,
+                             sample: Optional[tuple]):
+    """The slot engine's speculative block dispatch: draft scan +
+    (k+1)-position verify extend + accept/reject + on-device emit
+    latch, in ONE donated program (``_spec_core``). One program per
+    (config, k); acceptance varying per slot per block is data — the
+    speculative extension of the engine's no-recompile contract,
+    pinned by the ``engine_speculative_step`` lint entry."""
+
+    def d_step(dp, dkv, tok, dpos, mask):
+        return _slot_decode_step(dp, dkv, tok, dpos, draft_cfg,
+                                 write_mask=mask)
+
+    def t_extend(p, tkv, block, bpos, mask):
+        return _slot_extend(p, tkv, block, bpos, cfg, write_mask=mask)
+
+    return _spec_core(params, draft_params, state, pos, done,
+                      remaining, eos_ids, stop_ids, step_idx, key_data,
+                      k, sample, t_extend, d_step)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "draft_cfg", "k", "sample"),
+         donate_argnums=(2,))
+def _engine_paged_speculative_step(params: dict, draft_params: dict,
+                                   state: dict, pos: jnp.ndarray,
+                                   done: jnp.ndarray,
+                                   remaining: jnp.ndarray,
+                                   eos_ids: jnp.ndarray,
+                                   stop_ids: jnp.ndarray,
+                                   step_idx: jnp.ndarray,
+                                   key_data: Optional[jnp.ndarray],
+                                   page_table: jnp.ndarray,
+                                   draft_page_table: jnp.ndarray,
+                                   cfg: TransformerConfig,
+                                   draft_cfg: TransformerConfig, k: int,
+                                   sample: Optional[tuple]):
+    """The paged speculative dispatch: ``_spec_core`` with the target
+    KV in the main page pool and the DRAFT KV in its own small pool,
+    each addressed through its own int32 page-table operand (data,
+    never donated, never a shape — churn and acceptance variation
+    rewrite tables while the one program is reused)."""
+
+    def d_step(dp, dkv, tok, dpos, mask):
+        return _paged_decode_step(dp, dkv, tok, dpos, draft_page_table,
+                                  draft_cfg, "gather", write_mask=mask)
+
+    def t_extend(p, tkv, block, bpos, mask):
+        return _paged_extend(p, tkv, block, bpos, page_table, cfg,
+                             write_mask=mask)
+
+    return _spec_core(params, draft_params, state, pos, done,
+                      remaining, eos_ids, stop_ids, step_idx, key_data,
+                      k, sample, t_extend, d_step)
+
+
+@partial(jax.jit, static_argnames=("cfg", "draft_cfg"),
+         donate_argnums=(2,))
+def _engine_spec_prefill(params: dict, draft_params: dict, state: dict,
+                         prompt: jnp.ndarray, slot: jnp.ndarray,
+                         cfg: TransformerConfig,
+                         draft_cfg: TransformerConfig):
+    """Prefill ``prompt`` (1, L) into ``slot``'s TARGET and DRAFT lanes
+    in one dispatch — both models must hold the prompt's K/V before
+    the first speculative block. Exact-length only (the parity mode;
+    prefill_buckets is rejected at config time). The carried logits
+    are the target's (the draft never chooses a token, only predicts
+    the target), and a sampled engine's residual row resets to zero
+    (a fresh request starts with no pending rejection)."""
+    quant = "k_scale" in state
+    one = init_kv_cache(cfg, 1, kv_dtype="int8" if quant else None)
+    cache, logits = prefill(params, one, prompt, cfg)
+    d_one = init_kv_cache(draft_cfg, 1)
+    d_cache, _ = prefill(draft_params, d_one, prompt, draft_cfg)
+    out = dict(state)
+    for n in _KV_KEYS:
+        if n in cache:
+            out[n] = lax.dynamic_update_slice(
+                state[n], cache[n],
+                (0, slot) + (0,) * (cache[n].ndim - 2))
+        dn = _DRAFT_PREFIX + n
+        if dn in state and n in d_cache:
+            out[dn] = lax.dynamic_update_slice(
+                state[dn], d_cache[n],
+                (0, slot) + (0,) * (d_cache[n].ndim - 2))
+    out["logits"] = lax.dynamic_update_slice(
+        state["logits"], logits.astype(state["logits"].dtype),
+        (slot, 0))
+    if "q_res" in state:
+        out["q_res"] = lax.dynamic_update_slice(
+            state["q_res"],
+            jnp.zeros((1, state["q_res"].shape[1]), state["q_res"].dtype),
+            (slot, 0))
+    return out
+
+
+@partial(jax.jit, static_argnames=("cfg", "draft_cfg"),
+         donate_argnums=(2,))
+def _engine_paged_spec_prefill(params: dict, draft_params: dict,
+                               state: dict, prompt: jnp.ndarray,
+                               page_ids: jnp.ndarray,
+                               draft_page_ids: jnp.ndarray,
+                               slot: jnp.ndarray,
+                               cfg: TransformerConfig,
+                               draft_cfg: TransformerConfig):
+    """The paged ``_engine_spec_prefill``: prefill both models and
+    scatter each cache page-wise into its own pool (the target's
+    through ``page_ids``, the draft's through ``draft_page_ids`` —
+    static counts, so jit keys one program per prompt length exactly
+    like the plain paged prefill)."""
+    quant = "k_scale" in state
+    one = init_kv_cache(cfg, 1, kv_dtype="int8" if quant else None)
+    cache, logits = prefill(params, one, prompt, cfg)
+    d_one = init_kv_cache(draft_cfg, 1)
+    d_cache, _ = prefill(draft_params, d_one, prompt, draft_cfg)
+    out = dict(state)
+    P = state["k"].shape[2]
+    dP = state[_DRAFT_PREFIX + "k"].shape[2]
+    for n in _KV_KEYS:
+        if n in cache:
+            pool = out[n]
+            for c in range(page_ids.shape[0]):
+                chunk = cache[n][:, 0, c * P:(c + 1) * P][:, None]
+                pool = lax.dynamic_update_slice(
+                    pool, chunk,
+                    (0, page_ids[c], 0) + (0,) * (chunk.ndim - 3))
+            out[n] = pool
+        dn = _DRAFT_PREFIX + n
+        if dn in state and n in d_cache:
+            pool = out[dn]
+            for c in range(draft_page_ids.shape[0]):
+                chunk = d_cache[n][:, 0, c * dP:(c + 1) * dP][:, None]
+                pool = lax.dynamic_update_slice(
+                    pool, chunk,
+                    (0, draft_page_ids[c], 0) + (0,) * (chunk.ndim - 3))
+            out[dn] = pool
+    out["logits"] = lax.dynamic_update_slice(
+        state["logits"], logits.astype(state["logits"].dtype),
+        (slot, 0))
+    if "q_res" in state:
+        out["q_res"] = lax.dynamic_update_slice(
+            state["q_res"],
+            jnp.zeros((1, state["q_res"].shape[1]),
+                      state["q_res"].dtype),
+            (slot, 0))
+    return out
+
+
 @dataclasses.dataclass
 class _SlotState:
     """Host-side bookkeeping for one occupied slot."""
@@ -798,6 +1505,18 @@ class ServingEngine:
         self._stops = np.full((ecfg.num_slots, ecfg.max_stop_tokens),
                               -1, np.int32)
         self._remaining = np.zeros((ecfg.num_slots,), np.int32)
+        # per-slot sampling state (ISSUE 10): raw key bytes derived from
+        # each REQUEST's seed (never the slot — streams are placement/
+        # churn invariant) + the lane's emitted-token index, the two
+        # inputs of the canonical key schedule (models/generate.py
+        # sample_step_key). Greedy engines carry the arrays but never
+        # upload them.
+        self._step_idx = np.zeros((ecfg.num_slots,), np.int32)
+        self._key_data = None
+        if self._needs_keys():
+            kw = np.asarray(
+                jax.random.key_data(jax.random.key(0))).shape[0]
+            self._key_data = np.zeros((ecfg.num_slots, kw), np.uint32)
         # device copies of the block program's slot vectors, carried
         # across blocks: a block with no admit/free in between reuses
         # the PREVIOUS block's device outputs verbatim (they equal the
@@ -835,6 +1554,10 @@ class ServingEngine:
         # at the first dispatch so it lands on the metrics registry the
         # serve loop attaches AFTER construction
         self._dtimer = None
+
+    def _needs_keys(self) -> bool:
+        """Does any dispatch path of this engine consume PRNG keys?"""
+        return self.ecfg.sample is not None
 
     def _device_timer(self):
         if self._dtimer is None:
@@ -987,6 +1710,14 @@ class ServingEngine:
         for j, t in enumerate(stops[:self.ecfg.max_stop_tokens]):
             self._stops[slot, j] = t
         self._remaining[slot] = req.max_new_tokens - len(emitted)
+        # the sampled stream's coordinates: base key from the REQUEST's
+        # seed (rid-derived when unset) and the emitted-token index —
+        # a restore resumes exactly where the drained stream stopped
+        self._step_idx[slot] = len(emitted)
+        if self._key_data is not None:
+            seed = req.seed if req.seed is not None else req.rid
+            self._key_data[slot] = np.asarray(
+                jax.random.key_data(jax.random.key(seed)))
         self._vectors_dirty = True
         self._slots[slot] = _SlotState(req=req, emitted=list(emitted))
         self.peak_occupied = max(self.peak_occupied, self.occupied)
@@ -1014,6 +1745,9 @@ class ServingEngine:
         self._eos[i] = -1
         self._stops[i, :] = -1
         self._remaining[i] = 0
+        self._step_idx[i] = 0
+        if self._key_data is not None:
+            self._key_data[i, :] = 0
         self._vectors_dirty = True
 
     # -- failure handling ----------------------------------------------
@@ -1232,6 +1966,7 @@ class ServingEngine:
             slot.emitted.append(t)
             self._pos[i] += 1
             self._remaining[i] -= 1
+            self._step_idx[i] += 1
             req = slot.req
             if self.metrics is not None:
                 self.metrics.on_token(req.rid, req.submitted_at)
@@ -1245,11 +1980,46 @@ class ServingEngine:
         self._evict_expired(finished)
         return finished
 
+    def _refresh_dev_vectors(self, include_idx: bool) -> dict:
+        """(Re)build the carried per-slot device vectors from host
+    truth when dirty — shared by the block and speculative dispatch
+    paths so a new carried vector can never be added to one and
+    missed by the other. ``include_idx`` adds the sampled/speculative
+    ``step_idx`` carry; key bytes ride whenever the engine samples."""
+        if self._vectors_dirty:
+            self._dev_vectors = {
+                "pos": jnp.asarray(self._pos),
+                "done": jnp.asarray(
+                    np.array([s is None for s in self._slots])),
+                "remaining": jnp.asarray(self._remaining),
+                "eos": jnp.asarray(self._eos),
+                "stops": jnp.asarray(self._stops),
+            }
+            if include_idx:
+                self._dev_vectors["step_idx"] = jnp.asarray(
+                    self._step_idx)
+            if self._key_data is not None:
+                self._dev_vectors["key_data"] = jnp.asarray(
+                    self._key_data)
+            self._vectors_dirty = False
+        return self._dev_vectors
+
+    def _sample_operands(self) -> dict:
+        """The sampled dispatch's extra operands — empty in greedy mode
+        so every greedy call site stays byte-for-byte the historical
+        one (the parity + no-recompile pins)."""
+        if self.ecfg.sample is None:
+            return {}
+        return {"sample": self.ecfg.sample,
+                "key_data": jnp.asarray(self._key_data),
+                "step_idx": jnp.asarray(self._step_idx)}
+
     def _dispatch_single(self, state_in: dict, pos_in, dspan=None):
         with (dspan.annotation() if dspan is not None
               else _null_span()):
             state, packed = _engine_step(
-                self.params, state_in, pos_in, self.cfg)
+                self.params, state_in, pos_in, self.cfg,
+                **self._sample_operands())
             if dspan is not None:
                 # dispatch returned, readback not yet forced:
                 # everything after this mark is the block-until-ready
@@ -1267,17 +2037,8 @@ class ServingEngine:
         steps as wasted."""
         s_steps = self.ecfg.decode_steps
         self._maybe_poison()
-        if self._vectors_dirty:
-            self._dev_vectors = {
-                "pos": jnp.asarray(self._pos),
-                "done": jnp.asarray(
-                    np.array([s is None for s in self._slots])),
-                "remaining": jnp.asarray(self._remaining),
-                "eos": jnp.asarray(self._eos),
-                "stops": jnp.asarray(self._stops),
-            }
-            self._vectors_dirty = False
-        d = self._dev_vectors
+        sampled = self.ecfg.sample is not None
+        d = self._refresh_dev_vectors(include_idx=sampled)
         span = (self.tracer.span("serve_step", occupied=self.occupied,
                                  decode_steps=s_steps)
                 if self.tracer is not None else _null_span())
@@ -1289,10 +2050,9 @@ class ServingEngine:
             with span, self._device_timer().span(
                     occupied=self.occupied,
                     decode_steps=s_steps) as dspan:
-                state, block, pos_d, done_d, rem_d = \
-                    self._guarded_dispatch(
-                        lambda: self._dispatch_block(state_in, d,
-                                                     s_steps, dspan))
+                out = self._guarded_dispatch(
+                    lambda: self._dispatch_block(state_in, d,
+                                                 s_steps, dspan))
         except WatchdogTimeout:
             self.watchdog_trips += 1
             if self.metrics is not None:
@@ -1300,11 +2060,17 @@ class ServingEngine:
             return self._recover("watchdog")
         except InjectedFault:
             return self._recover("fault")
+        if sampled:
+            state, block, pos_d, done_d, rem_d, idx_d = out
+        else:
+            state, block, pos_d, done_d, rem_d = out
+            idx_d = None
         self._state = state
         # carry the post-block device vectors; a dirty event below
         # (admit/free) re-uploads from host truth instead
         self._dev_vectors = {**d, "pos": pos_d, "done": done_d,
-                             "remaining": rem_d}
+                             "remaining": rem_d,
+                             **({"step_idx": idx_d} if sampled else {})}
         self.decode_dispatches += 1
         toks, dev_pos, bad = \
             block[:s_steps], block[s_steps], block[s_steps + 1]
@@ -1331,6 +2097,7 @@ class ServingEngine:
                 consumed += 1
                 self._pos[i] += 1
                 self._remaining[i] -= 1
+                self._step_idx[i] += 1
                 reason = self._finish_reason(req, t, len(slot.emitted))
                 if reason is not None:
                     break
@@ -1361,16 +2128,300 @@ class ServingEngine:
 
     def _dispatch_block(self, state_in: dict, d: dict, s_steps: int,
                         dspan=None):
+        sample = self.ecfg.sample
         with (dspan.annotation() if dspan is not None
               else _null_span()):
-            state, packed, pos_d, done_d, rem_d = _engine_multi_step(
-                self.params, state_in, d["pos"], d["done"],
-                d["remaining"], d["eos"], d["stops"], self.cfg,
-                s_steps)
+            if sample is None:
+                state, packed, pos_d, done_d, rem_d = _engine_multi_step(
+                    self.params, state_in, d["pos"], d["done"],
+                    d["remaining"], d["eos"], d["stops"], self.cfg,
+                    s_steps)
+                if dspan is not None:
+                    dspan.mark_dispatched()  # see _dispatch_single
+                return (state, np.asarray(packed),  # ONE readback per S
+                        pos_d, done_d, rem_d)
+            state, packed, pos_d, done_d, rem_d, idx_d = \
+                _engine_multi_step(
+                    self.params, state_in, d["pos"], d["done"],
+                    d["remaining"], d["eos"], d["stops"], self.cfg,
+                    s_steps, sample=sample, key_data=d["key_data"],
+                    step_idx=d["step_idx"])
             if dspan is not None:
-                dspan.mark_dispatched()  # see _dispatch_single
-            return (state, np.asarray(packed),  # ONE readback per S
-                    pos_d, done_d, rem_d)
+                dspan.mark_dispatched()
+            return (state, np.asarray(packed), pos_d, done_d, rem_d,
+                    idx_d)
+
+
+class _SpeculativeMixin:
+    """The host half of speculative serving (ISSUE 10), shared by the
+    slot (:class:`SpeculativeEngine`) and paged
+    (:class:`PagedSpeculativeEngine`) engines: block unpack with the
+    acceptance replay, the draft-token ledger (``draft_proposed ==
+    draft_accepted + draft_rejected``, rejected charged to wasted
+    tokens), admission headroom (the verify writes ``draft_steps``
+    positions past the emitted frontier — the offline
+    ``speculative_generate`` guard, per slot) and the dispatch-vector
+    carry. Each concrete class supplies state layout, prefill and the
+    dispatch itself."""
+
+    def _init_spec(self, draft_params: dict,
+                   draft_cfg: TransformerConfig, cfg: TransformerConfig,
+                   ecfg: EngineConfig) -> None:
+        if ecfg.draft_steps < 1:
+            raise ValueError(
+                "a speculative engine needs draft_steps >= 1 "
+                "(EngineConfig.draft_steps; plain engines use 0)")
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft and target must share a vocabulary: "
+                f"{draft_cfg.vocab_size} != {cfg.vocab_size}")
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        # the draft ledger (ISSUE 10 satellite): proposed == accepted +
+        # rejected by construction per block; rejected feeds the
+        # wasted-token account (verify positions computed then thrown
+        # away — the speculation tax the acceptance rate prices)
+        self.draft_proposed = 0
+        self.draft_accepted = 0
+        self.draft_rejected = 0
+        self._lane_draft: dict = {}  # slot -> [proposed, accepted]
+
+    @property
+    def acceptance_rate(self) -> float:
+        return (self.draft_accepted / self.draft_proposed
+                if self.draft_proposed else 0.0)
+
+    def speculative_summary(self) -> dict:
+        return {"draft_steps": self.ecfg.draft_steps,
+                "draft_proposed": self.draft_proposed,
+                "draft_accepted": self.draft_accepted,
+                "draft_rejected": self.draft_rejected,
+                "acceptance_rate": round(self.acceptance_rate, 4)}
+
+    def kv_cache_bytes(self) -> int:
+        # target + draft caches; the carried logits/q_res are not cache
+        return sum(int(self._state[n].size
+                       * self._state[n].dtype.itemsize)
+                   for n in self._state
+                   if n not in ("logits", "q_res"))
+
+    def _validate_admit(self, req: Request, emitted: tuple) -> tuple:
+        stops = super()._validate_admit(req, emitted)
+        k = self.ecfg.draft_steps
+        n = len(req.prompt)
+        if n + req.max_new_tokens + k > self.cfg.max_seq:
+            # k of HEADROOM beyond the final emitted length: a last
+            # block's verify can write k positions past the frontier,
+            # and dynamic_update_slice would silently CLAMP an
+            # out-of-range write onto live prefix entries (the offline
+            # speculative_generate guard, per slot)
+            raise ValueError(
+                f"request {req.rid}: prompt {n} + max_new_tokens "
+                f"{req.max_new_tokens} + draft_steps {k} exceeds "
+                f"max_seq {self.cfg.max_seq} (speculative blocks write "
+                f"up to draft_steps positions past the emitted "
+                f"frontier)")
+        if n + req.max_new_tokens + k > self.draft_cfg.max_seq:
+            raise ValueError(
+                f"request {req.rid}: draft max_seq "
+                f"{self.draft_cfg.max_seq} must cover prompt + "
+                f"max_new_tokens + draft_steps = "
+                f"{n + req.max_new_tokens + k}")
+        if len(tuple(req.stop_tokens or ())) > self.ecfg.max_stop_tokens:
+            # the speculative block latches stops ON DEVICE like the
+            # S>1 engine; the static stop matrix bounds the row
+            raise ValueError(
+                f"request {req.rid}: {len(req.stop_tokens)} stop tokens "
+                f"exceed the block program's static width "
+                f"max_stop_tokens={self.ecfg.max_stop_tokens}")
+        return stops
+
+    def _free_slot(self, i: int) -> None:
+        self._lane_draft.pop(i, None)
+        super()._free_slot(i)
+
+    def step(self) -> list:
+        return self._step_spec()
+
+    def _step_spec(self) -> list:
+        """One speculative block dispatch + unpack: the `_step_block`
+        shape with the token rows replaced by [anchor, proposals] and
+        the consume loop bounded by each lane's accepted count — the
+        host replays the device latch token for token, then settles
+        the draft ledger from what actually entered the stream."""
+        k = self.ecfg.draft_steps
+        self._maybe_poison()
+        d = self._refresh_dev_vectors(include_idx=True)
+        span = (self.tracer.span("serve_step", occupied=self.occupied,
+                                 draft_steps=k)
+                if self.tracer is not None else _null_span())
+        state_in = self._state  # see step(): donate the snapshot only
+        try:
+            with span, self._device_timer().span(
+                    occupied=self.occupied, draft_steps=k) as dspan:
+                state, block, pos_d, done_d, rem_d, idx_d = \
+                    self._guarded_dispatch(
+                        lambda: self._dispatch_spec(state_in, d, k,
+                                                    dspan))
+        except WatchdogTimeout:
+            self.watchdog_trips += 1
+            if self.metrics is not None:
+                self.metrics.on_watchdog_trip()
+            return self._recover("watchdog")
+        except InjectedFault:
+            return self._recover("fault")
+        self._state = state
+        self._dev_vectors = {**d, "pos": pos_d, "done": done_d,
+                             "remaining": rem_d, "step_idx": idx_d}
+        self.decode_dispatches += 1
+        toks, n_accs, dev_pos, bad = \
+            block[:k + 1], block[k + 1], block[k + 2], block[k + 3]
+        finished = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if bad[i]:
+                finished.append(self._fail_lane(i, "nan"))
+                if self.metrics is not None:
+                    self.metrics.on_fault_survived("nan")
+                continue
+            req = slot.req
+            n_acc = int(n_accs[i])
+            reason = None
+            consumed = 0
+            for j in range(n_acc + 1):
+                t = int(toks[j, i])
+                slot.emitted.append(t)
+                consumed += 1
+                self._pos[i] += 1
+                self._remaining[i] -= 1
+                self._step_idx[i] += 1
+                reason = self._finish_reason(req, t, len(slot.emitted))
+                if reason is not None:
+                    break
+            # ledger: this block proposed k draft tokens for the lane;
+            # the ones that entered the emitted stream (everything the
+            # host consumed past the anchor) are accepted, the rest
+            # rejected — computed-then-discarded verify work, charged
+            # to the wasted-token account
+            accepted = consumed - 1
+            rejected = k - accepted
+            self.draft_proposed += k
+            self.draft_accepted += accepted
+            self.draft_rejected += rejected
+            self.wasted_tokens += rejected
+            ld = self._lane_draft.setdefault(i, [0, 0])
+            ld[0] += k
+            ld[1] += accepted
+            if self.metrics is not None:
+                self.metrics.on_block_tokens(req.rid, req.submitted_at,
+                                             consumed)
+                self.metrics.on_draft_block(req.rid, k, accepted)
+            if reason is not None:
+                if self.metrics is not None:
+                    prop, acc = self._lane_draft.get(i, (0, 0))
+                    self.metrics.on_draft_complete(
+                        req.rid, acc / prop if prop else 0.0)
+                    self.metrics.on_complete(req.rid, len(slot.emitted),
+                                             reason)
+                finished.append((i, req, slot.emitted, reason))
+                self._free_slot(i)
+            elif int(dev_pos[i]) != int(self._pos[i]):
+                raise RuntimeError(
+                    f"slot {i} (rid {req.rid}): device pos "
+                    f"{int(dev_pos[i])} != host replay {self._pos[i]} "
+                    f"after a draft_steps={k} speculative block — "
+                    f"on-device accept latch and host replay diverged")
+        self._evict_expired(finished)
+        return finished
+
+
+class SpeculativeEngine(_SpeculativeMixin, ServingEngine):
+    """The speculative slot engine (ISSUE 10 tentpole): the
+    continuous-batching engine's host loop, admission, failure story
+    and no-recompile discipline, with every decode dispatch replaced
+    by a draft-verify block — a small DRAFT model proposes
+    ``draft_steps`` tokens per slot, ONE target verify extend scores
+    the anchor + all proposals, and per-slot acceptance emits 1 to
+    draft_steps + 1 tokens per dispatch.
+
+    Greedy output (temperature 0) is BITWISE the plain greedy
+    engine's / ``generate()``'s: the verify extend runs the slot
+    step's exact math batched over block positions (``_slot_extend``),
+    acceptance keeps exactly the tokens greedy decode would have
+    picked, and the carried logits after a block are the extend row at
+    the accepted frontier — bit-for-bit the logits the sequential
+    engine would carry. Sampled mode implements per-slot
+    modified-rejection sampling (the carried ``q_res`` residual row),
+    preserving the target's sampling distribution per request.
+
+    The draft's KV cache rides the SAME donated state dict under
+    ``draft_*`` keys: one donation covers both models' caches, and
+    watchdog recovery rebuilds both at warmup avals (compiling
+    nothing, like every other recovery). One sampled-mode restore
+    caveat (DESIGN.md §15): the pending-rejection residual ``q_res``
+    is device state a drain does not snapshot, so a restored sampled
+    stream's FIRST anchor samples from plain p — a one-token
+    distributional nudge; determinism and temp-0 parity are
+    unaffected."""
+
+    def __init__(self, params: dict, cfg: TransformerConfig,
+                 draft_params: dict, draft_cfg: TransformerConfig,
+                 ecfg: EngineConfig = EngineConfig(draft_steps=4),
+                 metrics=None, tracer=None, clock=time.monotonic,
+                 site_prefix: str = "engine"):
+        self._init_spec(draft_params, draft_cfg, cfg, ecfg)
+        super().__init__(params, cfg, ecfg, metrics=metrics,
+                         tracer=tracer, clock=clock,
+                         site_prefix=site_prefix)
+
+    def _fresh_state(self) -> dict:
+        base = init_kv_cache(self.cfg, self.ecfg.num_slots,
+                             kv_dtype=self.ecfg.kv_dtype)
+        del base["pos"]
+        draft = init_kv_cache(self.draft_cfg, self.ecfg.num_slots)
+        del draft["pos"]
+        state = {**base,
+                 **{_DRAFT_PREFIX + n: draft[n] for n in draft},
+                 "logits": jnp.zeros(
+                     (self.ecfg.num_slots, self.cfg.vocab_size),
+                     self.cfg.dtype)}
+        if self.ecfg.sample is not None:
+            # the pending-rejection residual (sampled speculation):
+            # zero rows = no rejection pending = plain sampling
+            state["q_res"] = jnp.zeros(
+                (self.ecfg.num_slots, self.cfg.vocab_size), jnp.float32)
+        return state
+
+    def _prefill_into(self, slot: int, req: Request, full: tuple) -> None:
+        n_full = len(full)
+        arr = np.asarray(full, np.int32)[None]
+        span = (self.tracer.span("serve_prefill", rid=req.rid,
+                                 slot=slot, prompt_len=n_full,
+                                 speculative=True)
+                if self.tracer is not None else _null_span())
+        with span:
+            self._state = _engine_spec_prefill(
+                self.params, self.draft_params, self._state,
+                jnp.asarray(arr), jnp.asarray(slot, jnp.int32),
+                self.cfg, self.draft_cfg)
+        self.prefill_dispatches += 1
+        self.prefill_shapes.add((n_full, False))
+
+    def _dispatch_spec(self, state_in: dict, d: dict, k: int,
+                       dspan=None):
+        with (dspan.annotation() if dspan is not None
+              else _null_span()):
+            state, packed, pos_d, done_d, rem_d, idx_d = \
+                _engine_speculative_step(
+                    self.params, self.draft_params, state_in,
+                    d["pos"], d["done"], d["remaining"], d["eos"],
+                    d["stops"], d["step_idx"], d.get("key_data"),
+                    self.cfg, self.draft_cfg, k, self.ecfg.sample)
+            if dspan is not None:
+                dspan.mark_dispatched()
+            return (state, np.asarray(packed), pos_d, done_d, rem_d,
+                    idx_d)
 
 
 class PagedServingEngine(ServingEngine):
@@ -1516,32 +2567,37 @@ class PagedServingEngine(ServingEngine):
         conservative over the block (a lane that latches early splits a
         page it wouldn't have written; correctness is unaffected)."""
         s_steps = self.ecfg.decode_steps
-        P = self.ecfg.page_size
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
-            pages = self._lane_pages[i]
-            p0 = int(self._pos[i])
             n_write = max(1, min(s_steps, int(self._remaining[i])))
-            last = min(p0 + n_write - 1, self._lane_end[i] - 1)
-            for c in range(p0 // P, min(last // P + 1, len(pages))):
-                page = pages[c]
-                if not (self.pool.is_shared(page)
-                        or self.pool.is_registered(page)):
-                    continue
-                new = self.pool.split_for_write(page)
-                if new is not None:
-                    self._state = _copy_page(
-                        self._state, jnp.asarray(page, jnp.int32),
-                        jnp.asarray(new, jnp.int32))
-                    self.cow_page_copies += 1
-                    pages[c] = new
-                    self._pt[i, c] = new
-                    self._pt_dirty = True
-                    if self.tracer is not None:
-                        self.tracer.record("serve_cow_split", slot=i,
-                                           rid=slot.req.rid,
-                                           src=page, dst=new)
+            self._resolve_lane_writes(i, slot, n_write)
+
+    def _resolve_lane_writes(self, i: int, slot, n_write: int) -> None:
+        """COW-resolve the target-pool pages lane ``i``'s next dispatch
+        can write (``n_write`` positions from its current one)."""
+        P = self.ecfg.page_size
+        pages = self._lane_pages[i]
+        p0 = int(self._pos[i])
+        last = min(p0 + n_write - 1, self._lane_end[i] - 1)
+        for c in range(p0 // P, min(last // P + 1, len(pages))):
+            page = pages[c]
+            if not (self.pool.is_shared(page)
+                    or self.pool.is_registered(page)):
+                continue
+            new = self.pool.split_for_write(page)
+            if new is not None:
+                self._state = _copy_page(
+                    self._state, jnp.asarray(page, jnp.int32),
+                    jnp.asarray(new, jnp.int32))
+                self.cow_page_copies += 1
+                pages[c] = new
+                self._pt[i, c] = new
+                self._pt_dirty = True
+                if self.tracer is not None:
+                    self.tracer.record("serve_cow_split", slot=i,
+                                       rid=slot.req.rid,
+                                       src=page, dst=new)
 
     def step(self) -> list:
         self._prepare_writes()
@@ -1561,7 +2617,7 @@ class PagedServingEngine(ServingEngine):
               else _null_span()):
             state, packed = _engine_paged_step(
                 self.params, state_in, pos_in, pt, self.cfg,
-                self.ecfg.attention_impl)
+                self.ecfg.attention_impl, **self._sample_operands())
             if dspan is not None:
                 dspan.mark_dispatched()
             return state, np.asarray(packed)
@@ -1569,16 +2625,29 @@ class PagedServingEngine(ServingEngine):
     def _dispatch_block(self, state_in: dict, d: dict, s_steps: int,
                         dspan=None):
         pt = self._page_table_device()
+        sample = self.ecfg.sample
         with (dspan.annotation() if dspan is not None
               else _null_span()):
-            state, packed, pos_d, done_d, rem_d = \
+            if sample is None:
+                state, packed, pos_d, done_d, rem_d = \
+                    _engine_paged_multi_step(
+                        self.params, state_in, d["pos"], d["done"],
+                        d["remaining"], d["eos"], d["stops"], pt,
+                        self.cfg, s_steps, self.ecfg.attention_impl)
+                if dspan is not None:
+                    dspan.mark_dispatched()
+                return (state, np.asarray(packed), pos_d, done_d, rem_d)
+            state, packed, pos_d, done_d, rem_d, idx_d = \
                 _engine_paged_multi_step(
                     self.params, state_in, d["pos"], d["done"],
                     d["remaining"], d["eos"], d["stops"], pt,
-                    self.cfg, s_steps, self.ecfg.attention_impl)
+                    self.cfg, s_steps, self.ecfg.attention_impl,
+                    sample=sample, key_data=d["key_data"],
+                    step_idx=d["step_idx"])
             if dspan is not None:
                 dspan.mark_dispatched()
-            return (state, np.asarray(packed), pos_d, done_d, rem_d)
+            return (state, np.asarray(packed), pos_d, done_d, rem_d,
+                    idx_d)
 
     # -- introspection / metrics ----------------------------------------
 
@@ -1621,6 +2690,167 @@ class PagedServingEngine(ServingEngine):
         }
 
 
+class PagedSpeculativeEngine(_SpeculativeMixin, PagedServingEngine):
+    """Speculative decode over the PAGED engine (ISSUE 10 x ISSUE 7):
+    the target KV stays in the main page pool behind its page table;
+    the DRAFT model's KV lives in its own small pool — same page
+    geometry (the draft tracks the same token frontier), a fraction of
+    the bytes (draft dims) — behind a second int32 table operand.
+
+    The draft pool never shares pages (``PagePool.admit(share=False)``):
+    prefix sharing would put shared pages under the draft's block
+    writes, and the COW device copy covers the target pool's keys
+    only. The target pool keeps its full sharing/COW story — the
+    pre-write pass just widens to the ``draft_steps + 1`` positions a
+    speculative verify writes. Greedy parity is bitwise through the
+    gather read path, exactly as for the plain paged engine."""
+
+    def __init__(self, params: dict, cfg: TransformerConfig,
+                 draft_params: dict, draft_cfg: TransformerConfig,
+                 ecfg: "PagedEngineConfig" = None,
+                 metrics=None, tracer=None, clock=time.monotonic,
+                 site_prefix: str = "engine"):
+        from akka_allreduce_tpu.serving.paging import PagePool, pages_for
+        if ecfg is None:
+            ecfg = PagedEngineConfig(draft_steps=4)
+        self._init_spec(draft_params, draft_cfg, cfg, ecfg)
+        if not isinstance(ecfg, PagedEngineConfig):
+            raise TypeError(
+                f"PagedSpeculativeEngine needs a PagedEngineConfig, "
+                f"got {type(ecfg).__name__}")
+        # the draft pool: same positions-per-lane budget as the target
+        # (both caches advance to the same frontier), its own free
+        # list/table — "small" because a draft position's bytes are a
+        # fraction of the target's
+        self._draft_pages_per_seq = pages_for(cfg.max_seq,
+                                              ecfg.page_size)
+        self.draft_pool = PagePool(
+            ecfg.num_slots * self._draft_pages_per_seq + 1,
+            ecfg.page_size, scratch_pages=1)
+        self._draft_lane_pages: "list[Optional[list]]" = \
+            [None] * ecfg.num_slots
+        self._draft_pt = np.zeros(
+            (ecfg.num_slots, self._draft_pages_per_seq), np.int32)
+        self._draft_pt_dirty = True
+        self._dev_draft_pt = None
+        super().__init__(params, cfg, ecfg, metrics=metrics,
+                         tracer=tracer, clock=clock,
+                         site_prefix=site_prefix)
+
+    def _fresh_state(self) -> dict:
+        draft = init_kv_pool(self.draft_cfg, self.draft_pool.num_pages,
+                             self.ecfg.page_size)
+        state = {**init_kv_pool(self.cfg, self.pool.num_pages,
+                                self.ecfg.page_size,
+                                kv_dtype=self.ecfg.kv_dtype),
+                 **{_DRAFT_PREFIX + n: draft[n] for n in draft},
+                 "logits": jnp.zeros(
+                     (self.ecfg.num_slots, self.cfg.vocab_size),
+                     self.cfg.dtype)}
+        if self.ecfg.sample is not None:
+            state["q_res"] = jnp.zeros(
+                (self.ecfg.num_slots, self.cfg.vocab_size), jnp.float32)
+        return state
+
+    # -- admission: both pools must cover prompt + budget + headroom --
+
+    def _spec_budget(self, req: Request, emitted: tuple) -> int:
+        """Page reservation per lane: decode budget plus the
+        draft_steps positions a final verify can write past the
+        frontier (the paged rendering of the max_seq headroom)."""
+        return (req.max_new_tokens - len(emitted)
+                + self.ecfg.draft_steps)
+
+    def can_admit(self, req: Request, emitted: tuple = ()) -> bool:
+        full = tuple(req.prompt) + tuple(emitted)
+        budget = self._spec_budget(req, emitted)
+        return (self.pool.can_admit(full, budget)
+                and self.draft_pool.can_admit(full, budget,
+                                              share=False))
+
+    def _prefill_into(self, slot: int, req: Request, full: tuple) -> None:
+        from akka_allreduce_tpu.serving.paging import pages_for
+        n_full = len(full)
+        budget = self._spec_budget(req, full[len(req.prompt):])
+        pages, _writes = self.pool.admit(full, budget)
+        d_pages, _d_writes = self.draft_pool.admit(full, budget,
+                                                   share=False)
+        self._lane_pages[slot] = pages
+        self._draft_lane_pages[slot] = d_pages
+        self._lane_end[slot] = n_full + budget
+        self._pt[slot, :] = 0
+        self._pt[slot, :len(pages)] = pages
+        self._pt_dirty = True
+        self._draft_pt[slot, :] = 0
+        self._draft_pt[slot, :len(d_pages)] = d_pages
+        self._draft_pt_dirty = True
+        self._unshared_pages_now += pages_for(n_full + budget,
+                                              self.ecfg.page_size)
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pool.pages_in_use)
+        self.peak_pages_unshared = max(self.peak_pages_unshared,
+                                       self._unshared_pages_now)
+        arr = np.asarray(full, np.int32)[None]
+        n_cov = pages_for(n_full, self.ecfg.page_size)
+        span = (self.tracer.span("serve_prefill", rid=req.rid,
+                                 slot=slot, prompt_len=n_full,
+                                 pages=len(pages), speculative=True,
+                                 shared=sum(1 for w in _writes if not w))
+                if self.tracer is not None else _null_span())
+        with span:
+            self._state = _engine_paged_spec_prefill(
+                self.params, self.draft_params, self._state,
+                jnp.asarray(arr), jnp.asarray(pages[:n_cov], jnp.int32),
+                jnp.asarray(d_pages[:n_cov], jnp.int32),
+                jnp.asarray(slot, jnp.int32), self.cfg, self.draft_cfg)
+        self.prefill_dispatches += 1
+        self.prefill_shapes.add((n_full, False))
+
+    def _free_slot(self, i: int) -> None:
+        if self._draft_lane_pages[i] is not None:
+            self.draft_pool.release_all(self._draft_lane_pages[i])
+            self._draft_lane_pages[i] = None
+        self._draft_pt[i, :] = 0
+        self._draft_pt_dirty = True
+        super()._free_slot(i)
+
+    # -- dispatch ------------------------------------------------------
+
+    def step(self) -> list:
+        # the verify writes draft_steps + 1 target-pool positions per
+        # active lane whatever its remaining budget; resolve sharing
+        # over that whole span (the draft pool never shares)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._resolve_lane_writes(i, slot,
+                                          self.ecfg.draft_steps + 1)
+        return self._step_spec()
+
+    def _draft_table_device(self):
+        if self._draft_pt_dirty or self._dev_draft_pt is None:
+            self._dev_draft_pt = jnp.asarray(self._draft_pt)
+            self._draft_pt_dirty = False
+        return self._dev_draft_pt
+
+    def _dispatch_spec(self, state_in: dict, d: dict, k: int,
+                       dspan=None):
+        pt = self._page_table_device()
+        dpt = self._draft_table_device()
+        with (dspan.annotation() if dspan is not None
+              else _null_span()):
+            state, packed, pos_d, done_d, rem_d, idx_d = \
+                _engine_paged_speculative_step(
+                    self.params, self.draft_params, state_in,
+                    d["pos"], d["done"], d["remaining"], d["eos"],
+                    d["stops"], d["step_idx"], d.get("key_data"),
+                    pt, dpt, self.cfg, self.draft_cfg, k,
+                    self.ecfg.sample)
+            if dspan is not None:
+                dspan.mark_dispatched()
+            return (state, np.asarray(packed), pos_d, done_d, rem_d,
+                    idx_d)
+
+
 class _null_span:
     def __enter__(self):
         return self
@@ -1646,7 +2876,11 @@ def _req_to_json(req: Request) -> dict:
             "max_new_tokens": req.max_new_tokens,
             "eos_token": req.eos_token,
             "stop_tokens": list(req.stop_tokens or ()),
-            "attempts": req.attempts}
+            "attempts": req.attempts,
+            # the sampled stream's identity: a restore in the NEXT
+            # process must resume the same key schedule (None stays
+            # rid-derived, which the rid already preserves)
+            "seed": req.seed}
 
 
 def _req_from_json(d: dict) -> Request:
@@ -1660,7 +2894,8 @@ def _req_from_json(d: dict) -> Request:
                    eos_token=d["eos_token"],
                    stop_tokens=tuple(d["stop_tokens"]),
                    arrival=0.0, submitted_at=None,
-                   attempts=d["attempts"])
+                   attempts=d["attempts"],
+                   seed=d.get("seed"))
 
 
 def persist_drained(directory: str, drained, metrics=None) -> str:

@@ -84,6 +84,17 @@ class ServingMetrics:
         self.requests_submitted = 0
         self.requests_completed = 0
         self.requests_rejected = 0
+        # -- speculative decode (ISSUE 10): the draft-token ledger.
+        # proposed == accepted + rejected holds per block by
+        # construction (the engine settles it from the host replay);
+        # rejected tokens are verify work computed then discarded and
+        # feed wasted_tokens, so the wasted_token_rate denominator
+        # prices speculation honestly. The per-completion acceptance
+        # histogram is the operator's choosing-k signal.
+        self.draft_proposed = 0
+        self.draft_accepted = 0
+        self.draft_rejected = 0
+        self.draft_acceptance = Histogram()
         # -- fault-tolerance counters (ISSUE 5): the robustness story in
         # numbers, surfaced in summary() next to wasted_token_rate
         self.retries_total = 0          # requeues within the budget
@@ -156,7 +167,15 @@ class ServingMetrics:
             ("serve_decode_tokens_total", lambda: self.decode_tokens,
              "decode tokens delivered"),
             ("serve_wasted_tokens_total", lambda: self.wasted_tokens,
-             "block tail waste + failure/eviction discards"),
+             "block tail waste + failure/eviction discards + rejected "
+             "draft tokens"),
+            ("serve_draft_proposed_total", lambda: self.draft_proposed,
+             "draft tokens proposed by the speculative engine"),
+            ("serve_draft_accepted_total", lambda: self.draft_accepted,
+             "draft tokens accepted into emitted streams"),
+            ("serve_draft_rejected_total", lambda: self.draft_rejected,
+             "draft tokens rejected (verify work discarded — feeds "
+             "wasted tokens)"),
         )
         for name, pull, help_text in counters:
             r.register_callback(name, pull, kind="counter",
@@ -174,6 +193,9 @@ class ServingMetrics:
              lambda: self.wasted_per_completion,
              "block steps computed after the lane's done-mask latched, "
              "per completion"),
+            ("serve_draft_acceptance", lambda: self.draft_acceptance,
+             "per-completion draft acceptance rate (accepted / "
+             "proposed over the request's lifetime)"),
         )
         for name, pull, help_text in histograms:
             r.register_histogram(name, pull, help=help_text,
@@ -344,6 +366,30 @@ class ServingMetrics:
         self._drain_persisted.inc(n)
         self._record("serve_drain_persisted", count=n)
 
+    def on_draft_block(self, rid: int, proposed: int,
+                       accepted: int) -> None:
+        """One speculative block settled for ``rid``: ``proposed``
+        draft tokens were scored by the verify, ``accepted`` of them
+        entered the emitted stream (acceptance AND the done-latch both
+        bound it — a proposal accepted by the test but cut by EOS/
+        budget still counts rejected: it was computed and thrown
+        away). Rejected tokens move into the wasted account."""
+        self.draft_proposed += proposed
+        self.draft_accepted += accepted
+        rejected = proposed - accepted
+        self.draft_rejected += rejected
+        self.wasted_tokens += rejected
+        self._record("serve_draft_block", rid=rid, proposed=proposed,
+                     accepted=accepted)
+
+    def on_draft_complete(self, rid: int, rate: float) -> None:
+        """A speculative request finished: bank its lifetime
+        acceptance rate (accepted / proposed) in the per-completion
+        histogram."""
+        self.draft_acceptance.record(rate)
+        self._record("serve_draft_complete", rid=rid,
+                     acceptance=round(rate, 4))
+
     def on_wasted(self, rid: int, n: int) -> None:
         """Block steps the device computed for ``rid``'s lane after its
         done-mask latched (multi-step tail waste); called once per
@@ -432,6 +478,18 @@ class ServingMetrics:
             "queue_depth": self.queue_depth.summary(digits=2),
             "slot_occupancy": self.slot_occupancy.summary(digits=3),
         }
+        if self.draft_proposed:
+            # the speculation story (speculative engines only): the
+            # same cells the serve_draft_* collectors read
+            out["speculative"] = {
+                "draft_proposed": self.draft_proposed,
+                "draft_accepted": self.draft_accepted,
+                "draft_rejected": self.draft_rejected,
+                "acceptance_rate": round(
+                    self.draft_accepted / self.draft_proposed, 4),
+                "acceptance_per_completion":
+                    self.draft_acceptance.summary(digits=3),
+            }
         if self._paging is not None:
             # the page-pool story (paged engine only): the same dict
             # the registry's serve_page_* collectors read

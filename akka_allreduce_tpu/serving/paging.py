@@ -190,13 +190,18 @@ class PagePool:
     # -- admission ------------------------------------------------------
 
     def plan(self, prompt: tuple, max_new_tokens: int,
-             count: bool = False) -> AdmitPlan:
+             count: bool = False, share: bool = True) -> AdmitPlan:
         """Price a request without changing any state. ``count=False``
         (the admission-gate poll) leaves the prefix-hit counters alone;
         :meth:`admit` prices with ``count=True`` so the exported rate
-        reflects admissions, not gate polls."""
+        reflects admissions, not gate polls. ``share=False`` prices a
+        no-sharing pool (every page fresh; the speculative engine's
+        draft pool — see :meth:`admit`)."""
         n = len(prompt)
         total = pages_for(n + max_new_tokens, self.page_size)
+        if not share:
+            return AdmitPlan(total_pages=total, shared_full=0,
+                             tail_shared=False, fresh_pages=total)
         full = n // self.page_size
         shared_full = 0
         for k in range(full):
@@ -214,13 +219,14 @@ class PagePool:
                          tail_shared=tail_shared,
                          fresh_pages=total - shared_full)
 
-    def can_admit(self, prompt: tuple, max_new_tokens: int) -> bool:
+    def can_admit(self, prompt: tuple, max_new_tokens: int,
+                  share: bool = True) -> bool:
         """The admission gate: will :meth:`admit` succeed right now?"""
-        return self.plan(prompt, max_new_tokens).fresh_pages \
-            <= self.free_pages
+        return self.plan(prompt, max_new_tokens,
+                         share=share).fresh_pages <= self.free_pages
 
-    def admit(self, prompt: tuple, max_new_tokens: int
-              ) -> "tuple[list, list]":
+    def admit(self, prompt: tuple, max_new_tokens: int,
+              share: bool = True) -> "tuple[list, list]":
         """Allocate/share the request's end-to-end page list.
 
         Returns ``(pages, prefill_writes)``: ``pages`` is the full
@@ -232,13 +238,25 @@ class PagePool:
         the flag is the HBM-saving accounting). A shared tail page gets
         a spare pushed onto its pile (module docstring). Raises
         RuntimeError when the free list cannot cover the bill — callers
-        gate on :meth:`can_admit` / :meth:`plan` first."""
-        plan = self.plan(prompt, max_new_tokens, count=True)
+        gate on :meth:`can_admit` / :meth:`plan` first.
+
+        ``share=False`` allocates every page fresh and registers
+        NOTHING — the speculative engine's draft pool, whose block
+        writes would otherwise land on shared/registered pages the
+        device COW copy does not cover. The prefix counters stay
+        untouched so the exported hit rate keeps describing the
+        sharing pool only."""
+        plan = self.plan(prompt, max_new_tokens, count=share,
+                         share=share)
         if plan.fresh_pages > self.free_pages:
             raise RuntimeError(
                 f"page pool exhausted: need {plan.fresh_pages} fresh "
                 f"pages, have {self.free_pages} (gate admission on "
                 f"can_admit)")
+        if not share:
+            pages = [self._alloc() for _ in range(plan.total_pages)]
+            return pages, [True] * (pages_for(len(prompt),
+                                              self.page_size))
         n = len(prompt)
         full = n // self.page_size
         pages: list = []

@@ -72,6 +72,14 @@ class Request:
     ``deadline`` is an absolute completion target the deadline policy
     sorts by. ``submitted_at`` is stamped by :meth:`RequestScheduler
     .submit`.
+
+    ``seed`` drives a SAMPLED engine's per-request PRNG stream
+    (models/generate.py ``sample_step_key``): the request's tokens are
+    a pure function of (seed, sampling config, model), invariant to
+    slot placement, admission order, churn and drain/restore. None
+    (the default) derives the stream from ``rid`` — still
+    deterministic per request, without the caller having to thread a
+    seed. Greedy engines ignore it.
     """
 
     rid: int
@@ -82,6 +90,7 @@ class Request:
     arrival: float = 0.0
     deadline: Optional[float] = None
     submitted_at: Optional[float] = None
+    seed: Optional[int] = None
     # failed-attempt count, stamped by requeue_failed — the retry
     # budget's ledger (a request enters the system with 0)
     attempts: int = 0

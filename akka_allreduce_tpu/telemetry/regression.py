@@ -46,7 +46,8 @@ from typing import Optional
 
 # sections the gate knows how to re-measure, in bank order
 SECTIONS = ("serving_throughput", "multi_step_decode", "paged_serving",
-            "replicated_serving", "ab_overlap", "quantized_collectives")
+            "replicated_serving", "speculative_serving", "ab_overlap",
+            "quantized_collectives")
 
 # per-section relative tolerance, derived from the banked captures' own
 # recorded run-to-run spread (module docstring); _DEFAULT for unknowns
@@ -63,6 +64,11 @@ SECTION_TOLERANCE = {
     # the gated row is a RATIO of two serve runs on the same box —
     # same noise regime as the serving sections
     "replicated_serving": 0.45,
+    # ISSUE 10: speculative (half-layer distilled-stand-in draft) vs
+    # sampled-S=1 tok/s ratio — serving noise regime again (the
+    # full-cost self-draft row is deliberately named self_RATIO, not
+    # *_speedup, so only the spec-arm claim gates)
+    "speculative_serving": 0.45,
     "ab_overlap": 0.35,
     # ISSUE 9: swing/ef8 goodput as a fraction of the fused psum,
     # measured back-to-back in one run — two-point deltas on a shared
@@ -230,6 +236,14 @@ def fresh_rows(section: str) -> list:
                 n_requests=32, prompt_len=64, steps=128, slots=4,
                 page_size=32, max_seq=1024)
         return measure_paged_serving()
+    if section == "speculative_serving":
+        from akka_allreduce_tpu.bench import (
+            measure_speculative_serving)
+        if on_tpu:
+            return measure_speculative_serving(
+                d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+                n_requests=16, prompt_len=64, steps=128, slots=4)
+        return measure_speculative_serving()
     if section == "replicated_serving":
         from akka_allreduce_tpu.bench import measure_replicated_serving
         if on_tpu:

@@ -89,7 +89,8 @@ def main(only=None) -> int:
                (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                 ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
                 serving_throughput, multi_step_decode, paged_serving,
-                replicated_serving, quantized_collectives)}
+                replicated_serving, speculative_serving,
+                quantized_collectives)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -173,7 +174,8 @@ def main(only=None) -> int:
     for fn in (ab_pallas_vs_xla, ab_flash_attention, ab_windowed_sp,
                ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
                serving_throughput, multi_step_decode, paged_serving,
-               replicated_serving, quantized_collectives):
+               replicated_serving, speculative_serving,
+               quantized_collectives):
         if fn.__name__ not in skip:
             fn()
     return 0
@@ -282,6 +284,32 @@ def replicated_serving():
             n_replicas=2)
     else:
         rows = measure_replicated_serving()
+    for row in rows:
+        emit(row["metric"], row["value"], row["unit"], row["note"])
+
+
+def speculative_serving():
+    """The speculative-decode A/B (ISSUE 10, SpeculativeEngine):
+    sampled S=1 engine vs the draft-verify speculative engine at equal
+    slots (slots=1, the latency regime) — the gated
+    ``speculative_serving_speedup`` claim is the SPEC arm (half-layer
+    draft over the back-half-attenuated target, the distilled-pair
+    stand-in); the full-cost self-draft rides as the ungated
+    ``self_ratio`` structure price, and a fused sampled S=k+1 block
+    row for context (akka_allreduce_tpu.bench
+    measure_speculative_serving). CPU sizes down like the other
+    serving sections; TPU sizes up."""
+    import jax
+
+    from akka_allreduce_tpu.bench import measure_speculative_serving
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        rows = measure_speculative_serving(
+            d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+            n_requests=16, prompt_len=64, steps=128, slots=4)
+    else:
+        rows = measure_speculative_serving()
     for row in rows:
         emit(row["metric"], row["value"], row["unit"], row["note"])
 

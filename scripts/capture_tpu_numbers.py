@@ -99,6 +99,17 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "quantized_collectives"], check=False)
 """),
+    # 6. the speculative-serving A/B (ISSUE 10's open claim): sampled
+    # S=1 engine vs the draft-verify SpeculativeEngine at equal slots
+    # (self-draft structure ceiling + half-layer tax floor + fused
+    # S=k+1 context row) — CPU rows banked in
+    # perf_capture/speculative.json; this is the on-chip row, sized up
+    # by bench_suite's on-TPU defaults
+    ("speculative_serving", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "speculative_serving"], check=False)
+"""),
     # 3. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time. guard_recompiles: every timed run holds under the

@@ -115,8 +115,9 @@ class TestCleanEntrypointsStayClean:
         its KV pool (+ logits) with the markers surviving lowering, its
         page TABLE rides as a non-donated int32 operand (the builder
         raises on violation — re-asserted here over the flat record),
-        the catalog carries 19 entries (ISSUE 9 added
-        collectives_swing + collectives_ef8), and the traced program is
+        the catalog carries 20 entries (ISSUE 9 added
+        collectives_swing + collectives_ef8; ISSUE 10 added
+        engine_speculative_step), and the traced program is
         host-sync clean."""
         import jax.numpy as jnp
 
@@ -124,7 +125,7 @@ class TestCleanEntrypointsStayClean:
             ENTRYPOINTS,
             build_engine_paged_step,
         )
-        assert len(ENTRYPOINTS) == 19
+        assert len(ENTRYPOINTS) == 20
         ctx = build_engine_paged_step()
         declared = sum(ctx.donated)
         assert declared >= 3  # k, v, logits at minimum
@@ -137,6 +138,31 @@ class TestCleanEntrypointsStayClean:
         assert len(tables) == 1, tables
         assert tables[0][0].shape[0] == 2  # (lanes, pages_per_seq)
         assert not tables[0][1], "page table must not be donated"
+        gating = [f for f in run_passes(ctx)
+                  if f.severity in ("error", "warning")]
+        assert not gating, [f"[{f.pass_name}] {f.message}"
+                            for f in gating]
+
+    def test_engine_speculative_step_structure(self):
+        """ISSUE 10 structural pins: the speculative block dispatch
+        donates its whole state (TARGET and DRAFT caches + carried
+        logits ride one pytree — 5 donated leaves minimum: k, v,
+        draft_k, draft_v, logits) with the markers surviving lowering,
+        the builder's aval-stability assert ran (fresh state ==
+        dispatch output, the recovery no-recompile half), at least one
+        scan rides the program (the emit latch), and the accept/reject
+        path is host-sync clean."""
+        from akka_allreduce_tpu.analysis.entrypoints import (
+            build_engine_speculative_step)
+        ctx = build_engine_speculative_step()
+        declared = sum(ctx.donated)
+        assert declared >= 5  # k, v, draft_k, draft_v, logits
+        markers = (ctx.stablehlo.count("jax.buffer_donor")
+                   + ctx.stablehlo.count("tf.aliasing_output"))
+        assert markers >= declared, (declared, markers)
+        scans = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
+                    if eqn.primitive.name == "scan")
+        assert scans >= 1  # the emit latch (draft steps unroll)
         gating = [f for f in run_passes(ctx)
                   if f.severity in ("error", "warning")]
         assert not gating, [f"[{f.pass_name}] {f.message}"

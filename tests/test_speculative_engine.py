@@ -1,0 +1,297 @@
+"""Speculative serving (ISSUE 10): draft-verify blocks in the engine.
+
+The contracts under test:
+
+* GREEDY PARITY — the speculative engine at temperature 0 emits every
+  request's tokens BITWISE equal to the plain greedy engine (S=1 and
+  S=4) and to standalone ``generate()``, across fp/int8 KV and
+  slot/paged engines, with EOS / stop-token / budget finishes landing
+  mid-block;
+* NO RECOMPILES — per-slot acceptance varies every block, churn
+  refills lanes, and none of it compiles a program after warmup;
+* THE DRAFT LEDGER — proposed == accepted + rejected exactly, the
+  engine's counters equal the metrics plane's, rejected drafts feed
+  wasted_tokens, and the per-completion acceptance histogram holds one
+  sample per completed request;
+* FAULTS — an injected dispatch raise takes the standard recovery
+  path (fail in-flight, rebuild at warmup avals, retry) and the
+  retried streams stay bitwise;
+* SAMPLED speculation is seed-deterministic, and a self-draft accepts
+  (almost) everything.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.analysis.recompile import no_recompiles
+from akka_allreduce_tpu.models.generate import generate
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from akka_allreduce_tpu.runtime.faults import FaultPlan, FaultPoint
+from akka_allreduce_tpu.serving import (
+    EngineConfig,
+    PagedEngineConfig,
+    PagedSpeculativeEngine,
+    Request,
+    RequestScheduler,
+    RetryPolicy,
+    SchedulerConfig,
+    ServingEngine,
+    ServingMetrics,
+    SpeculativeEngine,
+    serve_loop,
+)
+
+CFG = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                        n_layers=2, d_ff=64, max_seq=48)
+DRAFT_CFG = dataclasses.replace(CFG, n_layers=1)
+EOS = 5
+STOP = 9
+K = 3
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def draft_params(params):
+    return {**params, "layers": params["layers"][:1]}
+
+
+def make_requests(n=8, seed=7):
+    """EOS on odd rids, a stop token on rid 2, ragged budgets — every
+    finish kind lands mid-block somewhere."""
+    r = np.random.default_rng(seed)
+    reqs = []
+    for rid in range(n):
+        reqs.append(Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in r.integers(
+                0, CFG.vocab_size, size=int(r.integers(2, 7)))),
+            max_new_tokens=int(r.integers(4, 10)),
+            eos_token=EOS if rid % 2 else None,
+            stop_tokens=(STOP,) if rid == 2 else (),
+            seed=200 + rid,
+            submitted_at=0.0))
+    return reqs
+
+
+def run_spec(params, draft_params, reqs, ecfg=None, paged=False,
+             metrics=None, scfg=None, draft_cfg=DRAFT_CFG):
+    if paged:
+        engine = PagedSpeculativeEngine(
+            params, CFG, draft_params, draft_cfg,
+            ecfg or PagedEngineConfig(num_slots=3, page_size=4,
+                                      draft_steps=K),
+            metrics=metrics)
+    else:
+        engine = SpeculativeEngine(
+            params, CFG, draft_params, draft_cfg,
+            ecfg or EngineConfig(num_slots=3, draft_steps=K),
+            metrics=metrics)
+    sched = RequestScheduler(scfg or SchedulerConfig(),
+                             num_slots=engine.num_slots)
+    for r in reqs:
+        if metrics is not None:
+            metrics.on_submit(r.rid)
+        sched.submit(r)
+    results = serve_loop(engine, sched, metrics=metrics,
+                         max_dispatches=400)
+    return results, engine
+
+
+def run_greedy(params, reqs, decode_steps=1, kv_dtype=None):
+    engine = ServingEngine(
+        params, CFG, EngineConfig(num_slots=3,
+                                  decode_steps=decode_steps,
+                                  kv_dtype=kv_dtype))
+    sched = RequestScheduler(SchedulerConfig(), num_slots=3)
+    for r in reqs:
+        sched.submit(r)
+    return serve_loop(engine, sched, max_dispatches=400)
+
+
+class TestGreedyParity:
+    def test_bitwise_vs_greedy_engines_and_generate(self, params,
+                                                    draft_params):
+        """The acceptance criterion: speculative@temp0 == greedy S=1
+        == greedy S=4 == generate(), bitwise, finishes mid-block
+        included."""
+        reqs = make_requests()
+        spec, _ = run_spec(params, draft_params, reqs)
+        g1 = run_greedy(params, make_requests())
+        g4 = run_greedy(params, make_requests(), decode_steps=4)
+        for r in reqs:
+            assert list(spec[r.rid][0]) == list(g1[r.rid][0]), r.rid
+            assert list(spec[r.rid][0]) == list(g4[r.rid][0]), r.rid
+            assert spec[r.rid][1] == g1[r.rid][1], r.rid
+        for r in reqs:
+            if r.stop_tokens:
+                continue  # generate() has no stop-token set
+            prompt = jnp.asarray(r.prompt, jnp.int32)[None]
+            if r.eos_token is None:
+                want = np.asarray(generate(
+                    params, prompt, CFG, steps=r.max_new_tokens))[0]
+            else:
+                toks, lengths = generate(params, prompt, CFG,
+                                         steps=r.max_new_tokens,
+                                         eos_token=r.eos_token)
+                want = np.asarray(toks)[0][:int(lengths[0])]
+            assert list(spec[r.rid][0]) == want.tolist(), r.rid
+
+    def test_int8_kv_parity(self, params, draft_params):
+        reqs = make_requests()
+        spec, _ = run_spec(
+            params, draft_params, reqs,
+            ecfg=EngineConfig(num_slots=3, draft_steps=K,
+                              kv_dtype="int8"))
+        base = run_greedy(params, make_requests(), kv_dtype="int8")
+        for r in reqs:
+            assert list(spec[r.rid][0]) == list(base[r.rid][0]), r.rid
+
+    def test_paged_spec_parity_and_pool_hygiene(self, params,
+                                                draft_params):
+        """The paged speculative engine (draft KV in its own pool)
+        emits the same bitwise streams; both pools drain to empty and
+        pass the allocator's invariant oracle."""
+        reqs = make_requests()
+        base = run_greedy(params, make_requests())
+        spec, engine = run_spec(params, draft_params, reqs, paged=True)
+        for r in reqs:
+            assert list(spec[r.rid][0]) == list(base[r.rid][0]), r.rid
+        engine.pool.check_invariants()
+        engine.draft_pool.check_invariants()
+        assert engine.pool.pages_in_use == 0
+        assert engine.draft_pool.pages_in_use == 0
+
+    def test_different_k_same_tokens(self, params, draft_params):
+        """k changes speed, never tokens."""
+        reqs = make_requests(n=4)
+        a, _ = run_spec(params, draft_params, reqs,
+                        ecfg=EngineConfig(num_slots=2, draft_steps=1))
+        b, _ = run_spec(params, draft_params, make_requests(n=4),
+                        ecfg=EngineConfig(num_slots=2, draft_steps=5))
+        for r in reqs:
+            assert list(a[r.rid][0]) == list(b[r.rid][0]), r.rid
+
+
+class TestNoRecompileContract:
+    def test_spec_churn_compiles_nothing(self, params, draft_params):
+        """Acceptance varies per slot per block, lanes churn — and a
+        second run over warmed shapes compiles zero programs, slot
+        and paged both."""
+        reqs = make_requests()
+        first, _ = run_spec(params, draft_params, reqs)
+        with no_recompiles("speculative churn (slot)"):
+            again, _ = run_spec(params, draft_params, make_requests())
+        for rid, out in again.items():
+            assert list(out[0]) == list(first[rid][0])
+        run_spec(params, draft_params, make_requests(), paged=True)
+        with no_recompiles("speculative churn (paged)"):
+            run_spec(params, draft_params, make_requests(), paged=True)
+
+
+class TestDraftLedger:
+    def test_identity_and_metrics_agreement(self, params,
+                                            draft_params):
+        reqs = make_requests()
+        metrics = ServingMetrics()
+        results, engine = run_spec(params, draft_params, reqs,
+                                   metrics=metrics)
+        assert engine.draft_proposed > 0
+        assert engine.draft_proposed == (engine.draft_accepted
+                                         + engine.draft_rejected)
+        assert metrics.draft_proposed == engine.draft_proposed
+        assert metrics.draft_accepted == engine.draft_accepted
+        assert metrics.draft_rejected == engine.draft_rejected
+        # rejected drafts feed the wasted account (nothing else wasted
+        # in a fault-free run), and tokens/s denominators stay honest
+        assert metrics.wasted_tokens == engine.draft_rejected
+        assert engine.wasted_tokens == engine.draft_rejected
+        # one acceptance sample per completed request
+        assert metrics.draft_acceptance.summary()["count"] == len(reqs)
+        summ = metrics.summary()
+        assert summ["speculative"]["draft_proposed"] == \
+            engine.draft_proposed
+        assert summ["speculative"]["acceptance_rate"] == \
+            round(engine.acceptance_rate, 4)
+
+    def test_every_block_emits_at_least_one_token(self, params,
+                                                  draft_params):
+        """Even at acceptance 0 a block emits the anchor: total decode
+        dispatches are bounded by total emitted tokens (progress is
+        unconditional — no livelock on a hostile draft)."""
+        reqs = make_requests(n=4)
+        metrics = ServingMetrics()
+        results, engine = run_spec(params, draft_params, reqs,
+                                   metrics=metrics)
+        emitted = sum(len(t) for t, _ in results.values())
+        assert engine.decode_dispatches <= emitted
+
+
+class TestSampledSpeculation:
+    SAMPLE = dict(temperature=1.3, top_k=16)
+
+    def test_seeded_determinism(self, params, draft_params):
+        ecfg = EngineConfig(num_slots=3, draft_steps=K, **self.SAMPLE)
+        a, _ = run_spec(params, draft_params, make_requests(),
+                        ecfg=ecfg)
+        b, _ = run_spec(params, draft_params, make_requests(),
+                        ecfg=ecfg)
+        for rid in a:
+            assert list(a[rid][0]) == list(b[rid][0]), rid
+
+    def test_self_draft_accepts_consumed_proposals(self, params):
+        """draft == target: p == q at every proposal, so the accept
+        test passes and only finish latches (EOS/budget tails) reject
+        — acceptance lands far above the truncated draft's."""
+        ecfg = EngineConfig(num_slots=3, draft_steps=K, **self.SAMPLE)
+        _, engine = run_spec(params, params, make_requests(),
+                             ecfg=ecfg, draft_cfg=CFG)
+        assert engine.acceptance_rate > 0.5, engine.acceptance_rate
+
+
+class TestSpeculativeFaults:
+    def test_dispatch_raise_recovers_with_parity(self, params,
+                                                 draft_params):
+        """An injected dispatch exception fails in-flight requests
+        into the retry path; the rebuilt state reuses the warmed
+        programs and the retried streams equal the fault-free run."""
+        reqs = make_requests(n=6)
+        baseline, _ = run_spec(params, draft_params, reqs)
+        plan = FaultPlan([FaultPoint(site="engine.dispatch",
+                                     kind="raise", hit=3)])
+        scfg = SchedulerConfig(
+            retry=RetryPolicy(max_attempts=3, base_delay=0.0))
+        with plan.armed():
+            chaos, engine = run_spec(params, draft_params,
+                                     make_requests(n=6), scfg=scfg)
+        assert plan.fired, "the raise never fired"
+        for r in reqs:
+            assert list(chaos[r.rid][0]) == list(baseline[r.rid][0]), \
+                r.rid
+
+    def test_admission_headroom_enforced(self, params, draft_params):
+        engine = SpeculativeEngine(
+            params, CFG, draft_params, DRAFT_CFG,
+            EngineConfig(num_slots=1, draft_steps=K))
+        # prompt + budget alone fit max_seq, but not + draft_steps
+        bad = Request(rid=1, prompt=(1, 2, 3), max_new_tokens=44,
+                      submitted_at=0.0)
+        with pytest.raises(ValueError, match="draft_steps"):
+            engine.admit(bad)
+
+    def test_vocab_mismatch_rejected(self, params, draft_params):
+        with pytest.raises(ValueError, match="vocabulary"):
+            SpeculativeEngine(
+                params, CFG, draft_params,
+                dataclasses.replace(DRAFT_CFG, vocab_size=32),
+                EngineConfig(num_slots=1, draft_steps=K))
