@@ -2142,6 +2142,30 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "to healthy replicas until it completes a "
                         "probe dispatch again (the reference's maxLag "
                         "staleness bound at the fleet)")
+    # -- subprocess fabric (ISSUE 11)
+    p.add_argument("--replica-mode", choices=("inprocess", "subprocess"),
+                   default="inprocess",
+                   help="inprocess (default): the N replicas are "
+                        "engines in THIS process — the parity oracle. "
+                        "subprocess: each replica is a REAL child "
+                        "process (serving/supervisor.py + "
+                        "serving/worker.py) speaking the serving "
+                        "frames over TCP, with heartbeat deathwatch, "
+                        "seeded-backoff restarts and a restart-budget "
+                        "circuit breaker — SIGKILL a replica and the "
+                        "fleet fails over; SIGTERM one and its work "
+                        "migrates")
+    p.add_argument("--restart-budget", type=int, default=5,
+                   metavar="N",
+                   help="subprocess mode: restarts allowed per replica "
+                        "per minute before its circuit breaker OPENS "
+                        "and the replica is retired instead of "
+                        "restarted")
+    p.add_argument("--backoff-base", type=float, default=0.25,
+                   metavar="S",
+                   help="subprocess mode: first restart delay; doubles "
+                        "per restart up to 16x with seeded jitter "
+                        "(serving/supervisor.py BackoffPolicy)")
     # -- preemption notice (ISSUE 7 satellite / PR 5 loose end)
     p.add_argument("--preempt-poll", default=None, metavar="URL",
                    help="poll this GCE-style metadata URL for a "
@@ -3180,6 +3204,215 @@ def _serve_replicated_selfcheck(args: argparse.Namespace) -> int:
     return 0 if not failures else 1
 
 
+def _serve_subprocess_selfcheck(args: argparse.Namespace) -> int:
+    """`serve --selfcheck --replica-mode subprocess --replicas N`:
+    the ISSUE 11 acceptance run. N REAL replica subprocesses behind
+    the router over TCP; one of them is SIGKILLed mid-run (a real
+    ``os.kill`` on a real PID, not a fault site). Asserted, not hoped:
+
+    * PARITY — every request's greedy tokens from the killed fleet are
+      bitwise identical to a fault-free SINGLE-ENGINE run in THIS
+      process (two process boundaries and one murder between them);
+    * LEDGER RECONCILIATION — failed attempts == retries + dead
+      letters + hedge-absorbed, exactly as in-process;
+    * SUPERVISION — the dead replica restarted exactly once, within
+      its backoff budget, breaker closed; the survivor compiled ZERO
+      programs after the warm phase (worker-reported compile counts
+      over the wire);
+    * scrape == summary for the supervisor series
+      (``serve_replica_restarts_total`` / ``_backoff_seconds`` /
+      ``_breaker_open`` / ``_heartbeat_age_seconds``).
+    """
+    import jax
+    import numpy as np
+
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.runtime.faults import (ProcessChaosPlan,
+                                                   ProcessFaultPoint)
+    from akka_allreduce_tpu.serving import (BackoffPolicy, EngineConfig,
+                                            FleetMetrics, ReplicaRouter,
+                                            ReplicaSpec,
+                                            ReplicaSupervisor, Request,
+                                            RequestScheduler,
+                                            RestartBudget, RetryPolicy,
+                                            RouterConfig,
+                                            SchedulerConfig,
+                                            ServingEngine, serve_loop)
+    from akka_allreduce_tpu.telemetry import parse_prometheus_text
+
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=48)
+    params = init_transformer(jax.random.key(0), cfg)
+    eos = 5
+    slots = 2
+    n_rep = args.replicas
+
+    def make_requests():
+        r = np.random.default_rng(17)
+        return [Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in r.integers(
+                0, cfg.vocab_size, size=int(r.integers(2, 6)))),
+            max_new_tokens=8,
+            eos_token=eos if rid % 2 else None,
+            submitted_at=0.0) for rid in range(10)]
+
+    # the fault-free single-engine truth, in THIS process
+    base_engine = ServingEngine(params, cfg,
+                                EngineConfig(num_slots=slots))
+    base_sched = RequestScheduler(SchedulerConfig(), num_slots=slots)
+    for r in make_requests():
+        base_sched.submit(r)
+    baseline = serve_loop(base_engine, base_sched, max_dispatches=1000)
+
+    spec = ReplicaSpec(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_heads=cfg.n_heads, n_layers=cfg.n_layers, d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq, param_seed=0, num_slots=slots,
+        decode_steps=args.decode_steps)
+    chaos = ProcessChaosPlan([ProcessFaultPoint(
+        replica=0, action="sigkill", after=3)])
+    failures: "list[str]" = []
+    fleet_warm = FleetMetrics(n_rep)
+
+    def run_phase(sup, fleet, th):
+        sched = RequestScheduler(
+            SchedulerConfig(policy=args.policy,
+                            retry=RetryPolicy(max_attempts=4,
+                                              base_delay=0.0)),
+            num_slots=n_rep * slots)
+        for eng in sup.engines:
+            eng.metrics = None  # rewire to THIS phase's fleet sinks
+        router = ReplicaRouter(sup.engines, sched,
+                               RouterConfig(th=th,
+                                            max_lag=args.max_lag),
+                               fleet=fleet)
+        for r in make_requests():
+            fleet.on_submit(r.rid)
+            sched.submit(r)
+        results = router.run(max_rounds=20000)
+        return results, router
+
+    def check_parity(tag, results):
+        for rid, (toks, reason) in baseline.items():
+            got = results.get(rid)
+            if got is None:
+                failures.append(f"{tag}: rid={rid} missing")
+            elif list(got[0]) != list(toks) or got[1] != reason:
+                failures.append(
+                    f"{tag}: rid={rid} ({got[1]}) {list(got[0])} != "
+                    f"single-engine ({reason}) {list(toks)}")
+
+    with ReplicaSupervisor(
+            spec, replicas=n_rep,
+            backoff=BackoffPolicy(base_s=args.backoff_base,
+                                  cap_s=max(2.0, args.backoff_base),
+                                  seed=0),
+            budget=RestartBudget(max_restarts=args.restart_budget,
+                                 window_s=60.0),
+            fleet=fleet_warm, chaos=None) as sup:
+        # phase 1 — warm: fault-free fleet run, every prompt shape
+        # compiled in every worker (warm before you arm)
+        warm_results, _ = run_phase(sup, fleet_warm, th=1)
+        check_parity("warm", warm_results)
+        survivor_compiles = [sup.engines[i].remote_compiles
+                            for i in range(n_rep)]
+        # phase 2 — murder: SIGKILL replica 0 after its 3rd terminal
+        # completion crosses the wire; same requests, fresh ledger
+        fleet = FleetMetrics(n_rep)
+        sup.fleet = fleet
+        fleet.attach_supervisor(sup)
+        sup.chaos = chaos
+        sup.completions_seen = 0
+        sup.admissions_seen = 0
+        chaos_results, router = run_phase(sup, fleet, th=args.th)
+        check_parity("chaos", chaos_results)
+        if not chaos.fired:
+            failures.append("the kill never fired")
+        # the fleet may finish its queue on the survivors before the
+        # dead replica's backoff elapses — supervision must still
+        # complete the restart within its budget; pump until it does
+        deadline = time.monotonic() + 30.0
+        while (sup.restarts(0) < 1 or sup.state(0) != "up") \
+                and time.monotonic() < deadline:
+            sup.pump(0.05)
+        if sup.restarts(0) != 1:
+            failures.append(f"replica 0 restarts={sup.restarts(0)}, "
+                            f"want exactly 1 (within backoff budget)")
+        if sup.state(0) != "up":
+            failures.append(f"replica 0 state={sup.state(0)} after "
+                            f"restart, want up")
+        if any(sup.breaker_open(i) for i in range(n_rep)):
+            failures.append("a circuit breaker opened on a single "
+                            "kill — budget accounting broken")
+        # the survivor(s) compiled nothing after the warm phase
+        for i in range(1, n_rep):
+            grew = (sup.engines[i].remote_compiles
+                    - survivor_compiles[i])
+            if grew:
+                failures.append(
+                    f"survivor replica {i} compiled {grew} program(s) "
+                    f"post-warmup (want 0)")
+        if router.drained:
+            failures.append(f"{len(router.drained)} snapshots parked "
+                            f"on the router")
+        s = fleet.summary()
+        if (s["faults"]["retries_total"]
+                + s["faults"]["dead_letter_total"]
+                + s["hedge"]["absorbed_failures"]
+                != s["requests"]["failed_attempts"]):
+            failures.append(
+                f"retry ledger off: {s['faults']['retries_total']} "
+                f"retries + {s['faults']['dead_letter_total']} dead "
+                f"letters + {s['hedge']['absorbed_failures']} "
+                f"hedge-absorbed != "
+                f"{s['requests']['failed_attempts']} failed attempts")
+        # scrape == summary for the supervisor series
+        prom = parse_prometheus_text(
+            fleet.registry.to_prometheus_text())
+        sup_block = s["supervisor"]
+        for i in range(n_rep):
+            lbl = (("replica", str(i)),)
+            pairs = (
+                ("serve_replica_restarts_total",
+                 sup_block["restarts"][i]),
+                ("serve_replica_backoff_seconds",
+                 sup_block["backoff_seconds"][i]),
+                ("serve_replica_breaker_open",
+                 1 if sup_block["breaker_open"][i] else 0),
+            )
+            for name, want in pairs:
+                got = prom.get((name, lbl))
+                if got != want:
+                    failures.append(f"prometheus {name}{{replica={i}}}"
+                                    f" {got} != summary {want}")
+            hb = prom.get(("serve_replica_heartbeat_age_seconds",
+                           lbl))
+            if hb is None:
+                failures.append(f"serve_replica_heartbeat_age_seconds"
+                                f"{{replica={i}}} missing from scrape")
+        backoff_total = sum(sup_block["backoff_seconds"])
+        restarts_total = sum(sup_block["restarts"])
+
+    print(json.dumps({
+        "selfcheck": "ok" if not failures else "FAIL",
+        "replica_mode": "subprocess",
+        "replicas": n_rep,
+        "th": args.th,
+        "max_lag": args.max_lag,
+        "policy": args.policy,
+        "kills_fired": [list(f) for f in chaos.fired],
+        "restarts": restarts_total,
+        "backoff_seconds": round(backoff_total, 3),
+        "retries": s["faults"]["retries_total"],
+        "hedge_absorbed": s["hedge"]["absorbed_failures"],
+        "survivor_compiles_post_warmup": 0 if not failures else None,
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
 def _make_draft_model(params: dict, mcfg, draft_layers: int):
     """The serve CLI's draft model: the target's first N layers with
     the embed / positional / output-norm / unembed weights SHARED —
@@ -3251,6 +3484,53 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "(its fault script targets replica sites)",
               file=sys.stderr)
         return 2
+    if args.replica_mode == "subprocess":
+        if args.restart_budget < 1:
+            print(f"error: --restart-budget must be >= 1, got "
+                  f"{args.restart_budget}", file=sys.stderr)
+            return 2
+        if args.backoff_base < 0:
+            print(f"error: --backoff-base must be >= 0, got "
+                  f"{args.backoff_base}", file=sys.stderr)
+            return 2
+        if args.speculative:
+            print("error: --replica-mode subprocess hosts plain/paged "
+                  "engines; speculative replicas are an open "
+                  "follow-up (ROADMAP.md)", file=sys.stderr)
+            return 2
+        if args.chaos is not None:
+            print("error: --chaos scripts in-process fault sites; "
+                  "subprocess chaos is the selfcheck's real SIGKILL "
+                  "(`--selfcheck --replica-mode subprocess`) and "
+                  "tests/test_subprocess_fabric.py", file=sys.stderr)
+            return 2
+        if args.ckpt_dir:
+            print("error: --replica-mode subprocess rebuilds params "
+                  "from --seed in each worker; checkpoint-backed "
+                  "subprocess replicas are an open follow-up",
+                  file=sys.stderr)
+            return 2
+        if args.temperature != 0.0:
+            print("error: --replica-mode subprocess serves greedy "
+                  "decode for now (the ReplicaSpec does not carry "
+                  "sampling config); drop --temperature",
+                  file=sys.stderr)
+            return 2
+        if args.prefill_buckets.strip():
+            print("error: --replica-mode subprocess prefill is "
+                  "exact-length (the parity mode); drop "
+                  "--prefill-buckets", file=sys.stderr)
+            return 2
+        if args.kv_cache == "int8":
+            print("error: --replica-mode subprocess does not carry "
+                  "the int8 KV config yet; drop --kv-cache",
+                  file=sys.stderr)
+            return 2
+        if args.selfcheck and args.replicas < 2:
+            print("error: the subprocess selfcheck kills one of N>=2 "
+                  "replicas; run --replicas 2 (or more)",
+                  file=sys.stderr)
+            return 2
     if args.selfcheck and args.paged and args.replicas > 1:
         print("error: the replicated selfcheck runs slot-engine "
               "replicas; paged fleet recovery is covered by "
@@ -3316,6 +3596,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 2
     if args.selfcheck:
+        if args.replica_mode == "subprocess":
+            return _serve_subprocess_selfcheck(args)
         if args.speculative:
             return _serve_speculative_selfcheck(args)
         if args.replicas > 1:
@@ -3462,11 +3744,13 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             # Perfetto export wants the event stream even when no JSONL
             # was asked for — same tracer, second renderer
             tracer = Tracer()
-        if args.replicas > 1:
+        if args.replicas > 1 or args.replica_mode == "subprocess":
             # the replicated plane: one shared registry, per-replica
             # labeled series + fleet aggregation (serving/metrics.py
             # FleetMetrics) — every surface below (snapshot file, HTTP,
-            # host sampler) reads the same registry either way
+            # host sampler) reads the same registry either way. The
+            # subprocess fabric uses it at ANY N so the supervisor
+            # series (restarts/backoff/heartbeat/breaker) can land.
             from akka_allreduce_tpu.serving import FleetMetrics
             metrics = FleetMetrics(args.replicas, tracer=tracer)
         else:
@@ -3534,9 +3818,41 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 return ServingEngine(params, mcfg, ecfg,
                                      tracer=tracer)
 
-            engines = [build_engine() for _ in range(args.replicas)]
-            engine = engines[0]
-            if args.paged:
+            supervisor = None
+            if args.replica_mode == "subprocess":
+                # the subprocess fabric: real worker processes behind
+                # the SAME router (serving/supervisor.py). A
+                # FleetMetrics fronts any N (including 1) so the
+                # supervisor series have somewhere to land.
+                from akka_allreduce_tpu.serving import (
+                    BackoffPolicy, ReplicaSpec, ReplicaSupervisor,
+                    RestartBudget)
+                spec = ReplicaSpec(
+                    vocab_size=mcfg.vocab_size, d_model=mcfg.d_model,
+                    n_heads=mcfg.n_heads, n_layers=mcfg.n_layers,
+                    d_ff=mcfg.d_ff, max_seq=mcfg.max_seq,
+                    param_seed=args.seed, num_slots=args.slots,
+                    decode_steps=args.decode_steps,
+                    watchdog_timeout_s=args.watchdog_timeout,
+                    paged=args.paged, page_size=args.page_size,
+                    num_pages=args.num_pages)
+                supervisor = stack.enter_context(ReplicaSupervisor(
+                    spec, replicas=args.replicas,
+                    backoff=BackoffPolicy(base_s=args.backoff_base),
+                    budget=RestartBudget(
+                        max_restarts=args.restart_budget),
+                    fleet=metrics, tracer=tracer))
+                print(f"subprocess fleet up: "
+                      f"{args.replicas} replica worker(s), pids "
+                      f"{[supervisor.pid(i) for i in range(args.replicas)]}",
+                      file=sys.stderr)
+                engines = supervisor.engines
+                engine = None
+            else:
+                engines = [build_engine()
+                           for _ in range(args.replicas)]
+                engine = engines[0]
+            if args.paged and supervisor is None:
                 if args.replicas > 1:
                     # per-replica page-pool series, replica-labeled
                     for i, eng in enumerate(engines):
@@ -3562,7 +3878,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 # below never reject here)
                 on_reject=metrics.on_reject)
             router = None
-            if args.replicas > 1:
+            if args.replicas > 1 or supervisor is not None:
                 from akka_allreduce_tpu.serving import (ReplicaRouter,
                                                         RouterConfig)
                 router = ReplicaRouter(
@@ -3664,7 +3980,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                    # user's --num-pages and the metrics plane's
                    # serve_page_pool_pages / pages_total
                    **({"page_size": args.page_size,
-                       "num_pages": engine.pool.capacity,
+                       "num_pages": (engine.pool.capacity
+                                     if engine is not None
+                                     else args.num_pages),
                        "paged_attention": args.paged_attention}
                       if args.paged else {}),
                    **({"replicas": args.replicas, "th": args.th,
@@ -4055,6 +4373,37 @@ def _cmd_eval(args: argparse.Namespace) -> int:
     return 0
 
 
+def _add_replica_worker(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "replica-worker",
+        help="host one serving engine as a subprocess replica: dial "
+             "the supervisor, serve SubmitFrames over TCP, drain on "
+             "SIGTERM (spawned by serving/supervisor.py — not "
+             "normally run by hand)")
+    p.add_argument("--connect", required=True, metavar="HOST:PORT",
+                   help="the supervisor's TcpRouter address")
+    p.add_argument("--replica", type=int, required=True,
+                   help="this replica's fleet index")
+    p.add_argument("--spec", required=True,
+                   help="ReplicaSpec JSON (serving/worker.py) — model "
+                        "dims, engine knobs, and the parent's jax "
+                        "numerics config")
+
+
+def _cmd_replica_worker(args: argparse.Namespace) -> int:
+    from akka_allreduce_tpu.serving.worker import (
+        ReplicaSpec,
+        run_replica_worker,
+    )
+    host, _, port = args.connect.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"error: bad --connect {args.connect!r} "
+              f"(want HOST:PORT)", file=sys.stderr)
+        return 2
+    spec = ReplicaSpec.from_json(args.spec)
+    return run_replica_worker(spec, (host, int(port)), args.replica)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="akka_allreduce_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -4067,6 +4416,7 @@ def main(argv: list[str] | None = None) -> int:
     _add_eval(sub)
     _add_lint(sub)
     _add_perfgate(sub)
+    _add_replica_worker(sub)
     p_info = sub.add_parser("info", help="topology summary; --scaling "
                             "prints the analytic ICI scaling curve")
     p_info.add_argument("--scaling", action="store_true",
@@ -4091,6 +4441,7 @@ def main(argv: list[str] | None = None) -> int:
             "generate": _cmd_generate, "serve": _cmd_serve,
             "eval": _cmd_eval, "lint": _cmd_lint,
             "perfgate": _cmd_perfgate,
+            "replica-worker": _cmd_replica_worker,
             "info": _cmd_info, "bench": _cmd_bench}[args.cmd](args)
 
 

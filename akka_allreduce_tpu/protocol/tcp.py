@@ -53,6 +53,7 @@ class TcpRouter:
                  connect_timeout_s: float = 10.0,
                  heartbeat_interval_s: float = 2.0,
                  unreachable_after_s: Optional[float] = 10.0,
+                 max_frame_bytes: int = 1 << 26,
                  tracer=None):
         self._lib = load_library()
         self._connect_timeout_ms = int(connect_timeout_s * 1000)
@@ -126,6 +127,19 @@ class TcpRouter:
         # death — on_terminated must fire exactly once per incarnation,
         # whichever event order the kernel delivers.
         self._alive_addrs: set[wire.Addr] = set()
+        # Hostile-peer bound on the length prefix (above the C++
+        # transport's own 1 GiB corrupt-stream cap): a peer whose frame
+        # claims more than this is downed — legitimate serving frames
+        # are KiB-scale, gradient chunks MB-scale. The oversized frame
+        # is dequeued into a transient buffer (a one-shot copy of
+        # bytes the C++ inbound queue already holds, freed
+        # immediately; the PERSISTENT recv buffer never grows to a
+        # hostile size) and dropped undecoded.
+        if max_frame_bytes < (1 << 16):
+            raise ValueError(
+                f"max_frame_bytes={max_frame_bytes} below the 64 KiB "
+                f"floor a single protocol frame can legitimately need")
+        self._max_frame = max_frame_bytes
         self._recv_buf = (ctypes.c_uint8 * (1 << 20))()
 
     # -- Router surface (what the engines call) -----------------------------
@@ -194,6 +208,17 @@ class TcpRouter:
         if self._ensure_conn(tuple(addr)) is None:
             raise ConnectionError(f"cannot reach {addr}")
         return self.ref_of(tuple(addr))
+
+    def heartbeat_age(self, addr: wire.Addr) -> Optional[float]:
+        """Seconds since ANY frame arrived from ``addr`` (Pings count),
+        or None for a peer never heard from / already downed. The
+        supervisor's per-replica heartbeat-age gauge reads this — the
+        operator's first triage signal for a SIGSTOPped or wedged
+        replica (OPERATIONS.md "Dead-replica triage")."""
+        heard = self._last_heard.get(tuple(addr))
+        if heard is None:
+            return None
+        return max(0.0, time.monotonic() - heard)
 
     def purge_local(self) -> int:
         """Drop every queued local self-send. The multi-seed rejoin path
@@ -297,6 +322,37 @@ class TcpRouter:
             need = self._lib.aat_recv_len(self._t)
             if need < 0:
                 return n
+            if need > self._max_frame:
+                # hostile length prefix: dequeue into a TRANSIENT
+                # buffer (exactly the bytes the C++ queue already
+                # holds — freed when this scope exits; the persistent
+                # recv buffer must never grow to a hostile size), drop
+                # the frame undecoded, and DOWN the peer — one bad
+                # actor cannot keep feeding the codec
+                tmp = (ctypes.c_uint8 * int(need))()
+                src = ctypes.c_int(-1)
+                got = self._lib.aat_recv_take(self._t, tmp, len(tmp),
+                                              ctypes.byref(src))
+                del tmp
+                if got < 0:
+                    return n
+                addr = self._addr_of_conn.get(src.value)
+                log.warning(
+                    "downing peer %s: frame of %d bytes exceeds "
+                    "max_frame_bytes=%d", addr or f"conn {src.value}",
+                    got, self._max_frame)
+                if self.tracer is not None:
+                    self.tracer.record("peer_oversized_frame",
+                                       conn=src.value, bytes=int(got),
+                                       cap=self._max_frame)
+                if addr is not None:
+                    self._down_addr(addr)
+                else:
+                    # never said Hello, already hostile: close the
+                    # CONNECTION — an anonymous client must not get
+                    # to trigger giant allocations repeatedly
+                    self._lib.aat_close_peer(self._t, src.value)
+                continue
             if need > len(self._recv_buf):
                 self._recv_buf = (ctypes.c_uint8 * int(need * 2))()
             src = ctypes.c_int(-1)
@@ -310,11 +366,30 @@ class TcpRouter:
                 # materialize a per-byte Python int list on the hot path.
                 msg = wire.decode(ctypes.string_at(self._recv_buf, got),
                                   self.ref_of)
-            except Exception:
-                # One malformed frame must not kill the whole event loop:
-                # dead-letter it, like Akka dropping undeserializable mail.
-                log.exception("dropping undecodable frame from conn %d",
-                              src.value)
+            except Exception as exc:
+                # An undecodable frame from a MAPPED peer means the peer
+                # is corrupt, hostile, or a different build (the wire
+                # version check lands here too): surface it as a PEER
+                # FAILURE — deathwatch fires, the supervisor/engine sees
+                # a dead member — never as a codec exception swallowed
+                # in the router's loop. A conn that never said Hello
+                # has no deathwatch identity to fire — close the
+                # CONNECTION itself so an anonymous sender cannot keep
+                # feeding the codec.
+                addr = self._addr_of_conn.get(src.value)
+                if addr is not None:
+                    log.error("downing peer %s:%s on undecodable "
+                              "frame: %s", addr[0], addr[1], exc)
+                    if self.tracer is not None:
+                        self.tracer.record(
+                            "peer_undecodable_frame", host=addr[0],
+                            port=addr[1], error=str(exc)[:200])
+                    self._down_addr(addr)
+                else:
+                    log.error(
+                        "closing unmapped conn %d on undecodable "
+                        "frame: %s", src.value, exc)
+                    self._lib.aat_close_peer(self._t, src.value)
                 continue
             if isinstance(msg, wire.Hello):
                 self._handle_hello(msg, src.value)

@@ -38,6 +38,62 @@ MSG_COMPLETE = 5
 MSG_PING = 6
 MSG_SUBMIT = 7
 MSG_COMPLETION = 8
+MSG_HEALTH = 9
+MSG_DRAIN = 10
+MSG_RESUME = 11
+MSG_DRAIN_DONE = 12
+MSG_CANCEL = 13
+
+# The serving-frame wire format version. Bumped whenever any serving
+# frame's layout changes (v2 added the version byte itself, the
+# ``replica`` field on CompletionFrame, and the supervisor frames
+# 9-13). Every serving frame carries this byte right after its message
+# type, and decode refuses a mismatch with a readable error instead of
+# mis-parsing a peer running different code — the failure mode of a
+# rolling fleet upgrade where router and replica briefly disagree.
+# The allreduce frames (0-6) predate versioning and stay unversioned:
+# the training plane's processes are always launched as one build.
+SERVING_WIRE_VERSION = 2
+
+_SERVING_MSG_TYPES = frozenset({
+    MSG_SUBMIT, MSG_COMPLETION, MSG_HEALTH, MSG_DRAIN, MSG_RESUME,
+    MSG_DRAIN_DONE, MSG_CANCEL})
+
+
+class WireError(ValueError):
+    """A frame that cannot be decoded as what it claims to be. The TCP
+    router treats this as a PEER failure (the sender is hostile,
+    corrupt, or a different build), not a router bug — see
+    protocol/tcp.py ``_drain_inbound``."""
+
+
+class WireVersionError(WireError):
+    """A serving frame carrying a different SERVING_WIRE_VERSION."""
+
+
+class TruncatedFrame(WireError):
+    """A frame shorter than its own header claims — a peer that died
+    mid-write (the transport only delivers length-complete frames, so
+    in practice this means the LENGTH was right but the payload counts
+    inside it are hostile/corrupt)."""
+
+
+def _need(buf: bytes, off: int, n: int, what: str) -> None:
+    if off + n > len(buf):
+        raise TruncatedFrame(
+            f"frame truncated: need {n} byte(s) for {what} at offset "
+            f"{off}, frame has {len(buf) - off} left (of {len(buf)})")
+
+
+def _check_version(buf: bytes, off: int, mtype: int) -> int:
+    _need(buf, off, 1, "serving wire version byte")
+    (ver,) = struct.unpack_from("<B", buf, off)
+    if ver != SERVING_WIRE_VERSION:
+        raise WireVersionError(
+            f"serving frame type {mtype} carries wire version {ver}, "
+            f"this build speaks {SERVING_WIRE_VERSION} — router and "
+            f"replica are different builds; redeploy them together")
+    return off + 1
 
 
 class Ping:
@@ -125,11 +181,18 @@ class CompletionFrame:
     """A replica's terminal answer for one dispatched request:
     generated tokens plus the finish reason (``eos``/``stop``/
     ``max_tokens``, or a failure status the router routes through its
-    retry budget). The inverse direction of :class:`SubmitFrame`."""
+    retry budget). The inverse direction of :class:`SubmitFrame`.
 
-    __slots__ = ("rid", "tokens", "reason")
+    ``replica`` identifies the SENDING replica (wire v2): all worker
+    frames land on the supervisor's one inbound handler, and with
+    hedged dispatch the same rid is legitimately in flight on two
+    replicas — the router must unbind the copy that actually finished.
+    -1 (the in-process default) means "caller knows the source"."""
 
-    def __init__(self, rid: int, tokens, reason: str):
+    __slots__ = ("rid", "tokens", "reason", "replica")
+
+    def __init__(self, rid: int, tokens, reason: str,
+                 replica: int = -1):
         self.rid = rid
         self.tokens = tuple(int(t) for t in tokens)
         if len(reason.encode()) > 255:
@@ -139,15 +202,161 @@ class CompletionFrame:
             raise ValueError(
                 f"CompletionFrame reason exceeds 255 bytes: {reason[:40]!r}...")
         self.reason = reason
+        self.replica = replica
 
     def __repr__(self) -> str:
         return (f"CompletionFrame(rid={self.rid}, "
-                f"tokens={len(self.tokens)}, reason={self.reason!r})")
+                f"tokens={len(self.tokens)}, reason={self.reason!r}, "
+                f"replica={self.replica})")
 
     def __eq__(self, other) -> bool:
         return isinstance(other, CompletionFrame) and all(
             getattr(self, f) == getattr(other, f)
             for f in self.__slots__)
+
+
+class HealthFrame:
+    """A replica worker's periodic self-report: occupancy, cumulative
+    decode dispatches (the LagLedger's progress signal over the wire),
+    cumulative compiled-program count (the zero-recompile contract made
+    observable across the process boundary), the engine triage
+    counters the serve report renders per replica (watchdog trips,
+    deadline evictions, distinct prefill programs — without them a
+    subprocess fleet's report would show parent-side zeros exactly
+    where OPERATIONS.md sends the operator), and the drain flag. Sent
+    every worker loop tick; a SIGSTOPped worker stops sending, which IS
+    the straggler signal — the router's lag ledger degrades it exactly
+    as an in-process hung replica."""
+
+    __slots__ = ("replica", "occupied", "free_slots", "dispatches",
+                 "compiles", "draining", "watchdog_trips",
+                 "evictions", "prefill_programs")
+
+    def __init__(self, replica: int, occupied: int, free_slots: int,
+                 dispatches: int, compiles: int = 0,
+                 draining: bool = False, watchdog_trips: int = 0,
+                 evictions: int = 0, prefill_programs: int = 0):
+        self.replica = replica
+        self.occupied = occupied
+        self.free_slots = free_slots
+        self.dispatches = dispatches
+        self.compiles = compiles
+        self.draining = bool(draining)
+        self.watchdog_trips = watchdog_trips
+        self.evictions = evictions
+        self.prefill_programs = prefill_programs
+
+    def __repr__(self) -> str:
+        return (f"HealthFrame(replica={self.replica}, "
+                f"occupied={self.occupied}, free={self.free_slots}, "
+                f"dispatches={self.dispatches}, "
+                f"compiles={self.compiles}, draining={self.draining})")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, HealthFrame) and all(
+            getattr(self, f) == getattr(other, f)
+            for f in self.__slots__)
+
+
+class DrainFrame:
+    """Router -> replica: stop admitting, snapshot every in-flight
+    request, ship the snapshots back (:class:`ResumeFrame`), finish
+    with :class:`DrainDoneFrame`, exit. The wire form of the SIGTERM
+    the supervisor also sends — either signal path converges on the
+    worker's one drain routine."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "DrainFrame()"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DrainFrame)
+
+
+class CancelFrame:
+    """Router -> replica: free ``rid``'s slot (hedge loser after the
+    winner landed). A completion the worker already sent for this rid
+    may cross this frame on the wire — the router-side proxy filters
+    completions for unbound rids, so the race is benign."""
+
+    __slots__ = ("rid",)
+
+    def __init__(self, rid: int):
+        self.rid = rid
+
+    def __repr__(self) -> str:
+        return f"CancelFrame(rid={self.rid})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, CancelFrame) and self.rid == other.rid
+
+
+class ResumeFrame:
+    """A drained in-flight request crossing the process boundary —
+    :class:`~akka_allreduce_tpu.serving.engine.ResumableRequest` on the
+    wire. Bidirectional: a draining worker ships its snapshots to the
+    router (``replica`` = source), and the router restores a snapshot
+    into a sibling/replacement worker (``replica`` = -1, target implied
+    by the connection). ``generated`` is the decoded-so-far suffix the
+    restore replays through prefill for bitwise continuation."""
+
+    __slots__ = ("replica", "rid", "prompt", "max_new_tokens",
+                 "eos_token", "stop_tokens", "deadline", "attempts",
+                 "seed", "generated")
+
+    def __init__(self, rid: int, prompt, max_new_tokens: int,
+                 generated=(), eos_token: Optional[int] = None,
+                 stop_tokens=(), deadline: Optional[float] = None,
+                 attempts: int = 0, seed: Optional[int] = None,
+                 replica: int = -1):
+        self.rid = rid
+        self.prompt = tuple(int(t) for t in prompt)
+        self.max_new_tokens = max_new_tokens
+        self.generated = tuple(int(t) for t in generated)
+        self.eos_token = eos_token
+        self.stop_tokens = tuple(int(t) for t in stop_tokens)
+        if len(self.stop_tokens) > 255:
+            raise ValueError(
+                f"ResumeFrame carries at most 255 stop tokens, got "
+                f"{len(self.stop_tokens)}")
+        self.deadline = deadline
+        self.attempts = attempts
+        self.seed = seed
+        self.replica = replica
+
+    def __repr__(self) -> str:
+        return (f"ResumeFrame(rid={self.rid}, "
+                f"prompt_len={len(self.prompt)}, "
+                f"generated={len(self.generated)}, "
+                f"replica={self.replica})")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ResumeFrame) and all(
+            getattr(self, f) == getattr(other, f)
+            for f in self.__slots__)
+
+
+class DrainDoneFrame:
+    """Replica -> router: the drain finished; ``migrated`` snapshots
+    were shipped (the router-side proxy reconciles the count against
+    the ResumeFrames it actually received — a mismatch means frames
+    were lost and the drain degrades to a failover)."""
+
+    __slots__ = ("replica", "migrated")
+
+    def __init__(self, replica: int, migrated: int):
+        self.replica = replica
+        self.migrated = migrated
+
+    def __repr__(self) -> str:
+        return (f"DrainDoneFrame(replica={self.replica}, "
+                f"migrated={self.migrated})")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DrainDoneFrame) \
+            and self.replica == other.replica \
+            and self.migrated == other.migrated
 
 
 def request_to_frame(req) -> SubmitFrame:
@@ -178,6 +387,38 @@ def frame_to_request(frame: SubmitFrame):
                    stop_tokens=frame.stop_tokens,
                    deadline=frame.deadline, attempts=frame.attempts,
                    seed=frame.seed)
+
+
+def resumable_to_frame(rr, replica: int = -1) -> ResumeFrame:
+    """Map a drained :class:`~akka_allreduce_tpu.serving.engine
+    .ResumableRequest` to its wire frame. Same clock-domain rule as
+    :func:`request_to_frame`: the deadline field crosses the wire as
+    whatever the caller put there (the supervisor's proxy converts to
+    remaining-seconds before sending)."""
+    req = rr.req
+    return ResumeFrame(rid=req.rid, prompt=req.prompt,
+                       max_new_tokens=req.max_new_tokens,
+                       generated=rr.generated,
+                       eos_token=req.eos_token,
+                       stop_tokens=req.stop_tokens or (),
+                       deadline=req.deadline, attempts=req.attempts,
+                       seed=req.seed, replica=replica)
+
+
+def frame_to_resumable(frame: ResumeFrame):
+    """The restore-side half of :func:`resumable_to_frame`. ``slot`` is
+    -1: a snapshot that crossed a process boundary has no slot until
+    the receiving engine's admit assigns one."""
+    from akka_allreduce_tpu.serving.engine import ResumableRequest
+    from akka_allreduce_tpu.serving.scheduler import Request
+    req = Request(rid=frame.rid, prompt=frame.prompt,
+                  max_new_tokens=frame.max_new_tokens,
+                  eos_token=frame.eos_token,
+                  stop_tokens=frame.stop_tokens,
+                  deadline=frame.deadline, attempts=frame.attempts,
+                  seed=frame.seed)
+    return ResumableRequest(req=req, generated=frame.generated,
+                            slot=-1)
 
 
 def _pack_addr(addr: Addr) -> bytes:
@@ -235,7 +476,8 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
         prompt = np.asarray(msg.prompt, dtype=np.int32).tobytes()
         stops = np.asarray(msg.stop_tokens, dtype=np.int32).tobytes()
         return (struct.pack(
-            "<BqIiBiBdIBq", MSG_SUBMIT, msg.rid, msg.max_new_tokens,
+            "<BBqIiBiBdIBq", MSG_SUBMIT, SERVING_WIRE_VERSION,
+            msg.rid, msg.max_new_tokens,
             msg.eos_token if msg.eos_token is not None else -1,
             1 if msg.deadline is not None else 0,
             msg.attempts,
@@ -247,17 +489,60 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
     if isinstance(msg, CompletionFrame):
         tokens = np.asarray(msg.tokens, dtype=np.int32).tobytes()
         reason = msg.reason.encode()
-        return (struct.pack("<BqBI", MSG_COMPLETION, msg.rid,
+        return (struct.pack("<BBqiBI", MSG_COMPLETION,
+                            SERVING_WIRE_VERSION, msg.rid, msg.replica,
                             len(reason), len(msg.tokens))
                 + reason + tokens)
+    if isinstance(msg, HealthFrame):
+        return struct.pack("<BBiIIQQIIIB", MSG_HEALTH,
+                           SERVING_WIRE_VERSION, msg.replica,
+                           msg.occupied, msg.free_slots,
+                           msg.dispatches, msg.compiles,
+                           msg.watchdog_trips, msg.evictions,
+                           msg.prefill_programs,
+                           1 if msg.draining else 0)
+    if isinstance(msg, DrainFrame):
+        return struct.pack("<BB", MSG_DRAIN, SERVING_WIRE_VERSION)
+    if isinstance(msg, CancelFrame):
+        return struct.pack("<BBq", MSG_CANCEL, SERVING_WIRE_VERSION,
+                           msg.rid)
+    if isinstance(msg, ResumeFrame):
+        prompt = np.asarray(msg.prompt, dtype=np.int32).tobytes()
+        stops = np.asarray(msg.stop_tokens, dtype=np.int32).tobytes()
+        generated = np.asarray(msg.generated, dtype=np.int32).tobytes()
+        return (struct.pack(
+            "<BBiqIiBiBdIIBq", MSG_RESUME, SERVING_WIRE_VERSION,
+            msg.replica, msg.rid, msg.max_new_tokens,
+            msg.eos_token if msg.eos_token is not None else -1,
+            1 if msg.deadline is not None else 0,
+            msg.attempts,
+            len(msg.stop_tokens),
+            msg.deadline if msg.deadline is not None else 0.0,
+            len(msg.prompt),
+            len(msg.generated),
+            1 if msg.seed is not None else 0,
+            msg.seed if msg.seed is not None else 0)
+            + stops + prompt + generated)
+    if isinstance(msg, DrainDoneFrame):
+        return struct.pack("<BBiI", MSG_DRAIN_DONE,
+                           SERVING_WIRE_VERSION, msg.replica,
+                           msg.migrated)
     raise TypeError(f"cannot encode {type(msg).__name__}")
 
 
 def decode(buf: bytes, ref_of: Callable[[Addr], object]):
     """Deserialize one frame; ``ref_of(addr)`` resolves an address to a
-    (possibly interned/local) ref object."""
+    (possibly interned/local) ref object.
+
+    Serving frames (types 7-13) are version-checked and bounds-checked:
+    a hostile or cross-build peer surfaces as :class:`WireError` (which
+    the TCP router converts to a peer failure), never as a struct/numpy
+    exception from an arbitrary offset."""
+    _need(buf, 0, 1, "message type byte")
     (mtype,) = struct.unpack_from("<B", buf, 0)
     off = 1
+    if mtype in _SERVING_MSG_TYPES:
+        off = _check_version(buf, off, mtype)
     if mtype == MSG_HELLO:
         addr, off = _unpack_addr(buf, off)
         (rlen,) = struct.unpack_from("<B", buf, off)
@@ -313,10 +598,14 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
         (interval,) = struct.unpack_from("<d", buf, off)
         return Ping(interval)
     if mtype == MSG_SUBMIT:
+        _need(buf, off, struct.calcsize("<qIiBiBdIBq"),
+              "SubmitFrame header")
         (rid, max_new, eos, has_deadline, attempts, n_stops, deadline,
          n_prompt, has_seed, seed) = struct.unpack_from("<qIiBiBdIBq",
                                                         buf, off)
         off += struct.calcsize("<qIiBiBdIBq")
+        _need(buf, off, 4 * n_stops + 4 * n_prompt,
+              f"{n_stops} stop + {n_prompt} prompt tokens")
         stops = np.frombuffer(buf, dtype=np.int32, count=n_stops,
                               offset=off)
         off += 4 * n_stops
@@ -330,11 +619,66 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
                            attempts=attempts,
                            seed=seed if has_seed else None)
     if mtype == MSG_COMPLETION:
-        rid, rlen, n_tokens = struct.unpack_from("<qBI", buf, off)
-        off += struct.calcsize("<qBI")
+        _need(buf, off, struct.calcsize("<qiBI"),
+              "CompletionFrame header")
+        rid, replica, rlen, n_tokens = struct.unpack_from("<qiBI",
+                                                          buf, off)
+        off += struct.calcsize("<qiBI")
+        _need(buf, off, rlen + 4 * n_tokens,
+              f"{rlen}-byte reason + {n_tokens} tokens")
         reason = buf[off:off + rlen].decode()
         off += rlen
         tokens = np.frombuffer(buf, dtype=np.int32, count=n_tokens,
                                offset=off)
-        return CompletionFrame(rid=rid, tokens=tokens, reason=reason)
-    raise ValueError(f"unknown message type {mtype}")
+        return CompletionFrame(rid=rid, tokens=tokens, reason=reason,
+                               replica=replica)
+    if mtype == MSG_HEALTH:
+        _need(buf, off, struct.calcsize("<iIIQQIIIB"),
+              "HealthFrame body")
+        (replica, occupied, free_slots, dispatches, compiles, trips,
+         evictions, prefill_programs,
+         draining) = struct.unpack_from("<iIIQQIIIB", buf, off)
+        return HealthFrame(replica=replica, occupied=occupied,
+                           free_slots=free_slots,
+                           dispatches=dispatches, compiles=compiles,
+                           draining=bool(draining),
+                           watchdog_trips=trips, evictions=evictions,
+                           prefill_programs=prefill_programs)
+    if mtype == MSG_DRAIN:
+        return DrainFrame()
+    if mtype == MSG_CANCEL:
+        _need(buf, off, 8, "CancelFrame rid")
+        (rid,) = struct.unpack_from("<q", buf, off)
+        return CancelFrame(rid)
+    if mtype == MSG_RESUME:
+        _need(buf, off, struct.calcsize("<iqIiBiBdIIBq"),
+              "ResumeFrame header")
+        (replica, rid, max_new, eos, has_deadline, attempts, n_stops,
+         deadline, n_prompt, n_generated, has_seed,
+         seed) = struct.unpack_from("<iqIiBiBdIIBq", buf, off)
+        off += struct.calcsize("<iqIiBiBdIIBq")
+        _need(buf, off, 4 * (n_stops + n_prompt + n_generated),
+              f"{n_stops} stop + {n_prompt} prompt + "
+              f"{n_generated} generated tokens")
+        stops = np.frombuffer(buf, dtype=np.int32, count=n_stops,
+                              offset=off)
+        off += 4 * n_stops
+        prompt = np.frombuffer(buf, dtype=np.int32, count=n_prompt,
+                               offset=off)
+        off += 4 * n_prompt
+        generated = np.frombuffer(buf, dtype=np.int32,
+                                  count=n_generated, offset=off)
+        return ResumeFrame(rid=rid, prompt=prompt,
+                           max_new_tokens=max_new,
+                           generated=generated,
+                           eos_token=None if eos < 0 else eos,
+                           stop_tokens=stops,
+                           deadline=deadline if has_deadline else None,
+                           attempts=attempts,
+                           seed=seed if has_seed else None,
+                           replica=replica)
+    if mtype == MSG_DRAIN_DONE:
+        _need(buf, off, struct.calcsize("<iI"), "DrainDoneFrame body")
+        replica, migrated = struct.unpack_from("<iI", buf, off)
+        return DrainDoneFrame(replica=replica, migrated=migrated)
+    raise WireError(f"unknown message type {mtype}")

@@ -194,3 +194,98 @@ def maybe_fail(site: str) -> Optional[FaultPoint]:
     if plan is None:
         return None
     return plan.on_site(site)
+
+
+# -- process-level chaos (the subprocess replica fabric) ----------------
+
+_PROCESS_ACTIONS = ("sigkill", "sigstop", "sigterm")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessFaultPoint:
+    """One scheduled REAL kill: deliver ``action`` to replica
+    ``replica``'s process when the fleet's cumulative ``event`` counter
+    reaches ``after`` (1-based). ``event`` is ``"completion"`` (the
+    N-th terminal result crossed the wire — a kill mid-decode-load) or
+    ``"admission"`` (the N-th dispatch left the router — the
+    kill-during-prefill script). ``resume_after_s`` applies to
+    ``sigstop`` only: the scheduled SIGCONT delay — longer than the
+    router's ``max_lag * step_timeout`` window and the straggler is
+    degraded before it thaws, which is exactly what the SIGSTOP tests
+    pin."""
+
+    replica: int
+    action: str
+    after: int = 1
+    event: str = "completion"
+    resume_after_s: float = 1.0
+
+    def __post_init__(self):
+        if self.action not in _PROCESS_ACTIONS:
+            raise ValueError(f"unknown process action {self.action!r} "
+                             f"(have {_PROCESS_ACTIONS})")
+        if self.event not in ("completion", "admission"):
+            raise ValueError(f"unknown event {self.event!r}")
+        if self.after < 1:
+            raise ValueError(f"after must be >= 1, got {self.after}")
+        if self.resume_after_s < 0:
+            raise ValueError(f"resume_after_s must be >= 0, got "
+                             f"{self.resume_after_s}")
+
+
+class ProcessChaosPlan:
+    """The process-kill twin of :class:`FaultPlan`: a seeded script of
+    :class:`ProcessFaultPoint` entries fired against REAL child PIDs by
+    the replica supervisor (serving/supervisor.py hands itself in as
+    the kill surface). ``fired`` records ``(action, replica, event,
+    count)`` tuples — the reconciliation ground truth for the
+    subprocess chaos tests, same contract as ``FaultPlan.fired``.
+
+    Unlike an in-process plan nothing here sleeps or raises: a point's
+    firing is one ``os.kill`` and the fabric's recovery machinery is
+    what turns it into survival."""
+
+    def __init__(self, points=(), seed: int = 0):
+        self.points = tuple(points)
+        for pt in self.points:
+            if not isinstance(pt, ProcessFaultPoint):
+                raise TypeError(f"want ProcessFaultPoint, got "
+                                f"{type(pt).__name__}")
+        self.seed = seed
+        self.fired: list = []
+        self._spent: set = set()
+
+    @classmethod
+    def kill_one(cls, seed: int, replica: int = 0,
+                 action: str = "sigkill",
+                 event: str = "completion") -> "ProcessChaosPlan":
+        """The standard single-kill script: one signal into one replica
+        after a seed-derived number of events — early enough that work
+        is in flight, late enough that the fleet is warm (the same
+        staggering rule as :meth:`FaultPlan.chaos`)."""
+        rng = random.Random(seed)
+        return cls([ProcessFaultPoint(
+            replica=replica, action=action, event=event,
+            after=rng.randint(2, 5))], seed=seed)
+
+    def on_event(self, kind: str, count: int, supervisor) -> None:
+        """The supervisor's counter hook: fire every point whose
+        threshold this event crosses. ``supervisor`` provides
+        ``kill(replica, sig)`` / ``schedule_cont(replica, s)`` — the
+        only two capabilities a kill script needs."""
+        import signal as _signal
+        for idx, pt in enumerate(self.points):
+            if idx in self._spent or pt.event != kind \
+                    or count < pt.after:
+                continue
+            self._spent.add(idx)
+            self.fired.append((pt.action, pt.replica, kind, count))
+            if pt.action == "sigkill":
+                supervisor.kill(pt.replica, _signal.SIGKILL)
+            elif pt.action == "sigterm":
+                supervisor.kill(pt.replica, _signal.SIGTERM)
+            elif pt.action == "sigstop":
+                supervisor.kill(pt.replica, _signal.SIGSTOP)
+                if pt.resume_after_s:
+                    supervisor.schedule_cont(pt.replica,
+                                             pt.resume_after_s)

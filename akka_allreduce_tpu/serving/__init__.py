@@ -94,6 +94,14 @@ from akka_allreduce_tpu.serving.scheduler import (
     RetryPolicy,
     SchedulerConfig,
 )
+from akka_allreduce_tpu.serving.supervisor import (
+    BackoffPolicy,
+    CircuitBreaker,
+    RemoteEngine,
+    ReplicaSupervisor,
+    RestartBudget,
+)
+from akka_allreduce_tpu.serving.worker import ReplicaSpec
 
 __all__ = [
     "AdmitPlan",
@@ -123,4 +131,10 @@ __all__ = [
     "RequestScheduler",
     "RetryPolicy",
     "SchedulerConfig",
+    "BackoffPolicy",
+    "CircuitBreaker",
+    "RemoteEngine",
+    "ReplicaSpec",
+    "ReplicaSupervisor",
+    "RestartBudget",
 ]

@@ -2938,8 +2938,14 @@ def clear_drained(directory: str) -> bool:
 
 
 # failure reasons the serve loop hands back to the scheduler's retry
-# budget (everything else in a completion tuple is terminal)
-RETRYABLE_REASONS = frozenset({"watchdog", "fault", "nan"})
+# budget (everything else in a completion tuple is terminal).
+# "replica_dead" is the subprocess fabric's failover reason: a remote
+# replica's process died (SIGKILL, OOM, crash) with requests in
+# flight — the supervisor's proxy fails every bound request with it,
+# and the router requeues them (or lets a live hedge sibling absorb
+# the failure) exactly as it does an in-process watchdog trip.
+RETRYABLE_REASONS = frozenset({"watchdog", "fault", "nan",
+                               "replica_dead"})
 
 
 def serve_loop(engine: ServingEngine, scheduler: RequestScheduler,

@@ -566,6 +566,16 @@ class FleetMetrics:
         self.replicas_retired_total = 0
         # backpressure sheds at the fleet's admission edge
         self.requests_rejected = 0
+        # supervisor series (the subprocess fabric,
+        # serving/supervisor.py): restarts of crashed replica
+        # processes, cumulative seconds of restart backoff, and the
+        # per-replica circuit-breaker latch. In-process fleets never
+        # tick these — a zero row is itself the signal that the fleet
+        # ran without process churn.
+        self.replica_restarts = [0] * num_replicas
+        self.replica_backoff_s = [0.0] * num_replicas
+        self.replica_breaker_open = [False] * num_replicas
+        self._supervisor = None   # attach_supervisor wires gauges
         # the chaos reconciliation pair at fleet scope: injected is
         # stamped from FaultPlan.fired; survived sums the replicas'
         # recovery events plus router-level survivals (preempt drains)
@@ -655,6 +665,28 @@ class FleetMetrics:
         r.register_callback("serve_fleet_replicas",
                             lambda: len(self.replicas), kind="gauge",
                             help="replicas constructed into the fleet")
+        for i in range(len(self.replicas)):
+            labels = {"replica": str(i)}
+            r.register_callback(
+                "serve_replica_restarts_total",
+                (lambda i=i: self.replica_restarts[i]),
+                kind="counter", labels=labels,
+                help="supervisor restarts of this replica's process "
+                     "after an unexpected death (subprocess fabric)")
+            r.register_callback(
+                "serve_replica_backoff_seconds",
+                (lambda i=i: round(self.replica_backoff_s[i], 3)),
+                kind="counter", labels=labels,
+                help="cumulative seconds of scheduled restart backoff "
+                     "for this replica")
+            r.register_callback(
+                "serve_replica_breaker_open",
+                (lambda i=i: 1 if self.replica_breaker_open[i]
+                 else 0),
+                kind="gauge", labels=labels,
+                help="1 while this replica's restart circuit breaker "
+                     "is OPEN (restart budget exhausted — replica "
+                     "retired, operator attention required)")
         histograms = (
             ("serve_fleet_ttft_seconds", "ttft_s",
              "submit -> first token, merged across replicas"),
@@ -764,6 +796,48 @@ class FleetMetrics:
         self._fault_survived_fleet += 1
         self._record("serve_fault_survived", fault=kind)
 
+    # -- supervisor hooks (subprocess fabric) ---------------------------
+
+    def attach_supervisor(self, sup) -> None:
+        """Wire the live heartbeat-age gauges: one
+        ``serve_replica_heartbeat_age_seconds{replica=i}`` per replica,
+        pulling :meth:`ReplicaSupervisor.heartbeat_age` at scrape time
+        (-1 = never heard from / connection gone — distinguishable
+        from a legitimate 0.0 on a chatty replica). Called by the
+        supervisor's ctor when it is handed this FleetMetrics."""
+        if self._supervisor is not None:
+            return
+        self._supervisor = sup
+        for i in range(len(self.replicas)):
+            self.registry.register_callback(
+                "serve_replica_heartbeat_age_seconds",
+                (lambda i=i: self._heartbeat_age(i)),
+                kind="gauge", labels={"replica": str(i)},
+                help="seconds since the last frame (Pings included) "
+                     "from this replica's process; -1 = never heard / "
+                     "down. The SIGSTOP-straggler triage signal "
+                     "(OPERATIONS.md)")
+
+    def _heartbeat_age(self, i: int) -> float:
+        if self._supervisor is None:
+            return -1.0
+        age = self._supervisor.heartbeat_age(i)
+        return -1.0 if age is None else round(age, 3)
+
+    def on_replica_restart_scheduled(self, replica: int,
+                                     backoff_s: float) -> None:
+        self.replica_backoff_s[replica] += backoff_s
+        self._record("serve_replica_restart_scheduled",
+                     replica=replica, backoff_s=round(backoff_s, 3))
+
+    def on_replica_restarted(self, replica: int) -> None:
+        self.replica_restarts[replica] += 1
+        self._record("serve_replica_restarted", replica=replica)
+
+    def on_breaker_open(self, replica: int) -> None:
+        self.replica_breaker_open[replica] = True
+        self._record("serve_replica_breaker_open", replica=replica)
+
     # -- host plane ----------------------------------------------------
 
     def host_sampler(self, interval_s: float = 1.0):
@@ -824,6 +898,18 @@ class FleetMetrics:
                 "readmitted_total": self.replicas_readmitted_total,
                 "shed_admissions_total": self.shed_admissions_total,
                 "retired_total": self.replicas_retired_total,
+            },
+            # the subprocess-fabric supervisor block — the SAME lists/
+            # pulls the serve_replica_* series scrape (scrape ==
+            # summary holds here exactly as everywhere else)
+            "supervisor": {
+                "restarts": list(self.replica_restarts),
+                "backoff_seconds": [round(b, 3)
+                                    for b in self.replica_backoff_s],
+                "breaker_open": list(self.replica_breaker_open),
+                "heartbeat_age_s": [
+                    self._heartbeat_age(i)
+                    for i in range(len(self.replicas))],
             },
             # the merged fleet distributions — the SAME merge the
             # serve_fleet_* pull collectors run at scrape time

@@ -34,14 +34,19 @@ module applies them ACROSS engines:
   retires from the fleet. A failure a live hedge sibling already
   covers spends no retry at all.
 
-Transport note: the fleet here is in-process (N engines, one device
-context — how tests and the CPU bench run it). The request/response
-frames a SUBPROCESS replica needs ride the existing wire codec
-(protocol/wire.py ``SubmitFrame``/``CompletionFrame`` — serving
-requests mapped by :func:`akka_allreduce_tpu.protocol.wire
-.request_to_frame`), over the same tcp.py transport the training plane
-uses; the router's routing/ledger logic is transport-agnostic by
-construction (it sees admissions and completions, not call stacks).
+Transport note: the fleet is transport-agnostic by construction (the
+router sees admissions and completions, not call stacks). The DEFAULT
+fleet is in-process — N engines, one device context, how tests and
+the CPU bench run it, and the parity oracle for everything else. The
+SUBPROCESS fleet (serving/supervisor.py, ``--replica-mode
+subprocess``) drives this same router over
+:class:`~akka_allreduce_tpu.serving.supervisor.RemoteEngine` handles:
+each replica is a real child process (serving/worker.py) speaking
+``SubmitFrame``/``CompletionFrame`` (plus the drain/resume/health
+frames) over protocol/tcp.py, and every fault this docstring
+describes exists there as an actual ``os.kill`` — SIGKILL is the
+failover path, SIGTERM the drain migration, SIGSTOP the straggler the
+LagLedger degrades.
 
 Determinism: the router is single-threaded and steps replicas in index
 order, so a seeded FaultPlan yields a reproducible interleaving — the
@@ -352,13 +357,26 @@ class ReplicaRouter:
 
     # -- replica drain / retirement -------------------------------------
 
-    def _retire(self, rep: ReplicaHandle,
-                pending_resume: list) -> None:
+    def _harvest(self, rep: ReplicaHandle, results: dict) -> None:
+        """Route completions a TRANSPORT-BACKED replica already
+        delivered but the round loop has not routed yet (a completion
+        that raced the drain/retire decision on the wire). In-process
+        engines return completions synchronously from step() and have
+        no harvest surface — this is a no-op for them."""
+        harvest = getattr(rep.engine, "harvest", None)
+        if harvest is not None:
+            self._route_completions(rep, harvest(), results)
+
+    def _retire(self, rep: ReplicaHandle, pending_resume: list,
+                results: dict) -> None:
         """A preempted replica leaves the fleet: snapshot its in-flight
         requests and MIGRATE them — a copy a live sibling hedge already
         covers is dropped (covered, not lost); the rest join the resume
         queue ahead of fresh admissions, restoring into surviving
-        replicas with bitwise-parity continuation."""
+        replicas with bitwise-parity continuation. Completions the
+        replica delivered before the drain landed are routed first —
+        finished work is a result, never a migration."""
+        self._harvest(rep, results)
         migrated = 0
         for rr in rep.engine.drain():
             self._unbind(rr.req.rid, rep.index)
@@ -387,11 +405,23 @@ class ReplicaRouter:
             self.tracer.record("router_replica_retired",
                                replica=rep.index, migrated=migrated)
 
-    def _drain_fleet(self, pending_resume: list) -> None:
+    def _drain_fleet(self, pending_resume: list,
+                     results: dict) -> None:
         """Fleet-wide drain (SIGTERM / router-level preempt): every
         live replica's snapshots, plus resumables not yet re-placed,
-        land on ``self.drained`` for the caller's persistence path."""
-        for rep in self._live():
+        land on ``self.drained`` for the caller's persistence path.
+
+        Every live replica is told to drain FIRST: for an in-process
+        engine request_drain just latches the flag drain() honors, but
+        a transport-backed replica needs the DrainFrame on the wire
+        before its drain() wait can ever see snapshots — without it
+        the collection loop would time out per replica and degrade
+        every in-flight request to a zero-progress snapshot."""
+        live = self._live()
+        for rep in live:
+            rep.engine.request_drain()
+        for rep in live:
+            self._harvest(rep, results)
             for rr in rep.engine.drain():
                 self._unbind(rr.req.rid, rep.index)
                 # hedge copies of one rid collapse to a single snapshot
@@ -446,7 +476,7 @@ class ReplicaRouter:
                 if fleet is not None:
                     fleet.on_fault_survived("preempt")
             if self._draining:
-                self._drain_fleet(pending_resume)
+                self._drain_fleet(pending_resume, results)
                 drain_drops()
                 return results
             for rep in self.replicas:
@@ -456,7 +486,7 @@ class ReplicaRouter:
                 if pt is not None and pt.kind == "preempt":
                     rep.engine.request_drain()
                 if rep.engine.draining:
-                    self._retire(rep, pending_resume)
+                    self._retire(rep, pending_resume, results)
             live = self._live()
             if not live:
                 # the whole fleet is gone: whatever work remains is a

@@ -46,7 +46,8 @@ from typing import Optional
 
 # sections the gate knows how to re-measure, in bank order
 SECTIONS = ("serving_throughput", "multi_step_decode", "paged_serving",
-            "replicated_serving", "speculative_serving", "ab_overlap",
+            "replicated_serving", "speculative_serving",
+            "subprocess_serving", "ab_overlap",
             "quantized_collectives")
 
 # per-section relative tolerance, derived from the banked captures' own
@@ -69,6 +70,12 @@ SECTION_TOLERANCE = {
     # full-cost self-draft row is deliberately named self_RATIO, not
     # *_speedup, so only the spec-arm claim gates)
     "speculative_serving": 0.45,
+    # ISSUE 11: subprocess fleet vs in-process fleet at equal slots —
+    # the wire tax gate. Ratio of two serve runs on one shared box
+    # with worker processes contending for the cores: the same 0.45
+    # serving noise regime (< 0.5 keeps the 2x-regression acceptance
+    # property)
+    "subprocess_serving": 0.45,
     "ab_overlap": 0.35,
     # ISSUE 9: swing/ef8 goodput as a fraction of the fused psum,
     # measured back-to-back in one run — two-point deltas on a shared
@@ -252,6 +259,14 @@ def fresh_rows(section: str) -> list:
                 n_requests=16, prompt_len=64, steps=128,
                 total_slots=8, n_replicas=2)
         return measure_replicated_serving()
+    if section == "subprocess_serving":
+        from akka_allreduce_tpu.bench import measure_subprocess_serving
+        if on_tpu:
+            return measure_subprocess_serving(
+                d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+                n_requests=16, prompt_len=64, steps=128,
+                total_slots=8, n_replicas=2)
+        return measure_subprocess_serving()
     if section == "ab_overlap":
         from akka_allreduce_tpu.bench import measure_ab_overlap
         return list(measure_ab_overlap())

@@ -90,7 +90,7 @@ def main(only=None) -> int:
                 ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
                 serving_throughput, multi_step_decode, paged_serving,
                 replicated_serving, speculative_serving,
-                quantized_collectives)}
+                subprocess_serving, quantized_collectives)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -284,6 +284,30 @@ def replicated_serving():
             n_replicas=2)
     else:
         rows = measure_replicated_serving()
+    for row in rows:
+        emit(row["metric"], row["value"], row["unit"], row["note"])
+
+
+def subprocess_serving():
+    """The subprocess-fabric A/B (ISSUE 11, serving/supervisor.py):
+    in-process fleet vs REAL subprocess replicas over TCP at equal
+    total slots. The speedup row (subprocess / in-process, expected
+    < 1 on one box) is the claim — the wire tax of crossing a process
+    boundary per dispatch/completion, gated so the fabric's
+    steady-state cost cannot silently grow (akka_allreduce_tpu.bench
+    measure_subprocess_serving). CPU sizes down; TPU sizes up."""
+    import jax
+
+    from akka_allreduce_tpu.bench import measure_subprocess_serving
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        rows = measure_subprocess_serving(
+            d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+            n_requests=16, prompt_len=64, steps=128, total_slots=8,
+            n_replicas=2)
+    else:
+        rows = measure_subprocess_serving()
     for row in rows:
         emit(row["metric"], row["value"], row["unit"], row["note"])
 

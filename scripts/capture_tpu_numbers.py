@@ -110,6 +110,17 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "speculative_serving"], check=False)
 """),
+    # 7 (ISSUE 11). the subprocess-fabric wire tax: in-process fleet
+    # vs real subprocess replica workers over TCP at equal slots —
+    # on-chip this also answers whether worker processes can share a
+    # TPU (expected: no — one process owns the chip; the step banking
+    # an error row IS the finding, and the CPU rows in
+    # perf_capture/subprocess_serving.json carry the gate meanwhile)
+    ("subprocess_serving", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "subprocess_serving"], check=False)
+"""),
     # 3. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time. guard_recompiles: every timed run holds under the

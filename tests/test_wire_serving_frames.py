@@ -1,0 +1,173 @@
+"""Serving wire-format v2 (ISSUE 11): version byte, supervisor frames,
+and the hostile-peer read path.
+
+The contract under test: every serving frame (types 7-13) carries
+``SERVING_WIRE_VERSION`` right after its message type; a mismatched
+version or a truncated/hostile payload raises a :class:`WireError`
+subclass with a READABLE message — never a struct/numpy exception from
+an arbitrary offset — because protocol/tcp.py converts exactly those
+errors into peer failures (deathwatch), and a replica dying mid-write
+must surface as a dead peer, not a codec traceback in the router.
+
+Pure codec tests: no jax, no sockets, no subprocesses — the TCP-level
+half (oversized frames downing a peer, undecodable frames firing
+deathwatch) rides in tests/test_subprocess_fabric.py where real
+connections exist.
+"""
+
+import struct
+
+import pytest
+
+from akka_allreduce_tpu.protocol import wire
+from akka_allreduce_tpu.protocol.wire import (
+    CancelFrame,
+    CompletionFrame,
+    DrainDoneFrame,
+    DrainFrame,
+    HealthFrame,
+    ResumeFrame,
+    SERVING_WIRE_VERSION,
+    SubmitFrame,
+    TruncatedFrame,
+    WireError,
+    WireVersionError,
+    decode,
+    encode,
+    frame_to_resumable,
+    resumable_to_frame,
+)
+
+
+def roundtrip(frame):
+    return decode(encode(frame, None), None)
+
+
+class TestRoundTrips:
+    def test_submit(self):
+        f = SubmitFrame(rid=7, prompt=(1, 2, 3), max_new_tokens=5,
+                        eos_token=2, stop_tokens=(4, 9),
+                        deadline=1.5, attempts=2, seed=11)
+        assert roundtrip(f) == f
+
+    def test_completion_carries_replica(self):
+        f = CompletionFrame(3, (9, 8, 7), "eos", replica=5)
+        back = roundtrip(f)
+        assert back == f
+        assert back.replica == 5
+
+    def test_completion_default_replica_is_sentinel(self):
+        back = roundtrip(CompletionFrame(4, (), "watchdog"))
+        assert back.replica == -1
+
+    def test_health(self):
+        f = HealthFrame(replica=1, occupied=2, free_slots=0,
+                        dispatches=55, compiles=7, draining=True,
+                        watchdog_trips=2, evictions=3,
+                        prefill_programs=4)
+        assert roundtrip(f) == f
+
+    def test_drain_cancel_drain_done(self):
+        assert roundtrip(DrainFrame()) == DrainFrame()
+        assert roundtrip(CancelFrame(42)) == CancelFrame(42)
+        assert roundtrip(DrainDoneFrame(1, 3)) == DrainDoneFrame(1, 3)
+
+    def test_resume_full(self):
+        f = ResumeFrame(rid=4, prompt=(1, 2), max_new_tokens=8,
+                        generated=(5, 6, 7), eos_token=3,
+                        stop_tokens=(9,), deadline=0.25, attempts=1,
+                        seed=13, replica=0)
+        assert roundtrip(f) == f
+
+    def test_resume_optionals_none(self):
+        f = ResumeFrame(rid=4, prompt=(1,), max_new_tokens=8)
+        back = roundtrip(f)
+        assert back.eos_token is None
+        assert back.deadline is None
+        assert back.seed is None
+        assert back.generated == ()
+
+
+class TestResumableMapping:
+    def test_snapshot_roundtrip(self):
+        from akka_allreduce_tpu.serving.engine import ResumableRequest
+        from akka_allreduce_tpu.serving.scheduler import Request
+        rr = ResumableRequest(
+            req=Request(rid=9, prompt=(3, 1, 4), max_new_tokens=6,
+                        eos_token=2, stop_tokens=(5,), attempts=1,
+                        seed=77),
+            generated=(8, 8), slot=1)
+        frame = resumable_to_frame(rr, replica=1)
+        assert frame.replica == 1
+        back = frame_to_resumable(roundtrip(frame))
+        assert back.req.rid == 9
+        assert back.req.prompt == (3, 1, 4)
+        assert back.req.attempts == 1
+        assert back.req.seed == 77
+        assert back.generated == (8, 8)
+        assert back.slot == -1  # no slot until the target admits
+
+
+class TestVersioning:
+    @pytest.mark.parametrize("frame", [
+        SubmitFrame(rid=0, prompt=(5,), max_new_tokens=1),
+        CompletionFrame(0, (), "eos"),
+        HealthFrame(0, 0, 2, 0),
+        DrainFrame(),
+        CancelFrame(0),
+        ResumeFrame(rid=0, prompt=(5,), max_new_tokens=2),
+        DrainDoneFrame(0, 0),
+    ])
+    def test_every_serving_frame_carries_the_version_byte(self, frame):
+        buf = encode(frame, None)
+        assert buf[1] == SERVING_WIRE_VERSION
+
+    def test_version_mismatch_is_a_clear_error(self):
+        buf = bytearray(encode(CompletionFrame(1, (2,), "eos"), None))
+        buf[1] = SERVING_WIRE_VERSION + 1
+        with pytest.raises(WireVersionError,
+                           match="different builds"):
+            decode(bytes(buf), None)
+
+    def test_allreduce_frames_stay_unversioned(self):
+        # the training plane's frames (types 0-6) predate versioning;
+        # their layout must not have shifted under this PR
+        ping = wire.Ping(2.0)
+        back = decode(encode(ping, None), None)
+        assert isinstance(back, wire.Ping)
+        assert back.interval == 2.0
+
+
+class TestHostileFrames:
+    def test_truncated_submit_header(self):
+        buf = encode(SubmitFrame(rid=0, prompt=(5,),
+                                 max_new_tokens=1), None)
+        with pytest.raises(TruncatedFrame, match="truncated"):
+            decode(buf[:6], None)
+
+    def test_lying_payload_counts(self):
+        # header claims 1000 prompt tokens; payload carries 1 — the
+        # np.frombuffer ValueError must never escape raw
+        f = SubmitFrame(rid=0, prompt=(5,), max_new_tokens=1)
+        buf = bytearray(encode(f, None))
+        # n_prompt is the I at offset 2 + "<qIiBiBd" in the v2 layout
+        off = 2 + struct.calcsize("<qIiBiBd")
+        struct.pack_into("<I", buf, off, 1000)
+        with pytest.raises(TruncatedFrame):
+            decode(bytes(buf), None)
+
+    def test_lying_completion_counts(self):
+        buf = bytearray(encode(CompletionFrame(1, (2, 3), "eos"),
+                               None))
+        off = 2 + struct.calcsize("<qiB")
+        struct.pack_into("<I", buf, off, 1 << 20)
+        with pytest.raises(TruncatedFrame):
+            decode(bytes(buf), None)
+
+    def test_unknown_type_is_wire_error(self):
+        with pytest.raises(WireError, match="unknown message type"):
+            decode(bytes([250]), None)
+
+    def test_empty_frame(self):
+        with pytest.raises(TruncatedFrame):
+            decode(b"", None)
