@@ -2229,12 +2229,19 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "compiles")
     # -- synthetic load
     p.add_argument("--requests", type=int, default=32)
-    p.add_argument("--load", choices=("closed", "open"), default="closed",
+    p.add_argument("--load", choices=("closed", "open", "trace"),
+                   default="closed",
                    help="closed = all requests queued at t0 (throughput "
                         "regime); open = Poisson arrivals at "
-                        "--arrival-rate (latency-under-load regime)")
+                        "--arrival-rate (latency-under-load regime); "
+                        "trace = the seeded stress-plane workload "
+                        "(serving/loadgen.py): heavy-tailed lengths, "
+                        "--arrival-curve shapes, a tenant population "
+                        "with shared prefixes and slow clients, "
+                        "coordinated-omission-safe latency in the "
+                        "report's 'stress' block")
     p.add_argument("--arrival-rate", type=float, default=0.0,
-                   help="open loop: mean arrivals per second")
+                   help="open/trace loop: mean arrivals per second")
     p.add_argument("--prompt-len", default="4:16", metavar="MIN:MAX",
                    help="synthetic prompt length range (uniform)")
     p.add_argument("--max-new-tokens", type=int, default=32,
@@ -2246,6 +2253,74 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                    help="with --policy deadline: synthetic per-request "
                         "deadline = arrival + slack")
     p.add_argument("--seed", type=int, default=0)
+    # -- stress plane + admission economics (ISSUE 12)
+    p.add_argument("--arrival-curve",
+                   choices=("poisson", "diurnal", "burst"),
+                   default="poisson",
+                   help="with --load trace: the arrival-rate curve — "
+                        "flat Poisson, sinusoidal day/night swing, or "
+                        "square-wave thundering herds; every curve "
+                        "averages --arrival-rate")
+    p.add_argument("--tenant-count", type=int, default=1, metavar="N",
+                   help="with --load trace: tenants in the population "
+                        "(equal weights, per-tenant seeds; tenant0 "
+                        "carries the --prefix-len shared prefix and "
+                        "tenantN-1 the --slow-client-ratio)")
+    p.add_argument("--prefix-len", type=int, default=0,
+                   help="with --load trace: shared system-prompt "
+                        "tokens for tenant0 (composes with --paged's "
+                        "prefix registry); 0 = none")
+    p.add_argument("--prefix-ratio", type=float, default=0.75,
+                   help="with --load trace: fraction of tenant0's "
+                        "requests that start with the shared prefix")
+    p.add_argument("--slow-client-ratio", type=float, default=0.0,
+                   help="with --load trace: fraction of the LAST "
+                        "tenant's requests whose client picks results "
+                        "up --pickup-delay late — a bounded completion "
+                        "buffer (--pickup-capacity) turns slow readers "
+                        "into admission backpressure")
+    p.add_argument("--pickup-delay", type=float, default=0.05,
+                   metavar="S",
+                   help="slow-client pickup latency (seconds after "
+                        "completion)")
+    p.add_argument("--pickup-capacity", type=int, default=8,
+                   help="completion-buffer bound: admission stalls "
+                        "while this many results await pickup")
+    p.add_argument("--tenant-budget", default="", metavar="RATE:BURST",
+                   help="arm per-tenant token-bucket budgets "
+                        "(serving/admission.py): every tenant gets "
+                        "RATE tokens/s of sustained budget with BURST "
+                        "tokens of headroom; a request is priced "
+                        "prompt + max-new-tokens at admission and "
+                        "shed (shed_budget) when its tenant's bucket "
+                        "cannot cover it. Empty (default) = unmetered")
+    p.add_argument("--overload-backlog-s", type=float, default=0.0,
+                   metavar="S",
+                   help="arm the overload controller: when the live "
+                        "queue's estimated drain time (priced at "
+                        "--tpot-estimate) exceeds S, victims are shed "
+                        "by policy (shed_overload: over-budget "
+                        "tenants first, most-expensive-first within "
+                        "the pool) until the backlog fits. 0 = off")
+    p.add_argument("--edf-admission", action="store_true",
+                   help="queue-aware EDF deadline admission: a "
+                        "deadline-carrying request that cannot decode "
+                        "even one useful token after the queued work "
+                        "that outranks it (at --tpot-estimate across "
+                        "the fleet's lanes) is shed at admission "
+                        "(shed_overload) — strictly stronger than the "
+                        "solo rejected_infeasible check")
+    p.add_argument("--stress", action="store_true",
+                   help="with --selfcheck: the overload-drill smoke — "
+                        "drives a seeded burst trace past saturation "
+                        "with economics armed and asserts open-loop "
+                        "accounting invariants (every scheduled "
+                        "arrival ends in exactly one terminal record), "
+                        "policy-only shedding, budget containment, "
+                        "CO-safe latency >= naive, slow-client "
+                        "backpressure, and scrape == summary for the "
+                        "serve_admission_*/serve_tenant_* series. The "
+                        "rate SWEEP (knee curves) is `cli.py stress`")
     p.add_argument("--trace-file", default=None,
                    help="write serve_* lifecycle events + prefill/step "
                         "spans (JSONL, runtime/tracing.py) here on exit")
@@ -3429,6 +3504,262 @@ def _make_draft_model(params: dict, mcfg, draft_layers: int):
     return draft_params, draft_cfg
 
 
+def _parse_tenant_budget(s: str):
+    """``RATE:BURST`` -> (tokens_per_s, burst_tokens), or None for the
+    empty string (unmetered). ValueError with an operator-readable
+    message otherwise."""
+    s = s.strip()
+    if not s:
+        return None
+    rate, sep, burst = s.partition(":")
+    if not sep:
+        raise ValueError(f"bad --tenant-budget {s!r} (want RATE:BURST, "
+                         f"e.g. 30:60)")
+    try:
+        vals = (float(rate), float(burst))
+    except ValueError:
+        raise ValueError(f"bad --tenant-budget {s!r} (want RATE:BURST "
+                         f"as numbers)")
+    if vals[0] < 0 or vals[1] < 1:
+        raise ValueError(f"--tenant-budget needs RATE >= 0 and "
+                         f"BURST >= 1, got {s!r}")
+    return vals
+
+
+def _serve_stress_selfcheck(args: argparse.Namespace) -> int:
+    """The ISSUE 12 overload drill (CI smoke): a seeded burst trace —
+    the whole population arriving effectively at once — driven
+    OPEN-LOOP through a deliberately small engine with admission
+    economics armed, far past its knee. Asserts the contracts the
+    stress plane exists to keep:
+
+    * open-loop accounting: every scheduled arrival ends in EXACTLY
+      one terminal record (completed or shed) — nothing unresolved,
+      nothing double-counted;
+    * shedding is POLICY, not collapse: every rejection carries
+      ``shed_overload`` or ``shed_budget``, the scheduler's terminal
+      drops reconcile exactly with the controller's counters (totals
+      and per tenant), and goodput stays nonzero;
+    * budgets bind within one request's tokens: a metered tenant's
+      spend never exceeds burst + rate x elapsed;
+    * latency accounting is coordinated-omission-safe: the co-safe p99
+      (measured from the SCHEDULED arrival) strictly exceeds the naive
+      admit-measured p99 under this saturating burst — queue delay is
+      charged, not hidden;
+    * slow clients are backpressure: the bounded pickup buffer blocks
+      admission polls and every slow result is eventually picked up;
+    * scrape == summary for every serve_admission_* / serve_tenant_*
+      series (same cells by construction, asserted through the
+      Prometheus text round-trip)."""
+    import jax
+
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.serving import (AdmissionConfig,
+                                            AdmissionController,
+                                            EngineConfig, LatencyLedger,
+                                            PickupBuffer,
+                                            RequestScheduler,
+                                            SchedulerConfig,
+                                            ServingEngine,
+                                            ServingMetrics, TenantBudget,
+                                            TenantSpec, TraceConfig,
+                                            anchor_trace, generate_trace,
+                                            hook_metrics, serve_loop,
+                                            trace_summary)
+    from akka_allreduce_tpu.telemetry import parse_prometheus_text
+
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=32)
+    params = init_transformer(jax.random.key(0), cfg)
+    tenants = (
+        # the shared-prefix majority
+        TenantSpec("paid", weight=2.0, prefix_len=4, prefix_ratio=0.75,
+                   prompt_mu=1.6, output_mu=1.8, seed=1),
+        # the METERED tenant: its bucket binds under the burst. Its
+        # requests are CHEAP so the overload sweep's most-expensive-
+        # first ranking leaves them queued — they must reach charge()
+        # and shed against the bucket, or the drill proves only one of
+        # the two policies
+        TenantSpec("free", weight=1.0, prompt_mu=1.2, output_mu=1.2,
+                   seed=2),
+        # the slow readers: every completion waits 80 ms for pickup
+        TenantSpec("slow", weight=1.0, prompt_mu=1.6, output_mu=1.8,
+                   slow_client_ratio=1.0, pickup_delay_s=0.08, seed=3),
+    )
+    tcfg = TraceConfig(seed=7, n_requests=24, rate=2000.0,
+                       arrival="burst", vocab=cfg.vocab_size,
+                       max_prompt=12, max_new_tokens=12,
+                       tenants=tenants)
+    trace = generate_trace(tcfg)
+    ledger = LatencyLedger()
+    pickup = PickupBuffer(capacity=1)
+    metrics = hook_metrics(
+        ServingMetrics(), ledger, pickup,
+        {tr.req.rid: tr.pickup_delay_s for tr in trace})
+    free_budget = TenantBudget(tokens_per_s=0.5, burst_tokens=10.0)
+    econ_t0 = time.monotonic()   # the free tenant's bucket is born now
+    ctrl = AdmissionController(
+        AdmissionConfig(
+            budgets={"free": free_budget},
+            tpot_estimate=0.004, overload_backlog_s=0.3),
+        slots=2)
+    metrics.attach_admission(ctrl)
+    engine = ServingEngine(params, cfg, EngineConfig(num_slots=2))
+    sched = RequestScheduler(
+        SchedulerConfig(max_queue_depth=256), num_slots=2,
+        on_reject=metrics.on_reject, admission=ctrl,
+        admit_gate=pickup.admit_ok)
+    t0 = time.monotonic()
+    anchor_trace(trace, t0)
+    ledger.schedule_trace(trace)
+    for tr in trace:
+        metrics.on_submit(tr.req.rid)
+        sched.submit(tr.req)
+    # let the whole burst ARRIVE before the first pop: the drill wants
+    # one overload sweep over the full backlog at a full bucket (price-
+    # ranked victims), so the metered tenant's cheap requests survive
+    # the sweep and shed at charge() against the bucket — both
+    # policies, deterministically (the trace spans ~4 ms; 50 ms covers
+    # it with margin)
+    time.sleep(0.05)
+    results = serve_loop(engine, sched, metrics=metrics,
+                         max_dispatches=4000)
+    wall = time.monotonic() - t0
+    while pickup.waiting:      # late readers drain after the run
+        pickup.poll()
+        time.sleep(0.01)
+
+    failures = []
+    summ = ledger.summary()
+    # -- open-loop accounting: one terminal record per arrival --------
+    if ledger.unresolved():
+        failures.append(f"unresolved rids {ledger.unresolved()} — an "
+                        f"open-loop arrival vanished without a "
+                        f"terminal record")
+    if set(results) != {tr.req.rid for tr in trace}:
+        failures.append("results keyed off the trace's rid set")
+    # -- policy-only shedding + exact reconciliation ------------------
+    reasons = {r for _, r in results.values()}
+    bad = reasons - set(LatencyLedger.SUCCESS) \
+        - {"shed_overload", "shed_budget"}
+    if bad:
+        failures.append(f"non-policy terminal reasons under the "
+                        f"drill: {sorted(bad)}")
+    n_budget = sum(1 for _, r in results.values()
+                   if r == "shed_budget")
+    n_over = sum(1 for _, r in results.values()
+                 if r == "shed_overload")
+    if n_budget != ctrl.shed_budget_total \
+            or n_over != ctrl.shed_overload_total:
+        failures.append(
+            f"shed reconciliation: results ({n_budget} budget, "
+            f"{n_over} overload) != controller "
+            f"({ctrl.shed_budget_total}, {ctrl.shed_overload_total})")
+    if n_budget < 1 or n_over < 1:
+        failures.append(f"the drill must shed by BOTH policies, got "
+                        f"budget={n_budget} overload={n_over}")
+    csum = ctrl.summary()
+    for key, total in (("admitted", ctrl.admitted_total),
+                       ("shed_budget", ctrl.shed_budget_total),
+                       ("shed_overload", ctrl.shed_overload_total),
+                       ("tokens_spent", ctrl.tokens_spent_total)):
+        per_tenant = sum(t[key] for t in csum["tenants"].values())
+        if per_tenant != total:
+            failures.append(f"per-tenant {key} sums to {per_tenant}, "
+                            f"controller total {total}")
+    n_done = sum(1 for _, r in results.values()
+                 if r in LatencyLedger.SUCCESS)
+    if ctrl.admitted_total != n_done:
+        failures.append(f"admitted {ctrl.admitted_total} != completed "
+                        f"{n_done} (no faults/deadlines in the drill: "
+                        f"every priced admission must finish)")
+    if n_done < 1:
+        failures.append("goodput zero: nothing completed past the "
+                        "knee — that is collapse, not policy")
+    # -- budget containment: the checked-then-spent bucket can never
+    # spend more than its burst plus everything that refilled over its
+    # whole lifetime — the EXACT contract, no slack beyond float fuzz
+    free = csum["tenants"]["free"]
+    bucket_age = time.monotonic() - econ_t0
+    cap = free_budget.burst_tokens \
+        + free_budget.tokens_per_s * bucket_age + 1e-6
+    if free["tokens_spent"] > cap:
+        failures.append(f"free tenant spent {free['tokens_spent']} "
+                        f"tokens > budget cap {cap:.1f} (burst "
+                        f"{free_budget.burst_tokens} + "
+                        f"{free_budget.tokens_per_s}/s x "
+                        f"{bucket_age:.2f}s)")
+    # -- coordinated-omission safety ----------------------------------
+    co_p99 = summ["co_safe_ms"].get("p99")
+    naive_p99 = summ["naive_ms"].get("p99")
+    if co_p99 is None or naive_p99 is None:
+        failures.append(f"latency ledger empty: co={summ['co_safe_ms']}"
+                        f" naive={summ['naive_ms']}")
+    elif not co_p99 > naive_p99:
+        failures.append(
+            f"co-safe p99 {co_p99} ms not above naive admit-measured "
+            f"p99 {naive_p99} ms under a saturating burst — queue "
+            f"delay is being hidden (coordinated omission)")
+    # -- slow-client backpressure -------------------------------------
+    n_slow_done = sum(
+        1 for tr in trace if tr.pickup_delay_s > 0
+        and results[tr.req.rid][1] in LatencyLedger.SUCCESS)
+    if pickup.picked_up != n_slow_done:
+        failures.append(f"pickup buffer released {pickup.picked_up} "
+                        f"results, {n_slow_done} slow completions")
+    if n_slow_done >= 2 and sched.blocked_on_client < 1:
+        failures.append("slow clients never blocked admission — the "
+                        "pickup buffer is not backpressure")
+    # -- scrape == summary for the admission series -------------------
+    prom = parse_prometheus_text(
+        metrics.registry.to_prometheus_text())
+    series = (("serve_admission_admitted_total", ctrl.admitted_total),
+              ("serve_admission_shed_budget_total",
+               ctrl.shed_budget_total),
+              ("serve_admission_shed_overload_total",
+               ctrl.shed_overload_total),
+              ("serve_admission_tokens_spent_total",
+               ctrl.tokens_spent_total),
+              ("serve_admission_overload_sweeps_total",
+               ctrl.overload_sweeps))
+    for name, want in series:
+        got = prom.get((name, ()))
+        if got != want:
+            failures.append(f"prometheus {name} {got} != summary "
+                            f"{want}")
+    for tenant, t in csum["tenants"].items():
+        for suffix in ("admitted", "shed_budget", "shed_overload",
+                       "tokens_spent"):
+            name = f"serve_tenant_{suffix}_total"
+            got = prom.get((name, (("tenant", tenant),)))
+            if got != t[suffix]:
+                failures.append(f"prometheus {name}{{tenant="
+                                f"{tenant}}} {got} != summary "
+                                f"{t[suffix]}")
+    report = {"selfcheck": "stress",
+              "requests": len(trace),
+              "completed": n_done,
+              "shed_budget": n_budget,
+              "shed_overload": n_over,
+              "co_p99_ms": co_p99,
+              "naive_p99_ms": naive_p99,
+              "blocked_on_client": sched.blocked_on_client,
+              "wall_s": round(wall, 3),
+              "trace": trace_summary(trace),
+              "admission": csum,
+              "ok": not failures}
+    print(json.dumps(report))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"stress selfcheck ok: {n_done} completed, "
+          f"{n_budget}+{n_over} shed by policy, co-p99 {co_p99} ms "
+          f"(naive {naive_p99} ms)", file=sys.stderr)
+    return 0
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     _apply_backend_flags(args)
     # validated BEFORE the selfcheck dispatch: a typo'd S must exit 2,
@@ -3510,21 +3841,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                   "subprocess replicas are an open follow-up",
                   file=sys.stderr)
             return 2
-        if args.temperature != 0.0:
-            print("error: --replica-mode subprocess serves greedy "
-                  "decode for now (the ReplicaSpec does not carry "
-                  "sampling config); drop --temperature",
-                  file=sys.stderr)
-            return 2
         if args.prefill_buckets.strip():
             print("error: --replica-mode subprocess prefill is "
                   "exact-length (the parity mode); drop "
                   "--prefill-buckets", file=sys.stderr)
-            return 2
-        if args.kv_cache == "int8":
-            print("error: --replica-mode subprocess does not carry "
-                  "the int8 KV config yet; drop --kv-cache",
-                  file=sys.stderr)
             return 2
         if args.selfcheck and args.replicas < 2:
             print("error: the subprocess selfcheck kills one of N>=2 "
@@ -3595,7 +3915,57 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                   f"{args.n_layers}], got {args.draft_layers}",
                   file=sys.stderr)
             return 2
+    # -- stress plane + admission economics validation (ISSUE 12) -----
+    if args.stress and not args.selfcheck:
+        print("error: --stress is the overload-drill smoke and needs "
+              "--selfcheck; the arrival-rate sweep (knee curves) is "
+              "`python -m akka_allreduce_tpu.cli stress`",
+              file=sys.stderr)
+        return 2
+    if args.load == "trace" and args.arrival_rate <= 0:
+        print("error: --load trace needs --arrival-rate > 0 (the "
+              "curve's mean)", file=sys.stderr)
+        return 2
+    if args.tenant_count < 1:
+        print(f"error: --tenant-count must be >= 1, got "
+              f"{args.tenant_count}", file=sys.stderr)
+        return 2
+    for name, val in (("--prefix-ratio", args.prefix_ratio),
+                      ("--slow-client-ratio", args.slow_client_ratio)):
+        if not 0.0 <= val <= 1.0:
+            print(f"error: {name} must be in [0, 1], got {val}",
+                  file=sys.stderr)
+            return 2
+    if args.prefix_len < 0 or args.pickup_delay < 0:
+        print("error: --prefix-len/--pickup-delay must be >= 0",
+              file=sys.stderr)
+        return 2
+    if args.pickup_capacity < 1:
+        print(f"error: --pickup-capacity must be >= 1, got "
+              f"{args.pickup_capacity}", file=sys.stderr)
+        return 2
+    if args.overload_backlog_s < 0:
+        print(f"error: --overload-backlog-s must be >= 0, got "
+              f"{args.overload_backlog_s}", file=sys.stderr)
+        return 2
+    if args.overload_backlog_s > 0 and args.tpot_estimate <= 0:
+        print("error: --overload-backlog-s prices the backlog at "
+              "--tpot-estimate; set --tpot-estimate > 0",
+              file=sys.stderr)
+        return 2
+    if args.edf_admission and args.tpot_estimate <= 0:
+        print("error: --edf-admission prices start estimates at "
+              "--tpot-estimate; set --tpot-estimate > 0",
+              file=sys.stderr)
+        return 2
+    try:
+        tenant_budget = _parse_tenant_budget(args.tenant_budget)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
     if args.selfcheck:
+        if args.stress:
+            return _serve_stress_selfcheck(args)
         if args.replica_mode == "subprocess":
             return _serve_subprocess_selfcheck(args)
         if args.speculative:
@@ -3715,26 +4085,66 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     rid_base = 1 + max((rr.req.rid for rr in resumed), default=-1)
 
     rng = np.random.default_rng(args.seed)
-    arrivals = np.zeros(args.requests)
-    if args.load == "open":
-        arrivals = np.cumsum(rng.exponential(1.0 / args.arrival_rate,
-                                             size=args.requests))
-    t0 = time.monotonic()
-    reqs = []
-    for i in range(args.requests):
-        rid = rid_base + i
-        plen = int(rng.integers(p_lo, p_hi + 1))
-        arrival = t0 + float(arrivals[i])
-        reqs.append(Request(
-            rid=rid,
-            prompt=tuple(int(x) for x in rng.integers(
-                0, args.vocab, size=plen)),
-            max_new_tokens=args.max_new_tokens,
-            eos_token=args.eos_token,
-            arrival=arrival,
-            deadline=(arrival + args.deadline_slack_s
-                      if args.deadline_slack_s > 0 else None),
-            submitted_at=arrival))
+    traced = None
+    stress_ledger = None
+    pickup = None
+    if args.load == "trace":
+        # the stress-plane workload (serving/loadgen.py): seeded
+        # heavy-tailed lengths, the --arrival-curve shape, a tenant
+        # population with shared prefixes and slow clients. Arrival
+        # OFFSETS generate here; the trace anchors to the live clock
+        # AFTER engine construction, so compile time never pollutes
+        # the coordinated-omission-safe latency samples.
+        from akka_allreduce_tpu.serving import (LatencyLedger,
+                                                PickupBuffer,
+                                                TenantSpec, TraceConfig,
+                                                generate_trace)
+        tenants = tuple(TenantSpec(
+            f"tenant{ti}",
+            prefix_len=args.prefix_len if ti == 0 else 0,
+            prefix_ratio=args.prefix_ratio,
+            slow_client_ratio=(args.slow_client_ratio
+                               if ti == args.tenant_count - 1
+                               else 0.0),
+            pickup_delay_s=args.pickup_delay,
+            deadline_slack_s=args.deadline_slack_s,
+            seed=ti) for ti in range(args.tenant_count))
+        try:
+            traced = generate_trace(TraceConfig(
+                seed=args.seed, n_requests=args.requests,
+                rate=args.arrival_rate, arrival=args.arrival_curve,
+                vocab=args.vocab, max_prompt=p_hi,
+                max_new_tokens=args.max_new_tokens,
+                eos_token=args.eos_token, tenants=tenants),
+                rid_base=rid_base)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        reqs = [tr.req for tr in traced]
+        stress_ledger = LatencyLedger()
+        if any(tr.pickup_delay_s > 0 for tr in traced):
+            pickup = PickupBuffer(capacity=args.pickup_capacity)
+    else:
+        arrivals = np.zeros(args.requests)
+        if args.load == "open":
+            arrivals = np.cumsum(rng.exponential(
+                1.0 / args.arrival_rate, size=args.requests))
+        t0 = time.monotonic()
+        reqs = []
+        for i in range(args.requests):
+            rid = rid_base + i
+            plen = int(rng.integers(p_lo, p_hi + 1))
+            arrival = t0 + float(arrivals[i])
+            reqs.append(Request(
+                rid=rid,
+                prompt=tuple(int(x) for x in rng.integers(
+                    0, args.vocab, size=plen)),
+                max_new_tokens=args.max_new_tokens,
+                eos_token=args.eos_token,
+                arrival=arrival,
+                deadline=(arrival + args.deadline_slack_s
+                          if args.deadline_slack_s > 0 else None),
+                submitted_at=arrival))
 
     from akka_allreduce_tpu.runtime.tracing import Tracer
 
@@ -3755,6 +4165,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             metrics = FleetMetrics(args.replicas, tracer=tracer)
         else:
             metrics = ServingMetrics(tracer=tracer)
+        if traced is not None:
+            # the CO-safe latency ledger + slow-client pickup buffer
+            # tap the metrics hooks transparently (loadgen.py
+            # hook_metrics). Wrapped BEFORE engine/router wiring so
+            # every sink the fleet hands out is the tapped one.
+            from akka_allreduce_tpu.serving import hook_metrics
+            metrics = hook_metrics(
+                metrics, stress_ledger, pickup,
+                {tr.req.rid: tr.pickup_delay_s for tr in traced})
         if args.metrics_port is not None:
             server = stack.enter_context(
                 metrics.registry.serve_http(port=args.metrics_port))
@@ -3835,7 +4254,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     decode_steps=args.decode_steps,
                     watchdog_timeout_s=args.watchdog_timeout,
                     paged=args.paged, page_size=args.page_size,
-                    num_pages=args.num_pages)
+                    num_pages=args.num_pages,
+                    temperature=args.temperature, top_k=args.top_k,
+                    top_p=args.top_p,
+                    kv_dtype="int8" if args.kv_cache == "int8"
+                    else None)
                 supervisor = stack.enter_context(ReplicaSupervisor(
                     spec, replicas=args.replicas,
                     backoff=BackoffPolicy(base_s=args.backoff_base),
@@ -3877,6 +4300,31 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 # depth bound at arrival time, so future-dated submits
                 # below never reject here)
                 on_reject=metrics.on_reject)
+            # admission economics (ISSUE 12, serving/admission.py):
+            # per-tenant token buckets + EDF pricing + the overload
+            # controller, consulted inside pop_ready — identical for
+            # the single engine, the in-process fleet and the
+            # subprocess fabric (one shared scheduler admits for all)
+            admission = None
+            if tenant_budget is not None or args.overload_backlog_s > 0 \
+                    or args.edf_admission:
+                from akka_allreduce_tpu.serving import (
+                    AdmissionConfig, AdmissionController, TenantBudget)
+                admission = AdmissionController(
+                    AdmissionConfig(
+                        default_budget=(TenantBudget(*tenant_budget)
+                                        if tenant_budget else None),
+                        tpot_estimate=args.tpot_estimate,
+                        overload_backlog_s=args.overload_backlog_s,
+                        edf_admission=args.edf_admission),
+                    slots=args.replicas * args.slots,
+                    clock=sched.clock)
+                sched.admission = admission
+                metrics.attach_admission(admission)
+            if pickup is not None:
+                # slow readers stall ADMISSION (the bounded completion
+                # buffer), through the same edge every other gate uses
+                sched.admit_gate = pickup.admit_ok
             router = None
             if args.replicas > 1 or supervisor is not None:
                 from akka_allreduce_tpu.serving import (ReplicaRouter,
@@ -3898,6 +4346,13 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         if resumed:
             print(f"restoring {len(resumed)} drained request(s) "
                   f"from {args.drain_dir}", file=sys.stderr)
+        if traced is not None:
+            # anchor the trace's relative offsets to the live clock
+            # only now — engines are built, programs are compiling on
+            # warmup, and the open-loop schedule starts HERE
+            from akka_allreduce_tpu.serving import anchor_trace
+            anchor_trace(traced, time.monotonic())
+            stress_ledger.schedule_trace(traced)
         for r in reqs:
             metrics.on_submit(r.rid)
             try:
@@ -4008,6 +4463,23 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         "resumed": len(resumed),
         "drain_persisted": (len(drained) if drain_path else 0),
     }
+    if traced is not None:
+        # the stress-plane story: the trace's shape, CO-safe vs naive
+        # latency (measured from the SCHEDULED arrival vs the admit
+        # instant — the divergence IS the queue delay coordinated
+        # omission would hide), sheds by reason, and the slow-client
+        # backpressure counters
+        from akka_allreduce_tpu.serving import trace_summary
+        common["stress"] = {
+            "arrival_curve": args.arrival_curve,
+            "trace": trace_summary(traced),
+            **stress_ledger.summary(),
+            "blocked_on_client": sched.blocked_on_client,
+            **({"pickup": {"picked_up": pickup.picked_up,
+                           "blocked_polls": pickup.blocked_polls,
+                           "waiting": pickup.waiting}}
+               if pickup is not None else {}),
+        }
     if router is not None:
         # the FLEET report: router semantics (hedge/lag/retirement) +
         # fleet-merged metrics; per-replica engine counters ride in a
@@ -4055,6 +4527,125 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     print(json.dumps(report))
     return 0
 
+
+
+def _add_stress(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "stress", help="fleet overload sweep (ISSUE 12): drive the "
+        "seeded stress trace open-loop through the replica fleet at "
+        "increasing arrival rates with admission economics armed, "
+        "find the goodput knee, and emit the goodput-vs-p99 knee "
+        "curve (bench.measure_fleet_stress) — the capture that banks "
+        "perf_capture/fleet_stress.json")
+    # default mirrors bench.STRESS_RATES so a re-bank through this
+    # command sweeps the SAME range perfgate's fresh re-measure does
+    p.add_argument("--rates", default="8,16,32,64,128,256",
+                   help="comma list of mean arrival rates (req/s) to "
+                        "sweep, increasing; the top rate should sit "
+                        ">= 2x past the expected knee or the plateau "
+                        "claim has nothing to plateau over")
+    p.add_argument("--requests", type=int, default=40,
+                   help="trace length per rate point (one seeded "
+                        "trace serves every point — only the arrival "
+                        "schedule compresses)")
+    p.add_argument("--slots", type=int, default=2,
+                   help="decode slots per replica")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="in-process engine replicas behind the router")
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--d-ff", type=int, default=1024)
+    p.add_argument("--vocab", type=int, default=1024)
+    p.add_argument("--overload-backlog-s", type=float, default=0.5,
+                   help="overload controller bound: shed queue "
+                        "victims by policy once the estimated drain "
+                        "time exceeds this (priced at the calibrated "
+                        "tpot)")
+    p.add_argument("--tenant-budget", default="30:60",
+                   metavar="RATE:BURST",
+                   help="the metered 'free' tenant's token bucket "
+                        "(the other tenants run unmetered); empty = "
+                        "no budgets")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="write the capture-style JSON document "
+                        "(section fleet_stress) here — e.g. "
+                        "perf_capture/fleet_stress.json; stdout gets "
+                        "the rows either way")
+    _add_backend_args(p)
+
+
+def _cmd_stress(args: argparse.Namespace) -> int:
+    _apply_backend_flags(args)
+    try:
+        rates = tuple(float(r) for r in args.rates.split(",")
+                      if r.strip())
+    except ValueError:
+        print(f"error: bad --rates {args.rates!r} (want a comma list "
+              f"of numbers)", file=sys.stderr)
+        return 2
+    if len(rates) < 2 or list(rates) != sorted(rates):
+        print(f"error: --rates must be an increasing sweep of >= 2 "
+              f"points, got {args.rates!r}", file=sys.stderr)
+        return 2
+    try:
+        budget = _parse_tenant_budget(args.tenant_budget)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    import jax
+
+    from akka_allreduce_tpu.bench import measure_fleet_stress
+    kw = {}
+    if budget is not None:
+        kw = {"budget_tokens_per_s": budget[0],
+              "budget_burst": budget[1]}
+    else:
+        # unmetered: an effectively infinite bucket (the controller
+        # still runs, the overload policy still sheds)
+        kw = {"budget_tokens_per_s": 1e9, "budget_burst": 1e9}
+    try:
+        rows = measure_fleet_stress(
+            d_model=args.d_model, n_layers=args.n_layers,
+            d_ff=args.d_ff, vocab=args.vocab,
+            n_requests=args.requests, slots=args.slots,
+            n_replicas=args.replicas, rates=rates,
+            overload_backlog_s=args.overload_backlog_s,
+            seed=args.seed, **kw)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    for row in rows:
+        print(json.dumps(row))
+    if args.out:
+        import datetime
+        plat = jax.devices()[0].platform
+        doc = {
+            "step": "fleet_stress",
+            "section": "fleet_stress",
+            "captured_at": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            "device": plat,
+            "cmd": "python -m akka_allreduce_tpu.cli stress"
+                   + (f" --rates {args.rates}"
+                      if args.rates != "8,16,32,64,128,256" else ""),
+            "note": "open-loop fleet stress sweep "
+                    f"({args.replicas}x{args.slots} slots, "
+                    f"{args.requests}-request seeded tenant trace per "
+                    f"rate point, admission economics armed): goodput "
+                    f"and CO-safe p99 per rate, the knee, and the "
+                    f"gated fleet_stress_overload_speedup robustness "
+                    f"ratio (goodput at the top rate / at the knee)",
+            "rows": rows,
+        }
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+        os.replace(tmp, args.out)
+        print(f"banked -> {args.out}", file=sys.stderr)
+    return 0
 
 
 def _add_lint(sub: argparse._SubParsersAction) -> None:
@@ -4413,6 +5004,7 @@ def main(argv: list[str] | None = None) -> int:
     _add_train(sub)
     _add_generate(sub)
     _add_serve(sub)
+    _add_stress(sub)
     _add_eval(sub)
     _add_lint(sub)
     _add_perfgate(sub)
@@ -4439,6 +5031,7 @@ def main(argv: list[str] | None = None) -> int:
     return {"emulate": _cmd_emulate, "master": _cmd_master,
             "worker": _cmd_worker, "train": _cmd_train,
             "generate": _cmd_generate, "serve": _cmd_serve,
+            "stress": _cmd_stress,
             "eval": _cmd_eval, "lint": _cmd_lint,
             "perfgate": _cmd_perfgate,
             "replica-worker": _cmd_replica_worker,

@@ -47,13 +47,16 @@ MSG_CANCEL = 13
 # The serving-frame wire format version. Bumped whenever any serving
 # frame's layout changes (v2 added the version byte itself, the
 # ``replica`` field on CompletionFrame, and the supervisor frames
-# 9-13). Every serving frame carries this byte right after its message
+# 9-13; v3 added CompletionFrame.waste — the cancelled-hedge-loser
+# discard count the router's accounting was previously blind to — and
+# HealthFrame.cancelled_tokens, its cumulative worker-side mirror).
+# Every serving frame carries this byte right after its message
 # type, and decode refuses a mismatch with a readable error instead of
 # mis-parsing a peer running different code — the failure mode of a
 # rolling fleet upgrade where router and replica briefly disagree.
 # The allreduce frames (0-6) predate versioning and stay unversioned:
 # the training plane's processes are always launched as one build.
-SERVING_WIRE_VERSION = 2
+SERVING_WIRE_VERSION = 3
 
 _SERVING_MSG_TYPES = frozenset({
     MSG_SUBMIT, MSG_COMPLETION, MSG_HEALTH, MSG_DRAIN, MSG_RESUME,
@@ -187,12 +190,21 @@ class CompletionFrame:
     frames land on the supervisor's one inbound handler, and with
     hedged dispatch the same rid is legitimately in flight on two
     replicas — the router must unbind the copy that actually finished.
-    -1 (the in-process default) means "caller knows the source"."""
+    -1 (the in-process default) means "caller knows the source".
 
-    __slots__ = ("rid", "tokens", "reason", "replica")
+    ``waste`` (wire v3) rides the ``reason="cancelled"`` acknowledgment
+    a worker sends back for every CancelFrame: the decode tokens the
+    worker's engine discarded for that rid. Before v3 a remote hedge
+    loser's waste was charged 0 on the router side (it lived only in
+    the worker's own counters) and the fleet's hedge-waste totals
+    silently disagreed between ``--replica-mode inprocess`` and
+    ``subprocess``; the ack makes the router-side ledger exact. 0 on
+    every other reason."""
+
+    __slots__ = ("rid", "tokens", "reason", "replica", "waste")
 
     def __init__(self, rid: int, tokens, reason: str,
-                 replica: int = -1):
+                 replica: int = -1, waste: int = 0):
         self.rid = rid
         self.tokens = tuple(int(t) for t in tokens)
         if len(reason.encode()) > 255:
@@ -201,8 +213,11 @@ class CompletionFrame:
             # not a struct.error at dispatch
             raise ValueError(
                 f"CompletionFrame reason exceeds 255 bytes: {reason[:40]!r}...")
+        if waste < 0:
+            raise ValueError(f"waste must be >= 0, got {waste}")
         self.reason = reason
         self.replica = replica
+        self.waste = waste
 
     def __repr__(self) -> str:
         return (f"CompletionFrame(rid={self.rid}, "
@@ -230,12 +245,13 @@ class HealthFrame:
 
     __slots__ = ("replica", "occupied", "free_slots", "dispatches",
                  "compiles", "draining", "watchdog_trips",
-                 "evictions", "prefill_programs")
+                 "evictions", "prefill_programs", "cancelled_tokens")
 
     def __init__(self, replica: int, occupied: int, free_slots: int,
                  dispatches: int, compiles: int = 0,
                  draining: bool = False, watchdog_trips: int = 0,
-                 evictions: int = 0, prefill_programs: int = 0):
+                 evictions: int = 0, prefill_programs: int = 0,
+                 cancelled_tokens: int = 0):
         self.replica = replica
         self.occupied = occupied
         self.free_slots = free_slots
@@ -245,6 +261,11 @@ class HealthFrame:
         self.watchdog_trips = watchdog_trips
         self.evictions = evictions
         self.prefill_programs = prefill_programs
+        # wire v3: cumulative decode tokens this worker's engine
+        # discarded for CancelFrames — the supervisor-side triage
+        # mirror of the per-cancel ``waste`` acks (OPERATIONS.md
+        # "Hedging economics"; the two must reconcile)
+        self.cancelled_tokens = cancelled_tokens
 
     def __repr__(self) -> str:
         return (f"HealthFrame(replica={self.replica}, "
@@ -489,17 +510,19 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
     if isinstance(msg, CompletionFrame):
         tokens = np.asarray(msg.tokens, dtype=np.int32).tobytes()
         reason = msg.reason.encode()
-        return (struct.pack("<BBqiBI", MSG_COMPLETION,
+        return (struct.pack("<BBqiIBI", MSG_COMPLETION,
                             SERVING_WIRE_VERSION, msg.rid, msg.replica,
+                            msg.waste,
                             len(reason), len(msg.tokens))
                 + reason + tokens)
     if isinstance(msg, HealthFrame):
-        return struct.pack("<BBiIIQQIIIB", MSG_HEALTH,
+        return struct.pack("<BBiIIQQIIIQB", MSG_HEALTH,
                            SERVING_WIRE_VERSION, msg.replica,
                            msg.occupied, msg.free_slots,
                            msg.dispatches, msg.compiles,
                            msg.watchdog_trips, msg.evictions,
                            msg.prefill_programs,
+                           msg.cancelled_tokens,
                            1 if msg.draining else 0)
     if isinstance(msg, DrainFrame):
         return struct.pack("<BB", MSG_DRAIN, SERVING_WIRE_VERSION)
@@ -619,11 +642,11 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
                            attempts=attempts,
                            seed=seed if has_seed else None)
     if mtype == MSG_COMPLETION:
-        _need(buf, off, struct.calcsize("<qiBI"),
+        _need(buf, off, struct.calcsize("<qiIBI"),
               "CompletionFrame header")
-        rid, replica, rlen, n_tokens = struct.unpack_from("<qiBI",
-                                                          buf, off)
-        off += struct.calcsize("<qiBI")
+        (rid, replica, waste, rlen,
+         n_tokens) = struct.unpack_from("<qiIBI", buf, off)
+        off += struct.calcsize("<qiIBI")
         _need(buf, off, rlen + 4 * n_tokens,
               f"{rlen}-byte reason + {n_tokens} tokens")
         reason = buf[off:off + rlen].decode()
@@ -631,19 +654,20 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
         tokens = np.frombuffer(buf, dtype=np.int32, count=n_tokens,
                                offset=off)
         return CompletionFrame(rid=rid, tokens=tokens, reason=reason,
-                               replica=replica)
+                               replica=replica, waste=waste)
     if mtype == MSG_HEALTH:
-        _need(buf, off, struct.calcsize("<iIIQQIIIB"),
+        _need(buf, off, struct.calcsize("<iIIQQIIIQB"),
               "HealthFrame body")
         (replica, occupied, free_slots, dispatches, compiles, trips,
-         evictions, prefill_programs,
-         draining) = struct.unpack_from("<iIIQQIIIB", buf, off)
+         evictions, prefill_programs, cancelled_tokens,
+         draining) = struct.unpack_from("<iIIQQIIIQB", buf, off)
         return HealthFrame(replica=replica, occupied=occupied,
                            free_slots=free_slots,
                            dispatches=dispatches, compiles=compiles,
                            draining=bool(draining),
                            watchdog_trips=trips, evictions=evictions,
-                           prefill_programs=prefill_programs)
+                           prefill_programs=prefill_programs,
+                           cancelled_tokens=cancelled_tokens)
     if mtype == MSG_DRAIN:
         return DrainFrame()
     if mtype == MSG_CANCEL:

@@ -49,6 +49,15 @@ Modules:
   and failover that requeues a failed replica's in-flight requests
   (or migrates a preempted replica's drain snapshots) onto healthy
   replicas with bitwise-parity continuation.
+* ``loadgen.py`` / ``admission.py`` — the STRESS + ECONOMICS plane
+  (ISSUE 12): seeded trace-driven workloads (heavy-tailed lengths,
+  diurnal/burst arrival curves, tenant mixes with shared prefixes,
+  slow clients) with coordinated-omission-safe latency accounting,
+  and per-tenant token-bucket budgets + EDF pricing + the overload
+  controller that turns saturation into policy sheds
+  (``shed_budget``/``shed_overload``) instead of queue collapse —
+  the reference's partial-completion philosophy at the admission
+  edge.
 
 Failure domains (ISSUE 5 — the paper's "complete the round without the
 missing contribution", pointed at serving): a hung dispatch trips the
@@ -65,6 +74,12 @@ plane (runtime/faults.py) in tests/test_serving_faults.py and
 Entry point: ``python -m akka_allreduce_tpu.cli serve`` (cli.py).
 """
 
+from akka_allreduce_tpu.serving.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    TenantBudget,
+    TokenBucket,
+)
 from akka_allreduce_tpu.serving.engine import (
     EngineConfig,
     PagedEngineConfig,
@@ -78,6 +93,18 @@ from akka_allreduce_tpu.serving.engine import (
     load_drained,
     persist_drained,
     serve_loop,
+)
+from akka_allreduce_tpu.serving.loadgen import (
+    LatencyLedger,
+    PickupBuffer,
+    TenantSpec,
+    TraceConfig,
+    TracedRequest,
+    anchor_trace,
+    find_knee,
+    generate_trace,
+    hook_metrics,
+    trace_summary,
 )
 from akka_allreduce_tpu.serving.metrics import (
     FleetMetrics,
@@ -104,6 +131,20 @@ from akka_allreduce_tpu.serving.supervisor import (
 from akka_allreduce_tpu.serving.worker import ReplicaSpec
 
 __all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "TenantBudget",
+    "TokenBucket",
+    "LatencyLedger",
+    "PickupBuffer",
+    "TenantSpec",
+    "TraceConfig",
+    "TracedRequest",
+    "anchor_trace",
+    "find_knee",
+    "generate_trace",
+    "hook_metrics",
+    "trace_summary",
     "AdmitPlan",
     "PagePool",
     "PagedEngineConfig",

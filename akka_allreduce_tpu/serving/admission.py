@@ -1,0 +1,447 @@
+"""Admission economics: token budgets, EDF pricing, overload policy.
+
+The reference's defining idea is that PARTIAL COMPLETION is a priced,
+first-class outcome — ``th`` accepts a round without its stragglers,
+``maxLag`` bounds how stale a member may run (PAPER.md §1). PR 8/11
+applied those dials to replicas; this module applies the philosophy to
+ADMISSION: under overload, the fleet does not queue without bound
+(latency collapse), OOM (paged admission already prevents that), or
+drop arbitrarily (fairness collapse) — it sheds by an explicit,
+auditable policy, and every shed is a terminal record with a priced
+reason:
+
+* ``shed_budget`` — the request's TENANT is over its token budget: a
+  per-tenant :class:`TokenBucket` (capacity ``burst_tokens``, refill
+  ``tokens_per_s``) is charged the request's PRICE — prompt tokens plus
+  the full decode budget, ``price() = len(prompt) + max_new_tokens`` —
+  at admission. A tenant can never overdraw by more than one request's
+  price (the bucket is checked before spending), which is the
+  "budgets respected within one request's tokens" contract the stress
+  selfcheck pins.
+* ``shed_overload`` — the fleet-protection verdict, two forms: (a) the
+  EDF admission check: a deadline-carrying request whose earliest
+  possible start (behind the queued work with earlier deadlines, at
+  ``tpot_estimate`` seconds/token across ``slots`` lanes) leaves no
+  room to decode even ``min_useful_tokens`` before its deadline is
+  shed at pop — queue-aware, strictly stronger than the PR 5 solo
+  ``rejected_infeasible`` check; (b) the overload controller: when the
+  live queue's estimated drain time exceeds ``overload_backlog_s``,
+  victims are shed from the queue BY POLICY until the backlog fits —
+  over-budget tenants first across tenants, most-expensive-first
+  within a tenant (equivalently: the cheapest feasible requests are
+  kept — under overload, goodput-per-token is the objective, and many
+  small completions beat one giant one).
+
+Wiring: :class:`~akka_allreduce_tpu.serving.scheduler.RequestScheduler`
+takes a controller at construction and consults it inside
+``pop_ready`` — which means the economics work IDENTICALLY for the
+single-engine serve_loop, the in-process :class:`ReplicaRouter` fleet
+and the subprocess fabric, because all three admit through the same
+scheduler. Sheds travel the existing ``drain_dropped`` terminal-record
+path (one terminal status per request, reconciled in the ledger
+identity); nothing here is a retry.
+
+Observability: every counter the controller keeps is exported through
+``ServingMetrics.attach_admission`` / ``FleetMetrics.attach_admission``
+as ``serve_admission_*`` (controller scope) and ``serve_tenant_*``
+(per-tenant labeled) pull collectors reading the SAME cells
+``summary()`` renders — scrape == summary by construction, asserted by
+``serve --selfcheck --stress``.
+
+Pure host Python, fake-clock testable, no jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+DEFAULT_TENANT = "default"
+
+# the two priced shed reasons (terminal statuses, next to the
+# scheduler's dead_letter / rejected_infeasible)
+SHED_BUDGET = "shed_budget"
+SHED_OVERLOAD = "shed_overload"
+
+
+def price(req) -> int:
+    """A request's token price: prompt (prefill work) plus the FULL
+    decode budget. Priced at the budget, not the realized length —
+    admission happens before anyone knows where the EOS lands, and a
+    budget is what the tenant asked to reserve."""
+    return len(req.prompt) + req.max_new_tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantBudget:
+    """One tenant's token-bucket contract: sustained ``tokens_per_s``
+    with ``burst_tokens`` of headroom. The bucket starts full."""
+
+    tokens_per_s: float
+    burst_tokens: float
+
+    def __post_init__(self):
+        if self.tokens_per_s < 0:
+            raise ValueError(f"tokens_per_s must be >= 0, got "
+                             f"{self.tokens_per_s}")
+        if self.burst_tokens < 1:
+            raise ValueError(f"burst_tokens must be >= 1, got "
+                             f"{self.burst_tokens}")
+
+
+class TokenBucket:
+    """Continuous-refill token bucket, deterministic given a clock."""
+
+    def __init__(self, budget: TenantBudget, clock=time.monotonic):
+        self.budget = budget
+        self.clock = clock
+        self.level = float(budget.burst_tokens)
+        self._last = clock()
+
+    def _refill(self, now: float) -> None:
+        dt = now - self._last
+        if dt > 0:
+            self.level = min(self.budget.burst_tokens,
+                             self.level + dt * self.budget.tokens_per_s)
+        self._last = now
+
+    def peek(self, now: Optional[float] = None) -> float:
+        self._refill(self.clock() if now is None else now)
+        return self.level
+
+    def spend(self, cost: float, now: Optional[float] = None) -> bool:
+        """Charge ``cost`` if the bucket covers it; a tenant can never
+        overdraw by more than one request (checked-then-spent)."""
+        self._refill(self.clock() if now is None else now)
+        if cost > self.level:
+            return False
+        self.level -= cost
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+    """The economics dials.
+
+    ``budgets`` maps tenant name -> :class:`TenantBudget`;
+    ``default_budget`` covers tenants not named (None = unmetered).
+    ``tpot_estimate`` (seconds/token) prices time — it feeds both the
+    EDF start estimate and the overload backlog estimate; 0 disables
+    both time-based checks (budgets still apply).
+    ``overload_backlog_s``: shed queue victims once the estimated
+    drain time of the live queue exceeds this; 0 disables the sweep.
+    ``edf_admission``: arm the queue-aware deadline feasibility check.
+    ``min_useful_tokens``: the smallest decode worth starting — the
+    EDF check's partial-completion floor (the reference's th dial
+    pointed at a single request's budget)."""
+
+    budgets: "dict[str, TenantBudget]" = dataclasses.field(
+        default_factory=dict)
+    default_budget: Optional[TenantBudget] = None
+    tpot_estimate: float = 0.0
+    overload_backlog_s: float = 0.0
+    edf_admission: bool = False
+    min_useful_tokens: int = 1
+
+    def __post_init__(self):
+        if self.tpot_estimate < 0:
+            raise ValueError(f"tpot_estimate must be >= 0, got "
+                             f"{self.tpot_estimate}")
+        if self.overload_backlog_s < 0:
+            raise ValueError(f"overload_backlog_s must be >= 0, got "
+                             f"{self.overload_backlog_s}")
+        if self.min_useful_tokens < 1:
+            raise ValueError(f"min_useful_tokens must be >= 1, got "
+                             f"{self.min_useful_tokens}")
+        if self.edf_admission and self.tpot_estimate == 0:
+            raise ValueError("edf_admission needs tpot_estimate > 0 "
+                             "(a start estimate needs a token cost)")
+
+
+class _TenantLedger:
+    """Per-tenant counters — the cells both summary() and the
+    serve_tenant_* pull collectors read."""
+
+    __slots__ = ("admitted", "shed_budget", "shed_overload",
+                 "tokens_spent")
+
+    def __init__(self):
+        self.admitted = 0
+        self.shed_budget = 0
+        self.shed_overload = 0
+        self.tokens_spent = 0
+
+
+class AdmissionController:
+    """The scheduler's economics oracle (see module docstring).
+
+    ``slots`` is the fleet's total lane count (replicas x slots) — the
+    service-rate denominator for the EDF start estimate and the
+    backlog bound. ``clock`` is injectable for fake-clock tests and is
+    normally the SCHEDULER's clock (one clock domain for arrival,
+    admission and refill)."""
+
+    def __init__(self, cfg: AdmissionConfig, slots: int = 1,
+                 clock=time.monotonic):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.cfg = cfg
+        self.slots = slots
+        self.clock = clock
+        self._buckets: "dict[str, Optional[TokenBucket]]" = {}
+        self._tenants: "dict[str, _TenantLedger]" = {}
+        # controller-scope counters
+        self.admitted_total = 0
+        self.shed_budget_total = 0
+        self.shed_overload_total = 0
+        self.tokens_spent_total = 0
+        self.overload_sweeps = 0      # sweeps that shed at least once
+        self.overloaded = False       # last sweep's verdict (gauge)
+        # lazy per-tenant series registration (attach_registry)
+        self._registry = None
+        self._labels: dict = {}
+        for name in cfg.budgets:
+            self._ensure_tenant(name)
+        self._ensure_tenant(DEFAULT_TENANT)
+
+    # -- tenant bookkeeping ---------------------------------------------
+
+    def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        if tenant not in self._buckets:
+            budget = self.cfg.budgets.get(tenant,
+                                          self.cfg.default_budget)
+            self._buckets[tenant] = (
+                TokenBucket(budget, clock=self.clock)
+                if budget is not None else None)
+        return self._buckets[tenant]
+
+    def _ensure_tenant(self, tenant: str) -> _TenantLedger:
+        led = self._tenants.get(tenant)
+        if led is None:
+            led = self._tenants[tenant] = _TenantLedger()
+            if self._registry is not None:
+                self._register_tenant(tenant)
+        return led
+
+    def tenants(self) -> "list[str]":
+        return sorted(self._tenants)
+
+    @staticmethod
+    def tenant_of(req) -> str:
+        return req.tenant or DEFAULT_TENANT
+
+    # -- the scheduler-facing verdicts ----------------------------------
+
+    def _edf_infeasible(self, req, now: float, queued) -> bool:
+        """Queue-aware EDF feasibility: can this request still decode
+        ``min_useful_tokens`` before its deadline, starting after the
+        queued work that outranks it (earlier deadline) drains through
+        ``slots`` lanes at ``tpot_estimate``? Deadline-less requests
+        are always feasible (nothing to miss)."""
+        if not self.cfg.edf_admission or req.deadline is None:
+            return False
+        tpot = self.cfg.tpot_estimate
+        ahead = sum(
+            r.max_new_tokens - 0 for r in queued
+            if r.deadline is not None and r.deadline <= req.deadline)
+        start = now + ahead * tpot / self.slots
+        return start + self.cfg.min_useful_tokens * tpot > req.deadline
+
+    def charge(self, req, now: float, queued=()) -> Optional[str]:
+        """Price one request at admission: None = admitted (budget
+        spent), else the shed reason. Called by ``pop_ready`` for
+        fresh requests only — a retry keeps the admission it paid."""
+        tenant = self.tenant_of(req)
+        led = self._ensure_tenant(tenant)
+        if self._edf_infeasible(req, now, queued):
+            led.shed_overload += 1
+            self.shed_overload_total += 1
+            return SHED_OVERLOAD
+        cost = price(req)
+        bucket = self._bucket_for(tenant)
+        if bucket is not None and not bucket.spend(cost, now):
+            led.shed_budget += 1
+            self.shed_budget_total += 1
+            return SHED_BUDGET
+        led.admitted += 1
+        led.tokens_spent += cost
+        self.admitted_total += 1
+        self.tokens_spent_total += cost
+        return None
+
+    def _backlog_tokens(self, queued) -> int:
+        return sum(price(r) for r in queued)
+
+    def _bound_tokens(self, num_slots: Optional[int]) -> float:
+        slots = self.slots if num_slots is None else num_slots
+        return self.cfg.overload_backlog_s * slots \
+            / self.cfg.tpot_estimate
+
+    @property
+    def sweep_armed(self) -> bool:
+        """True when the backlog-bound overload sweep is configured
+        (both the bound and the token time-price are set)."""
+        return (self.cfg.overload_backlog_s > 0
+                and self.cfg.tpot_estimate > 0)
+
+    def check_overloaded(self, backlog_tokens: float,
+                         num_slots: Optional[int] = None) -> bool:
+        """O(1) overload verdict from a precomputed backlog total —
+        the scheduler maintains the live queue's running token price
+        so the per-poll check never walks the queue. Updates the
+        ``overloaded`` gauge; True means a sweep is worth running."""
+        if not self.sweep_armed or backlog_tokens <= 0:
+            self.overloaded = False
+            return False
+        self.overloaded = backlog_tokens > self._bound_tokens(num_slots)
+        return self.overloaded
+
+    def overload_victims(self, queued, now: float,
+                         num_slots: Optional[int] = None,
+                         backlog: Optional[float] = None) -> list:
+        """The overload sweep: victims to shed (``shed_overload``)
+        until the live queue's estimated drain time fits
+        ``overload_backlog_s``. Victim ORDER is the policy: requests
+        of over-budget tenants first (they are already outside their
+        contract — shedding them first is the fairness rule), then
+        most-expensive-first within the remaining pool (keeping the
+        cheapest feasible requests maximizes completions per token —
+        goodput economics under saturation). Retried requests are
+        never victims. Returns the victim Requests; the scheduler
+        removes them and writes the terminal records. ``backlog`` is
+        the caller's precomputed queue token total (the scheduler's
+        running sum); None re-sums ``queued`` here."""
+        if not self.sweep_armed or not queued:
+            self.overloaded = False
+            return []
+        bound_tokens = self._bound_tokens(num_slots)
+        if backlog is None:
+            backlog = self._backlog_tokens(queued)
+        self.overloaded = backlog > bound_tokens
+        if not self.overloaded:
+            return []
+        candidates = [r for r in queued if r.attempts == 0]
+
+        def over_budget(r) -> bool:
+            b = self._bucket_for(self.tenant_of(r))
+            return b is not None and b.peek(now) < price(r)
+
+        ranked = sorted(
+            candidates,
+            key=lambda r: (0 if over_budget(r) else 1,
+                           -price(r), r.rid))
+        victims = []
+        for r in ranked:
+            if backlog <= bound_tokens:
+                break
+            victims.append(r)
+            backlog -= price(r)
+            led = self._ensure_tenant(self.tenant_of(r))
+            led.shed_overload += 1
+            self.shed_overload_total += 1
+        if victims:
+            self.overload_sweeps += 1
+        return victims
+
+    # -- observability ---------------------------------------------------
+
+    def bucket_level(self, tenant: str) -> Optional[float]:
+        b = self._bucket_for(tenant)
+        return None if b is None else b.peek()
+
+    def summary(self) -> dict:
+        """The ``admission`` block of the serve summary — the same
+        cells the serve_admission_* / serve_tenant_* collectors pull,
+        so scrape == summary holds by construction."""
+        tenants = {}
+        for name in self.tenants():
+            led = self._tenants[name]
+            lvl = self.bucket_level(name)
+            tenants[name] = {
+                "admitted": led.admitted,
+                "shed_budget": led.shed_budget,
+                "shed_overload": led.shed_overload,
+                "tokens_spent": led.tokens_spent,
+                **({"bucket_level": round(lvl, 1)}
+                   if lvl is not None else {}),
+            }
+        return {
+            "admitted_total": self.admitted_total,
+            "shed_budget_total": self.shed_budget_total,
+            "shed_overload_total": self.shed_overload_total,
+            "tokens_spent_total": self.tokens_spent_total,
+            "overload_sweeps": self.overload_sweeps,
+            "overloaded": self.overloaded,
+            "tenants": tenants,
+        }
+
+    def attach_registry(self, registry, labels=None) -> None:
+        """Register the serve_admission_* / serve_tenant_* series as
+        pull collectors on a telemetry registry (normally via
+        ``ServingMetrics.attach_admission``). Tenants discovered after
+        attach register lazily — the scrape surface grows with the
+        population, never lags it."""
+        if self._registry is not None:
+            raise RuntimeError("admission already attached")
+        self._registry = registry
+        self._labels = dict(labels or {})
+        counters = (
+            ("serve_admission_admitted_total",
+             lambda: self.admitted_total,
+             "requests priced and admitted by the controller"),
+            ("serve_admission_shed_budget_total",
+             lambda: self.shed_budget_total,
+             "requests shed because their tenant's token bucket "
+             "could not cover the price"),
+            ("serve_admission_shed_overload_total",
+             lambda: self.shed_overload_total,
+             "requests shed by the overload controller (EDF "
+             "infeasibility + backlog-bound sweeps)"),
+            ("serve_admission_tokens_spent_total",
+             lambda: self.tokens_spent_total,
+             "token prices charged to tenant buckets"),
+            ("serve_admission_overload_sweeps_total",
+             lambda: self.overload_sweeps,
+             "overload sweeps that shed at least one victim"),
+        )
+        for name, pull, help_text in counters:
+            registry.register_callback(name, pull, kind="counter",
+                                       help=help_text,
+                                       labels=self._labels)
+        registry.register_callback(
+            "serve_admission_overloaded",
+            lambda: 1 if self.overloaded else 0, kind="gauge",
+            help="1 while the last sweep judged the backlog over its "
+                 "bound", labels=self._labels)
+        for tenant in self.tenants():
+            self._register_tenant(tenant)
+
+    def _register_tenant(self, tenant: str) -> None:
+        r = self._registry
+        labels = {**self._labels, "tenant": tenant}
+        led = self._tenants[tenant]
+        series = (
+            ("serve_tenant_admitted_total",
+             (lambda led=led: led.admitted), "counter",
+             "requests admitted for this tenant"),
+            ("serve_tenant_shed_budget_total",
+             (lambda led=led: led.shed_budget), "counter",
+             "this tenant's budget sheds"),
+            ("serve_tenant_shed_overload_total",
+             (lambda led=led: led.shed_overload), "counter",
+             "this tenant's overload sheds"),
+            ("serve_tenant_tokens_spent_total",
+             (lambda led=led: led.tokens_spent), "counter",
+             "token prices charged to this tenant"),
+        )
+        for name, pull, kind, help_text in series:
+            r.register_callback(name, pull, kind=kind, help=help_text,
+                                labels=labels)
+        if self._bucket_for(tenant) is not None:
+            r.register_callback(
+                "serve_tenant_bucket_level",
+                (lambda t=tenant: round(self.bucket_level(t), 1)),
+                kind="gauge", labels=labels,
+                help="current token-bucket level (burst headroom "
+                     "remaining)")

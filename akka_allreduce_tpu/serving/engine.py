@@ -2880,7 +2880,11 @@ def _req_to_json(req: Request) -> dict:
             # the sampled stream's identity: a restore in the NEXT
             # process must resume the same key schedule (None stays
             # rid-derived, which the rid already preserves)
-            "seed": req.seed}
+            "seed": req.seed,
+            # the paying party (admission economics): a restored
+            # request keeps its tenant attribution — its budget was
+            # charged in the previous life and must not re-bill
+            "tenant": req.tenant}
 
 
 def _req_from_json(d: dict) -> Request:
@@ -2895,7 +2899,7 @@ def _req_from_json(d: dict) -> Request:
                    stop_tokens=tuple(d["stop_tokens"]),
                    arrival=0.0, submitted_at=None,
                    attempts=d["attempts"],
-                   seed=d.get("seed"))
+                   seed=d.get("seed"), tenant=d.get("tenant"))
 
 
 def persist_drained(directory: str, drained, metrics=None) -> str:

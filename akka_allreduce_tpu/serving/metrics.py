@@ -120,6 +120,8 @@ class ServingMetrics:
         self._t_end: Optional[float] = None
         # paged-engine page-pool summary source (attach_paging)
         self._paging = None
+        # admission-economics controller (attach_admission)
+        self._admission = None
         # -- telemetry plane (ISSUE 6): drained-snapshot persistence
         # (the registry-owned counter the drain runbook watches)
         self._drain_persisted = self.registry.counter(
@@ -242,6 +244,19 @@ class ServingMetrics:
             self.registry.register_callback(
                 name, (lambda k=key: self._paging()[k]), kind="counter",
                 help=help_text, labels=self.labels)
+
+    # -- admission economics (ISSUE 12) --------------------------------
+
+    def attach_admission(self, controller) -> None:
+        """Register an :class:`~akka_allreduce_tpu.serving.admission
+        .AdmissionController`'s series (``serve_admission_*`` /
+        ``serve_tenant_*``) as pull collectors on this registry and
+        fold its block into ``summary()``. Scrape and summary read the
+        SAME controller cells by construction."""
+        if self._admission is not None:
+            raise RuntimeError("admission already attached")
+        self._admission = controller
+        controller.attach_registry(self.registry)
 
     # -- lifecycle hooks ----------------------------------------------
 
@@ -494,6 +509,10 @@ class ServingMetrics:
             # the page-pool story (paged engine only): the same dict
             # the registry's serve_page_* collectors read
             out["paging"] = self._paging()
+        if self._admission is not None:
+            # the admission-economics story: the same cells the
+            # serve_admission_* / serve_tenant_* collectors pull
+            out["admission"] = self._admission.summary()
         if self.wall_s is not None:
             out["wall_s"] = round(self.wall_s, 3)
             out["decode_tokens_per_s"] = round(
@@ -576,6 +595,7 @@ class FleetMetrics:
         self.replica_backoff_s = [0.0] * num_replicas
         self.replica_breaker_open = [False] * num_replicas
         self._supervisor = None   # attach_supervisor wires gauges
+        self._admission = None    # attach_admission wires economics
         # the chaos reconciliation pair at fleet scope: injected is
         # stamped from FaultPlan.fired; survived sums the replicas'
         # recovery events plus router-level survivals (preempt drains)
@@ -769,6 +789,18 @@ class FleetMetrics:
         self._record("serve_hedge_absorbed", rid=rid, replica=replica,
                      reason=reason)
 
+    def on_hedge_waste(self, rid: int, replica: int,
+                       tokens: int) -> None:
+        """Hedge-loser waste settled AFTER the cancel event (the
+        subprocess fabric's wire-v3 ack path: the router charged 0 at
+        cancel time because the discard count lived in the worker;
+        the ack carries the exact number one pump later). In-process
+        fleets charge synchronously through on_hedge_cancelled and
+        never call this."""
+        self.hedge_wasted_tokens += tokens
+        self._record("serve_hedge_waste", rid=rid, replica=replica,
+                     tokens=tokens)
+
     def on_degraded(self, replica: int, lag: int) -> None:
         self.replicas_degraded_total += 1
         self._record("serve_replica_degraded", replica=replica, lag=lag)
@@ -795,6 +827,18 @@ class FleetMetrics:
         summed into :attr:`fault_survived`."""
         self._fault_survived_fleet += 1
         self._record("serve_fault_survived", fault=kind)
+
+    # -- admission economics (ISSUE 12) ---------------------------------
+
+    def attach_admission(self, controller) -> None:
+        """Fleet-scope admission economics: one controller for the
+        whole fleet (admission happens in the shared scheduler), its
+        series on the shared registry — same contract as
+        :meth:`ServingMetrics.attach_admission`."""
+        if self._admission is not None:
+            raise RuntimeError("admission already attached")
+        self._admission = controller
+        controller.attach_registry(self.registry)
 
     # -- supervisor hooks (subprocess fabric) ---------------------------
 
@@ -919,6 +963,8 @@ class FleetMetrics:
             "slot_occupancy": self.merged("slot_occupancy").summary(
                 digits=3),
         }
+        if self._admission is not None:
+            out["admission"] = self._admission.summary()
         if self.wall_s is not None:
             out["wall_s"] = round(self.wall_s, 3)
             out["decode_tokens_per_s"] = round(

@@ -55,6 +55,8 @@ import random
 import time
 from typing import Optional
 
+from .admission import price as _price
+
 
 class QueueFull(RuntimeError):
     """Backpressure: the admission queue is at ``max_queue_depth``."""
@@ -80,6 +82,13 @@ class Request:
     (the default) derives the stream from ``rid`` — still
     deterministic per request, without the caller having to thread a
     seed. Greedy engines ignore it.
+
+    ``tenant`` names the paying party for admission economics
+    (serving/admission.py): budgets, shed ordering, and the
+    serve_tenant_* metrics key on it. None (the default) bills the
+    ``default`` tenant. An ADMISSION-plane identity: it never crosses
+    the replica wire — budgets are charged router-side, before any
+    engine sees the request.
     """
 
     rid: int
@@ -91,6 +100,7 @@ class Request:
     deadline: Optional[float] = None
     submitted_at: Optional[float] = None
     seed: Optional[int] = None
+    tenant: Optional[str] = None
     # failed-attempt count, stamped by requeue_failed — the retry
     # budget's ledger (a request enters the system with 0)
     attempts: int = 0
@@ -190,7 +200,8 @@ class RequestScheduler:
     a real open-loop server would shed it."""
 
     def __init__(self, cfg: SchedulerConfig, num_slots: int,
-                 clock=time.monotonic, sleep=time.sleep, on_reject=None):
+                 clock=time.monotonic, sleep=time.sleep, on_reject=None,
+                 admission=None, admit_gate=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.cfg = cfg
@@ -198,8 +209,30 @@ class RequestScheduler:
         self.clock = clock
         self._sleep = sleep
         self.on_reject = on_reject
+        # admission economics (serving/admission.py
+        # AdmissionController): when armed, pop_ready prices each
+        # FRESH request against its tenant's token budget
+        # (shed_budget) and the overload controller sweeps the live
+        # queue for policy victims (shed_overload) — both are terminal
+        # records through the same drain_dropped path dead letters
+        # use, so the one-terminal-per-request ledger identity holds
+        # with economics on. Retries (attempts > 0) are exempt: they
+        # paid at first admission.
+        self.admission = admission
+        # edge backpressure beyond the engine's memory gate: a
+        # callable consulted before any admission (the stress plane's
+        # slow-client PickupBuffer.admit_ok — a client that stops
+        # reading its completions must stall ADMISSION, not grow an
+        # unbounded result buffer). Same push-back semantics as
+        # pop_ready's can_admit; polls blocked here count in
+        # blocked_on_client.
+        self.admit_gate = admit_gate
         self._seq = itertools.count()
         self._arrived: list[tuple] = []  # heap of (sort_key, seq, req)
+        # running token price of the live queue (admission economics'
+        # backlog quantity), maintained at every _arrived mutation so
+        # the per-poll overload check is O(1), not O(queue)
+        self._arrived_price = 0
         self._future: list[tuple] = []   # heap of (arrival, seq, req)
         self._slots: dict[int, Request] = {}
         # decode quorum: ceil(th * slots), floored at 1 so th > 0 never
@@ -212,6 +245,11 @@ class RequestScheduler:
         # otherwise available — sustained growth means the page pool,
         # not the lane count, is the bottleneck (OPERATIONS.md)
         self.blocked_on_memory = 0
+        # admission polls where the head request waited on the CLIENT
+        # side (admit_gate False — e.g. a full slow-client pickup
+        # buffer): the reader-side backpressure signal next to
+        # blocked_on_memory's engine-side one
+        self.blocked_on_client = 0
         # -- failure plumbing (serving fault tolerance) -----------------
         self._rng = random.Random(cfg.seed)  # retry jitter
         self.retries = 0            # successful requeues
@@ -245,6 +283,7 @@ class RequestScheduler:
     def _push_arrived(self, req: Request) -> None:
         heapq.heappush(self._arrived,
                        (self._sort_key(req), next(self._seq), req))
+        self._arrived_price += _price(req)
 
     def submit(self, req: Request) -> None:
         """Enqueue. An already-arrived request that finds the live queue
@@ -308,19 +347,64 @@ class RequestScheduler:
         if now is None:
             now = self.clock()
         self._drain_arrivals(now)
+        self._overload_sweep(now)
+        if self.admit_gate is not None and self._arrived \
+                and not self.admit_gate():
+            # the edge itself is blocked (slow-client pickup buffer
+            # full): nothing admits until a reader catches up — the
+            # queue holds position, the caller keeps stepping
+            self.blocked_on_client += 1
+            return None
         while self._arrived:
             entry = heapq.heappop(self._arrived)
             req = entry[2]
+            self._arrived_price -= _price(req)
             if self._infeasible(req, now):
                 self.shed_infeasible += 1
                 self._dropped.append((req, "rejected_infeasible"))
                 continue
             if can_admit is not None and not can_admit(req):
                 heapq.heappush(self._arrived, entry)
+                self._arrived_price += _price(req)
                 self.blocked_on_memory += 1
                 return None
+            if self.admission is not None and req.attempts == 0:
+                # the queue snapshot feeds only the EDF feasibility
+                # ranking — skip the O(queue) copy when EDF is off
+                queued = ([e[2] for e in self._arrived]
+                          if self.admission.cfg.edf_admission else ())
+                reason = self.admission.charge(req, now, queued=queued)
+                if reason is not None:
+                    # a priced shed: terminal, never a retry — the
+                    # request's budget/feasibility verdict, not a
+                    # transient engine condition
+                    self._dropped.append((req, reason))
+                    continue
             return req
         return None
+
+    def _overload_sweep(self, now: float) -> None:
+        """Let the armed overload controller shed live-queue victims
+        by POLICY (serving/admission.py: cheapest-feasible-first
+        within a tenant, over-budget tenants first across tenants)
+        until the estimated backlog fits its bound. Victims become
+        ``shed_overload`` terminal records; retried requests are never
+        victims (they paid their admission)."""
+        if self.admission is None or not self.admission.check_overloaded(
+                self._arrived_price, self.num_slots):
+            return
+        victims = self.admission.overload_victims(
+            [e[2] for e in self._arrived], now, self.num_slots,
+            backlog=self._arrived_price)
+        if not victims:
+            return
+        vset = {req.rid for req in victims}
+        self._arrived = [e for e in self._arrived
+                         if e[2].rid not in vset]
+        heapq.heapify(self._arrived)
+        for req in victims:
+            self._arrived_price -= _price(req)
+            self._dropped.append((req, "shed_overload"))
 
     # -- failure handling ----------------------------------------------
 

@@ -243,6 +243,17 @@ class RemoteEngine:
         self.evictions = 0
         self._evictions_base = 0
         self._prefill_programs = 0
+        # hedge-loser waste accounting (wire v3): rids this proxy
+        # cancelled whose worker-side fate is still in flight. The
+        # worker answers every CancelFrame with a reason="cancelled"
+        # ack carrying the EXACT discard count, and a completion that
+        # raced the cancel arrives with its full token payload — both
+        # are charged to the fleet's hedge-waste ledger here, closing
+        # the "remote losers charged 0" accounting gap (ROADMAP).
+        self._cancelled_rids: set = set()
+        self.remote_cancel_waste = 0   # router-side total, this replica
+        self.worker_cancelled_tokens = 0  # worker's cumulative mirror
+        self._cancelled_base = 0
 
     # -- state the router reads ----------------------------------------
 
@@ -309,6 +320,9 @@ class RemoteEngine:
                 self.evictions,
                 self._evictions_base + msg.evictions)
             self._prefill_programs = msg.prefill_programs
+            self.worker_cancelled_tokens = max(
+                self.worker_cancelled_tokens,
+                self._cancelled_base + msg.cancelled_tokens)
             if msg.draining:
                 self._worker_draining = True
 
@@ -322,10 +336,15 @@ class RemoteEngine:
 
     def _on_incarnation(self) -> None:
         """A replacement process came up: its counters start at 0 —
-        re-anchor the monotonic mirrors."""
+        re-anchor the monotonic mirrors. Cancels in flight to the dead
+        incarnation will never be acked — their rids are forgotten
+        (the dead process's partial decode is lost work, not hedge
+        waste: nobody computed those tokens to completion)."""
         self._dispatch_base = self.decode_dispatches
         self._trips_base = self.watchdog_trips
         self._evictions_base = self.evictions
+        self._cancelled_base = self.worker_cancelled_tokens
+        self._cancelled_rids.clear()
 
     @property
     def prefill_shapes(self) -> frozenset:
@@ -385,12 +404,25 @@ class RemoteEngine:
         del self._inflight[rid]
         if self._sup.accepting(self.index):
             self._sup.send(self.index, wire.CancelFrame(rid))
+            # the discard count crosses back on the worker's
+            # reason="cancelled" ack (wire v3) — _pop_completions
+            # charges it to the fleet hedge-waste ledger when it
+            # lands. A replica we can no longer reach gets no frame
+            # and produces no waste to charge.
+            self._cancelled_rids.add(rid)
         if self.metrics is not None:
             self.metrics.on_cancel(rid)
-        # the loser's wasted decode count lives in the worker; the
-        # fabric charges 0 here (remote hedge waste is visible in the
-        # worker's own wasted-token series, not synchronously)
+        # None = "count follows asynchronously": the router charges 0
+        # now and the exact ack settles the ledger one pump later
         return None
+
+    def _charge_cancel_waste(self, rid: int, tokens: int) -> None:
+        if tokens <= 0:
+            return
+        self.remote_cancel_waste += tokens
+        fleet = getattr(self._sup, "fleet", None)
+        if fleet is not None and hasattr(fleet, "on_hedge_waste"):
+            fleet.on_hedge_waste(rid, self.index, tokens)
 
     def request_drain(self) -> None:
         if not self._drain_sent and self._sup.accepting(self.index):
@@ -456,11 +488,37 @@ class RemoteEngine:
         out = []
         while self._completions:
             frame = self._completions.popleft()
+            if frame.reason == "cancelled":
+                # the CancelFrame ack (wire v3): the worker's exact
+                # discard count for a hedge loser — settle the fleet
+                # hedge-waste ledger, never route to the router
+                self._cancelled_rids.discard(frame.rid)
+                self._charge_cancel_waste(frame.rid, frame.waste)
+                continue
             req = self._inflight.pop(frame.rid, None)
             if req is None:
+                if frame.rid in self._cancelled_rids:
+                    # a completion that raced our CancelFrame on the
+                    # wire: the worker computed the FULL payload
+                    # before the cancel landed — that compute is
+                    # hedge waste too (the ack following it will
+                    # carry waste=0). Before v3 these tokens vanished
+                    # from every ledger.
+                    self._charge_cancel_waste(frame.rid,
+                                              len(frame.tokens))
                 continue
             if self.metrics is not None:
                 if frame.reason in ("eos", "stop", "max_tokens"):
+                    # bank the delivery FIRST: decode tokens + TTFT
+                    # measured from the request's submit instant (the
+                    # scheduled arrival — queue delay included, the
+                    # coordinated-omission-safe convention) — without
+                    # this a subprocess fleet reported decode=0 and
+                    # no latency samples
+                    if req.submitted_at is not None:
+                        self.metrics.on_block_tokens(
+                            frame.rid, req.submitted_at,
+                            len(frame.tokens))
                     self.metrics.on_complete(frame.rid,
                                              len(frame.tokens),
                                              frame.reason)

@@ -83,6 +83,17 @@ class ReplicaSpec:
     paged: bool = False
     page_size: int = 8
     num_pages: int = 0
+    # -- sampling + KV format (ISSUE 12: the ReplicaSpec config gap).
+    # temperature > 0 arms the seeded per-request sampling plane
+    # (ISSUE 10): the per-request SEED travels on SubmitFrame, so a
+    # subprocess replica reproduces the exact stream an in-process
+    # engine (or bare generate(key=key(seed))) yields — pinned by
+    # tests/test_subprocess_fabric.py. kv_dtype="int8" builds the
+    # quantized KV cache; None (the default) keeps the model dtype.
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    kv_dtype: Optional[str] = None
     # -- runtime / determinism plane
     platform: Optional[str] = None
     disable_most_optimizations: Optional[bool] = None
@@ -154,15 +165,19 @@ def _build_engine(spec: ReplicaSpec):
         n_heads=spec.n_heads, n_layers=spec.n_layers, d_ff=spec.d_ff,
         max_seq=spec.max_seq)
     params = init_transformer(jax.random.key(spec.param_seed), mcfg)
+    sample_kw = dict(temperature=spec.temperature, top_k=spec.top_k,
+                     top_p=spec.top_p, kv_dtype=spec.kv_dtype)
     if spec.paged:
         ecfg = PagedEngineConfig(
             num_slots=spec.num_slots, decode_steps=spec.decode_steps,
             watchdog_timeout_s=spec.watchdog_timeout_s or None,
-            page_size=spec.page_size, num_pages=spec.num_pages)
+            page_size=spec.page_size, num_pages=spec.num_pages,
+            **sample_kw)
         return PagedServingEngine(params, mcfg, ecfg)
     ecfg = EngineConfig(
         num_slots=spec.num_slots, decode_steps=spec.decode_steps,
-        watchdog_timeout_s=spec.watchdog_timeout_s or None)
+        watchdog_timeout_s=spec.watchdog_timeout_s or None,
+        **sample_kw)
     return ServingEngine(params, mcfg, ecfg)
 
 
@@ -222,6 +237,8 @@ def run_replica_worker(spec: ReplicaSpec, connect: "tuple[str, int]",
         return None if remaining is None \
             else time.monotonic() + remaining
 
+    cancelled_tokens = 0  # cumulative CancelFrame discards (wire v3)
+
     def send_health() -> None:
         send(wire.HealthFrame(
             replica=index, occupied=engine.occupied,
@@ -230,7 +247,8 @@ def run_replica_worker(spec: ReplicaSpec, connect: "tuple[str, int]",
             compiles=compile_log.count, draining=draining,
             watchdog_trips=engine.watchdog_trips,
             evictions=engine.evictions,
-            prefill_programs=len(engine.prefill_shapes)))
+            prefill_programs=len(engine.prefill_shapes),
+            cancelled_tokens=cancelled_tokens))
 
     def send_completions(completions) -> None:
         for _slot, req, tokens, reason in completions:
@@ -274,7 +292,16 @@ def run_replica_worker(spec: ReplicaSpec, connect: "tuple[str, int]",
                         send(wire.CompletionFrame(
                             msg.rid, (), "fault", replica=index))
                 elif isinstance(msg, wire.CancelFrame):
-                    engine.cancel(msg.rid)
+                    # acknowledge with the EXACT discard count: the
+                    # router's hedge-waste ledger charges remote
+                    # losers from this ack instead of charging 0
+                    # (wire v3; None = the rid already finished here
+                    # and its completion frame carries the tokens)
+                    n = engine.cancel(msg.rid) or 0
+                    cancelled_tokens += n
+                    send(wire.CompletionFrame(
+                        msg.rid, (), "cancelled", replica=index,
+                        waste=n))
                 elif isinstance(msg, wire.DrainFrame):
                     draining = True
                 # anything else (stray Hello repeats) is ignored

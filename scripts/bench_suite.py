@@ -90,7 +90,8 @@ def main(only=None) -> int:
                 ab_bf16_cast, ab_moe_dispatch, ab_overlap, mfu_lines,
                 serving_throughput, multi_step_decode, paged_serving,
                 replicated_serving, speculative_serving,
-                subprocess_serving, quantized_collectives)}
+                subprocess_serving, fleet_stress,
+                quantized_collectives)}
         for name in only:
             if name not in fns:
                 raise SystemExit(f"--only: unknown section {name!r}; "
@@ -308,6 +309,32 @@ def subprocess_serving():
             n_replicas=2)
     else:
         rows = measure_subprocess_serving()
+    for row in rows:
+        emit(row["metric"], row["value"], row["unit"], row["note"])
+
+
+def fleet_stress():
+    """The overload sweep (ISSUE 12, serving/loadgen.py +
+    serving/admission.py): one seeded heavy-tailed tenant trace driven
+    open-loop through the replica fleet at increasing arrival rates
+    with admission economics armed. Emits the goodput-vs-CO-safe-p99
+    knee curve; the gated ``fleet_stress_overload_speedup`` row is
+    goodput at the top swept rate (>= 2x the knee) / goodput at the
+    knee — ~1 when the fleet plateaus past saturation by shedding on
+    policy, << 1 when it collapses (akka_allreduce_tpu.bench
+    measure_fleet_stress). CPU sweeps the default rates; TPU's faster
+    service rate sweeps higher."""
+    import jax
+
+    from akka_allreduce_tpu.bench import measure_fleet_stress
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        rows = measure_fleet_stress(
+            d_model=1024, n_layers=8, d_ff=4096, vocab=32768,
+            n_requests=64, rates=(32.0, 64.0, 128.0, 256.0, 512.0))
+    else:
+        rows = measure_fleet_stress()
     for row in rows:
         emit(row["metric"], row["value"], row["unit"], row["note"])
 

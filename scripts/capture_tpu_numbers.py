@@ -121,6 +121,17 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "subprocess_serving"], check=False)
 """),
+    # 8 (ISSUE 12). the fleet overload sweep: the seeded tenant trace
+    # driven open-loop to saturation with admission economics armed —
+    # on-chip the knee sits far higher (bench_suite's on-TPU defaults
+    # sweep 32-512 req/s), and the banked claim is the same
+    # fleet_stress_overload_speedup robustness ratio the CPU rows in
+    # perf_capture/fleet_stress.json gate meanwhile
+    ("fleet_stress", "suite", 900, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "fleet_stress"], check=False)
+"""),
     # 3. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time. guard_recompiles: every timed run holds under the

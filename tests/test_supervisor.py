@@ -235,6 +235,82 @@ class TestRemoteEngineBookkeeping:
         assert eng.occupied == 0
         assert eng.draining
 
+    def test_cancel_ack_settles_exact_waste(self):
+        """Wire v3 (ISSUE 12): the worker answers every CancelFrame
+        with a reason="cancelled" ack carrying the EXACT discard
+        count; the proxy settles the fleet hedge-waste ledger from it
+        — the deterministic pin of the ROADMAP bug where a remote
+        hedge loser was charged 0 while the worker's own counters
+        said otherwise. Charged == computed, bitwise."""
+        class _Fleet:
+            def __init__(self):
+                self.charged = []
+
+            def on_hedge_waste(self, rid, replica, tokens):
+                self.charged.append((rid, replica, tokens))
+
+        sup = FakeSupervisor()
+        sup.fleet = _Fleet()
+        eng = RemoteEngine(sup, 0, SPEC)
+        eng.admit(req(1))
+        assert eng.cancel(1) is None   # count follows asynchronously
+        eng._on_frame(wire.CompletionFrame(1, (), "cancelled",
+                                           replica=0, waste=5))
+        assert eng.step() == []        # the ack never reaches a router
+        assert eng.remote_cancel_waste == 5
+        assert sup.fleet.charged == [(1, 0, 5)]
+
+    def test_completion_racing_cancel_is_full_waste(self):
+        """The race path: the worker finished before the cancel landed
+        — its completion carries the full payload, which IS the
+        loser's compute; the ack that follows carries waste=0. Exactly
+        the payload is charged, once."""
+        class _Fleet:
+            def __init__(self):
+                self.charged = []
+
+            def on_hedge_waste(self, rid, replica, tokens):
+                self.charged.append((rid, replica, tokens))
+
+        sup = FakeSupervisor()
+        sup.fleet = _Fleet()
+        eng = RemoteEngine(sup, 0, SPEC)
+        eng.admit(req(1))
+        eng.cancel(1)
+        eng._on_frame(wire.CompletionFrame(1, (7, 8, 9), "eos",
+                                           replica=0))
+        eng._on_frame(wire.CompletionFrame(1, (), "cancelled",
+                                           replica=0, waste=0))
+        assert eng.step() == []
+        assert eng.remote_cancel_waste == 3
+        assert sup.fleet.charged == [(1, 0, 3)]
+
+    def test_incarnation_forgets_unacked_cancels(self):
+        """A cancel in flight to a DEAD incarnation is never acked:
+        the rid is forgotten and the replacement's counters re-anchor
+        — lost work is not hedge waste (nobody computed those tokens
+        to completion)."""
+        sup = FakeSupervisor()
+        eng = RemoteEngine(sup, 0, SPEC)
+        eng.admit(req(1))
+        eng.cancel(1)
+        eng._on_frame(wire.HealthFrame(replica=0, occupied=0,
+                                       free_slots=2, dispatches=3,
+                                       cancelled_tokens=4))
+        assert eng.worker_cancelled_tokens == 4
+        eng._on_incarnation()
+        assert eng._cancelled_rids == set()
+        # a stale completion from the old incarnation charges nothing
+        eng._on_frame(wire.CompletionFrame(1, (7, 8), "eos",
+                                           replica=0))
+        assert eng.step() == []
+        assert eng.remote_cancel_waste == 0
+        # the replacement's mirror counts FORWARD from the old total
+        eng._on_frame(wire.HealthFrame(replica=0, occupied=0,
+                                       free_slots=2, dispatches=1,
+                                       cancelled_tokens=2))
+        assert eng.worker_cancelled_tokens == 6
+
     def test_harvest_returns_raced_completions(self):
         sup = FakeSupervisor()
         eng = RemoteEngine(sup, 0, SPEC)

@@ -60,12 +60,28 @@ class TestRoundTrips:
         back = roundtrip(CompletionFrame(4, (), "watchdog"))
         assert back.replica == -1
 
+    def test_completion_carries_waste(self):
+        # wire v3: the cancel ack's discard count — the field that
+        # closes the remote-hedge-loser-charged-0 accounting gap
+        back = roundtrip(CompletionFrame(9, (), "cancelled",
+                                         replica=2, waste=17))
+        assert back.waste == 17
+        assert roundtrip(CompletionFrame(9, (1,), "eos")).waste == 0
+        with pytest.raises(ValueError, match="waste"):
+            CompletionFrame(9, (), "cancelled", waste=-1)
+
     def test_health(self):
         f = HealthFrame(replica=1, occupied=2, free_slots=0,
                         dispatches=55, compiles=7, draining=True,
                         watchdog_trips=2, evictions=3,
                         prefill_programs=4)
         assert roundtrip(f) == f
+
+    def test_health_carries_cancelled_tokens(self):
+        # wire v3: the worker's cumulative cancel-discard mirror
+        f = HealthFrame(replica=0, occupied=1, free_slots=3,
+                        dispatches=9, cancelled_tokens=123)
+        assert roundtrip(f).cancelled_tokens == 123
 
     def test_drain_cancel_drain_done(self):
         assert roundtrip(DrainFrame()) == DrainFrame()
@@ -159,7 +175,7 @@ class TestHostileFrames:
     def test_lying_completion_counts(self):
         buf = bytearray(encode(CompletionFrame(1, (2, 3), "eos"),
                                None))
-        off = 2 + struct.calcsize("<qiB")
+        off = 2 + struct.calcsize("<qiIB")
         struct.pack_into("<I", buf, off, 1 << 20)
         with pytest.raises(TruncatedFrame):
             decode(bytes(buf), None)
