@@ -81,6 +81,15 @@ class LintPolicy:
     steps per reduce axis (log2 of the group size; a dropped exchange
     leaves every rank holding a partial sum, the swing analog of an
     unpaired window). None = not a swing entry, ppermutes unchecked.
+    ``expect_hierarchical``: ``(ici_axis, dcn_axis)`` turns on the
+    ICI x DCN hybrid invariant (ISSUE 13): the ICI axis carries exactly
+    one float-payload reduce-scatter paired with float all-gather(s)
+    (the exact fast-plane legs), while the DCN axis moves its payload
+    int8-quantized — at least one int8 exchange each direction and NO
+    float-payload reduction over it (scales ride f32, values never do).
+    A refactor that loses the compression re-routes the full payload
+    over the slow plane; one that drops the ICI gather leaves every
+    rank a column shard. None = not a hierarchical entry.
     ``wire``: "bf16"/"int8" turn on the wire-dtype discipline (no f32
     payload escapes the compressed wire).
     ``exact_counts``: count/bookkeeping psums must be integer-dtyped
@@ -96,6 +105,7 @@ class LintPolicy:
     reduce_axes: Optional[frozenset] = None
     expect_two_phase: bool = False
     expect_swing: Optional[int] = None
+    expect_hierarchical: Optional[tuple] = None
     wire: Optional[str] = None
     exact_counts: bool = False
     expect_donation: bool = False

@@ -77,6 +77,15 @@ collectives_ef8     ef8 (block-quantized + error-feedback) lossy
                     allreduce_gradients with the residual threaded —
                     int8 wire discipline + exact counts + rs/ag
                     pairing on the two-phase structure
+collectives_hierarchical  the ICI x DCN hybrid (ISSUE 13): exact f32
+                    rs/ag legs pinned to the ICI axis, >= 2 int8
+                    exchanges and zero float reductions over the DCN
+                    group (expect_hierarchical), residual operand
+                    asserted present
+collective_auto     transport_schedule="auto" against a frozen
+                    CollectivePlan pinning swing — the lowered program
+                    must BE the plan's verdict (expect_swing), the
+                    dispatch half of the zero-recompile contract
 ==================  =================================================
 """
 
@@ -659,6 +668,111 @@ def build_collectives_ef8() -> LintContext:
                        lower=False)
 
 
+def build_collectives_hierarchical() -> LintContext:
+    """The ICI x DCN hybrid schedule (ISSUE 13): lossy
+    ``allreduce_gradients`` on ``transport_schedule="hierarchical"``
+    over a dp(outer/DCN) x ep(inner/ICI) mesh with the residual
+    threaded. The collective-axis pass asserts the lowered program
+    matches the schedule's shape — exactly one exact f32 reduce-scatter
+    paired with an all-gather on the ICI axis, >= 2 int8 exchanges and
+    ZERO float-payload reductions over the DCN group
+    (``expect_hierarchical``), rs/ag phase pairing per axis, and exact
+    int32 counts."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.ops.bucketing import bucketize
+    from akka_allreduce_tpu.parallel.dp import (GradSyncConfig,
+                                                allreduce_gradients)
+    mesh = _mesh(dp=2, ep=2)
+    grads = {"w": jnp.zeros((_D_MODEL, _D_MODEL), jnp.float32),
+             "b": jnp.zeros((_D_MODEL,), jnp.float32)}
+    sync = GradSyncConfig(bucket_elems=_BUCKET_ELEMS,
+                          axis_name=("dp", "ep"), transport="ef8",
+                          transport_schedule="hierarchical",
+                          return_elem_counts=False)
+    buckets, spec = bucketize(grads, sync.bucket_elems)
+    valid = jnp.ones((spec.num_buckets,), jnp.float32)
+    residual = jnp.zeros(buckets.shape, jnp.float32)
+    key = jax.random.key(0)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P(), P()),
+             out_specs=(P(), P(), P()), check_vma=False)
+    def entry(tree, valid, key, residual):
+        out = allreduce_gradients(tree, sync, valid=valid,
+                                  quant_key=key, residual=residual)
+        # the residual operand must be present in the lowered program
+        # (the plan's error-feedback contract) — asserted structurally
+        # at trace time, like the engine builders' aval pins
+        assert out.residual is not None
+        assert out.residual.shape == residual.shape
+        assert out.schedule == "hierarchical"
+        return out.grads, out.bucket_counts, out.residual
+
+    policy = LintPolicy(known_axes=_mesh_axes(mesh),
+                        reduce_axes=frozenset({"dp", "ep"}),
+                        exact_counts=True, expect_two_phase=True,
+                        expect_hierarchical=("ep", "dp"))
+    return trace_entry("collectives_hierarchical", entry,
+                       (grads, valid, key, residual), policy,
+                       lower=False)
+
+
+def build_collective_auto() -> LintContext:
+    """The autotuned-plan dispatch (ISSUE 13): ``allreduce_gradients``
+    on ``transport_schedule="auto"`` against a frozen CollectivePlan
+    whose entry pins the swing schedule for this bucket class. The
+    policy then asserts the LOWERED program is the plan's verdict —
+    exactly log2(group) exchange steps (``expect_swing``), the int8
+    wire discipline, exact counts — i.e. the plan is not advisory: what
+    it says is what lowers (the zero-recompile contract's other half)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.ops.autotune import (CollectivePlan,
+                                                 PlanEntry, plan_key,
+                                                 resolve_schedule)
+    from akka_allreduce_tpu.ops.bucketing import bucketize
+    from akka_allreduce_tpu.parallel.dp import (GradSyncConfig,
+                                                allreduce_gradients)
+    mesh = _mesh(dp=2)
+    grads = {"w": jnp.zeros((_D_MODEL, _D_MODEL), jnp.float32),
+             "b": jnp.zeros((_D_MODEL,), jnp.float32)}
+    buckets, spec = bucketize(grads, _BUCKET_ELEMS)
+    plan = CollectivePlan(
+        wire="ef8", axes=(("dp", 2),),
+        entries={plan_key(spec.num_buckets, _BUCKET_ELEMS): PlanEntry(
+            schedule="swing", num_windows=1,
+            timings_us={"fused": 2.0, "swing": 1.0})})
+    # the plan must RESOLVE to what we assert the lowering shows
+    assert resolve_schedule(plan, spec.num_buckets, _BUCKET_ELEMS,
+                            [2], "ef8") == ("swing", 4)
+    sync = GradSyncConfig(bucket_elems=_BUCKET_ELEMS, axis_name="dp",
+                          transport="ef8", transport_schedule="auto",
+                          plan=plan, return_elem_counts=False)
+    valid = jnp.ones((spec.num_buckets,), jnp.float32)
+    residual = jnp.zeros(buckets.shape, jnp.float32)
+    key = jax.random.key(0)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P(), P()),
+             out_specs=(P(), P(), P()), check_vma=False)
+    def entry(tree, valid, key, residual):
+        out = allreduce_gradients(tree, sync, valid=valid,
+                                  quant_key=key, residual=residual)
+        assert out.schedule == "swing", \
+            "auto did not dispatch the plan's winner"
+        assert out.residual is not None
+        return out.grads, out.bucket_counts, out.residual
+
+    policy = LintPolicy(known_axes=_mesh_axes(mesh),
+                        reduce_axes=frozenset({"dp"}),
+                        exact_counts=True, wire="int8",
+                        expect_swing=1)  # log2(2)
+    return trace_entry("collective_auto", entry,
+                       (grads, valid, key, residual), policy,
+                       lower=False)
+
+
 ENTRYPOINTS = {
     "train_step": build_train_step,
     "train_step_windowed": build_train_step_windowed,
@@ -680,6 +794,8 @@ ENTRYPOINTS = {
     "collective_bf16": build_collective_bf16,
     "collectives_swing": build_collectives_swing,
     "collectives_ef8": build_collectives_ef8,
+    "collectives_hierarchical": build_collectives_hierarchical,
+    "collective_auto": build_collective_auto,
 }
 
 

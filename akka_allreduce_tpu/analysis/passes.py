@@ -61,6 +61,11 @@ def collective_axis_pass(ctx: LintContext) -> list:
     gather_count: dict = {}
     # per-axis float-payload ppermute tally for the swing check
     exchange_count: dict = {}
+    # per-axis dtype-split tallies for the hierarchical check
+    rs_float: dict = {}
+    ag_float: dict = {}
+    int8_exchange: dict = {}
+    float_reduce: dict = {}
     for eqn, _in_loop in iter_eqns(ctx.jaxpr):
         prim = eqn.primitive.name
         if prim not in COLLECTIVE_PRIMS:
@@ -102,6 +107,18 @@ def collective_axis_pass(ctx: LintContext) -> list:
             # way), so the tally counts exactly the schedule's hops
             for ax in axes:
                 exchange_count[ax] = exchange_count.get(ax, 0) + 1
+        for ax in axes:
+            if prim == "reduce_scatter" and _is_float(dtype):
+                rs_float[ax] = rs_float.get(ax, 0) + 1
+            if prim == "all_gather" and _is_float(dtype):
+                ag_float[ax] = ag_float.get(ax, 0) + 1
+            if (prim in ("all_to_all", "all_gather")
+                    and dtype is not None
+                    and jnp.issubdtype(dtype, jnp.signedinteger)
+                    and jnp.dtype(dtype).itemsize == 1):
+                int8_exchange[ax] = int8_exchange.get(ax, 0) + 1
+            if prim in ("psum", "reduce_scatter") and _is_float(dtype):
+                float_reduce[ax] = float_reduce.get(ax, 0) + 1
     if pol.expect_swing is not None:
         # the swing invariant: every reduce axis carries exactly
         # log2(group) exchange steps — one missing leaves every rank a
@@ -118,6 +135,44 @@ def collective_axis_pass(ctx: LintContext) -> list:
                     f"dropped ±2^t exchange leaves every rank holding "
                     f"a partial sum; an extra one double-counts a "
                     f"subgroup", f"axis {ax}"))
+    if pol.expect_hierarchical is not None:
+        # the ICI x DCN hybrid invariant (ISSUE 13): the fast plane's
+        # legs are exact f32 (one reduce-scatter, gathered back), the
+        # slow plane's payload is int8 with f32 scales riding as small
+        # side-cars — and NOTHING full-precision reduces over it
+        ici_ax, dcn_ax = pol.expect_hierarchical
+        if rs_float.get(ici_ax, 0) != 1:
+            findings.append(Finding(
+                "collective-axis", "error", ctx.name,
+                f"hierarchical ICI leg over axis {ici_ax!r} carries "
+                f"{rs_float.get(ici_ax, 0)} float-payload "
+                f"reduce-scatter(s), expected exactly 1 — without it "
+                f"the full payload crosses the DCN group instead of "
+                f"each rank's 1/|ici| shard", f"axis {ici_ax}"))
+        if ag_float.get(ici_ax, 0) < 1:
+            findings.append(Finding(
+                "collective-axis", "error", ctx.name,
+                f"hierarchical ICI leg over axis {ici_ax!r} has no "
+                f"float-payload all_gather: the reduced shards are "
+                f"never reassembled and every rank keeps a column "
+                f"shard", f"axis {ici_ax}"))
+        if int8_exchange.get(dcn_ax, 0) < 2:
+            findings.append(Finding(
+                "collective-axis", "error", ctx.name,
+                f"hierarchical DCN exchange over axis {dcn_ax!r} "
+                f"carries {int8_exchange.get(dcn_ax, 0)} int8 "
+                f"collective(s), expected >= 2 (the quantized "
+                f"contribution hop and the quantized broadcast): the "
+                f"compressed leg lost its compression",
+                f"axis {dcn_ax}"))
+        if float_reduce.get(dcn_ax, 0):
+            findings.append(Finding(
+                "collective-axis", "error", ctx.name,
+                f"float-payload reduction "
+                f"({float_reduce[dcn_ax]} psum/reduce_scatter) crosses "
+                f"the DCN axis {dcn_ax!r}: the hierarchical schedule's "
+                f"point is that only int8 values (+ f32 block scales) "
+                f"ride the slow plane", f"axis {dcn_ax}"))
     if pol.expect_two_phase:
         for ax in sorted(set(reduce_count) | set(gather_count)):
             r, g = reduce_count.get(ax, 0), gather_count.get(ax, 0)

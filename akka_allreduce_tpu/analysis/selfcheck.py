@@ -120,6 +120,39 @@ def fixture_swing_dropped_exchange():
                        policy, lower=False)
 
 
+def fixture_hierarchical_uncompressed():
+    """A "hierarchical" schedule whose DCN leg lost its compression:
+    the ICI reduce-scatter/all-gather legs are right, but the slow-plane
+    exchange reduces the f32 shard directly — the full-precision payload
+    crosses the DCN group, the exact failure the schedule exists to
+    prevent (ISSUE 13). Fires BOTH hierarchical findings: a float
+    reduction over the DCN axis, and no int8 exchange on it."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh2()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        x = stacked[0]
+        shard = lax.psum_scatter(x, "tp", scatter_dimension=1,
+                                 tiled=True)
+        # BUG: plain f32 psum over the slow plane instead of the ef8
+        # block-quantized exchange
+        reduced = lax.psum(shard, "dp")
+        return lax.all_gather(reduced, "tp", axis=1, tiled=True)[None]
+
+    x = jnp.zeros((2, 4, 8), jnp.float32)
+    policy = LintPolicy(known_axes=_axes(mesh),
+                        reduce_axes=frozenset({"dp", "tp"}),
+                        expect_hierarchical=("tp", "dp"))
+    return trace_entry("fixture_hierarchical_uncompressed", entry,
+                       (x,), policy, lower=False)
+
+
 def fixture_dropped_donation():
     """donate_argnums declared, but no output matches the donated
     buffer's dtype — XLA copies silently; the HBM saving never happens."""
@@ -271,6 +304,8 @@ FIXTURES = [
     ("unpaired_window", fixture_unpaired_window, "collective-axis",
      "error"),
     ("swing_dropped_exchange", fixture_swing_dropped_exchange,
+     "collective-axis", "error"),
+    ("hierarchical_uncompressed", fixture_hierarchical_uncompressed,
      "collective-axis", "error"),
     ("dropped_donation", fixture_dropped_donation, "donation", "error"),
     ("missing_donation", fixture_missing_donation, "donation", "error"),

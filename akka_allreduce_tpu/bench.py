@@ -367,10 +367,14 @@ def measure_ab_overlap(windows=AB_OVERLAP_WINDOWS,
                         + flags_note}
 
 
-# canonical quantized/topology A/B payloads (ISSUE 9): the same small
-# (2.5M float) and ResNet-50-sized (25M float) rows the overlap A/B
-# uses, lane-aligned buckets
-QUANTIZED_AB_PAYLOADS = ((2_500_000, 327_680),
+# canonical quantized/topology A/B payloads (ISSUE 9, widened to the
+# ISSUE 13 crossover sweep): four bucket-size classes from the
+# latency-bound small end to the ResNet-50-sized bandwidth end — the
+# range over which Swing/two-phase/hierarchical winners FLIP, which is
+# exactly what the autotuned arm has to get right per class
+QUANTIZED_AB_PAYLOADS = ((250_000, 32_768),
+                         (1_000_000, 131_072),
+                         (2_500_000, 327_680),
                          (25_000_000, BUCKET_ELEMS_ALIGNED))
 
 
@@ -378,16 +382,25 @@ def measure_quantized_collectives(payloads=QUANTIZED_AB_PAYLOADS,
                                   r_hi: Optional[int] = None,
                                   r_lo: Optional[int] = None,
                                   reps: Optional[int] = None):
-    """The ISSUE 9 gradient-sync transport A/B: the fused f32 psum
-    baseline vs (a) the Swing short-cut schedule (f32 payload, ±2^t
-    exchange steps — log2(n) latency-bound hops instead of the
-    two-phase's O(n)) and (b) the ef8 wire (EQuARX-style block-quantized
-    int8 with error feedback — ~4x fewer wire bytes, the residual
-    carried through the round chain exactly as training carries it
-    through the scan). YIELDS one JSON-able row per (payload, arm) plus
-    the gated ``quantized_collectives_{swing,ef8}_speedup_*`` claim
-    rows, generator-style like measure_ab_overlap (a watchdog SIGKILL
-    loses only the in-flight measurement).
+    """The ISSUE 9 gradient-sync transport A/B, grown into the ISSUE 13
+    crossover sweep: the fused f32 psum baseline vs (a) the Swing
+    short-cut schedule (f32 payload, ±2^t exchange steps — log2(n)
+    latency-bound hops instead of the two-phase's O(n)), (b) the ef8
+    wire (EQuARX-style block-quantized int8 with error feedback — ~4x
+    fewer wire bytes, the residual carried through the round chain
+    exactly as training carries it through the scan), (c) ``auto`` —
+    the autotuned dispatch: a CollectivePlan built from THIS run's
+    measured f32 arms (the same winner-per-class rule ops/autotune.py
+    applies at train startup) drives ``transport_schedule="auto"``, so
+    its goodput must track the winning fixed arm at every bucket size
+    (the never-worse-than-the-worst-flag claim), and (d)
+    ``hierarchical`` — the ICI x DCN hybrid on a 2 x (n/2) two-axis
+    mesh (exact rs/ag over the inner axis, ef8 exchange over the
+    outer), the multi-slice schedule priced on CPU as a cost gate.
+    YIELDS one JSON-able row per (payload, arm) plus the gated
+    ``quantized_collectives_{arm}_speedup_*`` claim rows,
+    generator-style like measure_ab_overlap (a watchdog SIGKILL loses
+    only the in-flight measurement).
 
     Methodology matches the goodput bench: all rounds inside one jitted
     lax.scan, CHAINED through the carry (round r+1 consumes round r's
@@ -417,25 +430,45 @@ def measure_quantized_collectives(payloads=QUANTIZED_AB_PAYLOADS,
         reps = 3 if on_tpu else 2
     mesh = single_axis_mesh("dp", devices=devices)
     pow2 = n & (n - 1) == 0
+    # the hierarchical arm's two-axis mesh: dp = the outer/slow (DCN)
+    # group of 2, ep = the inner/fast (ICI) axis over the rest
+    mesh2 = None
+    if n >= 4 and n % 2 == 0:
+        from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                      make_device_mesh)
+        mesh2 = make_device_mesh(MeshSpec(dp=2, ep=n // 2),
+                                 devices=devices)
     ident = ("; 1-device: schedule identity — every arm IS the fused "
              "path, deltas are jitter" if n == 1 else "")
 
-    def make(arm, elems, bucket, rounds):
+    def make(arm, elems, bucket, rounds, plan=None):
         nb = tree_bucket_spec(
             {"g": jax.ShapeDtypeStruct((elems,), jnp.float32)},
             bucket).num_buckets
-        ef = arm == "ef8"
+        hier = arm == "hierarchical"
+        ef = arm == "ef8" or hier
         cfg = GradSyncConfig(
             bucket_elems=bucket, average=True, rescale_target=1.0,
             return_elem_counts=False,
+            axis_name=("dp", "ep") if hier else "dp",
             transport="ef8" if ef else "f32",
-            transport_schedule="swing" if arm == "swing" else "fused")
+            transport_schedule=("hierarchical" if hier
+                                else "swing" if arm == "swing"
+                                else "auto" if arm == "auto"
+                                else "fused"),
+            plan=plan)
+        m = mesh2 if hier else mesh
+        spec = P(("dp", "ep")) if hier else P("dp")
 
-        @partial(jax.shard_map, mesh=mesh,
-                 in_specs=(P("dp"), P("dp")), out_specs=P("dp"),
+        @partial(jax.shard_map, mesh=m,
+                 in_specs=(spec, spec), out_specs=spec,
                  check_vma=False)
         def run(x0, resid0):
             base_key = jax.random.key(11)
+            if hier:
+                # decorrelate the ef8 broadcast draws across ICI ranks
+                base_key = jax.random.fold_in(
+                    base_key, lax.axis_index("ep"))
 
             def one(carry, i):
                 x, r = carry
@@ -458,16 +491,16 @@ def measure_quantized_collectives(payloads=QUANTIZED_AB_PAYLOADS,
             return xf[None]
 
         x0 = jnp.zeros((n, elems), jnp.float32)
-        # only the ef8 arm reads the residual: the other arms carry a
-        # scalar-sized dummy so a payload-sized dead buffer never rides
-        # (or doubles the HBM of) the fused/swing measurements
+        # only the error-feedback arms read the residual: the others
+        # carry a scalar-sized dummy so a payload-sized dead buffer
+        # never rides (or doubles the HBM of) their measurements
         resid0 = (jnp.zeros((n, nb, bucket), jnp.float32) if ef
                   else jnp.zeros((n, 1, 1), jnp.float32))
         return jax.jit(run), x0, resid0
 
-    def arm_goodput(arm, elems, bucket):
+    def arm_goodput(arm, elems, bucket, plan=None):
         def measure(rounds):
-            f, x0, resid0 = make(arm, elems, bucket, rounds)
+            f, x0, resid0 = make(arm, elems, bucket, rounds, plan=plan)
             np.asarray(f(x0, resid0).addressable_shards[0]
                        .data[0, :4])  # compile + warm
             ts = []
@@ -496,11 +529,23 @@ def measure_quantized_collectives(payloads=QUANTIZED_AB_PAYLOADS,
                  "log2(n) hops",
         "ef8": "block-quantized int8 + error feedback (residual through "
                "the scan carry, fresh key per round), fused two-phase",
+        "auto": "autotuned dispatch: CollectivePlan built from this "
+                "run's measured f32 arms, resolved at trace time "
+                "(ops/autotune.py)",
+        "hierarchical": "ICI x DCN hybrid on a 2 x (n/2) mesh: exact "
+                        "rs/ag over the inner axis, ef8 exchange + "
+                        "error feedback over the outer group",
     }
+    from akka_allreduce_tpu.ops.autotune import (CollectivePlan,
+                                                 PlanEntry, plan_key)
     for elems, bucket in payloads:
         mega = f"{elems / 1_000_000:g}"
         base = None
-        for arm in ("fused", "swing", "ef8"):
+        f32_times = {}  # arm -> us/round, the auto plan's input
+        nb = tree_bucket_spec(
+            {"g": jax.ShapeDtypeStruct((elems,), jnp.float32)},
+            bucket).num_buckets
+        for arm in ("fused", "swing", "ef8", "auto", "hierarchical"):
             if arm == "swing" and not pow2:
                 yield {"metric":
                        f"quantized_collectives_swing_{mega}M_{n}{label}",
@@ -508,21 +553,55 @@ def measure_quantized_collectives(payloads=QUANTIZED_AB_PAYLOADS,
                        "error": f"swing needs a power-of-two group, "
                                 f"got {n} devices"}
                 continue
+            if arm == "hierarchical" and mesh2 is None:
+                yield {"metric":
+                       f"quantized_collectives_hierarchical_{mega}M_"
+                       f"{n}{label}",
+                       "value": 0.0, "unit": "GB/s",
+                       "error": f"hierarchical needs an even group of "
+                                f">= 4 for the 2 x (n/2) mesh, got "
+                                f"{n} devices"}
+                continue
+            plan = None
+            if arm == "auto":
+                # the per-class winner rule ops/autotune.py applies at
+                # train startup, fed by THIS run's f32 measurements —
+                # auto's goodput must then track the winning fixed arm
+                if not f32_times:
+                    yield {"metric":
+                           f"quantized_collectives_auto_{mega}M_"
+                           f"{n}{label}",
+                           "value": 0.0, "unit": "GB/s",
+                           "error": "no f32 arm survived to build the "
+                                    "plan from"}
+                    continue
+                win = min(f32_times, key=f32_times.get)
+                plan = CollectivePlan(
+                    wire="f32",
+                    axes=(("dp", n),) if n > 1 else (),
+                    entries={plan_key(nb, bucket): PlanEntry(
+                        schedule=win, num_windows=1,
+                        timings_us={a: round(t, 3)
+                                    for a, t in f32_times.items()})})
             _log(f"quantized_collectives: {arm} @ {mega}M on "
                  f"{n} {label}(s)")
             try:
-                g = arm_goodput(arm, elems, bucket)
+                g = arm_goodput(arm, elems, bucket, plan=plan)
             except Exception as e:  # noqa: BLE001 — bank, move on
                 yield {"metric":
                        f"quantized_collectives_{arm}_{mega}M_{n}{label}",
                        "value": 0.0, "unit": "GB/s",
                        "error": f"{type(e).__name__}: {e}"}
                 continue
+            note = f"{arm_notes[arm]}, buckets of {bucket}" + ident
+            if arm == "auto":
+                note += f"; plan winner {win}, hash {plan.plan_hash}"
             yield {"metric":
                    f"quantized_collectives_{arm}_{mega}M_{n}{label}",
                    "value": round(g, 3), "unit": "GB/s",
-                   "note": f"{arm_notes[arm]}, buckets of {bucket}"
-                           + ident}
+                   "note": note}
+            if arm in ("fused", "swing"):
+                f32_times[arm] = elems * 4 / g / 1e9 * 1e6  # us/round
             if arm == "fused":
                 base = g
             elif base:
@@ -530,7 +609,9 @@ def measure_quantized_collectives(payloads=QUANTIZED_AB_PAYLOADS,
                 # of the fused psum on the same box in the same run —
                 # a REGRESSION gate on the transports' cost (on CPU and
                 # single chips the schedules cannot win; what the gate
-                # holds is that they do not silently get MORE expensive)
+                # holds is that they do not silently get MORE expensive,
+                # and for auto that dispatch tracks the winning arm
+                # instead of a wrong hand-flag)
                 yield {"metric":
                        f"quantized_collectives_{arm}_speedup_{mega}M",
                        "value": round(g / base, 3), "unit": "x",

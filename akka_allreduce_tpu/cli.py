@@ -543,9 +543,14 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "on resume (bitwise), carried through "
                         "--grad-accum/--accum-schedule overlap and "
                         "--steps-per-dispatch scan carries. Single >1 "
-                        "data axis; dense models only (no --moe-experts)")
+                        "data axis (two with --grad-schedule "
+                        "hierarchical). MoE models carry a second, "
+                        "ep-rank-owned residual plane for the expert "
+                        "sync (ISSUE 13); the deadline/hybrid trainers "
+                        "thread the residual as their own state")
     p.add_argument("--grad-schedule",
-                   choices=("fused", "windowed", "swing"),
+                   choices=("fused", "windowed", "swing",
+                            "hierarchical", "auto"),
                    default="fused",
                    help="gradient-collective schedule: fused (one "
                         "monolithic collective per sync); windowed "
@@ -554,17 +559,39 @@ def _add_train(sub: argparse._SubParsersAction) -> None:
                         "ops/collectives.pipelined_two_phase_allreduce "
                         "so one window's all-gather overlaps the next's "
                         "reduce-scatter; pair with --xla-overlap on "
-                        "TPU); or swing (ISSUE 9: the ±2^t short-cut "
+                        "TPU); swing (ISSUE 9: the ±2^t short-cut "
                         "exchange schedule — log2(n) latency-bound "
                         "steps instead of the two-phase's O(n), the "
                         "mid-size-payload winner; composes with every "
-                        "--grad-quant wire). Needs a single >1 data "
-                        "axis (swing: power-of-two size); ragged "
-                        "bucket geometry pads internally on every "
-                        "schedule (ops/collectives.py pad-and-trim)")
+                        "--grad-quant wire); hierarchical (ISSUE 13: "
+                        "the ICI x DCN hybrid — exact reduce-scatter "
+                        "over the inner/fast data axis, ef8 block-"
+                        "quantized exchange WITH error feedback over "
+                        "the outer/slow group, exact all-gather back; "
+                        "needs --grad-quant ef8 and exactly two >1 "
+                        "data axes); or auto (ISSUE 13: measure every "
+                        "feasible schedule per bucket-size class at "
+                        "startup — ops/autotune.py — and dispatch each "
+                        "bucket's winner; the plan persists as a JSON "
+                        "sidecar in --plan-dir/--ckpt-dir and reloads "
+                        "on restart instead of re-measuring; its hash "
+                        "is logged and a frozen plan always lowers the "
+                        "same programs). Windowed/swing need a single "
+                        ">1 data axis (swing: power-of-two size); "
+                        "ragged bucket geometry pads internally on "
+                        "every schedule (ops/collectives.py "
+                        "pad-and-trim)")
     p.add_argument("--grad-windows", type=int, default=4, metavar="W",
                    help="window count for --grad-schedule windowed "
                         "(the bucket axis pads to a multiple of W)")
+    p.add_argument("--plan-dir", default=None,
+                   help="directory for --grad-schedule auto's measured "
+                        "CollectivePlan sidecar (default: --ckpt-dir; "
+                        "neither set = measure fresh every start, "
+                        "narrated). A matching sidecar (same wire, "
+                        "mesh axes, bucket classes) reloads instead of "
+                        "re-measuring — delete it, or pass a fresh "
+                        "directory, to force a re-measure")
     p.add_argument("--accum-schedule", choices=("deferred", "overlap"),
                    default="deferred",
                    help="with --grad-accum K > 1: deferred = one sync "
@@ -1267,28 +1294,43 @@ def _cmd_train(args: argparse.Namespace) -> int:
     # swing a power-of-two one); bucket geometry pads internally on
     # every schedule (parallel/dp.py, ops/collectives.py)
     data_axes = {"dp": dp, "sp": args.sp, "ep": args.ep}
-    if grad_wire in ("int8", "ef8"):
-        err = _two_phase_geometry_error(
-            f"--grad-quant {grad_wire}", data_axes,
-            remedy="use f32/bf16 transport or fold the parallelism "
-                   "into dp")
-        if err:
-            print(f"error: {err}", file=sys.stderr)
-            return 2
-    if grad_wire == "ef8":
-        if args.moe_experts:
-            print("error: --grad-quant ef8 does not yet compose with "
-                  "--moe-experts (the ep-owned expert sync would need "
-                  "its own residual plane) — use int8 for MoE models",
+    if args.grad_schedule == "hierarchical":
+        # the ICI x DCN hybrid spans exactly two >1 data axes (outer =
+        # the slow/DCN-like group, inner = the fast/ICI axis, mesh
+        # order) and IS the ef8 compressed exchange — validate at the
+        # flag layer with the mesh math spelled out
+        if grad_wire != "ef8":
+            print("error: --grad-schedule hierarchical IS the ef8 "
+                  "ICI x DCN hybrid (the compressed slow-plane leg is "
+                  "its point) — pair it with --grad-quant ef8",
                   file=sys.stderr)
             return 2
-        if args.coordinator or args.deadline_ms > 0:
-            print("error: --grad-quant ef8 does not yet compose with "
-                  "the deadline/hybrid paths (--deadline-ms / "
-                  "--coordinator): their trainers do not thread the "
-                  "residual state — use int8 there, or run ef8 on the "
-                  "exact single-process path", file=sys.stderr)
+        wide = [f"{k}={v}" for k, v in data_axes.items() if v > 1]
+        if len(wide) != 2:
+            print(f"error: --grad-schedule hierarchical needs exactly "
+                  f"two >1 data axes (outer = DCN group, inner = ICI "
+                  f"axis), got {' '.join(wide) or 'none'} — reshape "
+                  f"the mesh (e.g. --dp 2 --sp 2) or use a "
+                  f"single-axis schedule", file=sys.stderr)
             return 2
+    elif grad_wire in ("int8", "ef8"):
+        # auto on the hierarchical geometry (ef8, exactly two >1 data
+        # axes) is legal: the autotuner measures the hierarchical arm
+        # there and resolve_schedule falls back to it too — rejecting
+        # it here would make the autotuner's hierarchical arm
+        # unreachable through the CLI
+        two_wide = len([v for v in data_axes.values() if v > 1]) == 2
+        if not (args.grad_schedule == "auto" and grad_wire == "ef8"
+                and two_wide):
+            err = _two_phase_geometry_error(
+                f"--grad-quant {grad_wire}", data_axes,
+                remedy="use f32/bf16 transport, fold the parallelism "
+                       "into dp, or (ef8, two axes) --grad-schedule "
+                       "hierarchical (measured by --grad-schedule "
+                       "auto)")
+            if err:
+                print(f"error: {err}", file=sys.stderr)
+                return 2
     if args.grad_windows < 1:
         print(f"error: --grad-windows must be >= 1, got "
               f"{args.grad_windows}", file=sys.stderr)
@@ -1417,6 +1459,37 @@ def _cmd_train(args: argparse.Namespace) -> int:
               f"1f1b {st['1f1b']['bubble_fraction']:.1%} (resident "
               f"{st['1f1b']['resident_microbatches']})")
     params, opt_state, opt = make_train_state(jax.random.key(0), cfg, mesh)
+    if args.grad_schedule == "auto":
+        # measure (or reload) the per-bucket-class collective plan under
+        # the REAL mesh and the REAL bucket shapes, then freeze it into
+        # the config: trace-time resolution against a frozen plan lowers
+        # the same programs on every trace (the zero-recompile contract)
+        from akka_allreduce_tpu.models.train import (_data_axes,
+                                                     dense_bucket_count,
+                                                     expert_bucket_count)
+        from akka_allreduce_tpu.ops.autotune import load_or_measure
+        shapes = [(dense_bucket_count(cfg, mesh, params),
+                   cfg.bucket_elems)]
+        if mcfg.moe is not None:
+            shapes.append((expert_bucket_count(cfg, mesh, params),
+                           cfg.bucket_elems))
+        plan_dir = args.plan_dir or args.ckpt_dir
+        if plan_dir is None and chatty:
+            print("note: --grad-schedule auto without --plan-dir/"
+                  "--ckpt-dir: the plan is measured fresh every start "
+                  "(give it a directory to reload on restart)")
+        t_plan = time.perf_counter()
+        plan, reused = load_or_measure(
+            plan_dir, mesh, _data_axes(cfg, mesh), shapes,
+            wire=grad_wire, log=print if chatty else None)
+        if chatty:
+            winners = {k: (e.schedule if e.schedule != "windowed"
+                           else f"windowed:{e.num_windows}")
+                       for k, e in sorted(plan.entries.items())}
+            print(f"collective plan {plan.plan_hash} "
+                  f"{'reloaded' if reused else 'measured'} in "
+                  f"{time.perf_counter() - t_plan:.1f}s: {winners}")
+        cfg = dataclasses.replace(cfg, collective_plan=plan)
     # ef8 error-feedback residual: explicit training state next to
     # params/opt_state (None for every other wire) — the step consumes
     # and returns it, the checkpoint stores it as the 'sync' item
@@ -1447,6 +1520,10 @@ def _cmd_train(args: argparse.Namespace) -> int:
             dcn_bucket_elems=args.dcn_bucket_elems or None,
             hb_timeout_s=args.master_timeout_s,
             tracer=tracer)
+        if ef_state is not None:
+            # hand over the already-built residual so the trainer's
+            # lazy first-round init never allocates a second copy
+            dcn.set_ef_state(ef_state)
         step = None
     else:
         # donate: the loop rebinds params/opt_state every step and the
@@ -1464,9 +1541,14 @@ def _cmd_train(args: argparse.Namespace) -> int:
         from akka_allreduce_tpu.runtime.straggler import DeadlineTrainer
         n_ranks = data_rank_count(cfg, mesh)
         clock = RoundClock(n_ranks, deadline_s=args.deadline_ms / 1e3)
+        # ef8: the trainer owns the residual across rounds (the step is
+        # the (params, opt_state, tokens, ef_state, valid) form);
+        # trainer.ef_state after any round is what the checkpoint's
+        # 'sync' item stores — ISSUE 13 closed the deadline-path gap
         trainer = DeadlineTrainer(step, clock,
                                   dense_bucket_count(cfg, mesh, params),
-                                  max_lag=args.max_lag)
+                                  max_lag=args.max_lag,
+                                  ef_state=ef_state)
 
     start = 0
     mgr = None
@@ -1481,7 +1563,18 @@ def _cmd_train(args: argparse.Namespace) -> int:
         if start and chatty:
             print(f"resumed from step {start - 1} "
                   f"(data position {extra.get('data_step', '?')})")
-        if start and ef_state is not None:
+        if start and ef_state is not None \
+                and hybrid and jax.process_index() != 0:
+            # the residual is PER-PROCESS state (each island's own
+            # quantization errors) and the shared checkpoint carries
+            # only the writer's plane — a worker restores params from
+            # the master but restarts its own accumulator at zero
+            # (safe: EF is self-correcting within a few rounds; the
+            # master's resume stays bitwise)
+            print(f"note: process {jax.process_index()}: ef8 residual "
+                  f"restarts at zero on resume (per-process state; "
+                  f"the checkpoint carries the master's plane)")
+        elif start and ef_state is not None:
             # the ef8 residual's own item: restoring it makes the
             # resumed run bitwise the uninterrupted one; a checkpoint
             # without it (pre-ef8, or saved under another wire)
@@ -1504,6 +1597,13 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     print(f"note: no restorable 'sync' item at step "
                           f"{start - 1} ({type(exc).__name__}); ef8 "
                           f"residual restarts at zero")
+            # install the (restored-or-zero) residual wherever it will
+            # actually be threaded: the deadline trainer and the DCN
+            # hybrid trainer carry it as their own state (ISSUE 13)
+            if trainer is not None:
+                trainer.ef_state = ef_state
+            if dcn is not None:
+                dcn.set_ef_state(ef_state)
         if hybrid and not chatty:
             # hybrid params are replicated per process: every process
             # restores, only process 0 writes (one writer per directory)
@@ -1564,7 +1664,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                     return
                 mgr.save(rep.round, params, opt_state,
                          {"data_step": rep.round}, force=True,
-                         ema=ema_of(opt_state))
+                         ema=ema_of(opt_state),
+                         sync=None if dcn.ef_state is None
+                         else {"residual": dcn.ef_state})
                 mgr.wait_until_finished()  # worker reads it immediately
                 dcn.publish_snapshot_step(rep.round)
                 print(f"served rejoin snapshot at step {rep.round}")
@@ -1682,7 +1784,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 if mgr is not None:
                     mgr.maybe_save(rep.round, params, opt_state,
                                    {"data_step": rep.round},
-                                   ema=ema_of(opt_state))
+                                   ema=ema_of(opt_state),
+                                   sync=None if dcn.ef_state is None
+                                   else {"residual": dcn.ef_state})
                 steps_in_window += 1
                 if rep.round == start \
                         or (rep.round + 1) % args.log_every == 0:
@@ -1706,7 +1810,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 if mgr is not None:
                     mgr.maybe_save(rep.round, params, opt_state,
                                    {"data_step": rep.round},
-                                   ema=ema_of(opt_state))
+                                   ema=ema_of(opt_state),
+                                   sync=None if dcn.ef_state is None
+                                   else {"residual": dcn.ef_state})
                 if chatty:
                     print(f"step {rep.round + 1:4d}: loss "
                           f"{rep.loss:.4f} (drained) [masked "
@@ -1722,7 +1828,9 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 if args.steps > start and mgr.latest_step() != final:
                     mgr.save(final, params, opt_state,
                              {"data_step": final}, force=True,
-                             ema=ema_of(opt_state))
+                             ema=ema_of(opt_state),
+                             sync=None if dcn.ef_state is None
+                             else {"residual": dcn.ef_state})
                 # a straggler whose rejoin request landed during the
                 # master's LAST rounds would otherwise see the done
                 # marker and give up: hand it the final checkpoint on
@@ -1884,10 +1992,15 @@ def _cmd_train(args: argparse.Namespace) -> int:
                 guard = CompileLog()
                 guard.__enter__()
             if mgr is not None:
+                # under the deadline trainer the live residual is the
+                # TRAINER's copy (rebound every dispatch, possibly ahead
+                # of the local var); checkpoint that one
+                live_ef = (trainer.ef_state if trainer is not None
+                           else ef_state)
                 mgr.maybe_save(i, params, opt_state, {"data_step": i},
                                ema=ema_of(opt_state),
-                               sync=None if ef_state is None else
-                               {"residual": ef_state})
+                               sync=None if live_ef is None else
+                               {"residual": live_ef})
             steps_in_window += 1
             if i == start or (i + 1) % args.log_every == 0:
                 loss = float(jax.block_until_ready(metrics["loss"]))
@@ -1915,12 +2028,14 @@ def _cmd_train(args: argparse.Namespace) -> int:
                   f"({fell} all-masked, ran exact for liveness)")
         if mgr is not None:
             final = args.steps - 1
+            live_ef = (trainer.ef_state if trainer is not None
+                       else ef_state)
             if args.steps > start and mgr.latest_step() != final:
                 mgr.save(final, params, opt_state,
                          {"data_step": final}, force=True,
                          ema=ema_of(opt_state),
-                         sync=None if ef_state is None else
-                         {"residual": ef_state})
+                         sync=None if live_ef is None else
+                         {"residual": live_ef})
     finally:
         if guard is not None:
             guard.__exit__(None, None, None)

@@ -97,8 +97,10 @@ class TrainConfig:
     # state: init_ef_state() builds it, the train step takes and
     # returns it — including through the accum_schedule="overlap" scan
     # carry — and the checkpoint stores it as its own 'sync' item.
-    # Dense models only for now: the ep-owned expert sync would need a
-    # second residual plane)
+    # MoE models carry TWO planes (ISSUE 13): a dense plane riding the
+    # dense sync and an ep-rank-owned expert plane riding the expert
+    # sync — init_ef_state returns the {"dense", "expert"} dict and
+    # every consumer treats the state as a pytree)
     grad_transport: str = "f32"
     # Collective schedule for the gradient sync (GradSyncConfig.
     # transport_schedule): "fused" issues one monolithic collective per
@@ -108,11 +110,26 @@ class TrainConfig:
     # the next's reduce-scatter under XLA's latency-hiding scheduler
     # (runtime/xla_flags.py); "swing" (ISSUE 9) runs the ±2^t short-cut
     # exchange schedule — log2(n) latency-bound steps instead of the
-    # two-phase's O(n), the mid-size-payload winner (DESIGN.md §14).
-    # Windowed/swing need a single (>1) data axis (swing: power-of-two
-    # size); bucket geometry pads internally on every schedule.
+    # two-phase's O(n), the mid-size-payload winner (DESIGN.md §14);
+    # "hierarchical" (ISSUE 13) runs the ICI x DCN hybrid — exact
+    # reduce-scatter over the inner/fast data axis, ef8 compressed
+    # exchange with error feedback over the outer/slow group, exact
+    # all-gather back (needs exactly two >1 data axes and
+    # grad_transport="ef8"); "auto" (ISSUE 13) dispatches each bucket
+    # class's MEASURED winner from collective_plan (ops/autotune.py) —
+    # resolution happens at trace time, so a frozen plan compiles
+    # exactly one program per (bucket-class, schedule) and zero
+    # post-warmup (the hand-flag default "fused" serves classes the
+    # plan does not cover). Windowed/swing need a single (>1) data axis
+    # (swing: power-of-two size); bucket geometry pads internally on
+    # every schedule.
     transport_schedule: str = "fused"
     num_windows: int = 4
+    # the measured CollectivePlan for transport_schedule="auto"
+    # (ops/autotune.py: measure_plan / load_or_measure; the CLI builds
+    # it for `train --grad-schedule auto` and logs its hash). None =
+    # auto degrades to fused.
+    collective_plan: Any = None
     # "bf16" runs the model compute (matmuls, activations) in bfloat16 on
     # the MXU while master weights, gradients, and the optimizer stay f32
     # (loss/softmax/norm statistics are f32 internally regardless); "f32"
@@ -650,21 +667,17 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
                           return_elem_counts=False,
                           transport=cfg.grad_transport,
                           transport_schedule=cfg.transport_schedule,
-                          num_windows=cfg.num_windows)
+                          num_windows=cfg.num_windows,
+                          plan=cfg.collective_plan)
     gcfg_expert = GradSyncConfig(bucket_elems=cfg.bucket_elems,
                                  axis_name=cfg.grad_axes, average=True,
                                  rescale_target=float(n_expert_ranks),
                                  return_elem_counts=False,
                                  transport=cfg.grad_transport,
                                  transport_schedule=cfg.transport_schedule,
-                                 num_windows=cfg.num_windows)
+                                 num_windows=cfg.num_windows,
+                                 plan=cfg.collective_plan)
     use_ef = cfg.grad_transport == "ef8"
-    if use_ef and has_moe:
-        raise ValueError(
-            "grad_transport='ef8' does not yet compose with MoE: the "
-            "ep-owned expert sync would need its own residual plane "
-            "(a second (ranks, buckets, elems) state over different "
-            "axes) — use 'int8' for MoE models, or file the follow-up")
 
     def targets_and_weights(tokens):
         """Per-token next-token targets and loss weights; under sp the
@@ -752,19 +765,29 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
             k_expert = jax.random.fold_in(quant_key, 1)
         if has_moe:
             dense, expert = split_expert_leaves(grads)
+            # the MoE ef state is TWO planes (ISSUE 13 lifted the
+            # flag-layer exclusion): the dense residual rides the dense
+            # sync, the expert residual — ep-rank-OWNED, like the
+            # expert weights themselves — rides the expert sync over
+            # cfg.grad_axes. Each compensates its own wire's error;
+            # mixing them would feed one collective's rounding error
+            # into the other's contribution.
+            ef_d = ef["dense"] if use_ef else None
+            ef_e = ef["expert"] if use_ef else None
             res = allreduce_gradients(dense, gcfg, valid=valid,
-                                      quant_key=k_dense)
+                                      quant_key=k_dense, residual=ef_d)
             res_e = allreduce_gradients(expert, gcfg_expert,
-                                        quant_key=k_expert)
+                                        quant_key=k_expert,
+                                        residual=ef_e)
             grads_out = merge_expert_leaves(res.grads, res_e.grads)
             min_count = jnp.minimum(res.bucket_counts.min(),
                                     res_e.bucket_counts.min())
-        else:
-            res = allreduce_gradients(grads, gcfg, valid=valid,
-                                      quant_key=k_dense, residual=ef)
-            grads_out = res.grads
-            min_count = res.bucket_counts.min()
-        return grads_out, min_count, res.residual
+            new_ef = ({"dense": res.residual, "expert": res_e.residual}
+                      if use_ef else None)
+            return grads_out, min_count, new_ef
+        res = allreduce_gradients(grads, gcfg, valid=valid,
+                                  quant_key=k_dense, residual=ef)
+        return res.grads, res.bucket_counts.min(), res.residual
 
     def make_metrics(loss, aux, total_count, min_count):
         return {
@@ -1046,18 +1069,29 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
     # gradients — an out_spec claiming tp replication here would
     # silently keep one rank's residual and corrupt the others' error
     # feedback every step
-    ef_spec = P(_ef_state_axes(cfg, mesh), None, None)
+    ef_leaf_spec = P(_ef_state_axes(cfg, mesh), None, None)
+    # MoE state is a {"dense", "expert"} dict of planes (ISSUE 13
+    # lifted the flag-layer exclusion); both stack over the same rank
+    # axes — only their bucket counts differ — so the spec tree is the
+    # leaf spec mapped over the state structure
+    ef_spec = ({"dense": ef_leaf_spec, "expert": ef_leaf_spec}
+               if has_moe else ef_leaf_spec)
+
+    def _unlead_ef(e):
+        # stacked state -> this rank's plane(s): (num_buckets,
+        # bucket_elems) per leaf inside shard_map
+        return jax.tree.map(lambda x: x[0], e)
 
     def _relead_ef(out):
         # the rank-local residual is (num_buckets, bucket_elems); the
         # stacked state regains its leading rank axis for the out_spec
         g, m, e = out
-        return g, m, e[None]
+        return g, m, jax.tree.map(lambda x: x[None], e)
 
     if dynamic_valid and use_ef:
         mapped = jax.shard_map(
             lambda p, t, s, e, v: _relead_ef(
-                local_fn(p, t, s, valid=v[0], ef=e[0])),
+                local_fn(p, t, s, valid=v[0], ef=_unlead_ef(e))),
             mesh=mesh,
             in_specs=(specs, P(batch_axes, "sp"), P(), ef_spec,
                       P(dense_axes, None)),
@@ -1077,7 +1111,8 @@ def make_grad_step(cfg: TrainConfig, mesh: Mesh,
         )
     elif use_ef:
         mapped = jax.shard_map(
-            lambda p, t, s, e: _relead_ef(local_fn(p, t, s, ef=e[0])),
+            lambda p, t, s, e: _relead_ef(
+                local_fn(p, t, s, ef=_unlead_ef(e))),
             mesh=mesh,
             in_specs=(specs, P(batch_axes, "sp"), P(), ef_spec),
             out_specs=(specs, P(), ef_spec),
@@ -1149,14 +1184,18 @@ def _ef_state_axes(cfg: TrainConfig, mesh: Mesh) -> tuple:
 
 
 def init_ef_state(cfg: TrainConfig, mesh: Mesh,
-                  params: Any) -> Optional[jax.Array]:
+                  params: Any) -> Optional[Any]:
     """The ef8 transport's error-feedback state: a zero
     ``(n_ranks, num_buckets, bucket_elems)`` f32 array, leading axis
     sharded over every mesh axis whose ranks hold different gradients
     (data axes AND tp/pp — each such rank owns its own residual plane,
     because quantization error is rank-local; see
-    :func:`_ef_state_axes`). None for every other transport, so
-    callers can thread it unconditionally.
+    :func:`_ef_state_axes`). MoE models get a ``{"dense", "expert"}``
+    dict of two such planes (ISSUE 13): the expert sync is its own
+    collective over different axes with its own bucket geometry, so its
+    quantization error needs its own accumulator — the expert plane is
+    ep-rank-owned exactly like the expert weights it compensates. None
+    for every other transport, so callers can thread it unconditionally.
 
     This is TRAINING STATE on par with opt_state: the step consumes and
     returns it, cli.py train rebinds it every step and checkpoints it
@@ -1169,10 +1208,17 @@ def init_ef_state(cfg: TrainConfig, mesh: Mesh,
         return None
     axes = _ef_state_axes(cfg, mesh)
     n_ranks = math.prod(mesh.shape.get(a, 1) for a in axes)
-    n_buckets = dense_bucket_count(cfg, mesh, params)
-    zeros = jnp.zeros((n_ranks, n_buckets, cfg.bucket_elems),
-                      jnp.float32)
-    return jax.device_put(zeros, NamedSharding(mesh, P(axes, None, None)))
+
+    def plane(n_buckets: int) -> jax.Array:
+        zeros = jnp.zeros((n_ranks, n_buckets, cfg.bucket_elems),
+                          jnp.float32)
+        return jax.device_put(zeros,
+                              NamedSharding(mesh, P(axes, None, None)))
+
+    if cfg.model.moe is not None:
+        return {"dense": plane(dense_bucket_count(cfg, mesh, params)),
+                "expert": plane(expert_bucket_count(cfg, mesh, params))}
+    return plane(dense_bucket_count(cfg, mesh, params))
 
 
 def make_train_step(cfg: TrainConfig, mesh: Mesh,
@@ -1335,11 +1381,10 @@ def data_rank_count(cfg: TrainConfig, mesh: Mesh) -> int:
                      for a in _data_axes(cfg, mesh))
 
 
-def dense_bucket_count(cfg: TrainConfig, mesh: Mesh, params: Any) -> int:
-    """Bucket count of the rank-local dense gradient tree — the column
-    count of a dynamic ``valid`` mask. Computed from shapes only (no device
-    work): each rank's gradient shard is its parameter shard, so the local
-    leaf shapes follow from the global params and their PartitionSpecs."""
+def _local_shaped_params(cfg: TrainConfig, mesh: Mesh, params: Any) -> Any:
+    """Rank-local parameter SHAPES (ShapeDtypeStructs, no device work):
+    each rank's gradient shard is its parameter shard, so the local leaf
+    shapes follow from the global params and their PartitionSpecs."""
     from jax.sharding import PartitionSpec
     pp_size = mesh.shape.get("pp", 1)
     specs = param_specs(cfg.model, pp=pp_size)
@@ -1353,9 +1398,29 @@ def dense_bucket_count(cfg: TrainConfig, mesh: Mesh, params: Any) -> int:
                 shape[d] //= mesh.shape.get(a, 1)
         return jax.ShapeDtypeStruct(tuple(shape), x.dtype)
 
-    shaped = jax.tree.map(local, params, specs,
-                          is_leaf=lambda v: isinstance(v, PartitionSpec))
+    return jax.tree.map(local, params, specs,
+                        is_leaf=lambda v: isinstance(v, PartitionSpec))
+
+
+def dense_bucket_count(cfg: TrainConfig, mesh: Mesh, params: Any) -> int:
+    """Bucket count of the rank-local dense gradient tree — the column
+    count of a dynamic ``valid`` mask (and the dense ef8 residual
+    plane's row count)."""
+    shaped = _local_shaped_params(cfg, mesh, params)
     if cfg.model.moe is not None:
         shaped, _ = split_expert_leaves(shaped)
     from akka_allreduce_tpu.ops.bucketing import tree_bucket_spec
     return tree_bucket_spec(shaped, cfg.bucket_elems).num_buckets
+
+
+def expert_bucket_count(cfg: TrainConfig, mesh: Mesh, params: Any) -> int:
+    """Bucket count of the rank-local EXPERT gradient tree (the ep-owned
+    we1/we2 leaves) — the expert ef8 residual plane's row count. The
+    expert sync buckets its own split of the tree, so its geometry is
+    independent of the dense sync's."""
+    if cfg.model.moe is None:
+        raise ValueError("expert_bucket_count needs an MoE model")
+    shaped = _local_shaped_params(cfg, mesh, params)
+    _, expert = split_expert_leaves(shaped)
+    from akka_allreduce_tpu.ops.bucketing import tree_bucket_spec
+    return tree_bucket_spec(expert, cfg.bucket_elems).num_buckets

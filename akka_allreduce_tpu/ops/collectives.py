@@ -385,13 +385,20 @@ def _dequantize_blocks(values: jnp.ndarray, scales: jnp.ndarray,
             * jnp.repeat(scales, block, axis=-1)[..., :e])
 
 
+def ef8_phase2_rows(num_buckets: int, group: int) -> int:
+    """Row count of the phase-2 (broadcast-leg) residual: the OWNER rows
+    this rank broadcasts — bucket rows padded to a multiple of the group,
+    divided by it. The shape contract for ``residual2`` below."""
+    return (num_buckets + (-num_buckets) % group) // max(group, 1)
+
+
 def ef8_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
                             axis_name: str = "dp",
                             residual: Optional[jnp.ndarray] = None,
                             valid: Optional[jnp.ndarray] = None,
                             num_windows: int = 1,
-                            block_elems: int = DEFAULT_EF_BLOCK
-                            ) -> tuple[jnp.ndarray, jnp.ndarray]:
+                            block_elems: int = DEFAULT_EF_BLOCK,
+                            residual2: Optional[jnp.ndarray] = None):
     """EQuARX-style block-quantized allreduce WITH error feedback.
 
     Same two-phase structure as :func:`quantized_two_phase_allreduce`
@@ -423,7 +430,21 @@ def ef8_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
     wire and its residual carries over UNCHANGED — a protocol drop is
     not a compression error, so it is not fed back.
 
-    Returns ``(summed, new_residual)``.
+    ``residual2`` (ISSUE 13, PR 9's named follow-up) opts the BROADCAST
+    leg into error feedback too: phase 2 then quantizes
+    ``reduced + residual2`` with deterministic RTN and carries
+    ``new_residual2 = (reduced + residual2) - dequant(sent)``, so the
+    delivered value telescopes on BOTH legs — the terminal error is two
+    residuals, independent of T, instead of one residual plus T rounds
+    of zero-mean broadcast noise. The state is owner-rows-shaped
+    ``(ef8_phase2_rows(num_buckets, group), bucket_elems)`` f32 (the
+    rows this rank broadcasts). Fused schedule only (``num_windows``
+    must be 1): the windowed carve re-partitions owner rows per window
+    and would need a per-window state layout for no measured gain.
+
+    Returns ``(summed, new_residual)``, or
+    ``(summed, new_residual, new_residual2)`` when ``residual2`` is
+    given.
     """
     if buckets.ndim != 2:
         raise ValueError(
@@ -440,14 +461,20 @@ def ef8_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
             f"{buckets.shape} — the error-feedback state is one f32 "
             f"residual per bucket element (re-init it when the model "
             f"or bucket_elems changes)")
+    if residual2 is not None and num_windows != 1:
+        raise ValueError(
+            "phase-2 error feedback (residual2) needs the fused "
+            "schedule (num_windows=1): the windowed carve re-partitions "
+            "owner rows per window")
     n = lax.axis_size(axis_name)
     if n == 1:
         # identity sync: nothing is compressed, so no error to feed
         # back — but a masked bucket still contributes nothing
-        if valid is not None:
-            return buckets * valid.astype(buckets.dtype)[:, None], \
-                residual
-        return buckets, residual
+        out = buckets if valid is None else \
+            buckets * valid.astype(buckets.dtype)[:, None]
+        if residual2 is not None:
+            return out, residual, residual2
+        return out, residual
     comp = buckets + residual
     if valid is not None:
         comp = comp * valid.astype(comp.dtype)[:, None]
@@ -483,9 +510,29 @@ def ef8_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
     # window carve: identical to the int8 path — whole owner row-groups,
     # never more rows than the fused form pads
     num_windows = min(num_windows, bp // n)
+    new_residual2 = residual2
     if num_windows == 1:
         reduced, deq_local = phase1(comp_p)
-        out = phase2(reduced, key)[:b]
+        if residual2 is not None:
+            if residual2.shape != (bp // n, e):
+                raise ValueError(
+                    f"residual2 shape {residual2.shape} != owner rows "
+                    f"({bp // n}, {e}) — the phase-2 state is one f32 "
+                    f"residual per broadcast element "
+                    f"(ef8_phase2_rows(num_buckets, group) rows)")
+            # phase-2 EF: deterministic RTN of the compensated reduced
+            # rows; the broadcast delivers dequant(sent) and the owner
+            # carries the error forward — the same telescoping argument
+            # as phase 1, now on the second leg
+            comp2 = reduced + residual2
+            v2, s2 = _quantize_blocks(comp2, block_elems)
+            new_residual2 = comp2 - _dequantize_blocks(v2, s2,
+                                                       block_elems)
+            all_v = lax.all_gather(v2, axis_name, axis=0, tiled=True)
+            all_s = lax.all_gather(s2, axis_name, axis=0, tiled=True)
+            out = _dequantize_blocks(all_v, all_s, block_elems)[:b]
+        else:
+            out = phase2(reduced, key)[:b]
         deq = deq_local[:b]
     else:
         m = bp // n
@@ -512,6 +559,8 @@ def ef8_two_phase_allreduce(buckets: jnp.ndarray, key: jax.Array,
         # residual as-is — the drop is the protocol's, not the wire's
         new_residual = jnp.where(valid.astype(bool)[:, None],
                                  new_residual, residual)
+    if residual2 is not None:
+        return out, new_residual, new_residual2
     return out, new_residual
 
 
@@ -660,6 +709,101 @@ def quantized_swing_allreduce(buckets: jnp.ndarray, key: jax.Array,
         rs = lax.ppermute(s, axis_name, perm)
         acc = acc + deq(rv, rs)
     return acc, new_residual
+
+
+def hierarchical_allreduce(buckets: jnp.ndarray, key: jax.Array,
+                           dcn_axis: str, ici_axis: str,
+                           residual: Optional[jnp.ndarray] = None,
+                           valid: Optional[jnp.ndarray] = None,
+                           block_elems: int = DEFAULT_EF_BLOCK
+                           ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The ICI x DCN hybrid schedule (ISSUE 13): exact reduce-scatter
+    over the fast ``ici_axis``, an ef8 block-quantized exchange WITH
+    error feedback over the slow ``dcn_axis`` group, then an exact
+    all-gather over ICI. Rank-local (inside shard_map over both axes).
+
+    This is the schedule the multi-slice plane has been missing: the
+    two exact legs ride the ~100 GB/s ICI links, and only the 1/|ici|
+    shard each rank owns after the reduce-scatter crosses DCN — at int8
+    with block scales, so the slow plane moves ``payload / (4 * ici)``
+    bytes per rank instead of ``payload``. Compression error on the DCN
+    leg is COMPENSATED, not just bounded: the shard's quantization error
+    feeds the same per-rank residual contract as
+    :func:`ef8_two_phase_allreduce` (deterministic RTN on the
+    contribution hop, telescoping across rounds, masked rows carrying
+    their residual unchanged).
+
+    ``residual`` is this rank's carried state, full ``buckets``-shaped
+    f32 (None = zeros): each rank only *updates* the columns of the
+    shard it owns after the ICI reduce-scatter — the other columns ride
+    along untouched (zeros for a fresh state) so the state keeps ONE
+    shape across every schedule and the checkpoint/threading plumbing
+    (init_ef_state, the scan carries, the ``sync`` item) is unchanged.
+
+    ``valid`` masks lossy rounds at bucket-row granularity, with the
+    DCN-dropout semantic: a masked row contributes exact zeros to the
+    ICI reduce-scatter AND to the DCN exchange, and its residual
+    carries over unchanged. Rows are masked per DCN group — rank-local
+    masks within one ICI group should agree (the deadline plane masks
+    whole processes/slices, never half an ICI group).
+
+    Degenerate groups compose naturally: |ici| = 1 makes the ICI legs
+    the identity (the schedule IS the ef8 two-phase over DCN); |dcn| = 1
+    makes the DCN leg the identity sync (residual unchanged — nothing
+    was compressed), leaving the exact two-phase over ICI.
+
+    Returns ``(summed, new_residual)``.
+    """
+    if buckets.ndim != 2:
+        raise ValueError(
+            f"expected (num_buckets, bucket_elems), got {buckets.shape}")
+    if residual is None:
+        residual = jnp.zeros_like(buckets)
+    if residual.shape != buckets.shape:
+        raise ValueError(
+            f"residual shape {residual.shape} != buckets shape "
+            f"{buckets.shape} — the error-feedback state keeps the full "
+            f"bucket shape on every schedule (hierarchical updates only "
+            f"the owned-shard columns)")
+    n_ici = lax.axis_size(ici_axis)
+    contrib = buckets if valid is None else \
+        buckets * valid.astype(buckets.dtype)[:, None]
+    if n_ici == 1:
+        return ef8_two_phase_allreduce(
+            buckets, key, dcn_axis, residual=residual, valid=valid,
+            block_elems=block_elems)
+    b, e = buckets.shape
+    xp, _ = _pad_scatter_geometry(contrib, ici_axis)
+    shard_cols = xp.shape[-1] // n_ici
+    me = lax.axis_index(ici_axis)
+    # ICI reduce phase: each rank ends owning the ICI-group-reduced
+    # version of its column shard (the reference's block-ownership rule
+    # at column granularity)
+    shard = lax.psum_scatter(xp, ici_axis, scatter_dimension=1,
+                             tiled=True)
+    # the owned shard's residual columns: pad the full-state view to the
+    # scatter geometry, slice this rank's window (padded columns carry
+    # zero gradient, quantize to exact zeros, and keep a zero residual)
+    resid_p = residual if xp.shape[-1] == e else jnp.concatenate(
+        [residual, jnp.zeros((b, xp.shape[-1] - e), residual.dtype)],
+        axis=-1)
+    resid_shard = lax.dynamic_slice(
+        resid_p, (0, me * shard_cols), (b, shard_cols))
+    # decorrelate phase-2 broadcast noise across ICI siblings (they
+    # quantize different shards; independence of the VALUES is what
+    # unbiasedness needs, but distinct draws cost nothing)
+    key = jax.random.fold_in(key, me)
+    # DCN exchange: the ef8 two-phase over the slow group, residual
+    # contract included — the masked-row rule (residual unchanged on a
+    # DCN dropout) comes along for free
+    out_shard, new_resid_shard = ef8_two_phase_allreduce(
+        shard, key, dcn_axis, residual=resid_shard, valid=valid,
+        block_elems=block_elems)
+    out = lax.all_gather(out_shard, ici_axis, axis=1,
+                         tiled=True)[..., :e]
+    new_residual = lax.dynamic_update_slice(
+        resid_p, new_resid_shard, (0, me * shard_cols))[..., :e]
+    return out, new_residual
 
 
 def exact_allreduce(stacked: jnp.ndarray, mesh: Mesh, axis_name: str = "dp",

@@ -30,9 +30,11 @@ from jax import lax
 
 from akka_allreduce_tpu.ops.bucketing import BucketSpec, bucketize, \
     debucketize, vector_to_tree
+from akka_allreduce_tpu.ops.autotune import resolve_schedule
 from akka_allreduce_tpu.ops.collectives import (
     DEFAULT_EF_BLOCK,
     ef8_two_phase_allreduce,
+    hierarchical_allreduce,
     pipelined_two_phase_allreduce,
     quantized_swing_allreduce,
     quantized_two_phase_allreduce,
@@ -101,8 +103,22 @@ class GradSyncConfig:
     # swing additionally a power-of-two one; bucket geometry is
     # satisfied by construction (pads slice back off), and lossy
     # rounds keep their per-bucket counts on ONE exact int32 psum.
+    # Two more values (ISSUE 13): "hierarchical" — the ICI x DCN hybrid
+    # (exact reduce-scatter over the inner/fast axis, ef8 block-
+    # quantized exchange WITH error feedback over the outer/slow group,
+    # exact all-gather back over the inner axis; needs exactly two (>1)
+    # data axes, outer first in axis_name order, and transport="ef8" —
+    # the compressed DCN leg is the schedule's point) and "auto" — the
+    # measured per-bucket-class dispatch: the bucket matrix's
+    # (rows, cols) class resolves against ``plan`` (a CollectivePlan
+    # from ops/autotune.py) at TRACE time, so a frozen plan always
+    # lowers the same programs; no plan / no entry / an infeasible
+    # winner all fall back to the fused hand-flag default.
     transport_schedule: str = "fused"
     num_windows: int = 4
+    # the measured CollectivePlan "auto" dispatches against (None =
+    # auto degrades to fused); ignored by every explicit schedule
+    plan: Any = None
 
 
 @dataclasses.dataclass
@@ -115,7 +131,10 @@ class GradSyncResult:
     rounds honor ``config.transport``). ``residual`` is the updated
     error-feedback state of the ef8 transport — buckets-shaped f32,
     thread it into the next round's ``allreduce_gradients`` call (None
-    for every other transport)."""
+    for every other transport). ``residual2`` is the phase-2
+    (broadcast-leg) residual when the caller opted in (owner-rows-
+    shaped; None otherwise). ``schedule`` is the schedule that actually
+    lowered — what "auto" resolved to, or the hand flag verbatim."""
 
     grads: Any
     counts: Any
@@ -123,12 +142,15 @@ class GradSyncResult:
     spec: BucketSpec
     transport: str = "f32"
     residual: Any = None
+    residual2: Any = None
+    schedule: str = "fused"
 
 
 def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
                         valid: Optional[jnp.ndarray] = None,
                         quant_key: Optional[jax.Array] = None,
-                        residual: Optional[jnp.ndarray] = None
+                        residual: Optional[jnp.ndarray] = None,
+                        residual2: Optional[jnp.ndarray] = None
                         ) -> GradSyncResult:
     """Synchronise a gradient pytree across the data axis (rank-local).
 
@@ -151,21 +173,54 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     live_axes = [a for a in _axis_tuple(config.axis_name)
                  if lax.axis_size(a) > 1]
     use_bf16 = config.transport == "bf16" and bool(live_axes)
-    if config.transport_schedule not in ("fused", "windowed", "swing"):
+    if config.transport_schedule not in ("fused", "windowed", "swing",
+                                         "hierarchical", "auto"):
         raise ValueError(
             f"unknown transport_schedule {config.transport_schedule!r}: "
             f"'fused' (one monolithic collective), 'windowed' (the "
-            f"software-pipelined schedule), or 'swing' (the ±2^t "
-            f"short-cut exchange schedule)")
-    windowed = config.transport_schedule == "windowed" and bool(live_axes)
-    swing = config.transport_schedule == "swing" and bool(live_axes)
-    if windowed or swing:
-        if windowed and config.num_windows < 1:
+            f"software-pipelined schedule), 'swing' (the ±2^t "
+            f"short-cut exchange schedule), 'hierarchical' (the ef8 "
+            f"ICI x DCN hybrid), or 'auto' (the measured per-bucket-"
+            f"class plan, ops/autotune.py)")
+    schedule = config.transport_schedule
+    n_windows = config.num_windows
+    if schedule == "auto":
+        # trace-time resolution against the measured plan: a frozen
+        # plan is static Python, so every trace of one bucket class
+        # lowers the same program — the zero-recompile contract.
+        # Infeasible/missing entries fall back to the fused default
+        # inside resolve_schedule (auto is never worse than a flag).
+        schedule, n_windows = resolve_schedule(
+            config.plan, buckets.shape[0], buckets.shape[1],
+            [lax.axis_size(a) for a in live_axes], config.transport,
+            default_windows=config.num_windows)
+    windowed = schedule == "windowed" and bool(live_axes)
+    swing = schedule == "swing" and bool(live_axes)
+    hier = schedule == "hierarchical"
+    if hier:
+        if config.transport != "ef8":
             raise ValueError(
-                f"num_windows must be >= 1, got {config.num_windows}")
+                f"transport_schedule='hierarchical' IS the ef8 ICI x "
+                f"DCN hybrid (the compressed DCN leg is its point) — "
+                f"got transport={config.transport!r}; use "
+                f"transport='ef8', or a different schedule")
+        if len(live_axes) > 2:
+            raise ValueError(
+                f"hierarchical schedule needs exactly two (>1) data "
+                f"axes (outer = DCN group, inner = ICI axis); got "
+                f"{live_axes} — fold the extra parallelism away")
+        if len(live_axes) < 2:
+            # mesh shrank under the flag (one slice, or one rank):
+            # degrade to the fused ef8 two-phase over whatever is left
+            # — the DCN exchange without an ICI plane to scatter over
+            hier = False
+    if windowed or swing:
+        if windowed and n_windows < 1:
+            raise ValueError(
+                f"num_windows must be >= 1, got {n_windows}")
         if len(live_axes) > 1:
             raise ValueError(
-                f"transport_schedule={config.transport_schedule!r} needs "
+                f"transport_schedule={schedule!r} needs "
                 f"a single (>1) data axis; got {live_axes} — fold the "
                 f"parallelism into one axis or use the fused schedule")
         win_axis = live_axes[0]
@@ -181,7 +236,7 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
         window count, never multiply the wire bytes — the same
         guarantee the int8 path's row-group carve makes."""
         rows = mat.shape[0]
-        w = min(config.num_windows, rows)
+        w = min(n_windows, rows)
         while w > 1 and (-rows) % w >= -(-rows // w):
             w -= 1
         pad = (-rows) % w
@@ -195,10 +250,11 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     if quantized:
         # shared int8/ef8 preconditions (exact and masked paths)
         int8_axes = live_axes
-        if len(int8_axes) > 1:
+        if len(int8_axes) > 1 and not hier:
             raise ValueError(
                 f"{config.transport} transport needs a single (>1) data "
-                f"axis, got {int8_axes}")
+                f"axis, got {int8_axes} (only the hierarchical schedule "
+                f"spans two: outer DCN group x inner ICI axis)")
         if quant_key is None:
             raise ValueError(
                 f"{config.transport} transport needs quant_key, varied "
@@ -210,16 +266,25 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
             residual = jnp.zeros_like(buckets)
     elif config.transport not in ("f32", "bf16"):
         raise ValueError(f"unknown transport {config.transport!r}")
+    if residual2 is not None and (
+            config.transport != "ef8" or windowed or swing or hier):
+        raise ValueError(
+            "residual2 (phase-2 error feedback) needs the ef8 transport "
+            "on the fused two-phase schedule — the broadcast-leg "
+            "residual is owner-rows-shaped, which only the fused carve "
+            "keeps stable")
     # captured AFTER the fresh-start default so the size-1 identity
     # path still honors the residual contract (ef8 always returns the
     # buckets-shaped state, never the caller's None back)
     new_residual = residual if config.transport == "ef8" else None
+    new_residual2 = residual2
 
     def quantized_sum(mat, vmask):
         """The compressed-wire sum on whichever schedule is selected;
-        updates ``new_residual`` for ef8 (the closure is the one place
-        the schedule x wire matrix is spelled out)."""
-        nonlocal new_residual
+        updates ``new_residual`` (and ``new_residual2``) for ef8 (the
+        closure is the one place the schedule x wire matrix is spelled
+        out)."""
+        nonlocal new_residual, new_residual2
         if not int8_axes:
             # size-1 identity: nothing moves, nothing rounds — but the
             # mask still applies (a masked bucket contributes nothing
@@ -229,14 +294,28 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
                 mat * vmask.astype(mat.dtype)[:, None]
         ax = int8_axes[0]
         if config.transport == "ef8":
-            if swing:
+            if hier:
+                # outer/slow axis first in axis_name order = the DCN
+                # group; inner/fast last = the ICI axis (mesh order is
+                # the bandwidth hierarchy, parallel/mesh.py)
+                out, new_residual = hierarchical_allreduce(
+                    mat, quant_key, int8_axes[0], int8_axes[-1],
+                    residual=residual, valid=vmask,
+                    block_elems=DEFAULT_EF_BLOCK)
+            elif swing:
                 out, new_residual = quantized_swing_allreduce(
                     mat, quant_key, ax, residual=residual, valid=vmask,
                     block_elems=DEFAULT_EF_BLOCK)
+            elif residual2 is not None:
+                out, new_residual, new_residual2 = \
+                    ef8_two_phase_allreduce(
+                        mat, quant_key, ax, residual=residual,
+                        valid=vmask, block_elems=DEFAULT_EF_BLOCK,
+                        residual2=residual2)
             else:
                 out, new_residual = ef8_two_phase_allreduce(
                     mat, quant_key, ax, residual=residual, valid=vmask,
-                    num_windows=config.num_windows if windowed else 1,
+                    num_windows=n_windows if windowed else 1,
                     block_elems=DEFAULT_EF_BLOCK)
             return out
         if swing:
@@ -247,7 +326,7 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
             mat * vmask.astype(mat.dtype)[:, None]
         return quantized_two_phase_allreduce(
             contrib, quant_key, ax,
-            num_windows=config.num_windows if windowed else 1)
+            num_windows=n_windows if windowed else 1)
 
     if valid is None:
         # Exact path (thresholds = 1.0): every rank contributes every
@@ -345,4 +424,9 @@ def allreduce_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig(),
     return GradSyncResult(grads=out_tree, counts=counts_tree,
                           bucket_counts=bucket_counts, spec=spec,
                           transport=config.transport,
-                          residual=new_residual)
+                          residual=new_residual,
+                          residual2=new_residual2,
+                          # what actually lowered: a degraded
+                          # hierarchical (< 2 live axes) ran fused
+                          schedule=("fused" if schedule == "hierarchical"
+                                    and not hier else schedule))

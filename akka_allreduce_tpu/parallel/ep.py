@@ -24,6 +24,17 @@ Rank-local: call inside ``shard_map``. Each ``ep`` rank owns
 ``ep`` (the expert axis doubles as a data axis outside MoE layers, the
 standard TPU MoE meshing). With ``axis_name=None`` the same code runs
 single-rank (all experts local) — used by unit tests and the 1-chip path.
+
+Gradient sync of the expert weights is the train step's job
+(models/train.py ``split_expert_leaves`` + the expert ``GradSyncConfig``):
+we1/we2 are ep-rank-OWNED, so they reduce over the plain data axes only,
+never over ep. Since ISSUE 13 that sync composes with the ef8
+error-feedback wire too — the expert collective carries its OWN residual
+plane (``init_ef_state``'s ``"expert"`` state item, ep-rank-owned like
+the weights it compensates, stacked/sharded over the same rank axes as
+the dense plane but with the expert tree's bucket geometry). Mixing the
+two planes would feed one collective's rounding error into the other's
+contribution; tests/test_ef8_grad_sync.py pins the separation.
 """
 
 from __future__ import annotations

@@ -293,9 +293,33 @@ class DcnDeadlineTrainer:
         self._downed: set[int] = set()
         self._consec_missed: dict[int, int] = {}
         self.reports: list[DcnRoundReport] = []
+        # ef8 on the LOCAL device plane (ISSUE 13, the "DCN trainers
+        # don't thread the residual at all" gap): the residual is the
+        # trainer's own explicit state — initialized lazily at the first
+        # round (it needs the params tree), rebound every round, exposed
+        # as .ef_state for the CLI to checkpoint as the 'sync' item and
+        # restore through set_ef_state. The DCN wire above stays
+        # residual-free by design: its int8 stochastic rounding is
+        # zero-mean across rounds (encode_payload), while the device
+        # plane's deterministic RTN is what needs compensation.
+        self.ef_state: Optional[Any] = None
+        self._use_ef = (grad_step is None
+                        and getattr(cfg, "grad_transport", None) == "ef8")
         if grad_step is None:
             from akka_allreduce_tpu.models.train import make_grad_step
-            grad_step = jax.jit(make_grad_step(cfg, mesh))
+            inner = jax.jit(make_grad_step(cfg, mesh))
+            if self._use_ef:
+                def grad_step(params, tokens, r):
+                    if self.ef_state is None:
+                        from akka_allreduce_tpu.models.train import \
+                            init_ef_state
+                        self.ef_state = init_ef_state(self.cfg, self.mesh,
+                                                      params)
+                    grads, metrics, self.ef_state = inner(
+                        params, tokens, r, ef_state=self.ef_state)
+                    return grads, metrics
+            else:
+                grad_step = inner
         self._gstep = grad_step
         self._flat = jax.jit(lambda g: tree_to_vector(g, jnp.float32))
         self._spec = None
@@ -858,6 +882,17 @@ class DcnDeadlineTrainer:
     def downed_peers(self) -> tuple[int, ...]:
         """Master: the currently auto-downed ranks (empty on workers)."""
         return tuple(sorted(self._downed))
+
+    def set_ef_state(self, ef_state: Any) -> None:
+        """Install a checkpoint-restored ef8 residual (the ``sync``
+        item) before the first round — a resume that skips this
+        restarts the error accumulator at zero (safe, but not bitwise
+        the uninterrupted run)."""
+        if not self._use_ef:
+            raise ValueError(
+                "set_ef_state needs the default ef8 grad step "
+                "(cfg.grad_transport='ef8', no grad_step override)")
+        self.ef_state = ef_state
 
     def set_start_round(self, r: int) -> None:
         """Start counting rounds at ``r`` (checkpoint resume). Must be

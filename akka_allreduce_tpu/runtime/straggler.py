@@ -54,15 +54,27 @@ class DeadlineTrainer:
     chunk). Per-bucket granularity stays available one level down
     (allreduce_gradients ``valid``) for callers with partial-arrival
     information.
+
+    ``ef_state`` (ISSUE 13) opts the ef8 error-feedback residual in:
+    the step is then the ``(params, opt_state, tokens, ef_state, valid)
+    -> (..., ef_state)`` form (``make_train_step`` with
+    ``grad_transport="ef8"`` + ``dynamic_valid=True``) and the trainer
+    carries the residual across rounds as its own state —
+    ``self.ef_state`` after any round is what a checkpoint must store
+    (the ``sync`` item, exactly like the exact-path CLI loop). A masked
+    peer's bucket rows keep their residual unchanged through the masked
+    round (the device collective's masked-row contract), so deadline
+    masking and error feedback compose without a special case here.
     """
 
     def __init__(self, step: Callable, clock: RoundClock, num_buckets: int,
-                 max_lag: int = 1):
+                 max_lag: int = 1, ef_state: Optional[Any] = None):
         self.step = step
         self.clock = clock
         self.num_buckets = num_buckets
         self.pacer = RoundPacer(max_lag)
         self.reports: list[RoundReport] = []
+        self.ef_state = ef_state
 
     @property
     def round(self) -> int:
@@ -103,7 +115,15 @@ class DeadlineTrainer:
         result = {}
 
         def launch(_r):
-            out = self.step(params, opt_state, tokens, mask)
+            if self.ef_state is not None:
+                out = self.step(params, opt_state, tokens, self.ef_state,
+                                mask)
+                # rebind the residual IMMEDIATELY (not at harvest): the
+                # next round's dispatch consumes it, and the pacer may
+                # hold several rounds in flight
+                self.ef_state = out[3]
+            else:
+                out = self.step(params, opt_state, tokens, mask)
             result["out"] = out
             # the pacer harvests (block_until_ready) what we return; hand
             # it only the metrics — with a donating step, the old round's
@@ -115,7 +135,7 @@ class DeadlineTrainer:
             return out[2]
 
         self.pacer.submit(launch)
-        out = result["out"]
+        out = result["out"][:3]
         # report what the clock observed, not the liveness substitution —
         # a fully-straggled round must not masquerade as a clean one
         self.reports.append(RoundReport(

@@ -132,6 +132,24 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
                 "--only", "fleet_stress"], check=False)
 """),
+    # 9 (ISSUE 13). the autotuned + hierarchical crossover sweep: the
+    # quantized_collectives A/B rerun with its auto and hierarchical
+    # arms over the 4-size bucket sweep — on-chip is where the
+    # crossover is REAL (ICI wire time vs latency-bound hops) and the
+    # claims to bank are (a) the measured plan's winners per class
+    # (regenerate DESIGN.md §14's table from the plan dump:
+    # python -m akka_allreduce_tpu.ops.autotune) and (b) auto tracking
+    # the winning fixed arm at EVERY swept size; on a multi-slice pod
+    # the hierarchical arm prices the ICI x DCN hybrid for real.
+    # Fresh subprocess for the latency-hiding flags, like step 5.
+    ("autotuned_collectives", "suite", 1200, """
+import subprocess, sys
+subprocess.run([sys.executable, "-u", "scripts/bench_suite.py",
+                "--only", "quantized_collectives"], check=False)
+subprocess.run([sys.executable, "-m",
+                "akka_allreduce_tpu.ops.autotune", "--wire", "ef8"],
+               check=False)
+"""),
     # 3. the >=65%-bf16 scan-MFU claim, open since round 3: scan_steps
     # defaults True in measure_train_mfu — this is the rework that never
     # got chip time. guard_recompiles: every timed run holds under the

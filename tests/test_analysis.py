@@ -71,6 +71,11 @@ class TestCleanEntrypointsStayClean:
         # and the error-feedback wire (residual threaded, int8
         # discipline + exact counts) pinned lint-clean
         "collectives_swing", "collectives_ef8",
+        # ISSUE 13: the ICI x DCN hybrid (expect_hierarchical: exact
+        # f32 legs on the ICI axis, int8-only payload over the DCN
+        # group, residual present) and the autotuned-plan dispatch
+        # (the lowered program must BE the plan's pinned schedule)
+        "collectives_hierarchical", "collective_auto",
     ])
     def test_fast_entrypoints_lint_clean(self, target):
         from akka_allreduce_tpu.analysis.entrypoints import ENTRYPOINTS
@@ -115,17 +120,18 @@ class TestCleanEntrypointsStayClean:
         its KV pool (+ logits) with the markers surviving lowering, its
         page TABLE rides as a non-donated int32 operand (the builder
         raises on violation — re-asserted here over the flat record),
-        the catalog carries 20 entries (ISSUE 9 added
+        the catalog carries 22 entries (ISSUE 9 added
         collectives_swing + collectives_ef8; ISSUE 10 added
-        engine_speculative_step), and the traced program is
-        host-sync clean."""
+        engine_speculative_step; ISSUE 13 added
+        collectives_hierarchical + collective_auto), and the traced
+        program is host-sync clean."""
         import jax.numpy as jnp
 
         from akka_allreduce_tpu.analysis.entrypoints import (
             ENTRYPOINTS,
             build_engine_paged_step,
         )
-        assert len(ENTRYPOINTS) == 20
+        assert len(ENTRYPOINTS) == 22
         ctx = build_engine_paged_step()
         declared = sum(ctx.donated)
         assert declared >= 3  # k, v, logits at minimum
@@ -210,6 +216,66 @@ class TestCleanEntrypointsStayClean:
         # values + scales ride separate collectives: 2 all_to_alls in
         # phase 1, 2 all_gathers in phase 2 — paired
         assert a2a == ag == 2, (a2a, ag)
+
+    def test_collectives_hierarchical_structure(self):
+        """ISSUE 13 structural pin: the hierarchical entry's jaxpr
+        matches the plan's shape — exactly one f32 reduce-scatter and
+        one f32 all-gather on the ICI (ep) axis, exactly 2 int8
+        exchanges (values a2a + values ag) over the DCN (dp) group with
+        NO float psum/reduce_scatter crossing it, and the residual
+        operand present in the flat record (buckets-shaped f32 input
+        AND output). Raw counts pinned so a pass refactor cannot
+        silently stop looking."""
+        import jax.numpy as jnp
+
+        from akka_allreduce_tpu.analysis.core import (eqn_axes,
+                                                      out_dtype)
+        from akka_allreduce_tpu.analysis.entrypoints import (
+            build_collectives_hierarchical)
+        ctx = build_collectives_hierarchical()
+        rs_ici = ag_ici = int8_dcn = f32_red_dcn = 0
+        for eqn, _ in iter_eqns(ctx.jaxpr):
+            prim = eqn.primitive.name
+            axes = eqn_axes(eqn)
+            dt = out_dtype(eqn)
+            if "ep" in axes and dt == jnp.float32:
+                rs_ici += prim == "reduce_scatter"
+                ag_ici += prim == "all_gather"
+            if "dp" in axes:
+                if dt == jnp.int8 and prim in ("all_to_all",
+                                               "all_gather"):
+                    int8_dcn += 1
+                if dt == jnp.float32 and prim in ("psum",
+                                                  "reduce_scatter"):
+                    f32_red_dcn += 1
+        assert rs_ici == 1, rs_ici
+        assert ag_ici == 1, ag_ici
+        assert int8_dcn == 2, int8_dcn
+        assert f32_red_dcn == 0, f32_red_dcn
+        # residual operand: a buckets-shaped f32 arg ((num_buckets,
+        # bucket_elems=256) — the grads leaves are (32, 32)/(32,))
+        resid_ins = [a for a in ctx.in_avals
+                     if a.dtype == jnp.float32 and a.ndim == 2
+                     and a.shape[1] == 256]
+        assert resid_ins, [(a.shape, str(a.dtype))
+                           for a in ctx.in_avals]
+
+    def test_collective_auto_lowers_the_plan(self):
+        """ISSUE 13 structural pin: under a frozen plan whose entry
+        pins swing, the auto entry's jaxpr IS a swing program — the
+        ±2^t ppermute hops present (log2(2) = 1 int8-value + 1
+        f32-scale hop pair) and NO two-phase all_to_all (the fused
+        fallback's signature primitive): auto dispatched the plan, not
+        the default."""
+        from akka_allreduce_tpu.analysis.entrypoints import (
+            build_collective_auto)
+        ctx = build_collective_auto()
+        pp = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
+                 if eqn.primitive.name == "ppermute")
+        a2a = sum(1 for eqn, _ in iter_eqns(ctx.jaxpr)
+                  if eqn.primitive.name == "all_to_all")
+        assert pp >= 2, pp  # values + scales, one hop each at dp=2
+        assert a2a == 0, a2a
 
     def test_train_step_donates_and_pairs(self):
         """The flagship claims, asserted structurally (not just "no

@@ -1,0 +1,405 @@
+"""Collective autotuner tests (ISSUE 13, ops/autotune.py).
+
+The plan machinery's contracts: same measurements serialize to
+byte-identical plans (content hash stable), the sidecar round-trips
+through the atomic JSON writer and reloads instead of re-measuring on a
+matching fingerprint, a measurement cell that raises degrades to the
+hand-flag default instead of taking the run down, "auto" dispatch under
+a frozen plan is BITWISE the explicitly-flagged schedule it resolves
+to, and the train step under a frozen plan keeps the zero-recompile
+contract (one compile at warmup, zero after).
+"""
+
+import json
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.ops.autotune import (
+    CollectivePlan,
+    PlanEntry,
+    feasible_arms,
+    load_or_measure,
+    load_plan,
+    measure_plan,
+    plan_key,
+    plan_markdown_table,
+    resolve_schedule,
+    save_plan,
+)
+from akka_allreduce_tpu.parallel.dp import (GradSyncConfig,
+                                            allreduce_gradients)
+from akka_allreduce_tpu.parallel.mesh import single_axis_mesh
+
+N = 8
+
+
+def _mesh(n=N):
+    return single_axis_mesh("dp", devices=jax.devices()[:n])
+
+
+def _cell(timings):
+    """An injected measurement cell: fixed seconds per arm (the tests'
+    stand-in for the timing harness — same injected measurements must
+    mean byte-identical plans)."""
+    def cell(arm, rows, cols):
+        if arm not in timings:
+            raise RuntimeError(f"no timing scripted for {arm}")
+        t = timings[arm]
+        if isinstance(t, Exception):
+            raise t
+        return t
+    return cell
+
+
+class TestPlanDeterminism:
+    def test_same_measurements_byte_identical(self):
+        mesh = _mesh()
+        shapes = [(4, 512), (16, 2048)]
+        timings = {"fused": 2e-3, "windowed:4": 1.5e-3, "swing": 1e-3}
+        a = measure_plan(mesh, "dp", shapes, wire="f32",
+                         measure_cell=_cell(timings))
+        b = measure_plan(mesh, "dp", shapes, wire="f32",
+                         measure_cell=_cell(timings))
+        assert a.canonical_bytes() == b.canonical_bytes()
+        assert a.plan_hash == b.plan_hash
+        # and the hash is content-sensitive, not incidental
+        c = measure_plan(mesh, "dp", shapes, wire="f32",
+                         measure_cell=_cell({**timings, "swing": 3e-3}))
+        assert c.plan_hash != a.plan_hash
+
+    def test_winner_is_the_measured_minimum(self):
+        mesh = _mesh()
+        plan = measure_plan(
+            mesh, "dp", [(8, 256)], wire="f32",
+            measure_cell=_cell({"fused": 5e-3, "windowed:4": 1e-3,
+                                "swing": 2e-3}))
+        e = plan.lookup(8, 256)
+        assert (e.schedule, e.num_windows) == ("windowed", 4)
+        # every arm's median banked for the table/regeneration story
+        assert set(e.timings_us) == {"fused", "windowed:4", "swing"}
+
+    def test_feasible_arms_mirror_dispatch_validation(self):
+        # single pow2 axis: everything single-axis
+        assert feasible_arms("f32", [8], rows=8) == \
+            ["fused", "windowed:4", "swing"]
+        # non-pow2 group: no swing
+        assert feasible_arms("f32", [6], rows=8) == \
+            ["fused", "windowed:4"]
+        # one bucket row: nothing to window
+        assert feasible_arms("f32", [8], rows=1) == ["fused", "swing"]
+        # two live axes: the quantized two-phase cannot span them
+        # (parallel/dp.py raises), so ef8 keeps ONLY the hierarchical
+        # hybrid and int8 has no arm at all; unquantized wires keep
+        # the fused psum, which handles any axis count
+        assert feasible_arms("f32", [2, 4], rows=8) == ["fused"]
+        assert feasible_arms("ef8", [2, 4], rows=8) == ["hierarchical"]
+        assert feasible_arms("int8", [2, 4], rows=8) == []
+
+    def test_markdown_table_renders_every_arm(self):
+        mesh = _mesh()
+        plan = measure_plan(
+            mesh, "dp", [(4, 512), (4, 4096)], wire="f32",
+            measure_cell=_cell({"fused": 2e-3, "windowed:4": 3e-3,
+                                "swing": 1e-3}))
+        table = plan_markdown_table(plan)
+        assert "4 x 512" in table and "4 x 4096" in table
+        assert "**swing**" in table
+        assert "swing (us/round)" in table
+
+
+class TestSidecar:
+    def test_round_trip_is_byte_identical(self, tmp_path):
+        mesh = _mesh()
+        plan = measure_plan(
+            mesh, "dp", [(4, 512)], wire="ef8",
+            measure_cell=_cell({"fused": 2e-3, "windowed:4": 1e-3,
+                                "swing": 3e-3}))
+        save_plan(str(tmp_path), plan)
+        back = load_plan(str(tmp_path))
+        assert back is not None
+        assert back.canonical_bytes() == plan.canonical_bytes()
+        assert back.plan_hash == plan.plan_hash
+
+    def test_reload_instead_of_remeasure(self, tmp_path):
+        mesh = _mesh()
+        calls = []
+
+        def counting_cell(arm, rows, cols):
+            calls.append(arm)
+            return {"fused": 2e-3, "windowed:4": 1e-3,
+                    "swing": 3e-3}[arm]
+
+        p1, reused1 = load_or_measure(
+            str(tmp_path), mesh, "dp", [(4, 512)], wire="f32",
+            measure_cell=counting_cell)
+        assert not reused1 and calls
+        calls.clear()
+        p2, reused2 = load_or_measure(
+            str(tmp_path), mesh, "dp", [(4, 512)], wire="f32",
+            measure_cell=counting_cell)
+        assert reused2 and not calls  # the restart contract
+        assert p2.plan_hash == p1.plan_hash
+
+    def test_fingerprint_mismatch_remeasures(self, tmp_path):
+        mesh = _mesh()
+        cell = _cell({"fused": 2e-3, "windowed:4": 1e-3, "swing": 3e-3})
+        load_or_measure(str(tmp_path), mesh, "dp", [(4, 512)],
+                        wire="f32", measure_cell=cell)
+        # different wire: the f32 plan must not serve ef8 dispatches
+        _, reused = load_or_measure(str(tmp_path), mesh, "dp",
+                                    [(4, 512)], wire="ef8",
+                                    measure_cell=cell)
+        assert not reused
+        # new shape class not in the sidecar: re-measure
+        _, reused = load_or_measure(str(tmp_path), mesh, "dp",
+                                    [(4, 512), (32, 512)], wire="ef8",
+                                    measure_cell=cell)
+        assert not reused
+
+    def test_corrupt_sidecar_remeasures(self, tmp_path):
+        (tmp_path / "collective_plan.json").write_text(
+            json.dumps({"version": 1, "wire": "f32"}))  # no axes
+        assert load_plan(str(tmp_path)) is None
+        mesh = _mesh()
+        plan, reused = load_or_measure(
+            str(tmp_path), mesh, "dp", [(4, 512)], wire="f32",
+            measure_cell=_cell({"fused": 1e-3, "windowed:4": 2e-3,
+                                "swing": 3e-3}))
+        assert not reused and plan.lookup(4, 512) is not None
+
+
+class TestFallback:
+    def test_raising_arm_falls_back_to_survivors(self):
+        mesh = _mesh()
+        plan = measure_plan(
+            mesh, "dp", [(4, 512)], wire="f32",
+            measure_cell=_cell({"fused": 2e-3,
+                                "windowed:4": RuntimeError("host noise"),
+                                "swing": 3e-3}))
+        e = plan.lookup(4, 512)
+        assert e.schedule == "fused"  # cheapest survivor
+        assert "windowed:4" not in e.timings_us
+        assert "host noise" in e.note  # the error recorded, not eaten
+
+    def test_every_arm_raising_yields_hand_flag_default(self):
+        mesh = _mesh()
+        boom = RuntimeError("no cell survived")
+        plan = measure_plan(
+            mesh, "dp", [(4, 512)], wire="f32",
+            measure_cell=_cell({"fused": boom, "windowed:4": boom,
+                                "swing": boom}))
+        e = plan.lookup(4, 512)
+        assert (e.schedule, e.num_windows) == ("fused", 1)
+        assert "hand-flag default" in e.note
+        # and the degraded plan still serializes deterministically
+        assert plan.plan_hash
+
+
+class TestResolve:
+    def test_no_plan_or_class_is_the_flag_default(self):
+        assert resolve_schedule(None, 4, 512, [8], "f32") == ("fused", 4)
+        plan = CollectivePlan(wire="f32", axes=(("dp", 8),), entries={})
+        assert resolve_schedule(plan, 4, 512, [8], "f32") == ("fused", 4)
+
+    def test_infeasible_winner_degrades(self):
+        def pin(schedule, windows=1):
+            return CollectivePlan(
+                wire="f32", axes=(("dp", 8),),
+                entries={plan_key(4, 512): PlanEntry(
+                    schedule=schedule, num_windows=windows,
+                    timings_us={})})
+        # swing pinned but the live group is no longer a power of two
+        assert resolve_schedule(pin("swing"), 4, 512, [6], "f32") == \
+            ("fused", 4)
+        # single-axis schedules pinned but the mesh grew a second axis
+        assert resolve_schedule(pin("windowed", 2), 4, 512, [2, 4],
+                                "f32") == ("fused", 4)
+        # hierarchical pinned but the wire is not ef8 / one axis folded
+        assert resolve_schedule(pin("hierarchical"), 4, 512, [2, 4],
+                                "int8") == ("fused", 4)
+        assert resolve_schedule(pin("hierarchical"), 4, 512, [8],
+                                "ef8") == ("fused", 4)
+        # feasible winners resolve verbatim
+        assert resolve_schedule(pin("windowed", 2), 4, 512, [8],
+                                "f32") == ("windowed", 2)
+        assert resolve_schedule(pin("hierarchical"), 4, 512, [2, 4],
+                                "ef8") == ("hierarchical", 4)
+        # a size-1 entry in live_sizes must not defeat the swing
+        # power-of-two guard (the single >1 size is what pairs)
+        assert resolve_schedule(pin("swing"), 4, 512, [1, 6], "f32") == \
+            ("fused", 4)
+        assert resolve_schedule(pin("swing"), 4, 512, [1, 8], "f32") == \
+            ("swing", 4)
+
+    def test_two_axis_ef8_fallback_is_hierarchical(self):
+        # on the (ef8, two >1 axes) geometry the fused two-phase cannot
+        # dispatch (parallel/dp.py raises) — the feasibility-aware
+        # fallback IS the hand flag an operator would have set there
+        assert resolve_schedule(None, 4, 512, [2, 4], "ef8") == \
+            ("hierarchical", 4)
+        empty = CollectivePlan(wire="ef8", axes=(("dp", 2), ("sp", 4)),
+                               entries={})
+        assert resolve_schedule(empty, 4, 512, [2, 4], "ef8") == \
+            ("hierarchical", 4)
+        # a stale single-axis plan's fused winner resolves hierarchical
+        # on the two-axis mesh too, never the undispatchable fused
+        stale = CollectivePlan(
+            wire="ef8", axes=(("dp", 8),),
+            entries={plan_key(4, 512): PlanEntry(
+                schedule="fused", num_windows=1, timings_us={})})
+        assert resolve_schedule(stale, 4, 512, [2, 4], "ef8") == \
+            ("hierarchical", 4)
+
+
+def _sync_under(plan_or_schedule, grads_stacked, transport="f32",
+                n=N, key_seed=None):
+    """Run allreduce_gradients under shard_map with either an explicit
+    schedule string or transport_schedule="auto" + a CollectivePlan."""
+    mesh = _mesh(n)
+    if isinstance(plan_or_schedule, str):
+        cfg = GradSyncConfig(bucket_elems=256, transport=transport,
+                             transport_schedule=plan_or_schedule,
+                             return_elem_counts=False)
+    else:
+        cfg = GradSyncConfig(bucket_elems=256, transport=transport,
+                             transport_schedule="auto",
+                             plan=plan_or_schedule,
+                             return_elem_counts=False)
+    quantized = transport in ("int8", "ef8")
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def run(stacked):
+        local = jax.tree.map(lambda x: x[0], stacked)
+        k = jax.random.key(7) if quantized else None
+        res = allreduce_gradients(local, cfg, quant_key=k)
+        return jax.tree.map(lambda x: x[None], res.grads)
+
+    return jax.tree.map(np.asarray, run(grads_stacked))
+
+
+class TestAutoDispatch:
+    def _grads(self, seed=11):
+        rng = np.random.default_rng(seed)
+        return {
+            "w": jnp.asarray(rng.normal(size=(N, 24, 40))
+                             .astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(N, 40))
+                             .astype(np.float32)),
+        }
+
+    def _plan_pinning(self, schedule, windows=1, wire="f32"):
+        # the bucket class of the _grads tree at bucket_elems=256:
+        # w 24x40=960 + b 40 = 1000 elems pack into 4 bucket rows
+        return CollectivePlan(
+            wire=wire, axes=(("dp", N),),
+            entries={plan_key(4, 256): PlanEntry(
+                schedule=schedule, num_windows=windows,
+                timings_us={schedule: 1.0})})
+
+    @pytest.mark.parametrize("schedule", ["fused", "swing"])
+    def test_auto_is_bitwise_the_pinned_schedule(self, schedule):
+        grads = self._grads()
+        fixed = _sync_under(schedule, grads)
+        auto = _sync_under(self._plan_pinning(schedule), grads)
+        for k in fixed:
+            np.testing.assert_array_equal(fixed[k], auto[k])
+
+    def test_auto_windowed_pins_window_count(self):
+        grads = self._grads()
+        # explicit windowed at W=2 vs a plan pinning windowed:2 — the
+        # plan's window count must override the config default (4)
+        mesh_cfg = GradSyncConfig(bucket_elems=256,
+                                  transport_schedule="windowed",
+                                  num_windows=2,
+                                  return_elem_counts=False)
+        mesh = _mesh()
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(stacked):
+            local = jax.tree.map(lambda x: x[0], stacked)
+            res = allreduce_gradients(local, mesh_cfg)
+            return jax.tree.map(lambda x: x[None], res.grads)
+
+        fixed = jax.tree.map(np.asarray, run(grads))
+        auto = _sync_under(self._plan_pinning("windowed", 2), grads)
+        for k in fixed:
+            np.testing.assert_array_equal(fixed[k], auto[k])
+
+    def test_auto_without_entry_is_fused(self):
+        grads = self._grads()
+        empty = CollectivePlan(wire="f32", axes=(("dp", N),),
+                               entries={})
+        fused = _sync_under("fused", grads)
+        auto = _sync_under(empty, grads)
+        for k in fused:
+            np.testing.assert_array_equal(fused[k], auto[k])
+
+    def test_result_reports_resolved_schedule(self):
+        mesh = _mesh()
+        cfg = GradSyncConfig(bucket_elems=256,
+                             transport_schedule="auto",
+                             plan=self._plan_pinning("swing"),
+                             return_elem_counts=False)
+        seen = {}
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                 out_specs=P("dp"), check_vma=False)
+        def run(stacked):
+            local = jax.tree.map(lambda x: x[0], stacked)
+            res = allreduce_gradients(local, cfg)
+            seen["schedule"] = res.schedule
+            return jax.tree.map(lambda x: x[None], res.grads)
+
+        run(self._grads())
+        assert seen["schedule"] == "swing"
+
+
+class TestZeroRecompileContract:
+    def test_train_step_under_frozen_plan(self):
+        """The acceptance criterion: warmup compiles one program per
+        (bucket-class, schedule) — here one class, one step program —
+        and steady state compiles ZERO under the guard."""
+        from akka_allreduce_tpu.analysis.recompile import (CompileLog,
+                                                           no_recompiles)
+        from akka_allreduce_tpu.models.train import (TrainConfig,
+                                                     dense_bucket_count,
+                                                     make_train_state,
+                                                     make_train_step)
+        from akka_allreduce_tpu.models.transformer import \
+            TransformerConfig
+        from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                      make_device_mesh)
+        mesh = make_device_mesh(MeshSpec(dp=8))
+        mcfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=4,
+                                 n_layers=2, d_ff=64, max_seq=16)
+        cfg = TrainConfig(model=mcfg, bucket_elems=256)
+        params, opt_state, opt = make_train_state(jax.random.key(0),
+                                                  cfg, mesh)
+        nb = dense_bucket_count(cfg, mesh, params)
+        plan = CollectivePlan(
+            wire="f32", axes=(("dp", 8),),
+            entries={plan_key(nb, 256): PlanEntry(
+                schedule="swing", num_windows=1,
+                timings_us={"swing": 1.0})})
+        import dataclasses
+        cfg = dataclasses.replace(cfg, transport_schedule="auto",
+                                  collective_plan=plan)
+        step = make_train_step(cfg, mesh, opt)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 61, size=(8, 16),
+                                          dtype=np.int32))
+        with CompileLog() as warm:
+            params, opt_state, _ = step(params, opt_state, tokens)
+        assert warm.compiled.count("step") == 1, warm.compiled
+        with no_recompiles("warmed auto-plan train step x3"):
+            for _ in range(3):
+                params, opt_state, metrics = step(params, opt_state,
+                                                  tokens)
+        assert np.isfinite(float(metrics["loss"]))

@@ -162,6 +162,154 @@ class TestEf8Collective:
         assert (np.asarray(r1)[0] != np.asarray(r0)[0]).any()
 
 
+class TestPhase2ErrorFeedback:
+    """ISSUE 13 (PR 9's named follow-up): error feedback on the
+    BROADCAST leg. With ``residual2`` the phase-2 quantize switches to
+    deterministic RTN of ``reduced + residual2`` and carries the error
+    forward, so the delivered value telescopes on BOTH legs — the
+    terminal error is two residuals, independent of round count,
+    instead of one residual plus T rounds of zero-mean broadcast
+    noise."""
+
+    def _runner2(self):
+        from akka_allreduce_tpu.ops.collectives import ef8_phase2_rows
+        mesh = single_axis_mesh("dp")
+        rows2 = ef8_phase2_rows(6, N)
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P(), P()),
+                 out_specs=(P(), P(), P()), check_vma=False)
+        def run(buckets, resid, resid2, key):
+            return ef8_two_phase_allreduce(buckets, key, "dp",
+                                           residual=resid,
+                                           residual2=resid2,
+                                           block_elems=128)
+
+        return run, rows2
+
+    def test_both_legs_telescope_beats_single_leg(self):
+        """The pin against the single-leg bound: the mean of T rounds'
+        outputs with phase-2 EF converges on the exact sum at least as
+        fast as with phase-1 EF alone — the broadcast noise is now
+        compensated, not just zero-mean."""
+        rng = np.random.default_rng(10)
+        b = jnp.asarray(rng.normal(size=(6, 300)).astype(np.float32))
+        exact = np.asarray(b) * N
+        run2, rows2 = self._runner2()
+        mesh = single_axis_mesh("dp")
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P()), check_vma=False)
+        def run1(buckets, resid, key):
+            return ef8_two_phase_allreduce(buckets, key, "dp",
+                                           residual=resid,
+                                           block_elems=128)
+
+        r1 = jnp.zeros_like(b)
+        r1b, r2b = jnp.zeros_like(b), jnp.zeros((rows2, 300),
+                                                jnp.float32)
+        single, both = [], []
+        for t in range(8):
+            o, r1 = run1(b, r1, jax.random.key(t))
+            single.append(np.asarray(o))
+            o2, r1b, r2b = run2(b, r1b, r2b, jax.random.key(t))
+            both.append(np.asarray(o2))
+        err_single = np.abs(np.mean(single, 0) - exact).mean()
+        err_both = np.abs(np.mean(both, 0) - exact).mean()
+        one = np.abs(both[0] - exact).mean()
+        assert err_both < one / 2, (err_both, one)
+        assert err_both <= err_single * 1.05, (err_both, err_single)
+
+    def test_phase2_residual_is_deterministic(self):
+        """Both legs deterministic RTN under residual2: same inputs ->
+        bitwise identical output AND both residuals (the checkpoint
+        property extends to the phase-2 state)."""
+        rng = np.random.default_rng(11)
+        b = jnp.asarray(rng.normal(size=(6, 300)).astype(np.float32))
+        run2, rows2 = self._runner2()
+        r1 = jnp.asarray((rng.normal(size=(6, 300)) * 1e-3)
+                         .astype(np.float32))
+        r2 = jnp.zeros((rows2, 300), jnp.float32)
+        o_a, r1_a, r2_a = run2(b, r1, r2, jax.random.key(1))
+        o_b, r1_b, r2_b = run2(b, r1, r2, jax.random.key(1))
+        np.testing.assert_array_equal(np.asarray(o_a), np.asarray(o_b))
+        np.testing.assert_array_equal(np.asarray(r1_a),
+                                      np.asarray(r1_b))
+        np.testing.assert_array_equal(np.asarray(r2_a),
+                                      np.asarray(r2_b))
+        assert (np.asarray(r2_a) != 0).any()
+
+    def test_shape_and_schedule_contracts(self):
+        """residual2 is owner-rows-shaped and fused-only — wrong shapes
+        and the windowed carve are rejected with the contract named."""
+        mesh = single_axis_mesh("dp")
+        b = jnp.zeros((6, 300), jnp.float32)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P(), P()), check_vma=False)
+        def bad_shape(buckets, resid, key):
+            return ef8_two_phase_allreduce(
+                buckets, key, "dp", residual=resid,
+                residual2=jnp.zeros((6, 300), jnp.float32),
+                block_elems=128)
+
+        with pytest.raises(ValueError, match="owner rows"):
+            bad_shape(b, jnp.zeros_like(b), jax.random.key(0))
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P(), P()), check_vma=False)
+        def windowed(buckets, resid, key):
+            return ef8_two_phase_allreduce(
+                buckets, key, "dp", residual=resid, num_windows=2,
+                residual2=jnp.zeros((1, 300), jnp.float32),
+                block_elems=128)
+
+        with pytest.raises(ValueError, match="fused"):
+            windowed(b, jnp.zeros_like(b), jax.random.key(0))
+
+    def test_grad_sync_threads_residual2(self):
+        """allreduce_gradients carries residual2 through the fused ef8
+        path and returns the updated state in GradSyncResult — and
+        rejects it on every other schedule/wire."""
+        from akka_allreduce_tpu.ops.collectives import ef8_phase2_rows
+        rng = np.random.default_rng(12)
+        g = {"w": jnp.asarray(rng.normal(size=(24, 40))
+                              .astype(np.float32))}
+        mesh = single_axis_mesh("dp")
+        cfg = GradSyncConfig(bucket_elems=256, axis_name="dp",
+                             transport="ef8",
+                             return_elem_counts=False)
+        rows2 = ef8_phase2_rows(4, N)  # 960 elems -> 4 buckets
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P(), P()), check_vma=False)
+        def run(tree, r2, key):
+            res = allreduce_gradients(tree, cfg, quant_key=key,
+                                      residual2=r2)
+            assert res.residual2 is not None
+            return res.grads, res.residual, res.residual2
+
+        r2 = jnp.zeros((rows2, 256), jnp.float32)
+        out, r1, r2n = run(g, r2, jax.random.key(0))
+        assert np.isfinite(np.asarray(out["w"])).all()
+        assert np.asarray(r2n).shape == (rows2, 256)
+        assert (np.asarray(r2n) != 0).any()
+
+        bad = GradSyncConfig(bucket_elems=256, axis_name="dp",
+                             transport="ef8",
+                             transport_schedule="swing",
+                             return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=P(), check_vma=False)
+        def run_bad(tree, r2, key):
+            return allreduce_gradients(tree, bad, quant_key=key,
+                                       residual2=r2).grads
+
+        with pytest.raises(ValueError, match="residual2"):
+            run_bad(g, r2, jax.random.key(0))
+
+
 class TestMaskOnIdentityPath:
     def test_size_one_axis_still_masks(self):
         """Review regression pin: on a size-1 data axis the quantized
@@ -218,6 +366,42 @@ class TestEf8Training:
                                                 tokens(i), ef)
             losses.append(float(m["loss"]))
         return losses, ef
+
+    @pytest.mark.slow
+    def test_loss_error_bound_hierarchical(self):
+        """The ISSUE 13 acceptance pin at the train level: an 8-step
+        run on the ICI x DCN hybrid schedule (dp outer x sp inner)
+        stays within the same fixed loss bound of the exact f32 run —
+        the compressed DCN leg's error is compensated, not drifting."""
+        mesh = make_device_mesh(MeshSpec(dp=2, sp=2),
+                                devices=jax.devices()[:4])
+        base = dict(model=MCFG, bucket_elems=256,
+                    grad_axes=("dp", "sp"), learning_rate=5e-3)
+
+        def run(cfg):
+            params, opt_state, opt = make_train_state(
+                jax.random.key(0), cfg, mesh)
+            ef = init_ef_state(cfg, mesh, params)
+            step = make_train_step(cfg, mesh, opt)
+            losses = []
+            for i in range(8):
+                if ef is None:
+                    params, opt_state, m = step(params, opt_state,
+                                                tokens(i))
+                else:
+                    params, opt_state, m, ef = step(params, opt_state,
+                                                    tokens(i), ef)
+                losses.append(float(m["loss"]))
+            return losses, ef
+
+        exact, _ = run(TrainConfig(**base))
+        hier, ef = run(TrainConfig(
+            **base, grad_transport="ef8",
+            transport_schedule="hierarchical"))
+        assert all(np.isfinite(hier))
+        deltas = [abs(a - b) for a, b in zip(hier, exact)]
+        assert max(deltas) < 0.05, deltas
+        assert float(jnp.abs(ef).max()) > 0
 
     @pytest.mark.parametrize("schedule", ["fused", "swing"])
     def test_loss_error_bound_vs_exact(self, schedule):
@@ -307,18 +491,101 @@ class TestEf8Training:
         assert max(deltas) < 0.1, deltas
         assert float(jnp.abs(ef).max()) > 0
 
-    @pytest.mark.slow
-    def test_moe_rejected(self):
+    def test_moe_carries_two_residual_planes(self):
+        """ISSUE 13 lifted the flag-layer MoE exclusion: the ef state
+        is a {"dense", "expert"} dict — the expert sync (its own
+        collective with its own bucket geometry) compensates its own
+        wire's error in its own plane. Pins: both planes exist with
+        INDEPENDENT bucket geometry, both pick up real RTN error over
+        a run, the update is deterministic (same inputs -> bitwise same
+        planes, the checkpoint property), and the run stays within the
+        exact-sync loss bound."""
+        from akka_allreduce_tpu.models.train import (
+            dense_bucket_count, expert_bucket_count)
         from akka_allreduce_tpu.parallel.ep import MoEConfig
         import dataclasses
         mcfg = dataclasses.replace(
             MCFG, moe=MoEConfig(n_experts=2, d_ff=64))
-        cfg = TrainConfig(model=mcfg, bucket_elems=256,
-                          grad_axes=("dp",), grad_transport="ef8")
         mesh = make_device_mesh(MeshSpec(dp=2),
                                 devices=jax.devices()[:2])
-        with pytest.raises(ValueError, match="MoE"):
-            make_grad_step(cfg, mesh)
+        base = dict(model=mcfg, bucket_elems=256, grad_axes=("dp",),
+                    learning_rate=5e-3)
+        cfg = TrainConfig(**base, grad_transport="ef8")
+        params, opt_state, opt = make_train_state(jax.random.key(0),
+                                                  cfg, mesh)
+        ef = init_ef_state(cfg, mesh, params)
+        assert set(ef) == {"dense", "expert"}
+        nb_d = dense_bucket_count(cfg, mesh, params)
+        nb_e = expert_bucket_count(cfg, mesh, params)
+        assert ef["dense"].shape == (2, nb_d, 256)
+        assert ef["expert"].shape == (2, nb_e, 256)
+        step = make_train_step(cfg, mesh, opt)
+        losses = []
+        for i in range(8):
+            params, opt_state, m, ef = step(params, opt_state,
+                                            tokens(i), ef)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(losses))
+        # both planes compensated something: the expert wire's error
+        # lands in the expert plane, not smeared into the dense one
+        assert float(jnp.abs(ef["dense"]).max()) > 0
+        assert float(jnp.abs(ef["expert"]).max()) > 0
+        # loss parity vs the exact run on identical data — the
+        # telescoping quality claim now covering the expert plane too
+        exact, _ = self._train(TrainConfig(**base))
+        deltas = [abs(a - b) for a, b in zip(losses, exact)]
+        assert max(deltas) < 0.05, deltas
+
+    def test_moe_expert_plane_is_deterministic_and_separate(self):
+        """Same params, same tokens, same seed -> bitwise identical
+        planes (restore-grade determinism); and the two planes hold
+        DIFFERENT values (independent accumulators, not views)."""
+        from akka_allreduce_tpu.parallel.ep import MoEConfig
+        import dataclasses
+        mcfg = dataclasses.replace(
+            MCFG, moe=MoEConfig(n_experts=2, d_ff=64))
+        mesh = make_device_mesh(MeshSpec(dp=2),
+                                devices=jax.devices()[:2])
+        cfg = TrainConfig(model=mcfg, bucket_elems=256,
+                          grad_axes=("dp",), grad_transport="ef8")
+        params, _, _ = make_train_state(jax.random.key(0), cfg, mesh)
+        gs = make_grad_step(cfg, mesh)
+        ef0 = init_ef_state(cfg, mesh, params)
+        _, _, ef1 = gs(params, tokens(), 7, ef_state=ef0)
+        _, _, ef2 = gs(params, tokens(), 7, ef_state=ef0)
+        np.testing.assert_array_equal(np.asarray(ef1["dense"]),
+                                      np.asarray(ef2["dense"]))
+        np.testing.assert_array_equal(np.asarray(ef1["expert"]),
+                                      np.asarray(ef2["expert"]))
+        assert (np.asarray(ef1["dense"]) != 0).any()
+        assert (np.asarray(ef1["expert"]) != 0).any()
+
+    @pytest.mark.slow
+    def test_moe_expert_plane_sharded_over_ep(self):
+        """With a real expert axis (ep=2), both planes' leading rank
+        axis covers the ep ranks too (ep doubles as a data axis for the
+        dense plane; the expert plane is ep-rank-owned like the weights
+        it compensates), and training stays finite."""
+        from akka_allreduce_tpu.parallel.ep import MoEConfig
+        import dataclasses
+        mcfg = dataclasses.replace(
+            MCFG, moe=MoEConfig(n_experts=2, d_ff=64))
+        mesh = make_device_mesh(MeshSpec(dp=1, ep=2),
+                                devices=jax.devices()[:2])
+        cfg = TrainConfig(model=mcfg, bucket_elems=256,
+                          grad_axes=("dp",), grad_transport="ef8",
+                          learning_rate=5e-3)
+        params, opt_state, opt = make_train_state(jax.random.key(0),
+                                                  cfg, mesh)
+        ef = init_ef_state(cfg, mesh, params)
+        # _ef_state_axes covers dp AND ep: 1 * 2 = 2 rank planes
+        assert ef["dense"].shape[0] == 2
+        assert ef["expert"].shape[0] == 2
+        step = make_train_step(cfg, mesh, opt)
+        for i in range(3):
+            params, opt_state, m, ef = step(params, opt_state,
+                                            tokens(i), ef)
+            assert np.isfinite(float(m["loss"]))
 
     def test_missing_ef_state_rejected(self):
         cfg = TrainConfig(model=MCFG, bucket_elems=256,
@@ -414,3 +681,180 @@ class TestEf8CheckpointRestore:
         ef1 = np.asarray(ef1)
         np.testing.assert_array_equal(ef1[1, 0], np.zeros((256,)))
         assert (ef1[1, 1:] != 0).any()
+
+
+class TestDeadlineTrainerResidual:
+    """ISSUE 13: the deadline trainer carries the ef8 residual as its
+    own state — rebinding it per dispatch, composing with round masks,
+    and exposing it for the checkpoint's 'sync' item."""
+
+    def _setup(self, max_lag=0):
+        from akka_allreduce_tpu.models.train import dense_bucket_count
+        from akka_allreduce_tpu.runtime.pacer import RoundClock
+        from akka_allreduce_tpu.runtime.straggler import DeadlineTrainer
+        cfg = TrainConfig(model=MCFG, bucket_elems=256,
+                          grad_axes=("dp",), grad_transport="ef8",
+                          learning_rate=5e-3)
+        mesh = make_device_mesh(MeshSpec(dp=2),
+                                devices=jax.devices()[:2])
+        params, opt_state, opt = make_train_state(jax.random.key(0),
+                                                  cfg, mesh)
+        ef = init_ef_state(cfg, mesh, params)
+        step = make_train_step(cfg, mesh, opt, dynamic_valid=True)
+        nb = dense_bucket_count(cfg, mesh, params)
+        clock = RoundClock(2, deadline_s=30.0)
+        trainer = DeadlineTrainer(step, clock, nb, max_lag=max_lag,
+                                  ef_state=ef)
+        return cfg, mesh, params, opt_state, step, trainer, ef, nb
+
+    def test_residual_threads_and_matches_manual_stepping(self):
+        """The trainer's rounds must be BITWISE the hand-threaded step
+        calls with the same masks — the residual rebinding is pure
+        plumbing, not a numerics change."""
+        (cfg, mesh, params, opt_state, step, trainer, ef0,
+         nb) = self._setup()
+        p2, o2, ef2 = params, opt_state, ef0
+        for i in range(3):
+            r = trainer.open_round()
+            trainer.clock.report_offset(r, 0, 0.0)
+            # peer 1 misses round 1's deadline
+            trainer.clock.report_offset(
+                r, 1, (2.0 if i == 1 else 0.0)
+                * trainer.clock.deadline_s)
+            params, opt_state, m = trainer.run_round(params, opt_state,
+                                                     tokens(i))
+            mask = np.ones((2, nb), np.float32)
+            if i == 1:
+                mask[1] = 0.0
+            p2, o2, m2, ef2 = step(p2, o2, tokens(i), ef2, mask)
+            assert float(m["loss"]) == float(m2["loss"]), i
+        trainer.drain()
+        np.testing.assert_array_equal(np.asarray(trainer.ef_state),
+                                      np.asarray(ef2))
+        assert trainer.reports[1].n_masked == 1
+        assert (np.asarray(ef2) != 0).any()
+
+    def test_state_round_trip_resumes_bitwise(self):
+        """Capture (params, opt_state, trainer.ef_state) after a round,
+        rebuild the trainer with the captured residual (what a
+        checkpoint restore does), replay — losses and final residual
+        bitwise the uninterrupted run's."""
+        from akka_allreduce_tpu.runtime.pacer import RoundClock
+        from akka_allreduce_tpu.runtime.straggler import DeadlineTrainer
+        (cfg, mesh, params, opt_state, step, trainer, ef0,
+         nb) = self._setup()
+
+        def on_time(r):
+            for peer in range(2):
+                trainer.clock.report_offset(r, peer, 0.0)
+
+        losses, saved = [], None
+        for i in range(4):
+            r = trainer.open_round()
+            on_time(r)
+            params, opt_state, m = trainer.run_round(params, opt_state,
+                                                     tokens(i))
+            losses.append(float(m["loss"]))
+            if i == 1:
+                trainer.drain()
+                saved = (params, opt_state, trainer.ef_state)
+        trainer.drain()
+        final_ef = np.asarray(trainer.ef_state)
+
+        p2, o2, ef2 = saved
+        clock2 = RoundClock(2, deadline_s=30.0)
+        t2 = DeadlineTrainer(step, clock2, nb, max_lag=0, ef_state=ef2)
+        resumed = []
+        for i in range(2, 4):
+            r = t2.open_round()
+            for peer in range(2):
+                t2.clock.report_offset(r, peer, 0.0)
+            p2, o2, m = t2.run_round(p2, o2, tokens(i))
+            resumed.append(float(m["loss"]))
+        t2.drain()
+        assert resumed == losses[2:], (resumed, losses[2:])
+        np.testing.assert_array_equal(np.asarray(t2.ef_state), final_ef)
+
+
+class TestDcnTrainerResidual:
+    """ISSUE 13 closes the 'DCN trainers don't thread the residual at
+    all' gap: DcnDeadlineTrainer owns the local plane's ef8 residual —
+    lazy init at the first round, rebound every round, restorable via
+    set_ef_state for the checkpoint's 'sync' item."""
+
+    def _mk(self, client, saved_ef=None):
+        import optax
+        from akka_allreduce_tpu.runtime.dcn_train import \
+            DcnDeadlineTrainer
+        cfg = TrainConfig(model=MCFG, bucket_elems=256,
+                          grad_axes=("dp",), grad_transport="ef8",
+                          learning_rate=5e-3)
+        mesh = make_device_mesh(MeshSpec(dp=2),
+                                devices=jax.devices()[:2])
+        params, opt_state, opt = make_train_state(jax.random.key(0),
+                                                  cfg, mesh)
+        tr = DcnDeadlineTrainer(cfg, mesh, opt, deadline_s=30.0,
+                                rank=0, num_processes=1, client=client,
+                                retain_rounds=16,
+                                hb_interval_s=0.1, hb_timeout_s=0.0)
+        if saved_ef is not None:
+            tr.set_ef_state(saved_ef)
+        return tr, params, opt_state
+
+    def test_threads_residual_and_resumes_bitwise(self):
+        import sys
+        sys.path.insert(0, "tests")
+        from kv_fake import FakeKvClient
+        client = FakeKvClient()
+        tr, params, opt_state = self._mk(client)
+        assert tr.ef_state is None  # lazy until the first round
+        losses, saved = [], None
+        for i in range(4):
+            params, opt_state, rep = tr.run_round(params, opt_state,
+                                                  tokens(i))
+            losses.append(rep.loss)
+            if i == 1:
+                # deep-copy: the apply step donates its inputs, so the
+                # captured buffers would otherwise be consumed by the
+                # next round (exactly what a real checkpoint avoids by
+                # copying to host before save returns)
+                saved = jax.tree.map(jnp.copy,
+                                     (params, opt_state, tr.ef_state))
+        assert tr.ef_state is not None
+        assert float(jnp.abs(tr.ef_state).max()) > 0
+        final_ef = np.asarray(tr.ef_state)
+        tr.close()
+
+        # the checkpoint-resume shape: fresh trainer, set_ef_state with
+        # the captured residual, same start round, same data
+        p2, o2, ef2 = saved
+        tr2, _, _ = self._mk(FakeKvClient(), saved_ef=ef2)
+        tr2.set_start_round(2)
+        resumed = []
+        for i in range(2, 4):
+            p2, o2, rep = tr2.run_round(p2, o2, tokens(i))
+            resumed.append(rep.loss)
+        assert resumed == losses[2:], (resumed, losses[2:])
+        np.testing.assert_array_equal(np.asarray(tr2.ef_state),
+                                      final_ef)
+        tr2.close()
+
+    def test_set_ef_state_guards_wire(self):
+        import sys
+        sys.path.insert(0, "tests")
+        from kv_fake import FakeKvClient
+        import optax
+        from akka_allreduce_tpu.runtime.dcn_train import \
+            DcnDeadlineTrainer
+        cfg = TrainConfig(model=MCFG, bucket_elems=256,
+                          grad_axes=("dp",))  # f32 wire: no residual
+        mesh = make_device_mesh(MeshSpec(dp=2),
+                                devices=jax.devices()[:2])
+        tr = DcnDeadlineTrainer(cfg, mesh, optax.sgd(1e-3),
+                                deadline_s=30.0, rank=0,
+                                num_processes=1,
+                                client=FakeKvClient(),
+                                retain_rounds=16, hb_timeout_s=0.0)
+        with pytest.raises(ValueError, match="ef8"):
+            tr.set_ef_state(jnp.zeros((2, 4, 256)))
+        tr.close()

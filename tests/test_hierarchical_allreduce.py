@@ -1,0 +1,314 @@
+"""Hierarchical ICI x DCN allreduce tests (ISSUE 13).
+
+The schedule: exact reduce-scatter over the fast inner axis, an ef8
+block-quantized exchange WITH error feedback over the slow outer group,
+exact all-gather back. Contracts pinned here: closeness to the exact
+psum (block-int8 envelope), the residual telescoping across rounds
+exactly as the flat ef8 wire's does, bitwise reproducibility (the
+checkpoint property), degenerate-axis composition (|ici| = 1 IS the
+ef8 two-phase; |dcn| = 1 is the exact sync), the full-state residual
+contract (only owned-shard columns update), and the DCN-dropout
+masked-row rule (masked rows contribute exact zeros and their residual
+carries over unchanged).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from akka_allreduce_tpu.ops.collectives import (
+    ef8_two_phase_allreduce,
+    hierarchical_allreduce,
+)
+from akka_allreduce_tpu.parallel.dp import (GradSyncConfig,
+                                            allreduce_gradients)
+from akka_allreduce_tpu.parallel.mesh import MeshSpec, make_device_mesh
+
+# dp = the outer/slow (DCN-like) group, ep = the inner/fast (ICI-like)
+# axis — the same roles parallel/dp.py assigns from axis order
+DCN, ICI = "dp", "ep"
+
+
+def _mesh(dcn=2, ici=4):
+    return make_device_mesh(MeshSpec(dp=dcn, ep=ici),
+                            devices=jax.devices()[:dcn * ici])
+
+
+def _runner(dcn=2, ici=4, block=128, with_valid=False):
+    mesh = _mesh(dcn, ici)
+
+    if with_valid:
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P(), P()), out_specs=(P(), P()),
+                 check_vma=False)
+        def run(buckets, resid, key, valid):
+            key = jax.random.fold_in(key, lax.axis_index(DCN))
+            return hierarchical_allreduce(buckets, key, DCN, ICI,
+                                          residual=resid, valid=valid,
+                                          block_elems=block)
+        return run
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+             out_specs=(P(), P()), check_vma=False)
+    def run(buckets, resid, key):
+        return hierarchical_allreduce(buckets, key, DCN, ICI,
+                                      residual=resid, block_elems=block)
+
+    return run
+
+
+class TestHierarchicalExactness:
+    def test_close_to_exact_psum(self):
+        """Replicated input: the group sum is input * group size; the
+        only error is the DCN leg's block-int8 rounding (compensated
+        next round, bounded this round)."""
+        rng = np.random.default_rng(0)
+        b = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        out, _ = _runner()(b, jnp.zeros_like(b), jax.random.key(0))
+        exact = np.asarray(b) * 8
+        err = np.abs(np.asarray(out) - exact)
+        scale = np.abs(exact).max()
+        assert err.max() < 0.05 * scale, (err.max(), scale)
+
+    def test_bitwise_reproducible(self):
+        """Same inputs, same key -> bitwise identical output AND
+        residual — the property checkpoint restore relies on (the DCN
+        contribution hop is deterministic RTN)."""
+        rng = np.random.default_rng(1)
+        b = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        r0 = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32)
+                         * 1e-3)
+        run = _runner()
+        o1, r1 = run(b, r0, jax.random.key(3))
+        o2, r2 = run(b, r0, jax.random.key(3))
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+
+    def test_residual_telescopes(self):
+        """The EF claim on the hybrid: the mean of T rounds' outputs
+        converges on the exact sum faster than one round and faster
+        than the same schedule WITHOUT feedback."""
+        rng = np.random.default_rng(2)
+        b = jnp.asarray(rng.normal(size=(6, 300)).astype(np.float32))
+        exact = np.asarray(b) * 8
+        run = _runner()
+        resid = jnp.zeros_like(b)
+        with_ef, without_ef = [], []
+        for t in range(8):
+            o, resid = run(b, resid, jax.random.key(t))
+            with_ef.append(np.asarray(o))
+            o2, _ = run(b, jnp.zeros_like(b), jax.random.key(t))
+            without_ef.append(np.asarray(o2))
+        one = np.abs(with_ef[0] - exact).mean()
+        ef_err = np.abs(np.mean(with_ef, 0) - exact).mean()
+        no_ef_err = np.abs(np.mean(without_ef, 0) - exact).mean()
+        assert ef_err < one / 2, (ef_err, one)
+        assert ef_err < no_ef_err, (ef_err, no_ef_err)
+
+    def test_residual_updates_only_owned_shard_columns(self):
+        """The full-state contract: each rank's residual keeps the
+        bucket shape, but only the columns of the shard it owns after
+        the ICI reduce-scatter change — the rest ride through
+        untouched (here: primed with a sentinel that must survive)."""
+        rng = np.random.default_rng(3)
+        dcn, ici = 2, 4
+        b = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        sentinel = jnp.full((4, 256), 7.25, jnp.float32)
+        mesh = _mesh(dcn, ici)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P()), check_vma=False)
+        def run(buckets, resid, key):
+            out, new_r = hierarchical_allreduce(buckets, key, DCN, ICI,
+                                                residual=resid,
+                                                block_elems=128)
+            # expose this rank's view with its ici coordinate so the
+            # host can check the per-rank column windows
+            me = lax.axis_index(ICI)
+            return out, (new_r, jnp.broadcast_to(me, (1,)))
+
+        _, (new_r, _) = run(b, sentinel, jax.random.key(0))
+        new_r = np.asarray(new_r)
+        # replicated out_spec returns ONE rank's view (ici rank 0 on
+        # dcn group 0): its owned window is columns [0, 64); the other
+        # columns must still hold the sentinel
+        cols = 256 // ici
+        assert (new_r[:, cols:] == 7.25).all()
+        assert (new_r[:, :cols] != 7.25).any()
+
+    def test_degenerate_ici_is_the_flat_ef8(self):
+        """|ici| = 1: the ICI legs are the identity, so the schedule IS
+        ef8_two_phase_allreduce over the DCN group — bitwise."""
+        rng = np.random.default_rng(4)
+        b = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        mesh = _mesh(dcn=8, ici=1)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P(), P(), P()), check_vma=False)
+        def run(buckets, resid, key):
+            h, hr = hierarchical_allreduce(buckets, key, DCN, ICI,
+                                           residual=resid,
+                                           block_elems=128)
+            f, fr = ef8_two_phase_allreduce(buckets, key, DCN,
+                                            residual=resid,
+                                            block_elems=128)
+            return h, hr, f, fr
+
+        h, hr, f, fr = run(b, jnp.zeros_like(b), jax.random.key(5))
+        np.testing.assert_array_equal(np.asarray(h), np.asarray(f))
+        np.testing.assert_array_equal(np.asarray(hr), np.asarray(fr))
+
+    def test_degenerate_dcn_is_exact(self):
+        """|dcn| = 1: the DCN leg is the identity sync (nothing
+        compressed, residual unchanged), leaving the exact two-phase
+        over ICI — equal to psum up to float tolerance, residual
+        bitwise untouched."""
+        rng = np.random.default_rng(5)
+        b = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        r0 = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        mesh = _mesh(dcn=1, ici=8)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P(), P()), check_vma=False)
+        def run(buckets, resid, key):
+            h, hr = hierarchical_allreduce(buckets, key, DCN, ICI,
+                                           residual=resid,
+                                           block_elems=128)
+            return h, hr, lax.psum(buckets, ICI)
+
+        h, hr, p = run(b, r0, jax.random.key(6))
+        np.testing.assert_allclose(np.asarray(h), np.asarray(p),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(hr), np.asarray(r0))
+
+
+class TestDcnDropout:
+    def test_masked_rows_contribute_zero_and_keep_residual(self):
+        """The DCN-dropout contract: rows masked for a round contribute
+        exact zeros to BOTH legs (output == sum of surviving
+        contributions' quantized exchange), and the masked rows'
+        residual carries over UNCHANGED — a protocol drop is not a
+        compression error."""
+        rng = np.random.default_rng(6)
+        b = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        r0 = jnp.asarray((rng.normal(size=(4, 256)) * 1e-3)
+                         .astype(np.float32))
+        valid = jnp.asarray([0.0, 1.0, 1.0, 1.0], jnp.float32)
+        out, new_r = _runner(with_valid=True)(
+            b, r0, jax.random.key(7), valid)
+        out, new_r = np.asarray(out), np.asarray(new_r)
+        # masked row 0: zero contribution from EVERY rank (replicated
+        # input, group-wide mask) -> the reduced row is exactly zero
+        np.testing.assert_array_equal(out[0], np.zeros((256,)))
+        # and its residual is EXACTLY the prior state, all columns
+        np.testing.assert_array_equal(new_r[0], np.asarray(r0)[0])
+        # surviving rows moved and their owned-shard residual updated
+        assert (out[1:] != 0).any()
+        assert (new_r[1:] != np.asarray(r0)[1:]).any()
+
+    def test_mid_run_dropout_recovers(self):
+        """A dropout ROUND in a chain: rounds before and after carry
+        the residual across the masked round; the telescoped mean over
+        the surviving rounds still converges (the masked round simply
+        contributes nothing — no poisoned feedback)."""
+        rng = np.random.default_rng(7)
+        b = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32))
+        exact = np.asarray(b) * 8
+        run = _runner(with_valid=True)
+        ones = jnp.ones((4,), jnp.float32)
+        drop = jnp.zeros((4,), jnp.float32)  # whole-round DCN dropout
+        resid = jnp.zeros_like(b)
+        outs = []
+        for t in range(6):
+            v = drop if t == 2 else ones
+            o, resid = run(b, resid, jax.random.key(t), v)
+            if t == 2:
+                np.testing.assert_array_equal(np.asarray(o),
+                                              np.zeros((4, 256)))
+            else:
+                outs.append(np.asarray(o))
+        err = np.abs(np.mean(outs, 0) - exact).mean()
+        one = np.abs(outs[0] - exact).mean()
+        assert err < one, (err, one)
+
+
+class TestGradSyncIntegration:
+    """allreduce_gradients on transport_schedule='hierarchical'."""
+
+    def _grads(self, seed=11):
+        rng = np.random.default_rng(seed)
+        return {
+            "w": jnp.asarray(rng.normal(size=(24, 40))
+                             .astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(40,)).astype(np.float32)),
+        }
+
+    def test_matches_exact_mean_within_envelope(self):
+        grads = self._grads()
+        mesh = _mesh(2, 4)
+        cfg = GradSyncConfig(bucket_elems=256, axis_name=(DCN, ICI),
+                             transport="ef8",
+                             transport_schedule="hierarchical",
+                             return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=(P(), P(), P()), check_vma=False)
+        def run(tree, key, resid):
+            res = allreduce_gradients(tree, cfg, quant_key=key,
+                                      residual=resid)
+            assert res.schedule == "hierarchical"
+            exact = jax.tree.map(
+                lambda g: lax.psum(g, (DCN, ICI)) / 8.0, tree)
+            return res.grads, exact, res.residual
+
+        nb = 4  # 1000 elems at 256/bucket
+        resid0 = jnp.zeros((nb, 256), jnp.float32)
+        got, exact, resid = run(grads, jax.random.key(0), resid0)
+        for k in got:
+            g, e = np.asarray(got[k]), np.asarray(exact[k])
+            assert np.abs(g - e).max() < 0.05 * np.abs(e).max() + 1e-6
+        assert (np.asarray(resid) != 0).any()
+
+    def test_degraded_mesh_runs_fused(self):
+        """One live axis under the hierarchical flag: the sync degrades
+        to the fused ef8 two-phase (reported via result.schedule) —
+        the mesh-shrank-under-the-flag path."""
+        grads = self._grads()
+        mesh = _mesh(dcn=8, ici=1)  # the ici axis folded to size 1
+        cfg = GradSyncConfig(bucket_elems=256, axis_name=("dp", "ep"),
+                             transport="ef8",
+                             transport_schedule="hierarchical",
+                             return_elem_counts=False)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                 out_specs=P(), check_vma=False)
+        def run(tree, key, resid):
+            res = allreduce_gradients(tree, cfg, quant_key=key,
+                                      residual=resid)
+            assert res.schedule == "fused"
+            return res.grads
+
+        resid0 = jnp.zeros((4, 256), jnp.float32)
+        out = run(grads, jax.random.key(0), resid0)
+        assert all(np.isfinite(np.asarray(v)).all()
+                   for v in jax.tree.leaves(out))
+
+    def test_wrong_wire_rejected(self):
+        cfg = GradSyncConfig(bucket_elems=256, axis_name=(DCN, ICI),
+                             transport="int8",
+                             transport_schedule="hierarchical",
+                             return_elem_counts=False)
+        mesh = _mesh(2, 4)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(), P()),
+                 out_specs=P(), check_vma=False)
+        def run(tree, key):
+            return allreduce_gradients(tree, cfg, quant_key=key).grads
+
+        with pytest.raises(ValueError, match="hierarchical"):
+            run(self._grads(), jax.random.key(0))
