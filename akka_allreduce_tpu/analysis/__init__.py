@@ -15,23 +15,38 @@ completion counts.
 Layout:
 
 * ``core``         — Finding/LintPolicy/LintContext, the pass registry,
-                     and the recursive jaxpr walk every pass shares.
+                     the recursive jaxpr walk every pass shares, and
+                     the shared dropped-donation reporter both planes
+                     use.
 * ``passes``       — the pass catalog: collective-axis consistency,
                      donation/aliasing audit, dtype-promotion lint,
                      host-sync hazards.
+* ``hlo``          — the compiled-module plane (``lint --hlo``): a
+                     lexical parser for optimized HLO text and the
+                     hlo-aliasing / hlo-overlap / hlo-census /
+                     hlo-fusion catalog — the input_output_alias
+                     table, async start/done overlap, and collective
+                     census of the programs XLA actually built.
 * ``recompile``    — the runtime half: a compile-counting guard that
                      turns "never recompiles after warmup" into an
                      asserted property.
 * ``entrypoints``  — builds LintContexts for the stack's jitted entry
                      points (train step, generate, engine step/prefill,
-                     both two-phase collectives).
+                     both two-phase collectives), each with a
+                     calibrated compiled-module policy.
 * ``report``       — findings -> text / JSON, severity gating, exit
                      codes (the ``lint`` CLI surface).
 * ``selfcheck``    — deliberately-broken fixtures each pass must catch
-                     (``lint --selfcheck``; the linter's own tier-1).
+                     (``lint --selfcheck``; the linter's own tier-1),
+                     including compiled-HLO fixtures the
+                     jaxpr/StableHLO catalog provably misses.
 """
 
-from akka_allreduce_tpu.analysis.core import (
+from akka_allreduce_tpu.utils.compat import install as _install_jax_compat
+
+_install_jax_compat()  # graft current-JAX names onto 0.4.x (no-op on new)
+
+from akka_allreduce_tpu.analysis.core import (  # noqa: E402
     Finding,
     LintContext,
     LintPolicy,
@@ -40,7 +55,14 @@ from akka_allreduce_tpu.analysis.core import (
     run_passes,
     trace_entry,
 )
-from akka_allreduce_tpu.analysis.recompile import (
+from akka_allreduce_tpu.analysis.hlo import (  # noqa: E402
+    HloModule,
+    HloPolicy,
+    parse_hlo_text,
+    run_hlo_passes,
+    run_with_hlo,
+)
+from akka_allreduce_tpu.analysis.recompile import (  # noqa: E402
     CompileLog,
     RecompileError,
     assert_max_compiles,
@@ -55,6 +77,11 @@ __all__ = [
     "lint_pass",
     "run_passes",
     "trace_entry",
+    "HloModule",
+    "HloPolicy",
+    "parse_hlo_text",
+    "run_hlo_passes",
+    "run_with_hlo",
     "CompileLog",
     "RecompileError",
     "assert_max_compiles",

@@ -10,7 +10,11 @@ another: a float psum over ``tp`` is the Megatron activation reduction
 inside a train step and a quantization escape inside the int8 collective
 — only the policy knows which program it is looking at.
 
-Everything here is trace-time only: no device execution, no compile.
+Everything here is trace-time only by default: no device execution, no
+compile. The compiled-HLO plane (analysis/hlo.py) is the lazy second
+artifact: :attr:`LintContext.hlo` compiles the entry's optimized module
+on first read (``lower().compile().as_text()``, CPU-safe) — paid only
+when the HLO passes are armed (``lint --hlo``).
 """
 
 from __future__ import annotations
@@ -125,6 +129,30 @@ class LintContext:
     in_avals: tuple = ()
     donated: tuple = ()  # declared donation per flat arg
     stablehlo: Optional[str] = None  # lowered module text, when lowered
+    # -- the compiled-HLO second artifact (analysis/hlo.py) ------------
+    # which compiled-module invariants apply (hlo.HloPolicy); None =
+    # entry opted out of the HLO plane
+    hlo_policy: Optional[Any] = None
+    # True while the runner will also run the HLO passes over this
+    # context — the StableHLO donation pass defers its lowering-
+    # survival audit to hlo-aliasing then, so one dropped donation is
+    # one finding (with both marker and alias evidence), never two
+    hlo_armed: bool = False
+    # compiled module text: seeded directly (selfcheck fixtures /
+    # golden tests) or produced lazily by the thunk trace_entry stashes
+    _hlo_text: Optional[str] = dataclasses.field(
+        default=None, repr=False)
+    _hlo_thunk: Optional[Callable[[], str]] = dataclasses.field(
+        default=None, repr=False)
+
+    @property
+    def hlo(self) -> Optional[str]:
+        """Optimized HLO text (``lower().compile().as_text()``),
+        compiled lazily on first read and cached. None when the entry
+        carries neither seeded text nor a compile thunk."""
+        if self._hlo_text is None and self._hlo_thunk is not None:
+            self._hlo_text = self._hlo_thunk()
+        return self._hlo_text
 
 
 # -- jaxpr traversal ----------------------------------------------------
@@ -188,6 +216,88 @@ def out_dtype(eqn):
     return None
 
 
+# -- the shared donation audit ------------------------------------------
+
+# the lowered markers jit emits for a donated input that survived
+# lowering: ``tf.aliasing_output`` pins the input to a specific output
+# at lowering time (simple un-sharded programs); ``jax.buffer_donor``
+# hands the buffer to XLA to alias during compilation (the sharded /
+# mesh path, where output layout is XLA's call). A donation that was
+# UNUSABLE (dtype/shape matched no output) gets neither marker — JAX
+# warns once at lowering and silently copies forever after.
+ALIAS_MARKER_ATTRS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+def count_donation_markers(stablehlo: Optional[str]) -> Optional[int]:
+    """Marker occurrences in lowered StableHLO text (None = not
+    lowered, evidence unavailable)."""
+    if stablehlo is None:
+        return None
+    import re as _re
+    return sum(len(_re.findall(_re.escape(attr), stablehlo))
+               for attr in ALIAS_MARKER_ATTRS)
+
+
+def donation_drop_findings(ctx: "LintContext",
+                           pass_name: str = "donation",
+                           alias_params: Optional[set] = None
+                           ) -> "list[Finding]":
+    """The ONE dropped-donation reporter, shared by the StableHLO
+    donation pass (marker evidence only) and the compiled-HLO aliasing
+    pass (marker + ``input_output_alias`` evidence). Called with
+    ``alias_params`` — the compiled module's aliased parameter numbers
+    — it names every dropped donation per-parameter, stating both what
+    the StableHLO level declared and what the compiled module kept;
+    called without, it audits marker survival in aggregate (the
+    pre-compile approximation). One code path, so the two planes can
+    never drift into reporting the same drop twice with different
+    stories."""
+    declared = [i for i, d in enumerate(ctx.donated) if d]
+    if not declared:
+        return []
+    markers = count_donation_markers(ctx.stablehlo)
+    findings: "list[Finding]" = []
+    if alias_params is not None:
+        dropped = [i for i in declared if i not in alias_params]
+        marker_story = (
+            "the jax.buffer_donor/tf.aliasing_output marker survived "
+            "StableHLO lowering, so the drop happened inside XLA "
+            "(layout/shape mismatch at compile time, or the output was "
+            "claimed by another donor)"
+            if markers is not None and markers >= len(declared) else
+            "the StableHLO marker was ALREADY missing (the donation "
+            "never reached the compiler — dtype/shape matched no "
+            "output at lowering)"
+            if markers is not None else
+            "StableHLO text unavailable for marker evidence")
+        for i in dropped:
+            name = ctx.arg_names[i] if i < len(ctx.arg_names) else \
+                f"param{i}"
+            aval = ctx.in_avals[i] if i < len(ctx.in_avals) else None
+            desc = (f" ({aval.dtype}{list(aval.shape)})"
+                    if aval is not None else "")
+            findings.append(Finding(
+                pass_name, "error", ctx.name,
+                f"donated input {name}{desc} has NO input_output_alias "
+                f"entry in the COMPILED module (parameter {i}): "
+                f"{marker_story}; XLA copies this buffer every "
+                f"dispatch and the in-place-update HBM contract is "
+                f"fiction for it", name))
+        return findings
+    if markers is not None and markers < len(declared):
+        dropped_n = len(declared) - markers
+        findings.append(Finding(
+            pass_name, "error", ctx.name,
+            f"{dropped_n} of {len(declared)} donated buffer(s) did "
+            f"not survive lowering (no "
+            f"{' / '.join(ALIAS_MARKER_ATTRS)} attribute) — XLA will "
+            f"silently copy instead of reusing them; the usual causes "
+            f"are a dtype/shape mismatch between the donated input and "
+            f"every output, or an output that was already claimed by "
+            f"another donor"))
+    return findings
+
+
 # -- pass registry ------------------------------------------------------
 
 PASSES: "dict[str, Callable[[LintContext], list]]" = {}
@@ -237,15 +347,19 @@ def _flat_args(tree_args: tuple, donate_argnums: tuple,
 
 def trace_entry(name: str, fn, args: tuple, policy: LintPolicy,
                 donate_argnums: tuple = (), static_argnums: tuple = (),
-                lower: bool = True) -> LintContext:
+                lower: bool = True,
+                hlo_policy: Optional[Any] = None) -> LintContext:
     """Trace ``fn(*args)`` to a LintContext: jaxpr always; StableHLO
     text when ``lower`` (the donation pass needs it — aliasing is a
     lowering artifact, not a jaxpr one). ``fn`` may already be a jit
     wrapper (the production entry points are; linting THEIR wrapper
     keeps the declared donations in the artifact) — then
     ``donate_argnums``/``static_argnums`` only label the flat record.
-    Accepts concrete arrays or ShapeDtypeStructs; never executes or
-    compiles."""
+    Accepts concrete arrays or ShapeDtypeStructs; never executes, and
+    never compiles EAGERLY — when ``hlo_policy`` is given the context
+    carries a thunk that compiles the optimized module on first
+    ``ctx.hlo`` read (the ``lint --hlo`` plane pays for exactly the
+    entries it lints)."""
     jitted = fn if hasattr(fn, "lower") else jax.jit(
         fn, donate_argnums=donate_argnums,
         static_argnums=static_argnums or None)
@@ -264,6 +378,25 @@ def trace_entry(name: str, fn, args: tuple, policy: LintPolicy,
             fn, static_argnums=static_argnums)(*args)
     names, avals, donated = _flat_args(args, tuple(donate_argnums),
                                        tuple(static_argnums))
+
+    def _compile_hlo() -> str:
+        # a fresh lower() (the traced one above may be consumed);
+        # compile-only — nothing executes. CPU-safe by construction:
+        # the same virtual mesh the trace used.
+        import warnings as _warnings
+        with _warnings.catch_warnings():
+            # a deliberately-unusable donation (selfcheck fixtures)
+            # would re-warn here; the finding is the signal, not the
+            # warning
+            _warnings.simplefilter("ignore")
+            return jitted.lower(*args).compile().as_text()
+
     return LintContext(name=name, jaxpr=closed, policy=policy,
                        arg_names=names, in_avals=avals, donated=donated,
-                       stablehlo=text)
+                       stablehlo=text, hlo_policy=hlo_policy,
+                       # the thunk rides only on entries that opted
+                       # into the HLO plane: a policy-less context must
+                       # never trigger a surprise compile through a
+                       # stray ctx.hlo read
+                       _hlo_thunk=(_compile_hlo
+                                   if hlo_policy is not None else None))

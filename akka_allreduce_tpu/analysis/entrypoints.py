@@ -6,7 +6,10 @@ donation declarations, tiny shapes — and traces it to a
 :class:`~akka_allreduce_tpu.analysis.core.LintContext` with the policy
 that entry's contract implies. CPU-only and execution-free: meshes are
 virtual host devices, tracing never touches a chip, and nothing
-compiles (tier-1-safe by construction).
+compiles EAGERLY (tier-1-safe by construction). Every entry also
+carries a calibrated :class:`~akka_allreduce_tpu.analysis.hlo.
+HloPolicy` — the compiled-module contract ``lint --hlo`` checks; the
+compile happens lazily, only when that plane is armed.
 
 The catalog (``lint --all`` order):
 
@@ -102,12 +105,38 @@ from akka_allreduce_tpu.analysis.core import (
     LintPolicy,
     trace_entry,
 )
+from akka_allreduce_tpu.analysis.hlo import (
+    HloPolicy,
+    expected_swing_census,
+)
 
 # Small enough that tracing the whole catalog stays in seconds; real
 # enough that every structural feature (GQA off, MoE off, 2 layers,
 # >= 2 buckets) exists in the jaxpr.
 _D_MODEL, _LAYERS, _HEADS, _DFF, _VOCAB, _SEQ = 32, 2, 4, 64, 61, 16
 _BUCKET_ELEMS = 256
+
+# -- compiled-module policies (ISSUE 14, analysis/hlo.py) ---------------
+#
+# Census counts are CALIBRATED against the modules XLA actually builds
+# for these miniatures on the CPU backend: exact where the count IS the
+# schedule's signature (standalone collectives, the plan-conformance
+# entry — a drifted count there is the bug the pass exists for), and
+# ``(min, None)`` where it derives from model geometry (train steps:
+# bucket count x metric psums — pinning those would turn every model
+# tweak into a census edit). A kind absent from a census dict must not
+# appear AT ALL: the fused train step lowering a reduce-scatter, or a
+# serving engine lowering any collective, is a finding even at
+# min-bound counts. ``overlap="verify"`` everywhere collectives exist:
+# the CPU backend never splits collectives (info note), while the same
+# policy run against a TPU module under runtime/xla_flags.py asserts
+# the async pairs — "require" is reserved for the on-chip lint
+# (OPERATIONS.md) and the selfcheck fixtures.
+
+# serving/decode entries compile single-device: the EMPTY census —
+# exhaustive, so ANY collective in a compiled engine program means a
+# mesh axis leaked into the hot path
+_HLO_LOCAL = HloPolicy(census={}, overlap="off")
 
 
 def _require_devices(n: int) -> None:
@@ -151,7 +180,9 @@ def _tokens(batch: int, seq: int = _SEQ):
 
 def _train_entry(name: str, dp: int, tp: int, policy_kw: dict,
                  ep: int = 1, pp: int = 1, model_kw: "dict | None" = None,
-                 batch: "int | None" = None, **cfg_kw) -> LintContext:
+                 batch: "int | None" = None,
+                 hlo_policy: "HloPolicy | None" = None,
+                 **cfg_kw) -> LintContext:
     import jax
     from akka_allreduce_tpu.models.train import (TrainConfig,
                                                  make_train_state,
@@ -170,29 +201,57 @@ def _train_entry(name: str, dp: int, tp: int, policy_kw: dict,
     return trace_entry(name, step,
                        (params, opt_state,
                         _tokens(batch if batch is not None else 2 * dp)),
-                       policy, donate_argnums=(0, 1))
+                       policy, donate_argnums=(0, 1),
+                       hlo_policy=hlo_policy)
 
 
 def build_train_step() -> LintContext:
-    return _train_entry("train_step", dp=2, tp=2, policy_kw={})
+    # fused schedule: grad psums + metric psums lower to all-reduces
+    # (count geometry-dependent, min-bounded); XLA rewrites some tp
+    # reductions through all-to-all — but NO windowed legs: a
+    # reduce-scatter or all-gather in the FUSED step means the
+    # schedule flag stopped meaning what it says
+    return _train_entry("train_step", dp=2, tp=2, policy_kw={},
+                        hlo_policy=HloPolicy(
+                            overlap="verify",
+                            census={"all-reduce": (1, None),
+                                    "all-to-all": (0, None)}))
 
 
 def build_train_step_windowed() -> LintContext:
+    # W=2 windows: exactly 2 reduce-scatters paired (and interleaved)
+    # with 2 all-gathers in the COMPILED module — the HLO half of the
+    # PR 1 pairing claim
     return _train_entry("train_step_windowed", dp=2, tp=1,
                         policy_kw={"expect_two_phase": True},
-                        transport_schedule="windowed", num_windows=2)
+                        transport_schedule="windowed", num_windows=2,
+                        hlo_policy=HloPolicy(
+                            overlap="verify", pair_rs_ag=True,
+                            census={"all-reduce": (1, None),
+                                    "reduce-scatter": 2,
+                                    "all-gather": 2}))
 
 
 def build_train_step_int8() -> LintContext:
+    # quantized two-phase: values+scales ride 2 all-to-alls / 2
+    # all-gathers; every quantize/dequantize convert must stay fused
     return _train_entry("train_step_int8", dp=2, tp=1,
                         policy_kw={"wire": "int8",
                                    "expect_two_phase": True},
-                        grad_transport="int8")
+                        grad_transport="int8",
+                        hlo_policy=HloPolicy(
+                            overlap="verify", fused_quant=True,
+                            census={"all-reduce": (1, None),
+                                    "all-to-all": 2,
+                                    "all-gather": 2}))
 
 
 def build_train_step_bf16() -> LintContext:
     return _train_entry("train_step_bf16", dp=2, tp=1, policy_kw={},
-                        compute_dtype="bf16")
+                        compute_dtype="bf16",
+                        hlo_policy=HloPolicy(
+                            overlap="verify",
+                            census={"all-reduce": (1, None)}))
 
 
 def build_train_step_pp() -> LintContext:
@@ -202,7 +261,11 @@ def build_train_step_pp() -> LintContext:
     pp-side metric/grad psums; donation covers the stacked state."""
     return _train_entry("train_step_pp", dp=1, tp=1, pp=2,
                         policy_kw={}, batch=2, microbatches=2,
-                        grad_axes=("dp",))
+                        grad_axes=("dp",),
+                        hlo_policy=HloPolicy(
+                            overlap="verify",
+                            census={"all-reduce": (1, None),
+                                    "collective-permute": (2, None)}))
 
 
 def build_train_step_moe() -> LintContext:
@@ -215,7 +278,13 @@ def build_train_step_moe() -> LintContext:
         "train_step_moe", dp=1, tp=1, ep=2, policy_kw={}, batch=2,
         model_kw={"moe": MoEConfig(n_experts=4, d_ff=_DFF,
                                    capacity_factor=2.0)},
-        grad_axes=("dp",))
+        grad_axes=("dp",),
+        # 2 layers x dispatch+return = 4 a2a legs minimum (XLA may
+        # split each further)
+        hlo_policy=HloPolicy(
+            overlap="verify",
+            census={"all-reduce": (1, None),
+                    "all-to-all": (4, None)}))
 
 
 # -- decode / serving ---------------------------------------------------
@@ -232,7 +301,8 @@ def build_generate() -> LintContext:
     # skip the lowering (the expensive half of the trace)
     return trace_entry("generate", generate,
                        (params, prompt, cfg, 4), policy,
-                       static_argnums=(2, 3), lower=False)
+                       static_argnums=(2, 3), lower=False,
+                       hlo_policy=_HLO_LOCAL)
 
 
 def _engine_pieces():
@@ -258,7 +328,8 @@ def build_engine_step() -> LintContext:
     policy = LintPolicy(expect_donation=True, hot=True)
     return trace_entry("engine_step", _engine_step,
                        (params, state, pos, cfg), policy,
-                       donate_argnums=(1,), static_argnums=(3,))
+                       donate_argnums=(1,), static_argnums=(3,),
+                       hlo_policy=_HLO_LOCAL)
 
 
 def build_engine_multi_step() -> LintContext:
@@ -279,7 +350,8 @@ def build_engine_multi_step() -> LintContext:
     return trace_entry(
         "engine_multi_step", _engine_multi_step,
         (params, state, pos, done, remaining, eos_ids, stop_ids, cfg, 4),
-        policy, donate_argnums=(1,), static_argnums=(7, 8))
+        policy, donate_argnums=(1,), static_argnums=(7, 8),
+        hlo_policy=_HLO_LOCAL)
 
 
 def build_engine_prefill() -> LintContext:
@@ -292,7 +364,8 @@ def build_engine_prefill() -> LintContext:
         "engine_prefill", _engine_prefill,
         (params, state, prompt, jnp.asarray(4, jnp.int32),
          jnp.asarray(0, jnp.int32), cfg, False),
-        policy, donate_argnums=(1,), static_argnums=(5, 6))
+        policy, donate_argnums=(1,), static_argnums=(5, 6),
+        hlo_policy=_HLO_LOCAL)
 
 
 def build_engine_paged_step() -> LintContext:
@@ -340,7 +413,8 @@ def build_engine_paged_step() -> LintContext:
     ctx = trace_entry(
         "engine_paged_step", _engine_paged_step,
         (params, engine._state, pos, pt, cfg, "gather"), policy,
-        donate_argnums=(1,), static_argnums=(4, 5))
+        donate_argnums=(1,), static_argnums=(4, 5),
+        hlo_policy=_HLO_LOCAL)
     # the page-table operand contract: exactly one 2-D int32 input
     # (lanes, pages_per_seq), and it must NOT be donated
     tables = [(aval, don) for aval, don in zip(ctx.in_avals, ctx.donated)
@@ -420,7 +494,8 @@ def build_engine_speculative_step() -> LintContext:
         "engine_speculative_step", _engine_speculative_step,
         (params, draft_params, engine._state, pos, done, remaining,
          eos_ids, stop_ids, step_idx, None, cfg, draft_cfg, k, None),
-        policy, donate_argnums=(2,), static_argnums=(10, 11, 12, 13))
+        policy, donate_argnums=(2,), static_argnums=(10, 11, 12, 13),
+        hlo_policy=_HLO_LOCAL)
 
 
 def build_engine_step_telemetry() -> LintContext:
@@ -452,7 +527,8 @@ def build_engine_step_telemetry() -> LintContext:
     policy = LintPolicy(expect_donation=True, hot=True)
     ctx = trace_entry("engine_step_telemetry", _engine_step,
                       (params, engine._state, pos, cfg), policy,
-                      donate_argnums=(1,), static_argnums=(3,))
+                      donate_argnums=(1,), static_argnums=(3,),
+                      hlo_policy=_HLO_LOCAL)
     # structural identity with the bare engine_step: telemetry armed
     # must trace to the SAME program (eqn sequence), or a span helper
     # has leaked into the jitted function — a compile/sync hazard the
@@ -512,7 +588,8 @@ def build_engine_recovery() -> LintContext:
     policy = LintPolicy(expect_donation=True, hot=True)
     return trace_entry("engine_recovery", _engine_step,
                        (params, rebuilt, pos, cfg), policy,
-                       donate_argnums=(1,), static_argnums=(3,))
+                       donate_argnums=(1,), static_argnums=(3,),
+                       hlo_policy=_HLO_LOCAL)
 
 
 # -- standalone collectives ---------------------------------------------
@@ -536,8 +613,14 @@ def build_collective_fused() -> LintContext:
         return two_phase_allreduce(stacked[0], "dp")[None]
 
     x = jnp.zeros((2, 4, _BUCKET_ELEMS), jnp.float32)
+    # one rs paired with one ag in the compiled module — the
+    # two-phase signature, exact
     return trace_entry("collective_fused", entry, (x,),
-                       _collective_policy(mesh), lower=False)
+                       _collective_policy(mesh), lower=False,
+                       hlo_policy=HloPolicy(
+                           overlap="verify", pair_rs_ag=True,
+                           census={"reduce-scatter": 1,
+                                   "all-gather": 1}))
 
 
 def build_collective_windowed() -> LintContext:
@@ -555,12 +638,18 @@ def build_collective_windowed() -> LintContext:
             stacked[0], "dp", num_windows=2)[None]
 
     x = jnp.zeros((2, 4, _BUCKET_ELEMS), jnp.float32)
+    # W=2: two interleaved rs/ag pairs survive compilation
     return trace_entry("collective_windowed", entry, (x,),
-                       _collective_policy(mesh), lower=False)
+                       _collective_policy(mesh), lower=False,
+                       hlo_policy=HloPolicy(
+                           overlap="verify", pair_rs_ag=True,
+                           census={"reduce-scatter": 2,
+                                   "all-gather": 2}))
 
 
-def _lossy_sync_entry(name: str, transport: str,
-                      policy_kw: dict) -> LintContext:
+def _lossy_sync_entry(name: str, transport: str, policy_kw: dict,
+                      hlo_policy: "HloPolicy | None" = None
+                      ) -> LintContext:
     """allreduce_gradients on a compressed wire with a straggler mask —
     the full lossy sync: compressed payload + exact int32 counts."""
     import jax
@@ -591,16 +680,28 @@ def _lossy_sync_entry(name: str, transport: str,
                         exact_counts=True, wire=transport, **policy_kw)
     # undonated collective entries skip lowering too (see generate)
     return trace_entry(name, entry, (grads, valid, key), policy,
-                       lower=False)
+                       lower=False, hlo_policy=hlo_policy)
 
 
 def build_collective_int8() -> LintContext:
+    # values + scales each cross one all-to-all (phase 1) and one
+    # all-gather (phase 2); counts ride ONE exact all-reduce; the
+    # quantize/dequantize converts must stay fused
     return _lossy_sync_entry("collective_int8", "int8",
-                             {"expect_two_phase": True})
+                             {"expect_two_phase": True},
+                             hlo_policy=HloPolicy(
+                                 overlap="verify", fused_quant=True,
+                                 census={"all-to-all": 2,
+                                         "all-gather": 2,
+                                         "all-reduce": 1}))
 
 
 def build_collective_bf16() -> LintContext:
-    return _lossy_sync_entry("collective_bf16", "bf16", {})
+    # bf16 payload + int32 counts: two all-reduces, nothing else
+    return _lossy_sync_entry("collective_bf16", "bf16", {},
+                             hlo_policy=HloPolicy(
+                                 overlap="verify",
+                                 census={"all-reduce": 2}))
 
 
 def build_collectives_swing() -> LintContext:
@@ -625,8 +726,14 @@ def build_collectives_swing() -> LintContext:
     policy = LintPolicy(known_axes=_mesh_axes(mesh),
                         reduce_axes=frozenset({"dp"}),
                         expect_swing=1)  # log2(2)
+    # the compiled module must carry the same log2(group) hops the
+    # jaxpr promised — the f32 wire rides one collective-permute
+    # per hop
     return trace_entry("collectives_swing", entry, (x,), policy,
-                       lower=False)
+                       lower=False,
+                       hlo_policy=HloPolicy(
+                           overlap="verify",
+                           census=expected_swing_census(2)))
 
 
 def build_collectives_ef8() -> LintContext:
@@ -665,7 +772,16 @@ def build_collectives_ef8() -> LintContext:
                         expect_two_phase=True)
     return trace_entry("collectives_ef8", entry,
                        (grads, valid, key, residual), policy,
-                       lower=False)
+                       lower=False,
+                       # block values + block scales: same two-phase
+                       # compiled shape as the int8 wire, converts
+                       # fused (the EF residual is arithmetic, not a
+                       # collective)
+                       hlo_policy=HloPolicy(
+                           overlap="verify", fused_quant=True,
+                           census={"all-to-all": 2,
+                                   "all-gather": 2,
+                                   "all-reduce": 1}))
 
 
 def build_collectives_hierarchical() -> LintContext:
@@ -715,7 +831,19 @@ def build_collectives_hierarchical() -> LintContext:
                         expect_hierarchical=("ep", "dp"))
     return trace_entry("collectives_hierarchical", entry,
                        (grads, valid, key, residual), policy,
-                       lower=False)
+                       lower=False,
+                       # the three legs, compiled: 1 exact f32
+                       # reduce-scatter (ICI), 2 int8 DCN exchanges
+                       # (values a2a + values ag) with the scale
+                       # side-car gathered alongside, and the ICI
+                       # all-gather reassembling shards (3 ag total);
+                       # counts ride 1 exact all-reduce
+                       hlo_policy=HloPolicy(
+                           overlap="verify", fused_quant=True,
+                           census={"reduce-scatter": 1,
+                                   "all-to-all": 2,
+                                   "all-gather": 3,
+                                   "all-reduce": 1}))
 
 
 def build_collective_auto() -> LintContext:
@@ -770,7 +898,19 @@ def build_collective_auto() -> LintContext:
                         expect_swing=1)  # log2(2)
     return trace_entry("collective_auto", entry,
                        (grads, valid, key, residual), policy,
-                       lower=False)
+                       lower=False,
+                       # the HLO half of plan conformance: the frozen
+                       # plan pinned swing, so the COMPILED module
+                       # must carry exactly log2(2) hops x (values +
+                       # scales) = 2 collective-permutes, 1 exact
+                       # count all-reduce, and — census exhaustive —
+                       # NO all-to-all (the fused fallback's
+                       # signature op): what the plan says is what
+                       # lowers
+                       hlo_policy=HloPolicy(
+                           overlap="verify", fused_quant=True,
+                           census={"collective-permute": 2,
+                                   "all-reduce": 1}))
 
 
 ENTRYPOINTS = {

@@ -1,0 +1,808 @@
+"""Compiled-HLO lint plane: verify the modules XLA actually runs.
+
+Every pass in ``passes.py`` reads the *input* IR — jaxprs and lowered
+StableHLO, artifacts produced before XLA's optimizer gets a vote. But
+the repo's load-bearing performance claims are decided inside the
+compiled module: donation only saves HBM if the compiler kept the
+``input_output_alias`` entry; the windowed schedule only overlaps if
+the latency-hiding scheduler split its collectives into
+``-start``/``-done`` pairs with compute between them; the autotuner's
+"the lowered program IS the plan's verdict" contract is only as strong
+as the collective census of the module that actually dispatched. This
+module is the other half of graftlint: a lightweight parser for
+post-optimization HLO text (``jitted.lower(...).compile().as_text()``,
+available on CPU with no chip) into a module model, and a pass catalog
+over it.
+
+The model is deliberately *lexical*: HLO text is a stable, line-oriented
+format (one instruction per line, ``name = shape opcode(operands),
+attrs``), and the passes only need names, opcodes, shapes, operand
+edges, the fusion kinds, and the alias table — not a faithful IR. A
+parser that tried to be XLA would bit-rot against XLA; one that reads
+the five facts the passes consume survives dialect drift (and the
+golden-module tests in tests/test_hlo_lint.py pin exactly those facts).
+
+Pass catalog (names the CLI/report/DESIGN.md §9 use):
+
+* ``hlo-aliasing`` — every donation graftlint asserts at the StableHLO
+  level must survive as a real ``input_output_alias`` entry in the
+  compiled module; dropped aliases are named per-parameter, with both
+  the declared marker and the missing alias in one finding (the shared
+  helper ``core.donation_drop_findings``).
+* ``hlo-overlap``  — collectives lower to async ``-start``/``-done``
+  pairs with non-trivial compute scheduled between them. Policy
+  ``overlap="require"`` errors on a sync-only module (a TPU build under
+  the runtime/xla_flags.py latency-hiding flags that did NOT split its
+  collectives paid for overlap and got serialization); ``"verify"``
+  checks any pairs present and notes sync-only modules as info (the CPU
+  backend never splits — the designed degradation).
+* ``hlo-census``   — collective op kind/count/ordering vs the
+  schedule's expected signature: log2(n) collective-permutes for swing,
+  reduce-scatter->all-gather pairing per window, the hierarchical
+  schedule's rs/exchange/ag legs. A census dict is EXHAUSTIVE: kinds it
+  does not name must not appear (a windowed program dispatched under a
+  plan that pinned fused contradicts the plan here, not on a chip).
+* ``hlo-fusion``   — quantize/dequantize converts left unfused outside
+  their collective are flagged (policy-gated); the kLoop/kInput fusion
+  census is reported as a regression-pinnable info line.
+
+Everything is compile-only: no device executes. Compilation happens
+lazily per entry (LintContext.hlo) so the jaxpr-only passes stay as
+fast as before.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Callable, Iterator, Mapping, Optional
+
+from akka_allreduce_tpu.analysis.core import (
+    Finding,
+    LintContext,
+    donation_drop_findings,
+)
+
+# -- module model -------------------------------------------------------
+
+# Collective opcodes that move payload bytes. Async forms append
+# -start/-done; XLA also wraps some collectives in generic
+# async-start/async-done pairs whose wrapped op lives in a called
+# computation — both spellings are normalized by `collective_kind`.
+COLLECTIVE_KINDS = frozenset({
+    "all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+    "collective-permute",
+})
+# Instructions that move/alias bytes without computing — not "compute"
+# for the overlap check (an async pair whose gap holds only these is
+# still a serialized collective).
+TRIVIAL_OPS = frozenset({
+    "bitcast", "copy", "tuple", "get-tuple-element", "parameter",
+    "constant", "broadcast", "reshape", "transpose", "after-all",
+    "copy-start", "copy-done", "partition-id", "replica-id",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class AliasEntry:
+    """One ``input_output_alias`` row: output index tuple -> parameter."""
+
+    output_index: tuple
+    param_number: int
+    param_index: tuple
+    kind: str  # "may-alias" | "must-alias"
+
+
+@dataclasses.dataclass
+class HloInstruction:
+    name: str
+    opcode: str
+    dtype: Optional[str]      # "f32", "s8", ... (first element if tuple)
+    shape: tuple              # dims of the (first) result
+    operands: tuple           # operand instruction names (no leading %)
+    attrs: "dict[str, str]"   # raw top-level key=value attrs
+    op_name: str = ""         # metadata op_name, when present
+    is_root: bool = False
+
+    @property
+    def elems(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+
+@dataclasses.dataclass
+class HloComputation:
+    name: str
+    is_entry: bool
+    instructions: "list[HloInstruction]"
+
+    def find(self, name: str) -> Optional[HloInstruction]:
+        for inst in self.instructions:
+            if inst.name == name:
+                return inst
+        return None
+
+
+@dataclasses.dataclass
+class HloModule:
+    name: str
+    computations: "dict[str, HloComputation]"
+    entry: Optional[str]
+    aliases: "list[AliasEntry]"
+    attrs: "dict[str, str]"
+
+    # -- queries the passes consume ------------------------------------
+
+    @property
+    def aliased_params(self) -> "set[int]":
+        return {a.param_number for a in self.aliases}
+
+    @property
+    def fusion_computations(self) -> "set[str]":
+        """Names of computations called by a fusion instruction."""
+        called = set()
+        for comp in self.computations.values():
+            for inst in comp.instructions:
+                if inst.opcode == "fusion" and "calls" in inst.attrs:
+                    called.add(inst.attrs["calls"].lstrip("%"))
+        return called
+
+    @property
+    def async_wrapped_computations(self) -> "set[str]":
+        """Computations called by generic async-start/done wrappers —
+        their body op is the async op's payload, not a collective of
+        its own (excluded from the census walk or every wrapped
+        collective would count twice)."""
+        called = set()
+        for comp in self.computations.values():
+            for inst in comp.instructions:
+                if inst.opcode.startswith("async-") and \
+                        "calls" in inst.attrs:
+                    called.add(inst.attrs["calls"].lstrip("%"))
+        return called
+
+    def all_instructions(self) -> Iterator[tuple]:
+        """(computation, instruction) over every computation."""
+        for comp in self.computations.values():
+            for inst in comp.instructions:
+                yield comp, inst
+
+    def collective_kind(self, inst: HloInstruction,
+                        comp: HloComputation) -> Optional[tuple]:
+        """``(kind, phase)`` for a collective instruction — phase one of
+        "sync"/"start"/"done" — else None. Handles the dedicated
+        ``all-gather-start`` spellings and the generic ``async-start``
+        wrapper (whose payload op lives in the called computation)."""
+        op = inst.opcode
+        if op in COLLECTIVE_KINDS:
+            return op, "sync"
+        for kind in COLLECTIVE_KINDS:
+            if op == f"{kind}-start":
+                return kind, "start"
+            if op == f"{kind}-done":
+                return kind, "done"
+        if op in ("async-start", "async-done", "async-update"):
+            called = inst.attrs.get("calls", "").lstrip("%")
+            target = self.computations.get(called)
+            if target is None and op != "async-start":
+                # -done/-update name no calls= in some dialect versions;
+                # resolve through their operand (the matching start)
+                for opnd in inst.operands:
+                    src = comp.find(opnd)
+                    if src is not None and src.opcode == "async-start":
+                        called = src.attrs.get("calls", "").lstrip("%")
+                        target = self.computations.get(called)
+                        break
+            if target is not None:
+                for wrapped in target.instructions:
+                    if wrapped.opcode in COLLECTIVE_KINDS:
+                        phase = ("start" if op == "async-start" else
+                                 "done" if op == "async-done" else
+                                 "update")
+                        return wrapped.opcode, phase
+        return None
+
+    def collectives(self) -> "list[tuple]":
+        """Every collective as ``(comp, inst, kind, phase)``, in module
+        order. ``-done`` halves are included (the census counts each
+        logical collective once: sync + start); ops inside
+        async-wrapped computations are the wrapper's payload, not
+        separate collectives."""
+        wrapped = self.async_wrapped_computations
+        out = []
+        for comp, inst in self.all_instructions():
+            if comp.name in wrapped:
+                continue
+            hit = self.collective_kind(inst, comp)
+            if hit is not None:
+                out.append((comp, inst, hit[0], hit[1]))
+        return out
+
+    def collective_census(self) -> "dict[str, int]":
+        """Logical collective count per kind: one per sync op, one per
+        ``-start`` (its ``-done`` is the same collective)."""
+        census: "dict[str, int]" = {}
+        for _comp, _inst, kind, phase in self.collectives():
+            if phase in ("sync", "start"):
+                census[kind] = census.get(kind, 0) + 1
+        return census
+
+    def async_pairs(self) -> "list[tuple]":
+        """Matched ``(start, done, compute_between)`` triples per
+        computation, where ``compute_between`` counts non-trivial
+        instructions scheduled between the start and its done (the
+        module prints in schedule order when ``is_scheduled=true`` —
+        jit compiled modules are). An unmatched start pairs with None."""
+        pairs = []
+        for comp in self.computations.values():
+            starts = []  # (position, inst)
+            for i, inst in enumerate(comp.instructions):
+                hit = self.collective_kind(inst, comp)
+                if hit is None:
+                    continue
+                if hit[1] == "start":
+                    starts.append((i, inst))
+                elif hit[1] == "done":
+                    # the done consumes its start by operand name
+                    match = None
+                    for j, (pos, s) in enumerate(starts):
+                        if s.name in inst.operands:
+                            match = j
+                            break
+                    if match is None and starts:
+                        match = 0  # dialect without operand names: FIFO
+                    if match is not None:
+                        pos, s = starts.pop(match)
+                        between = sum(
+                            1 for k in range(pos + 1, i)
+                            if comp.instructions[k].opcode
+                            not in TRIVIAL_OPS
+                            and self.collective_kind(
+                                comp.instructions[k], comp) is None)
+                        pairs.append((s, inst, between))
+            for _pos, s in starts:
+                pairs.append((s, None, 0))
+        return pairs
+
+    def fusion_census(self) -> "dict[str, int]":
+        census: "dict[str, int]" = {}
+        for _comp, inst in self.all_instructions():
+            if inst.opcode == "fusion":
+                kind = inst.attrs.get("kind", "kCustom")
+                census[kind] = census.get(kind, 0) + 1
+        return census
+
+
+# -- parser -------------------------------------------------------------
+
+_MODULE_RE = re.compile(r"^HloModule\s+([^\s,]+)")
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9,\s]*)\}:\s*\(\s*(\d+)\s*,\s*\{([0-9,\s]*)\}\s*,?\s*"
+    r"([a-z-]*)\s*\)")
+_COMP_RE = re.compile(
+    r"^\s*(ENTRY\s+)?%?([\w.\-$]+)\s*(?:\(.*)?\{\s*$")
+_INST_RE = re.compile(
+    r"^\s*(ROOT\s+)?%?([\w.\-$]+)\s*=\s*(.*)$")
+_SHAPE_RE = re.compile(r"^([a-z]+[0-9]*(?:e[0-9]+m[0-9]+\w*)?)"
+                       r"\[([0-9,]*)\]")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-$]+)")
+
+
+def _operand_name(part: str) -> Optional[str]:
+    """The instruction name one operand refers to. The ``%`` sigil is
+    the reliable marker on every dialect this repo has seen; a printer
+    that drops it would otherwise silently parse EVERY operand list
+    empty (and the passes that walk operand edges — dequantize lookup,
+    async done-matching — would degrade to silent green), so fall back
+    to the last non-shape token."""
+    m = _OPERAND_NAME_RE.search(part)
+    if m:
+        return m.group(1)
+    # instruction names carry letters; this also keeps literal operands
+    # (parameter(0), constant(1)) out of the edge list
+    toks = [t for t in part.split()
+            if t and "[" not in t and re.search(r"[A-Za-z]", t)]
+    return toks[-1] if toks else None
+
+
+def _index_tuple(text: str) -> tuple:
+    return tuple(int(t) for t in text.replace(",", " ").split())
+
+
+def _parse_alias_table(header: str) -> "list[AliasEntry]":
+    # the table is brace-nested; grab the balanced region after the key
+    key = "input_output_alias={"
+    at = header.find(key)
+    if at < 0:
+        return []
+    depth, start = 1, at + len(key)
+    end = start
+    while end < len(header) and depth:
+        depth += {"{": 1, "}": -1}.get(header[end], 0)
+        end += 1
+    body = header[start:end - 1]
+    return [AliasEntry(_index_tuple(m.group(1)), int(m.group(2)),
+                       _index_tuple(m.group(3)), m.group(4) or
+                       "may-alias")
+            for m in _ALIAS_ENTRY_RE.finditer(body)]
+
+
+def _split_top_level(text: str, sep: str = ",") -> "list[str]":
+    parts, depth, cur = [], 0, []
+    in_str = False
+    for ch in text:
+        if ch == '"':
+            in_str = not in_str
+        if not in_str:
+            if ch in "({[":
+                depth += 1
+            elif ch in ")}]":
+                depth -= 1
+            if ch == sep and depth == 0:
+                parts.append("".join(cur).strip())
+                cur = []
+                continue
+        cur.append(ch)
+    tail = "".join(cur).strip()
+    if tail:
+        parts.append(tail)
+    return parts
+
+
+def _parse_shape(text: str) -> "tuple[Optional[str], tuple]":
+    """Leading result-shape token -> (dtype, dims). Tuple shapes report
+    their first array element (collective starts return tuples; the
+    payload element is what the passes size)."""
+    text = text.strip()
+    while text.startswith("("):
+        text = text[1:].strip()
+    m = _SHAPE_RE.match(text)
+    if not m:
+        return None, ()
+    dims = tuple(int(d) for d in m.group(2).split(",") if d != "")
+    return m.group(1), dims
+
+
+def _parse_instruction(line: str, is_root: bool, name: str,
+                       rhs: str) -> Optional[HloInstruction]:
+    # rhs: "<shape> <opcode>(<operands>), attr=..., metadata={...}"
+    # — where <shape> may itself be a parenthesized tuple (collective
+    # starts return tuples), so skip it structurally before looking
+    # for the operand list's paren
+    dtype, shape = _parse_shape(rhs)
+    rest = rhs
+    if rest.lstrip().startswith("("):
+        rest = rest.lstrip()
+        depth, j = 0, 0
+        while j < len(rest):
+            if rest[j] == "(":
+                depth += 1
+            elif rest[j] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        rest = rest[j + 1:]
+    paren = rest.find("(")
+    if paren < 0:
+        return None
+    head = rest[:paren].strip().split()
+    if not head:
+        return None
+    opcode = head[-1]
+    rhs = rest
+    # find the matching close paren of the operand list
+    depth, i = 0, paren
+    while i < len(rhs):
+        if rhs[i] == "(":
+            depth += 1
+        elif rhs[i] == ")":
+            depth -= 1
+            if depth == 0:
+                break
+        i += 1
+    operand_text = rhs[paren + 1:i]
+    attr_text = rhs[i + 1:].lstrip(", ")
+    operands = tuple(
+        name
+        for part in _split_top_level(operand_text)
+        for name in [_operand_name(part)] if name)
+    attrs: "dict[str, str]" = {}
+    for part in _split_top_level(attr_text):
+        k, eq, v = part.partition("=")
+        if eq and re.fullmatch(r"[\w.\-]+", k.strip()):
+            attrs[k.strip()] = v.strip()
+    op_name = ""
+    m = _OPNAME_RE.search(attr_text)
+    if m:
+        op_name = m.group(1)
+    return HloInstruction(name=name, opcode=opcode, dtype=dtype,
+                          shape=shape, operands=operands, attrs=attrs,
+                          op_name=op_name, is_root=is_root)
+
+
+def parse_hlo_text(text: str) -> HloModule:
+    """Parse optimized HLO module text (``compiled.as_text()``) into the
+    lightweight model. Lexical and tolerant by design: unknown attrs are
+    kept raw, unknown line shapes are skipped — the passes only need
+    opcodes, shapes, operand edges, fusion kinds, and the alias table."""
+    lines = text.splitlines()
+    mod_name, attrs, aliases = "<module>", {}, []
+    computations: "dict[str, HloComputation]" = {}
+    entry: Optional[str] = None
+    current: Optional[HloComputation] = None
+    for line in lines:
+        header = _MODULE_RE.match(line)
+        if header and current is None:
+            mod_name = header.group(1).rstrip(",")
+            aliases = _parse_alias_table(line)
+            for part in _split_top_level(line):
+                k, eq, v = part.partition("=")
+                if eq and re.fullmatch(r"[\w.\-]+", k.strip()):
+                    attrs[k.strip()] = v.strip()
+            continue
+        if current is None:
+            m = _COMP_RE.match(line)
+            # a header never assigns; "=" appears only in /*index=N*/
+            # comments (long entry signatures) — strip those first
+            head = re.sub(r"/\*.*?\*/", "",
+                          line.split("{")[0])
+            if m and "=" not in head:
+                current = HloComputation(
+                    name=m.group(2), is_entry=bool(m.group(1)),
+                    instructions=[])
+                continue
+        else:
+            if line.strip() == "}":
+                computations[current.name] = current
+                if current.is_entry:
+                    entry = current.name
+                current = None
+                continue
+            m = _INST_RE.match(line)
+            if m:
+                inst = _parse_instruction(line, bool(m.group(1)),
+                                          m.group(2), m.group(3))
+                if inst is not None:
+                    current.instructions.append(inst)
+    return HloModule(name=mod_name, computations=computations,
+                     entry=entry, aliases=aliases, attrs=attrs)
+
+
+# -- policy -------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class HloPolicy:
+    """Which compiled-module invariants apply to an entry point.
+
+    ``check_aliasing``: the donation audit — every donated flat arg
+    must appear in the module's ``input_output_alias`` table (only
+    meaningful for entries that declare donations).
+    ``overlap``: "require" — collectives MUST lower to async
+    start/done pairs with non-trivial compute between them (a TPU
+    module built under the latency-hiding flags); "verify" — any async
+    pairs present are checked, a sync-only module is an info note (the
+    CPU backend never splits collectives — the designed degradation,
+    see runtime/xla_flags.py); "off" — no collectives expected to
+    overlap (single-device entries).
+    ``census``: the schedule's expected collective signature — kind ->
+    exact count, or ``(min, max)`` with ``max=None`` for open-ended.
+    EXHAUSTIVE: kinds absent from the dict must not appear in the
+    module at all (a plan that pinned fused must not lower windowed
+    legs). None = no census check.
+    ``pair_rs_ag``: compiled reduce-scatter and all-gather counts must
+    match AND interleave (the k-th gather scheduled after the k-th
+    scatter) — the windowed rs->ag pairing at the module level.
+    ``expect_permutes``: exactly this many collective-permutes (swing's
+    log2(n) short-cut hops; subsumed by ``census`` when both given).
+    ``fused_quant``: int8 quantize/dequantize converts must live inside
+    fusion computations, not bare in an entry/loop computation (an
+    unfused quantize materializes the full-precision buffer the wire
+    existed to avoid).
+    ``fusion_census``: report the kLoop/kInput fusion counts as an info
+    finding (regression-pinnable; never gates).
+    """
+
+    check_aliasing: bool = True
+    overlap: str = "off"
+    census: Optional[Mapping] = None
+    pair_rs_ag: bool = False
+    expect_permutes: Optional[int] = None
+    fused_quant: bool = False
+    fusion_census: bool = True
+
+
+def expected_swing_census(group: int, wire_collectives: int = 1
+                          ) -> "dict[str, int]":
+    """The swing schedule's compiled signature: log2(group) hops, each
+    moving ``wire_collectives`` collective-permutes (values alone for
+    f32/bf16; values + scales for the quantized wires)."""
+    return {"collective-permute":
+            int(math.log2(group)) * wire_collectives}
+
+
+# -- pass registry ------------------------------------------------------
+
+HLO_PASSES: "dict[str, Callable[[LintContext, HloModule], list]]" = {}
+
+
+def hlo_pass(name: str):
+    def register(fn):
+        HLO_PASSES[name] = fn
+        return fn
+
+    return register
+
+
+def arm_hlo(ctx: LintContext) -> LintContext:
+    """Arm ``hlo_armed`` — but ONLY when the hlo-aliasing pass will
+    actually run for this context (a policy exists and its aliasing
+    audit is on). Arming unconditionally would make the StableHLO
+    donation pass defer to an HLO audit that never happens, silently
+    dropping the donation check exactly in the stricter ``--hlo``
+    mode."""
+    pol = ctx.hlo_policy
+    ctx.hlo_armed = pol is not None and pol.check_aliasing
+    return ctx
+
+
+def run_with_hlo(ctx: LintContext, only: Optional[list] = None,
+                 hlo_only: Optional[list] = None) -> "list[Finding]":
+    """Both planes over one context: arm ``hlo_armed`` (so the
+    StableHLO donation pass defers its survival audit to hlo-aliasing
+    — one dropped donation, one finding), run the jaxpr catalog, then
+    the compiled-module catalog."""
+    from akka_allreduce_tpu.analysis.core import run_passes
+    arm_hlo(ctx)
+    return run_passes(ctx, only) + run_hlo_passes(ctx, hlo_only)
+
+
+def run_hlo_passes(ctx: LintContext,
+                   only: Optional[list] = None) -> "list[Finding]":
+    """Compile (lazily, cached on the context) and lint one entry's
+    optimized module. Entries without an ``hlo_policy`` are skipped —
+    the jaxpr catalog stays compile-free unless the entry opted in."""
+    policy = ctx.hlo_policy
+    if policy is None:
+        return []
+    text = ctx.hlo
+    if text is None:
+        return [Finding(
+            "hlo", "error", ctx.name,
+            "entry has an hlo_policy but no compiled module is "
+            "available (trace_entry captured no compile thunk and no "
+            "hlo text was seeded)")]
+    module = parse_hlo_text(text)
+    findings = []
+    for name, fn in HLO_PASSES.items():
+        if only is not None and name not in only:
+            continue
+        findings.extend(fn(ctx, module))
+    return findings
+
+
+# -- passes -------------------------------------------------------------
+
+@hlo_pass("hlo-aliasing")
+def aliasing_pass(ctx: LintContext, module: HloModule) -> list:
+    """Donations must survive COMPILATION, not just lowering: the
+    StableHLO marker is a request, the ``input_output_alias`` entry is
+    the grant. Reports through the same shared helper as the StableHLO
+    donation pass, so a dropped donation is named once — with both the
+    declared marker and the missing alias in the message."""
+    if not ctx.hlo_policy.check_aliasing:
+        return []
+    return donation_drop_findings(ctx, pass_name="hlo-aliasing",
+                                  alias_params=module.aliased_params)
+
+
+@hlo_pass("hlo-overlap")
+def overlap_pass(ctx: LintContext, module: HloModule) -> list:
+    """The first machine check that the overlap we pay for is real:
+    collectives under the latency-hiding flags must compile to
+    ``-start``/``-done`` pairs with actual compute scheduled into the
+    gap. A pair with an empty gap is a serialized collective wearing
+    async clothes; a sync-only module under ``overlap="require"`` means
+    the flags never reached the compiler (set after backend init — the
+    exact failure runtime/xla_flags.py documents)."""
+    pol = ctx.hlo_policy
+    if pol.overlap == "off":
+        return []
+    findings = []
+    pairs = module.async_pairs()
+    sync_ops = [(c, i, k) for c, i, k, phase in module.collectives()
+                if phase == "sync"]
+    for start, done, between in pairs:
+        if done is None:
+            findings.append(Finding(
+                "hlo-overlap", "error", ctx.name,
+                f"async collective {start.name} ({start.opcode}) has "
+                f"no matching -done in its computation — the module "
+                f"text is inconsistent or the parser missed the "
+                f"consumer; treat as a schedule bug until proven "
+                f"otherwise", start.name))
+        elif between == 0:
+            findings.append(Finding(
+                "hlo-overlap", "error", ctx.name,
+                f"async pair {start.name} -> {done.name} has NO "
+                f"non-trivial compute scheduled between start and done "
+                f"— the collective is split but still serialized; the "
+                f"latency-hiding scheduler found nothing to move into "
+                f"the gap (check the window carve: each window's "
+                f"compute must be independent of its in-flight "
+                f"collective)", start.name))
+    if pol.overlap == "require":
+        if sync_ops:
+            # any leftover sync collective is a serialized transfer,
+            # whether the module split none of them (flags never
+            # reached the compiler) or only some (flags partially
+            # effective — the remaining sync ops still pay the exact
+            # cost the overlap was bought to hide)
+            kinds = sorted({k for _c, _i, k in sync_ops})
+            how = ("only SYNCHRONOUS collectives" if not pairs else
+                   f"{len(sync_ops)} SYNCHRONOUS collective(s) "
+                   f"alongside {len(pairs)} async pair(s)")
+            findings.append(Finding(
+                "hlo-overlap", "error", ctx.name,
+                f"module carries {how} "
+                f"({', '.join(kinds)}) but this "
+                f"entry requires async overlap — the latency-hiding / "
+                f"async-collective flags (runtime/xla_flags.py) did "
+                f"not reach the compiler (set after backend init they "
+                f"are silently ignored) or covered only part of the "
+                f"schedule; every remaining sync transfer "
+                f"serializes against compute"))
+        elif not pairs:
+            findings.append(Finding(
+                "hlo-overlap", "error", ctx.name,
+                "entry requires async overlap but the compiled module "
+                "carries no collectives at all — the schedule was "
+                "optimized away or the entry compiled single-device"))
+    if pol.overlap == "verify" and not pairs and sync_ops:
+        findings.append(Finding(
+            "hlo-overlap", "info", ctx.name,
+            f"{len(sync_ops)} collective(s) compiled synchronous (no "
+            f"start/done split) — expected on the CPU backend, which "
+            f"never splits; on TPU under the xla_flags overlap set "
+            f"this same entry must show async pairs (re-lint on-chip "
+            f"or in the capture run)"))
+    return findings
+
+
+def _census_bounds(spec) -> "tuple[int, Optional[int]]":
+    if isinstance(spec, tuple):
+        return spec[0], spec[1]
+    return spec, spec
+
+
+@hlo_pass("hlo-census")
+def census_pass(ctx: LintContext, module: HloModule) -> list:
+    """The compiled collective census vs the schedule's signature. This
+    is the HLO half of the autotuner's plan-conformance contract: a
+    CollectivePlan that pinned swing promises log2(n) permute hops in
+    the module that runs — count them there, not in the jaxpr the
+    optimizer was still free to rewrite."""
+    pol = ctx.hlo_policy
+    findings = []
+    census = module.collective_census()
+    if pol.census is not None:
+        expected = dict(pol.census)
+        if pol.expect_permutes is not None:
+            expected.setdefault("collective-permute",
+                                pol.expect_permutes)
+        for kind in sorted(set(expected) | set(census)):
+            lo, hi = _census_bounds(expected.get(kind, 0))
+            got = census.get(kind, 0)
+            if got < lo or (hi is not None and got > hi):
+                want = (f"{lo}" if hi == lo else
+                        f">= {lo}" if hi is None else f"{lo}..{hi}")
+                findings.append(Finding(
+                    "hlo-census", "error", ctx.name,
+                    f"compiled module carries {got} {kind} "
+                    f"collective(s), schedule signature expects {want} "
+                    f"— the program XLA built contradicts the "
+                    f"schedule/plan this entry declared (a hand-flag "
+                    f"or plan verdict that does not survive "
+                    f"compilation is a silent perf lie)",
+                    f"{kind}"))
+    elif pol.expect_permutes is not None:
+        got = census.get("collective-permute", 0)
+        if got != pol.expect_permutes:
+            findings.append(Finding(
+                "hlo-census", "error", ctx.name,
+                f"compiled module carries {got} collective-permute(s), "
+                f"expected exactly {pol.expect_permutes} (the swing "
+                f"schedule's log2(n) short-cut hops) — a dropped "
+                f"exchange leaves partial sums, an extra one "
+                f"double-counts a subgroup", "collective-permute"))
+    if pol.pair_rs_ag:
+        rs = census.get("reduce-scatter", 0)
+        ag = census.get("all-gather", 0)
+        if rs != ag:
+            findings.append(Finding(
+                "hlo-census", "error", ctx.name,
+                f"compiled module pairs {rs} reduce-scatter(s) with "
+                f"{ag} all-gather(s) — a window lost a phase during "
+                f"compilation (the jaxpr was paired; the optimizer "
+                f"merged or elided one side)", "reduce-scatter"))
+        else:
+            # ordering: the k-th gather must be scheduled after the
+            # k-th scatter (windows drain in order)
+            seq = [kind for _c, _i, kind, phase in module.collectives()
+                   if phase in ("sync", "start")
+                   and kind in ("reduce-scatter", "all-gather")]
+            seen_rs = seen_ag = 0
+            for kind in seq:
+                if kind == "reduce-scatter":
+                    seen_rs += 1
+                else:
+                    seen_ag += 1
+                    if seen_ag > seen_rs:
+                        findings.append(Finding(
+                            "hlo-census", "error", ctx.name,
+                            f"all-gather #{seen_ag} is scheduled "
+                            f"before reduce-scatter #{seen_ag} — a "
+                            f"gather overtook its scatter in the "
+                            f"compiled schedule; the window it "
+                            f"belongs to gathers un-reduced data",
+                            "all-gather"))
+                        break
+    return findings
+
+
+_QUANT_DTYPES = frozenset({"s8", "u8"})
+
+
+@hlo_pass("hlo-fusion")
+def fusion_pass(ctx: LintContext, module: HloModule) -> list:
+    """Fusion-boundary lint: the quantize/dequantize converts around a
+    compressed-wire collective must fuse into their producers/consumers
+    — left bare they materialize the full-precision buffer the wire
+    existed to avoid. Plus the kLoop/kInput census as a pinnable info
+    line (a fusion-count regression is how a 'minor refactor' shows up
+    as an HBM-bandwidth cliff on-chip)."""
+    pol = ctx.hlo_policy
+    findings = []
+    if pol.fused_quant:
+        fusion_comps = module.fusion_computations
+        bare = []
+        for comp, inst in module.all_instructions():
+            if comp.name in fusion_comps:
+                continue
+            if inst.opcode != "convert":
+                continue
+            if inst.dtype in _QUANT_DTYPES:
+                bare.append((comp, inst, "quantize"))
+            else:
+                src = comp.find(inst.operands[0]) if inst.operands \
+                    else None
+                if src is not None and src.dtype in _QUANT_DTYPES:
+                    bare.append((comp, inst, "dequantize"))
+        for comp, inst, which in bare:
+            findings.append(Finding(
+                "hlo-fusion", "warning", ctx.name,
+                f"{which} convert {inst.name} "
+                f"({inst.dtype}[{','.join(map(str, inst.shape))}]) "
+                f"sits UNFUSED in computation {comp.name} — the "
+                f"full-precision intermediate materializes in HBM "
+                f"instead of fusing into the collective's "
+                f"producer/consumer (EQuARX failure mode: the wire "
+                f"saved bytes the memory system then re-spent)",
+                inst.name))
+    if pol.fusion_census:
+        census = module.fusion_census()
+        if census:
+            total = sum(census.values())
+            detail = ", ".join(f"{v} {k}" for k, v in
+                               sorted(census.items()))
+            findings.append(Finding(
+                "hlo-fusion", "info", ctx.name,
+                f"fusion census: {total} fusion(s) ({detail}) — "
+                f"regression-pinnable; a falling count after a "
+                f"refactor means XLA stopped fusing something it used "
+                f"to"))
+    return findings

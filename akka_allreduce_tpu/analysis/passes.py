@@ -26,18 +26,18 @@ quiet (docs/DESIGN.md §9 has the recipe).
 
 from __future__ import annotations
 
-import re
-
 import jax.numpy as jnp
 import numpy as np
 
 from akka_allreduce_tpu.analysis.core import (
+    ALIAS_MARKER_ATTRS,
     COLLECTIVE_PRIMS,
     GATHER_PHASE_PRIMS,
     HOST_SYNC_PRIMS,
     REDUCE_PHASE_PRIMS,
     Finding,
     LintContext,
+    donation_drop_findings,
     eqn_axes,
     iter_eqns,
     lint_pass,
@@ -188,21 +188,24 @@ def collective_axis_pass(ctx: LintContext) -> list:
     return findings
 
 
-# the lowered markers jit emits for a donated input that survived
-# lowering: ``tf.aliasing_output`` pins the input to a specific output
-# at lowering time (simple un-sharded programs); ``jax.buffer_donor``
-# hands the buffer to XLA to alias during compilation (the sharded /
-# mesh path, where output layout is XLA's call). A donation that was
-# UNUSABLE (dtype/shape matched no output) gets neither marker — JAX
-# warns once at lowering and silently copies forever after, which is
-# exactly the state this pass hardens into a gated finding.
-_ALIAS_ATTRS = ("tf.aliasing_output", "jax.buffer_donor")
+# kept as an alias for external readers; the marker list itself (and
+# the dropped-donation reporter both planes share) lives in core so the
+# StableHLO pass here and the compiled-HLO aliasing pass
+# (hlo.aliasing_pass) can never drift apart — ISSUE 14's dedupe.
+_ALIAS_ATTRS = ALIAS_MARKER_ATTRS
 
 
 @lint_pass("donation")
 def donation_pass(ctx: LintContext) -> list:
     """Declared donations must survive lowering; expected donations must
-    be declared; buffers dwarfing the donated set are surfaced."""
+    be declared; buffers dwarfing the donated set are surfaced. The
+    lowering-survival audit reports through the shared
+    :func:`core.donation_drop_findings` helper — and DEFERS to the
+    compiled-HLO aliasing pass when that plane is armed
+    (``ctx.hlo_armed``): the compiled module's ``input_output_alias``
+    table is the stronger evidence, and one dropped donation must be
+    one finding, named once with both the declared marker and the
+    missing alias."""
     findings = []
     pol = ctx.policy
     declared = sum(bool(d) for d in ctx.donated)
@@ -214,18 +217,8 @@ def donation_pass(ctx: LintContext) -> list:
             "doubles the state's HBM residency"))
     if ctx.stablehlo is None or declared == 0:
         return findings
-    aliased = sum(len(re.findall(re.escape(attr), ctx.stablehlo))
-                  for attr in _ALIAS_ATTRS)
-    if aliased < declared:
-        dropped = declared - aliased
-        findings.append(Finding(
-            "donation", "error", ctx.name,
-            f"{dropped} of {declared} donated buffer(s) did not "
-            f"survive lowering (no {' / '.join(_ALIAS_ATTRS)} "
-            f"attribute) — XLA will silently copy instead of reusing "
-            f"them; the usual causes are a dtype/shape mismatch between "
-            f"the donated input and every output, or an output that "
-            f"was already claimed by another donor"))
+    if not ctx.hlo_armed:
+        findings.extend(donation_drop_findings(ctx))
     if pol.expect_donation:
         # the bar is the TOTAL donated set, not the largest single leaf:
         # a quantized state legitimately donates many small buffers, and

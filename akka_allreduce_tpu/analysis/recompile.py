@@ -41,7 +41,42 @@ _COMPILE_LOGGERS = ("jax._src.interpreters.pxla",)
 # does not spray compile timings over the program's stderr
 _QUIET_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch",
                   "jax._src.compiler")
-_COMPILE_RE = re.compile(r"^Compiling ([^\s]+)")
+# The record's name half has drifted across jax releases: bare function
+# names ("Compiling step with global shapes..."), module-suffixed names
+# ("Compiling jit_step.2 ..."), fingerprint-suffixed names ("Compiling
+# step (hash) for ..."). The guard's job is COUNTING — a format drift
+# that stopped the name regex matching must never zero the compile
+# count (that would green-light every recompile the count exists to
+# catch), so parsing is two-stage: any record whose message starts with
+# the "Compiling " prefix IS a compile (counted unconditionally, as
+# "<unparsed>" if the name can't be extracted), and the name regex +
+# suffix strip only decorate the entry for the diff message.
+_COMPILE_PREFIX = "Compiling "
+_COMPILE_RE = re.compile(r"^Compiling\s+(\S+)")
+# trailing decorations newer pxla variants append to the name token:
+# a ".N" disambiguation counter, trailing punctuation, a "(fingerprint)"
+# parenthetical glued to the name
+_NAME_SUFFIX_RE = re.compile(r"(?:\(.*\)|[.,;:]+|\.\d+)$")
+
+
+def _compiled_name(message: str) -> Optional[str]:
+    """The program name a pxla compile record names, normalized across
+    log-format variants — or None when the record is not a compile
+    record at all. NEVER returns None for a "Compiling ..."-prefixed
+    message: an unparsable name degrades to "<unparsed>", not to an
+    uncounted compile."""
+    if not message.startswith(_COMPILE_PREFIX):
+        return None
+    m = _COMPILE_RE.match(message)
+    if not m:
+        return "<unparsed>"
+    name = m.group(1)
+    while True:
+        stripped = _NAME_SUFFIX_RE.sub("", name)
+        if stripped == name or not stripped:
+            break
+        name = stripped
+    return name or "<unparsed>"
 
 
 class RecompileError(AssertionError):
@@ -54,9 +89,9 @@ class _CountingHandler(logging.Handler):
         self._sink = sink
 
     def emit(self, record: logging.LogRecord) -> None:
-        m = _COMPILE_RE.match(record.getMessage())
-        if m:
-            self._sink.compiled.append(m.group(1))
+        name = _compiled_name(record.getMessage())
+        if name is not None:
+            self._sink.compiled.append(name)
 
 
 class CompileLog:

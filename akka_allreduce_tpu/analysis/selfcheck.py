@@ -11,6 +11,15 @@ fails unless every pass fires on its fixture.
 Fixtures are *realistic miniatures*: each one is the smallest program
 that makes the production mistake, not a synthetic eqn soup, so a pass
 that bit-rots against real jaxpr shapes fails here first.
+
+The compiled-HLO fixtures (``HLO_FIXTURES``, ``lint --selfcheck
+--hlo``) carry a second obligation: each one must be a bug the
+jaxpr/StableHLO catalog PROVABLY misses — the selfcheck runs the base
+passes over every HLO fixture first and fails if any of them fire.
+That is the plane's existence proof: a dropped ``input_output_alias``
+behind a surviving StableHLO marker, a sync-only module under overlap
+expectations, a compiled collective census contradicting the declared
+plan — bugs that are invisible before XLA's optimizer runs.
 """
 
 from __future__ import annotations
@@ -22,6 +31,10 @@ from akka_allreduce_tpu.analysis.core import (
     LintPolicy,
     run_passes,
     trace_entry,
+)
+from akka_allreduce_tpu.analysis.hlo import (
+    HloPolicy,
+    run_hlo_passes,
 )
 
 
@@ -298,6 +311,174 @@ def fixture_weak_input():
                        lower=False)
 
 
+# -- compiled-HLO fixtures (ISSUE 14) -----------------------------------
+#
+# Each one is CLEAN at the jaxpr/StableHLO level (run_selfcheck proves
+# it before running the HLO pass) and dirty only in the compiled
+# module — the bugs analysis/hlo.py exists for.
+
+def _windowed_entry(name: str, hlo_policy: HloPolicy,
+                    num_windows: int = 2):
+    """A correctly-paired windowed allreduce (the production schedule,
+    jaxpr-clean by construction) traced with a compiled-module policy —
+    the shared chassis for the HLO-only fixtures."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    from akka_allreduce_tpu.ops.collectives import (
+        pipelined_two_phase_allreduce)
+    from akka_allreduce_tpu.parallel.mesh import (MeshSpec,
+                                                  make_device_mesh)
+    mesh = make_device_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+             out_specs=P("dp"), check_vma=False)
+    def entry(stacked):
+        return pipelined_two_phase_allreduce(
+            stacked[0], "dp", num_windows=num_windows)[None]
+
+    x = jnp.zeros((2, 4, 256), jnp.float32)
+    policy = LintPolicy(known_axes=_axes(mesh),
+                        reduce_axes=frozenset({"dp"}),
+                        expect_two_phase=True)
+    return trace_entry(name, entry, (x,), policy, lower=False,
+                       hlo_policy=hlo_policy)
+
+
+def fixture_hlo_dropped_alias():
+    """The donation that died INSIDE XLA: declared, StableHLO marker
+    survived (so passes.donation_pass is quiet — provably), but the
+    compiled module's ``input_output_alias`` table lost the entry.
+    Seeded by erasing the table from a real compiled module — exactly
+    the artifact a compile-time layout/shape mismatch produces while
+    the input IR still looks donated."""
+    import jax.numpy as jnp
+
+    def entry(state, x):
+        return state + x
+
+    args = (jnp.zeros((64, 64), jnp.float32),
+            jnp.ones((64, 64), jnp.float32))
+    policy = LintPolicy(expect_donation=True)
+    ctx = trace_entry("fixture_hlo_dropped_alias", entry, args, policy,
+                      donate_argnums=(0,),
+                      hlo_policy=HloPolicy(census={}, overlap="off"))
+    text = ctx.hlo  # compile the REAL module (alias present)...
+    assert "input_output_alias" in text
+    # ...then seed the drop: rename the table key so the parser sees a
+    # module that kept no alias (the marker in ctx.stablehlo stands)
+    ctx._hlo_text = text.replace("input_output_alias=",
+                                 "dropped_output_alias=", 1)
+    return ctx
+
+
+def fixture_hlo_sync_only_overlap():
+    """The overlap that never happened: a correctly-paired windowed
+    schedule (jaxpr passes all green) whose compiled module carries
+    only SYNCHRONOUS collectives while the entry's contract requires
+    async start/done pairs — what a TPU build produces when the
+    latency-hiding flags (runtime/xla_flags.py) were set after backend
+    init and silently ignored. The CPU backend compiles sync-only by
+    nature, which makes it the perfect stand-in for that broken TPU
+    module."""
+    return _windowed_entry(
+        "fixture_hlo_sync_only_overlap",
+        HloPolicy(overlap="require", pair_rs_ag=True,
+                  census={"reduce-scatter": 2, "all-gather": 2}))
+
+
+def fixture_hlo_census_vs_plan():
+    """The schedule that contradicts its plan: the entry declares the
+    FUSED verdict (one reduce-scatter, one all-gather — the
+    CollectivePlan's compiled signature for this class) but the program
+    that actually lowered is the W=2 WINDOWED schedule. Its jaxpr is
+    impeccable — phases paired, axes right — so the jaxpr catalog is
+    provably quiet; only the compiled census can see that what runs is
+    not what the plan priced."""
+    return _windowed_entry(
+        "fixture_hlo_census_vs_plan",
+        HloPolicy(overlap="verify",
+                  census={"reduce-scatter": 1, "all-gather": 1}))
+
+
+_SEEDED_TRIVIAL_OVERLAP = """\
+HloModule seeded_trivial_overlap, is_scheduled=true
+
+ENTRY %main (param: f32[8,64]) -> f32[8,128] {
+  %param = f32[8,64]{1,0} parameter(0)
+  %ag-start = (f32[8,64]{1,0}, f32[8,128]{1,0}) all-gather-start(f32[8,64]{1,0} %param), channel_id=1, replica_groups={{0,1}}, dimensions={1}
+  ROOT %ag-done = f32[8,128]{1,0} all-gather-done((f32[8,64]{1,0}, f32[8,128]{1,0}) %ag-start), channel_id=1
+}
+"""
+
+_SEEDED_UNFUSED_QUANT = """\
+HloModule seeded_unfused_quant, is_scheduled=true
+
+ENTRY %main (param: f32[64,512]) -> s8[64,512] {
+  %param = f32[64,512]{1,0} parameter(0)
+  %multiply.1 = f32[64,512]{1,0} multiply(f32[64,512]{1,0} %param, f32[64,512]{1,0} %param)
+  ROOT %convert.1 = s8[64,512]{1,0} convert(f32[64,512]{1,0} %multiply.1)
+}
+"""
+
+
+def _seeded_hlo_ctx(name: str, text: str,
+                    hlo_policy: HloPolicy):
+    """A trivially-clean traced entry carrying a hand-pinned compiled
+    module — for bug classes the CPU compiler cannot be coaxed into
+    producing (async forms exist only on accelerator backends)."""
+    import jax.numpy as jnp
+
+    def entry(x):
+        return x * 2.0
+
+    ctx = trace_entry(name, entry, (jnp.zeros((4,), jnp.float32),),
+                      LintPolicy(), lower=False, hlo_policy=hlo_policy)
+    ctx._hlo_text = text
+    return ctx
+
+
+def fixture_hlo_trivial_overlap():
+    """The async pair that overlaps NOTHING: start and done split (the
+    flags reached the compiler) but scheduled back-to-back — zero
+    compute in the gap, a serialized collective wearing async clothes.
+    Hand-pinned module text: only accelerator backends emit the
+    -start/-done forms, and this is what a failed window carve looks
+    like there."""
+    return _seeded_hlo_ctx(
+        "fixture_hlo_trivial_overlap", _SEEDED_TRIVIAL_OVERLAP,
+        HloPolicy(overlap="verify"))
+
+
+def fixture_hlo_unfused_quant():
+    """The quantize convert XLA left bare in the entry computation: the
+    full-precision buffer materializes in HBM before the wire — the
+    byte saving the int8 transport promised is spent again on the
+    memory system. Hand-pinned: the CPU backend fuses these miniatures
+    too eagerly to reproduce the miss organically."""
+    return _seeded_hlo_ctx(
+        "fixture_hlo_unfused_quant", _SEEDED_UNFUSED_QUANT,
+        HloPolicy(overlap="off", fused_quant=True))
+
+
+# (fixture name, builder, HLO pass that must fire, severity) — every
+# builder's context must ALSO be clean under the jaxpr/StableHLO
+# catalog (asserted by run_selfcheck: the provably-missed half)
+HLO_FIXTURES = [
+    ("hlo_dropped_alias", fixture_hlo_dropped_alias,
+     "hlo-aliasing", "error"),
+    ("hlo_sync_only_overlap", fixture_hlo_sync_only_overlap,
+     "hlo-overlap", "error"),
+    ("hlo_census_vs_plan", fixture_hlo_census_vs_plan,
+     "hlo-census", "error"),
+    ("hlo_trivial_overlap", fixture_hlo_trivial_overlap,
+     "hlo-overlap", "error"),
+    ("hlo_unfused_quant", fixture_hlo_unfused_quant,
+     "hlo-fusion", "warning"),
+]
+
+
 # (fixture name, pass that must fire, severity it must fire at)
 FIXTURES = [
     ("bad_axis", fixture_bad_axis, "collective-axis", "error"),
@@ -344,9 +525,14 @@ def _check_recompile_guard() -> "tuple[bool, str]":
     return False, "recompile guard NEVER fired on a shape change"
 
 
-def run_selfcheck() -> "tuple[bool, list[str]]":
+def run_selfcheck(include_hlo: bool = False
+                  ) -> "tuple[bool, list[str]]":
     """Build every fixture, run the pass catalog, verify each expected
-    (pass, severity) fires. Returns (all_caught, report lines)."""
+    (pass, severity) fires. With ``include_hlo`` the compiled-HLO
+    fixtures run too, each under a DOUBLE obligation: the
+    jaxpr/StableHLO catalog must stay quiet on it (the bug is provably
+    invisible pre-compile) AND the named HLO pass must fire. Returns
+    (all_caught, report lines)."""
     ok, lines = True, []
     for name, build, expect_pass, expect_sev in FIXTURES:
         ctx = build()
@@ -365,4 +551,32 @@ def run_selfcheck() -> "tuple[bool, list[str]]":
     guard_ok, guard_line = _check_recompile_guard()
     ok = ok and guard_ok
     lines.append(("caught  " if guard_ok else "MISSED  ") + guard_line)
+    if include_hlo:
+        for name, build, expect_pass, expect_sev in HLO_FIXTURES:
+            ctx = build()
+            base = [f for f in run_passes(ctx)
+                    if f.severity in ("error", "warning")]
+            if base:
+                ok = False
+                got = [(f.pass_name, f.severity) for f in base]
+                lines.append(
+                    f"MISSED  {name}: jaxpr/StableHLO passes fired "
+                    f"{got} — the fixture no longer demonstrates an "
+                    f"HLO-only gap (its point is a bug the base "
+                    f"catalog cannot see)")
+                continue
+            hits = [f for f in run_hlo_passes(ctx)
+                    if f.pass_name == expect_pass
+                    and f.severity == expect_sev]
+            if hits:
+                lines.append(f"caught  {name}: jaxpr-clean, "
+                             f"[{expect_pass}] "
+                             f"{hits[0].message[:60]}...")
+            else:
+                ok = False
+                got = [(f.pass_name, f.severity)
+                       for f in run_hlo_passes(ctx)]
+                lines.append(
+                    f"MISSED  {name}: expected [{expect_pass}] at "
+                    f"{expect_sev}, got {got or 'nothing'}")
     return ok, lines

@@ -22,8 +22,11 @@ the CLI surface maps as:
 * ``bench`` — the device-plane goodput benchmark (bench.py).
 * ``lint`` — the static-analysis plane (analysis/): trace the stack's
   jitted entry points to jaxprs on a virtual CPU mesh and machine-check
-  collective-axis / donation / dtype / host-sync invariants; exit-code
-  gated for CI, ``--selfcheck`` proves every pass still fires.
+  collective-axis / donation / dtype / host-sync invariants; ``--hlo``
+  additionally compiles each entry's optimized module and lints the
+  input_output_alias table, async start/done overlap, and collective
+  census of the programs XLA actually built; exit-code gated for CI,
+  ``--selfcheck`` proves every pass still fires.
 * ``perfgate`` — the perf-regression gate (telemetry/regression.py):
   re-measure the A/B benchmark sections and fail (exit 1) any claim
   row below the banked ``perf_capture/`` median minus tolerance.
@@ -4781,10 +4784,24 @@ def _add_lint(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--strict", action="store_true",
                    help="warnings gate the exit code too (default: "
                         "errors only)")
+    p.add_argument("--hlo", action="store_true",
+                   help="also lint the COMPILED modules (analysis/"
+                        "hlo.py): compile each entry's optimized HLO "
+                        "(lower().compile(), CPU-safe, no execution) "
+                        "and run the hlo-aliasing / hlo-overlap / "
+                        "hlo-census / hlo-fusion catalog — the "
+                        "input_output_alias table, async start/done "
+                        "overlap, and collective census of the "
+                        "programs XLA actually built (~40 s extra "
+                        "for the full catalog); composes with "
+                        "--all/--target/--format/--strict/--selfcheck")
     p.add_argument("--selfcheck", action="store_true",
                    help="run the deliberately-broken fixtures instead: "
                         "every pass must catch its fixture (the "
-                        "linter's own tier-1; analysis/selfcheck.py)")
+                        "linter's own tier-1; analysis/selfcheck.py). "
+                        "With --hlo the compiled-module fixtures run "
+                        "too — each must be jaxpr/StableHLO-clean AND "
+                        "caught by its HLO pass")
 
 
 def _cmd_lint(args: argparse.Namespace) -> int:
@@ -4813,7 +4830,7 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         return 0
     if args.selfcheck:
         from akka_allreduce_tpu.analysis.selfcheck import run_selfcheck
-        ok, lines = run_selfcheck()
+        ok, lines = run_selfcheck(include_hlo=args.hlo)
         for line in lines:
             print(line)
         print("selfcheck: every pass caught its fixture" if ok
@@ -4841,7 +4858,26 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         return 2
     findings = []
     for ctx in contexts:
-        findings.extend(run_passes(ctx))
+        if args.hlo:
+            from akka_allreduce_tpu.analysis.hlo import (arm_hlo,
+                                                         run_hlo_passes)
+            arm_hlo(ctx)
+            findings.extend(run_passes(ctx))
+            # only the COMPILE gets the build-error wrap (forced here;
+            # ctx.hlo caches, so the passes reuse the text) — a crash
+            # in a lint pass or the parser must surface as itself, not
+            # as a bogus "compile failed" triage trail
+            if ctx.hlo_policy is not None:
+                try:
+                    ctx.hlo
+                except Exception as e:
+                    print(f"error: compiling {ctx.name} for --hlo "
+                          f"failed: {type(e).__name__}: {e}",
+                          file=sys.stderr)
+                    return 2
+            findings.extend(run_hlo_passes(ctx))
+        else:
+            findings.extend(run_passes(ctx))
     names = [c.name for c in contexts]
     if args.format == "json":
         print(json.dumps(render_json(names, findings), indent=1))
