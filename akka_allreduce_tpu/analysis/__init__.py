@@ -27,6 +27,14 @@ Layout:
                      hlo-fusion catalog — the input_output_alias
                      table, async start/done overlap, and collective
                      census of the programs XLA actually built.
+* ``host``         — the host-concurrency plane (``lint --host``,
+                     ISSUE 15): pure-AST passes over the serving
+                     control plane's source — inferred lock
+                     discipline (host-guard), the lock-order /
+                     blocking-call / callback-under-lock deadlock
+                     catalog (host-order), and the thread-lifecycle
+                     inventory (host-lifecycle); the dynamic twin is
+                     runtime/raced.py.
 * ``recompile``    — the runtime half: a compile-counting guard that
                      turns "never recompiles after warmup" into an
                      asserted property.
@@ -62,6 +70,12 @@ from akka_allreduce_tpu.analysis.hlo import (  # noqa: E402
     run_hlo_passes,
     run_with_hlo,
 )
+from akka_allreduce_tpu.analysis.host import (  # noqa: E402
+    HostPolicy,
+    analyze_source,
+    build_host_catalog,
+    run_host_passes,
+)
 from akka_allreduce_tpu.analysis.recompile import (  # noqa: E402
     CompileLog,
     RecompileError,
@@ -70,6 +84,10 @@ from akka_allreduce_tpu.analysis.recompile import (  # noqa: E402
 )
 
 __all__ = [
+    "HostPolicy",
+    "analyze_source",
+    "build_host_catalog",
+    "run_host_passes",
     "Finding",
     "LintContext",
     "LintPolicy",

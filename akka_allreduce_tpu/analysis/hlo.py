@@ -54,7 +54,9 @@ fast as before.
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import os
 import re
 from typing import Callable, Iterator, Mapping, Optional
 
@@ -503,8 +505,11 @@ class HloPolicy:
     fusion computations, not bare in an entry/loop computation (an
     unfused quantize materializes the full-precision buffer the wire
     existed to avoid).
-    ``fusion_census``: report the kLoop/kInput fusion counts as an info
-    finding (regression-pinnable; never gates).
+    ``fusion_census``: report the kLoop/kInput fusion counts as an
+    info finding, and — when analysis/fusion_baseline.json banks a
+    floor for this entry on the SAME backend — gate (warning, so
+    ``--strict`` fails) if the total collapses below 0.5x the banked
+    count.
     """
 
     check_aliasing: bool = True
@@ -577,6 +582,7 @@ def run_hlo_passes(ctx: LintContext,
             "available (trace_entry captured no compile thunk and no "
             "hlo text was seeded)")]
     module = parse_hlo_text(text)
+    ctx._hlo_module = module  # reused by bank_fusion_baseline
     findings = []
     for name, fn in HLO_PASSES.items():
         if only is not None and name not in only:
@@ -795,14 +801,115 @@ def fusion_pass(ctx: LintContext, module: HloModule) -> list:
                 inst.name))
     if pol.fusion_census:
         census = module.fusion_census()
+        total = sum(census.values())
+        banked = (load_fusion_baseline() or {}).get(ctx.name)
+        if banked is not None:
+            import jax as _jax
+            if fusion_baseline_backend() != _jax.default_backend():
+                # the floors are backend-calibrated: CPU and TPU
+                # fusion strategies differ widely, so a CPU-banked
+                # floor must not gate an --on-chip run (and vice
+                # versa) — the info line still shows the banked count
+                banked = None
+        if banked is not None:
+            # the PIN (ISSUE 15 satellite): a census that COLLAPSED
+            # vs the banked artifact gates instead of hiding in an
+            # artifact diff. The 0.5x floor absorbs XLA-version count
+            # jitter; a halving is structural — a refactor un-fused
+            # something. Checked OUTSIDE the census-nonempty guard:
+            # the most extreme collapse (0 fusions left) must gate
+            # hardest, not vanish
+            floor = max(1, banked // 2)
+            if total < floor:
+                findings.append(Finding(
+                    "hlo-fusion", "warning", ctx.name,
+                    f"fusion census COLLAPSED: {total} fusion(s) "
+                    f"vs {banked} banked in "
+                    f"analysis/fusion_baseline.json (floor "
+                    f"{floor}) — XLA stopped fusing most of what "
+                    f"it used to for this entry; on-chip that is "
+                    f"an HBM-bandwidth cliff. Re-bank (`lint "
+                    f"--all --hlo --rebank-fusion`) ONLY if the "
+                    f"drop is understood and intended"))
         if census:
-            total = sum(census.values())
             detail = ", ".join(f"{v} {k}" for k, v in
                                sorted(census.items()))
+            vs = f" (banked: {banked})" if banked is not None else ""
             findings.append(Finding(
                 "hlo-fusion", "info", ctx.name,
-                f"fusion census: {total} fusion(s) ({detail}) — "
-                f"regression-pinnable; a falling count after a "
-                f"refactor means XLA stopped fusing something it used "
-                f"to"))
+                f"fusion census: {total} fusion(s) ({detail})"
+                f"{vs} — regression-pinnable; a falling count after "
+                f"a refactor means XLA stopped fusing something it "
+                f"used to"))
     return findings
+
+
+# -- the banked fusion baseline (ISSUE 15 satellite) --------------------
+#
+# `lint --all --hlo --rebank-fusion` writes the per-entry fusion totals
+# observed in a run; the fusion pass above gates later runs against a
+# 0.5x floor of the banked number. The artifact lives in the repo so
+# the pin travels with the code it pins.
+
+_FUSION_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fusion_baseline.json")
+_fusion_baseline_cache: "Optional[dict]" = None
+
+
+_fusion_baseline_backend: "Optional[str]" = None
+
+
+def load_fusion_baseline() -> "Optional[dict]":
+    """entry name -> banked total fusion count, or None when no
+    baseline is banked (the pass then only reports the info line)."""
+    global _fusion_baseline_cache, _fusion_baseline_backend
+    if _fusion_baseline_cache is None:
+        try:
+            with open(_FUSION_BASELINE_PATH) as f:
+                data = json.load(f)
+            _fusion_baseline_cache = {
+                k: int(v) for k, v in data.get("entries", {}).items()}
+            _fusion_baseline_backend = data.get("backend", "cpu")
+        except (OSError, ValueError):
+            _fusion_baseline_cache = {}
+    return _fusion_baseline_cache or None
+
+
+def fusion_baseline_backend() -> str:
+    """The backend the banked floors were calibrated on ("cpu" unless
+    an operator re-banked on-chip) — the collapse gate compares only
+    same-backend runs."""
+    load_fusion_baseline()
+    return _fusion_baseline_backend or "cpu"
+
+
+def bank_fusion_baseline(contexts: "list[LintContext]") -> str:
+    """Write the observed per-entry fusion totals as the new banked
+    baseline (compiles lazily through ``ctx.hlo`` like the passes)."""
+    import jax as _jax
+    global _fusion_baseline_cache, _fusion_baseline_backend
+    entries = {}
+    for ctx in contexts:
+        if ctx.hlo_policy is None or ctx.hlo is None:
+            continue
+        # run_hlo_passes stashes its parsed module on the context —
+        # reparsing the largest pure-CPU artifact of the run just to
+        # re-count fusions would double the expensive step
+        module = getattr(ctx, "_hlo_module", None)
+        if module is None:
+            module = parse_hlo_text(ctx.hlo)
+        census = module.fusion_census()
+        if census:
+            entries[ctx.name] = sum(census.values())
+    data = {"comment": "per-entry compiled fusion totals; the "
+                       "hlo-fusion pass gates at 0.5x this floor on "
+                       "the SAME backend (re-bank via lint --all "
+                       "--hlo --rebank-fusion)",
+            "backend": _jax.default_backend(),
+            "entries": dict(sorted(entries.items()))}
+    with open(_FUSION_BASELINE_PATH, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+    _fusion_baseline_cache = None
+    _fusion_baseline_backend = None
+    return _FUSION_BASELINE_PATH

@@ -1,0 +1,1048 @@
+"""Host-plane concurrency lint: the third graftlint plane (ISSUE 15).
+
+The jaxpr catalog (passes.py) and the compiled-HLO catalog (hlo.py)
+verify the DEVICE programs — but the fault-tolerance and overload
+claims (watchdog recovery, drain/restore, hedged dispatch, admission
+economics) run on the HOST plane: Python threads, locks, sockets, and
+executors. The reference implementation got data-race freedom for free
+by putting every piece of mutable protocol state inside a
+single-threaded Akka actor; our reproduction replaced actors with
+threads, and nothing machine-checked the replacement until now. A
+lock-order inversion in the telemetry plane or an unguarded counter in
+a retry ledger silently breaks the exact reconciliation identities the
+chaos suites pin — and is invisible to both device planes by
+construction, because the bug lives in source the tracer never sees.
+
+This module is the STATIC half: pure ``ast`` analysis over the host
+source (no imports executed — linting a module can never run its
+side effects), in the same calibrated-policy shape as the other
+planes. The DYNAMIC half is ``runtime/raced.py`` (the opt-in
+lockset/happens-before detector armed inside the chaos/stress suites).
+
+Pass catalog (names the CLI/report/DESIGN.md §18 use):
+
+* ``host-guard``   — lock-discipline inference. For each class owning a
+  ``threading.Lock``/``RLock``, the guarded field set is INFERRED: a
+  field written at least once under ``with self._lock`` is a guarded
+  field, so every other write must hold the lock too (error) and bare
+  reads from thread-reachable methods are flagged (warning). Classes
+  that own threads but no lock get the cross-thread write/write check:
+  a field written both inside and outside the thread's reach without
+  any lock is a finding unless the per-module :class:`HostPolicy`
+  names it (e.g. a single-writer monotonic counter, or a field whose
+  cross-thread handoff is sequenced by ``Thread.join``).
+* ``host-order``   — the deadlock catalog. Interprocedural
+  acquire-while-holding edges (nested ``with`` blocks plus self-calls
+  resolved through a per-class fixpoint) feed a global lock-order
+  graph; any cycle is a deadlock candidate (error). The same walk
+  flags BLOCKING calls inside a critical section — socket recv,
+  ``Future.result``, ``Event.wait``, thread ``join``, ``time.sleep``,
+  device readback (``block_until_ready``/``device_get``),
+  ``urlopen``, subprocess waits — the machine-checked form of the
+  hung-peer deadlock comment in protocol/tcp.py; and CALLBACK
+  invocations under a lock (``.pull()`` / ``.read()`` / ``on_*``),
+  the rule telemetry/registry.py's pull-collector contract previously
+  promised only in prose.
+* ``host-lifecycle`` — the thread inventory. Every ``Thread(...)``
+  must be daemon or reachably joined; every loop-thread target must
+  check a stop ``Event`` (``while not self._stop.wait(..)`` or an
+  ``is_set`` break); every ``ThreadPoolExecutor`` field must be shut
+  down from a teardown-named method (``close``/``stop``/``__exit__``
+  ...), not only from an exception path. The per-module thread
+  inventory is also emitted as a pinnable info line.
+
+Calibration, not silence: the repo lints clean under
+``lint --all --host --strict`` because every deliberate exception is a
+NAMED per-module :class:`HostPolicy` entry with a WHY — never a
+skipped file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Callable, Mapping, Optional
+
+from akka_allreduce_tpu.analysis.core import Finding
+
+# -- what counts as what ------------------------------------------------
+
+# threading factory callables that make a lock-ish attribute
+_LOCK_FACTORIES = frozenset({"Lock", "RLock"})
+_EVENT_FACTORIES = frozenset({"Event"})
+_THREAD_FACTORIES = frozenset({"Thread", "Timer"})
+_EXECUTOR_FACTORIES = frozenset({"ThreadPoolExecutor",
+                                 "ProcessPoolExecutor"})
+# method calls on a field that MUTATE the referenced container — writes
+# for the guard inference (CPython makes each individually atomic, but
+# the invariant a lock guards usually spans more than one of them)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "clear", "add", "discard", "update",
+    "setdefault", "put", "put_nowait", "sort", "reverse",
+})
+# attribute calls that BLOCK the calling thread — forbidden while
+# holding a lock (the tcp.py hung-peer rule, machine-checked): a peer
+# needing the same lock to make progress deadlocks the pair
+_BLOCKING_ATTRS = frozenset({
+    "recv", "recvfrom", "recv_into", "accept", "connect",  # sockets
+    "result",                      # concurrent.futures.Future.result
+    "wait", "waitpid", "communicate",  # Event/Condition/subprocess
+    "join",                        # Thread.join
+    "urlopen",                     # urllib
+    "block_until_ready", "device_get",  # device readback
+    "serve_forever",
+})
+# time.sleep is blocking too, but "sleep" alone is too generic — match
+# the (base, attr) pair
+_BLOCKING_DOTTED = frozenset({("time", "sleep")})
+# attribute calls that INVOKE A CALLBACK — user code of unknown cost
+# and unknown lock needs; calling one while holding a lock hands your
+# critical section to a stranger (the registry pull-collector rule)
+_CALLBACK_ATTRS = frozenset({"pull", "read", "cb", "callback", "hook"})
+_CALLBACK_PREFIX = "on_"
+# a teardown-shaped method: the place an executor shutdown / thread
+# join must be reachable from (shutdown only on an exception path is
+# not teardown — the happy path leaks the worker thread)
+_TEARDOWN_NAMES = frozenset({
+    "close", "stop", "shutdown", "terminate", "teardown", "__exit__",
+    "__del__", "join", "finish",
+})
+
+
+# -- policy -------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class HostPolicy:
+    """Which host-concurrency invariants bend, per module — every entry
+    a NAMED exception with a WHY string that the report can surface.
+
+    ``shared_classes``: class names whose instances are read from
+    threads the class itself does not spawn (e.g. a registry scraped
+    by an HTTP handler thread) — every method counts as
+    thread-reachable for the bare-read check.
+    ``unguarded_ok``: ``"Class.field" -> why`` — fields deliberately
+    accessed without the lock (single-writer monotonic counters, or
+    cross-thread handoffs sequenced by ``Thread.join``).
+    ``blocking_ok``: ``"Class.method" -> why`` — a blocking call that
+    is legitimately inside a critical section there.
+    ``callback_ok``: ``"Class.method" -> why`` — a callback invocation
+    under a lock that is safe (e.g. the callee is documented
+    lock-free).
+    ``unjoined_ok``: ``"Class.method" -> why`` — a non-daemon,
+    never-joined thread spawned in that method that is deliberate.
+    ``loop_ok``: ``"Class.method" -> why`` — a loop-thread target
+    excused from the stop-``Event`` rule (e.g. terminates by socket
+    close).
+    ``executor_ok``: ``"Class.field" -> why`` — an executor excused
+    from the teardown-shutdown rule.
+    """
+
+    shared_classes: tuple = ()
+    unguarded_ok: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+    blocking_ok: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+    callback_ok: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+    unjoined_ok: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+    loop_ok: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    executor_ok: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+
+
+# -- module model -------------------------------------------------------
+
+@dataclasses.dataclass
+class FieldAccess:
+    method: str
+    field: str
+    kind: str          # "read" | "write"
+    line: int
+    locks: tuple       # lock attr names held at the access
+
+
+@dataclasses.dataclass
+class ThreadSpawn:
+    method: str
+    line: int
+    target: Optional[str]    # "self.m" | "self.X.m" | local name | None
+    daemon: Optional[bool]   # None = not set (default False)
+    name: Optional[str]
+    assigned: Optional[str]  # "self.X" field, local name, or None
+    joined: bool = False
+
+
+@dataclasses.dataclass
+class ExecutorSpawn:
+    method: str
+    line: int
+    assigned: Optional[str]          # field name when self.X = ...
+
+
+@dataclasses.dataclass
+class CallRecord:
+    """One call site, with the lock context it ran under (possibly
+    empty — the blocking fixpoint needs every call, the under-lock
+    checks filter on ``locks``)."""
+
+    method: str
+    line: int
+    callee: str        # dotted-ish description
+    attr: str          # final attribute name ("" for opaque callees)
+    base: str          # leading name ("self", "time", local, ...)
+    locks: tuple       # lock attr names held
+
+
+@dataclasses.dataclass
+class ClassModel:
+    name: str
+    locks: "dict[str, int]"              # lock attr -> def line
+    events: "set[str]"
+    methods: "set[str]"
+    accesses: "list[FieldAccess]"
+    spawns: "list[ThreadSpawn]"
+    executors: "list[ExecutorSpawn]"
+    calls: "list[CallRecord]"
+    # lock acquisitions: [(method, held_tuple, acquired, line)]
+    acquires: "list[tuple]"
+    self_calls: "list[tuple]"            # (method, callee, line, held)
+    field_joins: "set[str]"              # self.X.join(...) seen, any method
+    field_join_methods: "dict[str, set]"  # field -> methods joining it
+    shutdown_sites: "dict[str, set]"     # field -> methods calling .shutdown()
+    while_loops: "dict[str, list]"       # method -> [(line, checks_event)]
+
+
+@dataclasses.dataclass
+class HostModule:
+    relpath: str                         # e.g. "serving/engine.py"
+    policy: HostPolicy
+    classes: "list[ClassModel]"
+    parse_error: Optional[str] = None
+
+
+# -- AST analysis -------------------------------------------------------
+
+def _dotted(expr) -> "tuple[str, str]":
+    """(base, attr) of a call target: ``self._sock.recv`` ->
+    ("self._sock", "recv"); ``time.sleep`` -> ("time", "sleep");
+    ``pull()`` -> ("", "pull")."""
+    if isinstance(expr, ast.Attribute):
+        parts = []
+        node = expr
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        base = node.id if isinstance(node, ast.Name) else "<expr>"
+        parts.reverse()
+        return ".".join([base] + parts[:-1]), parts[-1]
+    if isinstance(expr, ast.Name):
+        return "", expr.id
+    return "<expr>", ""
+
+
+def _factory_of(call: ast.Call) -> Optional[str]:
+    """The trailing name of a call's callee (``threading.Lock`` ->
+    "Lock"), for matching against the factory sets."""
+    _base, attr = _dotted(call.func)
+    return attr or None
+
+
+def _self_attr(expr) -> Optional[str]:
+    """``self.X`` -> "X" (one level only)."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return expr.attr
+    return None
+
+
+def _self_attr_deep(expr) -> Optional[str]:
+    """The FIELD a write target ultimately mutates: ``self.X`` /
+    ``self.X[...]`` / ``self.X.anything`` all resolve to "X"."""
+    node = expr
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        direct = _self_attr(node)
+        if direct is not None:
+            return direct
+        node = node.value
+    return None
+
+
+class _ClassWalker:
+    """One pass over a class body, tracking the held-lock context."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.model = ClassModel(
+            name=cls.name, locks={}, events=set(),
+            methods={n.name for n in cls.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))},
+            accesses=[], spawns=[], executors=[], calls=[],
+            acquires=[], self_calls=[], field_joins=set(),
+            field_join_methods={}, shutdown_sites={}, while_loops={})
+        self._cls = cls
+        self._method = ""
+        # local name -> ThreadSpawn (for t = Thread(...); t.join())
+        self._local_threads: "dict[str, ThreadSpawn]" = {}
+
+    # -- discovery pass: lock/event attributes must be known before
+    # the access walk can classify `with self.X` regions
+    def discover(self) -> None:
+        for node in ast.walk(self._cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = getattr(node, "value", None)
+            if not isinstance(value, ast.Call):
+                continue
+            factory = _factory_of(value)
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if factory in _LOCK_FACTORIES:
+                    self.model.locks[attr] = node.lineno
+                elif factory in _EVENT_FACTORIES:
+                    self.model.events.add(attr)
+
+    def walk(self) -> ClassModel:
+        self.discover()
+        for node in self._cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._method = node.name
+                self._local_threads = {}
+                for stmt in node.body:
+                    self._walk(stmt, ())
+        return self.model
+
+    # -- the recursive walk ----------------------------------------------
+
+    def _walk(self, node, held: tuple) -> None:
+        if isinstance(node, ast.With):
+            self._walk_with(node, held)
+            return
+        if isinstance(node, ast.Call):
+            self._walk_call(node, held)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._walk_assign(node, held)
+            return
+        if isinstance(node, ast.While):
+            self.model.while_loops.setdefault(self._method, []).append(
+                (node.lineno, self._while_checks_event(node)))
+            self._walk(node.test, held)
+            for child in node.body + node.orelse:
+                self._walk(child, held)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested def/lambda runs LATER, not here: its body is
+            # walked with no held locks (a closure defined inside a
+            # critical section does not execute inside it)
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            for child in body:
+                self._walk(child, ())
+            return
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.ctx, ast.Load):
+                attr = _self_attr(node)
+                if attr is not None:
+                    self._record_access(attr, "read", node.lineno, held)
+            self._walk(node.value, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+    def _walk_with(self, node: ast.With, held: tuple) -> None:
+        entered = list(held)
+        for item in node.items:
+            ctx = item.context_expr
+            self._walk(ctx, tuple(entered))
+            attr = _self_attr(ctx)
+            if attr is not None and attr in self.model.locks:
+                self.model.acquires.append(
+                    (self._method, tuple(entered), attr, ctx.lineno))
+                entered.append(attr)
+        for child in node.body:
+            self._walk(child, tuple(entered))
+
+    def _while_checks_event(self, node: ast.While) -> bool:
+        """Does the loop's condition (or a break path in its body)
+        consult an Event field — ``while not self._stop.wait(..)`` /
+        ``.is_set()`` — or iterate over something bounded (a plain
+        ``for`` is not a loop thread's forever loop)?"""
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("wait", "is_set"):
+                field = _self_attr(sub.value)
+                if field in self.model.events:
+                    return True
+        # break/return guarded by an event check inside the body
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("wait", "is_set"):
+                field = _self_attr(sub.value)
+                if field in self.model.events:
+                    return True
+        return False
+
+    def _walk_call(self, node: ast.Call, held: tuple) -> None:
+        base, attr = _dotted(node.func)
+        factory = attr or None
+        # thread / executor construction (bare-expression spawns; the
+        # assigned forms go through _walk_assign)
+        if factory in _THREAD_FACTORIES:
+            self.model.spawns.append(self._spawn_from(node))
+        elif factory in _EXECUTOR_FACTORIES:
+            self.model.executors.append(ExecutorSpawn(
+                self._method, node.lineno, assigned=None))
+        # field-method calls: mutators are writes, joins are joins
+        field = _self_attr(getattr(node.func, "value", None)) \
+            if isinstance(node.func, ast.Attribute) else None
+        if field is not None:
+            if attr in _MUTATORS:
+                self._record_access(field, "write", node.lineno, held)
+            elif attr == "join":
+                self.model.field_joins.add(field)
+                self.model.field_join_methods.setdefault(
+                    field, set()).add(self._method)
+                if field in self._local_threads:
+                    self._local_threads[field].joined = True
+            elif attr == "shutdown":
+                self.model.shutdown_sites.setdefault(
+                    field, set()).add(self._method)
+            else:
+                self._record_access(field, "read", node.lineno, held)
+        elif isinstance(node.func, ast.Attribute):
+            # deeper chains (self.a.b.c()): the base chain is reads
+            self._walk(node.func.value, held)
+        # local-thread ops: t.join()
+        if isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name):
+            local = node.func.value.id
+            if local in self._local_threads and attr == "join":
+                self._local_threads[local].joined = True
+        # self-calls for the interprocedural fixpoint
+        if base == "self" and attr in self.model.methods:
+            self.model.self_calls.append(
+                (self._method, attr, node.lineno, held))
+        self.model.calls.append(CallRecord(
+            self._method, node.lineno,
+            callee=(f"{base}.{attr}" if base else attr or "<call>"),
+            attr=attr, base=base, locks=held))
+        self._walk_call_operands(node, held)
+
+    def _walk_call_operands(self, node: ast.Call, held: tuple) -> None:
+        for kw in node.keywords:
+            self._walk(kw.value, held)
+        for arg in node.args:
+            self._walk(arg, held)
+
+    def _spawn_from(self, node: ast.Call) -> ThreadSpawn:
+        target = daemon = name = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                tbase, tattr = _dotted(kw.value)
+                if isinstance(kw.value, ast.Name):
+                    target = kw.value.id
+                elif tattr:
+                    target = f"{tbase}.{tattr}" if tbase else tattr
+            elif kw.arg == "daemon" \
+                    and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            elif kw.arg == "name" \
+                    and isinstance(kw.value, ast.Constant):
+                name = str(kw.value.value)
+        return ThreadSpawn(self._method, node.lineno, target=target,
+                           daemon=daemon, name=name, assigned=None)
+
+    def _walk_assign(self, node, held: tuple) -> None:
+        value = getattr(node, "value", None)
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        # spawn assignment: self.X = Thread(...) / t = Thread(...) /
+        # self.X = t  — bind the spawn to its name so join detection
+        # can follow it
+        spawn = None
+        if isinstance(value, ast.Call):
+            factory = _factory_of(value)
+            if factory in _THREAD_FACTORIES:
+                spawn = self._spawn_from(value)
+                self.model.spawns.append(spawn)
+                # the ctor's argument EXPRESSIONS still execute here:
+                # a mutator / blocking call smuggled into args=(...)
+                # must reach the passes (walking `value` itself would
+                # double-record the spawn through _walk_call)
+                self._walk_call_operands(value, held)
+            elif factory in _EXECUTOR_FACTORIES:
+                ex = ExecutorSpawn(self._method, node.lineno,
+                                   assigned=None)
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        ex.assigned = attr
+                self.model.executors.append(ex)
+                self._walk_call_operands(value, held)
+                for t in targets:
+                    self._mark_write_target(t, held)
+                return
+        elif isinstance(value, ast.Name) \
+                and value.id in self._local_threads:
+            # self.X = t — the field aliases the local spawn
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    self._local_threads[value.id].assigned = attr
+                    # a later self.X.join() resolves through
+                    # field_joins; link the alias
+                    self._local_threads[attr] = \
+                        self._local_threads[value.id]
+        if spawn is not None:
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    spawn.assigned = attr
+                    self._local_threads[attr] = spawn
+                elif isinstance(t, ast.Name):
+                    spawn.assigned = t.id
+                    self._local_threads[t.id] = spawn
+        if value is not None and spawn is None:
+            self._walk(value, held)
+        for t in targets:
+            self._mark_write_target(t, held)
+        if isinstance(node, ast.AugAssign):
+            # x += 1 reads too, but the WRITE is the racing half;
+            # one access record is enough for the inference
+            pass
+
+    def _mark_write_target(self, target, held: tuple) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._mark_write_target(elt, held)
+            return
+        field = _self_attr_deep(target)
+        if field is not None:
+            self._record_access(field, "write",
+                                getattr(target, "lineno", 0), held)
+        # a subscript/attribute write also READS the base chain
+        if isinstance(target, (ast.Subscript, ast.Attribute)):
+            self._walk(target.value, held)
+
+    def _record_access(self, field: str, kind: str, line: int,
+                       held: tuple) -> None:
+        if field in self.model.locks or field in self.model.events:
+            return  # the lock itself is not guarded state
+        if field in self.model.methods:
+            return  # self.method reference, not a field
+        self.model.accesses.append(FieldAccess(
+            self._method, field, kind, line, tuple(held)))
+
+
+def analyze_source(relpath: str, source: str,
+                   policy: Optional[HostPolicy] = None) -> HostModule:
+    """Parse one module's source into a :class:`HostModule` — no
+    imports executed, ever."""
+    policy = policy or HostPolicy()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return HostModule(relpath, policy, [], parse_error=str(e))
+    classes = []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            classes.append(_ClassWalker(node).walk())
+    # module-level functions ride as a pseudo-class so thread spawns /
+    # blocking-under-lock in free functions are still inventoried
+    free = ast.ClassDef(name="<module>", bases=[], keywords=[],
+                        body=[n for n in tree.body
+                              if isinstance(n, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))],
+                        decorator_list=[])
+    if free.body:
+        classes.append(_ClassWalker(free).walk())
+    return HostModule(relpath, policy, classes)
+
+
+# -- interprocedural helpers --------------------------------------------
+
+def _closure(roots: "set[str]", edges: "dict[str, set]") -> "set[str]":
+    out = set(roots)
+    frontier = list(roots)
+    while frontier:
+        m = frontier.pop()
+        for n in edges.get(m, ()):
+            if n not in out:
+                out.add(n)
+                frontier.append(n)
+    return out
+
+
+def _acquire_sets(cm: ClassModel) -> "dict[str, set]":
+    """Fixpoint: locks each method acquires, directly or via
+    self-calls."""
+    direct: "dict[str, set]" = {}
+    for method, _held, acquired, _line in cm.acquires:
+        direct.setdefault(method, set()).add(acquired)
+    call_edges: "dict[str, set]" = {}
+    for m, callee, _line, _held in cm.self_calls:
+        call_edges.setdefault(m, set()).add(callee)
+    out: "dict[str, set]" = {}
+    for m in cm.methods:
+        out[m] = set()
+        for n in _closure({m}, call_edges):
+            out[m] |= direct.get(n, set())
+    return out
+
+
+def _thread_roots(cm: ClassModel, policy: HostPolicy) -> "set[str]":
+    """Methods that RUN ON another thread: Thread targets (self.m),
+    plus every method when the policy marks the class shared."""
+    roots: "set[str]" = set()
+    for sp in cm.spawns:
+        if sp.target and sp.target.startswith("self.") \
+                and sp.target.count(".") == 1:
+            roots.add(sp.target.split(".", 1)[1])
+    if cm.name in policy.shared_classes:
+        roots |= set(cm.methods)
+    return roots
+
+
+def _thread_reachable(cm: ClassModel, policy: HostPolicy) -> "set[str]":
+    call_edges: "dict[str, set]" = {}
+    for m, callee, _line, _held in cm.self_calls:
+        call_edges.setdefault(m, set()).add(callee)
+    return _closure(_thread_roots(cm, policy), call_edges)
+
+
+# -- passes -------------------------------------------------------------
+
+HOST_PASSES: "dict[str, Callable[[HostModule], list]]" = {}
+
+
+def host_pass(name: str):
+    def register(fn):
+        HOST_PASSES[name] = fn
+        return fn
+
+    return register
+
+
+def _where(cm: ClassModel, method: str, line: int) -> str:
+    return f"{cm.name}.{method}:{line}"
+
+
+@host_pass("host-guard")
+def guard_pass(module: HostModule) -> list:
+    """Lock-discipline inference (see module docstring)."""
+    pol = module.policy
+    findings: "list[Finding]" = []
+    for cm in module.classes:
+        reachable = _thread_reachable(cm, pol)
+        has_threads = bool(cm.spawns) or cm.name in pol.shared_classes
+        if cm.locks:
+            locked_writes: "dict[str, list]" = {}
+            for a in cm.accesses:
+                if a.kind == "write" and a.locks \
+                        and a.method != "__init__":
+                    locked_writes.setdefault(a.field, []).append(a)
+            guarded = set(locked_writes)
+            # holding A lock is not holding THE lock: every locked
+            # write to one field must share at least one common lock,
+            # or the writers exclude nobody (the disjoint-lockset
+            # write race, statically — raced.py's intersection rule)
+            for field, accs in sorted(locked_writes.items()):
+                key = f"{cm.name}.{field}"
+                if key in pol.unguarded_ok or len(accs) < 2:
+                    continue
+                common = set(accs[0].locks)
+                witness = None
+                for a in accs[1:]:
+                    if not common & set(a.locks):
+                        witness = a
+                        break
+                    common &= set(a.locks)
+                if witness is not None:
+                    first = accs[0]
+                    findings.append(Finding(
+                        "host-guard", "error", module.relpath,
+                        f"field {cm.name}.{field} is written under "
+                        f"DISJOINT locks: {first.method}:{first.line} "
+                        f"holds {sorted(first.locks)} while "
+                        f"{witness.method}:{witness.line} holds "
+                        f"{sorted(witness.locks)} — no common lock "
+                        f"orders the writers; pick ONE lock for the "
+                        f"field or name the exception with its "
+                        f"story",
+                        _where(cm, witness.method, witness.line)))
+            for a in cm.accesses:
+                if a.field not in guarded or a.locks \
+                        or a.method == "__init__":
+                    continue
+                key = f"{cm.name}.{a.field}"
+                if key in pol.unguarded_ok:
+                    continue
+                if a.kind == "write":
+                    findings.append(Finding(
+                        "host-guard", "error", module.relpath,
+                        f"field {cm.name}.{a.field} is lock-guarded "
+                        f"(written under {sorted(set(cm.locks))} "
+                        f"elsewhere) but WRITTEN BARE in "
+                        f"{a.method}:{a.line} — two writers can "
+                        f"interleave and the guarded invariant is "
+                        f"fiction at exactly the access a reader "
+                        f"trusts; hold the lock or name the exception "
+                        f"in the module HostPolicy with a WHY",
+                        _where(cm, a.method, a.line)))
+                elif a.method in reachable:
+                    findings.append(Finding(
+                        "host-guard", "warning", module.relpath,
+                        f"field {cm.name}.{a.field} is lock-guarded "
+                        f"but READ BARE from thread-reachable "
+                        f"{a.method}:{a.line} — the read can observe "
+                        f"a torn multi-field update mid-flight; take "
+                        f"the lock, copy under it, or policy-name the "
+                        f"exception",
+                        _where(cm, a.method, a.line)))
+        if has_threads:
+            # cross-thread write/write with no lock at all: fields
+            # written both inside and outside the thread's reach
+            unguarded_writes: "dict[str, list]" = {}
+            for a in cm.accesses:
+                if a.kind == "write" and not a.locks \
+                        and a.method != "__init__":
+                    unguarded_writes.setdefault(a.field, []).append(a)
+            for field, accs in sorted(unguarded_writes.items()):
+                inside = [a for a in accs if a.method in reachable]
+                outside = [a for a in accs if a.method not in reachable]
+                if not inside or not outside:
+                    continue
+                key = f"{cm.name}.{field}"
+                if key in pol.unguarded_ok:
+                    continue
+                findings.append(Finding(
+                    "host-guard", "warning", module.relpath,
+                    f"field {cm.name}.{field} is written from the "
+                    f"class's own thread ({inside[0].method}:"
+                    f"{inside[0].line}) AND from caller methods "
+                    f"({outside[0].method}:{outside[0].line}) with no "
+                    f"lock — a write/write race unless one side is "
+                    f"sequenced (join/Event); make the handoff "
+                    f"explicit or name the exception with its "
+                    f"happens-before story",
+                    _where(cm, outside[0].method, outside[0].line)))
+    return findings
+
+
+@host_pass("host-order")
+def order_pass(module: HostModule) -> list:
+    """Per-module half of the deadlock catalog: blocking calls and
+    callback invocations inside critical sections. (Lock-order CYCLES
+    need the cross-module graph — :func:`lock_order_findings`.)"""
+    pol = module.policy
+    findings: "list[Finding]" = []
+    for cm in module.classes:
+        blocking_sets = _method_blocking(cm)
+        for lc in cm.calls:
+            if not lc.locks:
+                continue
+            mkey = f"{cm.name}.{lc.method}"
+            is_blocking = _is_blocking(lc)
+            if is_blocking and mkey not in pol.blocking_ok:
+                findings.append(Finding(
+                    "host-order", "error", module.relpath,
+                    f"BLOCKING call {lc.callee}() at {cm.name}."
+                    f"{lc.method}:{lc.line} inside a critical section "
+                    f"(holding {list(lc.locks)}) — any thread that "
+                    f"needs {list(lc.locks)} to make the blocked "
+                    f"operation complete deadlocks the pair (the "
+                    f"hung-peer rule protocol/tcp.py documents); move "
+                    f"the wait outside the lock or policy-name the "
+                    f"exception",
+                    _where(cm, lc.method, lc.line)))
+            is_callback = (lc.attr in _CALLBACK_ATTRS
+                           or lc.attr.startswith(_CALLBACK_PREFIX)
+                           or (not lc.attr and lc.base == ""))
+            if is_callback and not is_blocking \
+                    and mkey not in pol.callback_ok:
+                findings.append(Finding(
+                    "host-order", "error", module.relpath,
+                    f"callback {lc.callee}() invoked at {cm.name}."
+                    f"{lc.method}:{lc.line} while holding "
+                    f"{list(lc.locks)} — the callee's cost and lock "
+                    f"needs are not this module's to know; a collector "
+                    f"that re-enters the registry (or just blocks) "
+                    f"wedges every writer. Snapshot under the lock, "
+                    f"call outside it (the pull-collector rule)",
+                    _where(cm, lc.method, lc.line)))
+            # interprocedural: calling a self-method that blocks,
+            # while holding a lock
+            if lc.base == "self" and lc.attr in cm.methods:
+                via = blocking_sets.get(lc.attr)
+                if via and mkey not in pol.blocking_ok:
+                    desc, bline = via
+                    findings.append(Finding(
+                        "host-order", "error", module.relpath,
+                        f"self.{lc.attr}() called at {cm.name}."
+                        f"{lc.method}:{lc.line} while holding "
+                        f"{list(lc.locks)}, and {lc.attr} BLOCKS "
+                        f"(via {desc} at line {bline}) — the critical "
+                        f"section now waits on the outside world",
+                        _where(cm, lc.method, lc.line)))
+    return findings
+
+
+def _is_blocking(lc: CallRecord) -> bool:
+    """Is this call blocking for the under-lock rule? ``join`` only
+    counts on a self-field (``", ".join`` / ``os.path.join`` are
+    string/path joins, not thread waits)."""
+    if (lc.base, lc.attr) in _BLOCKING_DOTTED:
+        return True
+    if lc.attr not in _BLOCKING_ATTRS:
+        return False
+    if lc.attr == "join":
+        return lc.base.startswith("self")
+    return True
+
+
+def _method_blocking(cm: ClassModel) -> "dict[str, tuple]":
+    """method -> (description, line) for methods containing a blocking
+    call (any lock context — the interprocedural rule flags the
+    locked CALLER)."""
+    direct: "dict[str, tuple]" = {}
+    for lc in cm.calls:
+        if _is_blocking(lc):
+            direct.setdefault(lc.method, (lc.callee, lc.line))
+    return direct
+
+
+def lock_order_findings(modules: "list[HostModule]") -> list:
+    """The cross-module lock-order graph: every acquire-while-holding
+    edge (nested ``with`` or a self-call that acquires, resolved per
+    class) lands in one digraph; a cycle is a deadlock candidate.
+    Nodes are ``module:Class.lockattr``."""
+    edges: "dict[str, dict[str, str]]" = {}
+
+    def _add_edge(a: str, b: str, site: str) -> None:
+        edges.setdefault(a, {}).setdefault(b, site)
+
+    for module in modules:
+        for cm in module.classes:
+            qual = f"{module.relpath}:{cm.name}"
+            acq = _acquire_sets(cm)
+            # direct nesting
+            for method, held, acquired, line in cm.acquires:
+                for h in held:
+                    if h != acquired:
+                        _add_edge(f"{qual}.{h}", f"{qual}.{acquired}",
+                                  f"{cm.name}.{method}:{line}")
+            # interprocedural: self.m() under a lock acquires m's set
+            for m, callee, line, held in cm.self_calls:
+                if not held:
+                    continue
+                for lock in acq.get(callee, ()):
+                    for h in held:
+                        if h != lock:
+                            _add_edge(f"{qual}.{h}", f"{qual}.{lock}",
+                                      f"{cm.name}.{m}:{line}")
+    findings: "list[Finding]" = []
+    seen_cycles: "set[frozenset]" = set()
+    # DFS cycle detection with path recovery
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: "dict[str, int]" = {}
+
+    def _dfs(node: str, path: list) -> None:
+        color[node] = GRAY
+        path.append(node)
+        for nxt, site in edges.get(node, {}).items():
+            if color.get(nxt, WHITE) == GRAY:
+                cycle = path[path.index(nxt):] + [nxt]
+                key = frozenset(cycle)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    sites = [edges[a].get(b, "?") for a, b in
+                             zip(cycle, cycle[1:])]
+                    mod = cycle[0].split(":", 1)[0]
+                    findings.append(Finding(
+                        "host-order", "error", mod,
+                        f"lock-order CYCLE: "
+                        f"{' -> '.join(c.split(':')[-1] for c in cycle)}"
+                        f" (acquire sites: {', '.join(sites)}) — two "
+                        f"threads entering from opposite ends deadlock;"
+                        f" pick one global order and re-nest the "
+                        f"acquisitions",
+                        cycle[0]))
+            elif color.get(nxt, WHITE) == WHITE:
+                _dfs(nxt, path)
+        path.pop()
+        color[node] = BLACK
+
+    for node in list(edges):
+        if color.get(node, WHITE) == WHITE:
+            _dfs(node, [])
+    return findings
+
+
+@host_pass("host-lifecycle")
+def lifecycle_pass(module: HostModule) -> list:
+    """Thread-lifecycle inventory (see module docstring)."""
+    pol = module.policy
+    findings: "list[Finding]" = []
+    inventory: "list[str]" = []
+    for cm in module.classes:
+        for sp in cm.spawns:
+            label = sp.name or sp.target or "<anonymous>"
+            inventory.append(
+                f"{cm.name}.{sp.method}:{sp.line} -> {label}"
+                f"{' (daemon)' if sp.daemon else ''}")
+            mkey = f"{cm.name}.{sp.method}"
+            joined = sp.joined or (
+                sp.assigned is not None
+                and sp.assigned in cm.field_joins)
+            if not sp.daemon and not joined \
+                    and mkey not in pol.unjoined_ok:
+                findings.append(Finding(
+                    "host-lifecycle", "error", module.relpath,
+                    f"Thread spawned at {cm.name}.{sp.method}:"
+                    f"{sp.line} (target={sp.target or '?'}) is "
+                    f"neither daemon nor reachably joined — it can "
+                    f"outlive its owner, keep the process alive past "
+                    f"shutdown, and touch freed state; pass "
+                    f"daemon=True or join it from a teardown path",
+                    _where(cm, sp.method, sp.line)))
+            # loop-thread stop rule: the target method's forever loop
+            # must consult a stop Event
+            if sp.target and sp.target.startswith("self.") \
+                    and sp.target.count(".") == 1:
+                tgt = sp.target.split(".", 1)[1]
+                tkey = f"{cm.name}.{tgt}"
+                loops = cm.while_loops.get(tgt, [])
+                bad = [line for line, checks in loops if not checks]
+                if bad and tkey not in pol.loop_ok:
+                    findings.append(Finding(
+                        "host-lifecycle", "error", module.relpath,
+                        f"loop thread {cm.name}.{tgt} (spawned at "
+                        f"{sp.method}:{sp.line}) has a while-loop at "
+                        f"line {bad[0]} that never consults a stop "
+                        f"Event — stop() has no lever; the thread "
+                        f"spins until process death (add `while not "
+                        f"self._stop.wait(interval)` or an is_set "
+                        f"break)",
+                        _where(cm, tgt, bad[0])))
+        for ex in cm.executors:
+            if ex.assigned is None:
+                continue
+            ekey = f"{cm.name}.{ex.assigned}"
+            sites = cm.shutdown_sites.get(ex.assigned, set())
+            teardown = [m for m in sites if m in _TEARDOWN_NAMES]
+            if not teardown and ekey not in pol.executor_ok:
+                where_seen = (f" (shutdown seen only in "
+                              f"{sorted(sites)})" if sites else "")
+                findings.append(Finding(
+                    "host-lifecycle", "error", module.relpath,
+                    f"ThreadPoolExecutor {cm.name}.{ex.assigned} "
+                    f"(created at {ex.method}:{ex.line}) is never "
+                    f"shut down from a teardown method{where_seen} — "
+                    f"its non-daemon workers keep the process alive "
+                    f"until interpreter exit and hold their last "
+                    f"task's state; add a close()/stop() that calls "
+                    f".shutdown()",
+                    _where(cm, ex.method, ex.line)))
+    if inventory:
+        findings.append(Finding(
+            "host-lifecycle", "info", module.relpath,
+            f"thread inventory: {len(inventory)} spawn site(s) — "
+            f"{'; '.join(inventory)}"))
+    return findings
+
+
+# -- catalog ------------------------------------------------------------
+
+# the host source the plane lints: every module of the four host-plane
+# packages. Policies are CALIBRATED — each entry is a deliberate,
+# documented exception, so a new finding is a new bug (or a new
+# exception that must be argued into the policy, with its WHY).
+HOST_PACKAGES = ("serving", "telemetry", "runtime", "protocol")
+
+HOST_POLICIES: "dict[str, HostPolicy]" = {
+    "runtime/metrics.py": HostPolicy(
+        unguarded_ok={
+            # stop() joins the sampler thread (join(timeout=5)) BEFORE
+            # folding the kernel HWM into the peak — the join is the
+            # happens-before edge; summary() after stop() reads a
+            # quiesced field. Mid-run summary() reads a monotonic int
+            # a torn read cannot corrupt (CPython int store is atomic).
+            "HostResourceSampler._peak_rss_kb":
+                "single-writer sampler thread; stop() joins before "
+                "the caller-side HWM fold (join = happens-before)",
+        }),
+    "telemetry/registry.py": HostPolicy(
+        # scraped by the MetricsServer handler threads and the
+        # SnapshotWriter thread while the owning loop mutates — every
+        # method is thread-reachable
+        shared_classes=("Histogram", "MetricsRegistry"),
+    ),
+}
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def host_module_paths() -> "list[str]":
+    """The relpaths of every module in the host catalog, sorted."""
+    out = []
+    for pkg in HOST_PACKAGES:
+        pkg_dir = os.path.join(_PKG_ROOT, pkg)
+        if not os.path.isdir(pkg_dir):
+            continue
+        for fn in sorted(os.listdir(pkg_dir)):
+            if fn.endswith(".py"):
+                out.append(f"{pkg}/{fn}")
+    return out
+
+
+def build_host_catalog(targets: Optional[list] = None
+                       ) -> "list[HostModule]":
+    """Parse the host catalog (or the ``targets`` subset of relpaths)
+    into :class:`HostModule` models. Pure reads — nothing imports."""
+    paths = host_module_paths()
+    if targets is not None:
+        unknown = set(targets) - set(paths)
+        if unknown:
+            raise ValueError(
+                f"unknown host lint target(s) {sorted(unknown)}; "
+                f"targets are package-relative paths like "
+                f"'telemetry/registry.py' (see host_module_paths())")
+        paths = [p for p in paths if p in set(targets)]
+    modules = []
+    for rel in paths:
+        with open(os.path.join(_PKG_ROOT, rel)) as f:
+            source = f.read()
+        modules.append(analyze_source(
+            rel, source, HOST_POLICIES.get(rel)))
+    return modules
+
+
+def run_host_passes(modules: "list[HostModule]",
+                    only: Optional[list] = None) -> "list[Finding]":
+    """The host catalog over a set of modules: per-module passes plus
+    the cross-module lock-order cycle check."""
+    findings: "list[Finding]" = []
+    for module in modules:
+        if module.parse_error:
+            findings.append(Finding(
+                "host-guard", "error", module.relpath,
+                f"module failed to parse: {module.parse_error} — an "
+                f"unparseable host module is an UNLINTED host module"))
+            continue
+        for name, fn in HOST_PASSES.items():
+            if only is not None and name not in only:
+                continue
+            findings.extend(fn(module))
+    if only is None or "host-order" in only:
+        findings.extend(lock_order_findings(
+            [m for m in modules if not m.parse_error]))
+    return findings

@@ -479,6 +479,198 @@ HLO_FIXTURES = [
 ]
 
 
+# -- host-plane fixtures (ISSUE 15, analysis/host.py) -------------------
+#
+# Each one is a small-but-realistic HOST source miniature carrying one
+# concurrency bug class the device planes PROVABLY cannot see: the bug
+# lives in Python source the tracer never touches, so run_selfcheck
+# first traces each fixture's device shadow (the jitted compute its
+# threads would dispatch) through the jaxpr AND compiled-HLO catalogs
+# and fails if either fires — then requires the named host pass to
+# catch the source. That pair is the host plane's existence proof.
+
+_HOST_FIXTURE_UNGUARDED = '''\
+import threading
+
+
+class HedgeLedger:
+    """Hedged-dispatch win/loss counters (miniature of the router's
+    reconciliation ledger)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.wins = 0
+        self.losses = 0
+
+    def on_win(self):
+        with self._lock:
+            self.wins += 1
+
+    def on_loss(self):
+        # BUG: the loss path skips the lock its sibling takes — two
+        # racing completions interleave the += and the ledger identity
+        # (wins + losses == completions) silently breaks
+        self.losses += 1
+        self.wins -= 1
+'''
+
+_HOST_FIXTURE_LOCK_CYCLE = '''\
+import threading
+
+
+class PairedLedgers:
+    """Submit/retire ledgers with a lock each (miniature of a
+    scheduler/router pair)."""
+
+    def __init__(self):
+        self._submit_lock = threading.Lock()
+        self._retire_lock = threading.Lock()
+        self.submitted = {}
+        self.retired = {}
+
+    def submit(self, rid):
+        with self._submit_lock:
+            with self._retire_lock:
+                self.submitted[rid] = True
+
+    def retire(self, rid):
+        # BUG: reverse nesting — a submitter and a retirer entering
+        # simultaneously each hold the lock the other needs
+        with self._retire_lock:
+            with self._submit_lock:
+                self.retired[rid] = True
+'''
+
+_HOST_FIXTURE_CALLBACK = '''\
+import threading
+
+
+class PullRegistry:
+    """Pull-collector registry (miniature of telemetry/registry.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pulls = []
+
+    def register(self, pull):
+        with self._lock:
+            self._pulls.append(pull)
+
+    def scrape(self):
+        out = []
+        with self._lock:
+            for p in self._pulls:
+                # BUG: collector callback invoked INSIDE the registry
+                # lock — a collector that re-enters the registry (or
+                # just blocks) wedges every writer
+                out.append(p.pull())
+        return out
+'''
+
+_HOST_FIXTURE_UNJOINED = '''\
+import threading
+
+
+class SnapshotPump:
+    """Periodic snapshot thread (miniature of SnapshotWriter)."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        # BUG: neither daemon=True nor joined from any teardown — the
+        # pump outlives its owner and keeps the process alive
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(1.0):
+            pass
+'''
+
+_HOST_FIXTURE_BLOCKING = '''\
+import threading
+
+
+class ResultCache:
+    """Dispatch-result cache fed by a watchdog future and a status
+    socket (miniature of the engine's guarded dispatch)."""
+
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._last = None
+        self._ack = None
+
+    def refresh(self, fut):
+        with self._lock:
+            # BUG: device readback (Future.result on the dispatch) and
+            # a socket recv inside the critical section — a wedged
+            # chip or silent peer holds the lock forever and every
+            # reader deadlocks behind a hardware fault
+            self._last = fut.result()
+            self._ack = self._sock.recv(4)
+'''
+
+_HOST_FIXTURE_NO_STOP = '''\
+import threading
+
+
+class PollerForever:
+    """Metadata poller (miniature of PreemptionWatcher)."""
+
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        # BUG: no stop Event anywhere — stop() has no lever and the
+        # poller spins until process death
+        while True:
+            self._poll()
+
+    def _poll(self):
+        pass
+'''
+
+
+def _host_device_shadow(name: str):
+    """The device program a host fixture's threads would dispatch —
+    trivially clean, traced through BOTH device catalogs to prove the
+    concurrency bug is invisible there (it lives in host source the
+    tracer never sees)."""
+    import jax.numpy as jnp
+
+    def entry(x):
+        return x * 2.0 + 1.0
+
+    return trace_entry(f"{name}_device_shadow", entry,
+                       (jnp.zeros((8,), jnp.float32),),
+                       LintPolicy(hot=True), lower=False,
+                       hlo_policy=HloPolicy(census={}, overlap="off"))
+
+
+# (fixture name, module source, host pass that must fire, severity)
+HOST_FIXTURES = [
+    ("host_unguarded_counter", _HOST_FIXTURE_UNGUARDED,
+     "host-guard", "error"),
+    ("host_lock_cycle", _HOST_FIXTURE_LOCK_CYCLE,
+     "host-order", "error"),
+    ("host_callback_under_lock", _HOST_FIXTURE_CALLBACK,
+     "host-order", "error"),
+    ("host_unjoined_thread", _HOST_FIXTURE_UNJOINED,
+     "host-lifecycle", "error"),
+    ("host_blocking_under_lock", _HOST_FIXTURE_BLOCKING,
+     "host-order", "error"),
+    ("host_loop_no_stop", _HOST_FIXTURE_NO_STOP,
+     "host-lifecycle", "error"),
+]
+
+
 # (fixture name, pass that must fire, severity it must fire at)
 FIXTURES = [
     ("bad_axis", fixture_bad_axis, "collective-axis", "error"),
@@ -525,14 +717,17 @@ def _check_recompile_guard() -> "tuple[bool, str]":
     return False, "recompile guard NEVER fired on a shape change"
 
 
-def run_selfcheck(include_hlo: bool = False
+def run_selfcheck(include_hlo: bool = False, include_host: bool = False
                   ) -> "tuple[bool, list[str]]":
     """Build every fixture, run the pass catalog, verify each expected
     (pass, severity) fires. With ``include_hlo`` the compiled-HLO
     fixtures run too, each under a DOUBLE obligation: the
     jaxpr/StableHLO catalog must stay quiet on it (the bug is provably
-    invisible pre-compile) AND the named HLO pass must fire. Returns
-    (all_caught, report lines)."""
+    invisible pre-compile) AND the named HLO pass must fire. With
+    ``include_host`` the host-concurrency fixtures run under the same
+    double obligation — each fixture's device shadow must be clean
+    under BOTH device catalogs, and the named host pass must catch the
+    source. Returns (all_caught, report lines)."""
     ok, lines = True, []
     for name, build, expect_pass, expect_sev in FIXTURES:
         ctx = build()
@@ -576,6 +771,40 @@ def run_selfcheck(include_hlo: bool = False
                 ok = False
                 got = [(f.pass_name, f.severity)
                        for f in run_hlo_passes(ctx)]
+                lines.append(
+                    f"MISSED  {name}: expected [{expect_pass}] at "
+                    f"{expect_sev}, got {got or 'nothing'}")
+    if include_host:
+        from akka_allreduce_tpu.analysis.host import (analyze_source,
+                                                      run_host_passes)
+        for name, source, expect_pass, expect_sev in HOST_FIXTURES:
+            # the existence proof: the bug's device shadow is clean
+            # under the jaxpr AND compiled-HLO catalogs — the
+            # concurrency fault lives in host source neither can see
+            shadow = _host_device_shadow(name)
+            device = [f for f in run_passes(shadow)
+                      + run_hlo_passes(shadow)
+                      if f.severity in ("error", "warning")]
+            if device:
+                ok = False
+                got = [(f.pass_name, f.severity) for f in device]
+                lines.append(
+                    f"MISSED  {name}: device catalogs fired {got} on "
+                    f"the fixture's device shadow — the fixture no "
+                    f"longer demonstrates a host-only gap")
+                continue
+            module = analyze_source(f"fixture/{name}.py", source)
+            hits = [f for f in run_host_passes([module])
+                    if f.pass_name == expect_pass
+                    and f.severity == expect_sev]
+            if hits:
+                lines.append(f"caught  {name}: device-blind, "
+                             f"[{expect_pass}] "
+                             f"{hits[0].message[:60]}...")
+            else:
+                ok = False
+                got = [(f.pass_name, f.severity)
+                       for f in run_host_passes([module])]
                 lines.append(
                     f"MISSED  {name}: expected [{expect_pass}] at "
                     f"{expect_sev}, got {got or 'nothing'}")

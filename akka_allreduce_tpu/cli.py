@@ -2439,6 +2439,27 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "backpressure, and scrape == summary for the "
                         "serve_admission_*/serve_tenant_* series. The "
                         "rate SWEEP (knee curves) is `cli.py stress`")
+    p.add_argument("--soak-s", type=float, default=0.0, metavar="S",
+                   help="with --load trace: long-horizon soak smoke — "
+                        "repeat the seeded trace in waves for S "
+                        "seconds with the raced lockset detector "
+                        "(runtime/raced.py) armed and the host "
+                        "sampler watching, then assert stability: "
+                        "zero races/lock-order inversions, flat "
+                        "thread count, bounded RSS growth, and (with "
+                        "--paged) the page pool draining back to full "
+                        "between waves. Exit 1 on any drift — the "
+                        "leak-detection slice of the ROADMAP soak "
+                        "item. 0 = off")
+    p.add_argument("--raced", action="store_true",
+                   help="arm the opt-in lockset/happens-before race "
+                        "detector (runtime/raced.py) around the "
+                        "--selfcheck run: the serving control-plane "
+                        "classes are write-traced, their locks "
+                        "wrapped, and any same-field disjoint-lockset "
+                        "write race or runtime lock-order inversion "
+                        "fails the run with both sites and both "
+                        "locksets named")
     p.add_argument("--trace-file", default=None,
                    help="write serve_* lifecycle events + prefill/step "
                         "spans (JSONL, runtime/tracing.py) here on exit")
@@ -3878,6 +3899,178 @@ def _serve_stress_selfcheck(args: argparse.Namespace) -> int:
     return 0
 
 
+def _serve_soak(args: argparse.Namespace) -> int:
+    """``serve --load trace --soak-s S``: the long-horizon soak smoke
+    (ISSUE 15 satellite — the leak-detection slice of ROADMAP item 5's
+    soak remainder). One engine serves the seeded diurnal trace in
+    WAVES until the budget elapses, with the raced lockset detector
+    armed over the serving control-plane classes the whole time and
+    the host plane watched between waves. A soak is a leak detector:
+    the assertion is not throughput, it is that NOTHING ACCUMULATES —
+
+    * zero race / lock-order-inversion findings from raced;
+    * thread count flat after the first wave (a watchdog executor or
+      snapshot thread leaked per wave would stair-step here);
+    * RSS growth across the soak bounded (waves must reuse, not
+      accumulate);
+    * with --paged: the page pool drains back to its full free count
+      after every wave (a refcount leak strands pages forever);
+    * every wave's requests all reach a terminal state.
+    """
+    import gc
+    import threading
+
+    import jax
+
+    from akka_allreduce_tpu.runtime import raced
+    from akka_allreduce_tpu.runtime.metrics import _read_rss_kb
+    from akka_allreduce_tpu.serving import (EngineConfig,
+                                            PagedEngineConfig,
+                                            PagedServingEngine,
+                                            QueueFull,
+                                            RequestScheduler,
+                                            SchedulerConfig,
+                                            ServingEngine,
+                                            ServingMetrics, TenantSpec,
+                                            TraceConfig, anchor_trace,
+                                            generate_trace, serve_loop)
+    from akka_allreduce_tpu.models.transformer import init_transformer
+
+    mcfg = _build_model_config(args, args.max_seq)
+    lo, _, hi = args.prompt_len.partition(":")
+    p_hi = int(hi or lo)
+    tenants = tuple(TenantSpec(
+        f"tenant{ti}",
+        prefix_len=args.prefix_len if ti == 0 else 0,
+        prefix_ratio=args.prefix_ratio,
+        slow_client_ratio=0.0,
+        deadline_slack_s=args.deadline_slack_s,
+        seed=ti) for ti in range(args.tenant_count))
+    params = init_transformer(jax.random.key(args.seed), mcfg)
+
+    rss0 = _read_rss_kb(os.getpid()) or 0
+    waves = 0
+    incomplete = 0
+    rejected_total = 0
+    rss_mb: "list[float]" = []
+    thread_counts: "list[int]" = []
+    pool_leaks: "list[int]" = []
+    # the engine (and its locks) must be BORN inside the trace window
+    # so raced wraps them; everything below runs race-probed
+    with raced.trace(watch=raced.default_serving_watch()) as probe:
+        if args.paged:
+            engine = PagedServingEngine(params, mcfg, PagedEngineConfig(
+                num_slots=args.slots, decode_steps=args.decode_steps,
+                watchdog_timeout_s=args.watchdog_timeout or None,
+                page_size=args.page_size, num_pages=args.num_pages))
+        else:
+            engine = ServingEngine(params, mcfg, EngineConfig(
+                num_slots=args.slots, decode_steps=args.decode_steps,
+                watchdog_timeout_s=args.watchdog_timeout or None))
+        metrics = ServingMetrics()
+        try:
+            deadline = time.monotonic() + args.soak_s
+            while time.monotonic() < deadline:
+                traced = generate_trace(TraceConfig(
+                    seed=args.seed + waves, n_requests=args.requests,
+                    rate=args.arrival_rate, arrival=args.arrival_curve,
+                    vocab=args.vocab, max_prompt=p_hi,
+                    max_new_tokens=args.max_new_tokens,
+                    eos_token=args.eos_token, tenants=tenants))
+                anchor_trace(traced, time.monotonic())
+                # edge-shed accounting like every other serve path: a
+                # request rejected at a full queue is a TERMINAL
+                # outcome (designed backpressure), not a leak — it
+                # must neither raise out of the soak nor count as
+                # never-finished
+                rejected = [0]
+
+                def _on_reject(rid, *a, **kw):
+                    rejected[0] += 1
+                    metrics.on_reject(rid, *a, **kw)
+
+                sched = RequestScheduler(
+                    SchedulerConfig(max_queue_depth=args.queue_depth,
+                                    seed=args.seed),
+                    num_slots=args.slots, on_reject=_on_reject)
+                for tr in traced:
+                    metrics.on_submit(tr.req.rid)
+                    try:
+                        sched.submit(tr.req)
+                    except QueueFull:
+                        pass  # counted via _on_reject
+                results = serve_loop(engine, sched, metrics=metrics)
+                incomplete += (args.requests - len(results)
+                               - rejected[0])
+                rejected_total += rejected[0]
+                waves += 1
+                gc.collect()
+                rss_mb.append(round((_read_rss_kb(os.getpid()) or 0)
+                                    / 1024, 1))
+                thread_counts.append(threading.active_count())
+                if args.paged:
+                    pool_leaks.append(
+                        engine.pool.capacity - engine.pool.free_pages)
+        finally:
+            # a mid-wave exception must not leak the watchdog
+            # executor — the exact teardown class this PR's host
+            # lint exists to catch
+            engine.close()
+    report = probe.report()
+
+    failures = []
+    if not report.clean:
+        failures.append(
+            f"raced found {len(report.races)} race(s) / "
+            f"{len(report.inversions)} inversion(s): "
+            + "; ".join(str(x) for x in
+                        [*report.races, *report.inversions]))
+    if waves < 2:
+        failures.append(
+            f"soak budget {args.soak_s}s completed only {waves} "
+            f"wave(s) — too short to observe accumulation; raise "
+            f"--soak-s or shrink the per-wave load")
+    if incomplete:
+        failures.append(f"{incomplete} request(s) never reached a "
+                        f"terminal state across the soak")
+    if len(thread_counts) >= 2 \
+            and thread_counts[-1] > thread_counts[0]:
+        failures.append(
+            f"thread count climbed across waves: {thread_counts} — "
+            f"something spawns per wave without joining")
+    if len(rss_mb) >= 2:
+        # bounded growth: the last wave may sit above the first (warm
+        # caches, compiled programs land early) but not keep climbing
+        # — allow the larger of 64 MB or 15% over the post-warmup base
+        base = rss_mb[0]
+        allowed = base + max(64.0, 0.15 * base)
+        if rss_mb[-1] > allowed:
+            failures.append(
+                f"RSS climbed past the leak bound: {rss_mb} MB "
+                f"(allowed <= {round(allowed, 1)} from base {base})")
+    if args.paged and any(pool_leaks):
+        failures.append(
+            f"page pool did not drain back to full between waves "
+            f"(pages still held per wave: {pool_leaks}) — a "
+            f"refcount/registry leak strands HBM forever")
+
+    print(json.dumps({
+        "soak": "ok" if not failures else "FAIL",
+        "soak_s": args.soak_s, "waves": waves,
+        "requests_per_wave": args.requests,
+        "rejected_at_edge": rejected_total,
+        "raced": {"writes_seen": report.writes_seen,
+                  "locks_wrapped": report.locks_wrapped,
+                  "races": len(report.races),
+                  "inversions": len(report.inversions)},
+        "rss_mb": rss_mb, "rss_mb_start": round(rss0 / 1024, 1),
+        "threads": thread_counts,
+        **({"pool_pages_held": pool_leaks} if args.paged else {}),
+        "failures": failures,
+    }, indent=1))
+    return 1 if failures else 0
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     _apply_backend_flags(args)
     # validated BEFORE the selfcheck dispatch: a typo'd S must exit 2,
@@ -4081,20 +4274,57 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
+    if args.soak_s < 0:
+        print(f"error: --soak-s must be >= 0, got {args.soak_s}",
+              file=sys.stderr)
+        return 2
+    if args.soak_s > 0:
+        if args.load != "trace" or args.selfcheck:
+            print("error: --soak-s is the trace-soak smoke: it needs "
+                  "--load trace (and composes with --paged), not "
+                  "--selfcheck", file=sys.stderr)
+            return 2
+        return _serve_soak(args)
+    if args.raced and not args.selfcheck:
+        print("error: --raced arms the race detector around a "
+              "--selfcheck run (the soak arms it by itself)",
+              file=sys.stderr)
+        return 2
     if args.selfcheck:
-        if args.stress:
-            return _serve_stress_selfcheck(args)
-        if args.replica_mode == "subprocess":
-            return _serve_subprocess_selfcheck(args)
-        if args.speculative:
-            return _serve_speculative_selfcheck(args)
-        if args.replicas > 1:
-            return _serve_replicated_selfcheck(args)
-        if args.chaos is not None:
-            return _serve_chaos_selfcheck(args)
-        if args.paged:
-            return _serve_paged_selfcheck(args)
-        return _serve_selfcheck(args)
+        def _run_selfcheck() -> int:
+            if args.stress:
+                return _serve_stress_selfcheck(args)
+            if args.replica_mode == "subprocess":
+                return _serve_subprocess_selfcheck(args)
+            if args.speculative:
+                return _serve_speculative_selfcheck(args)
+            if args.replicas > 1:
+                return _serve_replicated_selfcheck(args)
+            if args.chaos is not None:
+                return _serve_chaos_selfcheck(args)
+            if args.paged:
+                return _serve_paged_selfcheck(args)
+            return _serve_selfcheck(args)
+
+        if not args.raced:
+            return _run_selfcheck()
+        # --raced: the whole selfcheck (fleet construction included —
+        # locks wrap at construction) runs under the lockset detector;
+        # a clean selfcheck with a dirty race report still fails
+        from akka_allreduce_tpu.runtime import raced
+        with raced.trace(watch=raced.default_serving_watch()) as probe:
+            rc = _run_selfcheck()
+        report = probe.report()
+        print(f"raced: {report.writes_seen} writes across "
+              f"{report.locks_wrapped} wrapped lock(s) — "
+              f"{len(report.races)} race(s), "
+              f"{len(report.inversions)} inversion(s)",
+              file=sys.stderr)
+        if not report.clean:
+            for x in [*report.races, *report.inversions]:
+                print(f"raced: {x}", file=sys.stderr)
+            return 1
+        return rc
     import jax
     import numpy as np
 
@@ -4393,6 +4623,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 engines = [build_engine()
                            for _ in range(args.replicas)]
                 engine = engines[0]
+                for eng in engines:
+                    # watchdog executor threads die with the run, not
+                    # with the interpreter (lint --host's teardown rule)
+                    stack.callback(eng.close)
             if args.paged and supervisor is None:
                 if args.replicas > 1:
                     # per-replica page-pool series, replica-labeled
@@ -4795,13 +5029,46 @@ def _add_lint(sub: argparse._SubParsersAction) -> None:
                         "programs XLA actually built (~40 s extra "
                         "for the full catalog); composes with "
                         "--all/--target/--format/--strict/--selfcheck")
+    p.add_argument("--on-chip", action="store_true",
+                   help="with --hlo: lint the modules the AMBIENT "
+                        "backend compiles (the CPU force is skipped) "
+                        "and escalate every overlap='verify' policy "
+                        "to 'require' — on a TPU host under the "
+                        "runtime/xla_flags.py overlap set this "
+                        "machine-checks that collectives actually "
+                        "compile to async start/done pairs with "
+                        "compute in the gap (a sync-only module GATES "
+                        "instead of noting as info). Queued as "
+                        "capture_tpu_numbers.py step 10; multi-device "
+                        "entries need >= 8 devices on the backend")
+    p.add_argument("--host", action="store_true",
+                   help="also lint the HOST plane (analysis/host.py): "
+                        "pure-AST concurrency passes over serving/, "
+                        "telemetry/, runtime/ and protocol/ — inferred "
+                        "lock discipline (host-guard), the lock-order/"
+                        "blocking-call/callback-under-lock deadlock "
+                        "catalog (host-order), and the thread-"
+                        "lifecycle inventory (host-lifecycle); no "
+                        "module is imported, only parsed. With "
+                        "--target, host modules are named by relpath "
+                        "(e.g. telemetry/registry.py); composes with "
+                        "--all/--format/--strict/--selfcheck")
+    p.add_argument("--rebank-fusion", action="store_true",
+                   help="with --all --hlo: write the per-entry fusion "
+                        "census observed in this run to analysis/"
+                        "fusion_baseline.json — the banked artifact "
+                        "the hlo-fusion pass pins later runs against "
+                        "(a collapsed census then gates instead of "
+                        "hiding in artifact diffs)")
     p.add_argument("--selfcheck", action="store_true",
                    help="run the deliberately-broken fixtures instead: "
                         "every pass must catch its fixture (the "
                         "linter's own tier-1; analysis/selfcheck.py). "
                         "With --hlo the compiled-module fixtures run "
                         "too — each must be jaxpr/StableHLO-clean AND "
-                        "caught by its HLO pass")
+                        "caught by its HLO pass; with --host the "
+                        "concurrency fixtures run, each proven "
+                        "invisible to BOTH device catalogs first")
 
 
 def _cmd_lint(args: argparse.Namespace) -> int:
@@ -4817,20 +5084,46 @@ def _cmd_lint(args: argparse.Namespace) -> int:
             flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    if args.on_chip:
+        if not args.hlo:
+            print("error: --on-chip escalates the COMPILED-module "
+                  "overlap contract; it needs --hlo", file=sys.stderr)
+            return 2
+        # the ambient backend (TPU on a chip host) compiles the
+        # modules; the overlap escalation happens after build, below
+    else:
+        jax.config.update("jax_platforms", "cpu")
     from akka_allreduce_tpu.analysis.entrypoints import (ENTRYPOINTS,
                                                          build_entrypoints)
     from akka_allreduce_tpu.analysis.report import (exit_code,
                                                     render_json,
                                                     render_text)
 
+    if args.rebank_fusion and (args.selfcheck or args.list
+                               or not (args.all and args.hlo)):
+        # a targeted rebank would OVERWRITE the whole baseline with
+        # only the targeted entries (and a --selfcheck/--list run
+        # banks nothing at all) — the flag must never be silently
+        # ignored: an operator who thinks they re-banked would leave
+        # the stale floor in place
+        print("error: --rebank-fusion rewrites the entire banked "
+              "baseline and therefore needs the entire catalog: use "
+              "it only with --all --hlo (not --selfcheck/--list)",
+              file=sys.stderr)
+        return 2
     if args.list:
         for name in ENTRYPOINTS:
             print(name)
+        if args.host:
+            from akka_allreduce_tpu.analysis.host import \
+                host_module_paths
+            for rel in host_module_paths():
+                print(rel)
         return 0
     if args.selfcheck:
         from akka_allreduce_tpu.analysis.selfcheck import run_selfcheck
-        ok, lines = run_selfcheck(include_hlo=args.hlo)
+        ok, lines = run_selfcheck(include_hlo=args.hlo,
+                                  include_host=args.host)
         for line in lines:
             print(line)
         print("selfcheck: every pass caught its fixture" if ok
@@ -4850,12 +5143,32 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         print("error: --target got no entry-point names (empty value); "
               "use --all to lint the whole catalog", file=sys.stderr)
         return 2
+    host_targets = None
+    if args.host and targets is not None:
+        # host modules are addressed by relpath; route them to the
+        # host catalog and keep the rest for the entry-point builder
+        from akka_allreduce_tpu.analysis.host import host_module_paths
+        known_host = set(host_module_paths())
+        host_targets = [t for t in targets if t in known_host]
+        targets = [t for t in targets if t not in known_host]
     try:
         from akka_allreduce_tpu.analysis.core import run_passes
-        contexts = build_entrypoints(targets)
+        contexts = build_entrypoints(targets) \
+            if not (args.host and targets == []) else []
     except (ValueError, RuntimeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.on_chip:
+        # overlap="verify" is the CPU calibration (the CPU backend
+        # never splits collectives); on the ambient backend the same
+        # entries must PROVE their async pairs — a sync-only module
+        # under the latency-hiding flags is the silently-ignored-flags
+        # failure this run exists to catch, and it must gate
+        for ctx in contexts:
+            pol = ctx.hlo_policy
+            if pol is not None and pol.overlap == "verify":
+                ctx.hlo_policy = dataclasses.replace(
+                    pol, overlap="require")
     findings = []
     for ctx in contexts:
         if args.hlo:
@@ -4879,6 +5192,21 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         else:
             findings.extend(run_passes(ctx))
     names = [c.name for c in contexts]
+    if args.rebank_fusion:
+        from akka_allreduce_tpu.analysis.hlo import bank_fusion_baseline
+        path = bank_fusion_baseline(contexts)
+        print(f"fusion baseline ({len(contexts)} entries) -> {path}",
+              file=sys.stderr)
+    if args.host:
+        from akka_allreduce_tpu.analysis.host import (build_host_catalog,
+                                                      run_host_passes)
+        try:
+            modules = build_host_catalog(host_targets)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        findings.extend(run_host_passes(modules))
+        names.extend(m.relpath for m in modules)
     if args.format == "json":
         print(json.dumps(render_json(names, findings), indent=1))
     else:

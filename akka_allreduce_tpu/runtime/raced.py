@@ -1,0 +1,451 @@
+"""raced — the opt-in lockset/happens-before race detector (ISSUE 15).
+
+The static host plane (analysis/host.py) infers lock discipline from
+source; this module CHECKS it at runtime, Eraser-style, with zero
+footprint until armed. ``raced.trace(watch=(...))`` instruments the
+watched classes for the duration of a test:
+
+* every attribute WRITE on a watched instance is recorded as a
+  ``(thread, held-lockset, site)`` tuple, and the per-field candidate
+  lockset shrinks by intersection — two threads writing the same field
+  with DISJOINT locksets is a data race, reported with both sites and
+  both locksets;
+* every ``threading.Lock``/``RLock`` assigned onto a watched instance
+  while the trace is armed is transparently wrapped, so the detector
+  sees acquisition order — an acquire-while-holding edge whose reverse
+  edge was ever observed (any thread) is a lock-order INVERSION, the
+  runtime twin of the static cycle check;
+* the single-writer handoff rule is honored: when the recorded owner
+  thread of a field is no longer alive, the next writer takes clean
+  ownership — ``stop()``-after-``join()`` sequences (the sampler's HWM
+  fold) are not races, they are the happens-before edge ``join``
+  provides.
+
+Armed inside the chaos/stress/subprocess suites, every seeded fault
+schedule doubles as a race probe: the suites already explore the
+interesting interleavings (watchdog trips, drains, restarts); raced
+makes each of them assert concurrency cleanliness for free.
+
+Deliberately NOT a general-purpose TSan: only write/write races on
+watched instances are detected (read/write torn-state belongs to the
+static plane's bare-read check), and only locks owned by watched
+instances join locksets. Identity is monotonic-token based (stamped on
+locks at wrap time and on instances at first write), never ``id()`` —
+recycled addresses must not alias a freed lock's order edges or a dead
+object's field states. Those are the right economics for a test-scoped
+probe — no global monkey-patching, no interpreter hooks, overhead only
+where armed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+from typing import Iterable, Optional
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_THIS_FILE = os.path.abspath(__file__)
+
+
+def _site() -> str:
+    """file:line of the first frame outside this module — the access
+    site a finding names."""
+    f = sys._getframe(1)
+    while f is not None and os.path.abspath(
+            f.f_code.co_filename) == _THIS_FILE:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    fn = f.f_code.co_filename
+    try:
+        rel = os.path.relpath(fn, os.path.dirname(_PKG_ROOT))
+        if not rel.startswith(".."):
+            fn = rel
+    except ValueError:
+        pass
+    return f"{fn}:{f.f_lineno}"
+
+
+@dataclasses.dataclass(frozen=True)
+class RaceFinding:
+    """Two threads wrote one field with disjoint locksets."""
+
+    field: str                 # "Class.field"
+    first_thread: str
+    first_site: str
+    first_lockset: tuple       # lock names, sorted
+    second_thread: str
+    second_site: str
+    second_lockset: tuple
+
+    def __str__(self) -> str:
+        return (f"RACE on {self.field}: {self.first_thread} wrote at "
+                f"{self.first_site} holding "
+                f"{list(self.first_lockset) or '{}'} ; "
+                f"{self.second_thread} wrote at {self.second_site} "
+                f"holding {list(self.second_lockset) or '{}'} — "
+                f"no common lock orders the writes")
+
+
+@dataclasses.dataclass(frozen=True)
+class InversionFinding:
+    """Lock B acquired under A on one path, A under B on another."""
+
+    lock_a: str
+    lock_b: str
+    ab_site: str               # where A->B was observed
+    ab_thread: str
+    ba_site: str               # where B->A was observed
+    ba_thread: str
+
+    def __str__(self) -> str:
+        return (f"LOCK-ORDER INVERSION: {self.lock_a} -> {self.lock_b} "
+                f"at {self.ab_site} ({self.ab_thread}) vs "
+                f"{self.lock_b} -> {self.lock_a} at {self.ba_site} "
+                f"({self.ba_thread}) — two threads entering from "
+                f"opposite ends deadlock")
+
+
+@dataclasses.dataclass
+class RaceReport:
+    races: "list[RaceFinding]"
+    inversions: "list[InversionFinding]"
+    writes_seen: int
+    locks_wrapped: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.races and not self.inversions
+
+    def assert_clean(self) -> None:
+        if not self.clean:
+            detail = "\n".join(
+                str(x) for x in [*self.races, *self.inversions])
+            raise AssertionError(
+                f"raced: {len(self.races)} race(s), "
+                f"{len(self.inversions)} lock-order inversion(s):\n"
+                f"{detail}")
+
+
+class TracedLock:
+    """A ``threading.Lock``/``RLock`` stand-in that reports
+    acquisition order to the detector. Fully functional after the
+    trace window closes (recording just stops) — instances created
+    during a test keep working.
+
+    ``token`` is a monotonic identity that is NEVER reused — keying
+    locksets and order edges by ``id()`` would let a freed lock's
+    recycled address alias a new lock (phantom inversions), and
+    keying by NAME would let two instances of one class alias each
+    other (masking the wrong-instance-lock bug, exactly the race
+    class the detector exists for). The display name carries the
+    token (``C._lock#7``) so a report showing two same-named locks
+    is readable as two instances."""
+
+    def __init__(self, raw, name: str, detector: "Detector"):
+        self._raw = raw
+        self.token = detector._next_token()
+        self.name = f"{name}#{self.token}"
+        self._det = detector
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            self._det._on_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._det._on_release(self)
+        self._raw.release()
+
+    def locked(self) -> bool:
+        # RLock grew .locked() only in newer CPythons
+        fn = getattr(self._raw, "locked", None)
+        return bool(fn()) if fn is not None else False
+
+    def __enter__(self) -> "TracedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<TracedLock {self.name}>"
+
+
+@dataclasses.dataclass
+class _FieldState:
+    owner: threading.Thread
+    lockset: frozenset         # candidate lockset (lock names)
+    site: str
+    # Eraser's exclusive -> shared ladder: the FIRST thread's writes
+    # (typically __init__ before publication) never race — the
+    # candidate lockset starts from the SECOND thread's first write,
+    # and only a THIRD party (or the demoted first writer returning)
+    # can empty it
+    shared: bool = False
+    reported: bool = False
+
+
+class Detector:
+    """One trace window's state. Internals use a RAW lock — the
+    detector must never route its own bookkeeping through the wrappers
+    it hands out."""
+
+    def __init__(self):
+        self._meta = threading.Lock()
+        self._tls = threading.local()
+        self.active = False
+        self._token_counter = 0
+        # (token_a, token_b) -> (a_name, b_name, site, thread_name)
+        self._edges: "dict[tuple, tuple]" = {}
+        self._fields: "dict[tuple, _FieldState]" = {}
+        self.races: "list[RaceFinding]" = []
+        self.inversions: "list[InversionFinding]" = []
+        self.writes_seen = 0
+        self.locks_wrapped = 0
+        self._seen_inversions: "set[frozenset]" = set()
+
+    def _next_token(self) -> int:
+        with self._meta:
+            self._token_counter += 1
+            return self._token_counter
+
+    # -- per-thread held stack -------------------------------------------
+
+    def _held(self) -> "list[TracedLock]":
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _counts(self) -> "dict[int, int]":
+        counts = getattr(self._tls, "counts", None)
+        if counts is None:
+            counts = self._tls.counts = {}
+        return counts
+
+    # -- lock hooks ------------------------------------------------------
+
+    def _on_acquire(self, lock: TracedLock) -> None:
+        if not self.active:
+            return
+        counts = self._counts()
+        lid = lock.token
+        counts[lid] = counts.get(lid, 0) + 1
+        if counts[lid] > 1:
+            return  # RLock re-entry: no new edge, no new held entry
+        held = self._held()
+        site = _site()
+        tname = threading.current_thread().name
+        new_edges = []
+        for h in held:
+            new_edges.append(((h.token, lid), (h.name, lock.name)))
+        held.append(lock)
+        if not new_edges:
+            return
+        with self._meta:
+            for key, names in new_edges:
+                self._edges.setdefault(key, (*names, site, tname))
+                rev = self._edges.get((key[1], key[0]))
+                if rev is not None:
+                    pair = frozenset(key)
+                    if pair not in self._seen_inversions:
+                        self._seen_inversions.add(pair)
+                        self.inversions.append(InversionFinding(
+                            lock_a=rev[0], lock_b=rev[1],
+                            ab_site=rev[2], ab_thread=rev[3],
+                            ba_site=site, ba_thread=tname))
+
+    def _on_release(self, lock: TracedLock) -> None:
+        if not self.active:
+            return
+        counts = self._counts()
+        lid = lock.token
+        n = counts.get(lid, 0)
+        if n > 1:
+            counts[lid] = n - 1
+            return
+        counts.pop(lid, None)
+        held = self._held()
+        if lock in held:
+            held.remove(lock)
+
+    # -- write hook ------------------------------------------------------
+
+    def _obj_token(self, obj) -> int:
+        tok = getattr(obj, "_raced_token", None)
+        if tok is None:
+            tok = self._next_token()
+            try:
+                # direct object.__setattr__: must NOT recurse through
+                # the patched class __setattr__ (and must not count as
+                # a write)
+                object.__setattr__(obj, "_raced_token", tok)
+            except (AttributeError, TypeError):
+                return id(obj)  # slotted/frozen: fall back to id()
+        return tok
+
+    def _on_write(self, obj, name: str) -> None:
+        if not self.active:
+            return
+        if name == "_raced_token":
+            return
+        key = (self._obj_token(obj), name)
+        field = f"{type(obj).__name__}.{name}"
+        t = threading.current_thread()
+        held = self._held()
+        lockset = frozenset(h.name for h in held)
+        site = _site()
+        with self._meta:
+            self.writes_seen += 1
+            st = self._fields.get(key)
+            if st is None:
+                self._fields[key] = _FieldState(t, lockset, site)
+                return
+            if st.owner is t:
+                st.lockset &= lockset
+                st.site = site
+                return
+            if not st.owner.is_alive():
+                # the previous writer is dead: whoever joined/outlived
+                # it owns the field now (the join happens-before rule)
+                self._fields[key] = _FieldState(t, lockset, site)
+                return
+            if not st.shared:
+                # exclusive -> shared: construction writes happened
+                # before this thread could see the object (Thread.start
+                # is the happens-before edge) — the candidate lockset
+                # is THIS thread's, not the constructor's
+                st.owner, st.lockset, st.site = t, lockset, site
+                st.shared = True
+                return
+            candidate = st.lockset & lockset
+            if not candidate and not st.reported:
+                st.reported = True
+                self.races.append(RaceFinding(
+                    field=field,
+                    first_thread=st.owner.name, first_site=st.site,
+                    first_lockset=tuple(sorted(st.lockset)),
+                    second_thread=t.name, second_site=site,
+                    second_lockset=tuple(sorted(lockset))))
+            st.owner = t
+            st.lockset = candidate
+            st.site = site
+
+    def report(self) -> RaceReport:
+        with self._meta:
+            return RaceReport(list(self.races), list(self.inversions),
+                              self.writes_seen, self.locks_wrapped)
+
+
+class _Probe:
+    """The context-manager handle ``trace()`` returns."""
+
+    def __init__(self, watch: Iterable[type]):
+        self.detector = Detector()
+        self._watch = tuple(dict.fromkeys(watch))  # dedupe, keep order
+        self._originals: "list[tuple[type, object]]" = []
+
+    def __enter__(self) -> "_Probe":
+        det = self.detector
+        for cls in self._watch:
+            orig = cls.__setattr__
+
+            def traced_setattr(obj, name, value, _orig=orig,
+                               _det=det):
+                if _det.active:
+                    if isinstance(value, _LOCK_TYPES):
+                        value = TracedLock(
+                            value, f"{type(obj).__name__}.{name}",
+                            _det)
+                        with _det._meta:  # the detector practices
+                            _det.locks_wrapped += 1  # what it preaches
+                    elif not isinstance(value, TracedLock):
+                        _det._on_write(obj, name)
+                _orig(obj, name, value)
+
+            self._originals.append((cls, orig))
+            cls.__setattr__ = traced_setattr
+        det.active = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.detector.active = False
+        for cls, orig in self._originals:
+            cls.__setattr__ = orig
+        self._originals.clear()
+
+    def report(self) -> RaceReport:
+        return self.detector.report()
+
+    def assert_clean(self) -> None:
+        self.report().assert_clean()
+
+
+_ACTIVE: "list[_Probe]" = []
+
+
+def trace(watch: Iterable[type]) -> _Probe:
+    """Arm the detector over ``watch`` classes for a ``with`` block::
+
+        with raced.trace(watch=(ServingMetrics, Histogram)) as probe:
+            run_scenario()
+        probe.assert_clean()
+
+    Instances CONSTRUCTED inside the window get their locks wrapped
+    (the ``self._lock = threading.Lock()`` in ``__init__`` runs
+    through the instrumented ``__setattr__``); pre-existing instances
+    are write-tracked but their locks stay invisible — build the
+    system under test inside the window. Nesting is rejected: two
+    probes patching one class would unwind in the wrong order."""
+    classes = tuple(watch)
+    if not classes:
+        raise ValueError("raced.trace needs at least one class to "
+                         "watch")
+    if _ACTIVE:
+        raise RuntimeError("raced.trace does not nest — one probe per "
+                           "test")
+    probe = _Probe(classes)
+
+    class _Managed:
+        def __enter__(self):
+            _ACTIVE.append(probe)
+            return probe.__enter__()
+
+        def __exit__(self, *exc):
+            probe.__exit__(*exc)
+            _ACTIVE.remove(probe)
+
+    return _Managed()
+
+
+def default_serving_watch() -> tuple:
+    """The serving control-plane classes the chaos/stress suites arm:
+    the metrics registry plane (mutated by the serve loop, scraped by
+    snapshot/HTTP threads), the engine/scheduler/router bookkeeping,
+    the fleet supervisor's parent-side state, and the host sampler —
+    the classes whose fields the static plane's policies reason
+    about. Subclasses (paged/speculative engines, FleetMetrics)
+    inherit the instrumented ``__setattr__`` from their bases."""
+    from akka_allreduce_tpu.runtime.metrics import HostResourceSampler
+    from akka_allreduce_tpu.runtime.tracing import Tracer
+    from akka_allreduce_tpu.serving.engine import ServingEngine
+    from akka_allreduce_tpu.serving.metrics import ServingMetrics
+    from akka_allreduce_tpu.serving.replica import (LagLedger,
+                                                    ReplicaHandle)
+    from akka_allreduce_tpu.serving.router import ReplicaRouter
+    from akka_allreduce_tpu.serving.scheduler import RequestScheduler
+    from akka_allreduce_tpu.serving.supervisor import (RemoteEngine,
+                                                       ReplicaSupervisor)
+    from akka_allreduce_tpu.telemetry.registry import (Counter, Gauge,
+                                                       Histogram,
+                                                       MetricsRegistry)
+    return (MetricsRegistry, Histogram, Counter, Gauge,
+            ServingMetrics, RequestScheduler, ServingEngine,
+            ReplicaRouter, LagLedger, ReplicaHandle, RemoteEngine,
+            ReplicaSupervisor, HostResourceSampler, Tracer)

@@ -1573,6 +1573,25 @@ class ServingEngine:
                 tracer=self.tracer, annotate_site="dispatch")
         return self._dtimer
 
+    def close(self) -> None:
+        """Release host-side resources at engine teardown: the
+        watchdog executor's worker thread (non-daemon — left running
+        it keeps the process alive past shutdown and pins its last
+        dispatch's state). Idempotent; the engine stays usable for
+        host-side introspection (summaries, drained snapshots) but
+        must not dispatch again. The happy-path counterpart of the
+        tripped-watchdog replacement in :meth:`_guarded_dispatch` —
+        `lint --host` pins that this teardown exists."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def device_time_summary(self) -> dict:
         """host/device/dispatch-gap histograms across this engine's
         decode dispatches (telemetry/device.py): ``dispatch_gap_ms`` is

@@ -335,4 +335,5 @@ def run_replica_worker(spec: ReplicaSpec, connect: "tuple[str, int]",
         return 0
     finally:
         compile_log.__exit__(None, None, None)
+        engine.close()  # watchdog executor thread, if one was armed
         router.close()

@@ -99,7 +99,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return len(self._vals)
+        # read under the lock: count is scraped from export threads
+        # (HTTP handler, snapshot writer) while the owning loop
+        # records — len() alone is GIL-atomic, but the lock keeps the
+        # count consistent with the percentile snapshot scraped next
+        # to it (lint --host pins this: Histogram is a shared class)
+        with self._lock:
+            return len(self._vals)
 
     @property
     def total(self) -> float:

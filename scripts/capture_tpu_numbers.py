@@ -213,6 +213,51 @@ import subprocess, sys
 subprocess.run([sys.executable, "-u", "scripts/bench_speculative.py"],
                check=False)
 """),
+    # 10. the ON-CHIP compiled-module lint (ISSUE 14's overlap="require"
+    # follow-up, queued by ISSUE 15): run `lint --all --hlo --on-chip`
+    # in a fresh subprocess with the runtime/xla_flags.py latency-
+    # hiding / async-collective set installed BEFORE the backend
+    # initializes. --on-chip compiles against the AMBIENT backend and
+    # escalates every overlap="verify" policy to "require", so the
+    # hlo-overlap pass machine-checks that the windowed/swing/
+    # hierarchical entries actually compile to async start/done pairs
+    # with compute in the gap — a sync-only module (the silently-
+    # ignored-flags failure) exits 1 and the step banks NOTHING (the
+    # capture reports partial and retries next window) instead of
+    # banking a green-looking report.
+    ("hlo_overlap_lint", "lint", 900, """
+import json, os, subprocess, sys
+sys.path.insert(0, os.getcwd())
+from akka_allreduce_tpu.runtime.xla_flags import install_overlap_flags
+env = dict(os.environ)
+install_overlap_flags(env=env)
+proc = subprocess.run(
+    [sys.executable, "-m", "akka_allreduce_tpu.cli", "lint", "--all",
+     "--hlo", "--on-chip", "--format", "json", "--strict"],
+    env=env, capture_output=True, text=True)
+report = None
+try:
+    report = json.loads(proc.stdout)
+except json.JSONDecodeError:
+    pass
+if report is not None:
+    with open(os.path.join("perf_capture",
+                           "hlo_overlap_lint_report.json"), "w") as f:
+        json.dump(report, f, indent=1)
+if proc.returncode == 0 and report is not None:
+    summary = report.get("summary", {})
+    print(json.dumps({"metric": "hlo_overlap_lint_exit",
+                      "value": 0,
+                      "errors": summary.get("errors"),
+                      "warnings": summary.get("warnings"),
+                      "info": summary.get("info"),
+                      "entrypoints":
+                          len(report.get("entrypoints", []))}))
+else:
+    sys.stderr.write("hlo_overlap_lint: lint exited "
+                     f"{proc.returncode}\\n")
+    sys.stderr.write(proc.stdout[-2000:] + proc.stderr[-2000:])
+"""),
 ]
 
 # HOST-plane steps — no TPU involved (canonical-scale native runs, the

@@ -47,3 +47,28 @@ if not os.environ.get("AATPU_TEST_NO_COMPILE_CACHE"):
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+# -- the shared race probe (ISSUE 15, runtime/raced.py) ------------------
+#
+# Suites that exercise the serving control plane under faults arm the
+# lockset/happens-before detector for the duration of each test: the
+# fleet built INSIDE the window gets its locks wrapped and every field
+# write ledgered, and the teardown assertion turns any same-field
+# disjoint-lockset write race or lock-order inversion the seeded
+# schedule provokes into a test failure naming both sites and both
+# locksets. Defined once here — the probe contract (non-vacuity check +
+# assert_clean) must not drift between suites.
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def race_probe():
+    from akka_allreduce_tpu.runtime import raced
+    with raced.trace(watch=raced.default_serving_watch()) as probe:
+        yield probe
+    report = probe.report()
+    assert report.writes_seen > 0, (
+        "raced probe saw no writes — the instrumentation came off")
+    report.assert_clean()

@@ -1,0 +1,416 @@
+"""The dynamic lockset/happens-before detector (ISSUE 15,
+runtime/raced.py): deliberately-racy fixture threads must produce
+EXACT reports (field, both sites with file:line, both locksets), and
+the happy paths — consistent locking, single-writer handoff over
+``join``, RLock re-entry — must stay clean. The live integration pin
+runs the real metrics registry under cross-thread scrape load."""
+
+import threading
+
+import pytest
+
+from akka_allreduce_tpu.runtime import raced
+
+
+class TwoLocks:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.n = 0
+
+
+class OneLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+
+class Bare:
+    def __init__(self):
+        self.n = 0
+
+
+def run_threads(*targets):
+    ts = [threading.Thread(target=t, name=f"worker{i}")
+          for i, t in enumerate(targets)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def interleave(*writers):
+    """Run each writer once, in order, on its OWN thread, with every
+    thread held alive until all have written — a deterministic
+    observed interleaving (no reliance on GIL timeslice luck), which
+    is exactly the evidence a lockset detector needs."""
+    done = threading.Event()
+    turns = [threading.Event() for _ in writers]
+
+    def runner(i, fn):
+        if i:
+            turns[i - 1].wait(timeout=10)
+        fn()
+        turns[i].set()
+        done.wait(timeout=10)   # stay alive: overlap is the point
+
+    ts = [threading.Thread(target=runner, args=(i, fn),
+                           name=f"worker{i}")
+          for i, fn in enumerate(writers)]
+    for t in ts:
+        t.start()
+    turns[-1].wait(timeout=10)
+    done.set()
+    for t in ts:
+        t.join(timeout=10)
+
+
+class TestWriteRaces:
+    def test_disjoint_locksets_race_with_exact_report(self):
+        with raced.trace(watch=(TwoLocks,)) as probe:
+            obj = TwoLocks()
+
+            def via_a():
+                with obj._lock_a:
+                    obj.n += 1
+
+            def via_b():
+                with obj._lock_b:
+                    obj.n += 1
+
+            interleave(via_a, via_b)
+        report = probe.report()
+        assert len(report.races) == 1
+        race = report.races[0]
+        assert race.field == "TwoLocks.n"
+        # exact evidence: both sites name THIS file and a line, both
+        # locksets name the disjoint locks
+        assert "test_raced.py" in race.first_site
+        assert "test_raced.py" in race.second_site
+        assert all(s.rsplit(":", 1)[1].isdigit()
+                   for s in (race.first_site, race.second_site))
+        # lock names carry an instance token (C._lock#N) so reports
+        # distinguish same-named locks on different instances
+        held = sorted(ls[0].split("#")[0]
+                      for ls in (race.first_lockset,
+                                 race.second_lockset))
+        assert held == ["TwoLocks._lock_a", "TwoLocks._lock_b"]
+        with pytest.raises(AssertionError, match="TwoLocks.n"):
+            report.assert_clean()
+
+    def test_common_lock_is_clean(self):
+        with raced.trace(watch=(OneLock,)) as probe:
+            obj = OneLock()
+
+            def w():
+                for _ in range(30):
+                    with obj._lock:
+                        obj.n += 1
+
+            run_threads(w, w, w)
+        assert probe.report().clean
+        assert probe.report().writes_seen > 60
+
+    def test_no_locks_at_all_race(self):
+        with raced.trace(watch=(Bare,)) as probe:
+            obj = Bare()
+
+            def w():
+                obj.n += 1
+
+            interleave(w, w)
+        report = probe.report()
+        assert len(report.races) == 1
+        assert report.races[0].first_lockset == ()
+        assert report.races[0].second_lockset == ()
+
+    def test_partial_overlap_shrinks_candidate_to_race(self):
+        # w1 holds {a,b}, w2 holds {b}: candidate {b} — clean so far;
+        # then w3 holds {a}: {b} & {a} = {} — the lockset math's edge
+        with raced.trace(watch=(TwoLocks,)) as probe:
+            obj = TwoLocks()
+
+            def both():
+                with obj._lock_a, obj._lock_b:
+                    obj.n += 1
+
+            def only_b():
+                with obj._lock_b:
+                    obj.n += 1
+
+            def only_a():
+                with obj._lock_a:
+                    obj.n += 1
+
+            interleave(both, only_b, only_a)
+        report = probe.report()
+        assert len(report.races) == 1
+        race = report.races[0]
+        assert race.field == "TwoLocks.n"
+        # the shrunken candidate {b} vs the final writer's {a}
+        assert race.first_lockset[0].startswith("TwoLocks._lock_b")
+        assert race.second_lockset[0].startswith("TwoLocks._lock_a")
+
+    def test_wrong_instance_lock_is_a_race(self):
+        # the classic wrong-instance-lock bug: both writers are
+        # "locked", but each holds a DIFFERENT instance's lock — lock
+        # identity (not the Class.attr name) must decide the
+        # intersection
+        with raced.trace(watch=(OneLock,)) as probe:
+            shared = OneLock()
+            decoy = OneLock()
+
+            def via_own():
+                with shared._lock:
+                    shared.n += 1
+
+            def via_decoy():
+                with decoy._lock:    # BUG: wrong object's lock
+                    shared.n += 1
+
+            interleave(via_own, via_decoy)
+        report = probe.report()
+        assert len(report.races) == 1
+        assert report.races[0].field == "OneLock.n"
+
+    def test_sequential_thread_lifetimes_are_not_a_race(self):
+        # the same disjoint-lockset writes, but each writer DIES
+        # before the next starts: no observed overlap, no race — the
+        # dead-owner handoff is the detector's join/HB rule
+        with raced.trace(watch=(TwoLocks,)) as probe:
+            obj = TwoLocks()
+
+            def via(lk):
+                with lk:
+                    obj.n += 1
+
+            run_threads(lambda: via(obj._lock_a))
+            run_threads(lambda: via(obj._lock_b))
+        assert probe.report().clean
+
+    def test_join_handoff_is_not_a_race(self):
+        with raced.trace(watch=(Bare,)) as probe:
+            obj = Bare()
+
+            def w():
+                for _ in range(10):
+                    obj.n += 1
+
+            t = threading.Thread(target=w)
+            t.start()
+            t.join()
+            obj.n = 99   # sequenced by the join: handoff, not a race
+        assert probe.report().clean
+
+    def test_constructor_writes_never_race_with_thread(self):
+        # __init__ runs before Thread.start publishes the object —
+        # the exclusive->shared ladder must not charge the ctor
+        with raced.trace(watch=(Bare,)) as probe:
+            obj = Bare()   # ctor writes n with no locks
+
+            def w():
+                for _ in range(10):
+                    obj.n += 1
+
+            t = threading.Thread(target=w)
+            t.start()
+            t.join()
+        assert probe.report().clean
+
+
+class TestInversions:
+    def test_ab_ba_inversion_reported_without_deadlocking(self):
+        with raced.trace(watch=(TwoLocks,)) as probe:
+            obj = TwoLocks()
+
+            def fwd():
+                with obj._lock_a:
+                    with obj._lock_b:
+                        pass
+
+            def rev():
+                with obj._lock_b:
+                    with obj._lock_a:
+                        pass
+
+            # sequential execution: the ORDER EDGES conflict even
+            # though no actual deadlock can occur — exactly the bug
+            # class that ships quiet and fires in production
+            run_threads(fwd)
+            run_threads(rev)
+        report = probe.report()
+        assert len(report.inversions) == 1
+        inv = report.inversions[0]
+        assert sorted(x.split("#")[0]
+                      for x in (inv.lock_a, inv.lock_b)) == \
+            ["TwoLocks._lock_a", "TwoLocks._lock_b"]
+        assert "test_raced.py" in inv.ab_site
+        assert "test_raced.py" in inv.ba_site
+        with pytest.raises(AssertionError, match="INVERSION"):
+            report.assert_clean()
+
+    def test_consistent_order_is_clean(self):
+        with raced.trace(watch=(TwoLocks,)) as probe:
+            obj = TwoLocks()
+
+            def fwd():
+                with obj._lock_a:
+                    with obj._lock_b:
+                        pass
+
+            run_threads(fwd, fwd)
+        assert probe.report().clean
+
+    def test_lock_churn_no_phantom_inversions(self):
+        # freed locks' recycled addresses must not alias new locks:
+        # every object acquires a then b (one consistent global
+        # order), across many short-lived instances — zero inversions
+        with raced.trace(watch=(TwoLocks,)) as probe:
+            def wave():
+                for _ in range(40):
+                    obj = TwoLocks()
+                    with obj._lock_a:
+                        with obj._lock_b:
+                            obj.n += 1
+
+            run_threads(wave, wave)
+        report = probe.report()
+        assert report.inversions == []
+
+    def test_rlock_reentry_no_false_edges(self):
+        class WithRLock:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:   # re-entry, not a new acquisition
+                    self.n += 1
+
+        with raced.trace(watch=(WithRLock,)) as probe:
+            obj = WithRLock()
+            run_threads(obj.outer, obj.outer)
+        assert probe.report().clean
+
+
+class TestHarness:
+    def test_trace_does_not_nest(self):
+        with raced.trace(watch=(Bare,)):
+            with pytest.raises(RuntimeError, match="nest"):
+                with raced.trace(watch=(Bare,)):
+                    pass
+
+    def test_empty_watch_rejected(self):
+        with pytest.raises(ValueError):
+            raced.trace(watch=())
+
+    def test_instrumentation_restored_after_exit(self):
+        orig = OneLock.__setattr__
+        with raced.trace(watch=(OneLock,)):
+            assert OneLock.__setattr__ is not orig
+        assert OneLock.__setattr__ is orig
+
+    def test_wrapped_locks_survive_the_window(self):
+        # instances born inside the trace keep their TracedLock after
+        # exit — it must stay a working lock
+        with raced.trace(watch=(OneLock,)):
+            obj = OneLock()
+        with obj._lock:
+            assert obj._lock.locked()
+        assert not obj._lock.locked()
+
+    def test_default_watch_importable(self):
+        classes = raced.default_serving_watch()
+        assert len(classes) >= 8
+        assert all(isinstance(c, type) for c in classes)
+
+
+@pytest.mark.slow
+class TestSoakSmoke:
+    """``serve --load trace --soak-s N`` (ISSUE 15 satellite): the
+    long-horizon soak runs diurnal trace waves with the race detector
+    armed and asserts host stability — zero race/inversion findings,
+    flat thread count, bounded RSS, all requests terminal. The small
+    slice of ROADMAP item 5's soak remainder that fits CI."""
+
+    def test_trace_soak_stays_stable(self, monkeypatch, capsys):
+        import json as _json
+        import sys as _sys
+
+        from akka_allreduce_tpu.cli import main
+        monkeypatch.setattr(_sys, "argv", [
+            "aat", "serve", "--load", "trace", "--soak-s", "10",
+            "--arrival-rate", "50", "--requests", "10",
+            "--arrival-curve", "diurnal", "--max-new-tokens", "6",
+            "--slots", "2", "--d-model", "32", "--n-layers", "1",
+            "--n-heads", "4", "--d-ff", "64", "--vocab", "61",
+            "--max-seq", "64", "--prompt-len", "4:8",
+            "--tenant-count", "2", "--prefix-len", "4"])
+        assert main() == 0
+        report = _json.loads(capsys.readouterr().out)
+        assert report["soak"] == "ok"
+        assert report["waves"] >= 2
+        assert report["failures"] == []
+        assert report["raced"]["races"] == 0
+        assert report["raced"]["inversions"] == 0
+        assert report["raced"]["writes_seen"] > 0
+        assert report["threads"][-1] <= report["threads"][0]
+
+    def test_soak_requires_trace_load(self, monkeypatch, capsys):
+        import sys as _sys
+
+        from akka_allreduce_tpu.cli import main
+        monkeypatch.setattr(_sys, "argv", [
+            "aat", "serve", "--soak-s", "5"])
+        assert main() == 2
+
+
+class TestLiveRegistry:
+    def test_registry_clean_under_scrape_load(self):
+        """The integration pin: the real metrics registry mutated by
+        an owner loop while a scraper renders — the cross-thread
+        pattern the telemetry plane documents — must be race-free
+        under the detector (the locks Histogram/MetricsRegistry carry
+        are exactly why)."""
+        from akka_allreduce_tpu.telemetry.registry import (
+            Counter,
+            Gauge,
+            Histogram,
+            MetricsRegistry,
+        )
+        with raced.trace(watch=(MetricsRegistry, Histogram, Counter,
+                                Gauge)) as probe:
+            reg = MetricsRegistry()
+            hist = reg.histogram("lat_seconds")
+            cnt = reg.counter("reqs_total")
+            stop = threading.Event()
+
+            def owner():
+                i = 0
+                while not stop.is_set():
+                    hist.record(i * 1e-3)
+                    cnt.inc()
+                    i += 1
+
+            def scraper():
+                while not stop.is_set():
+                    reg.to_prometheus_text()
+                    reg.to_json()
+
+            ts = [threading.Thread(target=owner),
+                  threading.Thread(target=scraper)]
+            for t in ts:
+                t.start()
+            stop_timer = threading.Timer(0.3, stop.set)
+            stop_timer.start()
+            for t in ts:
+                t.join(timeout=10)
+            stop_timer.join(timeout=10)
+        report = probe.report()
+        assert report.locks_wrapped >= 2
+        assert report.writes_seen > 10
+        report.assert_clean()

@@ -204,7 +204,8 @@ class TestFaultMatrix:
     @pytest.mark.parametrize("kind", ["hang", "raise", "nan", "preempt"])
     @pytest.mark.parametrize("policy", ["fifo", "deadline"])
     @pytest.mark.parametrize("s", [1, 4])
-    def test_matrix(self, params, baselines, kind, policy, s):
+    def test_matrix(self, params, baselines, race_probe, kind, policy,
+                    s):
         reqs = make_requests()
         plan = FaultPlan([point_for(kind, s)])
         metrics = ServingMetrics()

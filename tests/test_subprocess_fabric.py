@@ -135,7 +135,8 @@ def assert_ledger(fleet):
 
 
 class TestFaultFree:
-    def test_subprocess_fleet_bitwise_parity(self, baseline):
+    def test_subprocess_fleet_bitwise_parity(self, baseline,
+                                             race_probe):
         results, fleet, router, _ = run_fleet()
         assert_parity(baseline, results, "fault-free")
         s = assert_ledger(fleet)
@@ -146,7 +147,8 @@ class TestFaultFree:
 
 
 class TestSigkill:
-    def test_sigkill_midrun_failover_restart_parity(self, baseline):
+    def test_sigkill_midrun_failover_restart_parity(self, baseline,
+                                                    race_probe):
         """The issue's acceptance criterion, verbatim: real SIGKILL
         mid-run, bitwise parity, exact reconciliation, restart within
         the backoff budget."""
