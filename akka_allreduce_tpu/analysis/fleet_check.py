@@ -1,0 +1,228 @@
+"""graftcheck's explorer: explicit-state model checking of the fleet
+control plane (``lint --fleet``).
+
+Enumerates every reachable state of the :mod:`fleet_model` transition
+system inside configurable bounds, breadth-first with canonical state
+hashing (states are structurally-normalized tuples — sorted member
+sets, per-pair FIFO channels — so any two interleavings reaching the
+same protocol configuration collapse to one node) and a sleep-set
+partial-order reduction over the model's resource-footprint
+independence relation.  Sleep sets prune redundant COMMUTING
+interleavings only; every reachable state is still visited, so the
+per-state invariants are checked over the full reachable space.
+Bound overflow (state count or depth) is REPORTED as a finding,
+never silently truncated.
+
+A violation yields a depth-minimal counterexample schedule (BFS parent
+chain) that :func:`replay` re-executes deterministically — the same
+schedules the selfcheck fixtures and regression tests pin.
+"""
+
+import time
+from collections import deque, namedtuple
+
+from .core import Finding
+from . import fleet_model as fm
+
+ExploreResult = namedtuple("ExploreResult", [
+    "visited",        # states explored (after dedup)
+    "transitions",    # transitions fired (successor generations)
+    "violation",      # Violation or None
+    "overflow",       # None | "states" | "depth"
+    "quiescent",      # number of quiescent states reached
+    "elapsed_s",      # process time spent
+])
+
+Violation = namedtuple("Violation", [
+    "invariant",      # e.g. "one_terminal"
+    "message",        # human-readable defect statement
+    "schedule",       # tuple of transition tuples from the initial state
+    "state",          # the violating state
+])
+
+
+def _indep(a, b, fps):
+    return fps[a].isdisjoint(fps[b])
+
+
+def explore(bounds, bugs=frozenset(), por=True):
+    """Exhaustively check ``bounds``' state space; stop at the first
+    invariant violation with its minimal schedule."""
+    t0 = time.process_time()
+    init = fm.initial_state(bounds)
+    bad = fm.violations(init, bounds)
+    if bad:
+        return ExploreResult(1, 0, Violation(bad[0][0], bad[0][1], (),
+                                             init), None, 0,
+                             time.process_time() - t0)
+    n_rep = bounds.replicas + bounds.spares
+    fps = {}          # transition -> footprint (memoized)
+    # visited/parent key on fm.core(state) — the ledger-blind
+    # canonical form.  The frontier carries FULL states so successor
+    # ledgers (and thus the per-transition identity checks) are exact;
+    # see fm.core's docstring for why checking each (core, transition)
+    # once is sound for the ledger identities on every path.
+    k0 = fm.core(init)
+    visited = {k0: frozenset()}
+    parent = {k0: None}
+    frontier = deque([(init, frozenset(), 0)])
+    n_trans = 0
+    n_quiescent = 0
+    overflow = None
+
+    while frontier:
+        s, sleep, depth = frontier.popleft()
+        ks = fm.core(s)
+        ts = fm.enabled(s, bounds, bugs)
+        if not ts:
+            n_quiescent += 1
+            bad = fm.quiescent_violations(s, bounds)
+            if bad:
+                sched = _chain(parent, ks)
+                return ExploreResult(
+                    len(visited), n_trans,
+                    Violation(bad[0][0], bad[0][1], sched, s),
+                    overflow, n_quiescent, time.process_time() - t0)
+            continue
+        if depth >= bounds.max_depth:
+            overflow = "depth"
+            continue
+        done = set(sleep) if por else set()
+        for t in sorted(t for t in ts if t not in sleep) if por \
+                else sorted(ts):
+            succ = fm.apply(s, t, bounds, bugs)
+            ksucc = fm.core(succ)
+            n_trans += 1
+            bad = fm.violations(succ, bounds)
+            if bad:
+                if ksucc not in parent:
+                    parent[ksucc] = (ks, t)
+                sched = _chain(parent, ksucc)
+                return ExploreResult(
+                    len(visited) + 1, n_trans,
+                    Violation(bad[0][0], bad[0][1], sched, succ),
+                    overflow, n_quiescent, time.process_time() - t0)
+            if por:
+                for x in (t, *done):
+                    if x not in fps:
+                        fps[x] = fm.footprint(x, n_rep)
+                new_sleep = frozenset(
+                    x for x in done if _indep(x, t, fps))
+            else:
+                new_sleep = frozenset()
+            old = visited.get(ksucc)
+            if old is None:
+                visited[ksucc] = new_sleep
+                parent[ksucc] = (ks, t)
+                frontier.append((succ, new_sleep, depth + 1))
+                if len(visited) > bounds.max_states:
+                    return ExploreResult(
+                        len(visited), n_trans, None, "states",
+                        n_quiescent, time.process_time() - t0)
+            elif por and not (old <= new_sleep):
+                # revisited with transitions awake that were asleep
+                # before: re-expand with the intersection, or the
+                # reduction would drop reachable successors
+                merged = old & new_sleep
+                visited[ksucc] = merged
+                frontier.append((succ, merged, depth + 1))
+            done.add(t)
+    return ExploreResult(len(visited), n_trans, None, overflow,
+                         n_quiescent, time.process_time() - t0)
+
+
+def _chain(parent, state):
+    out = []
+    node = state
+    while parent[node] is not None:
+        node, t = parent[node]
+        out.append(t)
+    out.reverse()
+    return tuple(out)
+
+
+def replay(bounds, schedule, bugs=frozenset()):
+    """Deterministically re-execute a counterexample schedule.
+    Returns ``(state, violations)`` where ``violations`` are the
+    invariant failures of the FINAL state (the fixture/regression
+    pinning contract: a pinned schedule must still reach its
+    violation)."""
+    s = fm.initial_state(bounds)
+    for t in schedule:
+        if t not in fm.enabled(s, bounds, bugs):
+            raise AssertionError(
+                f"schedule step {fm.describe(t)} is not enabled — "
+                f"the model drifted from the pinned counterexample")
+        s = fm.apply(s, t, bounds, bugs)
+    bad = fm.violations(s, bounds)
+    if not bad and not fm.enabled(s, bounds, bugs):
+        bad = fm.quiescent_violations(s, bounds)
+    return s, bad
+
+
+def format_schedule(schedule):
+    lines = []
+    for n, t in enumerate(schedule, 1):
+        lines.append(f"  {n:2d}. {fm.describe(t)}")
+    return "\n".join(lines)
+
+
+def default_bounds_for(th):
+    """The default lint-matrix bounds for one hedge threshold.
+
+    th=1 takes DEFAULT_BOUNDS whole: the failure plane (two faults ->
+    breaker; spare join; fleet drain) is cheap without hedging.  th>=2
+    drops the spare and one fault event: hedging and elastic
+    membership are orthogonal, and their cross product quintuples the
+    state space for no new interaction — th=2 concentrates on the
+    hedge races (cancel/ack/orphan/absorbed against one death or
+    preempt)."""
+    b = fm.DEFAULT_BOUNDS._replace(th=th)
+    if th >= 2:
+        b = b._replace(spares=0, fault_budget=1)
+    return b
+
+
+def check_default_bounds(th_values=(1, 2), bounds=None,
+                         bugs=frozenset(), por=True):
+    """One explore() per hedge threshold — the default lint matrix."""
+    return {th: explore(bounds._replace(th=th) if bounds is not None
+                        else default_bounds_for(th), bugs, por=por)
+            for th in th_values}
+
+
+def run_fleet_plane(bounds=None, th_values=(1, 2)):
+    """The ``lint --fleet`` plane: findings + the per-run 'entrypoint'
+    names the CLI renders (one per hedge threshold)."""
+    findings = []
+    names = []
+    for th, res in sorted(
+            check_default_bounds(th_values, bounds).items()):
+        name = f"fleet:th={th}"
+        names.append(name)
+        if res.violation is not None:
+            v = res.violation
+            findings.append(Finding(
+                "fleet-model", "error", name,
+                f"invariant '{v.invariant}' violated: {v.message}\n"
+                f"counterexample schedule "
+                f"({len(v.schedule)} steps):\n"
+                f"{format_schedule(v.schedule)}",
+                where=f"depth {len(v.schedule)}"))
+        elif res.overflow is not None:
+            findings.append(Finding(
+                "fleet-model", "error", name,
+                f"state-space bound overflow ({res.overflow}): "
+                f"{res.visited} states visited — raise "
+                f"max_{res.overflow} or shrink the bounds; the check "
+                f"is INCOMPLETE and must not be trusted",
+                where=f"visited {res.visited}"))
+        else:
+            findings.append(Finding(
+                "fleet-model", "info", name,
+                f"all invariants hold over {res.visited} canonical "
+                f"states / {res.transitions} transitions "
+                f"({res.quiescent} quiescent, "
+                f"{res.elapsed_s:.1f}s cpu)",
+                where=f"visited {res.visited}"))
+    return findings, names

@@ -1,0 +1,234 @@
+"""graftcheck's dynamic twin: trace conformance against the fleet model.
+
+The real ``ReplicaRouter`` / ``ReplicaSupervisor`` / ``RemoteEngine``
+emit structured transition events (``Tracer.record_transition`` —
+kind ``fleet_transition``) at exactly the code sites the abstract
+model in :mod:`fleet_model` names.  This module replays any real
+execution's event log against the model's transition guards, so the
+model can never silently drift from the code it certifies: a code
+path that fires an event the model forbids (a dispatch after a
+terminal, a second terminal, a mirror regression, an unsolicited
+cancel ack, a restart of a breaker-open replica, a rid that ends the
+run neither terminal nor parked) fails conformance.
+
+Armed in the chaos matrix (tests/test_replica_router.py), the
+subprocess-fabric suite (tests/test_subprocess_fabric.py), and
+``serve --selfcheck`` — every traced fleet execution in CI is checked.
+
+Event vocabulary (field ``t``):
+
+===============  ====================================================
+``dispatch``     rid placed on a replica (``mode``: primary | hedge
+                 | resume)
+``result``       terminal result routed (one per rid, ever)
+``dup``          duplicate completion discarded (rid already terminal)
+``absorbed``     retryable failure absorbed by a live hedge sibling
+``retry``        retryable failure requeued (attempt budget holds)
+``dead_letter``  retryable failure dead-lettered (budget exhausted)
+``cancel``       hedge loser cancelled (``waste`` >= 0 settled
+                 synchronously; -1 = remote ack pending)
+``cancel_ack``   the worker's exact discard count landed (``orphan``
+                 marks a completion that raced the cancel)
+``covered``      drain snapshot dropped, a live sibling covers it
+``snapshot``     drain snapshot accepted for migration
+``park``         migrated work parked in the drained pool
+``drop``         scheduler drain-drop (terminal, empty result)
+``death``        replica process died unexpectedly
+``stopped``      replica exited after a requested drain
+``restart``      replica came up (``inc`` = incarnation counter)
+``breaker_open`` restart budget exhausted, replica retired for good
+``mirror``       proxy's monotonic dispatch-mirror value
+``retire``       router retired a draining replica
+``fleet_drain``  router began draining the whole fleet
+===============  ====================================================
+"""
+
+
+class ConformanceChecker:
+    """Feed fleet_transition events in trace order; collect
+    violations.  ``finish()`` applies the end-of-trace obligations."""
+
+    def __init__(self):
+        self.violations = []
+        self._terminal = set()
+        self._dispatched = set()
+        self._copies = {}        # rid -> set of replicas
+        self._alive = {}         # replica -> up|dead|stopped|broken
+        self._inc = {}           # replica -> incarnation
+        self._mirror = {}        # replica -> last mirror value
+        self._pending_ack = set()    # (replica, rid)
+        self._cancel_hist = {}   # replica -> rids ever cancelled there
+        self._resumable = set()
+        self._parked = set()
+        self._n = 0
+
+    def _fail(self, msg):
+        self.violations.append(f"event {self._n}: {msg}")
+
+    def _rm_copy(self, rid, replica, what):
+        copies = self._copies.setdefault(rid, set())
+        if replica in copies:
+            copies.discard(replica)
+            return True
+        self._fail(f"{what} for rid={rid} on replica {replica} "
+                   f"which holds no live copy")
+        return False
+
+    def feed(self, ev):
+        self._n += 1
+        t = ev.get("t")
+        rid = ev.get("rid")
+        rep = ev.get("replica")
+        if t == "dispatch":
+            mode = ev.get("mode", "primary")
+            self._dispatched.add(rid)
+            copies = self._copies.setdefault(rid, set())
+            if rid in self._terminal:
+                self._fail(f"dispatch of rid={rid} after its "
+                           f"terminal result")
+            if self._alive.get(rep, "up") != "up":
+                self._fail(f"dispatch of rid={rid} to replica {rep} "
+                           f"in state {self._alive[rep]}")
+            if rep in copies:
+                self._fail(f"rid={rid} placed twice on replica {rep}")
+            if mode == "hedge" and not copies:
+                self._fail(f"hedge of rid={rid} with no primary copy")
+            if mode == "primary" and copies:
+                self._fail(f"primary dispatch of rid={rid} with "
+                           f"copies still live on {sorted(copies)}")
+            if mode == "resume":
+                self._resumable.discard(rid)
+                self._parked.discard(rid)
+            copies.add(rep)
+        elif t == "result":
+            self._rm_copy(rid, rep, "result")
+            if rid in self._terminal:
+                self._fail(f"second terminal result for rid={rid}")
+            self._terminal.add(rid)
+        elif t == "dup":
+            if rid not in self._terminal:
+                self._fail(f"duplicate completion for rid={rid} "
+                           f"before any terminal result")
+            self._copies.setdefault(rid, set()).discard(rep)
+        elif t == "absorbed":
+            if self._rm_copy(rid, rep, "absorbed failure") \
+                    and not self._copies[rid]:
+                self._fail(f"failure of rid={rid} absorbed with no "
+                           f"live hedge sibling")
+        elif t == "retry":
+            self._rm_copy(rid, rep, "retry")
+            if self._copies.get(rid):
+                self._fail(f"retry of rid={rid} with copies still "
+                           f"live on {sorted(self._copies[rid])}")
+        elif t == "dead_letter":
+            self._rm_copy(rid, rep, "dead-letter")
+            if rid in self._terminal:
+                self._fail(f"dead-letter after terminal for rid={rid}")
+            self._terminal.add(rid)
+        elif t == "cancel":
+            self._rm_copy(rid, rep, "cancel")
+            if rid not in self._terminal:
+                self._fail(f"cancel of rid={rid} before any "
+                           f"terminal result")
+            if ev.get("waste", 0) < 0:
+                self._pending_ack.add((rep, rid))
+            self._cancel_hist.setdefault(rep, set()).add(rid)
+        elif t == "cancel_ack":
+            if ev.get("orphan"):
+                if rid not in self._cancel_hist.get(rep, ()):
+                    self._fail(f"orphan completion charged for "
+                               f"rid={rid} never cancelled on "
+                               f"replica {rep}")
+            elif (rep, rid) in self._pending_ack:
+                self._pending_ack.discard((rep, rid))
+            elif rid not in self._cancel_hist.get(rep, ()):
+                self._fail(f"unsolicited cancel ack for rid={rid} "
+                           f"from replica {rep}")
+        elif t == "covered":
+            self._rm_copy(rid, rep, "covered-drop")
+            if (not self._copies.get(rid)
+                    and rid not in self._terminal
+                    and rid not in self._resumable
+                    and rid not in self._parked):
+                self._fail(f"covered-drop of rid={rid} with no live "
+                           f"sibling, snapshot, or terminal")
+        elif t == "snapshot":
+            self._rm_copy(rid, rep, "drain snapshot")
+            self._resumable.add(rid)
+        elif t == "park":
+            if rid not in self._resumable:
+                self._fail(f"parked rid={rid} without a drain "
+                           f"snapshot")
+            self._resumable.discard(rid)
+            self._parked.add(rid)
+        elif t == "drop":
+            if rid in self._terminal:
+                self._fail(f"drain-drop after terminal for rid={rid}")
+            self._terminal.add(rid)
+            self._resumable.discard(rid)
+            self._parked.discard(rid)
+        elif t == "death":
+            self._alive[rep] = "dead"
+            self._pending_ack = {(r, q) for r, q in self._pending_ack
+                                 if r != rep}
+        elif t == "stopped" or t == "retire":
+            self._alive[rep] = "stopped"
+        elif t == "restart":
+            inc = ev.get("inc", 0)
+            if self._alive.get(rep) == "broken":
+                self._fail(f"replica {rep} restarted after its "
+                           f"breaker opened")
+            if rep in self._inc and inc <= self._inc[rep]:
+                self._fail(f"replica {rep} restarted without an "
+                           f"incarnation bump ({self._inc[rep]} -> "
+                           f"{inc})")
+            self._inc[rep] = inc
+            self._alive[rep] = "up"
+            self._cancel_hist.pop(rep, None)
+        elif t == "breaker_open":
+            self._alive[rep] = "broken"
+        elif t == "mirror":
+            v = ev.get("value", 0)
+            if v < self._mirror.get(rep, 0):
+                self._fail(f"dispatch mirror of replica {rep} "
+                           f"regressed {self._mirror[rep]} -> {v}")
+            else:
+                self._mirror[rep] = v
+        elif t == "fleet_drain":
+            pass
+        else:
+            self._fail(f"unknown fleet transition {t!r}")
+
+    def finish(self):
+        for rid in sorted(self._dispatched):
+            if rid not in self._terminal and rid not in self._parked \
+                    and rid not in self._resumable:
+                self._fail(f"rid={rid} ended the trace neither "
+                           f"terminal nor parked (lost)")
+        return self.violations
+
+
+def fleet_transitions(tracer):
+    """The fleet_transition event fields, in trace order."""
+    return [ev.fields for ev in tracer.events
+            if ev.kind == "fleet_transition"]
+
+
+def check_events(events):
+    """Replay a list of event-field dicts; return violations."""
+    chk = ConformanceChecker()
+    for ev in events:
+        chk.feed(ev)
+    return chk.finish()
+
+
+def assert_conformant(tracer):
+    """Raise if the tracer's fleet_transition log violates the model.
+    No-op for ``tracer=None`` (conformance is opt-in per run)."""
+    if tracer is None:
+        return
+    bad = check_events(fleet_transitions(tracer))
+    if bad:
+        raise AssertionError(
+            "fleet trace does not conform to the control-plane model "
+            f"({len(bad)} violations):\n  " + "\n  ".join(bad[:20]))

@@ -1,0 +1,659 @@
+"""graftcheck: an abstract finite-state model of the fleet control plane.
+
+The serving fleet's correctness story lives in ~2,800 LoC of
+router/supervisor/proxy/worker code (serving/router.py,
+serving/supervisor.py, serving/worker.py) whose hardest bugs have all
+been interleaving bugs.  This module is the third artifact of that
+plane: a small, explicit transition system over which
+``analysis/fleet_check.py`` enumerates EVERY reachable interleaving
+inside configurable bounds and checks the fleet's invariants in every
+state.
+
+Abstraction contract (what a model state means):
+
+* One abstract ``rid`` per request; token payloads collapse to unit
+  counts (a completion carries payload 1, a watchdog failure 0, a
+  drain snapshot 1 partial token).  The ledgers therefore balance in
+  units, exactly like the real ones balance in tokens.
+* Message channels are per-pair FIFO and unordered across pairs —
+  the TCP fabric's guarantee (protocol/tcp.py).  ``chan_dn[i]`` is the
+  router->worker_i stream (SubmitFrame/ResumeFrame/CancelFrame),
+  ``chan_up[i]`` the worker_i->router stream (CompletionFrame — both
+  results and cancel acks — and drain snapshots/DrainDone).
+* SIGTERM/preempt is OUT-OF-BAND: it stops the worker immediately and
+  undelivered router->worker frames are dropped, which models the
+  real "SIGTERM jumps a queued SubmitFrame" race.  The proxy's
+  DrainDone reconciliation (zero-progress resume synthesis for rids
+  the snapshots do not cover — RemoteEngine.drain) is the ``dd``
+  message's semantics here.
+* Death clears both channels (the connection dies with the process).
+  Cancel acks lost that way are accounted in ``lost_waste`` so the
+  waste-conservation invariant stays exact in every transient state.
+
+The transition vocabulary maps 1:1 onto code sites — the table lives
+in DESIGN.md §19 and ``analysis/fleet_conform.py`` replays real
+traced executions against these same semantics.
+
+Seeded bugs: ``BUG_NAMES`` lists five protocol mutations (the
+selfcheck fixtures for ``lint --selfcheck --fleet``).  Each is a
+one-site semantic edit of the kind code review has actually caught in
+this repo, and each drives at least one invariant to a violation
+within the default bounds.
+"""
+
+from collections import namedtuple
+
+# Replica lifecycle values (mirror serving/supervisor.py states).
+#: Saturation cap for the per-replica worker dispatch counter (see the
+#: `complete` transition): bounds the mirror arithmetic's state space.
+WDISP_CAP = 3
+
+UP = "up"
+DEAD = "dead"
+BROKEN = "broken"
+STOPPED = "stopped"
+SPARE = "spare"
+
+#: The five seeded protocol bugs (selfcheck fixtures).
+BUG_NAMES = (
+    "lost_rid_death_cancel",
+    "double_terminal_hedge_preempt",
+    "waste_uncharged_cancel_race",
+    "restart_no_inc_bump",
+    "breaker_bypass",
+)
+
+FleetBounds = namedtuple("FleetBounds", [
+    "replicas",       # live replicas at t=0
+    "spares",         # unranked spares that may `join`
+    "requests",       # rids submitted at t=0
+    "slots",          # worker slots per replica
+    "th",             # hedge threshold (1 = no hedging, 2 = one hedge)
+    "max_attempts",   # total attempts per rid before dead-letter
+    "max_restarts",   # deaths after which the breaker latches open
+    "fault_budget",   # total die/preempt/fleet_drain/join events
+    "max_wfails",     # total watchdog-failure events (branch bound)
+    "max_states",     # explorer overflow bound (reported, never silent)
+    "max_depth",      # explorer depth overflow bound
+])
+
+# The default lint matrix explores th=1 on these bounds exactly and
+# th=2 on a hedge-focused shrink (see fleet_check.default_bounds_for):
+# 2 live replicas x 3 requests, one worker slot, one spare, a 2-event
+# fault budget (enough for die+die -> breaker, or join+die, or
+# fleet_drain+die) and one watchdog failure (wfail + death failover on
+# the same rid reaches dead-letter at max_attempts=2).  Tuned so the
+# whole matrix fully explores in well under the 60s CPU budget CI pins.
+DEFAULT_BOUNDS = FleetBounds(
+    replicas=2, spares=1, requests=3, slots=1, th=1,
+    max_attempts=2, max_restarts=1, fault_budget=2, max_wfails=1,
+    max_states=400_000, max_depth=80)
+
+State = namedtuple("FleetState", [
+    "queue",           # tuple[int]: rids awaiting dispatch (FIFO)
+    "attempts",        # tuple[int] per rid: failed attempts consumed
+    "terminals",       # tuple[int] per rid: terminal results recorded
+    "hedged",          # tuple[int] per rid: 1 once a hedge copy fanned
+    "bound",           # tuple[tuple[int,...]] per rid: replicas holding a copy
+    "status",          # tuple[str] per replica
+    "ranked",          # tuple[int] per replica: in the dispatch ranking
+    "deaths",          # tuple[int] per replica
+    "inc",             # tuple[int] per replica: incarnation counter
+    "wdisp",           # tuple[int] per replica: worker dispatch counter
+    "base",            # tuple[int] per replica: proxy mirror re-anchor
+    "observed",        # tuple[int] per replica: proxy monotonic mirror
+    "worker",          # tuple[tuple[int,...]] per replica: admitted rids
+    "cancelled",       # tuple[tuple[int,...]] per replica: unacked cancels
+    "chan_dn",         # tuple[tuple[msg,...]] per replica: router->worker
+    "chan_up",         # tuple[tuple[msg,...]] per replica: worker->router
+    "pending_resume",  # tuple[int]: drain snapshots awaiting placement
+    "drained_pool",    # tuple[int]: parked work after a fleet drain
+    "fleet_draining",  # 0/1
+    "retries", "dead_letter", "absorbed", "failed",   # attempt ledger
+    "charged", "computed", "lost_waste",              # waste ledger
+    "faults", "wfails",                               # bound counters
+    "flags",           # tuple[str]: history-variable violation flags
+])
+
+
+def initial_state(bounds):
+    n = bounds.replicas + bounds.spares
+    return State(
+        queue=tuple(range(bounds.requests)),
+        attempts=(0,) * bounds.requests,
+        terminals=(0,) * bounds.requests,
+        hedged=(0,) * bounds.requests,
+        bound=((),) * bounds.requests,
+        status=(UP,) * bounds.replicas + (SPARE,) * bounds.spares,
+        ranked=(1,) * bounds.replicas + (0,) * bounds.spares,
+        deaths=(0,) * n, inc=(0,) * n, wdisp=(0,) * n,
+        base=(0,) * n, observed=(0,) * n,
+        worker=((),) * n, cancelled=((),) * n,
+        chan_dn=((),) * n, chan_up=((),) * n,
+        pending_resume=(), drained_pool=(), fleet_draining=0,
+        retries=0, dead_letter=0, absorbed=0, failed=0,
+        charged=0, computed=0, lost_waste=0,
+        faults=0, wfails=0, flags=())
+
+
+def core(s):
+    """The dedup key: the state with its ledger counters zeroed.
+
+    No transition guard reads the attempt or waste ledgers, so two
+    states that differ only in ledger values have identical futures
+    modulo a constant ledger offset — and each transition's ledger
+    delta is a function of (core, transition) alone.  The explorer
+    therefore dedups on the core and still checks the ledger
+    identities soundly: the identities hold initially and are
+    re-checked on every explored (core, transition) successor, so by
+    induction they hold along every path, not just the first one to
+    reach each core.  ``faults``/``wfails`` ARE guard inputs and stay
+    in the key; ``flags`` are invariant inputs that gate nothing but
+    are latched (not linear deltas), so they stay too.
+    """
+    return s._replace(retries=0, dead_letter=0, absorbed=0, failed=0,
+                      charged=0, computed=0, lost_waste=0)
+
+
+# -- tuple surgery helpers ------------------------------------------------
+
+def _tset(tup, i, v):
+    return tup[:i] + (v,) + tup[i + 1:]
+
+
+def _push(chans, i, msg):
+    return _tset(chans, i, chans[i] + (msg,))
+
+
+def _ins(sorted_tup, v):
+    return tuple(sorted(sorted_tup + (v,)))
+
+
+def _rm(tup, v):
+    out = list(tup)
+    out.remove(v)
+    return tuple(out)
+
+
+def _inflight(s, i, n_requests):
+    """The router-side occupancy mirror: rids bound to replica i
+    (RemoteEngine._inflight) — admission is gated on this, never on
+    the worker's own view."""
+    return sum(1 for r in range(n_requests) if i in s.bound[r])
+
+
+def _eligible(s, b, i):
+    return (s.status[i] == UP and s.ranked[i]
+            and _inflight(s, i, b.requests) < b.slots)
+
+
+# -- enabled transitions --------------------------------------------------
+
+def enabled(s, b, bugs=frozenset()):
+    """All transitions enabled in state ``s`` under bounds ``b``."""
+    ts = []
+    n = len(s.status)
+    if s.queue:
+        rid = s.queue[0]
+        for i in range(n):
+            if not _eligible(s, b, i):
+                continue
+            # hedging happens IN the admission round
+            # (ReplicaRouter._admit_hedges runs right after the
+            # primary admit): with capacity on a second replica the
+            # router always fans, so the un-hedged dispatch is only
+            # enabled when no hedge target exists
+            hedge_targets = []
+            if b.th >= 2 and not s.hedged[rid]:
+                hedge_targets = [j for j in range(n)
+                                 if j != i and _eligible(s, b, j)]
+            if hedge_targets:
+                for j in hedge_targets:
+                    ts.append(("hdispatch", rid, i, j))
+            else:
+                ts.append(("dispatch", rid, i))
+    if s.pending_resume:
+        rid = s.pending_resume[0]
+        for i in range(n):
+            if _eligible(s, b, i):
+                ts.append(("resume", rid, i))
+    for i in range(n):
+        st = s.status[i]
+        if st == UP:
+            for rid in s.worker[i]:
+                ts.append(("complete", i, rid))
+                if s.wfails < b.max_wfails:
+                    ts.append(("wfail", i, rid))
+            if s.chan_dn[i]:
+                ts.append(("dn", i))
+            if s.faults < b.fault_budget and not s.fleet_draining:
+                ts.append(("die", i))
+                ts.append(("preempt", i))
+        if st in (UP, STOPPED) and s.chan_up[i]:
+            # a stopped worker's flushed frames are still readable
+            ts.append(("up", i))
+        if st == DEAD:
+            if s.deaths[i] <= b.max_restarts:
+                ts.append(("restart", i))
+            else:
+                ts.append(("breaker", i))
+        if st == BROKEN and "breaker_bypass" in bugs:
+            ts.append(("restart", i))
+        if (st == SPARE and s.faults < b.fault_budget
+                and not s.fleet_draining):
+            ts.append(("join", i))
+    if any(s.status[i] == UP and not s.ranked[i] for i in range(n)):
+        ts.append(("re_rank",))
+    if (not s.fleet_draining and s.faults < b.fault_budget
+            and any(st == UP for st in s.status)):
+        ts.append(("fleet_drain",))
+    return ts
+
+
+# -- transition effects ---------------------------------------------------
+
+def _fail_copy(s, b, rid):
+    """One failed attempt for ``rid`` whose copy is already unbound:
+    absorbed by a live hedge sibling, retried, or dead-lettered —
+    exactly ReplicaRouter._route_completions' retryable branch."""
+    s = s._replace(failed=s.failed + 1)
+    if s.bound[rid]:
+        return s._replace(absorbed=s.absorbed + 1)
+    att = s.attempts[rid] + 1
+    s = s._replace(attempts=_tset(s.attempts, rid, att))
+    if att < b.max_attempts:
+        return s._replace(retries=s.retries + 1, queue=s.queue + (rid,))
+    return s._replace(dead_letter=s.dead_letter + 1,
+                      terminals=_tset(s.terminals, rid,
+                                      s.terminals[rid] + 1))
+
+
+def _charge(s, payload):
+    return s._replace(charged=s.charged + payload,
+                      computed=s.computed + payload)
+
+
+def _snapshot_in(s, b, i, rid, payload, bugs):
+    """Route one drain snapshot (worker-shipped or dd-synthesized)
+    from replica ``i``: ReplicaRouter._retire / _drain_fleet."""
+    if i not in s.bound[rid]:
+        # raced a cancel or the result already landed: the drained
+        # partial is discarded — and charged as hedge waste
+        return _charge(s, payload)
+    s = s._replace(bound=_tset(s.bound, rid, _rm(s.bound[rid], i)))
+    if "double_terminal_hedge_preempt" not in bugs:
+        if s.terminals[rid] or s.bound[rid]:
+            # covered by a live sibling (or already terminal): drop
+            # the copy, charge the partial
+            return _charge(s, payload)
+    if s.fleet_draining:
+        if rid in s.drained_pool:
+            # duplicate hedge snapshot at fleet drain — charged (the
+            # _drain_fleet accounting fix this PR pins)
+            return _charge(s, payload)
+        return s._replace(drained_pool=_ins(s.drained_pool, rid))
+    return s._replace(pending_resume=s.pending_resume + (rid,))
+
+
+def _preempt_effects(s, i):
+    """SIGTERM a live worker: snapshot everything admitted, flush a
+    DrainDone, drop undelivered router->worker frames (the SIGTERM
+    jumped them), stop."""
+    up = s.chan_up[i] + tuple(("snap", rid) for rid in s.worker[i]) \
+        + (("dd",),)
+    return s._replace(status=_tset(s.status, i, STOPPED),
+                      worker=_tset(s.worker, i, ()),
+                      chan_dn=_tset(s.chan_dn, i, ()),
+                      chan_up=_tset(s.chan_up, i, up))
+
+
+def _deliver_up(s, b, i, bugs):
+    msg = s.chan_up[i][0]
+    s = s._replace(chan_up=_tset(s.chan_up, i, s.chan_up[i][1:]))
+    kind = msg[0]
+    if kind == "cmp":
+        _, rid, reason, payload, wd = msg
+        # progress-mirror update (HealthFrame / dispatch mirror):
+        # worker counters reset across restarts, the proxy adds a
+        # per-incarnation base to stay monotonic
+        v = s.base[i] + wd
+        if v < s.observed[i]:
+            if "mirror_regression" not in s.flags:
+                s = s._replace(flags=tuple(sorted(
+                    s.flags + ("mirror_regression",))))
+        else:
+            s = s._replace(observed=_tset(s.observed, i, v))
+        if i not in s.bound[rid]:
+            # a completion that raced our CancelFrame on the wire:
+            # the worker computed the payload before the cancel
+            # landed — charge it (RemoteEngine._pop_completions)
+            if "waste_uncharged_cancel_race" in bugs:
+                return s._replace(computed=s.computed + payload)
+            if ("double_terminal_hedge_preempt" in bugs
+                    and s.status[i] == STOPPED):
+                # seeded bug: the harvest-at-retire path routes the
+                # buffered completion as a fresh result, skipping
+                # the cancelled-rid filter and the dup check
+                return s._replace(
+                    terminals=_tset(s.terminals, rid,
+                                    s.terminals[rid] + 1))
+            return _charge(s, payload)
+        s = s._replace(bound=_tset(s.bound, rid, _rm(s.bound[rid], i)))
+        if reason == "ok":
+            if s.terminals[rid]:
+                # in-process duplicate (both copies stepped before
+                # routing cancelled one): discarded and charged
+                return _charge(s, payload)
+            s = s._replace(terminals=_tset(s.terminals, rid,
+                                           s.terminals[rid] + 1))
+            # cancel the hedge losers (ReplicaRouter._cancel_losers)
+            for j in tuple(s.bound[rid]):
+                s = s._replace(bound=_tset(s.bound, rid,
+                                           _rm(s.bound[rid], j)))
+                if s.status[j] == UP:
+                    s = s._replace(
+                        chan_dn=_push(s.chan_dn, j, ("can", rid)),
+                        cancelled=_tset(s.cancelled, j,
+                                        _ins(s.cancelled[j], rid)))
+            return s
+        # retryable failure (watchdog / bounce)
+        return _fail_copy(s, b, rid)
+    if kind == "ack":
+        _, rid, waste = msg
+        if rid in s.cancelled[i]:
+            s = s._replace(cancelled=_tset(s.cancelled, i,
+                                           _rm(s.cancelled[i], rid)))
+        return s._replace(charged=s.charged + waste)
+    if kind == "snap":
+        return _snapshot_in(s, b, i, msg[1], 1, bugs)
+    # kind == "dd": DrainDone — zero-progress reconciliation for
+    # every rid still bound here whose SubmitFrame the SIGTERM jumped
+    for rid in range(b.requests):
+        if i in s.bound[rid]:
+            s = _snapshot_in(s, b, i, rid, 0, bugs)
+    return s
+
+
+def _deliver_dn(s, b, i):
+    msg = s.chan_dn[i][0]
+    s = s._replace(chan_dn=_tset(s.chan_dn, i, s.chan_dn[i][1:]))
+    kind, rid = msg
+    if kind in ("sub", "res"):
+        if len(s.worker[i]) >= b.slots:
+            # the mirror and the worker disagreed: bounce as a
+            # retryable failure (worker.py's no-capacity path) —
+            # unreachable while admission gates on the bound-count
+            # mirror, kept because the conformance twin needs it
+            return s._replace(chan_up=_push(
+                s.chan_up, i, ("cmp", rid, "fault", 0, s.wdisp[i])))
+        return s._replace(worker=_tset(s.worker, i,
+                                       _ins(s.worker[i], rid)))
+    # kind == "can": worker discards the partial and acks the EXACT
+    # count (wire v3); an unknown rid acks 0 — its completion frame,
+    # already in flight, carries the tokens
+    if rid in s.worker[i]:
+        return s._replace(worker=_tset(s.worker, i, _rm(s.worker[i], rid)),
+                          computed=s.computed + 1,
+                          chan_up=_push(s.chan_up, i, ("ack", rid, 1)))
+    return s._replace(chan_up=_push(s.chan_up, i, ("ack", rid, 0)))
+
+
+def apply(s, t, b, bugs=frozenset()):
+    """The successor of ``s`` under transition ``t``.  Deterministic:
+    all nondeterminism lives in the CHOICE of ``t``."""
+    k = t[0]
+    if k == "dispatch":
+        _, rid, i = t
+        return s._replace(queue=s.queue[1:],
+                          bound=_tset(s.bound, rid,
+                                      _ins(s.bound[rid], i)),
+                          chan_dn=_push(s.chan_dn, i, ("sub", rid)))
+    if k == "hdispatch":
+        _, rid, i, j = t
+        s = s._replace(queue=s.queue[1:],
+                       hedged=_tset(s.hedged, rid, 1),
+                       bound=_tset(s.bound, rid,
+                                   _ins(_ins(s.bound[rid], i), j)),
+                       chan_dn=_push(s.chan_dn, i, ("sub", rid)))
+        return s._replace(chan_dn=_push(s.chan_dn, j, ("sub", rid)))
+    if k == "resume":
+        _, rid, i = t
+        return s._replace(pending_resume=s.pending_resume[1:],
+                          bound=_tset(s.bound, rid,
+                                      _ins(s.bound[rid], i)),
+                          chan_dn=_push(s.chan_dn, i, ("res", rid)))
+    if k == "complete":
+        _, i, rid = t
+        # the dispatch counter saturates at WDISP_CAP: the mirror
+        # logic only compares rebased values, and within an
+        # incarnation the counter is non-decreasing either way — the
+        # cap stops pure counter arithmetic from manufacturing
+        # distinct states (a regression needs observed >= 2, well
+        # inside the cap)
+        wd = min(s.wdisp[i] + 1, WDISP_CAP)
+        return s._replace(worker=_tset(s.worker, i, _rm(s.worker[i], rid)),
+                          wdisp=_tset(s.wdisp, i, wd),
+                          chan_up=_push(s.chan_up, i,
+                                        ("cmp", rid, "ok", 1, wd)))
+    if k == "wfail":
+        _, i, rid = t
+        return s._replace(worker=_tset(s.worker, i, _rm(s.worker[i], rid)),
+                          wfails=s.wfails + 1,
+                          chan_up=_push(s.chan_up, i,
+                                        ("cmp", rid, "wd", 0, s.wdisp[i])))
+    if k == "dn":
+        return _deliver_dn(s, b, t[1])
+    if k == "up":
+        return _deliver_up(s, b, t[1], bugs)
+    if k == "die":
+        i = t[1]
+        had_cancels = bool(s.cancelled[i])
+        lost = sum(m[2] for m in s.chan_up[i] if m[0] == "ack")
+        s = s._replace(status=_tset(s.status, i, DEAD),
+                       deaths=_tset(s.deaths, i, s.deaths[i] + 1),
+                       faults=s.faults + 1,
+                       worker=_tset(s.worker, i, ()),
+                       cancelled=_tset(s.cancelled, i, ()),
+                       chan_dn=_tset(s.chan_dn, i, ()),
+                       chan_up=_tset(s.chan_up, i, ()),
+                       lost_waste=s.lost_waste + lost)
+        if "lost_rid_death_cancel" in bugs and had_cancels:
+            # seeded bug: the death handler returns early while
+            # cancel acks are pending — in-flight rids never fail over
+            return s
+        for rid in range(b.requests):
+            if i in s.bound[rid]:
+                s = s._replace(bound=_tset(s.bound, rid,
+                                           _rm(s.bound[rid], i)))
+                s = _fail_copy(s, b, rid)
+        return s
+    if k == "restart":
+        i = t[1]
+        flags = s.flags
+        if s.status[i] == BROKEN and "breaker_restart" not in flags:
+            flags = tuple(sorted(flags + ("breaker_restart",)))
+        if "restart_no_inc_bump" in bugs:
+            # seeded bug: _on_incarnation never runs — the mirror
+            # base is not re-anchored, the incarnation not bumped
+            return s._replace(status=_tset(s.status, i, UP),
+                              wdisp=_tset(s.wdisp, i, 0), flags=flags)
+        return s._replace(status=_tset(s.status, i, UP),
+                          inc=_tset(s.inc, i, s.inc[i] + 1),
+                          wdisp=_tset(s.wdisp, i, 0),
+                          base=_tset(s.base, i, s.observed[i]),
+                          flags=flags)
+    if k == "breaker":
+        return s._replace(status=_tset(s.status, t[1], BROKEN))
+    if k == "preempt":
+        return _preempt_effects(s._replace(faults=s.faults + 1), t[1])
+    if k == "fleet_drain":
+        s = s._replace(faults=s.faults + 1, fleet_draining=1)
+        for i in range(len(s.status)):
+            if s.status[i] == UP:
+                s = _preempt_effects(s, i)
+        # park work that was already awaiting placement
+        pool = s.drained_pool
+        for rid in s.pending_resume:
+            if rid not in pool:
+                pool = _ins(pool, rid)
+        return s._replace(pending_resume=(), drained_pool=pool)
+    if k == "join":
+        i = t[1]
+        return s._replace(status=_tset(s.status, i, UP),
+                          ranked=_tset(s.ranked, i, 0),
+                          faults=s.faults + 1)
+    if k == "re_rank":
+        ranked = tuple(1 if s.status[i] == UP else s.ranked[i]
+                       for i in range(len(s.status)))
+        return s._replace(ranked=ranked)
+    raise ValueError(f"unknown transition {t!r}")
+
+
+# -- invariants -----------------------------------------------------------
+
+def _rid_accounted(s, b, rid):
+    if s.terminals[rid]:
+        return True
+    if rid in s.queue or rid in s.pending_resume \
+            or rid in s.drained_pool:
+        return True
+    n = len(s.status)
+    for i in range(n):
+        if rid in s.worker[i]:
+            return True
+        for m in s.chan_dn[i]:
+            if m[0] in ("sub", "res") and m[1] == rid:
+                return True
+        for m in s.chan_up[i]:
+            if m[0] in ("cmp", "snap") and m[1] == rid:
+                return True
+        # bound to a stopped replica: the DrainDone reconciliation
+        # still owes a zero-progress snapshot
+        if (i in s.bound[rid] and s.status[i] == STOPPED
+                and any(m[0] == "dd" for m in s.chan_up[i])):
+            return True
+    return False
+
+
+def violations(s, b):
+    """Invariant failures in state ``s`` — checked in EVERY reachable
+    state, not only at quiescence.  Returns (invariant, message)."""
+    out = []
+    for rid in range(b.requests):
+        if s.terminals[rid] > 1:
+            out.append(("one_terminal",
+                        f"rid {rid} recorded {s.terminals[rid]} "
+                        f"terminal results"))
+    if s.failed != s.retries + s.dead_letter + s.absorbed:
+        out.append(("ledger_identity",
+                    f"failed_attempts={s.failed} != retries={s.retries}"
+                    f" + dead_letter={s.dead_letter}"
+                    f" + hedge_absorbed={s.absorbed}"))
+    in_flight = sum(m[2] for ch in s.chan_up for m in ch
+                    if m[0] == "ack")
+    if s.charged + s.lost_waste + in_flight != s.computed:
+        out.append(("waste_conservation",
+                    f"charged={s.charged} + lost={s.lost_waste}"
+                    f" + acks_in_flight={in_flight}"
+                    f" != computed={s.computed}"))
+    for rid in range(b.requests):
+        if not _rid_accounted(s, b, rid):
+            out.append(("no_lost_rid",
+                        f"rid {rid} is not terminal, queued, admitted,"
+                        f" in flight, or awaiting resume anywhere"))
+    if "mirror_regression" in s.flags:
+        out.append(("mirror_monotonic",
+                    "dispatch mirror regressed across an incarnation"))
+    if "breaker_restart" in s.flags:
+        out.append(("breaker_no_restart",
+                    "a breaker-open replica was restarted"))
+    return out
+
+
+def quiescent_violations(s, b):
+    """Extra obligations when NO transition is enabled."""
+    out = []
+    any_up = any(st == UP for st in s.status)
+    if not s.fleet_draining and s.drained_pool:
+        # parked work is only legitimate under a fleet drain (a
+        # restart AFTER the drain may leave a live-but-idle replica;
+        # the pool is the caller's to re-submit — router.run has
+        # already returned it)
+        out.append(("drained_pool_quiescence",
+                    f"quiescent without a fleet drain but "
+                    f"{len(s.drained_pool)} rids parked in the "
+                    f"drained pool"))
+    if any_up and not s.fleet_draining:
+        for rid in range(b.requests):
+            if s.terminals[rid] != 1:
+                out.append((
+                    "completeness",
+                    f"quiescent with live replicas but rid {rid} has "
+                    f"{s.terminals[rid]} terminal results"))
+    return out
+
+
+# -- partial-order reduction footprints -----------------------------------
+
+def footprint(t, n_replicas):
+    """Resource tokens ``t`` reads or writes.  Two transitions with
+    disjoint footprints commute (the independence relation the
+    sleep-set reduction in fleet_check.py is built on).  'R' is the
+    router/scheduler/ledger complex; per-replica tokens cover the
+    worker state and the two directed channels."""
+    k = t[0]
+    if k in ("complete", "wfail"):
+        i = t[1]
+        return frozenset((("w", i), ("u", i)))
+    if k == "dn":
+        i = t[1]
+        return frozenset((("d", i), ("w", i), ("u", i)))
+    if k == "up":
+        # may push cancels into any down-channel (hedge losers)
+        i = t[1]
+        return frozenset(("R", ("u", i))) | frozenset(
+            ("d", j) for j in range(n_replicas))
+    if k in ("dispatch", "resume"):
+        i = t[2]
+        return frozenset(("R", ("d", i)))
+    if k == "hdispatch":
+        return frozenset(("R", ("d", t[2]), ("d", t[3])))
+    if k in ("die", "restart", "preempt", "breaker", "join"):
+        i = t[1]
+        return frozenset(("R", ("d", i), ("u", i), ("w", i)))
+    if k == "re_rank":
+        return frozenset(("R",))
+    # fleet_drain touches everything
+    toks = {"R"}
+    for i in range(n_replicas):
+        toks.update((("d", i), ("u", i), ("w", i)))
+    return frozenset(toks)
+
+
+def describe(t):
+    k = t[0]
+    if k in ("dispatch", "resume"):
+        return f"{k} rid={t[1]} -> replica {t[2]}"
+    if k == "hdispatch":
+        return (f"dispatch rid={t[1]} -> replica {t[2]} "
+                f"+ hedge copy -> replica {t[3]}")
+    if k in ("complete", "wfail"):
+        verb = "completes" if k == "complete" else "watchdog-fails"
+        return f"replica {t[1]} {verb} rid={t[2]}"
+    if k == "dn":
+        return f"deliver next router->worker frame to replica {t[1]}"
+    if k == "up":
+        return f"deliver next worker->router frame from replica {t[1]}"
+    if k == "die":
+        return f"replica {t[1]} dies (SIGKILL)"
+    if k == "restart":
+        return f"replica {t[1]} restarts (new incarnation)"
+    if k == "breaker":
+        return f"replica {t[1]} circuit breaker opens"
+    if k == "preempt":
+        return f"replica {t[1]} preempted (SIGTERM drain)"
+    if k == "join":
+        return f"spare replica {t[1]} joins (unranked)"
+    if k == "re_rank":
+        return "membership re-rank"
+    return "fleet drain (SIGTERM all live replicas)"

@@ -671,6 +671,120 @@ HOST_FIXTURES = [
 ]
 
 
+# -- fleet (graftcheck) fixtures ------------------------------------------
+#
+# Each seeds one protocol bug into the abstract control-plane model
+# (analysis/fleet_model.BUG_NAMES) and carries a source-snippet
+# miniature of the buggy host logic.  The triple obligation
+# run_selfcheck enforces: the fixture's device shadow is clean under
+# BOTH device catalogs, its source miniature is clean under the host
+# concurrency catalog (the bug is a protocol-logic fault, not a data
+# race — no static plane can see it), and the model checker alone
+# catches it with a minimal replayable counterexample schedule.
+
+_FLEET_FIXTURE_LOST_RID = '''\
+class RouterFailover:
+    """Miniature of ReplicaRouter death failover (serving/router.py).
+
+    BUG: when the dead replica still has unacked cancels, the early
+    return skips failover for EVERY rid bound there — a request that
+    was never cancelled dies with the replica and is silently lost
+    (no retry, no dead-letter, no terminal)."""
+
+    def on_death(self, replica, bound, unacked_cancels, requeue):
+        if unacked_cancels.get(replica):
+            return  # BUG: masks the other bound rids
+        for rid in bound.get(replica, ()):
+            requeue(rid)
+'''
+
+_FLEET_FIXTURE_DOUBLE_TERMINAL = '''\
+class CompletionRouter:
+    """Miniature of completion routing (serving/router.py).
+
+    BUG: a completion that lands from a replica ALREADY stopped by a
+    preempt skips the terminal-dedup check, so a hedge winner that
+    raced the SIGTERM snapshot records a second terminal result for
+    the same rid."""
+
+    def route(self, rid, replica, status, terminals):
+        if status.get(replica) == "stopped":
+            terminals[rid] = terminals.get(rid, 0) + 1  # BUG: no dedup
+            return
+        if terminals.get(rid, 0) == 0:
+            terminals[rid] = 1
+'''
+
+_FLEET_FIXTURE_WASTE_UNCHARGED = '''\
+class CancelLedger:
+    """Miniature of orphan-completion charging (serving/supervisor.py
+    RemoteEngine._pop_completions).
+
+    BUG: a completion that raced its CancelFrame is discarded without
+    charging the wasted decode — computed work grows, charged waste
+    does not, and the hedge-overhead metric silently undercounts."""
+
+    def on_completion(self, rid, tokens, cancelled, ledger):
+        if rid in cancelled:
+            ledger["computed"] += len(tokens)
+            return  # BUG: ledger["charged"] never moves
+        ledger["computed"] += len(tokens)
+        ledger["charged"] += len(tokens)
+'''
+
+_FLEET_FIXTURE_NO_INC_BUMP = '''\
+class ProxyRebase:
+    """Miniature of incarnation re-anchoring (serving/supervisor.py
+    RemoteEngine._on_incarnation).
+
+    BUG: the restarted worker reports dispatch counts from zero but
+    the proxy keeps the old base, so the rebased mirror value jumps
+    backwards — every monotonicity consumer (watchdog deltas, the
+    health plane) sees a regression."""
+
+    def on_restart(self, proxy):
+        proxy["dispatches"] = 0
+        # BUG: proxy["base"] should re-anchor to the observed mirror
+        proxy["incarnation"] = proxy["incarnation"]  # and never bumps
+'''
+
+_FLEET_FIXTURE_BREAKER_BYPASS = '''\
+class RestartPolicy:
+    """Miniature of the supervisor restart loop (serving/supervisor.py
+    _reap).
+
+    BUG: the respawn path checks the restart budget but not the
+    latched breaker, so a replica whose breaker already opened is
+    resurrected — the breaker exists precisely to stop a crash-looping
+    rank from flapping the fleet."""
+
+    def on_death(self, child, spawn):
+        if child["restarts"] <= child["budget"]:
+            spawn(child)  # BUG: ignores child["breaker_open"]
+'''
+
+# (fixture name, source miniature, seeded model bug, invariant that
+#  must fire, fixture bounds overrides)
+FLEET_FIXTURES = [
+    ("fleet_lost_rid_death_cancel", _FLEET_FIXTURE_LOST_RID,
+     "lost_rid_death_cancel", "no_lost_rid",
+     dict(th=2, spares=0, fault_budget=1, requests=2)),
+    ("fleet_double_terminal_hedge_preempt",
+     _FLEET_FIXTURE_DOUBLE_TERMINAL,
+     "double_terminal_hedge_preempt", "one_terminal",
+     dict(th=2, spares=0, fault_budget=1, requests=2)),
+    ("fleet_waste_uncharged_cancel_race", _FLEET_FIXTURE_WASTE_UNCHARGED,
+     "waste_uncharged_cancel_race", "waste_conservation",
+     dict(th=2, spares=0, fault_budget=1, requests=2)),
+    ("fleet_restart_no_inc_bump", _FLEET_FIXTURE_NO_INC_BUMP,
+     "restart_no_inc_bump", "mirror_monotonic",
+     dict(th=1, spares=0, fault_budget=1, requests=2)),
+    ("fleet_breaker_bypass", _FLEET_FIXTURE_BREAKER_BYPASS,
+     "breaker_bypass", "breaker_no_restart",
+     dict(th=1, spares=0, fault_budget=2, requests=2)),
+]
+
+
 # (fixture name, pass that must fire, severity it must fire at)
 FIXTURES = [
     ("bad_axis", fixture_bad_axis, "collective-axis", "error"),
@@ -717,7 +831,8 @@ def _check_recompile_guard() -> "tuple[bool, str]":
     return False, "recompile guard NEVER fired on a shape change"
 
 
-def run_selfcheck(include_hlo: bool = False, include_host: bool = False
+def run_selfcheck(include_hlo: bool = False, include_host: bool = False,
+                  include_fleet: bool = False
                   ) -> "tuple[bool, list[str]]":
     """Build every fixture, run the pass catalog, verify each expected
     (pass, severity) fires. With ``include_hlo`` the compiled-HLO
@@ -727,7 +842,11 @@ def run_selfcheck(include_hlo: bool = False, include_host: bool = False
     ``include_host`` the host-concurrency fixtures run under the same
     double obligation — each fixture's device shadow must be clean
     under BOTH device catalogs, and the named host pass must catch the
-    source. Returns (all_caught, report lines)."""
+    source. With ``include_fleet`` the seeded protocol bugs run under
+    a TRIPLE obligation — device shadow clean, source miniature clean
+    under the host catalog, and only the model checker catches the
+    bug, with a counterexample schedule that replays to the same
+    violation. Returns (all_caught, report lines)."""
     ok, lines = True, []
     for name, build, expect_pass, expect_sev in FIXTURES:
         ctx = build()
@@ -808,4 +927,55 @@ def run_selfcheck(include_hlo: bool = False, include_host: bool = False
                 lines.append(
                     f"MISSED  {name}: expected [{expect_pass}] at "
                     f"{expect_sev}, got {got or 'nothing'}")
+    if include_fleet:
+        from akka_allreduce_tpu.analysis import fleet_model as fm
+        from akka_allreduce_tpu.analysis.fleet_check import (explore,
+                                                             replay)
+        from akka_allreduce_tpu.analysis.host import (analyze_source,
+                                                      run_host_passes)
+        for name, source, bug, expect_inv, bkw in FLEET_FIXTURES:
+            # existence proof, leg 1: the device shadow is clean under
+            # the jaxpr AND compiled-HLO catalogs
+            shadow = _host_device_shadow(name)
+            device = [f for f in run_passes(shadow)
+                      + run_hlo_passes(shadow)
+                      if f.severity in ("error", "warning")]
+            # leg 2: the buggy host logic is clean under the host
+            # concurrency catalog — it is a protocol fault, not a race
+            module = analyze_source(f"fixture/{name}.py", source)
+            hostf = [f for f in run_host_passes([module])
+                     if f.severity in ("error", "warning")]
+            if device or hostf:
+                ok = False
+                got = [(f.pass_name, f.severity)
+                       for f in device + hostf]
+                lines.append(
+                    f"MISSED  {name}: a static plane fired {got} — "
+                    f"the fixture no longer demonstrates a "
+                    f"model-checker-only gap")
+                continue
+            # leg 3: the checker catches the seeded bug, and the
+            # counterexample replays to the same invariant
+            bounds = fm.DEFAULT_BOUNDS._replace(**bkw)
+            res = explore(bounds, bugs=frozenset({bug}))
+            v = res.violation
+            if v is None or v.invariant != expect_inv:
+                ok = False
+                lines.append(
+                    f"MISSED  {name}: expected invariant "
+                    f"'{expect_inv}', got "
+                    f"{v.invariant if v else 'no violation'} "
+                    f"(overflow={res.overflow})")
+                continue
+            _, bad = replay(bounds, v.schedule, bugs=frozenset({bug}))
+            if not any(inv == expect_inv for inv, _ in bad):
+                ok = False
+                lines.append(
+                    f"MISSED  {name}: counterexample did not replay "
+                    f"to '{expect_inv}' (got {bad})")
+                continue
+            lines.append(
+                f"caught  {name}: static-plane-blind, "
+                f"[{expect_inv}] in {len(v.schedule)} steps "
+                f"(replayed)")
     return ok, lines

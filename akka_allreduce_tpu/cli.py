@@ -3237,11 +3237,14 @@ def _serve_replicated_selfcheck(args: argparse.Namespace) -> int:
     import jax
     import numpy as np
 
+    from akka_allreduce_tpu.analysis.fleet_conform import \
+        assert_conformant
     from akka_allreduce_tpu.analysis.recompile import (RecompileError,
                                                        no_recompiles)
     from akka_allreduce_tpu.models.transformer import (TransformerConfig,
                                                        init_transformer)
     from akka_allreduce_tpu.runtime.faults import FaultPlan, FaultPoint
+    from akka_allreduce_tpu.runtime.tracing import Tracer
     from akka_allreduce_tpu.serving import (EngineConfig, FleetMetrics,
                                             ReplicaRouter, Request,
                                             RequestScheduler, RetryPolicy,
@@ -3289,7 +3292,7 @@ def _serve_replicated_selfcheck(args: argparse.Namespace) -> int:
         router = ReplicaRouter(engines, sched,
                                RouterConfig(th=th,
                                             max_lag=args.max_lag),
-                               fleet=fleet)
+                               fleet=fleet, tracer=Tracer())
         return router, sched, fleet
 
     def run_fleet(router, sched, fleet, plan=None):
@@ -3299,7 +3302,11 @@ def _serve_replicated_selfcheck(args: argparse.Namespace) -> int:
         ctx = (plan.armed() if plan is not None
                else contextlib.nullcontext())
         with ctx:
-            return router.run(max_rounds=4000)
+            out = router.run(max_rounds=4000)
+        # graftcheck's dynamic twin: the run's fleet_transition trace
+        # must conform to the control-plane model's guards
+        assert_conformant(router.tracer)
+        return out
 
     # the fleet fault script: three failure domains on replica 0, then
     # replica 1 preempted mid-load (migration, not loss)
@@ -3413,6 +3420,7 @@ def _serve_replicated_selfcheck(args: argparse.Namespace) -> int:
             "wasted_tokens": s2["hedge"]["wasted_tokens"],
         },
         "churn_recompiles": 0 if results2 else None,
+        "conformance": "ok",  # assert_conformant raised otherwise
         "failures": failures,
     }))
     return 0 if not failures else 1
@@ -3440,10 +3448,13 @@ def _serve_subprocess_selfcheck(args: argparse.Namespace) -> int:
     import jax
     import numpy as np
 
+    from akka_allreduce_tpu.analysis.fleet_conform import \
+        assert_conformant
     from akka_allreduce_tpu.models.transformer import (TransformerConfig,
                                                        init_transformer)
     from akka_allreduce_tpu.runtime.faults import (ProcessChaosPlan,
                                                    ProcessFaultPoint)
+    from akka_allreduce_tpu.runtime.tracing import Tracer
     from akka_allreduce_tpu.serving import (BackoffPolicy, EngineConfig,
                                             FleetMetrics, ReplicaRouter,
                                             ReplicaSpec,
@@ -3498,10 +3509,14 @@ def _serve_subprocess_selfcheck(args: argparse.Namespace) -> int:
             num_slots=n_rep * slots)
         for eng in sup.engines:
             eng.metrics = None  # rewire to THIS phase's fleet sinks
+        # each phase gets a fresh trace (the rids repeat per phase);
+        # the proxies read sup.tracer dynamically, so swapping it here
+        # routes their transition events to this phase's log too
+        sup.tracer = Tracer()
         router = ReplicaRouter(sup.engines, sched,
                                RouterConfig(th=th,
                                             max_lag=args.max_lag),
-                               fleet=fleet)
+                               fleet=fleet, tracer=sup.tracer)
         for r in make_requests():
             fleet.on_submit(r.rid)
             sched.submit(r)
@@ -3530,6 +3545,7 @@ def _serve_subprocess_selfcheck(args: argparse.Namespace) -> int:
         # compiled in every worker (warm before you arm)
         warm_results, _ = run_phase(sup, fleet_warm, th=1)
         check_parity("warm", warm_results)
+        assert_conformant(sup.tracer)
         survivor_compiles = [sup.engines[i].remote_compiles
                             for i in range(n_rep)]
         # phase 2 — murder: SIGKILL replica 0 after its 3rd terminal
@@ -3554,6 +3570,9 @@ def _serve_subprocess_selfcheck(args: argparse.Namespace) -> int:
         if sup.restarts(0) != 1:
             failures.append(f"replica 0 restarts={sup.restarts(0)}, "
                             f"want exactly 1 (within backoff budget)")
+        # the chaos phase's trace — death, failover, restart included
+        # — must conform to the control-plane model
+        assert_conformant(sup.tracer)
         if sup.state(0) != "up":
             failures.append(f"replica 0 state={sup.state(0)} after "
                             f"restart, want up")
@@ -3622,6 +3641,7 @@ def _serve_subprocess_selfcheck(args: argparse.Namespace) -> int:
         "retries": s["faults"]["retries_total"],
         "hedge_absorbed": s["hedge"]["absorbed_failures"],
         "survivor_compiles_post_warmup": 0 if not failures else None,
+        "conformance": "ok",  # assert_conformant raised otherwise
         "failures": failures,
     }))
     return 0 if not failures else 1
@@ -5053,6 +5073,20 @@ def _add_lint(sub: argparse._SubParsersAction) -> None:
                         "--target, host modules are named by relpath "
                         "(e.g. telemetry/registry.py); composes with "
                         "--all/--format/--strict/--selfcheck")
+    p.add_argument("--fleet", action="store_true",
+                   help="also run graftcheck, the FLEET plane "
+                        "(analysis/fleet_check.py): explicit-state "
+                        "model checking of the replicated-serving "
+                        "control plane — every reachable state of the "
+                        "router/supervisor/worker/scheduler model "
+                        "inside the default bounds (2 replicas x 3 "
+                        "requests, hedge threshold 1 and 2) is checked "
+                        "against the terminal/ledger/waste/liveness "
+                        "invariants; a violation prints a minimal "
+                        "replayable counterexample schedule. Alone "
+                        "(no --all/--target) runs just this plane; "
+                        "composes with --all/--target/--format/"
+                        "--strict/--selfcheck")
     p.add_argument("--rebank-fusion", action="store_true",
                    help="with --all --hlo: write the per-entry fusion "
                         "census observed in this run to analysis/"
@@ -5068,7 +5102,11 @@ def _add_lint(sub: argparse._SubParsersAction) -> None:
                         "too — each must be jaxpr/StableHLO-clean AND "
                         "caught by its HLO pass; with --host the "
                         "concurrency fixtures run, each proven "
-                        "invisible to BOTH device catalogs first")
+                        "invisible to BOTH device catalogs first; "
+                        "with --fleet the seeded protocol bugs run — "
+                        "each invisible to every static plane, caught "
+                        "only by the model checker with a replayable "
+                        "counterexample")
 
 
 def _cmd_lint(args: argparse.Namespace) -> int:
@@ -5123,26 +5161,35 @@ def _cmd_lint(args: argparse.Namespace) -> int:
     if args.selfcheck:
         from akka_allreduce_tpu.analysis.selfcheck import run_selfcheck
         ok, lines = run_selfcheck(include_hlo=args.hlo,
-                                  include_host=args.host)
+                                  include_host=args.host,
+                                  include_fleet=args.fleet)
         for line in lines:
             print(line)
         print("selfcheck: every pass caught its fixture" if ok
               else "selfcheck: FAILED — a pass went blind (see MISSED "
                    "lines)")
         return 0 if ok else 1
-    if args.all == (args.target is not None):
-        print("error: pass exactly one of --all / --target (or "
-              "--selfcheck / --list)", file=sys.stderr)
-        return 2
-    targets = None if args.all else \
-        [t for t in args.target.split(",") if t]
-    if targets == []:
-        # `--target ""` (an empty shell variable) must not silently
-        # become --all: the caller asked for specific targets and named
-        # none
-        print("error: --target got no entry-point names (empty value); "
-              "use --all to lint the whole catalog", file=sys.stderr)
-        return 2
+    # `lint --fleet` alone is a complete run: the fleet plane lints a
+    # MODEL, not a catalog entry, so it needs no entry-point selection
+    fleet_only = (args.fleet and not args.all and args.target is None
+                  and not args.host and not args.hlo)
+    if fleet_only:
+        targets = []
+    else:
+        if args.all == (args.target is not None):
+            print("error: pass exactly one of --all / --target (or "
+                  "--selfcheck / --list / --fleet)", file=sys.stderr)
+            return 2
+        targets = None if args.all else \
+            [t for t in args.target.split(",") if t]
+        if targets == []:
+            # `--target ""` (an empty shell variable) must not silently
+            # become --all: the caller asked for specific targets and
+            # named none
+            print("error: --target got no entry-point names (empty "
+                  "value); use --all to lint the whole catalog",
+                  file=sys.stderr)
+            return 2
     host_targets = None
     if args.host and targets is not None:
         # host modules are addressed by relpath; route them to the
@@ -5154,7 +5201,7 @@ def _cmd_lint(args: argparse.Namespace) -> int:
     try:
         from akka_allreduce_tpu.analysis.core import run_passes
         contexts = build_entrypoints(targets) \
-            if not (args.host and targets == []) else []
+            if not ((args.host or fleet_only) and targets == []) else []
     except (ValueError, RuntimeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -5207,6 +5254,12 @@ def _cmd_lint(args: argparse.Namespace) -> int:
             return 2
         findings.extend(run_host_passes(modules))
         names.extend(m.relpath for m in modules)
+    if args.fleet:
+        from akka_allreduce_tpu.analysis.fleet_check import \
+            run_fleet_plane
+        fleet_findings, fleet_names = run_fleet_plane()
+        findings.extend(fleet_findings)
+        names.extend(fleet_names)
     if args.format == "json":
         print(json.dumps(render_json(names, findings), indent=1))
     else:

@@ -28,11 +28,15 @@ A failure reproduces by construction: the schedule is the label.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import random
+from collections import deque, namedtuple
 from itertools import product
 from typing import Callable, Iterable, Iterator, Optional
 
-from akka_allreduce_tpu.protocol.transport import ActorRef
+import numpy as np
+
+from akka_allreduce_tpu.protocol.transport import ActorRef, Router
 
 # choose(ready_actors, step_index) -> the actor that delivers next
 Chooser = Callable[[list, int], ActorRef]
@@ -154,3 +158,156 @@ def explore(make_cluster: Callable[[], object],
             failures.append(ScheduleFailure(
                 label, f"{type(exc).__name__}: {exc}"))
     return failures
+
+
+# -- exhaustive-prefix mode with canonical state dedup --------------------
+
+#: Attributes that are harness plumbing or wall-clock artifacts, not
+#: protocol state — excluded from the canonical digest (``tic`` /
+#: ``rates_mbps`` are perf_counter readings: identical protocol states
+#: reached at different times must collapse to one node).
+_DIGEST_SKIP = frozenset({
+    "router", "tracer", "data_source", "data_sink", "on_round_complete",
+    "on_member", "on_terminated", "tic", "rates_mbps", "verbose",
+})
+
+
+def _canon(obj, seen):
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, np.ndarray):
+        return ("nd", obj.dtype.str, obj.shape,
+                hashlib.blake2b(np.ascontiguousarray(obj).tobytes(),
+                                digest_size=16).hexdigest())
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, ActorRef):
+        # refs are freshly numbered per cluster; the NAME is the
+        # canonical identity that is stable across replays
+        return ("ref", obj.name)
+    if isinstance(obj, (list, tuple, deque)):
+        return tuple(_canon(x, seen) for x in obj)
+    if isinstance(obj, dict):
+        return ("dict", tuple(sorted(
+            (repr(_canon(k, seen)), _canon(v, seen))
+            for k, v in obj.items())))
+    if isinstance(obj, (set, frozenset)):
+        return ("set", tuple(sorted(repr(_canon(x, seen))
+                                    for x in obj)))
+    if callable(obj) and not hasattr(obj, "__dict__"):
+        return ("fn", getattr(obj, "__name__", "?"))
+    if id(obj) in seen:
+        return ("cycle",)
+    seen = seen | {id(obj)}
+    d = getattr(obj, "__dict__", None)
+    if d is not None:
+        return (type(obj).__name__, tuple(
+            (k, _canon(v, seen)) for k, v in sorted(d.items())
+            if k not in _DIGEST_SKIP and not callable(v)))
+    return ("opaque", type(obj).__name__)
+
+
+def state_digest(cluster) -> str:
+    """A canonical hash of the cluster's COMPLETE protocol state:
+    master, every worker (ids, rounds, buffers — numpy payloads by
+    content hash), and every pending mailbox in delivery order.  Two
+    interleavings that reach byte-identical protocol configurations get
+    the same digest, whatever order got them there; wall-clock
+    artifacts and harness plumbing are excluded."""
+    router: Router = cluster.router
+    mail = tuple(
+        (ref.name, _canon(tuple(router.mailbox(ref)), frozenset()))
+        for ref in router._order if router._mailboxes.get(ref))
+    body = (
+        _canon(getattr(cluster, "master", None), frozenset()),
+        tuple(_canon(w, frozenset())
+              for w in getattr(cluster, "workers", ())),
+        mail,
+    )
+    return hashlib.blake2b(repr(body).encode(),
+                           digest_size=16).hexdigest()
+
+
+PrefixReport = namedtuple("PrefixReport", [
+    "prefixes_total",    # width ** depth: the naive leaf count
+    "prefixes_run",      # full runs actually validated
+    "prefixes_deduped",  # subtree prunes (digest already visited)
+    "visited_states",    # distinct canonical states encountered
+])
+
+
+def explore_exhaustive(make_cluster: Callable[[], object],
+                       validate: Callable[[object], None],
+                       depth: int, width: int,
+                       prepare: Optional[Callable[[object], None]] = None,
+                       budget: Optional[int] = None,
+                       digest: Callable[[object], str] = state_digest,
+                       ) -> tuple[list[ScheduleFailure], PrefixReport]:
+    """Exhaustive-prefix exploration with canonical state-hash dedup.
+
+    Walks the delivery-choice tree of the first ``depth`` steps
+    (``width`` choices per step, indices wrapping over the ready set —
+    the same prefix space as :func:`exhaustive_prefixes`), but prunes
+    any node whose :func:`state_digest` was already reached by another
+    prefix: the continuation is a deterministic function of cluster
+    state, so an identical mid-state proves the whole subtree —
+    including its leaf validations — is a duplicate.  Wrapped sibling
+    indices and order-insensitive message races collapse this way,
+    typically cutting the leaf count by an order of magnitude while
+    checking the SAME set of reachable behaviors.
+
+    Each surviving leaf (or early-quiescent node) continues with the
+    deterministic rotation :func:`prefix_schedule` uses after its
+    script — the continuation chooser offsets the step index by the
+    consumed prefix length, because ``pump_scheduled`` resets its step
+    counter per call — then ``validate`` runs.  Returns
+    ``(failures, PrefixReport)``; the visited-state counter is the
+    dedup's audit trail (reported, never silent).
+
+    Caveat: the default digest hashes PROTOCOL state (engines +
+    mailboxes), not sink history — a validator that asserts on what
+    was already flushed during the prefix window should pass a custom
+    ``digest`` that folds the sink contents in, or two interleavings
+    that flushed differently but converged internally would collapse.
+    """
+    failures: list[ScheduleFailure] = []
+    seen: set[str] = set()
+    n_run = n_dedup = 0
+    stack: list[tuple] = [()]
+    while stack:
+        p = stack.pop()
+        label = f"prefix{p}"
+        cluster = make_cluster()
+        cap = budget if budget is not None else getattr(
+            cluster, "_message_budget", lambda: 1_000_000)()
+        try:
+            cluster.start()
+            if prepare is not None:
+                prepare(cluster)
+            delivered = cluster.router.pump_scheduled(
+                prefix_schedule(p), max_messages=len(p),
+                strict=False) if p else 0
+            key = digest(cluster)
+            if key in seen:
+                n_dedup += 1
+                continue
+            seen.add(key)
+            if delivered < len(p) or len(p) >= depth:
+                # early quiescence (the run already completed inside
+                # the prefix window) or a leaf: finish deterministically
+                # and validate.  The offset keeps the continuation
+                # identical to prefix_schedule's own rotation tail.
+                off = delivered
+                cluster.router.pump_scheduled(
+                    lambda ready, step: ready[(step + off) % len(ready)],
+                    max_messages=cap)
+                n_run += 1
+                validate(cluster)
+            else:
+                stack.extend(p + (i,) for i in range(width))
+        except Exception as exc:
+            n_run += 1
+            failures.append(ScheduleFailure(
+                label, f"{type(exc).__name__}: {exc}"))
+    return failures, PrefixReport(width ** depth, n_run, n_dedup,
+                                  len(seen))

@@ -557,10 +557,29 @@ def decode(buf: bytes, ref_of: Callable[[Addr], object]):
     """Deserialize one frame; ``ref_of(addr)`` resolves an address to a
     (possibly interned/local) ref object.
 
-    Serving frames (types 7-13) are version-checked and bounds-checked:
-    a hostile or cross-build peer surfaces as :class:`WireError` (which
-    the TCP router converts to a peer failure), never as a struct/numpy
-    exception from an arbitrary offset."""
+    EVERY malformed buffer surfaces as a :class:`WireError` subclass
+    (which the TCP router converts to a peer failure), never as a
+    struct/numpy/unicode exception from an arbitrary offset.  Serving
+    frames (types 7-13) are version-checked and bounds-checked with
+    readable messages; the containment wrapper below is the backstop
+    for what explicit checks miss — a bit-flipped type byte landing in
+    a training-plane branch, a corrupted length field, a reason string
+    that stopped being UTF-8 (the codec-fuzz suite in
+    tests/test_wire_serving_frames.py drives all three)."""
+    try:
+        return _decode_impl(buf, ref_of)
+    except WireError:
+        raise
+    except struct.error as exc:
+        raise TruncatedFrame(f"frame too short for its layout "
+                             f"({exc})") from exc
+    except (ValueError, IndexError, OverflowError,
+            UnicodeDecodeError) as exc:
+        raise WireError(f"malformed frame "
+                        f"({type(exc).__name__}: {exc})") from exc
+
+
+def _decode_impl(buf: bytes, ref_of: Callable[[Addr], object]):
     _need(buf, 0, 1, "message type byte")
     (mtype,) = struct.unpack_from("<B", buf, 0)
     off = 1

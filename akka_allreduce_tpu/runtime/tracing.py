@@ -103,6 +103,15 @@ class Tracer:
         self._append(ev)
         return ev
 
+    def record_transition(self, t: str, **fields: Any) -> TraceEvent:
+        """A fleet control-plane transition (graftcheck's dynamic
+        twin): one ``fleet_transition`` event whose ``t`` field names
+        a transition of analysis/fleet_model.py. The router,
+        supervisor, and replica proxies emit these at the code sites
+        the model maps; analysis/fleet_conform.py replays the log
+        against the model's guards."""
+        return self.record("fleet_transition", t=t, **fields)
+
     @contextmanager
     def span(self, kind: str, **fields: Any):
         """Time a block; records one event with ``duration_s`` on exit.

@@ -286,6 +286,13 @@ class ReplicaRouter:
                 return True
         return False
 
+    def _t(self, t: str, **fields) -> None:
+        """Emit one fleet control-plane transition (graftcheck's
+        conformance stream — analysis/fleet_conform.py replays these
+        against the model in analysis/fleet_model.py)."""
+        if self.tracer is not None:
+            self.tracer.record_transition(t, **fields)
+
     def _admit_hedges(self, req: Request, primary: int) -> None:
         """Dispatch up to ``th - 1`` hedge copies to healthy replicas
         beyond the primary — opportunistic: copies the fleet has no
@@ -306,6 +313,8 @@ class ReplicaRouter:
                 continue
             rep.engine.admit(req)
             self._bind(req.rid, rep.index)
+            self._t("dispatch", rid=req.rid, replica=rep.index,
+                    mode="hedge")
             placed += 1
         if placed and self.fleet_metrics is not None:
             self.fleet_metrics.on_hedge_dispatched(req.rid, placed)
@@ -319,6 +328,8 @@ class ReplicaRouter:
             rep = self.replicas[idx]
             n = rep.engine.cancel(rid)
             self._unbind(rid, idx)
+            self._t("cancel", rid=rid, replica=idx,
+                    waste=-1 if n is None else n)
             if self.fleet_metrics is not None:
                 self.fleet_metrics.on_hedge_cancelled(rid, idx, n or 0)
 
@@ -331,18 +342,25 @@ class ReplicaRouter:
                 if self._live_copies(rid):
                     # a sibling hedge copy is still decoding this
                     # request — the hedge IS the retry; no budget spent
+                    self._t("absorbed", rid=rid, replica=rep.index)
                     if self.fleet_metrics is not None:
                         self.fleet_metrics.on_hedge_absorbed(
                             rid, rep.index, reason)
-                elif self.scheduler.requeue_failed(req, reason) \
-                        and self.fleet_metrics is not None:
-                    self.fleet_metrics.on_retry(rid)
+                elif self.scheduler.requeue_failed(req, reason):
+                    self._t("retry", rid=rid, replica=rep.index)
+                    if self.fleet_metrics is not None:
+                        self.fleet_metrics.on_retry(rid)
+                else:
+                    # budget exhausted: the scheduler dead-lettered it
+                    # (the terminal record lands via drain_dropped)
+                    self._t("dead_letter", rid=rid, replica=rep.index)
                 continue
             if rid in results:
                 # a hedge copy finishing after the winner, same round
                 # (both stepped before routing cancelled it) — greedy
                 # decode is deterministic, so the tokens agree; the
                 # duplicate's work is hedge waste
+                self._t("dup", rid=rid, replica=rep.index)
                 if rep.metrics is not None:
                     rep.metrics.on_discard(rid, len(tokens))
                 if self.fleet_metrics is not None:
@@ -351,6 +369,8 @@ class ReplicaRouter:
                 continue
             results[rid] = (tokens, reason)
             self._req.pop(rid, None)
+            self._t("result", rid=rid, replica=rep.index,
+                    reason=reason)
             self._cancel_losers(rid, rep.index)
             if self.fleet_metrics is not None:
                 self.fleet_metrics.on_result(rid, reason)
@@ -388,6 +408,8 @@ class ReplicaRouter:
                 # identity failed_attempts == retries + dead_letters +
                 # hedge_absorbed must stay exact under preemption
                 n = len(rr.generated)
+                self._t("covered", rid=rr.req.rid, replica=rep.index,
+                        waste=n)
                 if rep.metrics is not None:
                     rep.metrics.on_discard(rr.req.rid, n)
                     rep.metrics.on_cancel(rr.req.rid)
@@ -395,9 +417,11 @@ class ReplicaRouter:
                     self.fleet_metrics.on_hedge_cancelled(
                         rr.req.rid, rep.index, n)
                 continue
+            self._t("snapshot", rid=rr.req.rid, replica=rep.index)
             pending_resume.append(rr)
             migrated += 1
         rep.retired = True
+        self._t("retire", replica=rep.index)
         if self.fleet_metrics is not None:
             self.fleet_metrics.on_retired(rep.index, migrated)
             self.fleet_metrics.on_fault_survived("preempt")
@@ -417,6 +441,7 @@ class ReplicaRouter:
         before its drain() wait can ever see snapshots — without it
         the collection loop would time out per replica and degrade
         every in-flight request to a zero-progress snapshot."""
+        self._t("fleet_drain")
         live = self._live()
         for rep in live:
             rep.engine.request_drain()
@@ -428,10 +453,29 @@ class ReplicaRouter:
                 # (the longest-progressed copy would do; they are
                 # identical by determinism — keep the first seen)
                 if not any(d.req.rid == rr.req.rid for d in self.drained):
+                    self._t("snapshot", rid=rr.req.rid,
+                            replica=rep.index)
                     self.drained.append(rr)
+                    continue
+                # the dropped duplicate's partial decode is hedge
+                # waste, same as _retire's covered-copy drop — found
+                # by graftcheck: without the charge, a fleet preempt
+                # under th=2 undercounts wasted_tokens by the loser
+                # snapshot's progress
+                n = len(rr.generated)
+                self._t("covered", rid=rr.req.rid, replica=rep.index,
+                        waste=n)
+                if rep.metrics is not None:
+                    rep.metrics.on_discard(rr.req.rid, n)
+                    rep.metrics.on_cancel(rr.req.rid)
+                if self.fleet_metrics is not None:
+                    self.fleet_metrics.on_hedge_cancelled(
+                        rr.req.rid, rep.index, n)
         for rr in pending_resume:
             if not any(d.req.rid == rr.req.rid for d in self.drained):
                 self.drained.append(rr)
+        for rr in self.drained:
+            self._t("park", rid=rr.req.rid)
         pending_resume.clear()
 
     # -- the round loop --------------------------------------------------
@@ -456,6 +500,10 @@ class ReplicaRouter:
             for req, reason in sched.drain_dropped():
                 results[req.rid] = ([], reason)
                 self._req.pop(req.rid, None)
+                if reason != "dead_letter":
+                    # dead letters already emitted their transition at
+                    # classification time (_route_completions)
+                    self._t("drop", rid=req.rid, reason=reason)
                 if fleet is not None:
                     fleet.on_drop(req.rid, reason)
                     fleet.on_result(req.rid, reason)
@@ -491,6 +539,8 @@ class ReplicaRouter:
             if not live:
                 # the whole fleet is gone: whatever work remains is a
                 # drain, not a loss — snapshots wait for the next fleet
+                for rr in pending_resume:
+                    self._t("park", rid=rr.req.rid)
                 self.drained.extend(pending_resume)
                 pending_resume = []
                 drain_drops()
@@ -511,6 +561,8 @@ class ReplicaRouter:
                     rr.req.submitted_at = now  # fresh clock domain
                 target.engine.restore(rr)
                 self._bind(rr.req.rid, target.index)
+                self._t("dispatch", rid=rr.req.rid,
+                        replica=target.index, mode="resume")
                 self._req[rr.req.rid] = rr.req
             # -- queue admission with hedging -------------------------
             while not resume_blocked and self._has_capacity():
@@ -529,6 +581,8 @@ class ReplicaRouter:
                     break
                 target.engine.admit(req)
                 self._bind(req.rid, target.index)
+                self._t("dispatch", rid=req.rid,
+                        replica=target.index, mode="primary")
                 self._req[req.rid] = req
                 self._admit_hedges(req, target.index)
             drain_drops()

@@ -296,6 +296,12 @@ class RemoteEngine:
 
     # -- frame intake (supervisor pump delivers here) -------------------
 
+    def _trace_t(self, t: str, **fields) -> None:
+        """Fleet control-plane transition (graftcheck conformance)."""
+        tracer = getattr(self._sup, "tracer", None)
+        if tracer is not None:
+            tracer.record_transition(t, **fields)
+
     def _on_frame(self, msg) -> None:
         if isinstance(msg, wire.CompletionFrame):
             self._completions.append(msg)
@@ -309,9 +315,15 @@ class RemoteEngine:
             self._drain_done = msg
             self._worker_draining = True
         elif isinstance(msg, wire.HealthFrame):
+            mirror = self._dispatch_base + msg.dispatches
+            if mirror != self.decode_dispatches:
+                # emit the RAW rebased value, before the max() below
+                # clamps it monotone — conformance checks that the
+                # incarnation re-anchor keeps it from regressing
+                self._trace_t("mirror", replica=self.index,
+                              value=mirror)
             self.decode_dispatches = max(
-                self.decode_dispatches,
-                self._dispatch_base + msg.dispatches)
+                self.decode_dispatches, mirror)
             self.remote_compiles = msg.compiles
             self.watchdog_trips = max(
                 self.watchdog_trips,
@@ -493,6 +505,9 @@ class RemoteEngine:
                 # discard count for a hedge loser — settle the fleet
                 # hedge-waste ledger, never route to the router
                 self._cancelled_rids.discard(frame.rid)
+                self._trace_t("cancel_ack", rid=frame.rid,
+                              replica=self.index, waste=frame.waste,
+                              orphan=0)
                 self._charge_cancel_waste(frame.rid, frame.waste)
                 continue
             req = self._inflight.pop(frame.rid, None)
@@ -504,6 +519,9 @@ class RemoteEngine:
                     # hedge waste too (the ack following it will
                     # carry waste=0). Before v3 these tokens vanished
                     # from every ledger.
+                    self._trace_t("cancel_ack", rid=frame.rid,
+                                  replica=self.index,
+                                  waste=len(frame.tokens), orphan=1)
                     self._charge_cancel_waste(frame.rid,
                                               len(frame.tokens))
                 continue
@@ -714,6 +732,8 @@ class ReplicaSupervisor:
             if self.tracer is not None:
                 self.tracer.record("replica_up", replica=i,
                                    pid=child.pid)
+                self.tracer.record_transition("restart", replica=i,
+                                              inc=child.restarts)
 
     def _on_msg(self, msg) -> None:
         if isinstance(msg, (wire.CompletionFrame, wire.HealthFrame,
@@ -762,6 +782,8 @@ class ReplicaSupervisor:
                 if self.tracer is not None:
                     self.tracer.record("replica_stopped",
                                        replica=child.index, rc=rc)
+                    self.tracer.record_transition(
+                        "stopped", replica=child.index)
                 continue
             # unexpected death: fail over + schedule restart
             engine._on_death()
@@ -771,8 +793,13 @@ class ReplicaSupervisor:
                 self.tracer.record("replica_died",
                                    replica=child.index,
                                    pid=child.pid, rc=rc)
+                self.tracer.record_transition(
+                    "death", replica=child.index)
             if not child.breaker.record():
                 child.state = BROKEN
+                if self.tracer is not None:
+                    self.tracer.record_transition(
+                        "breaker_open", replica=child.index)
                 if self.fleet is not None and hasattr(
                         self.fleet, "on_breaker_open"):
                     self.fleet.on_breaker_open(child.index)

@@ -1,0 +1,153 @@
+"""graftcheck — the fleet-plane model checker (analysis/fleet_check.py).
+
+Three kinds of pin live here:
+
+* the DEFAULT MATRIX is green and its visited-state counts sit inside
+  a tolerance band — a silent 10x growth (a transition added without
+  thinking about the cross product) or a silent 10x shrink (a guard
+  accidentally strangling reachability) both fail loudly;
+* every seeded protocol bug in the selfcheck fixture set is CAUGHT,
+  and its counterexample schedule REPLAYS deterministically to the
+  same invariant — the checker's sensitivity, pinned;
+* bound overflow is reported (never silent), and partial-order
+  reduction is an optimization, not a soundness lever: POR on/off
+  reach the same verdict on small bounds.
+"""
+
+import time
+
+import pytest
+
+from akka_allreduce_tpu.analysis import fleet_model as fm
+from akka_allreduce_tpu.analysis.fleet_check import (
+    check_default_bounds,
+    default_bounds_for,
+    explore,
+    replay,
+    run_fleet_plane,
+)
+from akka_allreduce_tpu.analysis.selfcheck import FLEET_FIXTURES
+
+# Pinned visited-state counts for the default lint matrix.  These move
+# ONLY when the model changes — and then the new count belongs in the
+# same commit, with the state-space delta argued in its message.
+PINNED_VISITED = {1: 165_521, 2: 53_579}
+TOLERANCE = 0.10  # +-10%: canonicalization tweaks, not silent blowups
+
+
+@pytest.fixture(scope="module")
+def matrix():
+    t0 = time.process_time()
+    results = check_default_bounds()
+    return results, time.process_time() - t0
+
+
+class TestDefaultMatrix:
+    def test_green_and_complete(self, matrix):
+        results, _ = matrix
+        for th, res in results.items():
+            assert res.violation is None, (
+                f"th={th}: {res.violation.invariant}: "
+                f"{res.violation.message}")
+            assert res.overflow is None, (
+                f"th={th}: overflow {res.overflow} at {res.visited} "
+                f"states — the default bounds no longer fit the budget")
+            assert res.quiescent > 0, f"th={th}: no quiescent states?"
+
+    @pytest.mark.parametrize("th", sorted(PINNED_VISITED))
+    def test_visited_count_pinned(self, matrix, th):
+        results, _ = matrix
+        pin = PINNED_VISITED[th]
+        got = results[th].visited
+        lo, hi = int(pin * (1 - TOLERANCE)), int(pin * (1 + TOLERANCE))
+        assert lo <= got <= hi, (
+            f"th={th}: visited {got} outside [{lo}, {hi}] (pin {pin}) "
+            f"— the model's state space moved; re-pin in the same "
+            f"commit with the delta argued")
+
+    def test_cpu_budget(self, matrix):
+        _, cpu = matrix
+        assert cpu < 60.0, (
+            f"default matrix took {cpu:.1f}s CPU — over the 60s lint "
+            f"budget; shrink bounds or strengthen dedup")
+
+    def test_plane_findings_report_counts(self, matrix):
+        del matrix  # ordering only: reuse warmed CPU, fresh run here
+        findings, names = run_fleet_plane(
+            bounds=fm.DEFAULT_BOUNDS._replace(
+                spares=0, fault_budget=1, requests=2),
+            th_values=(1,))
+        assert names == ["fleet:th=1"]
+        (f,) = findings
+        assert f.severity == "info"
+        assert "all invariants hold over" in f.message
+        assert "visited" in f.where
+
+
+class TestSeededBugs:
+    @pytest.mark.parametrize(
+        "name,bug,expect_inv,bkw",
+        [(n, b, e, k) for n, _, b, e, k in FLEET_FIXTURES],
+        ids=[n for n, *_ in FLEET_FIXTURES])
+    def test_bug_caught_and_replays(self, name, bug, expect_inv, bkw):
+        bounds = fm.DEFAULT_BOUNDS._replace(**bkw)
+        res = explore(bounds, bugs=frozenset({bug}))
+        assert res.violation is not None, (
+            f"{name}: checker is blind to seeded bug '{bug}'")
+        v = res.violation
+        assert v.invariant == expect_inv, (
+            f"{name}: caught as '{v.invariant}', pinned "
+            f"'{expect_inv}'")
+        # the counterexample is a first-class artifact: it must replay
+        _, bad = replay(bounds, v.schedule, bugs=frozenset({bug}))
+        assert any(inv == expect_inv for inv, _ in bad), (
+            f"{name}: pinned schedule no longer reproduces "
+            f"{expect_inv}: {bad}")
+
+    def test_clean_model_has_no_violation_at_fixture_bounds(self):
+        # the fixtures' shrunk bounds are themselves green without bugs
+        # (otherwise 'caught' would be vacuous)
+        for name, _, _, _, bkw in FLEET_FIXTURES:
+            bounds = fm.DEFAULT_BOUNDS._replace(**bkw)
+            res = explore(bounds)
+            assert res.violation is None, (
+                f"{name}: fixture bounds are not clean without the "
+                f"bug: {res.violation}")
+
+
+class TestBoundsAndSoundness:
+    def test_overflow_reported_never_silent(self):
+        res = explore(fm.DEFAULT_BOUNDS._replace(max_states=50))
+        assert res.overflow == "states"
+        findings, _ = run_fleet_plane(
+            bounds=fm.DEFAULT_BOUNDS._replace(max_states=50),
+            th_values=(1,))
+        (f,) = findings
+        assert f.severity == "error"
+        assert "INCOMPLETE" in f.message
+
+    def test_por_is_verdict_preserving(self):
+        # POR prunes interleavings, not reachable violations: on small
+        # bounds both modes agree on the verdict, clean and buggy
+        small = fm.DEFAULT_BOUNDS._replace(
+            replicas=2, spares=0, requests=2, fault_budget=1,
+            max_states=200_000)
+        a = explore(small, por=True)
+        b = explore(small, por=False)
+        assert (a.violation is None) == (b.violation is None)
+        assert a.quiescent == b.quiescent  # same terminal behaviors
+        assert a.visited <= b.visited  # POR only ever prunes
+
+        bug = frozenset({"restart_no_inc_bump"})
+        a = explore(small, bugs=bug, por=True)
+        b = explore(small, bugs=bug, por=False)
+        assert a.violation is not None and b.violation is not None
+        assert a.violation.invariant == b.violation.invariant
+
+    def test_replay_rejects_drifted_schedule(self):
+        bounds = fm.DEFAULT_BOUNDS._replace(
+            spares=0, fault_budget=1, requests=2)
+        with pytest.raises(AssertionError, match="not enabled"):
+            # a schedule whose first step can't fire from the initial
+            # state: completing a request that was never dispatched
+            replay(bounds, (("complete", 0, 0),))

@@ -1,0 +1,219 @@
+"""Trace conformance (analysis/fleet_conform.py) — the dynamic twin.
+
+The ConformanceChecker replays real fleet_transition logs against the
+abstract model's guards.  These tests pin its sensitivity from both
+sides with synthetic traces: every legal life-cycle passes, and every
+guard the model checker proves over the abstract fleet (one terminal,
+no dispatch-after-terminal, incarnation bumps, breaker finality,
+mirror monotonicity, no lost rids) rejects the corresponding illegal
+trace.  The checker must not drift lenient — a conformance harness
+that accepts everything certifies nothing.
+"""
+
+import pytest
+
+from akka_allreduce_tpu.analysis.fleet_conform import (
+    ConformanceChecker,
+    assert_conformant,
+    check_events,
+)
+from akka_allreduce_tpu.runtime.tracing import Tracer
+
+
+def D(t, **kw):
+    return dict(t=t, **kw)
+
+
+class TestLegalTraces:
+    def test_primary_lifecycle(self):
+        assert check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("result", rid=1, replica=0),
+        ]) == []
+
+    def test_hedge_cancel_with_deferred_ack(self):
+        assert check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("dispatch", rid=1, replica=1, mode="hedge"),
+            D("result", rid=1, replica=0),
+            D("cancel", rid=1, replica=1, waste=-1),
+            D("cancel_ack", rid=1, replica=1),
+        ]) == []
+
+    def test_orphan_completion_after_cancel(self):
+        assert check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("dispatch", rid=1, replica=1, mode="hedge"),
+            D("result", rid=1, replica=0),
+            D("cancel", rid=1, replica=1, waste=-1),
+            D("cancel_ack", rid=1, replica=1, orphan=True),
+        ]) == []
+
+    def test_retry_then_dead_letter(self):
+        assert check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("retry", rid=1, replica=0),
+            D("dispatch", rid=1, replica=1, mode="primary"),
+            D("dead_letter", rid=1, replica=1),
+        ]) == []
+
+    def test_absorbed_by_live_hedge_sibling(self):
+        assert check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("dispatch", rid=1, replica=1, mode="hedge"),
+            D("absorbed", rid=1, replica=0),
+            D("result", rid=1, replica=1),
+        ]) == []
+
+    def test_drain_snapshot_park_resume(self):
+        assert check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("fleet_drain"),
+            D("snapshot", rid=1, replica=0),
+            D("park", rid=1),
+            D("dispatch", rid=1, replica=1, mode="resume"),
+            D("result", rid=1, replica=1),
+        ]) == []
+
+    def test_death_restart_with_inc_bump(self):
+        assert check_events([
+            D("death", replica=0),
+            D("restart", replica=0, inc=1),
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("result", rid=1, replica=0),
+            D("death", replica=0),
+            D("restart", replica=0, inc=2),
+        ]) == []
+
+    def test_mirror_monotone_and_parked_end_state(self):
+        # a rid may legally end the trace parked (persistence path)
+        assert check_events([
+            D("mirror", replica=0, value=1),
+            D("mirror", replica=0, value=3),
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("fleet_drain"),
+            D("snapshot", rid=1, replica=0),
+            D("park", rid=1),
+        ]) == []
+
+
+class TestIllegalTraces:
+    @pytest.mark.parametrize("events,needle", [
+        # the one-terminal invariant, both orders
+        ([D("dispatch", rid=1, replica=0, mode="primary"),
+          D("result", rid=1, replica=0),
+          D("dispatch", rid=1, replica=1, mode="primary"),
+          D("result", rid=1, replica=1)], "second terminal"),
+        ([D("dispatch", rid=1, replica=0, mode="primary"),
+          D("result", rid=1, replica=0),
+          D("dispatch", rid=1, replica=1, mode="primary")],
+         "after its terminal"),
+        # hedging guards
+        ([D("dispatch", rid=1, replica=1, mode="hedge")],
+         "no primary copy"),
+        ([D("dispatch", rid=1, replica=0, mode="primary"),
+          D("absorbed", rid=1, replica=0)], "no live hedge sibling"),
+        # restart discipline
+        ([D("death", replica=0), D("restart", replica=0, inc=1),
+          D("death", replica=0), D("restart", replica=0, inc=1)],
+         "incarnation bump"),
+        ([D("breaker_open", replica=0), D("restart", replica=0, inc=5)],
+         "after its breaker opened"),
+        # dispatch to a dead replica
+        ([D("death", replica=0),
+          D("dispatch", rid=1, replica=0, mode="primary")],
+         "in state dead"),
+        # mirror regression
+        ([D("mirror", replica=0, value=5),
+          D("mirror", replica=0, value=4)], "regressed"),
+        # cancel-plane lies
+        ([D("cancel_ack", rid=1, replica=0)], "unsolicited"),
+        ([D("dispatch", rid=1, replica=0, mode="primary"),
+          D("cancel", rid=1, replica=0, waste=0)],
+         "before any terminal"),
+        # drain-plane lies
+        ([D("park", rid=7)], "without a drain snapshot"),
+        ([D("dispatch", rid=1, replica=0, mode="primary"),
+          D("covered", rid=1, replica=0)], "no live sibling"),
+        # a rid that simply vanishes
+        ([D("dispatch", rid=1, replica=0, mode="primary")], "lost"),
+    ], ids=["double-terminal", "dispatch-after-terminal",
+            "hedge-no-primary", "absorbed-no-sibling", "no-inc-bump",
+            "restart-after-breaker", "dispatch-to-dead",
+            "mirror-regression", "unsolicited-ack",
+            "cancel-before-terminal", "park-no-snapshot",
+            "covered-no-sibling", "lost-rid"])
+    def test_guard_rejects(self, events, needle):
+        bad = check_events(events)
+        assert bad, f"checker accepted an illegal trace ({needle})"
+        assert any(needle in v for v in bad), bad
+
+    def test_violations_carry_event_index(self):
+        bad = check_events([D("mirror", replica=0, value=5),
+                            D("mirror", replica=0, value=4)])
+        assert bad[0].startswith("event 2:")
+
+
+class TestAssertConformant:
+    def test_none_tracer_is_noop(self):
+        assert_conformant(None)
+
+    def test_tracer_roundtrip(self):
+        tr = Tracer()
+        tr.record_transition("dispatch", rid=1, replica=0,
+                             mode="primary")
+        tr.record_transition("result", rid=1, replica=0)
+        assert_conformant(tr)
+
+    def test_raises_with_readable_report(self):
+        tr = Tracer()
+        tr.record_transition("dispatch", rid=1, replica=0,
+                             mode="primary")
+        tr.record_transition("result", rid=1, replica=0)
+        tr.record_transition("result", rid=1, replica=0)
+        with pytest.raises(AssertionError,
+                           match=r"(?s)does not conform.*second terminal"):
+            assert_conformant(tr)
+
+    def test_non_fleet_events_are_ignored(self):
+        tr = Tracer()
+        tr.record("router_replica_retired", replica=0, migrated=2)
+        tr.record_transition("dispatch", rid=1, replica=0,
+                             mode="primary")
+        tr.record_transition("result", rid=1, replica=0)
+        assert_conformant(tr)
+
+
+class TestDrainFleetWasteRegression:
+    """The true finding this PR's model checker surfaced, pinned at
+    the trace level: a fleet drain that collapses a hedged rid's two
+    snapshots to one must CHARGE the dropped duplicate as hedge waste
+    (a ``covered`` event carrying its progress), not silently drop it
+    — the counterexample was a th=2 preempt where wasted_tokens
+    undercounted by the loser snapshot's decode."""
+
+    def test_duplicate_snapshot_is_covered_not_lost(self):
+        # the exact event shape router._drain_fleet now emits: the
+        # first snapshot parks, the duplicate is a covered-drop
+        assert check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("dispatch", rid=1, replica=1, mode="hedge"),
+            D("fleet_drain"),
+            D("snapshot", rid=1, replica=0),
+            D("covered", rid=1, replica=1, waste=3),
+            D("park", rid=1),
+        ]) == []
+
+    def test_covered_drop_needs_a_justification(self):
+        # a covered-drop must point at SOMETHING that owns the work —
+        # a live sibling, an accepted snapshot, or a terminal; a
+        # duplicate covered before its sibling's snapshot landed is
+        # the event-order lie the guard rejects
+        bad = check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("dispatch", rid=1, replica=1, mode="hedge"),
+            D("fleet_drain"),
+            D("covered", rid=1, replica=0, waste=3),
+            D("covered", rid=1, replica=1, waste=3),
+        ])
+        assert any("no live sibling" in v for v in bad), bad

@@ -36,7 +36,9 @@ from akka_allreduce_tpu.protocol.wire import (
     frame_to_request,
     request_to_frame,
 )
+from akka_allreduce_tpu.analysis.fleet_conform import assert_conformant
 from akka_allreduce_tpu.runtime.faults import FaultPlan, FaultPoint
+from akka_allreduce_tpu.runtime.tracing import Tracer
 from akka_allreduce_tpu.serving import (
     EngineConfig,
     FleetMetrics,
@@ -92,7 +94,7 @@ def build_fleet(params, s=1, th=1, max_lag=2, replicas=REPLICAS,
     fleet = FleetMetrics(replicas)
     router = ReplicaRouter(engines, sched,
                            RouterConfig(th=th, max_lag=max_lag),
-                           fleet=fleet)
+                           fleet=fleet, tracer=Tracer())
     return router, sched, fleet
 
 
@@ -103,6 +105,9 @@ def run_fleet(router, sched, fleet, reqs, plan=None, max_rounds=3000):
     ctx = plan.armed() if plan is not None else contextlib.nullcontext()
     with ctx:
         results = router.run(max_rounds=max_rounds)
+    # graftcheck's dynamic twin: every chaos-matrix run's transition
+    # trace must conform to the control-plane model's guards
+    assert_conformant(router.tracer)
     return results
 
 
@@ -506,6 +511,36 @@ class TestFleetDrain:
         for rid, (toks, reason) in baselines[1].items():
             assert list(results[rid][0]) == list(toks), f"rid={rid}"
             assert results[rid][1] == reason
+
+    def test_fleet_preempt_charges_duplicate_hedge_snapshots(
+            self, params, baselines):
+        """graftcheck's true finding, pinned on the REAL router: when
+        a fleet drain collapses a hedged rid's copies to one snapshot,
+        the dropped duplicate's partial decode is CHARGED as hedge
+        waste (a ``covered`` transition carrying its progress) — the
+        pre-fix router dropped it silently, undercounting
+        wasted_tokens by the loser snapshot's decode."""
+        from akka_allreduce_tpu.analysis.fleet_conform import (
+            fleet_transitions,
+        )
+        router, sched, fleet = build_fleet(params, th=2, watchdog=None)
+        plan = FaultPlan([FaultPoint("router.loop", "preempt", hit=4)])
+        run_fleet(router, sched, fleet, make_requests(), plan=plan)
+        assert router.draining and router.drained
+        # exactly one snapshot per rid survives the collapse
+        rids = [d.req.rid for d in router.drained]
+        assert len(rids) == len(set(rids)), rids
+        # every duplicate shows up as a covered-drop AFTER fleet_drain
+        evs = fleet_transitions(router.tracer)
+        cut = next(i for i, ev in enumerate(evs)
+                   if ev["t"] == "fleet_drain")
+        covered = [ev for ev in evs[cut:] if ev["t"] == "covered"]
+        assert covered, "th=2 preempt produced no duplicate snapshots"
+        dup_waste = sum(ev["waste"] for ev in covered)
+        # ... and its progress landed in the hedge-waste ledger
+        s = fleet.summary()
+        assert s["hedge"]["wasted_tokens"] >= dup_waste > 0
+        assert s["tokens"]["wasted"] >= s["hedge"]["wasted_tokens"]
 
 
 # -- fleet metrics --------------------------------------------------------

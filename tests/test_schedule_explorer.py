@@ -25,10 +25,12 @@ from akka_allreduce_tpu.protocol.explorer import (
     ScheduleFailure,
     exhaustive_prefixes,
     explore,
+    explore_exhaustive,
     prefix_schedule,
     random_schedule,
     standard_schedules,
     starvation_schedule,
+    state_digest,
 )
 
 
@@ -327,3 +329,61 @@ class TestScheduleMachinery:
             lambda cluster: (_ for _ in ()).throw(AssertionError("boom")))
         assert failures == [ScheduleFailure("random:seed0",
                                             "AssertionError: boom")]
+
+
+class TestExhaustiveDedup:
+    """explore_exhaustive: the canonical-state dedup must check the SAME
+    reachable behaviors as naive prefix enumeration while running a tiny
+    fraction of the leaves — and the report must account for everything
+    (prunes and runs are counted, never silent)."""
+
+    def test_dedup_matches_naive_enumeration(self):
+        n, ds, rounds = 2, 4, 2
+        depth, width = 7, 3
+
+        naive_outputs = {}
+        naive_failures = explore(
+            lambda: make_exact_cluster(naive_outputs, n, ds, rounds),
+            exhaustive_prefixes(depth=depth, width=width),
+            exact_validator(naive_outputs, n, ds, rounds))
+
+        outputs = {}
+        failures, report = explore_exhaustive(
+            lambda: make_exact_cluster(outputs, n, ds, rounds),
+            exact_validator(outputs, n, ds, rounds),
+            depth=depth, width=width)
+
+        # same verdict as the naive sweep over the same prefix space
+        assert bool(failures) == bool(naive_failures)
+        assert not failures, "\n".join(map(str, failures[:5]))
+        assert report.prefixes_total == width ** depth
+        # the dedup's whole point: run a small fraction of the leaves
+        assert report.prefixes_run < report.prefixes_total // 10, report
+        assert report.prefixes_deduped > 0, report
+        assert report.visited_states > 0, report
+
+    def test_dedup_still_surfaces_failures(self):
+        # an always-failing validator must not be pruned into silence
+        outputs = {}
+        failures, report = explore_exhaustive(
+            lambda: make_exact_cluster(outputs, 2, 4, 1),
+            lambda cluster: (_ for _ in ()).throw(AssertionError("boom")),
+            depth=2, width=2)
+        assert failures, report
+        assert all("AssertionError: boom" in f.error for f in failures)
+
+    def test_digest_distinguishes_protocol_state(self):
+        # same cluster config, different delivered prefixes -> digests
+        # split once the interleavings genuinely diverge
+        outputs = {}
+        c1 = make_exact_cluster(outputs, 2, 4, 1)
+        c1.start()
+        d_start = state_digest(c1)
+        c1.router.pump_scheduled(prefix_schedule((0,)), max_messages=3,
+                                 strict=False)
+        assert state_digest(c1) != d_start
+
+        outputs2 = {}
+        c2 = make_exact_cluster(outputs2, 2, 4, 1)
+        c2.start()
+        assert state_digest(c2) == d_start  # fresh clusters canonicalize

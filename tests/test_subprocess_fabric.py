@@ -32,10 +32,12 @@ from akka_allreduce_tpu.models.transformer import (
     TransformerConfig,
     init_transformer,
 )
+from akka_allreduce_tpu.analysis.fleet_conform import assert_conformant
 from akka_allreduce_tpu.runtime.faults import (
     ProcessChaosPlan,
     ProcessFaultPoint,
 )
+from akka_allreduce_tpu.runtime.tracing import Tracer
 from akka_allreduce_tpu.serving import (
     BackoffPolicy,
     EngineConfig,
@@ -91,13 +93,14 @@ def run_fleet(chaos=None, th=1, max_lag=3, policy="fifo",
               backoff=None, budget=None, replicas=REPLICAS,
               after_run=None):
     fleet = FleetMetrics(replicas)
+    tracer = Tracer()
     with ReplicaSupervisor(
             SPEC, replicas=replicas,
             backoff=backoff or BackoffPolicy(base_s=0.2, cap_s=1.0,
                                              seed=7),
             budget=budget or RestartBudget(max_restarts=4,
                                            window_s=60.0),
-            fleet=fleet, chaos=chaos,
+            fleet=fleet, chaos=chaos, tracer=tracer,
             spawn_timeout_s=300.0) as sup:
         sched = RequestScheduler(
             SchedulerConfig(policy=policy,
@@ -106,13 +109,17 @@ def run_fleet(chaos=None, th=1, max_lag=3, policy="fifo",
             num_slots=replicas * SLOTS)
         router = ReplicaRouter(
             sup.engines, sched,
-            RouterConfig(th=th, max_lag=max_lag), fleet=fleet)
+            RouterConfig(th=th, max_lag=max_lag), fleet=fleet,
+            tracer=tracer)
         for r in make_requests():
             fleet.on_submit(r.rid)
             sched.submit(r)
         results = router.run(max_rounds=30000)
         extra = after_run(sup, router) if after_run is not None \
             else None
+    # graftcheck's dynamic twin: the whole run — spawns, kills,
+    # failover, restarts included — must conform to the model
+    assert_conformant(tracer)
     return results, fleet, router, extra
 
 

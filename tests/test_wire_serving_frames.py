@@ -187,3 +187,144 @@ class TestHostileFrames:
     def test_empty_frame(self):
         with pytest.raises(TruncatedFrame):
             decode(b"", None)
+
+
+# -- seeded codec fuzz (graftcheck PR): the hostile-peer containment ----
+#
+# Property, not examples: for EVERY v3 serving frame type, across six
+# seeds of randomized field values — (1) the round trip is BYTE-exact
+# (decode(encode(f)) re-encodes to the identical buffer), (2) every
+# strict prefix raises TruncatedFrame, (3) any single corrupted byte
+# and any lying length field raises a WireError subclass with a
+# readable message — never a raw struct/numpy/unicode exception from
+# an arbitrary offset, because protocol/tcp.py turns WireError into a
+# dead peer and anything else into a codec traceback in the router.
+
+
+def _fuzz_frames(rng):
+    """One randomized instance of every v3 serving frame type."""
+    def toks(n):
+        return tuple(int(x) for x in rng.integers(0, 2**31 - 1, size=n))
+    return [
+        SubmitFrame(
+            rid=int(rng.integers(0, 2**62)),
+            prompt=toks(int(rng.integers(1, 33))),
+            max_new_tokens=int(rng.integers(1, 512)),
+            eos_token=(None if rng.random() < 0.5
+                       else int(rng.integers(0, 1000))),
+            stop_tokens=toks(int(rng.integers(0, 5))),
+            deadline=(None if rng.random() < 0.5
+                      else float(rng.random() * 100)),
+            attempts=int(rng.integers(0, 5)),
+            seed=(None if rng.random() < 0.5
+                  else int(rng.integers(0, 2**31)))),
+        CompletionFrame(
+            rid=int(rng.integers(0, 2**62)),
+            tokens=toks(int(rng.integers(0, 64))),
+            reason=str(rng.choice(["eos", "stop", "max_tokens",
+                                   "cancelled", "fault"])),
+            replica=int(rng.integers(-1, 8)),
+            waste=int(rng.integers(0, 100))),
+        HealthFrame(
+            replica=int(rng.integers(0, 8)),
+            occupied=int(rng.integers(0, 16)),
+            free_slots=int(rng.integers(0, 16)),
+            dispatches=int(rng.integers(0, 2**40)),
+            compiles=int(rng.integers(0, 1000)),
+            draining=bool(rng.random() < 0.5),
+            watchdog_trips=int(rng.integers(0, 10)),
+            evictions=int(rng.integers(0, 10)),
+            prefill_programs=int(rng.integers(0, 50)),
+            cancelled_tokens=int(rng.integers(0, 2**40))),
+        DrainFrame(),
+        CancelFrame(rid=int(rng.integers(0, 2**62))),
+        ResumeFrame(
+            rid=int(rng.integers(0, 2**62)),
+            prompt=toks(int(rng.integers(1, 33))),
+            max_new_tokens=int(rng.integers(1, 512)),
+            generated=toks(int(rng.integers(0, 32))),
+            eos_token=(None if rng.random() < 0.5
+                       else int(rng.integers(0, 1000))),
+            stop_tokens=toks(int(rng.integers(0, 5))),
+            deadline=(None if rng.random() < 0.5
+                      else float(rng.random() * 100)),
+            attempts=int(rng.integers(0, 5)),
+            seed=(None if rng.random() < 0.5
+                  else int(rng.integers(0, 2**31))),
+            replica=int(rng.integers(-1, 8))),
+        DrainDoneFrame(replica=int(rng.integers(0, 8)),
+                       migrated=int(rng.integers(0, 64))),
+    ]
+
+
+FUZZ_SEEDS = (0, 1, 2, 3, 4, 5)
+
+
+class TestCodecFuzz:
+    @pytest.mark.parametrize("seed", FUZZ_SEEDS)
+    def test_roundtrip_byte_exact(self, seed):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        for frame in _fuzz_frames(rng):
+            buf = encode(frame, None)
+            back = decode(buf, None)
+            assert back == frame, frame
+            assert encode(back, None) == buf, (
+                f"{type(frame).__name__}: re-encode is not byte-exact")
+
+    @pytest.mark.parametrize("seed", FUZZ_SEEDS)
+    def test_every_truncation_raises_truncated(self, seed):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        for frame in _fuzz_frames(rng):
+            buf = encode(frame, None)
+            for cut in range(len(buf)):
+                try:
+                    with pytest.raises(TruncatedFrame):
+                        decode(buf[:cut], None)
+                except BaseException:
+                    print(f"{type(frame).__name__} cut at {cut}/"
+                          f"{len(buf)}")
+                    raise
+
+    @pytest.mark.parametrize("seed", FUZZ_SEEDS)
+    def test_bit_flips_never_escape_raw(self, seed):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        for frame in _fuzz_frames(rng):
+            buf = bytearray(encode(frame, None))
+            for _ in range(min(4 * len(buf), 256)):
+                pos = int(rng.integers(0, len(buf)))
+                bit = 1 << int(rng.integers(0, 8))
+                mut = bytearray(buf)
+                mut[pos] ^= bit
+                try:
+                    decode(bytes(mut), None)  # a legal mutation is fine
+                except WireError:
+                    pass  # the contract: WireError, with a message
+                except BaseException as exc:  # pragma: no cover
+                    raise AssertionError(
+                        f"{type(frame).__name__}: flipping bit "
+                        f"{bit:#x} at byte {pos} escaped as "
+                        f"{type(exc).__name__}: {exc}") from exc
+
+    @pytest.mark.parametrize("seed", FUZZ_SEEDS)
+    def test_lying_length_fields_raise_wire_errors(self, seed):
+        # corrupt every byte to 0xFF one at a time — covers every
+        # length/count field with the nastiest value its width allows
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        for frame in _fuzz_frames(rng):
+            buf = bytearray(encode(frame, None))
+            for pos in range(len(buf)):
+                mut = bytearray(buf)
+                mut[pos] = 0xFF
+                try:
+                    decode(bytes(mut), None)
+                except WireError:
+                    pass
+                except BaseException as exc:  # pragma: no cover
+                    raise AssertionError(
+                        f"{type(frame).__name__}: byte {pos}=0xFF "
+                        f"escaped as {type(exc).__name__}: "
+                        f"{exc}") from exc
