@@ -40,6 +40,21 @@ Event vocabulary (field ``t``):
 ``mirror``       proxy's monotonic dispatch-mirror value
 ``retire``       router retired a draining replica
 ``fleet_drain``  router began draining the whole fleet
+``join``         member joined the ranking UNRANKED (scale-out /
+                 router.add_replica)
+``re_rank``      an unranked member earned its rank (first ready
+                 round — no dispatch may precede this)
+``scale_in``     voluntary retire announced (supervisor
+                 retire_replica / in-process autoscaler drain)
+``rollout_started``    rolling weight rollout began (``version``)
+``rollout_drain``      rollout took ``replica`` out of rotation
+``rollout_readmit``    rolled replica re-entered after its parity
+                       probe (``version`` must equal the rollout's,
+                       ``inc`` must exceed the pre-drain incarnation
+                       — the old checkpoint can never be readmitted)
+``rollout_completed``  every pending replica readmitted
+``rollout_aborted``    rollout gave up (stall / probe failure /
+                       breaker) — the mid-roll replica stays out
 ===============  ====================================================
 """
 
@@ -60,6 +75,10 @@ class ConformanceChecker:
         self._cancel_hist = {}   # replica -> rids ever cancelled there
         self._resumable = set()
         self._parked = set()
+        self._unranked = set()   # members in the fleet, not in the ranking
+        self._rollout = None     # (version,) while a rollout is active
+        self._rolling = None     # replica currently out for the rollout
+        self._roll_pre_inc = None  # its incarnation at rollout_drain
         self._n = 0
 
     def _fail(self, msg):
@@ -89,6 +108,9 @@ class ConformanceChecker:
             if self._alive.get(rep, "up") != "up":
                 self._fail(f"dispatch of rid={rid} to replica {rep} "
                            f"in state {self._alive[rep]}")
+            if rep in self._unranked:
+                self._fail(f"dispatch of rid={rid} to UNRANKED "
+                           f"replica {rep} (membership gate bypassed)")
             if rep in copies:
                 self._fail(f"rid={rid} placed twice on replica {rep}")
             if mode == "hedge" and not copies:
@@ -196,6 +218,70 @@ class ConformanceChecker:
                 self._mirror[rep] = v
         elif t == "fleet_drain":
             pass
+        elif t == "join":
+            if rep in self._alive and self._alive[rep] == "up":
+                self._fail(f"join of replica {rep} which is already "
+                           f"an up member")
+            self._alive[rep] = "up"
+            self._unranked.add(rep)
+        elif t == "re_rank":
+            if rep not in self._unranked:
+                self._fail(f"re-rank of replica {rep} which is not "
+                           f"unranked")
+            if self._rolling == rep:
+                self._fail(f"re-rank of replica {rep} while it is "
+                           f"mid-rollout (before rollout_readmit)")
+            self._unranked.discard(rep)
+        elif t == "scale_in":
+            if self._alive.get(rep, "up") != "up":
+                self._fail(f"scale-in of replica {rep} in state "
+                           f"{self._alive[rep]}")
+        elif t == "rollout_started":
+            if self._rollout is not None:
+                self._fail("rollout started while another rollout "
+                           "is active")
+            self._rollout = (ev.get("version"),)
+        elif t == "rollout_drain":
+            if self._rollout is None:
+                self._fail(f"rollout_drain of replica {rep} with no "
+                           f"active rollout")
+            if self._rolling is not None:
+                self._fail(f"rollout_drain of replica {rep} while "
+                           f"replica {self._rolling} is still out — "
+                           f"more than one member out of rotation")
+            self._rolling = rep
+            self._roll_pre_inc = self._inc.get(rep, 0)
+            self._unranked.add(rep)
+        elif t == "rollout_readmit":
+            if self._rolling != rep:
+                self._fail(f"rollout_readmit of replica {rep} which "
+                           f"is not the mid-roll replica "
+                           f"({self._rolling})")
+            if self._rollout is not None \
+                    and ev.get("version") != self._rollout[0]:
+                self._fail(
+                    f"rollout_readmit of replica {rep} at version "
+                    f"{ev.get('version')} != rollout target "
+                    f"{self._rollout[0]} — an old checkpoint was "
+                    f"readmitted")
+            inc = ev.get("inc", 0)
+            if self._roll_pre_inc is not None \
+                    and inc <= self._roll_pre_inc:
+                self._fail(
+                    f"rollout_readmit of replica {rep} on incarnation "
+                    f"{inc} <= pre-drain {self._roll_pre_inc} — the "
+                    f"old process was readmitted")
+            self._rolling = None
+            self._roll_pre_inc = None
+        elif t == "rollout_completed" or t == "rollout_aborted":
+            if self._rollout is None:
+                self._fail(f"{t} with no active rollout")
+            if t == "rollout_completed" and self._rolling is not None:
+                self._fail(f"rollout completed while replica "
+                           f"{self._rolling} is still out of rotation")
+            self._rollout = None
+            self._rolling = None
+            self._roll_pre_inc = None
         else:
             self._fail(f"unknown fleet transition {t!r}")
 
@@ -205,6 +291,10 @@ class ConformanceChecker:
                     and rid not in self._resumable:
                 self._fail(f"rid={rid} ended the trace neither "
                            f"terminal nor parked (lost)")
+        if self._rollout is not None:
+            self._fail(f"trace ended with a rollout still active "
+                       f"(version {self._rollout[0]}) — neither "
+                       f"completed nor aborted (stuck rollout)")
         return self.violations
 
 

@@ -97,6 +97,8 @@ State = namedtuple("FleetState", [
     "bound",           # tuple[tuple[int,...]] per rid: replicas holding a copy
     "status",          # tuple[str] per replica
     "ranked",          # tuple[int] per replica: in the dispatch ranking
+    "rolling",         # tuple[int] per replica: mid-rollout (drained, not yet probed)
+    "ckpt",            # tuple[int] per replica: 0 = old weights, 1 = rollout target
     "deaths",          # tuple[int] per replica
     "inc",             # tuple[int] per replica: incarnation counter
     "wdisp",           # tuple[int] per replica: worker dispatch counter
@@ -126,6 +128,7 @@ def initial_state(bounds):
         bound=((),) * bounds.requests,
         status=(UP,) * bounds.replicas + (SPARE,) * bounds.spares,
         ranked=(1,) * bounds.replicas + (0,) * bounds.spares,
+        rolling=(0,) * n, ckpt=(0,) * n,
         deaths=(0,) * n, inc=(0,) * n, wdisp=(0,) * n,
         base=(0,) * n, observed=(0,) * n,
         worker=((),) * n, cancelled=((),) * n,
@@ -242,11 +245,41 @@ def enabled(s, b, bugs=frozenset()):
         if (st == SPARE and s.faults < b.fault_budget
                 and not s.fleet_draining):
             ts.append(("join", i))
-    if any(s.status[i] == UP and not s.ranked[i] for i in range(n)):
+    if any(s.status[i] == UP and not s.ranked[i] and not s.rolling[i]
+           for i in range(n)):
         ts.append(("re_rank",))
     if (not s.fleet_draining and s.faults < b.fault_budget
             and any(st == UP for st in s.status)):
         ts.append(("fleet_drain",))
+    # -- elastic membership + rolling rollouts (ISSUE 20) ---------------
+    # Victim choice is DETERMINISTIC, mirroring the code: scale_to
+    # retires the HIGHEST-index live member; pump_rollout rolls the
+    # pending list in ascending index order, one at a time.
+    up_ranked = [i for i in range(n)
+                 if s.status[i] == UP and s.ranked[i]]
+    any_rolling = any(s.rolling)
+    if (len(up_ranked) >= 2 and s.faults < b.fault_budget
+            and not s.fleet_draining and not any_rolling):
+        ts.append(("scale_in", up_ranked[-1]))
+    if (not any_rolling and s.faults < b.fault_budget
+            and not s.fleet_draining):
+        for i in range(n):
+            if (s.status[i] == UP and not s.ckpt[i]
+                    and any(j != i for j in up_ranked)):
+                ts.append(("rollout_drain", i))
+                break
+    if not s.fleet_draining:
+        for i in range(n):
+            if not s.rolling[i]:
+                continue
+            # respawn only after the router consumed the whole drain
+            # stream (snapshots + DrainDone): pump_rollout's drain
+            # phase waits for router-retired before _spawn — an
+            # earlier respawn would orphan the dd reconciliation
+            if s.status[i] == STOPPED and not s.chan_up[i]:
+                ts.append(("rollout_up", i))
+            elif s.status[i] == UP and s.ckpt[i] and not s.worker[i]:
+                ts.append(("rollout_probe", i))
     return ts
 
 
@@ -477,11 +510,16 @@ def apply(s, t, b, bugs=frozenset()):
             # base is not re-anchored, the incarnation not bumped
             return s._replace(status=_tset(s.status, i, UP),
                               wdisp=_tset(s.wdisp, i, 0), flags=flags)
+        # a crash restart MID-ROLLOUT builds the rollout spec (the
+        # supervisor swapped child.spec before the drain — the old
+        # checkpoint is unreachable from any respawn path); a crash
+        # restart of a non-rolled replica keeps its current weights
+        ck = _tset(s.ckpt, i, 1) if s.rolling[i] else s.ckpt
         return s._replace(status=_tset(s.status, i, UP),
                           inc=_tset(s.inc, i, s.inc[i] + 1),
                           wdisp=_tset(s.wdisp, i, 0),
                           base=_tset(s.base, i, s.observed[i]),
-                          flags=flags)
+                          ckpt=ck, flags=flags)
     if k == "breaker":
         return s._replace(status=_tset(s.status, t[1], BROKEN))
     if k == "preempt":
@@ -503,9 +541,47 @@ def apply(s, t, b, bugs=frozenset()):
                           ranked=_tset(s.ranked, i, 0),
                           faults=s.faults + 1)
     if k == "re_rank":
-        ranked = tuple(1 if s.status[i] == UP else s.ranked[i]
-                       for i in range(len(s.status)))
+        # a mid-rollout replica is NOT re-ranked even while UP: its
+        # router handle stays retired until the parity probe passes
+        # (rollout_probe is the only path back to ranked for it)
+        ranked = tuple(
+            1 if s.status[i] == UP and not s.rolling[i]
+            else s.ranked[i] for i in range(len(s.status)))
         return s._replace(ranked=ranked)
+    if k == "scale_in":
+        # ReplicaSupervisor.retire_replica: voluntary decommission is
+        # the SIGTERM drain path — in-flight work migrates exactly as
+        # a preemption's does; the member never restarts (STOPPED)
+        i = t[1]
+        return _preempt_effects(
+            s._replace(faults=s.faults + 1,
+                       ranked=_tset(s.ranked, i, 0)), i)
+    if k == "rollout_drain":
+        # ReplicaSupervisor.pump_rollout phase "drain": spec swapped,
+        # member drained out of the ranking — same migration path as
+        # scale_in, but the member is coming back
+        i = t[1]
+        return _preempt_effects(
+            s._replace(faults=s.faults + 1,
+                       rolling=_tset(s.rolling, i, 1),
+                       ranked=_tset(s.ranked, i, 0)), i)
+    if k == "rollout_up":
+        # pump_rollout drain -> probe_wait: deliberate respawn with
+        # the rollout spec — a fresh incarnation (mirror re-anchors),
+        # NO breaker charge, and the new weights by construction
+        i = t[1]
+        return s._replace(status=_tset(s.status, i, UP),
+                          inc=_tset(s.inc, i, s.inc[i] + 1),
+                          wdisp=_tset(s.wdisp, i, 0),
+                          base=_tset(s.base, i, s.observed[i]),
+                          ckpt=_tset(s.ckpt, i, 1))
+    if k == "rollout_probe":
+        # pump_rollout phase "probe": health gate (up, idle, reports
+        # the target version) + bitwise parity probe passed — the one
+        # path back into the ranking for a rolled member
+        i = t[1]
+        return s._replace(rolling=_tset(s.rolling, i, 0),
+                          ranked=_tset(s.ranked, i, 1))
     raise ValueError(f"unknown transition {t!r}")
 
 
@@ -561,6 +637,11 @@ def violations(s, b):
             out.append(("no_lost_rid",
                         f"rid {rid} is not terminal, queued, admitted,"
                         f" in flight, or awaiting resume anywhere"))
+    for i in range(len(s.status)):
+        if s.rolling[i] and s.ranked[i]:
+            out.append(("rollout_gate",
+                        f"replica {i} is ranked while mid-rollout — "
+                        f"readmitted before its parity probe passed"))
     if "mirror_regression" in s.flags:
         out.append(("mirror_monotonic",
                     "dispatch mirror regressed across an incarnation"))
@@ -618,7 +699,9 @@ def footprint(t, n_replicas):
         return frozenset(("R", ("d", i)))
     if k == "hdispatch":
         return frozenset(("R", ("d", t[2]), ("d", t[3])))
-    if k in ("die", "restart", "preempt", "breaker", "join"):
+    if k in ("die", "restart", "preempt", "breaker", "join",
+             "scale_in", "rollout_drain", "rollout_up",
+             "rollout_probe"):
         i = t[1]
         return frozenset(("R", ("d", i), ("u", i), ("w", i)))
     if k == "re_rank":
@@ -656,4 +739,14 @@ def describe(t):
         return f"spare replica {t[1]} joins (unranked)"
     if k == "re_rank":
         return "membership re-rank"
+    if k == "scale_in":
+        return f"replica {t[1]} voluntarily retires (scale-in drain)"
+    if k == "rollout_drain":
+        return f"rollout drains replica {t[1]} (spec swapped)"
+    if k == "rollout_up":
+        return (f"rolled replica {t[1]} respawns with the target "
+                f"checkpoint")
+    if k == "rollout_probe":
+        return (f"rolled replica {t[1]} passes its parity probe and "
+                f"re-ranks")
     return "fleet drain (SIGTERM all live replicas)"

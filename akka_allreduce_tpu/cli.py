@@ -2439,6 +2439,24 @@ def _add_serve(sub: argparse._SubParsersAction) -> None:
                         "backpressure, and scrape == summary for the "
                         "serve_admission_*/serve_tenant_* series. The "
                         "rate SWEEP (knee curves) is `cli.py stress`")
+    p.add_argument("--elastic", action="store_true",
+                   help="with --selfcheck: the elastic-membership "
+                        "drill (ISSUE 20) — a burst over a LIVE "
+                        "2-replica subprocess fleet forces the "
+                        "autoscaler (serving/autoscale.py) through "
+                        "one scale-out and one scale-in, then a "
+                        "3-replica fleet takes a rolling weight "
+                        "rollout to a perturbed checkpoint "
+                        "mid-traffic; asserts zero dropped requests, "
+                        "bitwise parity (migrated streams resume "
+                        "bitwise; rolled streams are old-prefix + "
+                        "greedy-under-new-weights), every member "
+                        "reporting the target checkpoint_version, "
+                        "survivors-compile-0, reclaimed retiree "
+                        "series, fleet-model conformance, and "
+                        "scrape == summary for the serve_fleet_size/"
+                        "serve_scale_events_total/serve_rollout_* "
+                        "series")
     p.add_argument("--soak-s", type=float, default=0.0, metavar="S",
                    help="with --load trace: long-horizon soak smoke — "
                         "repeat the seeded trace in waves for S "
@@ -3647,6 +3665,363 @@ def _serve_subprocess_selfcheck(args: argparse.Namespace) -> int:
     return 0 if not failures else 1
 
 
+def _serve_elastic_selfcheck(args: argparse.Namespace) -> int:
+    """`serve --selfcheck --elastic`: the ISSUE 20 acceptance drill.
+    Two phases over REAL subprocess fleets:
+
+    * SCALE CYCLE — a closed burst over a live 2-replica fleet drives
+      the knee-driven autoscaler (serving/autoscale.py) through one
+      scale-out (the joiner Hellos into the ranking mid-traffic) and,
+      at the trough, one scale-in (SIGTERM drain through the same
+      migration path a preemption takes). Asserted: bitwise parity vs
+      a fault-free single engine in THIS process, zero drops, zero
+      survivor compiles post-warmup, the retiree's labeled series
+      reclaimed from the registry, and scrape == summary for
+      ``serve_fleet_size`` / ``serve_scale_events_total``;
+    * ROLLING ROLLOUT — a 3-replica fleet takes
+      ``begin_rollout(perturbed checkpoint)`` mid-traffic: one member
+      out of rotation at a time, drain -> respawn with the
+      checkpoint-backed spec -> bitwise probe -> readmit. Asserted:
+      zero drops, every member self-reporting the target
+      ``checkpoint_version`` (scrape == summary), exactly 3
+      drain/readmit transition pairs, rollout counters, and HYBRID
+      parity — every completed stream is bitwise the old-weights
+      baseline (migrations resume bitwise on old-weights survivors)
+      or an old-weights prefix whose tail is exactly greedy decode
+      under the NEW weights from the divergence point.
+
+    Both phases replay their fleet_transition traces against the
+    extended control-plane model (join / re_rank / scale_in /
+    rollout_*; analysis/fleet_model.py)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from akka_allreduce_tpu.analysis.fleet_conform import \
+        assert_conformant
+    from akka_allreduce_tpu.models.transformer import (TransformerConfig,
+                                                       init_transformer)
+    from akka_allreduce_tpu.runtime.checkpoint import (CheckpointConfig,
+                                                       CheckpointManager)
+    from akka_allreduce_tpu.runtime.tracing import Tracer
+    from akka_allreduce_tpu.serving import (AutoscaleConfig, Autoscaler,
+                                            EngineConfig, FleetMetrics,
+                                            ReplicaRouter, ReplicaSpec,
+                                            ReplicaSupervisor, Request,
+                                            RequestScheduler,
+                                            RetryPolicy, RouterConfig,
+                                            SchedulerConfig,
+                                            ServingEngine, serve_loop)
+    from akka_allreduce_tpu.telemetry import parse_prometheus_text
+
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq=48)
+    params = init_transformer(jax.random.key(0), cfg)
+    eos = 5
+    slots = 2
+    n_req = 12
+    target_step = 7
+    terminal = ("eos", "stop", "max_tokens")
+    failures: "list[str]" = []
+
+    def make_requests(seed):
+        r = np.random.default_rng(seed)
+        return [Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in r.integers(
+                0, cfg.vocab_size, size=int(r.integers(2, 6)))),
+            max_new_tokens=6,
+            eos_token=eos if rid % 2 else None,
+            submitted_at=0.0) for rid in range(n_req)]
+
+    def single_engine_truth(weights, seed):
+        engine = ServingEngine(weights, cfg,
+                               EngineConfig(num_slots=slots))
+        sched = RequestScheduler(SchedulerConfig(), num_slots=slots)
+        for r in make_requests(seed):
+            sched.submit(r)
+        return serve_loop(engine, sched, max_dispatches=4000)
+
+    def check_parity(tag, truth, results):
+        for rid, (toks, reason) in truth.items():
+            got = results.get(rid)
+            if got is None:
+                failures.append(f"{tag}: rid={rid} missing (dropped)")
+            elif list(got[0]) != list(toks) or got[1] != reason:
+                failures.append(
+                    f"{tag}: rid={rid} ({got[1]}) {list(got[0])} != "
+                    f"single-engine ({reason}) {list(toks)}")
+
+    def check_conformant(tag, tracer):
+        try:
+            assert_conformant(tracer)
+        except AssertionError as exc:
+            failures.append(f"{tag}: trace conformance: {exc}")
+
+    def run_fleet(sup, fleet, seed, on_round, max_rounds=120000):
+        sched = RequestScheduler(
+            SchedulerConfig(retry=RetryPolicy(max_attempts=5,
+                                              base_delay=0.0)),
+            num_slots=sup.live_count() * slots)
+        for eng in sup.engines:
+            eng.metrics = None  # rewire to THIS phase's fleet sinks
+        sup.tracer = Tracer()
+        router = ReplicaRouter(sup.engines, sched,
+                               RouterConfig(th=1, max_lag=3),
+                               fleet=fleet, tracer=sup.tracer)
+        for r in make_requests(seed):
+            fleet.on_submit(r.rid)
+            sched.submit(r)
+        return router.run(max_rounds=max_rounds,
+                          on_round=on_round), router
+
+    spec = ReplicaSpec(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_heads=cfg.n_heads, n_layers=cfg.n_layers, d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq, param_seed=0, num_slots=slots)
+    baseline = single_engine_truth(params, seed=17)
+
+    # ---- phase 1: the autoscaled scale cycle -------------------------
+    scale_report: dict = {}
+    fleet_warm = FleetMetrics(2)
+    with ReplicaSupervisor(spec, replicas=2, fleet=fleet_warm,
+                           spawn_timeout_s=300.0) as sup:
+        # warm: every prompt shape compiled in both workers, so the
+        # elastic phase's survivor-compile check means something
+        warm_results, _ = run_fleet(sup, fleet_warm, seed=17,
+                                    on_round=lambda r: sup.pump(0.0))
+        check_parity("warm", baseline, warm_results)
+        check_conformant("warm", sup.tracer)
+        compiles0 = [sup.engines[i].remote_compiles for i in range(2)]
+
+        fleet = FleetMetrics(2)
+        sup.fleet = fleet
+        fleet.attach_supervisor(sup)
+        asc = Autoscaler(
+            AutoscaleConfig(min_replicas=2, max_replicas=3,
+                            scale_out_frac=0.5, scale_out_hold_s=0.0,
+                            scale_in_occupancy=0.05,
+                            scale_in_hold_s=0.25, cooldown_s=0.0,
+                            overload_backlog_s=0.5,
+                            tpot_estimate=0.05),
+            supervisor=sup)
+
+        def on_round(r):
+            sup.pump(0.0)
+            asc.tick(r)
+            # busy until the trough verdict fired and membership work
+            # (join ranking, scale-in drain) has settled
+            return (asc.scale_in_events == 0
+                    or any(not rep.ranked and not rep.retired
+                           for rep in r.replicas)
+                    or any(rep.engine.draining and not rep.retired
+                           for rep in r.replicas))
+
+        elastic_results, _ = run_fleet(sup, fleet, seed=17,
+                                       on_round=on_round)
+        check_parity("scale-cycle", baseline, elastic_results)
+        if asc.scale_out_events < 1 or asc.scale_in_events < 1:
+            failures.append(f"autoscaler verdicts missing: "
+                            f"{asc.status()}")
+        # the trough victim, from the trace; its exit must reach the
+        # supervisor (the reap runs the series/log reclamation)
+        victims = sorted(ev.fields["replica"]
+                         for ev in sup.tracer.events
+                         if ev.kind == "fleet_transition"
+                         and ev.fields["t"] == "scale_in")
+        deadline = time.monotonic() + 30.0
+        while victims and sup.state(victims[-1]) != "stopped" \
+                and time.monotonic() < deadline:
+            sup.pump(0.05)
+        if victims and sup.state(victims[-1]) != "stopped":
+            failures.append(f"retiree {victims[-1]} state="
+                            f"{sup.state(victims[-1])}, want stopped")
+        retired = sorted(fleet.summary()["supervisor"]
+                         ["retired_voluntary"])
+        if retired != victims or len(retired) != 1:
+            failures.append(f"want exactly one voluntarily retired "
+                            f"member matching the scale_in victim "
+                            f"{victims}, got {retired}")
+        if sup.live_count() != 2:
+            failures.append(f"live_count {sup.live_count()} != 2 "
+                            f"after the scale cycle")
+        for i in range(2):
+            grew = sup.engines[i].remote_compiles - compiles0[i]
+            if grew and i not in retired:
+                failures.append(f"survivor replica {i} compiled "
+                                f"{grew} program(s) post-warmup "
+                                f"(want 0)")
+        # the retiree's labeled series were reclaimed (flat cycles)
+        prom_text = fleet.registry.to_prometheus_text()
+        for i in retired:
+            if f'replica="{i}"' in prom_text:
+                failures.append(f"retired replica {i}'s labeled "
+                                f"series still exported")
+        # scrape == summary for the elastic series
+        prom = parse_prometheus_text(prom_text)
+        s = fleet.summary()
+        if prom.get(("serve_fleet_size", ())) \
+                != s["elastic"]["fleet_size"]:
+            failures.append(
+                f"serve_fleet_size {prom.get(('serve_fleet_size', ()))}"
+                f" != summary {s['elastic']['fleet_size']}")
+        for d in ("out", "in"):
+            got = prom.get(("serve_scale_events_total",
+                            (("direction", d),)))
+            if got != s["elastic"]["scale_events"][d]:
+                failures.append(
+                    f"serve_scale_events_total{{direction={d}}} {got}"
+                    f" != summary {s['elastic']['scale_events'][d]}")
+        check_conformant("scale-cycle", sup.tracer)
+        kinds = [ev.fields["t"] for ev in sup.tracer.events
+                 if ev.kind == "fleet_transition"]
+        for want in ("join", "re_rank", "scale_in"):
+            if want not in kinds:
+                failures.append(f"scale-cycle trace missing a "
+                                f"{want!r} transition")
+        scale_report = {"scale_out_events": asc.scale_out_events,
+                        "scale_in_events": asc.scale_in_events,
+                        "retired": retired,
+                        "fleet_size": s["elastic"]["fleet_size"]}
+
+    # ---- phase 2: the rolling weight rollout -------------------------
+    def greedy_under(weights, prompt, n, eos_token):
+        engine = ServingEngine(weights, cfg, EngineConfig(num_slots=1))
+        sched = RequestScheduler(SchedulerConfig(), num_slots=1)
+        sched.submit(Request(rid=0, prompt=tuple(prompt),
+                             max_new_tokens=n, eos_token=eos_token,
+                             submitted_at=0.0))
+        return list(serve_loop(engine, sched,
+                               max_dispatches=1000)[0][0])
+
+    def check_hybrid_parity(reqs, results, old, new_weights):
+        """Old-bitwise, or old-prefix + greedy-under-new tail — the
+        only two stream shapes a correct rollout can produce."""
+        by_rid = {r.rid: r for r in reqs}
+        for rid, (toks, reason) in results.items():
+            toks = list(toks)
+            ref = list(old[rid][0])
+            if toks == ref:
+                continue
+            k0 = 0
+            while k0 < min(len(toks), len(ref)) \
+                    and toks[k0] == ref[k0]:
+                k0 += 1
+            req = by_rid[rid]
+            cont = greedy_under(
+                new_weights, tuple(req.prompt) + tuple(toks[:k0]),
+                req.max_new_tokens - k0, req.eos_token)
+            if toks[k0:] != cont:
+                failures.append(
+                    f"rollout: rid={rid} diverges from old weights "
+                    f"at {k0} but the tail is not greedy under the "
+                    f"new weights: {toks[k0:]} != {cont}")
+
+    rollout_report: dict = {}
+    with tempfile.TemporaryDirectory(prefix="elastic_ckpt_") as d:
+        bumped = jax.tree_util.tree_map(lambda x: x * 1.0625, params)
+        with CheckpointManager(CheckpointConfig(directory=d)) as mgr:
+            if not mgr.save(target_step, bumped,
+                            {"noop": np.zeros(1)}, force=True):
+                failures.append("perturbed checkpoint save failed")
+        old_truth = single_engine_truth(params, seed=23)
+        new_truth = single_engine_truth(bumped, seed=23)
+        if all(list(new_truth[rid][0]) == list(old_truth[rid][0])
+               for rid in old_truth):
+            failures.append("perturbed checkpoint indistinguishable "
+                            "from the seed build — provenance would "
+                            "not show in the tokens")
+
+        fleet = FleetMetrics(3)
+        tracer = Tracer()
+        with ReplicaSupervisor(spec, replicas=3, fleet=fleet,
+                               tracer=tracer,
+                               spawn_timeout_s=300.0) as sup:
+            reqs = make_requests(seed=23)
+            started = {"done": False}
+
+            def on_round(r):
+                sup.pump(0.0)
+                if not started["done"]:
+                    started["done"] = True
+                    v = sup.begin_rollout(d)
+                    if v != target_step:
+                        failures.append(f"begin_rollout resolved "
+                                        f"step {v} != {target_step}")
+                sup.pump_rollout(r)
+                return sup.rollout_active
+
+            results, _ = run_fleet(sup, fleet, seed=23,
+                                   on_round=on_round)
+            versions = [sup.checkpoint_version(i) for i in range(3)]
+            rolling = sup.rollout_active
+            tracer = sup.tracer
+        if rolling:
+            failures.append("rollout still active after the run")
+        if versions != [target_step] * 3:
+            failures.append(f"checkpoint versions {versions} != "
+                            f"{[target_step] * 3} — a member is "
+                            f"serving old weights")
+        if len(results) != n_req:
+            failures.append(f"rollout dropped requests: "
+                            f"{len(results)}/{n_req} completed")
+        for rid, (_toks, reason) in results.items():
+            if reason not in terminal:
+                failures.append(f"rollout: rid={rid} ended "
+                                f"{reason!r}, not a terminal success")
+        check_hybrid_parity(reqs, results, old_truth, bumped)
+        s = fleet.summary()
+        if (s["elastic"]["rollouts"]["started"] != 1
+                or s["elastic"]["rollouts"]["completed"] != 1
+                or s["elastic"]["rollouts"]["aborted"] != 0):
+            failures.append(f"rollout counters off: "
+                            f"{s['elastic']['rollouts']}")
+        # scrape == summary: rollout counters + per-member version
+        prom = parse_prometheus_text(
+            fleet.registry.to_prometheus_text())
+        for what in ("started", "completed", "aborted"):
+            got = prom.get((f"serve_rollout_{what}_total", ()))
+            if got != s["elastic"]["rollouts"][what]:
+                failures.append(
+                    f"serve_rollout_{what}_total {got} != summary "
+                    f"{s['elastic']['rollouts'][what]}")
+        for i in range(3):
+            got = prom.get(("serve_replica_checkpoint_version",
+                            (("replica", str(i)),)))
+            if got != target_step:
+                failures.append(
+                    f"serve_replica_checkpoint_version{{replica={i}}}"
+                    f" {got} != {target_step}")
+        check_conformant("rollout", tracer)
+        kinds = [ev.fields["t"] for ev in tracer.events
+                 if ev.kind == "fleet_transition"]
+        if kinds.count("rollout_drain") != 3 \
+                or kinds.count("rollout_readmit") != 3:
+            failures.append(
+                f"want 3 rollout_drain + 3 rollout_readmit "
+                f"transitions (one per member), got "
+                f"{kinds.count('rollout_drain')} + "
+                f"{kinds.count('rollout_readmit')}")
+        rollout_report = {
+            "target_step": target_step,
+            "checkpoint_versions": versions,
+            "rollouts": s["elastic"]["rollouts"],
+            "completed": len(results),
+        }
+
+    print(json.dumps({
+        "selfcheck": "ok" if not failures else "FAIL",
+        "elastic": True,
+        "scale_cycle": scale_report,
+        "rollout": rollout_report,
+        "conformance": "ok" if not any(
+            "conformance" in f for f in failures) else "FAIL",
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
 def _make_draft_model(params: dict, mcfg, draft_layers: int):
     """The serve CLI's draft model: the target's first N layers with
     the embed / positional / output-norm / unembed weights SHARED —
@@ -4166,16 +4541,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                   "(`--selfcheck --replica-mode subprocess`) and "
                   "tests/test_subprocess_fabric.py", file=sys.stderr)
             return 2
-        if args.ckpt_dir:
-            print("error: --replica-mode subprocess rebuilds params "
-                  "from --seed in each worker; checkpoint-backed "
-                  "subprocess replicas are an open follow-up",
+        if args.paged and args.prefill_buckets.strip():
+            # same rule the worker enforces (serving/worker.py):
+            # bucketed prefill is a slot-engine knob
+            print("error: --prefill-buckets is a slot-engine knob; "
+                  "paged prefill is page-granular already — drop one",
                   file=sys.stderr)
-            return 2
-        if args.prefill_buckets.strip():
-            print("error: --replica-mode subprocess prefill is "
-                  "exact-length (the parity mode); drop "
-                  "--prefill-buckets", file=sys.stderr)
             return 2
         if args.selfcheck and args.replicas < 2:
             print("error: the subprocess selfcheck kills one of N>=2 "
@@ -4253,6 +4624,27 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "`python -m akka_allreduce_tpu.cli stress`",
               file=sys.stderr)
         return 2
+    # -- elastic membership drill (ISSUE 20) ---------------------------
+    if args.elastic:
+        if not args.selfcheck:
+            print("error: --elastic is the membership drill and needs "
+                  "--selfcheck; production elasticity is the "
+                  "programmatic Autoscaler + ReplicaSupervisor.scale_to"
+                  "/begin_rollout surface (OPERATIONS.md)",
+                  file=sys.stderr)
+            return 2
+        if args.stress or args.chaos is not None or args.speculative \
+                or args.paged:
+            print("error: --elastic is its own drill (it builds its "
+                  "own subprocess fleet, perturbed checkpoint and "
+                  "burst); drop --stress/--chaos/--speculative/"
+                  "--paged", file=sys.stderr)
+            return 2
+        if args.replicas > 1 or args.replica_mode == "subprocess":
+            print("error: --elastic sizes its own fleet (2 members "
+                  "for the scale cycle, 3 for the rollout); drop "
+                  "--replicas/--replica-mode", file=sys.stderr)
+            return 2
     if args.load == "trace" and args.arrival_rate <= 0:
         print("error: --load trace needs --arrival-rate > 0 (the "
               "curve's mean)", file=sys.stderr)
@@ -4312,6 +4704,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         return 2
     if args.selfcheck:
         def _run_selfcheck() -> int:
+            if args.elastic:
+                return _serve_elastic_selfcheck(args)
             if args.stress:
                 return _serve_stress_selfcheck(args)
             if args.replica_mode == "subprocess":
@@ -4626,7 +5020,16 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     temperature=args.temperature, top_k=args.top_k,
                     top_p=args.top_p,
                     kv_dtype="int8" if args.kv_cache == "int8"
-                    else None)
+                    else None,
+                    # checkpoint-backed workers: only the REFERENCE
+                    # crosses the wire; each worker restores the step
+                    # the parent just validated (worker.py). The
+                    # bucket set crosses too — the fleet's compiled-
+                    # program bound is the spec's, not per-process
+                    # happenstance
+                    prefill_buckets=buckets,
+                    ckpt_dir=args.ckpt_dir,
+                    ckpt_step=(_step0 - 1) if args.ckpt_dir else None)
                 supervisor = stack.enter_context(ReplicaSupervisor(
                     spec, replicas=args.replicas,
                     backoff=BackoffPolicy(base_s=args.backoff_base),

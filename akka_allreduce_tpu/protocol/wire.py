@@ -49,14 +49,17 @@ MSG_CANCEL = 13
 # ``replica`` field on CompletionFrame, and the supervisor frames
 # 9-13; v3 added CompletionFrame.waste — the cancelled-hedge-loser
 # discard count the router's accounting was previously blind to — and
-# HealthFrame.cancelled_tokens, its cumulative worker-side mirror).
+# HealthFrame.cancelled_tokens, its cumulative worker-side mirror;
+# v4 added HealthFrame.checkpoint_version — the worker's self-reported
+# weight provenance, the signal a rolling rollout's readmission gate
+# requires before it re-ranks a restarted replica).
 # Every serving frame carries this byte right after its message
 # type, and decode refuses a mismatch with a readable error instead of
 # mis-parsing a peer running different code — the failure mode of a
 # rolling fleet upgrade where router and replica briefly disagree.
 # The allreduce frames (0-6) predate versioning and stay unversioned:
 # the training plane's processes are always launched as one build.
-SERVING_WIRE_VERSION = 3
+SERVING_WIRE_VERSION = 4
 
 _SERVING_MSG_TYPES = frozenset({
     MSG_SUBMIT, MSG_COMPLETION, MSG_HEALTH, MSG_DRAIN, MSG_RESUME,
@@ -245,13 +248,15 @@ class HealthFrame:
 
     __slots__ = ("replica", "occupied", "free_slots", "dispatches",
                  "compiles", "draining", "watchdog_trips",
-                 "evictions", "prefill_programs", "cancelled_tokens")
+                 "evictions", "prefill_programs", "cancelled_tokens",
+                 "checkpoint_version")
 
     def __init__(self, replica: int, occupied: int, free_slots: int,
                  dispatches: int, compiles: int = 0,
                  draining: bool = False, watchdog_trips: int = 0,
                  evictions: int = 0, prefill_programs: int = 0,
-                 cancelled_tokens: int = 0):
+                 cancelled_tokens: int = 0,
+                 checkpoint_version: int = 0):
         self.replica = replica
         self.occupied = occupied
         self.free_slots = free_slots
@@ -266,6 +271,12 @@ class HealthFrame:
         # mirror of the per-cancel ``waste`` acks (OPERATIONS.md
         # "Hedging economics"; the two must reconcile)
         self.cancelled_tokens = cancelled_tokens
+        # wire v4: which weights this worker is actually serving — the
+        # checkpoint step it restored (0 = param-seed build). The
+        # rollout readmission gate compares this against the target
+        # version; trusting the parent-side spec alone would readmit a
+        # worker that silently fell back to the wrong weights.
+        self.checkpoint_version = checkpoint_version
 
     def __repr__(self) -> str:
         return (f"HealthFrame(replica={self.replica}, "
@@ -516,13 +527,14 @@ def encode(msg, addr_of: Callable[[object], Addr]) -> bytes:
                             len(reason), len(msg.tokens))
                 + reason + tokens)
     if isinstance(msg, HealthFrame):
-        return struct.pack("<BBiIIQQIIIQB", MSG_HEALTH,
+        return struct.pack("<BBiIIQQIIIQqB", MSG_HEALTH,
                            SERVING_WIRE_VERSION, msg.replica,
                            msg.occupied, msg.free_slots,
                            msg.dispatches, msg.compiles,
                            msg.watchdog_trips, msg.evictions,
                            msg.prefill_programs,
                            msg.cancelled_tokens,
+                           msg.checkpoint_version,
                            1 if msg.draining else 0)
     if isinstance(msg, DrainFrame):
         return struct.pack("<BB", MSG_DRAIN, SERVING_WIRE_VERSION)
@@ -675,18 +687,20 @@ def _decode_impl(buf: bytes, ref_of: Callable[[Addr], object]):
         return CompletionFrame(rid=rid, tokens=tokens, reason=reason,
                                replica=replica, waste=waste)
     if mtype == MSG_HEALTH:
-        _need(buf, off, struct.calcsize("<iIIQQIIIQB"),
+        _need(buf, off, struct.calcsize("<iIIQQIIIQqB"),
               "HealthFrame body")
         (replica, occupied, free_slots, dispatches, compiles, trips,
          evictions, prefill_programs, cancelled_tokens,
-         draining) = struct.unpack_from("<iIIQQIIIQB", buf, off)
+         checkpoint_version,
+         draining) = struct.unpack_from("<iIIQQIIIQqB", buf, off)
         return HealthFrame(replica=replica, occupied=occupied,
                            free_slots=free_slots,
                            dispatches=dispatches, compiles=compiles,
                            draining=bool(draining),
                            watchdog_trips=trips, evictions=evictions,
                            prefill_programs=prefill_programs,
-                           cancelled_tokens=cancelled_tokens)
+                           cancelled_tokens=cancelled_tokens,
+                           checkpoint_version=checkpoint_version)
     if mtype == MSG_DRAIN:
         return DrainFrame()
     if mtype == MSG_CANCEL:

@@ -58,6 +58,13 @@ Modules:
   (``shed_budget``/``shed_overload``) instead of queue collapse —
   the reference's partial-completion philosophy at the admission
   edge.
+* ``autoscale.py`` — the ELASTIC MEMBERSHIP controller (ISSUE 20):
+  scale out before the admission knee sheds, scale in on sustained
+  idle, hysteresis + cooldown + health holds; drives
+  :meth:`~akka_allreduce_tpu.serving.supervisor.ReplicaSupervisor
+  .scale_to` over subprocess fleets (and rides the same SIGTERM
+  drain-migration path on scale-in, so membership changes never drop
+  in-flight work).
 
 Failure domains (ISSUE 5 — the paper's "complete the round without the
 missing contribution", pointed at serving): a hung dispatch trips the
@@ -79,6 +86,10 @@ from akka_allreduce_tpu.serving.admission import (
     AdmissionController,
     TenantBudget,
     TokenBucket,
+)
+from akka_allreduce_tpu.serving.autoscale import (
+    AutoscaleConfig,
+    Autoscaler,
 )
 from akka_allreduce_tpu.serving.engine import (
     EngineConfig,
@@ -135,6 +146,8 @@ __all__ = [
     "AdmissionController",
     "TenantBudget",
     "TokenBucket",
+    "AutoscaleConfig",
+    "Autoscaler",
     "LatencyLedger",
     "PickupBuffer",
     "TenantSpec",

@@ -1,0 +1,257 @@
+"""Knee-driven autoscaling: the membership controller for an elastic
+fleet (ISSUE 20, ROADMAP direction 3).
+
+The admission controller (serving/admission.py, PR 12) already states
+the saturation knee as a TIME bound: ``overload_backlog_s`` is the
+longest the operator lets the queue's estimated drain time grow before
+load is shed. Shedding is the last resort; the elastic move is to add
+capacity BEFORE the shed bound is hit. This controller closes that
+loop:
+
+* **scale out** when the live queue's estimated drain time
+  (``backlog_tokens * tpot_estimate / total_slots`` — the same
+  arithmetic the overload sweep uses, so the two surfaces can never
+  disagree about what "overloaded" means) has sat above
+  ``scale_out_frac`` of the shed bound for ``scale_out_hold_s``;
+* **scale in** when fleet occupancy has sat at/below
+  ``scale_in_occupancy`` with an empty queue for ``scale_in_hold_s``
+  (a diurnal trough, not a gap between bursts);
+* **never flap**: both verdicts are level-triggered with sustained-
+  condition windows (hysteresis), every action arms a shared
+  ``cooldown_s`` rate limiter, and membership moves one replica at a
+  time;
+* **never amplify a failure**: while the supervisor is nursing a
+  crashed child (DEAD/BACKOFF), holds an open circuit breaker, or is
+  mid-rollout, the controller HOLDS — the breaker caps replacement
+  spawn storms and an autoscaler that doubled down on a crash loop
+  would defeat it.
+
+The controller is transport-agnostic like the router it feeds on: with
+a :class:`~akka_allreduce_tpu.serving.supervisor.ReplicaSupervisor` it
+scales real subprocess members (``scale_to``); in-process it spawns
+engines via the ``spawn`` factory and SIGTERM-shapes the victim via
+``request_drain`` — both reuse the drain-migration path, so a scale-in
+never drops in-flight work.
+
+Pure host arithmetic on the scheduler's O(1) running sums; the clock
+is the scheduler's (injectable), so tests script diurnal hysteresis
+deterministically. Driven from the router round loop's ``on_round``
+hook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional
+
+log = logging.getLogger("akka_allreduce_tpu.serving.autoscale")
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """The controller dials.
+
+    ``overload_backlog_s`` / ``tpot_estimate`` default to 0 = inherit
+    from the scheduler's admission controller (the knee is stated
+    once); set them only when running without admission control.
+    ``scale_out_frac`` is the headroom: 0.8 means "act when estimated
+    drain time reaches 80% of the shed bound" — scaling must win the
+    race against the overload sweep, or the sweep sheds what the new
+    replica would have served."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_out_frac: float = 0.8
+    scale_out_hold_s: float = 0.25
+    scale_in_occupancy: float = 0.05
+    scale_in_hold_s: float = 5.0
+    cooldown_s: float = 10.0
+    overload_backlog_s: float = 0.0
+    tpot_estimate: float = 0.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas={self.max_replicas} below "
+                f"min_replicas={self.min_replicas}")
+        if not 0.0 < self.scale_out_frac <= 1.0:
+            raise ValueError(
+                f"scale_out_frac must be in (0, 1], got "
+                f"{self.scale_out_frac}")
+        if not 0.0 <= self.scale_in_occupancy < 1.0:
+            raise ValueError(
+                f"scale_in_occupancy must be in [0, 1), got "
+                f"{self.scale_in_occupancy}")
+
+
+class Autoscaler:
+    """The membership control loop. ``tick(router)`` once per router
+    round; returns ``"out"``, ``"in"``, or None (held / steady).
+
+    ``supervisor`` (optional) provides subprocess membership AND the
+    health holds; ``spawn`` (optional, in-process mode) is a zero-arg
+    engine factory for scale-out. With neither, the controller is a
+    pure observer (verdicts + counters, no actions) — the dry-run
+    mode the operator tunes dials in."""
+
+    def __init__(self, cfg: AutoscaleConfig = AutoscaleConfig(),
+                 supervisor=None,
+                 spawn: Optional[Callable[[], object]] = None):
+        self.cfg = cfg
+        self.supervisor = supervisor
+        self.spawn = spawn
+        self.scale_out_events = 0
+        self.scale_in_events = 0
+        self.holds = 0
+        self.last_action: Optional[str] = None
+        self.last_action_time: Optional[float] = None
+        self._over_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        # last tick's observability (status() / the fleet report)
+        self.est_drain_s = 0.0
+        self.occupancy = 0.0
+
+    # -- signal resolution ------------------------------------------------
+
+    def _knee(self, scheduler) -> "tuple[float, float]":
+        """(overload_backlog_s, tpot_estimate): the config's values,
+        else the admission controller's — the knee is defined once."""
+        bound = self.cfg.overload_backlog_s
+        tpot = self.cfg.tpot_estimate
+        adm = getattr(scheduler, "admission", None)
+        if adm is not None:
+            if bound <= 0:
+                bound = adm.cfg.overload_backlog_s
+            if tpot <= 0:
+                tpot = adm.cfg.tpot_estimate
+        return bound, tpot
+
+    def _unhealthy(self) -> bool:
+        """The spawn-storm cap: membership moves only on a healthy
+        fleet. A DEAD/BACKOFF child already has a replacement spawn in
+        flight; an open breaker says spawning is the problem; a
+        rollout owns membership until it finishes."""
+        sup = self.supervisor
+        if sup is None:
+            return False
+        if getattr(sup, "rollout_active", False):
+            return True
+        for i in range(len(sup.engines)):
+            # supervisor state strings (supervisor.py: DEAD/BACKOFF)
+            if sup.state(i) in ("dead", "backoff"):
+                return True
+            if sup.breaker_open(i):
+                return True
+        return False
+
+    # -- the control loop -------------------------------------------------
+
+    def tick(self, router) -> Optional[str]:
+        sched = router.scheduler
+        now = sched.clock()
+        live = [rep for rep in router.replicas
+                if rep.live and not rep.engine.draining]
+        joining = [rep for rep in router.replicas
+                   if not rep.ranked and not rep.retired]
+        n = len(live) + len(joining)
+        total_slots = sum(rep.engine.num_slots for rep in live)
+        backlog = sched.backlog_tokens
+        bound_s, tpot = self._knee(sched)
+        self.est_drain_s = (backlog * tpot / total_slots
+                            if total_slots > 0 and tpot > 0 else 0.0)
+        self.occupancy = (sum(rep.occupied for rep in live)
+                          / total_slots if total_slots > 0 else 0.0)
+
+        # -- level-triggered windows (hysteresis) -------------------
+        over = (bound_s > 0 and self.est_drain_s > 0
+                and self.est_drain_s
+                >= self.cfg.scale_out_frac * bound_s)
+        if over and self._over_since is None:
+            self._over_since = now
+        elif not over:
+            self._over_since = None
+        idle = (backlog == 0 and sched.queue_depth == 0
+                and self.occupancy <= self.cfg.scale_in_occupancy)
+        if idle and self._idle_since is None:
+            self._idle_since = now
+        elif not idle:
+            self._idle_since = None
+
+        want_out = (self._over_since is not None
+                    and now - self._over_since
+                    >= self.cfg.scale_out_hold_s
+                    and n < self.cfg.max_replicas
+                    and not joining)
+        want_in = (self._idle_since is not None
+                   and now - self._idle_since
+                   >= self.cfg.scale_in_hold_s
+                   and n > self.cfg.min_replicas)
+        if not want_out and not want_in:
+            return None
+        # -- rate limiter + health hold -----------------------------
+        if self.last_action_time is not None \
+                and now - self.last_action_time < self.cfg.cooldown_s:
+            self.holds += 1
+            return None
+        if self._unhealthy():
+            self.holds += 1
+            return None
+
+        if want_out:
+            self._do_scale_out(router, n)
+            self._record("out", now)
+            return "out"
+        self._do_scale_in(router, live)
+        self._record("in", now)
+        return "in"
+
+    def _record(self, direction: str, now: float) -> None:
+        self.last_action = direction
+        self.last_action_time = now
+        self._over_since = None
+        self._idle_since = None
+        if direction == "out":
+            self.scale_out_events += 1
+        else:
+            self.scale_in_events += 1
+        log.info("autoscale %s (est_drain=%.2fs occupancy=%.2f)",
+                 direction, self.est_drain_s, self.occupancy)
+
+    def _do_scale_out(self, router, n: int) -> None:
+        if self.supervisor is not None:
+            self.supervisor.scale_to(n + 1, router=router)
+        elif self.spawn is not None:
+            router.add_replica(self.spawn())
+        if router.fleet_metrics is not None and hasattr(
+                router.fleet_metrics, "on_scale_event"):
+            router.fleet_metrics.on_scale_event("out")
+
+    def _do_scale_in(self, router, live) -> None:
+        victim = max(live, key=lambda rep: rep.index)
+        if self.supervisor is not None:
+            self.supervisor.retire_replica(victim.index)
+        else:
+            # in-process: the same voluntary-drain shape the SIGTERM
+            # path takes — the router migrates in-flight work on its
+            # next round and retires the handle
+            router._t("scale_in", replica=victim.index)
+            victim.engine.request_drain()
+        if router.fleet_metrics is not None and hasattr(
+                router.fleet_metrics, "on_scale_event"):
+            router.fleet_metrics.on_scale_event("in")
+
+    # -- operator surface -------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "est_drain_s": round(self.est_drain_s, 4),
+            "occupancy": round(self.occupancy, 4),
+            "scale_out_events": self.scale_out_events,
+            "scale_in_events": self.scale_in_events,
+            "holds": self.holds,
+            "last_action": self.last_action,
+        }

@@ -596,6 +596,14 @@ class FleetMetrics:
         self.replica_breaker_open = [False] * num_replicas
         self._supervisor = None   # attach_supervisor wires gauges
         self._admission = None    # attach_admission wires economics
+        # elastic membership (ISSUE 20): voluntarily retired members
+        # (their labeled series are dropped from the registry — scale
+        # cycles keep the export surface flat), scale/rollout event
+        # counters the autoscaler and rollout machine tick
+        self._retired_voluntary: set = set()
+        self.scale_events = {"out": 0, "in": 0}
+        self.rollouts = {"started": 0, "completed": 0, "aborted": 0}
+        self.rollout_version: Optional[int] = None
         # the chaos reconciliation pair at fleet scope: injected is
         # stamped from FaultPlan.fired; survived sums the replicas'
         # recovery events plus router-level survivals (preempt drains)
@@ -685,28 +693,24 @@ class FleetMetrics:
         r.register_callback("serve_fleet_replicas",
                             lambda: len(self.replicas), kind="gauge",
                             help="replicas constructed into the fleet")
+        r.register_callback(
+            "serve_fleet_size", self._fleet_size, kind="gauge",
+            help="members currently serving or coming up (voluntarily "
+                 "retired members excluded) — the elastic-membership "
+                 "gauge the autoscaler steers")
+        for d in ("out", "in"):
+            r.register_callback(
+                "serve_scale_events_total",
+                (lambda d=d: self.scale_events[d]),
+                kind="counter", labels={"direction": d},
+                help="autoscaler membership changes by direction")
+        for what in ("started", "completed", "aborted"):
+            r.register_callback(
+                f"serve_rollout_{what}_total",
+                (lambda w=what: self.rollouts[w]), kind="counter",
+                help=f"rolling weight rollouts {what}")
         for i in range(len(self.replicas)):
-            labels = {"replica": str(i)}
-            r.register_callback(
-                "serve_replica_restarts_total",
-                (lambda i=i: self.replica_restarts[i]),
-                kind="counter", labels=labels,
-                help="supervisor restarts of this replica's process "
-                     "after an unexpected death (subprocess fabric)")
-            r.register_callback(
-                "serve_replica_backoff_seconds",
-                (lambda i=i: round(self.replica_backoff_s[i], 3)),
-                kind="counter", labels=labels,
-                help="cumulative seconds of scheduled restart backoff "
-                     "for this replica")
-            r.register_callback(
-                "serve_replica_breaker_open",
-                (lambda i=i: 1 if self.replica_breaker_open[i]
-                 else 0),
-                kind="gauge", labels=labels,
-                help="1 while this replica's restart circuit breaker "
-                     "is OPEN (restart budget exhausted — replica "
-                     "retired, operator attention required)")
+            self._register_replica(i)
         histograms = (
             ("serve_fleet_ttft_seconds", "ttft_s",
              "submit -> first token, merged across replicas"),
@@ -723,6 +727,110 @@ class FleetMetrics:
         for name, attr, help_text in histograms:
             r.register_histogram(name, (lambda a=attr: self.merged(a)),
                                  help=help_text)
+
+    def _register_replica(self, i: int) -> None:
+        """One member's labeled series — called for every ctor replica
+        and again by :meth:`add_replica` for runtime joiners."""
+        r = self.registry
+        labels = {"replica": str(i)}
+        r.register_callback(
+            "serve_replica_restarts_total",
+            (lambda i=i: self.replica_restarts[i]),
+            kind="counter", labels=labels,
+            help="supervisor restarts of this replica's process "
+                 "after an unexpected death (subprocess fabric)")
+        r.register_callback(
+            "serve_replica_backoff_seconds",
+            (lambda i=i: round(self.replica_backoff_s[i], 3)),
+            kind="counter", labels=labels,
+            help="cumulative seconds of scheduled restart backoff "
+                 "for this replica")
+        r.register_callback(
+            "serve_replica_breaker_open",
+            (lambda i=i: 1 if self.replica_breaker_open[i]
+             else 0),
+            kind="gauge", labels=labels,
+            help="1 while this replica's restart circuit breaker "
+                 "is OPEN (restart budget exhausted — replica "
+                 "retired, operator attention required)")
+        if self._supervisor is not None:
+            self._register_replica_supervised(i)
+
+    def _register_replica_supervised(self, i: int) -> None:
+        """The series that only exist over a subprocess fabric: the
+        live heartbeat age and the self-reported checkpoint version."""
+        self.registry.register_callback(
+            "serve_replica_heartbeat_age_seconds",
+            (lambda i=i: self._heartbeat_age(i)),
+            kind="gauge", labels={"replica": str(i)},
+            help="seconds since the last frame (Pings included) "
+                 "from this replica's process; -1 = never heard / "
+                 "down. The SIGSTOP-straggler triage signal "
+                 "(OPERATIONS.md)")
+        self.registry.register_callback(
+            "serve_replica_checkpoint_version",
+            (lambda i=i: self._checkpoint_version(i)),
+            kind="gauge", labels={"replica": str(i)},
+            help="checkpoint step this replica's worker self-reports "
+                 "on every HealthFrame (0 = param-seed build; the "
+                 "rollout drives every member to the target step)")
+
+    # -- elastic membership (ISSUE 20) ----------------------------------
+
+    def _fleet_size(self) -> int:
+        if self._supervisor is not None:
+            return self._supervisor.live_count()
+        return len(self.replicas) - len(self._retired_voluntary)
+
+    def _checkpoint_version(self, i: int) -> int:
+        if self._supervisor is None or i in self._retired_voluntary:
+            return -1
+        return int(self._supervisor.checkpoint_version(i))
+
+    def add_replica(self) -> "ServingMetrics":
+        """Grow the fleet's metrics surface by one member: a fresh
+        per-replica ServingMetrics under the next ``replica`` label,
+        its labeled series registered exactly as a ctor replica's —
+        called by the router/supervisor join path."""
+        i = len(self.replicas)
+        self.replicas.append(
+            ServingMetrics(clock=self.clock, tracer=self.tracer,
+                           registry=self.registry,
+                           labels={"replica": str(i)}))
+        self.replica_restarts.append(0)
+        self.replica_backoff_s.append(0.0)
+        self.replica_breaker_open.append(False)
+        self._register_replica(i)
+        self._record("serve_fleet_grew", replica=i)
+        return self.replicas[i]
+
+    def on_voluntary_retire(self, replica: int) -> None:
+        """A member voluntarily left (scale-in drain completed): drop
+        ALL its labeled series from the registry so repeated scale
+        cycles keep the export surface — and the scrape — flat. The
+        per-index lists keep their history for :meth:`summary`'s
+        supervisor block, which marks the member retired."""
+        self._retired_voluntary.add(replica)
+        n = self.registry.drop_labeled("replica", str(replica))
+        self._record("serve_replica_retired_voluntary",
+                     replica=replica, series_dropped=n)
+
+    def on_scale_event(self, direction: str) -> None:
+        self.scale_events[direction] += 1
+        self._record("serve_scale_event", direction=direction)
+
+    def on_rollout_started(self, version: int) -> None:
+        self.rollouts["started"] += 1
+        self.rollout_version = int(version)
+        self._record("serve_rollout_started", version=int(version))
+
+    def on_rollout_completed(self, version: int) -> None:
+        self.rollouts["completed"] += 1
+        self._record("serve_rollout_completed", version=int(version))
+
+    def on_rollout_aborted(self, version: int) -> None:
+        self.rollouts["aborted"] += 1
+        self._record("serve_rollout_aborted", version=int(version))
 
     # -- fleet event hooks ---------------------------------------------
 
@@ -843,27 +951,23 @@ class FleetMetrics:
     # -- supervisor hooks (subprocess fabric) ---------------------------
 
     def attach_supervisor(self, sup) -> None:
-        """Wire the live heartbeat-age gauges: one
-        ``serve_replica_heartbeat_age_seconds{replica=i}`` per replica,
-        pulling :meth:`ReplicaSupervisor.heartbeat_age` at scrape time
+        """Wire the live supervisor gauges: per replica, a
+        ``serve_replica_heartbeat_age_seconds`` gauge pulling
+        :meth:`ReplicaSupervisor.heartbeat_age` at scrape time
         (-1 = never heard from / connection gone — distinguishable
-        from a legitimate 0.0 on a chatty replica). Called by the
+        from a legitimate 0.0 on a chatty replica) and a
+        ``serve_replica_checkpoint_version`` gauge pulling the step
+        the worker self-reports on HealthFrames. Called by the
         supervisor's ctor when it is handed this FleetMetrics."""
         if self._supervisor is not None:
             return
         self._supervisor = sup
         for i in range(len(self.replicas)):
-            self.registry.register_callback(
-                "serve_replica_heartbeat_age_seconds",
-                (lambda i=i: self._heartbeat_age(i)),
-                kind="gauge", labels={"replica": str(i)},
-                help="seconds since the last frame (Pings included) "
-                     "from this replica's process; -1 = never heard / "
-                     "down. The SIGSTOP-straggler triage signal "
-                     "(OPERATIONS.md)")
+            if i not in self._retired_voluntary:
+                self._register_replica_supervised(i)
 
     def _heartbeat_age(self, i: int) -> float:
-        if self._supervisor is None:
+        if self._supervisor is None or i in self._retired_voluntary:
             return -1.0
         age = self._supervisor.heartbeat_age(i)
         return -1.0 if age is None else round(age, 3)
@@ -954,6 +1058,16 @@ class FleetMetrics:
                 "heartbeat_age_s": [
                     self._heartbeat_age(i)
                     for i in range(len(self.replicas))],
+                "retired_voluntary": sorted(self._retired_voluntary),
+            },
+            # elastic membership (ISSUE 20) — the SAME state the
+            # serve_fleet_size / serve_scale_events_total /
+            # serve_rollout_*_total series pull at scrape time
+            "elastic": {
+                "fleet_size": self._fleet_size(),
+                "scale_events": dict(self.scale_events),
+                "rollouts": dict(self.rollouts),
+                "rollout_version": self.rollout_version,
             },
             # the merged fleet distributions — the SAME merge the
             # serve_fleet_* pull collectors run at scrape time

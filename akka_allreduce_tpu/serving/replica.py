@@ -73,6 +73,28 @@ class LagLedger:
         self.round += 1
         return self.round
 
+    def grow(self, k: int = 1) -> None:
+        """Elastic membership (ISSUE 20): extend the ledger for ``k``
+        joining replicas. A joiner starts CURRENT — it has had no
+        round in which it could have lagged, and back-dating it to
+        round 0 would degrade it on arrival."""
+        if k < 1:
+            raise ValueError(f"grow() needs k >= 1, got {k}")
+        self._last.extend([self.round] * k)
+        self.degraded.extend([False] * k)
+        self.degrade_events.extend([0] * k)
+        self.readmit_events.extend([0] * k)
+        self.shed_events.extend([0] * k)
+
+    def rejoin(self, i: int) -> None:
+        """Membership rejoin (rollout readmit): the replica re-enters
+        current and healthy — whatever lag its RETIRED incarnation
+        accrued while out of the fleet is not this incarnation's debt.
+        Distinct from :meth:`on_progress` readmission, which is earned
+        lag recovery and counted as such."""
+        self._last[i] = self.round
+        self.degraded[i] = False
+
     def lag(self, i: int) -> int:
         return self.round - self._last[i]
 
@@ -124,15 +146,19 @@ class LagLedger:
 class ReplicaHandle:
     """One fleet member: the engine, its per-replica metrics sink, and
     the router-side state that is about the REPLICA rather than any
-    request. ``retired`` marks a replica permanently out of the fleet
-    (preemption drain — the in-process model of a host that went away);
-    ``probe_round`` is the last round this replica consumed its
-    one-degraded-probe admission."""
+    request. ``retired`` marks a replica out of the fleet (preemption
+    or voluntary drain; a rolling rollout readmits it after the parity
+    probe — the one path back); ``ranked`` is the membership gate from
+    the reference's master (PAPER.md L4): a joining replica enters
+    unranked and earns ranked on its first ready round — until then it
+    takes no dispatches; ``probe_round`` is the last round this
+    replica consumed its one-degraded-probe admission."""
 
     index: int
     engine: ServingEngine
     metrics: Optional[object] = None
     retired: bool = False
+    ranked: bool = True
     probe_round: int = -1
 
     @property
@@ -141,7 +167,7 @@ class ReplicaHandle:
 
     @property
     def live(self) -> bool:
-        return not self.retired
+        return not self.retired and self.ranked
 
     @property
     def free_slots(self) -> int:

@@ -172,8 +172,66 @@ class ReplicaRouter:
             "replicas": len(self.replicas),
             "retired": [rep.index for rep in self.replicas
                         if rep.retired],
+            "unranked": [rep.index for rep in self.replicas
+                         if not rep.ranked and not rep.retired],
             "occupied": [rep.engine.occupied for rep in self.replicas],
         }
+
+    # -- elastic membership (ISSUE 20) -----------------------------------
+
+    def add_replica(self, engine: ServingEngine) -> ReplicaHandle:
+        """A member JOINS at runtime: append the engine at the next
+        index, extend the lag ledger (the joiner starts current), and
+        enter it UNRANKED — the reference's master ranks a joining
+        worker before assigning it chunks (PAPER.md L4), and the round
+        loop mirrors that by ranking it on its first ready round. Until
+        then it takes no dispatches, so a slow jax import on the joiner
+        never stalls admission."""
+        i = len(self.replicas)
+        m = None
+        if self.fleet_metrics is not None:
+            if len(self.fleet_metrics.replicas) <= i and hasattr(
+                    self.fleet_metrics, "add_replica"):
+                self.fleet_metrics.add_replica()
+            if len(self.fleet_metrics.replicas) > i:
+                m = self.fleet_metrics.replicas[i]
+        if m is not None and engine.metrics is None:
+            engine.metrics = m
+        engine.site_prefix = f"replica{i}"
+        rep = ReplicaHandle(index=i, engine=engine,
+                            metrics=engine.metrics, ranked=False)
+        self.replicas.append(rep)
+        self.ledger.grow(1)
+        self._t("join", replica=i)
+        return rep
+
+    def readmit_replica(self, i: int) -> None:
+        """The one path back from ``retired``: a rolled replica that
+        passed its health-gated parity probe re-enters — UNRANKED, so
+        the same ranking pass that admits a joiner re-ranks it next
+        round (rollout readmission and join are the same membership
+        event to the round loop)."""
+        rep = self.replicas[i]
+        rep.retired = False
+        rep.ranked = False
+        self.ledger.rejoin(i)
+
+    def _rank_joiners(self) -> None:
+        """Rank any unranked member whose engine reports ready (the
+        subprocess Hello landed / the in-process engine exists) and is
+        not draining — the supervisor's membership gate feeding the
+        router's, one transition per member."""
+        for rep in self.replicas:
+            if rep.ranked or rep.retired:
+                continue
+            eng = rep.engine
+            if getattr(eng, "ready", True) and not eng.draining:
+                rep.ranked = True
+                self.ledger.rejoin(rep.index)
+                self._t("re_rank", replica=rep.index)
+                if self.fleet_metrics is not None and hasattr(
+                        self.fleet_metrics, "on_ranked"):
+                    self.fleet_metrics.on_ranked(rep.index)
 
     # -- drain (fleet preemption) --------------------------------------
 
@@ -480,7 +538,8 @@ class ReplicaRouter:
 
     # -- the round loop --------------------------------------------------
 
-    def run(self, resume=(), max_rounds: Optional[int] = None) -> dict:
+    def run(self, resume=(), max_rounds: Optional[int] = None,
+            on_round=None) -> dict:
         """Drive the fleet until queue + slots drain (or a preemption
         drains the fleet). Returns ``{rid: (tokens, reason)}`` with
         exactly one terminal record per submitted request — the same
@@ -489,7 +548,15 @@ class ReplicaRouter:
         ``resume`` seeds the migration queue (a previous process's
         persisted drain, restored fleet-wide ahead of admission);
         ``max_rounds`` bounds router rounds (tests / selfcheck) —
-        exceeding it raises instead of hanging."""
+        exceeding it raises instead of hanging.
+
+        ``on_round(router)`` is the control-plane hook, called once at
+        the top of every round — where the autoscaler ticks and the
+        supervisor's rollout machine pumps. A truthy return means
+        membership work is still in flight: the loop then keeps
+        spinning (with a bounded clock nudge) instead of declaring the
+        fleet done, so a rollout's last probe is never orphaned by an
+        empty queue."""
         results: dict = {}
         fleet = self.fleet_metrics
         sched = self.scheduler
@@ -517,6 +584,8 @@ class ReplicaRouter:
                     f"{len(self._assign)} in flight, "
                     f"{sched.queue_depth} queued)")
             self.ledger.begin_round()
+            busy = bool(on_round(self)) if on_round is not None \
+                else False
             # -- preemption: fleet-wide, then per replica -------------
             pt = maybe_fail("router.loop")
             if pt is not None and pt.kind == "preempt":
@@ -535,8 +604,9 @@ class ReplicaRouter:
                     rep.engine.request_drain()
                 if rep.engine.draining:
                     self._retire(rep, pending_resume, results)
+            self._rank_joiners()
             live = self._live()
-            if not live:
+            if not live and not busy:
                 # the whole fleet is gone: whatever work remains is a
                 # drain, not a loss — snapshots wait for the next fleet
                 for rr in pending_resume:
@@ -592,10 +662,17 @@ class ReplicaRouter:
                     self.ledger.mark_current(rep.index)
                 nxt = sched.next_arrival_time()
                 if nxt is None and not pending_resume \
-                        and not self._assign:
+                        and not self._assign and not busy:
                     return results
                 if nxt is not None:
                     sched.wait_until(nxt)
+                    continue
+                if busy:
+                    # membership work in flight (a respawn coming up,
+                    # a probe on the wire): nudge the clock a bounded
+                    # step so the spin is not a hot loop, then let the
+                    # next round's on_round observe progress
+                    sched.wait_until(sched.clock() + 0.02)
                     continue
                 if pending_resume:
                     raise RuntimeError(

@@ -495,6 +495,14 @@ class RequestScheduler:
         return len(self._arrived)
 
     @property
+    def backlog_tokens(self) -> int:
+        """The live queue's running token price (prompt + budgeted
+        decode) — the quantity the admission controller's knee bound
+        is stated in, maintained incrementally so overload checks and
+        the autoscaler read it in O(1)."""
+        return self._arrived_price
+
+    @property
     def unfinished(self) -> int:
         return len(self._arrived) + len(self._future) + len(self._slots)
 

@@ -53,9 +53,26 @@ peer would convert a straggler (the LagLedger's job, recoverable by
 SIGCONT) into a death (a restart, plus a zombie when the original
 thaws).
 
+The fleet is ELASTIC (ISSUE 20): :meth:`ReplicaSupervisor.scale_to`
+changes the member set at runtime — a joining worker spawns, Hellos,
+and enters the router/LagLedger unranked exactly as a replacement
+after a death does (the reference's master re-ranks workers on every
+membership event, PAPER.md L4); a voluntarily retiring worker reuses
+the SIGTERM drain migration, so its in-flight requests resume bitwise
+on survivors, and its logs + labeled metrics series are reclaimed
+(repeated scale cycles stay flat in RSS and registry size).
+:meth:`ReplicaSupervisor.begin_rollout` pushes a new checkpoint
+through the fleet one replica at a time: drain -> respawn with
+checkpoint-backed params -> health-gated parity probe -> readmit,
+with zero dropped requests; a SIGKILL mid-rollout just resumes the
+rollout on the restarted incarnation (the victim's spec was already
+swapped, so the old weights can never be readmitted).
+
 Single-threaded like everything in the serving plane: the supervisor
 has no threads; its event pump runs inside ``RemoteEngine.step()``,
-i.e. inside the router's own round loop. Determinism is therefore the
+i.e. inside the router's own round loop, and the elastic state
+machines (:meth:`pump_rollout`, the autoscaler's ``tick``) run from
+the router's per-round hook. Determinism is therefore the
 same kind the in-process fleet offers — one thread, seeded policies —
 with the honest caveat that real process deaths land at wall-clock
 points; the parity contract (fleet output bitwise == fault-free single
@@ -93,6 +110,11 @@ DEAD = "dead"           # process gone unexpectedly, restart pending
 BACKOFF = "backoff"     # dead, waiting out the restart delay
 STOPPED = "stopped"     # drained and exited on request — no restart
 BROKEN = "broken"       # circuit breaker open — retired from fleet
+
+# probe rids live far below any scheduler rid: the supervisor's
+# rollout parity probes ride ordinary SubmitFrames but never reach the
+# router — _on_msg intercepts their completions by rid range
+PROBE_RID_BASE = -1_000_000
 
 
 @dataclasses.dataclass(frozen=True)
@@ -178,7 +200,8 @@ class _Child:
 
     __slots__ = ("index", "proc", "pid", "addr", "state", "restarts",
                  "restart_at", "backoff_spent", "drain_requested",
-                 "log_path", "breaker", "stopped_since")
+                 "log_path", "breaker", "stopped_since", "incarnation",
+                 "spec", "retiring", "rolling")
 
     def __init__(self, index: int, breaker: CircuitBreaker):
         self.index = index
@@ -186,13 +209,49 @@ class _Child:
         self.pid: Optional[int] = None
         self.addr: Optional[wire.Addr] = None
         self.state = STARTING
-        self.restarts = 0            # completed restarts
+        self.restarts = 0            # completed CRASH restarts (breaker)
         self.restart_at: Optional[float] = None
         self.backoff_spent = 0.0     # cumulative seconds waited
         self.drain_requested = False
         self.log_path: Optional[str] = None
         self.breaker = breaker
         self.stopped_since: Optional[float] = None  # SIGSTOP bookkeeping
+        # incarnation counts EVERY respawn (crash restart or rollout
+        # respawn) — the monotonic value conformance checks on the
+        # "restart" transition. Distinct from restarts: a rollout
+        # respawn is deliberate and must not charge the breaker.
+        self.incarnation = 0
+        self.spec: Optional[ReplicaSpec] = None  # per-child override
+        self.retiring = False        # voluntary scale-in in progress
+        self.rolling = False         # rollout respawn in progress
+
+
+class _Rollout:
+    """One in-progress rolling weight rollout: the target spec, the
+    wave of replicas still to roll, and the per-replica phase machine
+    (drain -> respawn -> probe_wait -> probe -> readmit) that
+    :meth:`ReplicaSupervisor.pump_rollout` advances one transition per
+    router round. ``probe_ref`` is the first rolled replica's probe
+    output — the parity oracle every later replica must match bitwise
+    (all replicas of a wave serve the same weights, so greedy decode
+    of the same probe prompt must agree exactly)."""
+
+    __slots__ = ("spec", "version", "pending", "current", "phase",
+                 "phase_deadline", "stall_timeout_s", "probe_ref",
+                 "probe_inc", "readmitted")
+
+    def __init__(self, spec: ReplicaSpec, version: int,
+                 pending: "list[int]", stall_timeout_s: float):
+        self.spec = spec
+        self.version = version
+        self.pending = pending
+        self.current: Optional[int] = None
+        self.phase = ""
+        self.phase_deadline = 0.0
+        self.stall_timeout_s = stall_timeout_s
+        self.probe_ref: Optional[tuple] = None
+        self.probe_inc = -1
+        self.readmitted: "list[int]" = []
 
 
 class RemoteEngine:
@@ -254,6 +313,11 @@ class RemoteEngine:
         self.remote_cancel_waste = 0   # router-side total, this replica
         self.worker_cancelled_tokens = 0  # worker's cumulative mirror
         self._cancelled_base = 0
+        # the worker's self-reported weight provenance (wire v4): the
+        # checkpoint step it restored, 0 for a param-seed build. NOT
+        # rebased across incarnations — the latest incarnation's
+        # report is the truth the rollout readmission gate reads.
+        self.checkpoint_version = 0
 
     # -- state the router reads ----------------------------------------
 
@@ -271,6 +335,14 @@ class RemoteEngine:
     def draining(self) -> bool:
         return (self._worker_draining
                 or self._sup.state(self.index) in (STOPPED, BROKEN))
+
+    @property
+    def ready(self) -> bool:
+        """The router's ranking gate: a joined (or rolled) replica is
+        ranked into the dispatch rotation only once its process is UP
+        and admitting — the supervisor-side analogue of the master
+        re-ranking a worker after its Hello (PAPER.md L4)."""
+        return self._sup.accepting(self.index)
 
     def can_admit(self, req: Request, emitted: tuple = ()) -> bool:
         if not self._sup.accepting(self.index):
@@ -335,6 +407,7 @@ class RemoteEngine:
             self.worker_cancelled_tokens = max(
                 self.worker_cancelled_tokens,
                 self._cancelled_base + msg.cancelled_tokens)
+            self.checkpoint_version = msg.checkpoint_version
             if msg.draining:
                 self._worker_draining = True
 
@@ -357,6 +430,18 @@ class RemoteEngine:
         self._evictions_base = self.evictions
         self._cancelled_base = self.worker_cancelled_tokens
         self._cancelled_rids.clear()
+
+    def _on_respawn(self) -> None:
+        """A DELIBERATE respawn (rollout): the previous incarnation
+        drained and exited on request, so the drain latches must reset
+        for the replacement to admit again. Crash restarts never set
+        them; the monotonic mirrors re-anchor on Hello either way
+        (:meth:`_on_incarnation`)."""
+        self._worker_draining = False
+        self._drain_sent = False
+        self._drain_done = None
+        self._resume_in.clear()
+        self._dead_pending = False
 
     @property
     def prefill_shapes(self) -> frozenset:
@@ -647,6 +732,10 @@ class ReplicaSupervisor:
         self.engines: "list[RemoteEngine]" = [
             RemoteEngine(self, i, self.spec) for i in range(replicas)]
         self._pending_conts: "list[tuple[float, int]]" = []
+        self._rollout: Optional[_Rollout] = None
+        # probe completions keyed by replica index:
+        # (incarnation at receipt, tokens, reason)
+        self._probe_results: "dict[int, tuple]" = {}
         if fleet is not None and hasattr(fleet, "attach_supervisor"):
             fleet.attach_supervisor(self)
         for child in self._children:
@@ -657,11 +746,12 @@ class ReplicaSupervisor:
 
     def _spawn(self, child: _Child) -> None:
         i = child.index
+        spec = child.spec if child.spec is not None else self.spec
         child.log_path = os.path.join(
-            self.log_dir, f"replica{i}.{child.restarts}.log")
+            self.log_dir, f"replica{i}.{child.incarnation}.log")
         env = dict(os.environ)
-        if self.spec.platform:
-            env["JAX_PLATFORMS"] = self.spec.platform
+        if spec.platform:
+            env["JAX_PLATFORMS"] = spec.platform
         # make the package importable from wherever the parent runs
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -676,7 +766,7 @@ class ReplicaSupervisor:
                  "replica-worker",
                  "--connect", f"{host}:{port}",
                  "--replica", str(i),
-                 "--spec", self.spec.to_json()],
+                 "--spec", spec.to_json()],
                 stdout=logf, stderr=subprocess.STDOUT, env=env)
         finally:
             logf.close()
@@ -687,15 +777,17 @@ class ReplicaSupervisor:
         if self.tracer is not None:
             self.tracer.record("replica_spawned", replica=i,
                                pid=child.pid,
-                               incarnation=child.restarts)
+                               incarnation=child.incarnation)
 
     def _wait_ready(self) -> None:
         deadline = time.monotonic() + self.spawn_timeout_s
         while time.monotonic() < deadline:
-            if all(c.state == UP for c in self._children):
+            if all(c.state == UP for c in self._children
+                   if not c.retiring):
                 return
             self.pump(0.05)
-        down = [c.index for c in self._children if c.state != UP]
+        down = [c.index for c in self._children
+                if c.state != UP and not c.retiring]
         tails = []
         for i in down:
             path = self._children[i].log_path
@@ -733,9 +825,19 @@ class ReplicaSupervisor:
                 self.tracer.record("replica_up", replica=i,
                                    pid=child.pid)
                 self.tracer.record_transition("restart", replica=i,
-                                              inc=child.restarts)
+                                              inc=child.incarnation)
 
     def _on_msg(self, msg) -> None:
+        if isinstance(msg, wire.CompletionFrame) \
+                and msg.rid <= PROBE_RID_BASE:
+            # a rollout parity-probe answer: supervisor-internal, the
+            # router never sees these rids
+            i = msg.replica
+            if 0 <= i < len(self._children):
+                self._probe_results[i] = (
+                    self._children[i].incarnation,
+                    tuple(int(t) for t in msg.tokens), msg.reason)
+            return
         if isinstance(msg, (wire.CompletionFrame, wire.HealthFrame,
                             wire.ResumeFrame, wire.DrainDoneFrame)):
             i = msg.replica
@@ -784,6 +886,8 @@ class ReplicaSupervisor:
                                        replica=child.index, rc=rc)
                     self.tracer.record_transition(
                         "stopped", replica=child.index)
+                if child.retiring:
+                    self._cleanup_retired(child)
                 continue
             # unexpected death: fail over + schedule restart
             engine._on_death()
@@ -823,6 +927,7 @@ class ReplicaSupervisor:
             if child.state == BACKOFF and child.restart_at is not None \
                     and now >= child.restart_at:
                 child.restarts += 1
+                child.incarnation += 1
                 if self.fleet is not None and hasattr(
                         self.fleet, "on_replica_restarted"):
                     self.fleet.on_replica_restarted(child.index)
@@ -912,6 +1017,309 @@ class ReplicaSupervisor:
         router migrates the snapshots on its next round."""
         self.kill(i, signal.SIGTERM)
 
+    # -- elastic membership (ISSUE 20) ------------------------------------
+
+    def live_count(self) -> int:
+        """Members currently serving or coming up — the fleet-size
+        gauge, and the denominator the autoscaler reasons about."""
+        return sum(1 for c in self._children
+                   if c.state in (STARTING, UP) and not c.retiring)
+
+    def checkpoint_version(self, i: int) -> int:
+        return self.engines[i].checkpoint_version
+
+    def add_replica(self, spec: Optional[ReplicaSpec] = None,
+                    wait: bool = False) -> RemoteEngine:
+        """Grow the member set by one: spawn a worker at the next
+        index and hand back its engine proxy for
+        :meth:`~akka_allreduce_tpu.serving.router.ReplicaRouter
+        .add_replica`. The join is asynchronous by default — the
+        worker enters the router UNRANKED and is ranked on its Hello,
+        exactly the path a replacement after a death takes — so a
+        scale-out never stalls the serving loop on a jax import."""
+        i = len(self._children)
+        child = _Child(i, CircuitBreaker(self.budget))
+        if spec is not None:
+            child.spec = spec.captured()
+        self._children.append(child)
+        eng = RemoteEngine(self, i,
+                           child.spec if child.spec is not None
+                           else self.spec)
+        self.engines.append(eng)
+        if self.fleet is not None and hasattr(self.fleet,
+                                              "add_replica"):
+            self.fleet.add_replica()
+        if self.tracer is not None:
+            # the JOIN transition is the router's to emit (the member
+            # enters ITS ranking) — this record is the ops event only
+            self.tracer.record("replica_joining", replica=i)
+        self._spawn(child)
+        if wait:
+            deadline = time.monotonic() + self.spawn_timeout_s
+            while child.state != UP and time.monotonic() < deadline:
+                self.pump(0.05)
+            if child.state != UP:
+                raise RuntimeError(
+                    f"joining replica {i} not ready within "
+                    f"{self.spawn_timeout_s}s (state={child.state})")
+        return eng
+
+    def retire_replica(self, i: int) -> bool:
+        """Shrink the member set by one, voluntarily: SIGTERM-drain
+        replica ``i`` so its in-flight requests migrate to survivors
+        bitwise (the scale-in path IS the decommission path), then
+        reclaim its logs and labeled metrics series when it exits —
+        repeated scale cycles must leave the process flat (satellite:
+        the PR 15 soak asserts)."""
+        child = self._children[i]
+        if child.retiring or child.state not in (STARTING, UP):
+            return False
+        child.retiring = True
+        if self.tracer is not None:
+            self.tracer.record("replica_retiring", replica=i)
+            self.tracer.record_transition("scale_in", replica=i)
+        self.request_drain(i)
+        return True
+
+    def scale_to(self, n: int, router=None) -> "tuple[list, list]":
+        """Steer the live member count toward ``n``: spawn joins above
+        the current count, SIGTERM-drain the highest-index live
+        members below it. Returns ``(added_engines,
+        retiring_indices)``; when ``router`` is given, joins are wired
+        into it here (retires need no wiring — the router observes the
+        drain and migrates)."""
+        if n < 1:
+            raise ValueError(f"cannot scale below 1 replica, got {n}")
+        live = [c.index for c in self._children
+                if c.state in (STARTING, UP) and not c.retiring]
+        added, retiring = [], []
+        while len(live) < n:
+            eng = self.add_replica()
+            live.append(eng.index)
+            added.append(eng)
+            if router is not None:
+                router.add_replica(eng)
+        while len(live) > n:
+            i = live.pop()
+            if self.retire_replica(i):
+                retiring.append(i)
+        return added, retiring
+
+    def _cleanup_retired(self, child: _Child) -> None:
+        # voluntary retire leaves nothing behind: per-incarnation logs
+        # (only in a self-created temp dir — an operator-given log_dir
+        # keeps its triage material) and the replica's labeled metrics
+        # series, so scale cycles keep RSS and registry size flat
+        if self._own_log_dir:
+            import glob
+            for p in glob.glob(os.path.join(
+                    self.log_dir, f"replica{child.index}.*.log")):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        if self.fleet is not None and hasattr(self.fleet,
+                                              "on_voluntary_retire"):
+            self.fleet.on_voluntary_retire(child.index)
+        if self.tracer is not None:
+            self.tracer.record("replica_retired_voluntary",
+                               replica=child.index)
+
+    # -- rolling weight rollouts (ISSUE 20) -------------------------------
+
+    @property
+    def rollout_active(self) -> bool:
+        return self._rollout is not None
+
+    def rollout_status(self) -> Optional[dict]:
+        ro = self._rollout
+        if ro is None:
+            return None
+        return {"version": ro.version, "current": ro.current,
+                "phase": ro.phase, "pending": list(ro.pending),
+                "readmitted": list(ro.readmitted)}
+
+    def begin_rollout(self, ckpt_dir: str,
+                      step: Optional[int] = None,
+                      stall_timeout_s: float = 120.0) -> int:
+        """Start a rolling weight rollout to the checkpoint at
+        ``ckpt_dir`` (``step`` None = latest, resolved HERE so every
+        replica of the wave pins the same step). The rollout is a
+        state machine advanced by :meth:`pump_rollout` from the
+        router's round loop — one replica at a time: drain (in-flight
+        work migrates to survivors bitwise), respawn with
+        checkpoint-backed params, health-gated parity probe, readmit.
+        Returns the target version (the pinned step)."""
+        if self._rollout is not None:
+            raise RuntimeError("a rollout is already in progress")
+        if step is None:
+            from akka_allreduce_tpu.runtime.checkpoint import (
+                CheckpointConfig,
+                CheckpointManager,
+            )
+            with CheckpointManager(CheckpointConfig(
+                    directory=ckpt_dir)) as mgr:
+                step = mgr.latest_step()
+            if step is None:
+                raise ValueError(f"no checkpoint under {ckpt_dir}")
+        spec = dataclasses.replace(self.spec, ckpt_dir=ckpt_dir,
+                                   ckpt_step=int(step))
+        pending = [c.index for c in self._children
+                   if c.state in (STARTING, UP) and not c.retiring]
+        if not pending:
+            raise RuntimeError("no live replicas to roll")
+        self._rollout = _Rollout(spec, int(step), pending,
+                                 stall_timeout_s)
+        if self.tracer is not None:
+            self.tracer.record("rollout_started", version=int(step),
+                               replicas=list(pending))
+            self.tracer.record_transition("rollout_started",
+                                          version=int(step))
+        if self.fleet is not None and hasattr(self.fleet,
+                                              "on_rollout_started"):
+            self.fleet.on_rollout_started(int(step))
+        return int(step)
+
+    def _finish_rollout(self, outcome: str) -> None:
+        ro = self._rollout
+        self._rollout = None
+        if outcome == "completed":
+            # future joins / crash restarts build the new weights —
+            # the OLD spec is gone, it can never be readmitted
+            self.spec = ro.spec
+            for child in self._children:
+                child.spec = None
+        if self.tracer is not None:
+            self.tracer.record(f"rollout_{outcome}",
+                               version=ro.version,
+                               readmitted=list(ro.readmitted))
+            self.tracer.record_transition(f"rollout_{outcome}",
+                                          version=ro.version)
+        if self.fleet is not None:
+            hook = getattr(self.fleet, f"on_rollout_{outcome}", None)
+            if hook is not None:
+                hook(ro.version)
+
+    def pump_rollout(self, router=None) -> None:
+        """Advance the rollout state machine by at most one phase.
+        Call once per router round (the ``on_round`` hook) — the
+        machine is deliberately slow-is-smooth: at most one replica is
+        ever out of rotation, so fleet capacity never dips by more
+        than one replica's slots (the zero-downtime contract). A
+        replica that dies mid-roll (SIGKILL chaos) just re-enters the
+        machine on its restarted incarnation: its spec was swapped
+        BEFORE the drain, so any respawn path builds the new weights.
+        A phase stuck past ``stall_timeout_s`` aborts the rollout
+        (OPERATIONS.md "Stuck rollout")."""
+        ro = self._rollout
+        if ro is None:
+            return
+        now = time.monotonic()
+        if ro.current is None:
+            while ro.pending:
+                c = self._children[ro.pending[0]]
+                if c.state in (STARTING, UP) and not c.retiring:
+                    break
+                if c.state in (DEAD, BACKOFF):
+                    return  # let the restart machinery bring it back
+                ro.pending.pop(0)  # BROKEN/STOPPED left the fleet
+            if not ro.pending:
+                self._finish_rollout("completed")
+                return
+            i = ro.pending.pop(0)
+            child = self._children[i]
+            child.spec = ro.spec
+            child.rolling = True
+            ro.current = i
+            ro.phase = "drain"
+            ro.phase_deadline = now + ro.stall_timeout_s
+            if self.tracer is not None:
+                self.tracer.record_transition(
+                    "rollout_drain", replica=i, version=ro.version)
+            self.request_drain(i)
+            return
+        i = ro.current
+        child = self._children[i]
+        eng = self.engines[i]
+        if child.state == BROKEN:
+            self._finish_rollout("aborted")
+            return
+        if now > ro.phase_deadline:
+            log.error("rollout stuck in phase %r on replica %d for "
+                      "%.0fs — aborting", ro.phase, i,
+                      ro.stall_timeout_s)
+            self._finish_rollout("aborted")
+            return
+        if ro.phase == "drain":
+            # wait for the router to migrate the drained in-flight
+            # work off this replica BEFORE respawning: respawning
+            # first would flip engine.draining back to False and the
+            # router would never retire (= never migrate) it
+            retired = (router.replicas[i].retired
+                       if router is not None else True)
+            if retired and child.state == STOPPED:
+                child.incarnation += 1
+                eng._on_respawn()
+                self._spawn(child)
+                ro.phase = "probe_wait"
+                ro.phase_deadline = now + ro.stall_timeout_s
+            return
+        if child.state in (DEAD, BACKOFF, STARTING):
+            # died mid-probe (SIGKILL chaos): the restart machinery
+            # respawns it — with the NEW spec — and the probe restarts
+            # from scratch against the fresh incarnation
+            ro.phase = "probe_wait"
+            ro.phase_deadline = now + ro.stall_timeout_s
+            return
+        if ro.phase == "probe_wait":
+            if (child.state == UP and not eng._worker_draining
+                    and eng.checkpoint_version == ro.version
+                    and eng.occupied == 0):
+                # health gate passed: the NEW incarnation is up,
+                # admitting, idle, and self-reports the target
+                # weights — now the parity probe
+                ro.probe_inc = child.incarnation
+                self._probe_results.pop(i, None)
+                vocab = self.spec.vocab_size
+                prompt = tuple(1 + (j % max(1, vocab - 1))
+                               for j in range(4))
+                self.send(i, wire.SubmitFrame(
+                    rid=PROBE_RID_BASE - i, prompt=prompt,
+                    max_new_tokens=4))
+                ro.phase = "probe"
+                ro.phase_deadline = now + ro.stall_timeout_s
+            return
+        if ro.phase == "probe":
+            res = self._probe_results.get(i)
+            if res is None:
+                return
+            inc, tokens, reason = res
+            if inc != child.incarnation or inc != ro.probe_inc:
+                return  # stale ack from a dead incarnation
+            del self._probe_results[i]
+            ok = reason in ("eos", "stop", "max_tokens")
+            if ok and ro.probe_ref is None:
+                ro.probe_ref = tokens
+            elif ok:
+                ok = tokens == ro.probe_ref
+            if not ok:
+                log.error(
+                    "rollout parity probe FAILED on replica %d "
+                    "(reason=%s) — aborting, replica stays out of "
+                    "rotation", i, reason)
+                self._finish_rollout("aborted")
+                return
+            child.rolling = False
+            ro.readmitted.append(i)
+            if self.tracer is not None:
+                self.tracer.record_transition(
+                    "rollout_readmit", replica=i,
+                    version=eng.checkpoint_version,
+                    inc=child.incarnation)
+            if router is not None:
+                router.readmit_replica(i)
+            ro.current = None
+
     def _fire_chaos(self, kind: str, count: int) -> None:
         if self.chaos is not None:
             self.chaos.on_event(kind, count, self)
@@ -933,9 +1341,12 @@ class ReplicaSupervisor:
         # a self-created log dir is cleaned on an UNEVENTFUL shutdown;
         # any restart or open breaker leaves the per-incarnation logs
         # behind — they are the triage material the OPERATIONS.md
-        # runbook points at
+        # runbook points at. Voluntarily retired members don't count:
+        # their logs were already reclaimed at retire time, and an
+        # eventful LIFE (scale cycles) is not an eventful shutdown.
         if self._own_log_dir \
-                and not any(c.restarts or c.breaker.open
+                and not any((c.restarts or c.breaker.open)
+                            and not c.retiring
                             for c in self._children):
             import shutil
             shutil.rmtree(self.log_dir, ignore_errors=True)

@@ -38,7 +38,12 @@ parameter seed, and the parent's jax compilation config
 (``disable_most_optimizations`` changes numerics at the fusion level,
 so a worker MUST match the router process or the fleet's bitwise
 parity contract silently breaks). ``init_transformer(key(seed))`` is
-deterministic across processes, so no checkpoint crosses the wire.
+deterministic across processes, so by default no checkpoint crosses
+the wire; with ``ckpt_dir`` set only the checkpoint REFERENCE crosses
+(on argv, inside the spec) — the weights load from shared storage,
+and the worker reports the restored step back as
+``checkpoint_version`` on every HealthFrame so the supervisor's
+rollout gate verifies provenance instead of assuming it.
 
 Clock domains: a ``SubmitFrame``/``ResumeFrame`` ``deadline`` field
 arriving here carries REMAINING SECONDS (the supervisor's proxy
@@ -94,6 +99,19 @@ class ReplicaSpec:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     kv_dtype: Optional[str] = None
+    # -- prefill shape discipline crosses the spec (ROADMAP direction
+    # 1 fabric gap): without it a subprocess fleet pads prefills
+    # differently from the in-process engine and the compile-count
+    # contract diverges per replica
+    prefill_buckets: "tuple[int, ...]" = ()
+    # -- checkpoint-backed params (ISSUE 20 rolling rollouts): when
+    # ckpt_dir is set the worker restores the "params" item from that
+    # directory instead of rebuilding from param_seed. ckpt_step pins
+    # the step (None = latest at restore time — a rollout always pins
+    # it so every replica of a wave serves identical weights); the
+    # restored step is the replica's checkpoint_version on the wire.
+    ckpt_dir: Optional[str] = None
+    ckpt_step: Optional[int] = None
     # -- runtime / determinism plane
     platform: Optional[str] = None
     disable_most_optimizations: Optional[bool] = None
@@ -123,7 +141,12 @@ class ReplicaSpec:
 
     @classmethod
     def from_json(cls, s: str) -> "ReplicaSpec":
-        return cls(**json.loads(s))
+        d = json.loads(s)
+        # JSON has no tuple: restore the bucket list to the tuple the
+        # frozen spec (and EngineConfig validation) expects
+        if "prefill_buckets" in d:
+            d["prefill_buckets"] = tuple(d["prefill_buckets"])
+        return cls(**d)
 
 
 def _apply_runtime(spec: ReplicaSpec) -> None:
@@ -165,20 +188,43 @@ def _build_engine(spec: ReplicaSpec):
         n_heads=spec.n_heads, n_layers=spec.n_layers, d_ff=spec.d_ff,
         max_seq=spec.max_seq)
     params = init_transformer(jax.random.key(spec.param_seed), mcfg)
+    ckpt_version = 0
+    if spec.ckpt_dir:
+        # checkpoint-backed params: the seed-built tree is only the
+        # restore TEMPLATE (shape/dtype structure); the weights come
+        # from the checkpoint's standalone "params" item, so the
+        # restore is optimizer-agnostic (runtime/checkpoint.py save()
+        # contract). The restored step becomes the worker's
+        # checkpoint_version — self-reported provenance, not an echo
+        # of what the parent asked for.
+        from akka_allreduce_tpu.runtime.checkpoint import (
+            CheckpointConfig,
+            CheckpointManager,
+        )
+        with CheckpointManager(CheckpointConfig(
+                directory=spec.ckpt_dir)) as mgr:
+            step, params, _ = mgr.restore_params(
+                params, step=spec.ckpt_step)
+        ckpt_version = int(step)
     sample_kw = dict(temperature=spec.temperature, top_k=spec.top_k,
                      top_p=spec.top_p, kv_dtype=spec.kv_dtype)
     if spec.paged:
+        if spec.prefill_buckets:
+            raise ValueError(
+                "prefill_buckets is a slot-engine knob; paged prefill "
+                "is exact-length (same rule as PagedEngineConfig)")
         ecfg = PagedEngineConfig(
             num_slots=spec.num_slots, decode_steps=spec.decode_steps,
             watchdog_timeout_s=spec.watchdog_timeout_s or None,
             page_size=spec.page_size, num_pages=spec.num_pages,
             **sample_kw)
-        return PagedServingEngine(params, mcfg, ecfg)
+        return PagedServingEngine(params, mcfg, ecfg), ckpt_version
     ecfg = EngineConfig(
         num_slots=spec.num_slots, decode_steps=spec.decode_steps,
         watchdog_timeout_s=spec.watchdog_timeout_s or None,
+        prefill_buckets=tuple(spec.prefill_buckets),
         **sample_kw)
-    return ServingEngine(params, mcfg, ecfg)
+    return ServingEngine(params, mcfg, ecfg), ckpt_version
 
 
 def run_replica_worker(spec: ReplicaSpec, connect: "tuple[str, int]",
@@ -197,7 +243,7 @@ def run_replica_worker(spec: ReplicaSpec, connect: "tuple[str, int]",
     from akka_allreduce_tpu.protocol import wire
     from akka_allreduce_tpu.protocol.tcp import TcpRouter
 
-    engine = _build_engine(spec)
+    engine, ckpt_version = _build_engine(spec)
 
     inbox: deque = deque()
     # The local failure detector is OFF in both directions of the
@@ -248,7 +294,8 @@ def run_replica_worker(spec: ReplicaSpec, connect: "tuple[str, int]",
             watchdog_trips=engine.watchdog_trips,
             evictions=engine.evictions,
             prefill_programs=len(engine.prefill_shapes),
-            cancelled_tokens=cancelled_tokens))
+            cancelled_tokens=cancelled_tokens,
+            checkpoint_version=ckpt_version))
 
     def send_completions(completions) -> None:
         for _slot, req, tokens, reason in completions:

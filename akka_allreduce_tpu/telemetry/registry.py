@@ -303,6 +303,22 @@ class MetricsRegistry:
         self._add(_Series(name, "histogram", help, pull=pull,
                           labels=labels))
 
+    def drop_labeled(self, label: str, value: str) -> int:
+        """Unregister EVERY series carrying ``label == value`` —
+        the label-hygiene primitive for elastic membership: a
+        voluntarily retired replica's labeled series leave the export
+        surface with it, so repeated scale cycles keep the registry
+        (and every scrape) flat instead of accreting dead children.
+        Returns the number of series dropped. Names whose other
+        children survive keep exporting; a dropped cell owned by a
+        still-live component simply stops being exported."""
+        with self._lock:
+            doomed = [k for k, s in self._series.items()
+                      if s.labels.get(label) == value]
+            for k in doomed:
+                del self._series[k]
+        return len(doomed)
+
     # -- introspection --------------------------------------------------
 
     def value(self, name: str, labels: Optional[dict] = None) -> Any:

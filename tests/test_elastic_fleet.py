@@ -1,0 +1,964 @@
+"""Elastic fleet (ISSUE 20): runtime membership, knee-driven
+autoscaling, and zero-downtime rolling weight rollouts.
+
+THE acceptance property: a rolling update over a LIVE subprocess fleet
+under traffic finishes with zero dropped requests, migrated streams
+resumed bitwise, and every replica self-reporting the new
+``checkpoint_version`` — while ``scale_to`` / the autoscaler move
+membership at runtime through the SAME join (Hello -> unranked ->
+ranked) and leave (SIGTERM drain -> migrate) paths deaths and
+replacements already take. Everything here conforms to the extended
+fleet model (analysis/fleet_model.py: join / re_rank / scale_in /
+rollout_*) via the trace checker.
+
+The fast tier covers the host-side machinery (ledger growth, the
+autoscaler's hysteresis/cooldown/health holds against fakes, spec
+transport, metrics-registry reclamation) plus in-process membership
+churn and one real-subprocess cell per elastic family (spec parity,
+scale cycle, rollout). The chaos-during-elasticity matrix (SIGKILL
+the mid-roll replica, SIGSTOP a survivor during scale-in, diurnal
+scale cycles) rides the ``slow`` marker; the CI drill is
+``serve --selfcheck --elastic`` (cli.py).
+
+Model shapes are tiny and unique to this file.
+"""
+
+import dataclasses
+import json
+import signal
+import time
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from akka_allreduce_tpu.analysis.fleet_conform import assert_conformant
+from akka_allreduce_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from akka_allreduce_tpu.runtime.checkpoint import (
+    CheckpointConfig,
+    CheckpointManager,
+)
+from akka_allreduce_tpu.runtime.tracing import Tracer
+from akka_allreduce_tpu.serving import (
+    AutoscaleConfig,
+    Autoscaler,
+    EngineConfig,
+    FleetMetrics,
+    LagLedger,
+    ReplicaRouter,
+    ReplicaSpec,
+    ReplicaSupervisor,
+    Request,
+    RequestScheduler,
+    RetryPolicy,
+    RouterConfig,
+    SchedulerConfig,
+    ServingEngine,
+    serve_loop,
+)
+from akka_allreduce_tpu.telemetry.registry import MetricsRegistry
+
+CFG = TransformerConfig(vocab_size=61, d_model=32, n_heads=2,
+                        n_layers=2, d_ff=64, max_seq=40)
+SLOTS = 2
+N_REQ = 8
+
+SPEC = ReplicaSpec(vocab_size=CFG.vocab_size, d_model=CFG.d_model,
+                   n_heads=CFG.n_heads, n_layers=CFG.n_layers,
+                   d_ff=CFG.d_ff, max_seq=CFG.max_seq,
+                   num_slots=SLOTS, param_seed=0)
+
+SUCCESS = ("eos", "stop", "max_tokens")
+
+
+def make_requests(n=N_REQ, seed=31, budget=6):
+    rng = np.random.default_rng(seed)
+    return [Request(
+        rid=rid,
+        prompt=tuple(int(x) for x in rng.integers(
+            0, CFG.vocab_size, size=int(rng.integers(2, 6)))),
+        max_new_tokens=budget,
+        eos_token=4 if rid % 2 else None,
+        submitted_at=0.0) for rid in range(n)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def baseline(params):
+    """Fault-free single-engine truth — the bitwise target for every
+    membership-churn run over the same requests."""
+    engine = ServingEngine(params, CFG, EngineConfig(num_slots=SLOTS))
+    sched = RequestScheduler(SchedulerConfig(), num_slots=SLOTS)
+    for r in make_requests():
+        sched.submit(r)
+    return serve_loop(engine, sched, max_dispatches=2000)
+
+
+def assert_parity(baseline, results, tag=""):
+    for rid, (toks, reason) in baseline.items():
+        got = results.get(rid)
+        assert got is not None, f"{tag}: rid={rid} missing"
+        assert list(got[0]) == list(toks) and got[1] == reason, (
+            f"{tag}: rid={rid} fleet ({got[1]}) {list(got[0])} != "
+            f"single-engine ({reason}) {list(toks)}")
+
+
+# ---------------------------------------------------------------------------
+# LagLedger growth
+# ---------------------------------------------------------------------------
+
+
+class TestLagLedgerGrowth:
+    def test_grow_adds_current_members(self):
+        led = LagLedger(2, max_lag=2)
+        for _ in range(5):
+            led.begin_round()
+        led.grow(1)
+        assert len(led.degraded) == 3
+        # the joiner starts CURRENT: no instant degrade for rounds it
+        # never saw
+        led.begin_round()
+        assert not led.check_degrade(2)
+        assert led.lag(2) == 1
+
+    def test_rejoin_clears_lag_and_degradation(self):
+        led = LagLedger(2, max_lag=2)
+        for _ in range(6):
+            led.begin_round()
+            led.on_progress(0)
+        assert led.check_degrade(1)
+        led.rejoin(1)
+        assert not led.degraded[1]
+        assert led.lag(1) == 0
+
+    def test_grow_rejects_nonpositive(self):
+        led = LagLedger(2, max_lag=2)
+        with pytest.raises(ValueError):
+            led.grow(0)
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler units (fakes: no jax, scripted clock)
+# ---------------------------------------------------------------------------
+
+
+class FakeEngine:
+    def __init__(self, num_slots=2):
+        self.num_slots = num_slots
+        self.draining = False
+        self.occupied = 0
+        self.drains = 0
+
+    def request_drain(self):
+        self.drains += 1
+        self.draining = True
+
+
+class FakeRep:
+    def __init__(self, index, engine):
+        self.index = index
+        self.engine = engine
+        self.retired = False
+        self.ranked = True
+
+    @property
+    def live(self):
+        return not self.retired and self.ranked
+
+    @property
+    def occupied(self):
+        return self.engine.occupied
+
+
+class FakeSched:
+    def __init__(self):
+        self.now = 0.0
+        self.backlog_tokens = 0
+        self.queue_depth = 0
+        self.admission = None
+
+    def clock(self):
+        return self.now
+
+
+class FakeRouter:
+    def __init__(self, n=2, slots=2):
+        self.scheduler = FakeSched()
+        self.replicas = [FakeRep(i, FakeEngine(slots))
+                         for i in range(n)]
+        self.fleet_metrics = None
+        self.transitions = []
+
+    def _t(self, t, **kw):
+        self.transitions.append((t, kw))
+
+    def add_replica(self, engine):
+        rep = FakeRep(len(self.replicas), engine)
+        rep.ranked = False
+        self.replicas.append(rep)
+        return rep
+
+
+class FakeSup:
+    def __init__(self, n=2):
+        self.engines = [object()] * n
+        self.states = ["up"] * n
+        self.breakers = [False] * n
+        self.rollout_active = False
+        self.scale_calls = []
+        self.retired = []
+
+    def state(self, i):
+        return self.states[i]
+
+    def breaker_open(self, i):
+        return self.breakers[i]
+
+    def scale_to(self, n, router=None):
+        self.scale_calls.append(n)
+
+    def retire_replica(self, i):
+        self.retired.append(i)
+        return True
+
+
+# est_drain = backlog * tpot / slots; with 2x2 slots and tpot=0.1 the
+# 0.8 * 10s knee trips at backlog >= 320 tokens
+ACFG = AutoscaleConfig(min_replicas=1, max_replicas=4,
+                       scale_out_frac=0.8, scale_out_hold_s=0.25,
+                       scale_in_occupancy=0.05, scale_in_hold_s=5.0,
+                       cooldown_s=10.0, overload_backlog_s=10.0,
+                       tpot_estimate=0.1)
+
+
+class TestAutoscalerVerdicts:
+    def test_scale_out_needs_sustained_overload(self):
+        rt = FakeRouter()
+        asc = Autoscaler(ACFG, spawn=lambda: FakeEngine())
+        rt.scheduler.backlog_tokens = 400
+        assert asc.tick(rt) is None          # window opens
+        rt.scheduler.now = 0.1
+        assert asc.tick(rt) is None          # still inside the hold
+        rt.scheduler.now = 0.3
+        assert asc.tick(rt) == "out"
+        assert len(rt.replicas) == 3
+        assert not rt.replicas[2].ranked     # joins UNRANKED
+        assert asc.scale_out_events == 1
+
+    def test_transient_spike_resets_the_window(self):
+        rt = FakeRouter()
+        asc = Autoscaler(ACFG, spawn=lambda: FakeEngine())
+        rt.scheduler.backlog_tokens = 400
+        asc.tick(rt)
+        rt.scheduler.now, rt.scheduler.backlog_tokens = 0.1, 0
+        asc.tick(rt)                          # dips below: reset
+        rt.scheduler.now, rt.scheduler.backlog_tokens = 0.2, 400
+        asc.tick(rt)
+        rt.scheduler.now = 0.4                # 0.2s into the NEW window
+        assert asc.tick(rt) is None
+        rt.scheduler.now = 0.5
+        assert asc.tick(rt) == "out"
+
+    def test_cooldown_rate_limits(self):
+        rt = FakeRouter()
+        asc = Autoscaler(ACFG, spawn=lambda: FakeEngine())
+        rt.scheduler.backlog_tokens = 400
+        asc.tick(rt)
+        rt.scheduler.now = 0.3
+        assert asc.tick(rt) == "out"
+        rt.replicas[2].ranked = True          # joiner settled
+        rt.scheduler.backlog_tokens = 600     # still past the knee
+        rt.scheduler.now = 1.0                # over again, hold passed
+        asc.tick(rt)
+        rt.scheduler.now = 2.0
+        assert asc.tick(rt) is None           # cooldown blocks
+        assert asc.holds >= 1
+        rt.scheduler.now = 11.0
+        assert asc.tick(rt) == "out"          # cooldown expired
+
+    def test_max_replicas_caps_scale_out(self):
+        rt = FakeRouter(n=4)
+        asc = Autoscaler(ACFG, spawn=lambda: FakeEngine())
+        rt.scheduler.backlog_tokens = 4000
+        rt.scheduler.now = 1.0
+        asc.tick(rt)
+        rt.scheduler.now = 2.0
+        assert asc.tick(rt) is None
+        assert len(rt.replicas) == 4
+
+    def test_pending_joiner_blocks_another_scale_out(self):
+        rt = FakeRouter()
+        asc = Autoscaler(dataclasses.replace(ACFG, cooldown_s=0.0),
+                         spawn=lambda: FakeEngine())
+        rt.scheduler.backlog_tokens = 4000
+        asc.tick(rt)
+        rt.scheduler.now = 0.3
+        assert asc.tick(rt) == "out"
+        rt.scheduler.now = 1.0                # joiner still unranked
+        asc.tick(rt)
+        rt.scheduler.now = 2.0
+        assert asc.tick(rt) is None
+        rt.replicas[2].ranked = True          # joiner earned its rank
+        rt.scheduler.now = 3.0
+        assert asc.tick(rt) == "out"
+
+    def test_scale_in_on_sustained_idle_retires_highest_index(self):
+        rt = FakeRouter(n=3)
+        asc = Autoscaler(ACFG)
+        assert asc.tick(rt) is None           # idle window opens
+        rt.scheduler.now = 5.1
+        assert asc.tick(rt) == "in"
+        assert rt.replicas[2].engine.draining
+        assert rt.transitions == [("scale_in", {"replica": 2})]
+        assert asc.scale_in_events == 1
+
+    def test_min_replicas_floor(self):
+        rt = FakeRouter(n=1)
+        asc = Autoscaler(ACFG)
+        rt.scheduler.now = 10.0
+        assert asc.tick(rt) is None
+
+    def test_occupancy_blocks_scale_in(self):
+        rt = FakeRouter(n=2)
+        asc = Autoscaler(ACFG)
+        rt.replicas[0].engine.occupied = 1    # 25% occupied
+        rt.scheduler.now = 10.0
+        assert asc.tick(rt) is None
+
+    def test_supervisor_verbs_are_used(self):
+        rt = FakeRouter(n=3)
+        sup = FakeSup(3)
+        asc = Autoscaler(ACFG, supervisor=sup)
+        asc.tick(rt)                          # idle window opens
+        rt.scheduler.now = 5.1
+        assert asc.tick(rt) == "in"
+        assert sup.retired == [2]
+        rt.scheduler.backlog_tokens = 4000
+        rt.replicas[2].retired = True
+        rt.scheduler.now = 16.0
+        asc.tick(rt)
+        rt.scheduler.now = 16.3
+        assert asc.tick(rt) == "out"
+        assert sup.scale_calls == [3]
+
+    @pytest.mark.parametrize("ail", [
+        dict(rollout_active=True),
+        dict(states=["up", "dead"]),
+        dict(states=["up", "backoff"]),
+        dict(breakers=[False, True]),
+    ], ids=["mid-rollout", "dead-child", "backoff-child",
+            "breaker-open"])
+    def test_unhealthy_fleet_holds(self, ail):
+        rt = FakeRouter(n=2)
+        sup = FakeSup(2)
+        for k, v in ail.items():
+            setattr(sup, k, v)
+        asc = Autoscaler(ACFG, supervisor=sup)
+        rt.scheduler.backlog_tokens = 4000
+        asc.tick(rt)
+        rt.scheduler.now = 1.0
+        assert asc.tick(rt) is None           # held, not acted
+        assert asc.holds == 1
+        assert sup.scale_calls == []
+
+    def test_knee_inherited_from_admission_controller(self):
+        rt = FakeRouter()
+        rt.scheduler.admission = SimpleNamespace(
+            cfg=SimpleNamespace(overload_backlog_s=10.0,
+                                tpot_estimate=0.1))
+        asc = Autoscaler(AutoscaleConfig(scale_out_hold_s=0.0),
+                         spawn=lambda: FakeEngine())
+        rt.scheduler.backlog_tokens = 400
+        assert asc.tick(rt) == "out"
+        assert asc.est_drain_s == pytest.approx(10.0)
+
+    def test_no_knee_means_no_scale_out(self):
+        # without a bound (no admission, config zeros) overload is
+        # undefined — the controller must not act on garbage
+        rt = FakeRouter()
+        asc = Autoscaler(AutoscaleConfig(scale_out_hold_s=0.0),
+                         spawn=lambda: FakeEngine())
+        rt.scheduler.backlog_tokens = 10 ** 6
+        assert asc.tick(rt) is None
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            AutoscaleConfig(min_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(scale_out_frac=1.5)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(scale_in_occupancy=1.0)
+
+    def test_status_surface(self):
+        asc = Autoscaler(ACFG)
+        s = asc.status()
+        assert set(s) == {"est_drain_s", "occupancy",
+                          "scale_out_events", "scale_in_events",
+                          "holds", "last_action"}
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSpec transport (satellite: ckpt + prefill_buckets cross)
+# ---------------------------------------------------------------------------
+
+
+class TestSpecTransport:
+    def test_json_roundtrip_preserves_elastic_fields(self):
+        spec = dataclasses.replace(
+            SPEC.captured(), prefill_buckets=(8, 16),
+            ckpt_dir="/ckpts/run1", ckpt_step=7)
+        back = ReplicaSpec.from_json(spec.to_json())
+        assert back == spec
+        assert back.prefill_buckets == (8, 16)   # tuple, not list
+        assert back.ckpt_dir == "/ckpts/run1"
+        assert back.ckpt_step == 7
+        # and the argv encoding is stable json
+        assert json.loads(spec.to_json())["ckpt_step"] == 7
+
+
+# ---------------------------------------------------------------------------
+# Registry reclamation (satellite: flat scale cycles)
+# ---------------------------------------------------------------------------
+
+
+class TestDropLabeled:
+    def test_drops_only_the_matching_label_value(self):
+        r = MetricsRegistry()
+        r.register_callback("x_total", lambda: 1, kind="counter",
+                            labels={"replica": "0"})
+        r.register_callback("x_total", lambda: 2, kind="counter",
+                            labels={"replica": "1"})
+        r.register_callback("y_open", lambda: 0, kind="gauge",
+                            labels={"replica": "1"})
+        r.register_callback("z_total", lambda: 3, kind="counter")
+        assert r.drop_labeled("replica", "1") == 2
+        text = r.to_prometheus_text()
+        assert 'replica="1"' not in text
+        assert 'x_total{replica="0"} 1' in text
+        assert "z_total 3" in text
+        # idempotent
+        assert r.drop_labeled("replica", "1") == 0
+
+    def test_fleet_metrics_scrape_stays_flat_over_scale_cycles(self):
+        fm = FleetMetrics(num_replicas=2)
+        base = len(fm.registry.names())
+        for _ in range(3):
+            i = len(fm.replicas)
+            fm.add_replica()
+            fm.on_scale_event("out")
+            fm.on_voluntary_retire(i)
+            fm.on_scale_event("in")
+        # every cycle's labeled series were reclaimed
+        assert len(fm.registry.names()) == base
+        s = fm.summary()
+        assert s["elastic"]["fleet_size"] == 2
+        assert s["elastic"]["scale_events"] == {"out": 3, "in": 3}
+        assert s["supervisor"]["retired_voluntary"] == [2, 3, 4]
+
+    def test_scrape_equals_summary_for_elastic_series(self):
+        fm = FleetMetrics(num_replicas=2)
+        fm.add_replica()
+        fm.on_scale_event("out")
+        fm.on_rollout_started(7)
+        fm.on_rollout_completed(7)
+        text = fm.registry.to_prometheus_text()
+        s = fm.summary()
+        assert f'serve_fleet_size {s["elastic"]["fleet_size"]}' in text
+        assert ('serve_scale_events_total{direction="out"} '
+                f'{s["elastic"]["scale_events"]["out"]}') in text
+        assert ('serve_rollout_started_total '
+                f'{s["elastic"]["rollouts"]["started"]}') in text
+        assert ('serve_rollout_completed_total '
+                f'{s["elastic"]["rollouts"]["completed"]}') in text
+
+
+# ---------------------------------------------------------------------------
+# In-process membership churn (real router, real engines)
+# ---------------------------------------------------------------------------
+
+
+def build_fleet(params, replicas=2, **rkw):
+    engines = [ServingEngine(params, CFG,
+                             EngineConfig(num_slots=SLOTS))
+               for _ in range(replicas)]
+    sched = RequestScheduler(
+        SchedulerConfig(retry=RetryPolicy(max_attempts=3,
+                                          base_delay=0.0)),
+        num_slots=replicas * SLOTS)
+    fleet = FleetMetrics(replicas)
+    tracer = Tracer()
+    router = ReplicaRouter(engines, sched,
+                           RouterConfig(th=1, max_lag=3, **rkw),
+                           fleet=fleet, tracer=tracer)
+    return router, sched, fleet, tracer
+
+
+class TestInProcessMembership:
+    def test_join_mid_run_is_ranked_and_bitwise(self, params,
+                                                baseline):
+        router, sched, fleet, tracer = build_fleet(params)
+        for r in make_requests():
+            fleet.on_submit(r.rid)
+            sched.submit(r)
+        rounds = {"n": 0}
+
+        def on_round(r):
+            rounds["n"] += 1
+            if rounds["n"] == 3:
+                r.add_replica(ServingEngine(
+                    params, CFG, EngineConfig(num_slots=SLOTS)))
+            return False
+
+        results = router.run(max_rounds=3000, on_round=on_round)
+        assert_parity(baseline, results, "join")
+        assert len(router.replicas) == 3
+        assert router.replicas[2].ranked     # earned its rank
+        assert len(router.ledger.degraded) == 3
+        assert len(fleet.replicas) == 3      # metrics grew with it
+        assert_conformant(tracer)
+        kinds = [ev.fields["t"] for ev in tracer.events
+                 if ev.kind == "fleet_transition"]
+        assert "join" in kinds and "re_rank" in kinds
+
+    def test_scale_in_mid_run_migrates_bitwise(self, params,
+                                               baseline):
+        router, sched, fleet, tracer = build_fleet(params, replicas=3)
+        for r in make_requests():
+            fleet.on_submit(r.rid)
+            sched.submit(r)
+        rounds = {"n": 0}
+
+        def on_round(r):
+            rounds["n"] += 1
+            if rounds["n"] == 2:
+                r._t("scale_in", replica=2)
+                r.replicas[2].engine.request_drain()
+            return False
+
+        results = router.run(max_rounds=3000, on_round=on_round)
+        assert_parity(baseline, results, "scale-in")
+        assert router.replicas[2].retired
+        # exactly one terminal per arrival, none dropped
+        assert fleet.requests_completed + fleet.results_failed == N_REQ
+        assert fleet.results_failed == 0
+        assert_conformant(tracer)
+
+    def test_autoscaler_drives_a_full_cycle_in_process(self, params):
+        """Burst -> scale out (joiner serves) -> trough -> scale in
+        (victim drains, work migrates): one terminal per arrival and
+        a conformant membership trace, with the REAL controller in
+        the loop."""
+        router, sched, fleet, tracer = build_fleet(params)
+        asc = Autoscaler(
+            AutoscaleConfig(min_replicas=2, max_replicas=3,
+                            scale_out_frac=0.5, scale_out_hold_s=0.0,
+                            scale_in_hold_s=0.2, cooldown_s=0.0,
+                            overload_backlog_s=0.5,
+                            tpot_estimate=0.05),
+            spawn=lambda: ServingEngine(
+                params, CFG, EngineConfig(num_slots=SLOTS)))
+        reqs = make_requests(n=12, budget=5)
+        for r in reqs:
+            fleet.on_submit(r.rid)
+            sched.submit(r)
+
+        def on_round(r):
+            asc.tick(r)
+            # stay busy until the trough verdict has fired and its
+            # drain has settled
+            return asc.scale_in_events == 0 or any(
+                rep.engine.draining and not rep.retired
+                for rep in r.replicas)
+
+        results = router.run(max_rounds=5000, on_round=on_round)
+        assert asc.scale_out_events >= 1, asc.status()
+        assert asc.scale_in_events >= 1, asc.status()
+        assert len(results) == 12
+        assert all(reason in SUCCESS
+                   for _, reason in results.values())
+        assert fleet.requests_completed == 12
+        assert_conformant(tracer)
+
+
+# ---------------------------------------------------------------------------
+# Subprocess fabric: one real cell per elastic family
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory, params):
+    """A real checkpoint at step 7 holding PERTURBED weights —
+    distinguishable from the param_seed build, so provenance (did the
+    worker actually load it?) shows up in the tokens."""
+    d = tmp_path_factory.mktemp("elastic_ckpt")
+    bumped = jax.tree_util.tree_map(lambda x: x * 1.0625, params)
+    with CheckpointManager(CheckpointConfig(directory=str(d))) as mgr:
+        assert mgr.save(7, bumped, {"noop": np.zeros(1)}, force=True)
+    return str(d), bumped
+
+
+class TestSubprocessElastic:
+    def test_ckpt_and_buckets_cross_the_spec_bitwise(self, params,
+                                                     ckpt_dir):
+        """Satellite 1: checkpoint-backed params AND prefill_buckets
+        reach the worker, pinned bitwise against an in-process engine
+        built from the same checkpoint + buckets."""
+        d, bumped = ckpt_dir
+        buckets = (8, 16)
+        engine = ServingEngine(params, CFG, EngineConfig(
+            num_slots=SLOTS, prefill_buckets=buckets))
+        # provenance check: the perturbed weights must CHANGE tokens
+        sched = RequestScheduler(SchedulerConfig(), num_slots=SLOTS)
+        for r in make_requests(seed=77):
+            sched.submit(r)
+        seeded = serve_loop(engine, sched, max_dispatches=2000)
+
+        engine2 = ServingEngine(bumped, CFG, EngineConfig(
+            num_slots=SLOTS, prefill_buckets=buckets))
+        sched = RequestScheduler(SchedulerConfig(), num_slots=SLOTS)
+        for r in make_requests(seed=77):
+            sched.submit(r)
+        want = serve_loop(engine2, sched, max_dispatches=2000)
+        assert any(list(want[rid][0]) != list(seeded[rid][0])
+                   for rid in want), \
+            "perturbed checkpoint indistinguishable from seed build"
+
+        spec = dataclasses.replace(SPEC, prefill_buckets=buckets,
+                                   ckpt_dir=d, ckpt_step=7)
+        fleet = FleetMetrics(1)
+        with ReplicaSupervisor(spec, replicas=1, fleet=fleet,
+                               spawn_timeout_s=300.0) as sup:
+            sched = RequestScheduler(SchedulerConfig(),
+                                     num_slots=SLOTS)
+            router = ReplicaRouter(sup.engines, sched,
+                                   RouterConfig(th=1, max_lag=3),
+                                   fleet=fleet)
+            for r in make_requests(seed=77):
+                fleet.on_submit(r.rid)
+                sched.submit(r)
+            got = router.run(max_rounds=20000)
+            version = sup.checkpoint_version(0)
+        assert version == 7                  # self-reported provenance
+        assert_parity(want, got, "ckpt+buckets")
+
+    def test_scale_cycle_live_fleet_bitwise(self, baseline,
+                                            ckpt_dir):
+        """scale_to grows a live 2-replica fleet to 3 mid-traffic and
+        shrinks back: the joiner Hellos into the ranking, the retiree
+        SIGTERM-drains, results stay bitwise, and the retiree's
+        metrics series are reclaimed."""
+        fleet = FleetMetrics(2)
+        tracer = Tracer()
+        with ReplicaSupervisor(SPEC, replicas=2, fleet=fleet,
+                               tracer=tracer,
+                               spawn_timeout_s=300.0) as sup:
+            sched = RequestScheduler(
+                SchedulerConfig(retry=RetryPolicy(max_attempts=5,
+                                                  base_delay=0.0)),
+                num_slots=2 * SLOTS)
+            router = ReplicaRouter(sup.engines, sched,
+                                   RouterConfig(th=1, max_lag=3),
+                                   fleet=fleet, tracer=tracer)
+            for r in make_requests():
+                fleet.on_submit(r.rid)
+                sched.submit(r)
+            state = {"n": 0, "grown": False, "shrunk": False}
+
+            def on_round(r):
+                sup.pump(0.0)
+                state["n"] += 1
+                if state["n"] == 2 and not state["grown"]:
+                    state["grown"] = True
+                    sup.scale_to(3, router=r)
+                elif state["grown"] and not state["shrunk"] \
+                        and r.replicas[2].ranked:
+                    state["shrunk"] = True
+                    sup.scale_to(2)
+                # busy while the joiner is outside the ranking
+                return any(not rep.ranked and not rep.retired
+                           for rep in r.replicas)
+
+            results = router.run(max_rounds=30000,
+                                 on_round=on_round)
+            assert state["grown"] and state["shrunk"]
+            # let the retiree's exit reach the supervisor
+            deadline = time.monotonic() + 30.0
+            while sup.state(2) != "stopped" \
+                    and time.monotonic() < deadline:
+                sup.pump(0.05)
+            assert sup.state(2) == "stopped"
+            assert sup.live_count() == 2
+        assert_parity(baseline, results, "scale-cycle")
+        assert fleet.requests_completed + fleet.results_failed \
+            == N_REQ
+        # the retiree's labeled series were reclaimed (flat cycles)
+        assert 'replica="2"' not in fleet.registry.to_prometheus_text()
+        assert fleet.summary()["supervisor"]["retired_voluntary"] \
+            == [2]
+        assert_conformant(tracer)
+        kinds = [ev.fields["t"] for ev in tracer.events
+                 if ev.kind == "fleet_transition"]
+        assert "join" in kinds and "scale_in" in kinds
+
+    def test_rolling_rollout_live_fleet(self, params, ckpt_dir):
+        """The tentpole acceptance cell, 2-replica fast edition: a
+        rolling update to a perturbed checkpoint over a LIVE fleet
+        mid-traffic — zero dropped requests, every replica reporting
+        the new checkpoint_version, completed tokens explainable by
+        old or new weights (migration resumes bitwise under the
+        weights that finish the stream)."""
+        d, bumped = ckpt_dir
+        fleet = FleetMetrics(2)
+        tracer = Tracer()
+        with ReplicaSupervisor(SPEC, replicas=2, fleet=fleet,
+                               tracer=tracer,
+                               spawn_timeout_s=300.0) as sup:
+            sched = RequestScheduler(
+                SchedulerConfig(retry=RetryPolicy(max_attempts=5,
+                                                  base_delay=0.0)),
+                num_slots=2 * SLOTS)
+            router = ReplicaRouter(sup.engines, sched,
+                                   RouterConfig(th=1, max_lag=3),
+                                   fleet=fleet, tracer=tracer)
+            reqs = make_requests(n=10, budget=6)
+            for r in reqs:
+                fleet.on_submit(r.rid)
+                sched.submit(r)
+            started = {"done": False}
+
+            def on_round(r):
+                sup.pump(0.0)
+                if not started["done"]:
+                    started["done"] = True
+                    v = sup.begin_rollout(d)
+                    assert v == 7
+                sup.pump_rollout(r)
+                return sup.rollout_active
+
+            results = router.run(max_rounds=60000,
+                                 on_round=on_round)
+            status = [sup.checkpoint_version(i) for i in range(2)]
+        assert not sup.rollout_active
+        assert status == [7, 7], status
+        assert len(results) == 10            # zero dropped
+        assert all(reason in SUCCESS
+                   for _, reason in results.values())
+        # hybrid parity: old baseline for these requests, then every
+        # stream is old-bitwise or old-prefix + new-greedy tail
+        engine = ServingEngine(params, CFG,
+                               EngineConfig(num_slots=SLOTS))
+        sched = RequestScheduler(SchedulerConfig(), num_slots=SLOTS)
+        for r in make_requests(n=10, budget=6):
+            sched.submit(r)
+        old = serve_loop(engine, sched, max_dispatches=2000)
+        assert_hybrid_parity(reqs, results, old, bumped)
+        s = fleet.summary()
+        assert s["elastic"]["rollouts"]["started"] == 1
+        assert s["elastic"]["rollouts"]["completed"] == 1
+        assert s["elastic"]["rollouts"]["aborted"] == 0
+        assert_conformant(tracer)
+        kinds = [ev.fields["t"] for ev in tracer.events
+                 if ev.kind == "fleet_transition"]
+        assert kinds.count("rollout_drain") == 2
+        assert kinds.count("rollout_readmit") == 2
+
+
+def _greedy_under(params_tree, prompt, n, eos):
+    """Greedy continuation of ``prompt`` under ``params_tree`` — the
+    hybrid-parity oracle for streams that migrated mid-rollout."""
+    engine = ServingEngine(params_tree, CFG,
+                           EngineConfig(num_slots=1))
+    sched = RequestScheduler(SchedulerConfig(), num_slots=1)
+    sched.submit(Request(rid=0, prompt=tuple(prompt),
+                         max_new_tokens=n, eos_token=eos,
+                         submitted_at=0.0))
+    out = serve_loop(engine, sched, max_dispatches=500)
+    return list(out[0][0])
+
+
+def assert_hybrid_parity(reqs, results, old, new_params):
+    """Every completed stream must be explainable by the rollout's
+    weight timeline: bitwise the OLD baseline (served before/around
+    the wave, migrations resume bitwise on old-weights survivors),
+    or an old-weights prefix whose continuation is exactly greedy
+    decode under the NEW weights from that point (the stream's home
+    replica was rolled mid-flight or it landed on a rolled member).
+    Anything else — a drop, a corrupted resume, weights from nowhere
+    — fails."""
+    by_rid = {r.rid: r for r in reqs}
+    for rid, (toks, reason) in results.items():
+        toks = list(toks)
+        ref = list(old[rid][0])
+        if toks == ref:
+            continue
+        k0 = 0
+        while k0 < min(len(toks), len(ref)) and toks[k0] == ref[k0]:
+            k0 += 1
+        req = by_rid[rid]
+        cont = _greedy_under(
+            new_params, tuple(req.prompt) + tuple(toks[:k0]),
+            req.max_new_tokens - k0, req.eos_token)
+        assert toks[k0:] == cont, (
+            f"rid={rid}: tokens diverge from the old baseline at "
+            f"{k0} but the tail is not greedy-under-new-weights: "
+            f"{toks[k0:]} != {cont}")
+
+
+@pytest.mark.slow
+class TestChaosDuringElasticity:
+    def test_sigkill_mid_rollout_resumes_on_new_incarnation(
+            self, ckpt_dir):
+        """The chaos cell the acceptance names: SIGKILL the replica
+        being rolled out right after its respawn. The restart
+        machinery brings up ANOTHER incarnation — with the NEW spec —
+        the probe gates on it, and the old checkpoint is never
+        readmitted (conformance enforces version + incarnation)."""
+        d, _ = ckpt_dir
+        fleet = FleetMetrics(2)
+        tracer = Tracer()
+        with ReplicaSupervisor(SPEC, replicas=2, fleet=fleet,
+                               tracer=tracer,
+                               spawn_timeout_s=300.0) as sup:
+            sched = RequestScheduler(
+                SchedulerConfig(retry=RetryPolicy(max_attempts=5,
+                                                  base_delay=0.0)),
+                num_slots=2 * SLOTS)
+            router = ReplicaRouter(sup.engines, sched,
+                                   RouterConfig(th=1, max_lag=3),
+                                   fleet=fleet, tracer=tracer)
+            for r in make_requests(n=6):
+                fleet.on_submit(r.rid)
+                sched.submit(r)
+            state = {"started": False, "killed": False}
+
+            def on_round(r):
+                sup.pump(0.0)
+                if not state["started"]:
+                    state["started"] = True
+                    sup.begin_rollout(d, stall_timeout_s=240.0)
+                ro = sup.rollout_status()
+                if (not state["killed"] and ro is not None
+                        and ro["phase"] == "probe_wait"
+                        and ro["current"] is not None):
+                    i = ro["current"]
+                    if sup.state(i) == "up":
+                        state["killed"] = True
+                        sup.kill(i, signal.SIGKILL)
+                sup.pump_rollout(r)
+                return sup.rollout_active
+
+            results = router.run(max_rounds=120000,
+                                 on_round=on_round)
+            assert state["killed"], "the chaos kill never fired"
+            versions = [sup.checkpoint_version(i) for i in range(2)]
+            restarts = [sup.restarts(i) for i in range(2)]
+        assert versions == [7, 7]
+        assert sum(restarts) >= 1            # the kill forced one
+        assert len(results) == 6
+        assert all(reason in SUCCESS
+                   for _, reason in results.values())
+        s = fleet.summary()
+        assert s["elastic"]["rollouts"]["completed"] == 1
+        # conformance proves the stronger claim: every readmit was the
+        # NEW incarnation at the TARGET version
+        assert_conformant(tracer)
+
+    def test_sigstop_survivor_mid_scale_in(self, baseline):
+        """Scale-in while a SURVIVOR is SIGSTOPped: the retiree's
+        migrated work lands on the one healthy member, the lag ledger
+        sheds around the frozen one, and after SIGCONT the run ends
+        bitwise with one terminal per arrival."""
+        fleet = FleetMetrics(3)
+        tracer = Tracer()
+        with ReplicaSupervisor(SPEC, replicas=3, fleet=fleet,
+                               tracer=tracer,
+                               spawn_timeout_s=300.0) as sup:
+            sched = RequestScheduler(
+                SchedulerConfig(retry=RetryPolicy(max_attempts=5,
+                                                  base_delay=0.0)),
+                num_slots=3 * SLOTS)
+            router = ReplicaRouter(sup.engines, sched,
+                                   RouterConfig(th=1, max_lag=3),
+                                   fleet=fleet, tracer=tracer)
+            for r in make_requests():
+                fleet.on_submit(r.rid)
+                sched.submit(r)
+            state = {"n": 0}
+
+            def on_round(r):
+                sup.pump(0.0)
+                state["n"] += 1
+                if state["n"] == 2:
+                    sup.kill(1, signal.SIGSTOP)   # freeze a survivor
+                    sup.schedule_cont(1, 2.0)
+                    sup.retire_replica(2)         # and scale in
+                return False
+
+            results = router.run(max_rounds=60000,
+                                 on_round=on_round)
+        assert_parity(baseline, results, "sigstop+scale-in")
+        assert fleet.requests_completed + fleet.results_failed \
+            == N_REQ
+        assert_conformant(tracer)
+
+    def test_diurnal_scale_cycles_one_terminal_each(self, params):
+        """Repeated scale cycles (out/in x3) over an in-process fleet
+        under a continuous arrival stream: every arrival ends in
+        exactly one terminal record and the registry stays flat —
+        the soak shape of the PR 15 asserts, elastically."""
+        router, sched, fleet, tracer = build_fleet(params)
+        n = 24
+        reqs = make_requests(n=n, budget=4)
+        it = iter(reqs)
+        state = {"cycle": 0, "submitted": 0}
+
+        def spawn():
+            return ServingEngine(params, CFG,
+                                 EngineConfig(num_slots=SLOTS))
+
+        def on_round(r):
+            # drip-feed arrivals: two per round, a poor man's trace
+            for _ in range(2):
+                req = next(it, None)
+                if req is not None:
+                    fleet.on_submit(req.rid)
+                    sched.submit(req)
+                    state["submitted"] += 1
+            if state["submitted"] in (8, 16, 24) \
+                    and state["cycle"] < state["submitted"] // 8:
+                state["cycle"] += 1
+                r.add_replica(spawn())   # retired again once ranked
+            for rep in r.replicas[2:]:
+                if rep.ranked and not rep.retired \
+                        and not rep.engine.draining:
+                    r._t("scale_in", replica=rep.index)
+                    rep.engine.request_drain()
+                    if fleet is not None:
+                        fleet.on_voluntary_retire(rep.index)
+            return state["submitted"] < n
+
+        results = router.run(max_rounds=20000, on_round=on_round)
+        assert state["cycle"] == 3
+        assert set(results) == {r.rid for r in reqs}
+        assert all(reason in SUCCESS
+                   for _, reason in results.values())
+        assert fleet.requests_completed == n
+        # flat after churn: every joiner's labeled series reclaimed
+        # (names like engine_dispatch_* register lazily on first
+        # dispatch — label reclamation is the flatness contract)
+        text = fleet.registry.to_prometheus_text()
+        for i in (2, 3, 4):
+            assert f'replica="{i}"' not in text
+        assert fleet.summary()["supervisor"]["retired_voluntary"] \
+            == [2, 3, 4]
+        assert_conformant(tracer)

@@ -184,6 +184,118 @@ class TestAssertConformant:
         assert_conformant(tr)
 
 
+class TestElasticTraces:
+    """ISSUE 20: the membership / rollout vocabulary. Legal life-
+    cycles pass; every guard the extended model proves (unranked
+    members take no dispatches, one member out of rotation, readmit
+    only the NEW incarnation at the TARGET version, rollouts end)
+    rejects its illegal twin."""
+
+    def test_join_rank_serve_lifecycle(self):
+        assert check_events([
+            D("dispatch", rid=1, replica=0, mode="primary"),
+            D("join", replica=1),
+            D("re_rank", replica=1),
+            D("dispatch", rid=2, replica=1, mode="primary"),
+            D("result", rid=1, replica=0),
+            D("result", rid=2, replica=1),
+        ]) == []
+
+    def test_scale_in_drains_voluntarily(self):
+        assert check_events([
+            D("dispatch", rid=1, replica=1, mode="primary"),
+            D("scale_in", replica=1),
+            D("snapshot", rid=1, replica=1),
+            D("stopped", replica=1),
+            D("retire", replica=1),
+            D("dispatch", rid=1, replica=0, mode="resume"),
+            D("result", rid=1, replica=0),
+        ]) == []
+
+    def test_full_rollout_lifecycle(self):
+        # drain -> retire -> respawn (inc bump) -> readmit at the
+        # target version -> re_rank: the exact event shape the
+        # supervisor's pump_rollout + router emit
+        assert check_events([
+            D("rollout_started", version=7),
+            D("rollout_drain", replica=0, version=7),
+            D("stopped", replica=0),
+            D("retire", replica=0),
+            D("restart", replica=0, inc=1),
+            D("rollout_readmit", replica=0, version=7, inc=1),
+            D("re_rank", replica=0),
+            D("rollout_completed", version=7),
+        ]) == []
+
+    def test_sigkill_mid_rollout_readmits_new_incarnation(self):
+        # the chaos cell: the rolling replica dies after respawn; the
+        # restart machinery brings up ANOTHER incarnation (new spec)
+        # and the probe readmits that one
+        assert check_events([
+            D("rollout_started", version=7),
+            D("rollout_drain", replica=0, version=7),
+            D("stopped", replica=0),
+            D("retire", replica=0),
+            D("restart", replica=0, inc=1),
+            D("death", replica=0),
+            D("restart", replica=0, inc=2),
+            D("rollout_readmit", replica=0, version=7, inc=2),
+            D("re_rank", replica=0),
+            D("rollout_completed", version=7),
+        ]) == []
+
+    def test_aborted_rollout_leaves_member_out(self):
+        assert check_events([
+            D("rollout_started", version=7),
+            D("rollout_drain", replica=0, version=7),
+            D("rollout_aborted", version=7),
+        ]) == []
+
+    @pytest.mark.parametrize("events,needle", [
+        # membership gates
+        ([D("join", replica=1),
+          D("dispatch", rid=1, replica=1, mode="primary"),
+          D("result", rid=1, replica=1)],
+         "membership gate bypassed"),
+        ([D("re_rank", replica=1)], "not unranked"),
+        ([D("death", replica=0), D("scale_in", replica=0)],
+         "scale-in of replica 0 in state"),
+        # rollout discipline
+        ([D("rollout_started", version=7),
+          D("rollout_started", version=8)], "another rollout"),
+        ([D("rollout_drain", replica=0)], "no active rollout"),
+        ([D("rollout_started", version=7),
+          D("rollout_drain", replica=0, version=7),
+          D("rollout_drain", replica=1, version=7)],
+         "more than one member out"),
+        # the old checkpoint can never be readmitted
+        ([D("rollout_started", version=7),
+          D("rollout_drain", replica=0, version=7),
+          D("restart", replica=0, inc=1),
+          D("rollout_readmit", replica=0, version=0, inc=1)],
+         "old checkpoint"),
+        # ... nor the old process
+        ([D("rollout_started", version=7),
+          D("rollout_drain", replica=0, version=7),
+          D("rollout_readmit", replica=0, version=7, inc=0)],
+         "old process"),
+        ([D("rollout_started", version=7),
+          D("rollout_drain", replica=0, version=7),
+          D("rollout_completed", version=7)],
+         "still out of rotation"),
+        # a rollout must END
+        ([D("rollout_started", version=7)], "stuck rollout"),
+    ], ids=["dispatch-to-unranked", "re-rank-not-unranked",
+            "scale-in-dead-member", "nested-rollout",
+            "drain-without-rollout", "two-members-out",
+            "old-checkpoint-readmitted", "old-process-readmitted",
+            "completed-while-out", "stuck-rollout"])
+    def test_elastic_guard_rejects(self, events, needle):
+        bad = check_events(events)
+        assert bad, f"checker accepted an illegal trace ({needle})"
+        assert any(needle in v for v in bad), bad
+
+
 class TestDrainFleetWasteRegression:
     """The true finding this PR's model checker surfaced, pinned at
     the trace level: a fleet drain that collapses a hedged rid's two
